@@ -1,0 +1,26 @@
+"""kube_gpu_stats_tpu — a TPU-native accelerator-telemetry framework for Kubernetes.
+
+A from-scratch rebuild of the capability surface of ``kanglanglang/kube_gpu_stats``
+(a Kubernetes GPU statistics exporter; see SURVEY.md — the reference mount was
+empty at survey time, so all parity claims cite SURVEY.md sections rather than
+reference file:line) with no CUDA/NVML userspace:
+
+- device-poll loop over libtpu runtime counters and ``/sys/class/accel``
+- per-chip MXU duty cycle, HBM used/total, ICI link bandwidth, chip power as
+  Prometheus ``accelerator_*`` gauges
+- pod<->device attribution via the kubelet PodResources API
+  (GKE TPU device-plugin allocations)
+- mock/null collector for CPU-only nodes
+- DaemonSet deployment with HTTP ``/metrics`` and node_exporter textfile output
+
+Layer map (SURVEY.md §1):
+
+    L0 collectors/   device backends (mock, sysfs, libtpu, composite)
+    L1 poll.py       the 1 Hz latency-budgeted hot loop
+    L2 attribution/  kubelet PodResources client, cached off the hot path
+    L3 schema.py + registry.py   metric contract + atomic snapshot store
+    L4 exposition.py HTTP server + textfile writer
+    L5 cli.py/daemon.py + deploy/   flags, wiring, k8s manifests
+"""
+
+__version__ = "0.1.0"

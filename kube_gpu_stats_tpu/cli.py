@@ -1,0 +1,18 @@
+"""CLI entry point (layer L5, SURVEY.md §1): `kube-tpu-stats` / `python -m
+kube_gpu_stats_tpu`."""
+
+from __future__ import annotations
+
+import sys
+from typing import Sequence
+
+from .config import from_args
+from .daemon import run
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    return run(from_args(argv))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Device backends (layer L0, SURVEY.md §1).
+
+The reference's L0 is an NVML/DCGM collector shelling to / linking against
+nvidia-smi (SURVEY.md §2 C1). Here L0 is a small trait with three
+implementations and zero NVML anywhere:
+
+- :mod:`.mock`   — deterministic synthetic devices (C7): product feature for
+                   CPU-only nodes *and* the universal test fixture.
+- :mod:`.sysfs`  — ``/sys/class/accel`` enumeration + attribute reads (C11).
+- :mod:`.libtpu` — libtpu runtime-metrics gRPC client (C11).
+- :mod:`.composite` — merges sysfs static/environmental data with libtpu
+                   runtime counters into one sample per chip.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Device:
+    """One local accelerator chip.
+
+    ``device_id`` is the stable node-local identity used for attribution
+    joins; for TPUs this is the id the GKE device-plugin reports to kubelet
+    (e.g. "0"-"3" or "/dev/accel0"-style, version dependent) — the
+    attribution layer matches on several candidate forms (SURVEY.md §7
+    hard part c).
+    """
+
+    index: int
+    device_id: str
+    device_path: str  # "/dev/accel0"
+    accel_type: str  # "tpu-v5p", "mock", ...
+    uuid: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Sample:
+    """One poll of one device.
+
+    ``values`` maps metric-family name (schema.py) -> value.
+    ``ici_counters`` maps link name -> cumulative traffic bytes; the poll
+    loop turns deltas into bandwidth gauges (C10 rate math lives OFF the
+    collector so every backend gets wraparound handling for free).
+    """
+
+    device: Device
+    values: Mapping[str, float]
+    ici_counters: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    collective_ops: int | None = None
+
+
+class CollectorError(RuntimeError):
+    """A sample failed; the poll loop marks the device stale (never crashes —
+    SURVEY.md §5 failure-detection contract for a DaemonSet)."""
+
+
+class Collector(abc.ABC):
+    """L0 trait: ``discover() -> [Device]``, ``sample(Device) -> Sample``."""
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def discover(self) -> Sequence[Device]:
+        """Enumerate local devices. Called at startup and on rediscovery —
+        never on the poll hot path."""
+
+    @abc.abstractmethod
+    def sample(self, device: Device) -> Sample:
+        """Read one device's current counters. Hot path: must be fast and
+        must raise CollectorError (not crash) on backend failure."""
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass

@@ -1,0 +1,92 @@
+"""Mock collector (C7): schema-valid synthetic telemetry with no accelerator.
+
+Shippable product feature for CPU-only nodes (BASELINE.json configs[0]) and
+the fixture every test layer builds on (SURVEY.md §4 "fake backends"). The
+reference genre does the same with a stub nvidia-smi on PATH; here it is a
+first-class Collector.
+
+Values are deterministic functions of (chip, tick) so golden tests are
+byte-stable: duty cycle is a per-chip phase-shifted triangle wave, HBM a
+slow ramp, ICI counters advance at a chip-dependent constant rate.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Sequence
+
+from . import Collector, CollectorError, Device, Sample
+from .. import schema
+
+_HBM_TOTAL = 95 * 1024**3  # v5p-class HBM capacity, bytes
+_LINKS = ("x0", "x1", "y0", "y1", "z0", "z1")  # v5p 3D-torus link names
+
+
+class MockCollector(Collector):
+    name = "mock"
+
+    def __init__(
+        self,
+        num_devices: int = 4,
+        accel_type: str = "mock",
+        fail_devices: Sequence[int] = (),
+        start_tick: int = 0,
+    ) -> None:
+        self._num = num_devices
+        self._accel_type = accel_type
+        self._fail = frozenset(fail_devices)
+        # Per-device tick counters so each sample advances deterministically
+        # regardless of call interleaving.
+        self._ticks = [itertools.count(start_tick) for _ in range(num_devices)]
+
+    def discover(self) -> Sequence[Device]:
+        return [
+            Device(
+                index=i,
+                device_id=str(i),
+                device_path=f"/dev/accel{i}",
+                accel_type=self._accel_type,
+                uuid=f"mock-{i:04x}",
+            )
+            for i in range(self._num)
+        ]
+
+    def sample(self, device: Device) -> Sample:
+        if device.index in self._fail:
+            raise CollectorError(f"mock failure injected for chip {device.index}")
+        tick = next(self._ticks[device.index])
+        # Triangle wave 0..100 with period 60 ticks, phase-shifted per chip.
+        phase = (tick + device.index * 7) % 60
+        duty = (phase if phase <= 30 else 60 - phase) * (100.0 / 30.0)
+        hbm_used = int(_HBM_TOTAL * (0.10 + 0.008 * ((tick + device.index) % 100)))
+        values = {
+            schema.DUTY_CYCLE.name: duty,
+            schema.TENSORCORE_UTIL.name: duty * 0.85,
+            schema.MEMORY_USED.name: float(hbm_used),
+            schema.MEMORY_TOTAL.name: float(_HBM_TOTAL),
+            schema.POWER.name: 90.0 + duty * 2.5,
+            schema.TEMPERATURE.name: 35.0 + duty * 0.3,
+        }
+        # Cumulative link counters: constant per-link rate, distinct per chip
+        # so multi-host tests can tell series apart.
+        rate = 1_000_000 * (device.index + 1)
+        ici = {link: (tick + 1) * rate * (li + 1) for li, link in enumerate(_LINKS)}
+        return Sample(
+            device=device,
+            values=values,
+            ici_counters=ici,
+            collective_ops=(tick + 1) * 10 * (device.index + 1),
+        )
+
+
+class NullCollector(Collector):
+    """Zero devices: exposition stays schema-valid (self-metrics only) on
+    nodes with no accelerator and mock mode disabled."""
+
+    name = "null"
+
+    def discover(self) -> Sequence[Device]:
+        return []
+
+    def sample(self, device: Device) -> Sample:  # pragma: no cover
+        raise CollectorError("null collector has no devices")

@@ -1,0 +1,128 @@
+"""Configuration: CLI flags + environment (component C6, SURVEY.md §2/§5).
+
+Flag surface mirrors the genre contract SURVEY.md §5 lists: poll interval,
+listen port, textfile dir, backend selection auto/tpu/mock/null, kubelet
+socket path, attribution toggles, and the libtpu metrics port env
+(``TPU_RUNTIME_METRICS_PORTS``). Every flag also reads a ``KTS_*`` env var so
+the DaemonSet manifest can configure the container without args churn.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Sequence
+
+DEFAULT_KUBELET_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+DEFAULT_CHECKPOINT = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
+DEFAULT_LIBTPU_PORT = 8431  # TPU_RUNTIME_METRICS_PORTS default (SURVEY.md §2 C11)
+
+BACKENDS = ("auto", "tpu", "mock", "null")
+
+
+@dataclasses.dataclass
+class Config:
+    backend: str = "auto"
+    interval: float = 1.0
+    deadline: float = 0.050  # per-tick budget, BASELINE.md north star
+    listen_host: str = "0.0.0.0"
+    listen_port: int = 9400
+    textfile_dir: str = ""  # empty = textfile output disabled
+    sysfs_root: str = "/sys"
+    libtpu_ports: tuple[int, ...] = (DEFAULT_LIBTPU_PORT,)
+    libtpu_addr: str = "127.0.0.1"
+    attribution: str = "auto"  # auto|podresources|checkpoint|off
+    kubelet_socket: str = DEFAULT_KUBELET_SOCKET
+    checkpoint_path: str = DEFAULT_CHECKPOINT
+    attribution_interval: float = 10.0
+    mock_devices: int = 4
+    use_native: bool = True  # C++ fast path when the shared lib is present
+    log_level: str = "info"
+
+    @property
+    def textfile_enabled(self) -> bool:
+        return bool(self.textfile_dir)
+
+
+def _env(name: str, default: str | None = None) -> str | None:
+    return os.environ.get("KTS_" + name, default)
+
+
+def _env_bool(name: str) -> bool:
+    raw = os.environ.get("KTS_" + name, "")
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def parse_libtpu_ports(raw: str) -> tuple[int, ...]:
+    """Parse TPU_RUNTIME_METRICS_PORTS: comma/space separated port list."""
+    ports = []
+    for token in raw.replace(",", " ").split():
+        ports.append(int(token))
+    return tuple(ports) or (DEFAULT_LIBTPU_PORT,)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kube-tpu-stats",
+        description="TPU-native accelerator telemetry exporter for Kubernetes",
+    )
+    p.add_argument("--backend", choices=BACKENDS,
+                   default=_env("BACKEND", "auto"),
+                   help="device backend; auto probes tpu then falls back to null")
+    p.add_argument("--interval", type=float,
+                   default=float(_env("INTERVAL", "1.0")),
+                   help="poll interval seconds (default 1.0 = 1 Hz)")
+    p.add_argument("--deadline", type=float,
+                   default=float(_env("DEADLINE", "0.050")),
+                   help="per-tick sampling deadline seconds")
+    p.add_argument("--listen-host", default=_env("LISTEN_HOST", "0.0.0.0"))
+    p.add_argument("--listen-port", type=int,
+                   default=int(_env("LISTEN_PORT", "9400")))
+    p.add_argument("--textfile-dir", default=_env("TEXTFILE_DIR", ""),
+                   help="node_exporter textfile dir; empty disables")
+    p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"))
+    p.add_argument("--libtpu-addr", default=_env("LIBTPU_ADDR", "127.0.0.1"))
+    p.add_argument("--libtpu-ports",
+                   default=_env("LIBTPU_PORTS",
+                                os.environ.get("TPU_RUNTIME_METRICS_PORTS",
+                                               str(DEFAULT_LIBTPU_PORT))),
+                   help="libtpu runtime metrics ports (comma separated)")
+    p.add_argument("--attribution",
+                   choices=("auto", "podresources", "checkpoint", "off"),
+                   default=_env("ATTRIBUTION", "auto"))
+    p.add_argument("--kubelet-socket",
+                   default=_env("KUBELET_SOCKET", DEFAULT_KUBELET_SOCKET))
+    p.add_argument("--checkpoint-path",
+                   default=_env("CHECKPOINT_PATH", DEFAULT_CHECKPOINT))
+    p.add_argument("--attribution-interval", type=float,
+                   default=float(_env("ATTRIBUTION_INTERVAL", "10.0")))
+    p.add_argument("--mock-devices", type=int,
+                   default=int(_env("MOCK_DEVICES", "4")))
+    p.add_argument("--no-native", action="store_true",
+                   default=_env_bool("NO_NATIVE"),
+                   help="disable the C++ fast-path sampler")
+    p.add_argument("--log-level", default=_env("LOG_LEVEL", "info"))
+    return p
+
+
+def from_args(argv: Sequence[str] | None = None) -> Config:
+    args = build_parser().parse_args(argv)
+    return Config(
+        backend=args.backend,
+        interval=args.interval,
+        deadline=args.deadline,
+        listen_host=args.listen_host,
+        listen_port=args.listen_port,
+        textfile_dir=args.textfile_dir,
+        sysfs_root=args.sysfs_root,
+        libtpu_addr=args.libtpu_addr,
+        libtpu_ports=parse_libtpu_ports(args.libtpu_ports),
+        attribution=args.attribution,
+        kubelet_socket=args.kubelet_socket,
+        checkpoint_path=args.checkpoint_path,
+        attribution_interval=args.attribution_interval,
+        mock_devices=args.mock_devices,
+        use_native=not args.no_native,
+        log_level=args.log_level,
+    )

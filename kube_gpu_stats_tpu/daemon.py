@@ -1,0 +1,145 @@
+"""Process wiring — entry point E1 (SURVEY.md §3).
+
+main() → parse flags (C6) → detect backend (TPU present? else mock/null,
+C7/C11) → discover() devices → start attribution watcher (C3) → registry
+(C4) → HTTP server (C5) → poll loop (C2). Process-boundary crossings:
+kubelet gRPC over unix socket, libtpu metrics gRPC over localhost TCP.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+from . import __version__, topology
+from .config import Config
+from .collectors import Collector
+from .collectors.mock import MockCollector, NullCollector
+from .exposition import MetricsServer, TextfileWriter
+from .poll import AttributionProvider, NullAttribution, PollLoop
+from .registry import Registry
+
+log = logging.getLogger(__name__)
+
+
+def detect_tpu(cfg: Config) -> bool:
+    """Is a TPU visible on this node? Cheap sysfs probe (SURVEY.md §1 L0)."""
+    from .collectors.sysfs import SysfsCollector
+
+    return bool(SysfsCollector(cfg.sysfs_root).discover())
+
+
+def build_collector(cfg: Config) -> Collector:
+    if cfg.backend == "mock":
+        return MockCollector(num_devices=cfg.mock_devices)
+    if cfg.backend == "null":
+        return NullCollector()
+    if cfg.backend == "tpu":
+        return _tpu_collector(cfg)
+    # auto: TPU when present, else a schema-valid null exporter
+    # (BASELINE.json configs[0] behavior on CPU-only nodes).
+    try:
+        if detect_tpu(cfg):
+            return _tpu_collector(cfg)
+    except Exception as exc:
+        log.warning("TPU probe failed (%s); falling back to null backend", exc)
+    return NullCollector()
+
+
+def _tpu_collector(cfg: Config) -> Collector:
+    from .collectors.composite import TpuCollector
+
+    return TpuCollector(
+        sysfs_root=cfg.sysfs_root,
+        libtpu_addr=cfg.libtpu_addr,
+        libtpu_ports=cfg.libtpu_ports,
+        use_native=cfg.use_native,
+    )
+
+
+def build_attribution(cfg: Config) -> AttributionProvider:
+    if cfg.attribution == "off":
+        return NullAttribution()
+    try:
+        from .attribution import build as build_attr
+
+        return build_attr(
+            mode=cfg.attribution,
+            kubelet_socket=cfg.kubelet_socket,
+            checkpoint_path=cfg.checkpoint_path,
+            refresh_interval=cfg.attribution_interval,
+        )
+    except Exception as exc:
+        # Attribution is an enrichment, never a reason for the DaemonSet to
+        # crash-loop (SURVEY.md §5): degrade to unattributed metrics.
+        log.warning("attribution unavailable (%s); exporting without pod labels",
+                    exc)
+        return NullAttribution()
+
+
+class Daemon:
+    """Owns every long-lived component; start()/stop() are idempotent-ish
+    and stop() tears down in reverse order."""
+
+    def __init__(self, cfg: Config) -> None:
+        self.cfg = cfg
+        self.registry = Registry()
+        self.collector = build_collector(cfg)
+        self.attribution = build_attribution(cfg)
+        self.poll = PollLoop(
+            self.collector,
+            self.registry,
+            interval=cfg.interval,
+            deadline=cfg.deadline,
+            attribution=self.attribution,
+            topology_labels=topology.topology_labels(),
+            version=__version__,
+        )
+        self.server = MetricsServer(self.registry, cfg.listen_host, cfg.listen_port)
+        self.textfile = (
+            TextfileWriter(self.registry, cfg.textfile_dir)
+            if cfg.textfile_enabled
+            else None
+        )
+
+    def start(self) -> None:
+        starter = getattr(self.attribution, "start", None)
+        if starter:
+            starter()
+        self.server.start()
+        if self.textfile:
+            self.textfile.start()
+        self.poll.start()
+        log.info(
+            "kube-tpu-stats %s: backend=%s devices=%d listening on %s:%d",
+            __version__, self.collector.name, len(self.poll.devices),
+            self.cfg.listen_host, self.server.port,
+        )
+
+    def stop(self) -> None:
+        self.poll.stop()
+        if self.textfile:
+            self.textfile.stop()
+        self.server.stop()
+        stopper = getattr(self.attribution, "stop", None)
+        if stopper:
+            stopper()
+        self.collector.close()
+
+
+def run(cfg: Config) -> int:
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    daemon = Daemon(cfg)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    daemon.start()
+    try:
+        stop.wait()
+    finally:
+        daemon.stop()
+    return 0

@@ -1,0 +1,134 @@
+"""Exposition layer (component C5, SURVEY.md §1 L4).
+
+Two outputs, matching the reference's (SURVEY.md §2 C5):
+
+- HTTP ``GET /metrics`` — Prometheus scrape endpoint. Renders the last
+  published snapshot; never touches collector state, so a scrape storm
+  cannot perturb the poll budget (SURVEY.md §3 E3).
+- node_exporter textfile — ``<dir>/accelerator.prom`` rewritten atomically
+  (tmp + rename) after each poll tick (BASELINE.json configs[0]).
+"""
+
+from __future__ import annotations
+
+import http.server
+import logging
+import os
+import threading
+from pathlib import Path
+
+from .registry import Registry
+
+log = logging.getLogger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Threaded HTTP server for /metrics, /healthz and /."""
+
+    def __init__(self, registry: Registry, host: str = "0.0.0.0", port: int = 9400):
+        self._registry = registry
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            # Scrapes arrive at >= 1/s per Prometheus; default logging to
+            # stderr per request would swamp the DaemonSet logs.
+            def log_message(self, fmt: str, *args) -> None:
+                log.debug("http: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer._registry.snapshot().render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                elif path == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                elif path == "/":
+                    body = (
+                        b"<html><body>kube-tpu-stats "
+                        b'<a href="/metrics">/metrics</a></body></html>'
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when constructed with port 0 in tests)."""
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class TextfileWriter:
+    """Writes the snapshot to `<dir>/accelerator.prom` atomically.
+
+    node_exporter's textfile collector reads *.prom files; a partially
+    written file would be scraped as corrupt, hence tmp + os.replace (atomic
+    on POSIX within one filesystem).
+    """
+
+    def __init__(self, registry: Registry, directory: str | os.PathLike,
+                 filename: str = "accelerator.prom") -> None:
+        self._registry = registry
+        self._dir = Path(directory)
+        self._path = self._dir / filename
+        self._tmp = self._dir / (filename + ".tmp")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def path(self) -> Path:
+        return self._path
+
+    def write_once(self) -> None:
+        self._dir.mkdir(parents=True, exist_ok=True)
+        text = self._registry.snapshot().render()
+        self._tmp.write_text(text)
+        os.replace(self._tmp, self._path)
+
+    def run_forever(self) -> None:
+        generation = self._registry.generation
+        while not self._stop.is_set():
+            if self._registry.wait_for_publish(generation, timeout=0.5):
+                generation = self._registry.generation
+                try:
+                    self.write_once()
+                except OSError as exc:
+                    log.warning("textfile write failed: %s", exc)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, name="textfile-writer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

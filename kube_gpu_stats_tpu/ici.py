@@ -1,0 +1,51 @@
+"""ICI counter → bandwidth rate math (component C10, SURVEY.md §2).
+
+The GPU reference's analog is NVML NVLink counter deltas (SURVEY.md §5
+"distributed communication backend": the exporter *measures* interconnects,
+it never uses them). Wraparound/reset semantics are SURVEY.md §7 hard part
+(d): a counter that goes backwards means the device or runtime restarted —
+emit no rate for that interval rather than a huge negative/positive spike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class _Last:
+    value: int
+    monotonic: float
+
+
+class RateTracker:
+    """Turns cumulative per-(device, link) counters into byte/s rates.
+
+    Single-writer (the poll loop); no locking needed. Keys are opaque
+    (device_id, link) tuples so the tracker also serves collective-op rates.
+    """
+
+    def __init__(self) -> None:
+        self._last: dict[tuple[str, str], _Last] = {}
+
+    def rate(self, device_id: str, link: str, value: int, now: float) -> float | None:
+        """Return bytes/sec since the previous observation, or None when no
+        rate can be computed (first sample, reset/wraparound, zero dt)."""
+        key = (device_id, link)
+        prev = self._last.get(key)
+        self._last[key] = _Last(value, now)
+        if prev is None:
+            return None
+        dt = now - prev.monotonic
+        if dt <= 0:
+            return None
+        delta = value - prev.value
+        if delta < 0:
+            # Counter reset (libtpu restart, SURVEY.md §5 failure handling):
+            # drop this interval; next tick re-establishes the baseline.
+            return None
+        return delta / dt
+
+    def forget_device(self, device_id: str) -> None:
+        for key in [k for k in self._last if k[0] == device_id]:
+            del self._last[key]

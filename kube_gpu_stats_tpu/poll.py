@@ -1,0 +1,248 @@
+"""Device-poll loop (component C2) — the latency-critical hot loop.
+
+Budget (BASELINE.md): all per-chip metrics at 1 Hz with p50 tick latency
+< 50 ms. Per SURVEY.md §3 E2 the design rules are:
+
+- per-chip sampling fans out in parallel with a hard per-tick deadline —
+  never serialized across chips;
+- attribution is a cached in-memory join (C3 refreshes on its own cadence,
+  E4) — no RPC on this path;
+- publishing is one snapshot swap — scrape traffic can't block a tick;
+- any per-device failure marks that device stale (accelerator_up 0) and the
+  loop keeps running: a DaemonSet pod must survive libtpu restarts and
+  kubelet socket loss (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Callable, Mapping, Protocol, Sequence
+
+from . import schema
+from .collectors import Collector, Device, Sample
+from .ici import RateTracker
+from .registry import HistogramState, Registry, SnapshotBuilder
+
+log = logging.getLogger(__name__)
+
+_METRICS_BY_NAME = {spec.name: spec for spec in schema.PER_DEVICE_METRICS}
+
+
+class AttributionProvider(Protocol):
+    """Cached device→pod mapping (C3). `lookup` must be RPC-free."""
+
+    def lookup(self, device: Device) -> Mapping[str, str]:
+        """Return {"pod": ..., "namespace": ..., "container": ...} or {}."""
+        ...
+
+
+class NullAttribution:
+    def lookup(self, device: Device) -> Mapping[str, str]:
+        return {}
+
+
+class PollLoop:
+    def __init__(
+        self,
+        collector: Collector,
+        registry: Registry,
+        *,
+        interval: float = 1.0,
+        deadline: float = 0.050,
+        attribution: AttributionProvider | None = None,
+        topology_labels: Mapping[str, str] | None = None,
+        max_workers: int | None = None,
+        version: str = "dev",
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._collector = collector
+        self._registry = registry
+        self._interval = interval
+        self._deadline = deadline
+        self._attribution = attribution or NullAttribution()
+        self._topology = dict(topology_labels or {})
+        self._version = version
+        self._clock = clock
+
+        self._devices: Sequence[Device] = collector.discover()
+        workers = max_workers or max(4, len(self._devices))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="sampler"
+        )
+        self._rates = RateTracker()
+        # Futures for samples that missed their deadline but are still
+        # running: future.cancel() cannot stop a running call, so until it
+        # finishes we must not submit another sample for that device or a
+        # wedged backend would leak one pool worker per tick.
+        self._outstanding: dict[str, concurrent.futures.Future] = {}
+        self._hist = HistogramState.empty(
+            schema.SELF_POLL_DURATION, schema.POLL_DURATION_BUCKETS
+        )
+        self._errors: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Retained last-known MEMORY_TOTAL per device so a stale tick keeps
+        # capacity gauges stable instead of dropping series.
+        self._last_totals: dict[str, float] = {}
+
+    # -- public --------------------------------------------------------------
+
+    @property
+    def devices(self) -> Sequence[Device]:
+        return self._devices
+
+    @property
+    def poll_histogram(self) -> HistogramState:
+        return self._hist
+
+    def rediscover(self) -> None:
+        """Re-enumerate devices (startup / explicit recovery; not hot path).
+        Purges per-device rate/capacity state for devices that disappeared so
+        a renumbered chip never inherits another chip's counter baseline."""
+        self._devices = self._collector.discover()
+        alive = {dev.device_id for dev in self._devices}
+        for device_id in list(self._last_totals):
+            if device_id not in alive:
+                del self._last_totals[device_id]
+                self._rates.forget_device(device_id)
+        for device_id in [d for d in self._outstanding if d not in alive]:
+            self._outstanding.pop(device_id).cancel()
+
+    def tick(self) -> float:
+        """Run one poll over all devices; publish a snapshot; return tick
+        duration in seconds."""
+        start = self._clock()
+        results = self._sample_all()
+        duration = self._clock() - start
+        self._hist = self._hist.observe(duration)
+        snapshot = self._build_snapshot(results, now=start + duration)
+        self._registry.publish(snapshot)
+        return duration
+
+    def run_forever(self) -> None:
+        """Drift-free fixed-rate loop until stop()."""
+        next_fire = self._clock()
+        while not self._stop.is_set():
+            self.tick()
+            next_fire += self._interval
+            delay = next_fire - self._clock()
+            if delay <= 0:
+                # Ticks are overrunning the interval; resynchronize rather
+                # than firing a burst of catch-up ticks.
+                next_fire = self._clock()
+                continue
+            self._stop.wait(delay)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, name="poll-loop", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- internals -----------------------------------------------------------
+
+    def _sample_all(self) -> list[tuple[Device, Sample | None]]:
+        if not self._devices:
+            return []
+        futures: dict[concurrent.futures.Future, Device] = {}
+        results: list[tuple[Device, Sample | None]] = []
+        for dev in self._devices:
+            stuck = self._outstanding.get(dev.device_id)
+            if stuck is not None:
+                if not stuck.done():
+                    # Previous sample is still wedged inside the backend;
+                    # mark stale again rather than stacking another worker.
+                    self._count_error("stuck")
+                    results.append((dev, None))
+                    continue
+                del self._outstanding[dev.device_id]  # finally finished
+            futures[self._pool.submit(self._collector.sample, dev)] = dev
+        deadline = self._clock() + self._deadline
+        for future, dev in futures.items():
+            remaining = max(0.0, deadline - self._clock())
+            try:
+                results.append((dev, future.result(timeout=remaining)))
+            except concurrent.futures.TimeoutError:
+                if not future.cancel():
+                    self._outstanding[dev.device_id] = future
+                self._count_error("deadline")
+                log.warning("sample of %s missed the %gs deadline",
+                            dev.device_path, self._deadline)
+                results.append((dev, None))
+            except Exception as exc:  # CollectorError and anything else
+                self._count_error(type(exc).__name__)
+                log.warning("sample of %s failed: %s", dev.device_path, exc)
+                results.append((dev, None))
+        results.sort(key=lambda pair: pair[0].index)
+        return results
+
+    def _count_error(self, reason: str) -> None:
+        self._errors[reason] = self._errors.get(reason, 0) + 1
+
+    def _device_labels(self, dev: Device) -> list[tuple[str, str]]:
+        attribution = self._attribution.lookup(dev)
+        labels = [
+            ("accel_type", dev.accel_type),
+            ("chip", str(dev.index)),
+            ("device_path", dev.device_path),
+            ("uuid", dev.uuid),
+        ]
+        for key in schema.ATTRIBUTION_LABELS:
+            labels.append((key, attribution.get(key, "")))
+        for key in schema.TOPOLOGY_LABELS:
+            labels.append((key, self._topology.get(key, "")))
+        return labels
+
+    def _build_snapshot(
+        self, results: list[tuple[Device, Sample | None]], now: float
+    ):
+        builder = SnapshotBuilder()
+        by_name = _METRICS_BY_NAME
+        for dev, sample in results:
+            base = self._device_labels(dev)
+            if sample is None:
+                builder.add(schema.DEVICE_UP, 0.0, base)
+                total = self._last_totals.get(dev.device_id)
+                if total is not None:
+                    builder.add(schema.MEMORY_TOTAL, total, base)
+                continue
+            builder.add(schema.DEVICE_UP, 1.0, base)
+            for name, value in sample.values.items():
+                spec = by_name.get(name)
+                if spec is None:
+                    continue
+                builder.add(spec, value, base)
+                if name == schema.MEMORY_TOTAL.name:
+                    self._last_totals[dev.device_id] = value
+            for link, counter in sorted(sample.ici_counters.items()):
+                link_labels = base + [("link", link)]
+                builder.add(schema.ICI_TRAFFIC_TOTAL, float(counter), link_labels)
+                rate = self._rates.rate(dev.device_id, link, counter, now)
+                if rate is not None:
+                    builder.add(schema.ICI_BANDWIDTH, rate, link_labels)
+            if sample.collective_ops is not None:
+                builder.add(schema.COLLECTIVE_OPS, float(sample.collective_ops), base)
+
+        builder.add(schema.SELF_DEVICES, float(len(results)))
+        for reason in sorted(self._errors):
+            builder.add(
+                schema.SELF_POLL_ERRORS,
+                float(self._errors[reason]),
+                [("reason", reason)],
+            )
+        builder.add(
+            schema.SELF_INFO,
+            1.0,
+            [("version", self._version), ("backend", self._collector.name)],
+        )
+        builder.add_histogram(self._hist)
+        return builder.build()

@@ -1,0 +1,202 @@
+"""Snapshot registry — lock-light bridge between poll loop and scrape (C4).
+
+Concurrency contract (SURVEY.md §3 E2/E3, §5 race-detection item): the poll
+loop is the *single writer*. Each tick it builds a complete immutable
+:class:`Snapshot` and publishes it with one reference assignment (atomic under
+CPython). Scrapes and textfile writes render whichever snapshot was last
+published and never block — a scrape can never stall the 50 ms poll budget.
+
+The GPU reference's analog is the Prometheus client registry the collector
+writes into (SURVEY.md §2 C4); rebuilding it as copy-on-publish makes the
+poll/scrape race impossible by construction instead of by locking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Iterable, Mapping, Sequence
+
+from . import schema
+from .schema import MetricSpec, MetricType
+
+
+def format_value(value: float) -> str:
+    """Render a sample value in Prometheus text format."""
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Series:
+    """One (family, labelset, value) sample."""
+
+    spec: MetricSpec
+    labels: tuple[tuple[str, str], ...]
+    value: float
+
+
+@dataclasses.dataclass(frozen=True)
+class HistogramState:
+    """Cumulative histogram state owned by the poll loop, published by value."""
+
+    spec: MetricSpec
+    buckets: tuple[float, ...]
+    counts: tuple[int, ...]  # len(buckets) + 1, cumulative-by-render not stored
+    total: int
+    sum: float
+
+    @staticmethod
+    def empty(spec: MetricSpec, buckets: Sequence[float]) -> "HistogramState":
+        return HistogramState(spec, tuple(buckets), (0,) * (len(buckets) + 1), 0, 0.0)
+
+    def observe(self, value: float) -> "HistogramState":
+        counts = list(self.counts)
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+        return HistogramState(
+            self.spec, self.buckets, tuple(counts), self.total + 1, self.sum + value
+        )
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket bounds (upper bound of the bucket
+        containing the q-th observation). Used by bench/latency tests."""
+        if self.total == 0:
+            return math.nan
+        rank = q * self.total
+        seen = 0
+        for i, bound in enumerate(self.buckets):
+            seen += self.counts[i]
+            if seen >= rank:
+                return bound
+        return math.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    """Immutable rendering source for one poll tick."""
+
+    series: tuple[Series, ...]
+    histograms: tuple[HistogramState, ...]
+    timestamp: float  # unix seconds at publish
+
+    def render(self) -> str:
+        """Serialize to the Prometheus text exposition format (0.0.4).
+
+        Families render in schema order so output is byte-stable for golden
+        tests; series within a family keep insertion order (device order).
+        """
+        by_family: dict[str, list[Series]] = {}
+        for s in self.series:
+            by_family.setdefault(s.spec.name, []).append(s)
+
+        out: list[str] = []
+        for spec in schema.ALL_METRICS:
+            if spec.type is MetricType.HISTOGRAM:
+                continue
+            group = by_family.get(spec.name)
+            if not group:
+                continue
+            out.append(f"# HELP {spec.name} {spec.help}")
+            out.append(f"# TYPE {spec.name} {spec.type.value}")
+            for s in group:
+                out.append(
+                    f"{s.spec.name}{schema.render_labels(s.labels)} "
+                    f"{format_value(s.value)}"
+                )
+        for hist in self.histograms:
+            spec = hist.spec
+            out.append(f"# HELP {spec.name} {spec.help}")
+            out.append(f"# TYPE {spec.name} histogram")
+            cumulative = 0
+            for i, bound in enumerate(hist.buckets):
+                cumulative += hist.counts[i]
+                out.append(
+                    f'{spec.name}_bucket{{le="{format_value(bound)}"}} {cumulative}'
+                )
+            out.append(f'{spec.name}_bucket{{le="+Inf"}} {hist.total}')
+            out.append(f"{spec.name}_sum {format_value(hist.sum)}")
+            out.append(f"{spec.name}_count {hist.total}")
+        return "\n".join(out) + "\n" if out else ""
+
+
+EMPTY_SNAPSHOT = Snapshot(series=(), histograms=(), timestamp=0.0)
+
+
+class Registry:
+    """Holds the latest published snapshot.
+
+    `publish` is called only by the poll loop; `snapshot` by any reader.
+    The event lets tests and the textfile writer wait for a fresh tick
+    without polling.
+    """
+
+    def __init__(self) -> None:
+        self._snapshot: Snapshot = EMPTY_SNAPSHOT
+        self._published = threading.Condition()
+        self._generation = 0
+
+    def publish(self, snapshot: Snapshot) -> None:
+        with self._published:
+            self._snapshot = snapshot
+            self._generation += 1
+            self._published.notify_all()
+
+    def snapshot(self) -> Snapshot:
+        return self._snapshot
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def wait_for_publish(self, after_generation: int, timeout: float) -> bool:
+        """Block until a snapshot newer than `after_generation` is published."""
+        deadline = time.monotonic() + timeout
+        with self._published:
+            while self._generation <= after_generation:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._published.wait(remaining)
+        return True
+
+
+class SnapshotBuilder:
+    """Accumulates series for one tick; used only by the poll loop."""
+
+    def __init__(self) -> None:
+        self._series: list[Series] = []
+        self._histograms: list[HistogramState] = []
+
+    def add(
+        self,
+        spec: MetricSpec,
+        value: float,
+        labels: Mapping[str, str] | Iterable[tuple[str, str]] = (),
+    ) -> None:
+        if isinstance(labels, Mapping):
+            labels = tuple(labels.items())
+        else:
+            labels = tuple(labels)
+        self._series.append(Series(spec, labels, float(value)))
+
+    def add_histogram(self, state: HistogramState) -> None:
+        self._histograms.append(state)
+
+    def build(self) -> Snapshot:
+        return Snapshot(
+            series=tuple(self._series),
+            histograms=tuple(self._histograms),
+            timestamp=time.time(),
+        )

@@ -1,0 +1,205 @@
+"""Metric schema — the stable exposition contract (component C4, SURVEY.md §2).
+
+The reference exports GPU gauges "under the existing metric schema"
+(SURVEY.md §0 north star); the unified target family here is ``accelerator_*``
+so that mixed GPU+TPU clusters share one schema (SURVEY.md §2 C12,
+BASELINE.json configs[4]).
+
+Everything that renders, tests, or documents metrics derives from the tables
+in this module: names, types, help strings, and the label contract. Golden
+tests in tests/test_schema_golden.py pin the rendered form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import re
+from typing import Iterable
+
+
+class MetricType(enum.Enum):
+    GAUGE = "gauge"
+    COUNTER = "counter"
+    HISTOGRAM = "histogram"
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One metric family in the exposition contract."""
+
+    name: str
+    type: MetricType
+    help: str
+    # Labels beyond the base device/attribution labels (e.g. "link" for
+    # per-ICI-link families). Base labels are added by the registry.
+    extra_labels: tuple[str, ...] = ()
+
+
+# Base label set attached to every per-device sample. Order is the render
+# order and is part of the golden contract.
+#   accel_type   "tpu-v5p" / "tpu-v4" / "gpu-h100" / "mock" ...
+#   chip         local chip index as string ("0".."7")
+#   device_path  "/dev/accel0" or PCI address — stable node-local id
+#   uuid         device serial/uuid when the backend provides one, else ""
+DEVICE_LABELS: tuple[str, ...] = ("accel_type", "chip", "device_path", "uuid")
+
+# Attribution labels (component C3). Empty strings when the device is
+# unallocated or attribution is disabled — label *set* stays constant so
+# Prometheus series identity never churns on (de)allocation.
+ATTRIBUTION_LABELS: tuple[str, ...] = ("pod", "namespace", "container")
+
+# Slice topology labels (component C9): every per-node exporter on a
+# multi-host slice labels its local chips with its worker identity so
+# Prometheus can aggregate the whole slice.
+TOPOLOGY_LABELS: tuple[str, ...] = ("slice", "worker", "topology")
+
+ALL_BASE_LABELS: tuple[str, ...] = DEVICE_LABELS + ATTRIBUTION_LABELS + TOPOLOGY_LABELS
+
+
+# --- The accelerator_* family (north-star metrics, SURVEY.md §0) -----------
+
+DUTY_CYCLE = MetricSpec(
+    "accelerator_duty_cycle",
+    MetricType.GAUGE,
+    "Percent of time over the last sample window the accelerator core (MXU/"
+    "TensorCore) was actively executing (0-100).",
+)
+TENSORCORE_UTIL = MetricSpec(
+    "accelerator_tensorcore_utilization",
+    MetricType.GAUGE,
+    "Percent of peak TensorCore/MXU FLOP rate achieved over the last sample "
+    "window (0-100).",
+)
+MEMORY_USED = MetricSpec(
+    "accelerator_memory_used_bytes",
+    MetricType.GAUGE,
+    "Accelerator high-bandwidth memory currently allocated, in bytes.",
+)
+MEMORY_TOTAL = MetricSpec(
+    "accelerator_memory_total_bytes",
+    MetricType.GAUGE,
+    "Accelerator high-bandwidth memory capacity, in bytes.",
+)
+POWER = MetricSpec(
+    "accelerator_power_watts",
+    MetricType.GAUGE,
+    "Instantaneous chip power draw, in watts.",
+)
+TEMPERATURE = MetricSpec(
+    "accelerator_temperature_celsius",
+    MetricType.GAUGE,
+    "Chip temperature, in degrees Celsius.",
+)
+ICI_BANDWIDTH = MetricSpec(
+    "accelerator_ici_link_bandwidth_bytes_per_second",
+    MetricType.GAUGE,
+    "Per-link inter-chip-interconnect traffic rate over the last poll "
+    "interval, in bytes per second.",
+    extra_labels=("link",),
+)
+ICI_TRAFFIC_TOTAL = MetricSpec(
+    "accelerator_ici_link_traffic_bytes_total",
+    MetricType.COUNTER,
+    "Cumulative per-link inter-chip-interconnect traffic since device reset, "
+    "in bytes.",
+    extra_labels=("link",),
+)
+COLLECTIVE_OPS = MetricSpec(
+    "accelerator_collective_ops_total",
+    MetricType.COUNTER,
+    "Cumulative collective operations (all-reduce/all-gather/...) executed "
+    "by the runtime on this chip since reset.",
+)
+DEVICE_UP = MetricSpec(
+    "accelerator_up",
+    MetricType.GAUGE,
+    "1 if the last poll of this device succeeded, 0 if it is stale/erroring.",
+)
+
+PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
+    DUTY_CYCLE,
+    TENSORCORE_UTIL,
+    MEMORY_USED,
+    MEMORY_TOTAL,
+    POWER,
+    TEMPERATURE,
+    ICI_BANDWIDTH,
+    ICI_TRAFFIC_TOTAL,
+    COLLECTIVE_OPS,
+    DEVICE_UP,
+)
+
+
+# --- Exporter self-observability (SURVEY.md §5) ----------------------------
+
+SELF_POLL_DURATION = MetricSpec(
+    "collector_poll_duration_seconds",
+    MetricType.HISTOGRAM,
+    "Wall time of one full poll tick over all local devices. The north-star "
+    "budget is p50 < 0.050s at 1 Hz (BASELINE.md).",
+)
+SELF_POLL_ERRORS = MetricSpec(
+    "collector_poll_errors_total",
+    MetricType.COUNTER,
+    "Device-sample failures observed by the poll loop.",
+    extra_labels=("reason",),
+)
+SELF_DEVICES = MetricSpec(
+    "collector_devices",
+    MetricType.GAUGE,
+    "Number of accelerator devices discovered on this node.",
+)
+SELF_INFO = MetricSpec(
+    "collector_build_info",
+    MetricType.GAUGE,
+    "Constant 1; build/runtime identity in labels.",
+    extra_labels=("version", "backend"),
+)
+
+SELF_METRICS: tuple[MetricSpec, ...] = (
+    SELF_POLL_DURATION,
+    SELF_POLL_ERRORS,
+    SELF_DEVICES,
+    SELF_INFO,
+)
+
+ALL_METRICS: tuple[MetricSpec, ...] = PER_DEVICE_METRICS + SELF_METRICS
+
+# Default histogram buckets for collector_poll_duration_seconds. Chosen to
+# resolve the 50 ms budget from both sides.
+POLL_DURATION_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def validate() -> None:
+    """Sanity-check the schema tables (run from tests)."""
+    seen: set[str] = set()
+    for spec in ALL_METRICS:
+        if not _NAME_RE.match(spec.name):
+            raise ValueError(f"bad metric name: {spec.name!r}")
+        if spec.name in seen:
+            raise ValueError(f"duplicate metric name: {spec.name!r}")
+        seen.add(spec.name)
+        for label in spec.extra_labels:
+            if not _LABEL_RE.match(label):
+                raise ValueError(f"bad label {label!r} on {spec.name}")
+        if spec.type is MetricType.COUNTER and not spec.name.endswith("_total"):
+            raise ValueError(f"counter {spec.name!r} must end in _total")
+    for label in ALL_BASE_LABELS:
+        if not _LABEL_RE.match(label):
+            raise ValueError(f"bad base label {label!r}")
+
+
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_labels(labels: Iterable[tuple[str, str]]) -> str:
+    inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
+    return "{" + inner + "}" if inner else ""

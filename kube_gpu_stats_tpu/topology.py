@@ -1,0 +1,50 @@
+"""Slice/worker topology labels (component C9, SURVEY.md §2).
+
+On a multi-host slice (v5p-128/256) each worker VM sees only its local chips;
+every per-node DaemonSet pod exports those chips labeled with slice + worker
+identity so Prometheus aggregates the full slice (BASELINE.json configs[3]).
+
+Label sources, in priority order (all [T]-tier, SURVEY.md §0):
+1. explicit KTS_* env (set by the DaemonSet via the downward API),
+2. GKE TPU env vars injected by the device plugin / TPU VM runtime
+   (TPU_WORKER_ID, TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, ...),
+3. empty strings (labels stay present so series identity is stable).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+
+def topology_labels(environ: Mapping[str, str] | None = None) -> dict[str, str]:
+    env = dict(environ) if environ is not None else dict(os.environ)
+
+    slice_name = (
+        env.get("KTS_SLICE")
+        or env.get("TPU_NAME")
+        or env.get("MEGASCALE_SLICE_ID")
+        or env.get("HOSTNAME_SLICE", "")
+    )
+    worker = (
+        env.get("KTS_WORKER")
+        or env.get("TPU_WORKER_ID")
+        or env.get("CLOUD_TPU_TASK_ID", "")
+    )
+    topo = (
+        env.get("KTS_TOPOLOGY")
+        or env.get("TPU_TOPOLOGY")
+        or env.get("TPU_ACCELERATOR_TYPE", "")
+    )
+    return {"slice": slice_name, "worker": worker, "topology": topo}
+
+
+def accel_type(environ: Mapping[str, str] | None = None) -> str:
+    """Human accel_type label, e.g. "tpu-v5p" from TPU_ACCELERATOR_TYPE
+    "v5p-128"; falls back to "tpu"."""
+    env = dict(environ) if environ is not None else dict(os.environ)
+    raw = env.get("KTS_ACCEL_TYPE") or env.get("TPU_ACCELERATOR_TYPE", "")
+    if not raw:
+        return "tpu"
+    family = raw.split("-", 1)[0].lower()
+    return f"tpu-{family}" if not family.startswith("tpu") else family

@@ -1,0 +1,12 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh before any jax
+import (only the loadgen/graft tests use JAX — the exporter itself has no
+JAX dependency, SURVEY.md §7 non-goals)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

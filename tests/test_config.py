@@ -1,0 +1,66 @@
+from kube_gpu_stats_tpu.config import Config, from_args, parse_libtpu_ports
+
+
+def test_defaults():
+    cfg = from_args([])
+    assert cfg.backend == "auto"
+    assert cfg.interval == 1.0
+    assert cfg.deadline == 0.050
+    assert cfg.listen_port == 9400
+    assert cfg.libtpu_ports == (8431,)
+    assert cfg.attribution == "auto"
+    assert not cfg.textfile_enabled
+
+
+def test_flags():
+    cfg = from_args(
+        [
+            "--backend", "mock",
+            "--mock-devices", "8",
+            "--interval", "0.5",
+            "--textfile-dir", "/tmp/tf",
+            "--libtpu-ports", "8431,8432",
+            "--attribution", "off",
+            "--no-native",
+        ]
+    )
+    assert cfg.backend == "mock"
+    assert cfg.mock_devices == 8
+    assert cfg.interval == 0.5
+    assert cfg.textfile_enabled and cfg.textfile_dir == "/tmp/tf"
+    assert cfg.libtpu_ports == (8431, 8432)
+    assert cfg.attribution == "off"
+    assert cfg.use_native is False
+
+
+def test_env_overrides(monkeypatch):
+    monkeypatch.setenv("KTS_BACKEND", "null")
+    monkeypatch.setenv("KTS_LISTEN_PORT", "9999")
+    monkeypatch.setenv("TPU_RUNTIME_METRICS_PORTS", "8440 8441")
+    cfg = from_args([])
+    assert cfg.backend == "null"
+    assert cfg.listen_port == 9999
+    assert cfg.libtpu_ports == (8440, 8441)
+    # Explicit flag beats env.
+    assert from_args(["--backend", "mock"]).backend == "mock"
+
+
+def test_parse_libtpu_ports():
+    assert parse_libtpu_ports("8431") == (8431,)
+    assert parse_libtpu_ports("1, 2  3") == (1, 2, 3)
+    assert parse_libtpu_ports("") == (8431,)
+
+
+def test_config_dataclass_roundtrip():
+    cfg = Config(backend="mock")
+    assert cfg.textfile_enabled is False
+
+
+def test_no_native_env_spellings(monkeypatch):
+    for raw, expect_native in [
+        ("False", True), ("FALSE", True), ("0", True), ("", True),
+        ("no", True), ("off", True),
+        ("1", False), ("true", False), ("YES", False), ("on", False),
+    ]:
+        monkeypatch.setenv("KTS_NO_NATIVE", raw)
+        assert from_args([]).use_native is expect_native, raw

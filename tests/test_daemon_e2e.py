@@ -1,0 +1,70 @@
+"""Single-node integration: real poll loop + real HTTP server + mock backend
+(SURVEY.md §4 integration tier; BASELINE.json configs[0] end-to-end)."""
+
+import urllib.request
+
+from kube_gpu_stats_tpu.config import Config
+from kube_gpu_stats_tpu.daemon import Daemon
+
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_mock_daemon_end_to_end(tmp_path):
+    cfg = Config(
+        backend="mock",
+        mock_devices=4,
+        interval=0.05,
+        deadline=5.0,
+        listen_host="127.0.0.1",
+        listen_port=0,
+        textfile_dir=str(tmp_path),
+        attribution="off",
+    )
+    d = Daemon(cfg)
+    d.start()
+    try:
+        assert d.registry.wait_for_publish(0, timeout=5)
+        # Wait one more tick so ICI rates appear.
+        assert d.registry.wait_for_publish(d.registry.generation, timeout=5)
+        body = scrape(d.server.port)
+        for family in (
+            "accelerator_duty_cycle",
+            "accelerator_memory_used_bytes",
+            "accelerator_memory_total_bytes",
+            "accelerator_power_watts",
+            "accelerator_ici_link_bandwidth_bytes_per_second",
+            "accelerator_up",
+            "collector_poll_duration_seconds_bucket",
+            "collector_build_info",
+        ):
+            assert family in body, family
+        assert body.count('accelerator_up{') == 4
+        # Textfile output mirrors the scrape contract.
+        assert d.registry.wait_for_publish(d.registry.generation, timeout=5)
+        prom = (tmp_path / "accelerator.prom").read_text()
+        assert "accelerator_duty_cycle" in prom
+    finally:
+        d.stop()
+
+
+def test_null_daemon_schema_valid(tmp_path):
+    cfg = Config(
+        backend="null",
+        interval=0.05,
+        listen_host="127.0.0.1",
+        listen_port=0,
+        attribution="off",
+    )
+    d = Daemon(cfg)
+    d.start()
+    try:
+        assert d.registry.wait_for_publish(0, timeout=5)
+        body = scrape(d.server.port)
+        # No accelerator series, but self-metrics present and well-formed.
+        assert "collector_devices 0" in body
+        assert "accelerator_up" not in body
+    finally:
+        d.stop()

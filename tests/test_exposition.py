@@ -1,0 +1,74 @@
+"""HTTP + textfile exposition (SURVEY.md §3 E3, configs[0])."""
+
+import urllib.request
+
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.exposition import CONTENT_TYPE, MetricsServer, TextfileWriter
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+
+def _served(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+        return resp.status, resp.headers, resp.read().decode()
+
+
+def test_http_metrics_roundtrip():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        status, headers, body = _served(server.port, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"] == CONTENT_TYPE
+        assert 'accelerator_duty_cycle{accel_type="mock",chip="0"' in body
+        assert body == reg.snapshot().render()
+        status, _, body = _served(server.port, "/healthz")
+        assert (status, body) == (200, "ok\n")
+        try:
+            _served(server.port, "/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.stop()
+        loop.stop()
+
+
+def test_textfile_atomic_write(tmp_path):
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    writer = TextfileWriter(reg, tmp_path)
+    writer.write_once()
+    text = writer.path.read_text()
+    assert text == reg.snapshot().render()
+    assert not (tmp_path / "accelerator.prom.tmp").exists()
+
+
+def test_textfile_follows_publishes(tmp_path):
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, interval=0.02, deadline=5.0)
+    writer = TextfileWriter(reg, tmp_path)
+    writer.start()
+    loop.start()
+    try:
+        assert reg.wait_for_publish(0, timeout=2)
+        deadline_gen = reg.generation + 2
+        while reg.generation < deadline_gen:
+            assert reg.wait_for_publish(reg.generation, timeout=2)
+        # Writer has had at least one publish to chase; file must exist and
+        # parse as a full exposition.
+        for _ in range(100):
+            if writer.path.exists():
+                break
+            import time
+
+            time.sleep(0.01)
+        content = writer.path.read_text()
+        assert "accelerator_up" in content
+    finally:
+        loop.stop()
+        writer.stop()

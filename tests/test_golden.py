@@ -1,0 +1,52 @@
+"""Golden exposition test (SURVEY.md §4: "metric-schema goldens ... compared
+against golden .prom files"). Regenerate with:
+
+    GOLDEN_UPDATE=1 python -m pytest tests/test_golden.py
+"""
+
+import itertools
+import os
+import pathlib
+
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "mock_2dev.prom"
+
+
+class FakeAttribution:
+    def lookup(self, device):
+        if device.device_id == "0":
+            return {"pod": "train-abc", "namespace": "ml", "container": "worker"}
+        return {}
+
+
+def render_two_ticks() -> str:
+    reg = Registry()
+    clock = itertools.count(100.0, 0.5).__next__  # deterministic monotonic
+    loop = PollLoop(
+        MockCollector(num_devices=2),
+        reg,
+        deadline=5.0,
+        attribution=FakeAttribution(),
+        topology_labels={"slice": "test-slice", "worker": "0", "topology": "2x2x1"},
+        version="golden",
+        clock=clock,
+    )
+    loop.tick()
+    loop.tick()
+    loop.stop()
+    text = reg.snapshot().render()
+    # The poll-duration histogram depends on wall time via the fake clock
+    # only, so the whole exposition is deterministic.
+    return text
+
+
+def test_matches_golden():
+    text = render_two_ticks()
+    if os.environ.get("GOLDEN_UPDATE"):
+        GOLDEN.parent.mkdir(exist_ok=True)
+        GOLDEN.write_text(text)
+    assert GOLDEN.exists(), "golden missing; run with GOLDEN_UPDATE=1"
+    assert text == GOLDEN.read_text()

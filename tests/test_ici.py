@@ -1,0 +1,47 @@
+"""ICI counter delta/rate math (SURVEY.md §4 unit tier, §7 hard part d)."""
+
+from kube_gpu_stats_tpu.ici import RateTracker
+
+
+def test_first_sample_has_no_rate():
+    rt = RateTracker()
+    assert rt.rate("0", "x0", 1000, now=1.0) is None
+
+
+def test_steady_rate():
+    rt = RateTracker()
+    rt.rate("0", "x0", 1000, now=1.0)
+    assert rt.rate("0", "x0", 3000, now=2.0) == 2000.0
+    assert rt.rate("0", "x0", 3000, now=3.0) == 0.0
+
+
+def test_reset_drops_interval_then_recovers():
+    rt = RateTracker()
+    rt.rate("0", "x0", 10_000, now=1.0)
+    # Counter went backwards: libtpu restarted. No rate this interval.
+    assert rt.rate("0", "x0", 500, now=2.0) is None
+    # Baseline re-established from the post-reset value.
+    assert rt.rate("0", "x0", 1500, now=3.0) == 1000.0
+
+
+def test_zero_dt_guard():
+    rt = RateTracker()
+    rt.rate("0", "x0", 100, now=5.0)
+    assert rt.rate("0", "x0", 200, now=5.0) is None
+
+
+def test_links_and_devices_independent():
+    rt = RateTracker()
+    rt.rate("0", "x0", 100, now=1.0)
+    rt.rate("0", "x1", 100, now=1.0)
+    rt.rate("1", "x0", 100, now=1.0)
+    assert rt.rate("0", "x0", 200, now=2.0) == 100.0
+    assert rt.rate("0", "x1", 400, now=2.0) == 300.0
+    assert rt.rate("1", "x0", 150, now=2.0) == 50.0
+
+
+def test_forget_device():
+    rt = RateTracker()
+    rt.rate("0", "x0", 100, now=1.0)
+    rt.forget_device("0")
+    assert rt.rate("0", "x0", 200, now=2.0) is None

@@ -1,0 +1,47 @@
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import CollectorError
+from kube_gpu_stats_tpu.collectors.mock import MockCollector, NullCollector
+
+
+def test_discover_shape():
+    c = MockCollector(num_devices=8)
+    devs = c.discover()
+    assert len(devs) == 8
+    assert devs[3].device_path == "/dev/accel3"
+    assert devs[3].device_id == "3"
+    assert devs[3].uuid == "mock-0003"
+
+
+def test_sample_schema_valid_and_deterministic():
+    a = MockCollector(num_devices=2)
+    b = MockCollector(num_devices=2)
+    dev = a.discover()[1]
+    sa, sb = a.sample(dev), b.sample(dev)
+    assert sa.values == sb.values
+    assert sa.ici_counters == sb.ici_counters
+    assert set(sa.values) <= {m.name for m in schema.PER_DEVICE_METRICS}
+    assert 0.0 <= sa.values[schema.DUTY_CYCLE.name] <= 100.0
+    assert sa.values[schema.MEMORY_USED.name] <= sa.values[schema.MEMORY_TOTAL.name]
+
+
+def test_counters_monotonic_across_ticks():
+    c = MockCollector(num_devices=1)
+    dev = c.discover()[0]
+    s1, s2 = c.sample(dev), c.sample(dev)
+    for link in s1.ici_counters:
+        assert s2.ici_counters[link] > s1.ici_counters[link]
+    assert s2.collective_ops > s1.collective_ops
+
+
+def test_fault_injection():
+    c = MockCollector(num_devices=2, fail_devices=[1])
+    devs = c.discover()
+    c.sample(devs[0])
+    with pytest.raises(CollectorError):
+        c.sample(devs[1])
+
+
+def test_null_collector_empty():
+    assert NullCollector().discover() == []

@@ -1,0 +1,232 @@
+"""Poll-loop behavior: fan-out, staleness, attribution join, self-metrics
+(SURVEY.md §3 E2/E5, §5 failure detection)."""
+
+import time
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import Collector, CollectorError, Device, Sample
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+
+def series_map(snapshot):
+    return {
+        (s.spec.name, s.labels): s.value for s in snapshot.series
+    }
+
+
+def get(snapshot, name, **want_labels):
+    out = []
+    for s in snapshot.series:
+        if s.spec.name != name:
+            continue
+        labels = dict(s.labels)
+        if all(labels.get(k) == v for k, v in want_labels.items()):
+            out.append((labels, s.value))
+    return out
+
+
+def test_tick_publishes_all_families():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    snap = reg.snapshot()
+    assert len(get(snap, "accelerator_up")) == 2
+    assert all(v == 1.0 for _, v in get(snap, "accelerator_up"))
+    assert len(get(snap, "accelerator_duty_cycle")) == 2
+    # 6 links per chip
+    assert len(get(snap, "accelerator_ici_link_traffic_bytes_total", chip="0")) == 6
+    # First tick: no bandwidth rates yet (no prior counter observation).
+    assert get(snap, "accelerator_ici_link_bandwidth_bytes_per_second") == []
+    loop.tick()
+    snap = reg.snapshot()
+    rates = get(snap, "accelerator_ici_link_bandwidth_bytes_per_second", chip="1")
+    assert len(rates) == 6
+    assert all(v > 0 for _, v in rates)
+    assert get(snap, "collector_devices")[0][1] == 2.0
+    assert snap.histograms[0].total == 2
+    loop.stop()
+
+
+def test_failed_device_marked_stale_not_fatal():
+    reg = Registry()
+    loop = PollLoop(
+        MockCollector(num_devices=3, fail_devices=[1]), reg, deadline=5.0
+    )
+    loop.tick()
+    loop.tick()
+    snap = reg.snapshot()
+    ups = {dict(l)["chip"]: v for l, v in get(snap, "accelerator_up")}
+    assert ups == {"0": 1.0, "1": 0.0, "2": 1.0}
+    errors = get(snap, "collector_poll_errors_total", reason="CollectorError")
+    assert errors[0][1] == 2.0
+    # Healthy chips still export values.
+    assert len(get(snap, "accelerator_duty_cycle")) == 2
+    loop.stop()
+
+
+class SlowCollector(Collector):
+    name = "slow"
+
+    def __init__(self, delay):
+        self.delay = delay
+
+    def discover(self):
+        return [Device(0, "0", "/dev/accel0", "mock")]
+
+    def sample(self, device):
+        time.sleep(self.delay)
+        return Sample(device, {schema.POWER.name: 1.0})
+
+
+def test_deadline_marks_device_stale():
+    reg = Registry()
+    loop = PollLoop(SlowCollector(0.5), reg, deadline=0.02)
+    loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, "accelerator_up")[0][1] == 0.0
+    assert get(snap, "collector_poll_errors_total", reason="deadline")[0][1] == 1.0
+    loop.stop()
+
+
+def test_memory_total_retained_when_stale():
+    class FlakyCollector(Collector):
+        name = "flaky"
+
+        def __init__(self):
+            self.calls = 0
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):
+            self.calls += 1
+            if self.calls > 1:
+                raise CollectorError("down")
+            return Sample(device, {schema.MEMORY_TOTAL.name: 1024.0})
+
+    reg = Registry()
+    loop = PollLoop(FlakyCollector(), reg, deadline=5.0)
+    loop.tick()
+    loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, "accelerator_up")[0][1] == 0.0
+    assert get(snap, "accelerator_memory_total_bytes")[0][1] == 1024.0
+    loop.stop()
+
+
+class StaticAttribution:
+    def __init__(self, mapping):
+        self.mapping = mapping
+
+    def lookup(self, device):
+        return self.mapping.get(device.device_id, {})
+
+
+def test_attribution_and_topology_labels_joined():
+    reg = Registry()
+    attr = StaticAttribution(
+        {"0": {"pod": "train-0", "namespace": "ml", "container": "main"}}
+    )
+    loop = PollLoop(
+        MockCollector(num_devices=2),
+        reg,
+        deadline=5.0,
+        attribution=attr,
+        topology_labels={"slice": "v5p-16", "worker": "3", "topology": "2x2x2"},
+    )
+    loop.tick()
+    snap = reg.snapshot()
+    labels0 = get(snap, "accelerator_duty_cycle", chip="0")[0][0]
+    assert labels0["pod"] == "train-0"
+    assert labels0["namespace"] == "ml"
+    assert labels0["worker"] == "3"
+    labels1 = get(snap, "accelerator_duty_cycle", chip="1")[0][0]
+    # Unallocated chip keeps the label keys with empty values.
+    assert labels1["pod"] == ""
+    assert labels1["slice"] == "v5p-16"
+    loop.stop()
+
+
+def test_run_forever_ticks_at_interval():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, interval=0.02, deadline=5.0)
+    loop.start()
+    gen = reg.generation
+    assert reg.wait_for_publish(gen, timeout=2)
+    assert reg.wait_for_publish(reg.generation, timeout=2)
+    loop.stop()
+    assert loop.poll_histogram.total >= 2
+
+
+def test_hung_sample_does_not_leak_workers():
+    """A backend call that blocks past the deadline must not stack one pool
+    worker per tick (future.cancel can't stop a running call)."""
+    import threading
+
+    class HungCollector(Collector):
+        name = "hung"
+
+        def __init__(self):
+            self.release = threading.Event()
+            self.active = 0
+            self.peak = 0
+            self.lock = threading.Lock()
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):
+            with self.lock:
+                self.active += 1
+                self.peak = max(self.peak, self.active)
+            try:
+                self.release.wait(timeout=10)
+            finally:
+                with self.lock:
+                    self.active -= 1
+            return Sample(device, {schema.POWER.name: 1.0})
+
+    col = HungCollector()
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=0.01)
+    for _ in range(5):
+        loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, "accelerator_up")[0][1] == 0.0
+    # Only ONE sampler thread ever entered the backend.
+    assert col.peak == 1
+    stuck = get(snap, "collector_poll_errors_total", reason="stuck")
+    assert stuck and stuck[0][1] == 4.0
+    col.release.set()
+    loop.stop()
+
+
+def test_rediscover_purges_vanished_device_state():
+    class ShrinkingCollector(Collector):
+        name = "shrink"
+
+        def __init__(self):
+            self.n = 2
+
+        def discover(self):
+            return [
+                Device(i, str(i), f"/dev/accel{i}", "mock") for i in range(self.n)
+            ]
+
+        def sample(self, device):
+            return Sample(device, {schema.MEMORY_TOTAL.name: 7.0},
+                          ici_counters={"x0": 100})
+
+    col = ShrinkingCollector()
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=5.0)
+    loop.tick()
+    assert "1" in loop._last_totals
+    col.n = 1
+    loop.rediscover()
+    assert "1" not in loop._last_totals
+    assert ("1", "x0") not in loop._rates._last
+    assert ("0", "x0") in loop._rates._last
+    loop.stop()

@@ -1,0 +1,76 @@
+import math
+import threading
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.registry import (
+    HistogramState,
+    Registry,
+    SnapshotBuilder,
+    format_value,
+)
+
+
+def test_format_value():
+    assert format_value(1.0) == "1"
+    assert format_value(0.5) == "0.5"
+    assert format_value(-3.0) == "-3"
+    assert format_value(float("nan")) == "NaN"
+    assert format_value(float("inf")) == "+Inf"
+    assert format_value(95 * 1024**3) == str(95 * 1024**3)
+
+
+def test_histogram_observe_and_quantile():
+    h = HistogramState.empty(schema.SELF_POLL_DURATION, (0.01, 0.05, 0.1))
+    for v in (0.005, 0.005, 0.02, 0.2):
+        h = h.observe(v)
+    assert h.total == 4
+    assert math.isclose(h.sum, 0.23)
+    assert h.counts == (2, 1, 0, 1)
+    assert h.quantile(0.5) == 0.01  # 2 of 4 obs fall in the first bucket
+    assert h.quantile(0.99) == math.inf
+
+
+def test_render_family_order_and_help():
+    b = SnapshotBuilder()
+    b.add(schema.POWER, 123.0, {"chip": "0"})
+    b.add(schema.DUTY_CYCLE, 55.5, {"chip": "0"})
+    text = b.build().render()
+    # Families render in schema order: duty_cycle before power.
+    assert text.index("accelerator_duty_cycle") < text.index("accelerator_power")
+    assert "# HELP accelerator_power_watts" in text
+    assert "# TYPE accelerator_power_watts gauge" in text
+    assert 'accelerator_power_watts{chip="0"} 123' in text
+    assert text.endswith("\n")
+
+
+def test_histogram_render_cumulative():
+    h = HistogramState.empty(schema.SELF_POLL_DURATION, (0.01, 0.05))
+    h = h.observe(0.005)
+    h = h.observe(0.02)
+    b = SnapshotBuilder()
+    b.add_histogram(h)
+    text = b.build().render()
+    assert 'collector_poll_duration_seconds_bucket{le="0.01"} 1' in text
+    assert 'collector_poll_duration_seconds_bucket{le="0.05"} 2' in text
+    assert 'collector_poll_duration_seconds_bucket{le="+Inf"} 2' in text
+    assert "collector_poll_duration_seconds_count 2" in text
+
+
+def test_registry_publish_wait():
+    reg = Registry()
+    gen = reg.generation
+    done = threading.Event()
+
+    def publisher():
+        b = SnapshotBuilder()
+        b.add(schema.SELF_DEVICES, 1.0)
+        reg.publish(b.build())
+        done.set()
+
+    t = threading.Thread(target=publisher)
+    t.start()
+    assert reg.wait_for_publish(gen, timeout=5)
+    t.join()
+    assert reg.snapshot().series[0].value == 1.0
+    # Waiting for a generation already surpassed returns immediately.
+    assert reg.wait_for_publish(gen, timeout=0)

@@ -1,0 +1,30 @@
+"""Schema contract tests (SURVEY.md §4 unit tier)."""
+
+from kube_gpu_stats_tpu import schema
+
+
+def test_schema_validates():
+    schema.validate()
+
+
+def test_all_north_star_metrics_present():
+    # BASELINE.json north star: MXU duty cycle, HBM used/total, ICI link
+    # bandwidth, chip power — all as accelerator_* families.
+    names = {m.name for m in schema.PER_DEVICE_METRICS}
+    assert "accelerator_duty_cycle" in names
+    assert "accelerator_memory_used_bytes" in names
+    assert "accelerator_memory_total_bytes" in names
+    assert "accelerator_ici_link_bandwidth_bytes_per_second" in names
+    assert "accelerator_power_watts" in names
+
+
+def test_label_sets_stable():
+    assert schema.DEVICE_LABELS == ("accel_type", "chip", "device_path", "uuid")
+    assert schema.ATTRIBUTION_LABELS == ("pod", "namespace", "container")
+    assert schema.TOPOLOGY_LABELS == ("slice", "worker", "topology")
+
+
+def test_label_escaping():
+    assert schema.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert schema.render_labels([("pod", 'x"y')]) == '{pod="x\\"y"}'
+    assert schema.render_labels([]) == ""
