@@ -68,6 +68,13 @@ class Collector(abc.ABC):
         """Enumerate local devices. Called at startup and on rediscovery —
         never on the poll hot path."""
 
+    def begin_tick(self) -> None:
+        """Called once by the poll loop before the per-device fan-out of a
+        tick. Backends whose transport is naturally batched (libtpu returns
+        every chip's value in one RPC) refresh a tick-scoped cache here so
+        ``sample`` stays a lookup; per-device backends ignore it. Errors
+        must be swallowed and surfaced per-device from ``sample``."""
+
     @abc.abstractmethod
     def sample(self, device: Device) -> Sample:
         """Read one device's current counters. Hot path: must be fast and

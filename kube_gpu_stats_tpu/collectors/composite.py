@@ -1,0 +1,90 @@
+"""The production TPU backend: sysfs enumeration/environment + libtpu
+runtime counters merged into one per-chip sample (C11 assembled; wired by
+daemon.build_collector for --backend tpu/auto).
+
+Failure semantics (SURVEY.md §5): the two sources degrade independently —
+libtpu down => duty/HBM/ICI absent but power/temp still export; sysfs
+attribute missing => that gauge absent. A chip only goes accelerator_up 0
+when *neither* source yields anything.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+from . import Collector, CollectorError, Device, Sample
+from .libtpu import LibtpuClient, LibtpuCollector
+from .sysfs import SysfsCollector
+
+log = logging.getLogger(__name__)
+
+
+class TpuCollector(Collector):
+    name = "tpu"
+
+    def __init__(
+        self,
+        sysfs_root: str = "/sys",
+        libtpu_addr: str = "127.0.0.1",
+        libtpu_ports: Sequence[int] = (8431,),
+        use_native: bool = True,
+        libtpu_client: LibtpuClient | None = None,
+        rpc_timeout: float = 0.040,
+    ) -> None:
+        self._sysfs = SysfsCollector(sysfs_root)
+        if use_native:
+            from ..native import maybe_accelerate_sysfs
+
+            self._sysfs = maybe_accelerate_sysfs(self._sysfs)
+        self._libtpu = LibtpuCollector(
+            libtpu_client, addr=libtpu_addr, ports=libtpu_ports,
+            rpc_timeout=rpc_timeout,
+        )
+
+    def discover(self) -> Sequence[Device]:
+        devices = self._sysfs.discover()
+        if devices:
+            return devices
+        # TPU VM variants without the accel class still serve libtpu metrics.
+        try:
+            return self._libtpu.discover()
+        except CollectorError:
+            return []
+
+    def begin_tick(self) -> None:
+        self._libtpu.begin_tick()
+
+    def sample(self, device: Device) -> Sample:
+        values: dict[str, float] = {}
+        ici: dict[str, int] = {}
+        collectives = None
+        runtime_err = sysfs_err = None
+        try:
+            runtime = self._libtpu.sample(device)
+            values.update(runtime.values)
+            ici.update(runtime.ici_counters)
+            collectives = runtime.collective_ops
+        except CollectorError as exc:
+            runtime_err = exc
+        try:
+            values.update(self._sysfs.read_environment(device))
+        except CollectorError as exc:
+            sysfs_err = exc
+        if not values:
+            raise CollectorError(
+                f"chip {device.index}: libtpu: {runtime_err}; sysfs: {sysfs_err}"
+            )
+        if runtime_err is not None:
+            log.debug("chip %d: runtime counters missing: %s",
+                      device.index, runtime_err)
+        return Sample(
+            device=device,
+            values=values,
+            ici_counters=ici,
+            collective_ops=collectives,
+        )
+
+    def close(self) -> None:
+        self._libtpu.close()
+        self._sysfs.close()

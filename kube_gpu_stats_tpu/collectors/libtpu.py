@@ -1,0 +1,183 @@
+"""libtpu runtime-metrics gRPC client (component C11, SURVEY.md §2).
+
+Talks to the runtime's metric service on localhost (ports from
+``TPU_RUNTIME_METRICS_PORTS``; one process per port on multi-process
+runtimes — all are queried and merged by chip id). The proto surface lives
+entirely in :mod:`..proto.tpumetrics`; this module owns transport, deadlines
+and the per-tick batch cache.
+
+Transport design for the 50 ms p50 budget (SURVEY.md §3 E2): the service
+returns *every* chip's value for a metric in one RPC, so the collector
+fetches all metric families once per tick in :meth:`begin_tick` — RPCs
+fanned out across metric names and ports in parallel with a hard deadline —
+and ``sample`` is then a dict lookup. A wedged runtime costs one tick's
+cache refresh, not one hang per chip.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+from typing import Mapping, Sequence
+
+import grpc
+
+from . import Collector, CollectorError, Device, Sample
+from .. import schema, topology
+from ..proto import tpumetrics
+
+log = logging.getLogger(__name__)
+
+# schema family <- runtime metric name
+_VALUE_MAP: Mapping[str, str] = {
+    tpumetrics.DUTY_CYCLE: schema.DUTY_CYCLE.name,
+    tpumetrics.TC_UTIL: schema.TENSORCORE_UTIL.name,
+    tpumetrics.HBM_USED: schema.MEMORY_USED.name,
+    tpumetrics.HBM_TOTAL: schema.MEMORY_TOTAL.name,
+}
+
+
+class LibtpuClient:
+    """One channel per runtime-metrics port; bytes-level unary calls."""
+
+    def __init__(self, addr: str = "127.0.0.1",
+                 ports: Sequence[int] = (8431,),
+                 rpc_timeout: float = 0.040) -> None:
+        self._rpc_timeout = rpc_timeout
+        self._methods = []
+        self._channels = []
+        for port in ports:
+            channel = grpc.insecure_channel(
+                f"{addr}:{port}",
+                options=[("grpc.enable_http_proxy", 0)],
+            )
+            self._channels.append(channel)
+            self._methods.append(
+                channel.unary_unary(
+                    tpumetrics.METHOD,
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                )
+            )
+
+    def get_metric(self, metric_name: str) -> list[tpumetrics.MetricSample]:
+        """Fetch one metric family from every port, merged."""
+        request = tpumetrics.encode_request(metric_name)
+        samples: list[tpumetrics.MetricSample] = []
+        errors = []
+        for method in self._methods:
+            try:
+                raw = method(request, timeout=self._rpc_timeout)
+                samples.extend(tpumetrics.decode_response(raw))
+            except (grpc.RpcError, ValueError) as exc:
+                # RpcError: transport/deadline; ValueError: undecodable
+                # response bytes (runtime speaking a different schema).
+                errors.append(exc)
+        if errors and not samples:
+            raise CollectorError(
+                f"libtpu metric {metric_name!r} unavailable: {errors[0]}"
+            )
+        return samples
+
+    def close(self) -> None:
+        for channel in self._channels:
+            channel.close()
+
+
+class LibtpuCollector(Collector):
+    """Runtime counters only (duty cycle, HBM, ICI, collectives). Composite
+    with sysfs environmental reads via :mod:`.composite` for the full
+    per-chip sample."""
+
+    name = "libtpu"
+
+    def __init__(self, client: LibtpuClient | None = None, *,
+                 addr: str = "127.0.0.1", ports: Sequence[int] = (8431,),
+                 accel_type: str | None = None,
+                 rpc_timeout: float = 0.040) -> None:
+        self._client = client or LibtpuClient(addr, ports, rpc_timeout)
+        self._accel_type = accel_type if accel_type is not None else topology.accel_type()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(tpumetrics.ALL_METRICS), thread_name_prefix="libtpu-rpc"
+        )
+        self._lock = threading.Lock()
+        self._cache: dict[int, dict] = {}
+        self._cache_error: CollectorError | None = CollectorError(
+            "no libtpu fetch has completed yet"
+        )
+
+    # -- discovery ----------------------------------------------------------
+
+    def discover(self) -> Sequence[Device]:
+        """Devices are whatever chips the runtime reports HBM capacity for.
+        (When composed with sysfs, the sysfs enumeration wins and this is
+        unused.)"""
+        samples = self._client.get_metric(tpumetrics.HBM_TOTAL)
+        return [
+            Device(
+                index=s.device_id,
+                device_id=str(s.device_id),
+                device_path=f"/dev/accel{s.device_id}",
+                accel_type=self._accel_type,
+            )
+            for s in sorted(samples, key=lambda s: s.device_id)
+        ]
+
+    # -- hot path ------------------------------------------------------------
+
+    def begin_tick(self) -> None:
+        futures = {
+            name: self._pool.submit(self._client.get_metric, name)
+            for name in tpumetrics.ALL_METRICS
+        }
+        cache: dict[int, dict] = {}
+        first_error: CollectorError | None = None
+        for name, future in futures.items():
+            try:
+                for s in future.result():
+                    entry = cache.setdefault(
+                        s.device_id,
+                        {"values": {}, "ici": {}, "collectives": None},
+                    )
+                    if name == tpumetrics.ICI_TRAFFIC:
+                        entry["ici"][s.link or "link0"] = int(s.value)
+                    elif name == tpumetrics.COLLECTIVES:
+                        entry["collectives"] = int(s.value)
+                    else:
+                        entry["values"][_VALUE_MAP[name]] = float(s.value)
+            except CollectorError as exc:
+                # Partial data is fine (e.g. a runtime build without ICI
+                # counters); a fully-failed fetch poisons the tick below.
+                first_error = first_error or exc
+                log.debug("libtpu fetch of %s failed: %s", name, exc)
+        with self._lock:
+            if cache:
+                self._cache = cache
+                self._cache_error = None
+            else:
+                self._cache = {}
+                self._cache_error = first_error or CollectorError(
+                    "libtpu returned no samples"
+                )
+
+    def sample(self, device: Device) -> Sample:
+        with self._lock:
+            error = self._cache_error
+            entry = self._cache.get(device.index)
+        if error is not None:
+            raise error
+        if entry is None:
+            raise CollectorError(
+                f"libtpu reported no metrics for chip {device.index}"
+            )
+        return Sample(
+            device=device,
+            values=dict(entry["values"]),
+            ici_counters=dict(entry["ici"]),
+            collective_ops=entry["collectives"],
+        )
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self._client.close()

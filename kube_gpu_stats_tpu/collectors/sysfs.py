@@ -1,0 +1,109 @@
+"""``/sys/class/accel`` discovery + environmental attribute reads (part of
+C11, SURVEY.md §2; [T]-tier contract — the accel class is how TPU VMs expose
+chips, replacing the reference's NVML device enumeration).
+
+Discovery enumerates ``<sysfs_root>/class/accel/accel[0-9]*``. Attribute
+reads follow the Linux hwmon convention under each device
+(``device/hwmon/hwmon*/power1_average`` in microwatts,
+``temp1_input`` in millidegrees C), with flat-file fallbacks; every read is
+optional — a missing attribute just means that gauge isn't exported for the
+chip. Fixture trees under tests/ pin the parsing (SURVEY.md §4 "sysfs parser
+tests against fixture trees").
+
+When the C++ fast-path library is available it performs the batched file
+reads (kube_gpu_stats_tpu/native/); this module is the always-available
+pure-Python path and the single place that knows the attribute layout.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from pathlib import Path
+from typing import Sequence
+
+from . import Collector, CollectorError, Device, Sample
+from .. import schema, topology
+
+_ACCEL_RE = re.compile(r"accel(\d+)$")
+
+# Candidate relative paths per metric, tried in order. (path, scale) pairs:
+# value_in_metric_units = raw * scale.
+_POWER_CANDIDATES = (
+    ("device/hwmon/hwmon*/power1_average", 1e-6),  # microwatts -> watts
+    ("power_usage_uw", 1e-6),
+)
+_TEMP_CANDIDATES = (
+    ("device/hwmon/hwmon*/temp1_input", 1e-3),  # millidegree C -> C
+    ("temperature_mc", 1e-3),
+)
+_UUID_CANDIDATES = ("uuid", "device/serial_number")
+
+
+def _read_scaled(accel_dir: Path, candidates) -> float | None:
+    for pattern, scale in candidates:
+        for path in sorted(glob.glob(str(accel_dir / pattern))):
+            try:
+                return float(Path(path).read_text().strip()) * scale
+            except (OSError, ValueError):
+                continue
+    return None
+
+
+def _read_text(accel_dir: Path, names) -> str:
+    for name in names:
+        try:
+            return (accel_dir / name).read_text().strip()
+        except OSError:
+            continue
+    return ""
+
+
+class SysfsCollector(Collector):
+    name = "sysfs"
+
+    def __init__(self, sysfs_root: str | os.PathLike = "/sys",
+                 accel_type: str | None = None) -> None:
+        self._root = Path(sysfs_root)
+        self._accel_type = accel_type if accel_type is not None else topology.accel_type()
+
+    def accel_dir(self, device: Device) -> Path:
+        return self._root / "class" / "accel" / f"accel{device.index}"
+
+    def discover(self) -> Sequence[Device]:
+        devices = []
+        for path in sorted(glob.glob(str(self._root / "class" / "accel" / "accel*"))):
+            match = _ACCEL_RE.search(path)
+            if not match:
+                continue
+            index = int(match.group(1))
+            devices.append(
+                Device(
+                    index=index,
+                    device_id=str(index),
+                    device_path=f"/dev/accel{index}",
+                    accel_type=self._accel_type,
+                    uuid=_read_text(Path(path), _UUID_CANDIDATES),
+                )
+            )
+        devices.sort(key=lambda d: d.index)
+        return devices
+
+    def read_environment(self, device: Device) -> dict[str, float]:
+        """Power/temperature attribute reads; shared with the composite
+        collector so layout knowledge stays in one module."""
+        accel = self.accel_dir(device)
+        if not accel.exists():
+            raise CollectorError(f"{accel} vanished")
+        values: dict[str, float] = {}
+        power = _read_scaled(accel, _POWER_CANDIDATES)
+        if power is not None:
+            values[schema.POWER.name] = power
+        temp = _read_scaled(accel, _TEMP_CANDIDATES)
+        if temp is not None:
+            values[schema.TEMPERATURE.name] = temp
+        return values
+
+    def sample(self, device: Device) -> Sample:
+        return Sample(device=device, values=self.read_environment(device))

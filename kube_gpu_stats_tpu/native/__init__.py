@@ -1,0 +1,20 @@
+"""Native (C++) fast-path hooks.
+
+The hot loop's file-IO cost is dominated by many small sysfs reads; the C++
+sampler batches them in one call. Until the shared library is built (see
+native/Makefile, landing with the native milestone) this is a no-op pass
+through — the pure-Python path is always available.
+"""
+
+from __future__ import annotations
+
+
+def maybe_accelerate_sysfs(sysfs_collector):
+    """Wrap a SysfsCollector with the C++ batched reader when the shared
+    library is present; otherwise return it unchanged."""
+    try:
+        from .binding import NativeSysfsCollector
+
+        return NativeSysfsCollector(sysfs_collector)
+    except Exception:
+        return sysfs_collector

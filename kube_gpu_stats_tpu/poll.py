@@ -153,6 +153,7 @@ class PollLoop:
     def _sample_all(self) -> list[tuple[Device, Sample | None]]:
         if not self._devices:
             return []
+        self._collector.begin_tick()
         futures: dict[concurrent.futures.Future, Device] = {}
         results: list[tuple[Device, Sample | None]] = []
         for dev in self._devices:

@@ -1,0 +1,13 @@
+"""Minimal protobuf wire-format codec + message models for the two gRPC
+surfaces the exporter speaks (SURVEY.md §3 process-boundary crossings):
+
+- kubelet PodResources v1 (unix socket)    -> :mod:`.podresources`
+- libtpu runtime metric service (localhost) -> :mod:`.tpumetrics`
+
+The image has grpcio but no protoc python/grpc plugins, and both services'
+messages are tiny, so we encode/decode the wire format directly
+(:mod:`.codec`) and hand grpc bytes-in/bytes-out serializers. This also
+keeps the exporter free of generated-code version skew — the fake servers
+in tests/ speak the same codec, pinning the contract (SURVEY.md §7 hard
+part a).
+"""

@@ -1,0 +1,124 @@
+"""libtpu runtime metric service — message model + pinned metric names.
+
+The libtpu runtime serves chip counters over localhost gRPC (ports from
+``TPU_RUNTIME_METRICS_PORTS``, default 8431 — SURVEY.md §2 C11). The exact
+proto surface is version-sensitive (SURVEY.md §7 hard part a), so the whole
+contract is isolated here + pinned by the fake server in
+tests/fakes/libtpu_server.py: one method
+
+    /tpu.monitoring.runtime.MetricService/GetRuntimeMetric
+
+taking a metric-name selector and returning one sample per (chip, metric[,
+link]). Adapting to a different libtpu build means editing only this module.
+
+Wire schema (proto3):
+
+    message MetricRequest  { string metric_name = 1; }   // "" = all metrics
+    message Metric {
+      string name        = 1;
+      int64  device_id   = 2;   // local chip index
+      double double_value = 3;
+      int64  int_value   = 4;   // used when the metric is integral
+      int64  timestamp_ns = 5;
+      string link        = 6;   // per-ICI-link metrics only ("x0".."z1")
+    }
+    message MetricResponse { repeated Metric metrics = 1; }
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import codec
+
+METHOD = "/tpu.monitoring.runtime.MetricService/GetRuntimeMetric"
+
+# Pinned metric-name surface (the C11 contract). Keys are our schema
+# families; values are the runtime's metric names.
+DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+TC_UTIL = "tpu.runtime.tensorcore.utilization.percent"
+HBM_USED = "tpu.runtime.hbm.memory.usage.bytes"
+HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+ICI_TRAFFIC = "tpu.runtime.ici.link.traffic.bytes"
+COLLECTIVES = "tpu.runtime.collectives.completed.count"
+
+ALL_METRICS = (DUTY_CYCLE, TC_UTIL, HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVES)
+
+# Metrics whose value is integral and arrives in int_value.
+INT_METRICS = frozenset({HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVES})
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSample:
+    name: str
+    device_id: int
+    value: float | int
+    timestamp_ns: int = 0
+    link: str = ""
+
+
+def encode_request(metric_name: str = "") -> bytes:
+    return codec.field_string(1, metric_name) if metric_name else b""
+
+
+def decode_request(data: bytes) -> str:
+    for field, _, value in codec.iter_fields(data):
+        if field == 1:
+            return value.decode("utf-8")
+    return ""
+
+
+def encode_metric(sample: MetricSample) -> bytes:
+    out = codec.field_string(1, sample.name)
+    out += codec.field_varint(2, sample.device_id)
+    if sample.name in INT_METRICS:
+        out += codec.field_varint(4, int(sample.value))
+    else:
+        out += codec.field_double(3, float(sample.value))
+    if sample.timestamp_ns:
+        out += codec.field_varint(5, sample.timestamp_ns)
+    if sample.link:
+        out += codec.field_string(6, sample.link)
+    return out
+
+
+def decode_metric(data: bytes) -> MetricSample:
+    name = ""
+    device_id = 0
+    double_value: float | None = None
+    int_value: int | None = None
+    timestamp_ns = 0
+    link = ""
+    for field, _, value in codec.iter_fields(data):
+        if field == 1:
+            name = value.decode("utf-8")
+        elif field == 2:
+            device_id = codec.signed(value)
+        elif field == 3:
+            double_value = float(value)
+        elif field == 4:
+            int_value = codec.signed(value)
+        elif field == 5:
+            timestamp_ns = codec.signed(value)
+        elif field == 6:
+            link = value.decode("utf-8")
+    value_out: float | int
+    if int_value is not None:
+        value_out = int_value
+    elif double_value is not None:
+        value_out = double_value
+    else:
+        value_out = 0.0
+    return MetricSample(name, device_id, value_out, timestamp_ns, link)
+
+
+def encode_response(samples: list[MetricSample]) -> bytes:
+    return b"".join(codec.field_bytes(1, encode_metric(s)) for s in samples)
+
+
+def decode_response(data: bytes) -> list[MetricSample]:
+    out = []
+    for field, _, value in codec.iter_fields(data):
+        if field == 1:
+            out.append(decode_metric(value))
+    return out

@@ -1,0 +1,120 @@
+"""In-process fake libtpu runtime-metrics gRPC server (SURVEY.md §4 fake
+backend #2): speaks the pinned MetricService wire contract with scripted
+values, delays and failures, so collector/integration/latency tests run
+with no TPU and no real libtpu."""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from kube_gpu_stats_tpu.proto import tpumetrics
+
+LINKS = ("x0", "x1", "y0", "y1", "z0", "z1")
+HBM_TOTAL = 95 * 1024**3
+
+
+class FakeLibtpuServer:
+    """Deterministic per-chip values; every ICI_TRAFFIC fetch advances the
+    counters so rate math is exercised. Fault injection via attributes:
+
+        server.delay = 0.2          # seconds added to every RPC
+        server.fail = True          # abort with UNAVAILABLE
+        server.garble = True        # return undecodable bytes
+        server.scripted[(name, chip)] = value        # override a value
+        server.drop_metrics.add(tpumetrics.ICI_TRAFFIC)  # UNIMPLEMENTED
+    """
+
+    def __init__(self, num_chips: int = 4, port: int = 0,
+                 chip_offset: int = 0) -> None:
+        self.num_chips = num_chips
+        self.chip_offset = chip_offset  # multi-process runtimes: chips per port
+        self.delay = 0.0
+        self.fail = False
+        self.garble = False
+        self.scripted: dict[tuple[str, int], float] = {}
+        self.drop_metrics: set[str] = set()
+        self.requests: list[str] = []
+        self._ici_fetches = 0
+        self._lock = threading.Lock()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        handler = grpc.method_handlers_generic_handler(
+            "tpu.monitoring.runtime.MetricService",
+            {
+                "GetRuntimeMetric": grpc.unary_unary_rpc_method_handler(
+                    self._handle,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def start(self) -> "FakeLibtpuServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=None)
+
+    def __enter__(self) -> "FakeLibtpuServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request handling ----------------------------------------------------
+
+    def _chips(self) -> range:
+        return range(self.chip_offset, self.chip_offset + self.num_chips)
+
+    def _value(self, name: str, chip: int) -> float:
+        if (name, chip) in self.scripted:
+            return self.scripted[(name, chip)]
+        if name == tpumetrics.DUTY_CYCLE:
+            return 50.0 + chip
+        if name == tpumetrics.TC_UTIL:
+            return 40.0 + chip
+        if name == tpumetrics.HBM_USED:
+            return float((chip + 1) * 1024**3)
+        if name == tpumetrics.HBM_TOTAL:
+            return float(HBM_TOTAL)
+        if name == tpumetrics.COLLECTIVES:
+            return float(100 * (chip + 1))
+        raise AssertionError(name)
+
+    def _handle(self, request_bytes: bytes, context) -> bytes:
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        if self.garble:
+            return b"\xff\xff\xff\xff"
+        name = tpumetrics.decode_request(request_bytes)
+        with self._lock:
+            self.requests.append(name)
+        if name in self.drop_metrics:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, f"no metric {name}")
+        samples = []
+        names = tpumetrics.ALL_METRICS if not name else (name,)
+        for metric in names:
+            if metric == tpumetrics.ICI_TRAFFIC:
+                with self._lock:
+                    self._ici_fetches += 1
+                    fetch = self._ici_fetches
+                for chip in self._chips():
+                    for li, link in enumerate(LINKS):
+                        counter = fetch * 1_000_000 * (chip + 1) * (li + 1)
+                        samples.append(
+                            tpumetrics.MetricSample(metric, chip, counter, link=link)
+                        )
+            else:
+                for chip in self._chips():
+                    samples.append(
+                        tpumetrics.MetricSample(metric, chip, self._value(metric, chip))
+                    )
+        return tpumetrics.encode_response(samples)

@@ -1,0 +1,32 @@
+"""Sysfs fixture-tree builder (SURVEY.md §4: "sysfs parser tests against
+fixture trees under testdata/sys/class/accel/...")."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def make_sysfs(
+    root: Path,
+    num_chips: int = 4,
+    power_uw: int = 120_000_000,
+    temp_mc: int = 45_000,
+    with_hwmon: bool = True,
+    with_uuid: bool = True,
+) -> Path:
+    """Create `<root>/class/accel/accelN/...` mimicking a TPU VM node.
+    Returns `root` (pass as --sysfs-root / SysfsCollector(sysfs_root=...))."""
+    for i in range(num_chips):
+        accel = root / "class" / "accel" / f"accel{i}"
+        accel.mkdir(parents=True)
+        if with_uuid:
+            (accel / "uuid").write_text(f"tpu-chip-{i:04d}\n")
+        device = accel / "device"
+        device.mkdir()
+        (device / "vendor").write_text("0x1ae0\n")
+        if with_hwmon:
+            hwmon = device / "hwmon" / "hwmon0"
+            hwmon.mkdir(parents=True)
+            (hwmon / "power1_average").write_text(f"{power_uw + i * 1_000_000}\n")
+            (hwmon / "temp1_input").write_text(f"{temp_mc + i * 500}\n")
+    return root

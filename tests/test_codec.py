@@ -1,0 +1,64 @@
+"""Wire-format codec unit tests."""
+
+import struct
+
+import pytest
+
+from kube_gpu_stats_tpu.proto import codec
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2**32, 2**63 - 1):
+        data = codec.encode_varint(v)
+        decoded, pos = codec.decode_varint(data, 0)
+        assert (decoded, pos) == (v, len(data))
+
+
+def test_negative_varint_int64():
+    data = codec.encode_varint(-5)
+    assert len(data) == 10  # two's-complement 64-bit always 10 bytes
+    decoded, _ = codec.decode_varint(data, 0)
+    assert codec.signed(decoded) == -5
+
+
+def test_truncated_varint():
+    with pytest.raises(ValueError):
+        codec.decode_varint(b"\x80", 0)
+
+
+def test_field_roundtrip_all_types():
+    msg = (
+        codec.field_varint(1, 42)
+        + codec.field_double(2, 3.5)
+        + codec.field_string(3, "héllo")
+        + codec.field_bytes(4, b"\x00\x01")
+    )
+    fields = list(codec.iter_fields(msg))
+    assert fields[0] == (1, codec.VARINT, 42)
+    assert fields[1] == (2, codec.FIXED64, 3.5)
+    assert fields[2][2].decode("utf-8") == "héllo"
+    assert fields[3][2] == b"\x00\x01"
+
+
+def test_unknown_fields_are_iterated_not_fatal():
+    msg = codec.field_varint(99, 7) + codec.field_string(1, "x")
+    fields = {f: v for f, _, v in codec.iter_fields(msg)}
+    assert fields[1] == b"x"
+    assert fields[99] == 7
+
+
+def test_truncated_length_delimited():
+    bad = codec.tag(1, codec.LENGTH) + codec.encode_varint(100) + b"short"
+    with pytest.raises(ValueError):
+        list(codec.iter_fields(bad))
+
+
+def test_truncated_fixed64():
+    bad = codec.tag(1, codec.FIXED64) + struct.pack("<I", 1)
+    with pytest.raises(ValueError):
+        list(codec.iter_fields(bad))
+
+
+def test_unsupported_wire_type():
+    with pytest.raises(ValueError):
+        list(codec.iter_fields(codec.encode_varint((1 << 3) | 3)))  # start-group

@@ -1,0 +1,126 @@
+"""Composite TPU backend: sysfs + libtpu merged, independent degradation;
+plus daemon auto-detection against a fixture tree (configs[1] integration)."""
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import CollectorError
+from kube_gpu_stats_tpu.collectors.composite import TpuCollector
+from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+from fakes.libtpu_server import FakeLibtpuServer
+from fixtures import make_sysfs
+
+
+@pytest.fixture
+def server():
+    with FakeLibtpuServer(num_chips=2) as s:
+        yield s
+
+
+def make_tpu(tmp_path, server, **kw):
+    make_sysfs(tmp_path, num_chips=2)
+    return TpuCollector(
+        sysfs_root=str(tmp_path),
+        libtpu_client=LibtpuClient(ports=(server.port,), rpc_timeout=1.0),
+        use_native=False,
+        **kw,
+    )
+
+
+def test_merged_sample(tmp_path, server, monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    col = make_tpu(tmp_path, server)
+    devs = col.discover()
+    assert len(devs) == 2
+    assert devs[0].accel_type == "tpu-v5p"  # sysfs enumeration wins
+    assert devs[0].uuid == "tpu-chip-0000"
+    col.begin_tick()
+    s = col.sample(devs[1])
+    # Runtime counters AND sysfs environment in one sample.
+    assert s.values[schema.DUTY_CYCLE.name] == 51.0
+    assert s.values[schema.POWER.name] == pytest.approx(121.0)
+    assert s.values[schema.TEMPERATURE.name] == pytest.approx(45.5)
+    assert len(s.ici_counters) == 6
+    col.close()
+
+
+def test_libtpu_down_degrades_to_environment_only(tmp_path, server):
+    col = make_tpu(tmp_path, server)
+    devs = col.discover()
+    server.fail = True
+    col.begin_tick()
+    s = col.sample(devs[0])
+    assert schema.POWER.name in s.values
+    assert schema.DUTY_CYCLE.name not in s.values
+    col.close()
+
+
+def test_both_sources_down_is_stale(tmp_path, server):
+    col = make_tpu(tmp_path, server)
+    devs = col.discover()
+    server.fail = True
+    import shutil
+
+    shutil.rmtree(tmp_path / "class")
+    col.begin_tick()
+    with pytest.raises(CollectorError):
+        col.sample(devs[0])
+    col.close()
+
+
+def test_through_poll_loop_full_families(tmp_path, server):
+    col = make_tpu(tmp_path, server)
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=5.0)
+    loop.tick()
+    loop.tick()
+    snap = reg.snapshot()
+    families = {s.spec.name for s in snap.series}
+    for family in (
+        "accelerator_duty_cycle",
+        "accelerator_memory_used_bytes",
+        "accelerator_power_watts",
+        "accelerator_temperature_celsius",
+        "accelerator_ici_link_bandwidth_bytes_per_second",
+        "accelerator_collective_ops_total",
+        "accelerator_up",
+    ):
+        assert family in families, family
+    ups = [s.value for s in snap.series if s.spec.name == "accelerator_up"]
+    assert ups == [1.0, 1.0]
+    loop.stop()
+
+
+def test_daemon_auto_detects_tpu(tmp_path, server, monkeypatch):
+    """--backend auto probes sysfs and builds the TPU backend (E1)."""
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import build_collector
+
+    make_sysfs(tmp_path, num_chips=2)
+    monkeypatch.setenv("TPU_RUNTIME_METRICS_PORTS", str(server.port))
+    cfg = Config(backend="auto", sysfs_root=str(tmp_path),
+                 libtpu_ports=(server.port,), use_native=False)
+    col = build_collector(cfg)
+    assert col.name == "tpu"
+    assert len(col.discover()) == 2
+    col.close()
+
+
+def test_libtpu_only_node_discovers_via_runtime(tmp_path, server):
+    """TPU VM variants without /sys/class/accel fall back to runtime
+    enumeration."""
+    col = TpuCollector(
+        sysfs_root=str(tmp_path),  # empty tree
+        libtpu_client=LibtpuClient(ports=(server.port,), rpc_timeout=1.0),
+        use_native=False,
+    )
+    devs = col.discover()
+    assert len(devs) == 2
+    col.begin_tick()
+    s = col.sample(devs[0])
+    assert schema.DUTY_CYCLE.name in s.values
+    assert schema.POWER.name not in s.values
+    col.close()

@@ -1,0 +1,128 @@
+"""libtpu client/collector against the fake runtime-metrics server
+(SURVEY.md §4 fake backend #2; BASELINE.json configs[1])."""
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import CollectorError
+from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient, LibtpuCollector
+from kube_gpu_stats_tpu.proto import tpumetrics
+
+from fakes.libtpu_server import HBM_TOTAL, LINKS, FakeLibtpuServer
+
+
+@pytest.fixture
+def server():
+    with FakeLibtpuServer(num_chips=4) as s:
+        yield s
+
+
+def make_collector(server, **kw):
+    client = LibtpuClient(ports=(server.port,), rpc_timeout=kw.pop("rpc_timeout", 1.0))
+    return LibtpuCollector(client, accel_type="tpu-test", **kw)
+
+
+def test_client_get_metric(server):
+    client = LibtpuClient(ports=(server.port,), rpc_timeout=1.0)
+    samples = client.get_metric(tpumetrics.DUTY_CYCLE)
+    assert len(samples) == 4
+    assert samples[0].value == 50.0
+    client.close()
+
+
+def test_discover_via_hbm_total(server):
+    col = make_collector(server)
+    devs = col.discover()
+    assert [d.index for d in devs] == [0, 1, 2, 3]
+    assert devs[0].accel_type == "tpu-test"
+    col.close()
+
+
+def test_begin_tick_then_sample(server):
+    col = make_collector(server)
+    devs = col.discover()
+    col.begin_tick()
+    s = col.sample(devs[2])
+    assert s.values[schema.DUTY_CYCLE.name] == 52.0
+    assert s.values[schema.MEMORY_USED.name] == 3 * 1024**3
+    assert s.values[schema.MEMORY_TOTAL.name] == HBM_TOTAL
+    assert set(s.ici_counters) == set(LINKS)
+    assert s.collective_ops == 300
+    col.close()
+
+
+def test_sample_before_any_tick_raises(server):
+    col = make_collector(server)
+    devs = col.discover()
+    with pytest.raises(CollectorError):
+        col.sample(devs[0])
+    col.close()
+
+
+def test_server_down_poisons_tick(server):
+    col = make_collector(server)
+    devs = col.discover()
+    server.fail = True
+    col.begin_tick()
+    with pytest.raises(CollectorError):
+        col.sample(devs[0])
+    server.fail = False
+    col.begin_tick()
+    assert col.sample(devs[0]).values
+    col.close()
+
+
+def test_partial_metric_failure_keeps_rest(server):
+    server.drop_metrics.add(tpumetrics.ICI_TRAFFIC)
+    col = make_collector(server)
+    devs = col.discover()
+    col.begin_tick()
+    s = col.sample(devs[0])
+    assert s.ici_counters == {}
+    assert schema.DUTY_CYCLE.name in s.values
+    col.close()
+
+
+def test_rpc_timeout_is_a_collector_error(server):
+    server.delay = 0.5
+    col = make_collector(server, rpc_timeout=0.05)
+    col.begin_tick()
+    dev_stub = type("D", (), {"index": 0})
+    with pytest.raises(CollectorError):
+        col.sample(dev_stub)
+    col.close()
+
+
+def test_garbled_response_is_collector_error(server):
+    col = make_collector(server)
+    devs = col.discover()
+    server.garble = True
+    col.begin_tick()
+    with pytest.raises(CollectorError):
+        col.sample(devs[0])
+    col.close()
+
+
+def test_multi_port_merge():
+    """Multi-process runtimes serve different chips on different ports
+    (TPU_RUNTIME_METRICS_PORTS lists several); the client merges them."""
+    with FakeLibtpuServer(num_chips=2, chip_offset=0) as s1, \
+         FakeLibtpuServer(num_chips=2, chip_offset=2) as s2:
+        client = LibtpuClient(ports=(s1.port, s2.port), rpc_timeout=1.0)
+        col = LibtpuCollector(client, accel_type="tpu-test")
+        devs = col.discover()
+        assert [d.index for d in devs] == [0, 1, 2, 3]
+        col.begin_tick()
+        assert col.sample(devs[3]).values[schema.DUTY_CYCLE.name] == 53.0
+        col.close()
+
+
+def test_one_port_down_still_serves_other():
+    with FakeLibtpuServer(num_chips=2) as s1:
+        client = LibtpuClient(ports=(s1.port, 1), rpc_timeout=0.3)  # port 1: dead
+        col = LibtpuCollector(client, accel_type="tpu-test")
+        devs = col.discover()
+        assert len(devs) == 2
+        col.begin_tick()
+        assert col.sample(devs[1]).values
+        col.close()

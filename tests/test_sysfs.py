@@ -1,0 +1,73 @@
+"""Sysfs discovery + attribute parsing against fixture trees
+(SURVEY.md §4 unit tier)."""
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import CollectorError
+from kube_gpu_stats_tpu.collectors.sysfs import SysfsCollector
+
+from fixtures import make_sysfs
+
+
+def test_discovery(tmp_path):
+    make_sysfs(tmp_path, num_chips=4)
+    col = SysfsCollector(tmp_path, accel_type="tpu-v5p")
+    devs = col.discover()
+    assert [d.index for d in devs] == [0, 1, 2, 3]
+    assert devs[2].device_path == "/dev/accel2"
+    assert devs[2].uuid == "tpu-chip-0002"
+    assert devs[2].accel_type == "tpu-v5p"
+
+
+def test_discovery_empty_tree(tmp_path):
+    assert SysfsCollector(tmp_path, accel_type="tpu").discover() == []
+
+
+def test_environment_reads_hwmon_scaling(tmp_path):
+    make_sysfs(tmp_path, num_chips=1, power_uw=150_000_000, temp_mc=52_500)
+    col = SysfsCollector(tmp_path, accel_type="tpu")
+    dev = col.discover()[0]
+    sample = col.sample(dev)
+    assert sample.values[schema.POWER.name] == pytest.approx(150.0)
+    assert sample.values[schema.TEMPERATURE.name] == pytest.approx(52.5)
+
+
+def test_flat_file_fallback(tmp_path):
+    make_sysfs(tmp_path, num_chips=1, with_hwmon=False)
+    accel = tmp_path / "class" / "accel" / "accel0"
+    (accel / "power_usage_uw").write_text("99000000\n")
+    (accel / "temperature_mc").write_text("41000\n")
+    col = SysfsCollector(tmp_path, accel_type="tpu")
+    sample = col.sample(col.discover()[0])
+    assert sample.values[schema.POWER.name] == pytest.approx(99.0)
+    assert sample.values[schema.TEMPERATURE.name] == pytest.approx(41.0)
+
+
+def test_missing_attributes_are_omitted_not_fatal(tmp_path):
+    make_sysfs(tmp_path, num_chips=1, with_hwmon=False, with_uuid=False)
+    col = SysfsCollector(tmp_path, accel_type="tpu")
+    dev = col.discover()[0]
+    assert dev.uuid == ""
+    assert col.sample(dev).values == {}
+
+
+def test_garbage_attribute_skipped(tmp_path):
+    make_sysfs(tmp_path, num_chips=1, with_hwmon=True)
+    hwmon = tmp_path / "class/accel/accel0/device/hwmon/hwmon0"
+    (hwmon / "power1_average").write_text("not-a-number\n")
+    col = SysfsCollector(tmp_path, accel_type="tpu")
+    values = col.sample(col.discover()[0]).values
+    assert schema.POWER.name not in values
+    assert schema.TEMPERATURE.name in values
+
+
+def test_vanished_device_raises(tmp_path):
+    make_sysfs(tmp_path, num_chips=1)
+    col = SysfsCollector(tmp_path, accel_type="tpu")
+    dev = col.discover()[0]
+    import shutil
+
+    shutil.rmtree(tmp_path / "class" / "accel" / "accel0")
+    with pytest.raises(CollectorError):
+        col.sample(dev)

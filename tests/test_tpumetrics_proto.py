@@ -1,0 +1,35 @@
+from kube_gpu_stats_tpu.proto import tpumetrics
+
+
+def test_request_roundtrip():
+    assert tpumetrics.decode_request(tpumetrics.encode_request("foo")) == "foo"
+    assert tpumetrics.decode_request(tpumetrics.encode_request("")) == ""
+    assert tpumetrics.decode_request(b"") == ""
+
+
+def test_double_metric_roundtrip():
+    s = tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 3, 72.5, timestamp_ns=123)
+    out = tpumetrics.decode_response(tpumetrics.encode_response([s]))
+    assert out == [s]
+
+
+def test_int_metric_roundtrip():
+    s = tpumetrics.MetricSample(tpumetrics.HBM_USED, 0, 7 * 1024**3)
+    (decoded,) = tpumetrics.decode_response(tpumetrics.encode_response([s]))
+    assert decoded.value == 7 * 1024**3
+    assert isinstance(decoded.value, int)
+
+
+def test_link_metric_roundtrip():
+    s = tpumetrics.MetricSample(tpumetrics.ICI_TRAFFIC, 2, 999, link="y1")
+    (decoded,) = tpumetrics.decode_response(tpumetrics.encode_response([s]))
+    assert decoded.link == "y1"
+    assert decoded.value == 999
+
+
+def test_multiple_samples_preserve_order():
+    samples = [
+        tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, i, float(i)) for i in range(5)
+    ]
+    decoded = tpumetrics.decode_response(tpumetrics.encode_response(samples))
+    assert [s.device_id for s in decoded] == [0, 1, 2, 3, 4]
