@@ -1,0 +1,161 @@
+"""Pod<->device attribution (component C3, layer L2 — SURVEY.md §1/§2).
+
+The reference joined NVIDIA device-plugin allocations onto GPU samples; here
+the allocation source is the GKE TPU device-plugin, read through either:
+
+- :mod:`.podresources` — kubelet PodResources v1 ``List()`` over the unix
+  socket (the modern mechanism; pod name + namespace + container), or
+- :mod:`.checkpoint` — the kubelet device-plugin checkpoint file (fallback
+  for clusters where the PodResources socket isn't mounted; pod *UID* only).
+
+Both feed :class:`CachedAttribution`: a background refresher on its own
+cadence (E4) maintaining an immutable device-key -> labels dict, so the poll
+loop's ``lookup`` is a pure dict probe — the hot path never crosses a
+process boundary (SURVEY.md §3 E2).
+
+Device-key matching (SURVEY.md §7 hard part c): TPU device-plugin device IDs
+vary in shape across versions ("0", "4-7", "/dev/accel0", uuids), so a
+refresh indexes every id under several normalized candidate keys and
+``lookup`` probes the device's own candidates in order.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Mapping, Protocol
+
+from ..collectors import Device
+
+log = logging.getLogger(__name__)
+
+# Resource classes attributed. google.com/tpu is the GKE TPU device-plugin;
+# nvidia.com/gpu kept for the unified mixed-cluster schema (C12).
+TPU_RESOURCE = "google.com/tpu"
+GPU_RESOURCE = "nvidia.com/gpu"
+RESOURCE_NAMES = (TPU_RESOURCE, GPU_RESOURCE)
+
+Labels = Mapping[str, str]
+
+
+def candidate_keys(device_id: str) -> list[str]:
+    """Normalized index keys for one allocation-side device id."""
+    keys = [device_id]
+    if device_id.startswith("/dev/"):
+        keys.append(device_id[len("/dev/"):])
+    if device_id.startswith("accel"):
+        suffix = device_id[len("accel"):]
+        if suffix.isdigit():
+            keys.append(suffix)
+    # "4-7" style range ids expand to each index.
+    if "-" in device_id:
+        lo, _, hi = device_id.partition("-")
+        if lo.isdigit() and hi.isdigit() and int(lo) <= int(hi) <= int(lo) + 512:
+            keys.extend(str(i) for i in range(int(lo), int(hi) + 1))
+    return keys
+
+
+def device_probe_keys(device: Device) -> list[str]:
+    """Keys a local device answers to, in match-priority order."""
+    keys = [device.device_id]
+    if device.uuid:
+        keys.append(device.uuid)
+    keys.append(device.device_path)
+    if device.device_path.startswith("/dev/"):
+        keys.append(device.device_path[len("/dev/"):])
+    keys.append(str(device.index))
+    seen: set[str] = set()
+    return [k for k in keys if k and not (k in seen or seen.add(k))]
+
+
+class AllocationSource(Protocol):
+    """One refresh: returns device-key -> {"pod","namespace","container"}."""
+
+    def fetch(self) -> dict[str, Labels]: ...
+
+    def close(self) -> None: ...
+
+
+def index_allocations(
+    allocations: list[tuple[str, Labels]]
+) -> dict[str, Labels]:
+    """Expand (device_id, labels) pairs into the candidate-key index."""
+    table: dict[str, Labels] = {}
+    for device_id, labels in allocations:
+        for key in candidate_keys(device_id):
+            table.setdefault(key, labels)
+    return table
+
+
+class CachedAttribution:
+    """Background-refreshed map; RPC-free lookups (E4 off the hot path).
+
+    On refresh failure the previous map is retained and a warning logged —
+    stale attribution beats a crash-looping DaemonSet (SURVEY.md §5)."""
+
+    def __init__(self, source: AllocationSource,
+                 refresh_interval: float = 10.0) -> None:
+        self._source = source
+        self._interval = refresh_interval
+        self._map: dict[str, Labels] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.consecutive_failures = 0
+
+    def refresh_once(self) -> None:
+        try:
+            self._map = self._source.fetch()
+            self.consecutive_failures = 0
+        except Exception as exc:
+            self.consecutive_failures += 1
+            log.warning("attribution refresh failed (%d consecutive): %s",
+                        self.consecutive_failures, exc)
+
+    def lookup(self, device: Device) -> Labels:
+        table = self._map
+        for key in device_probe_keys(device):
+            labels = table.get(key)
+            if labels is not None:
+                return labels
+        return {}
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.refresh_once()
+            # Exponential-ish backoff on persistent failure, capped: don't
+            # hammer a dead kubelet socket (SURVEY.md §5 retry-with-backoff).
+            wait = self._interval * min(1 + self.consecutive_failures, 6)
+            self._stop.wait(wait)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="attribution-refresh", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._source.close()
+
+
+def build(mode: str, kubelet_socket: str, checkpoint_path: str,
+          refresh_interval: float) -> CachedAttribution:
+    """Factory for daemon.build_attribution. mode: auto|podresources|checkpoint."""
+    from .checkpoint import CheckpointSource
+    from .podresources import PodResourcesSource
+
+    source: AllocationSource
+    if mode == "podresources":
+        source = PodResourcesSource(kubelet_socket)
+    elif mode == "checkpoint":
+        source = CheckpointSource(checkpoint_path)
+    else:  # auto: prefer the richer PodResources API when its socket exists
+        import os
+
+        if os.path.exists(kubelet_socket):
+            source = PodResourcesSource(kubelet_socket)
+        else:
+            source = CheckpointSource(checkpoint_path)
+    return CachedAttribution(source, refresh_interval)

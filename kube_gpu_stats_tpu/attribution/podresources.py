@@ -1,0 +1,45 @@
+"""PodResources v1 allocation source — kubelet unix-socket gRPC client
+(SURVEY.md §3 E4: List() on its own cadence, crossing the node<->kubelet
+boundary)."""
+
+from __future__ import annotations
+
+import grpc
+
+from . import RESOURCE_NAMES, Labels, index_allocations
+from ..proto import podresources as pb
+
+
+class PodResourcesSource:
+    def __init__(self, socket_path: str, rpc_timeout: float = 5.0) -> None:
+        self._channel = grpc.insecure_channel(
+            f"unix://{socket_path}",
+            options=[("grpc.enable_http_proxy", 0)],
+        )
+        self._list = self._channel.unary_unary(
+            pb.LIST_METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self._timeout = rpc_timeout
+
+    def fetch(self) -> dict[str, Labels]:
+        raw = self._list(pb.encode_list_request(), timeout=self._timeout)
+        pods = pb.decode_list_response(raw)
+        allocations: list[tuple[str, Labels]] = []
+        for pod in pods:
+            for container in pod.containers:
+                labels = {
+                    "pod": pod.name,
+                    "namespace": pod.namespace,
+                    "container": container.name,
+                }
+                for devices in container.devices:
+                    if devices.resource_name not in RESOURCE_NAMES:
+                        continue
+                    for device_id in devices.device_ids:
+                        allocations.append((device_id, labels))
+        return index_allocations(allocations)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,121 @@
+"""kubelet PodResources v1 API — message model (component C3 transport).
+
+Public API shape (k8s.io/kubelet/pkg/apis/podresources/v1; [G]/[T] tier,
+SURVEY.md §0 — the reference consumed the same service for NVIDIA
+device-plugin allocations, SURVEY.md §2 C3):
+
+    service PodResources { rpc List(ListPodResourcesRequest)
+                               returns (ListPodResourcesResponse); }
+    message ListPodResourcesRequest {}
+    message ListPodResourcesResponse { repeated PodResources pod_resources = 1; }
+    message PodResources { string name = 1; string namespace = 2;
+                           repeated ContainerResources containers = 3; }
+    message ContainerResources { string name = 1;
+                                 repeated ContainerDevices devices = 2; }
+    message ContainerDevices { string resource_name = 1;
+                               repeated string device_ids = 2; }
+
+Fields beyond these (topology hints, cpu_ids, memory) are skipped by the
+codec's unknown-field tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import codec
+
+LIST_METHOD = "/v1.PodResources/List"
+
+
+@dataclasses.dataclass(frozen=True)
+class ContainerDevices:
+    resource_name: str
+    device_ids: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ContainerResources:
+    name: str
+    devices: tuple[ContainerDevices, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PodResources:
+    name: str
+    namespace: str
+    containers: tuple[ContainerResources, ...]
+
+
+def encode_list_request() -> bytes:
+    return b""
+
+
+def encode_container_devices(d: ContainerDevices) -> bytes:
+    out = codec.field_string(1, d.resource_name)
+    for device_id in d.device_ids:
+        out += codec.field_string(2, device_id)
+    return out
+
+
+def decode_container_devices(data: bytes) -> ContainerDevices:
+    resource_name = ""
+    ids: list[str] = []
+    for field, _, value in codec.iter_fields(data):
+        if field == 1:
+            resource_name = value.decode("utf-8")
+        elif field == 2:
+            ids.append(value.decode("utf-8"))
+    return ContainerDevices(resource_name, tuple(ids))
+
+
+def encode_container(c: ContainerResources) -> bytes:
+    out = codec.field_string(1, c.name)
+    for d in c.devices:
+        out += codec.field_bytes(2, encode_container_devices(d))
+    return out
+
+
+def decode_container(data: bytes) -> ContainerResources:
+    name = ""
+    devices: list[ContainerDevices] = []
+    for field, _, value in codec.iter_fields(data):
+        if field == 1:
+            name = value.decode("utf-8")
+        elif field == 2:
+            devices.append(decode_container_devices(value))
+    return ContainerResources(name, tuple(devices))
+
+
+def encode_pod(p: PodResources) -> bytes:
+    out = codec.field_string(1, p.name)
+    out += codec.field_string(2, p.namespace)
+    for c in p.containers:
+        out += codec.field_bytes(3, encode_container(c))
+    return out
+
+
+def decode_pod(data: bytes) -> PodResources:
+    name = ""
+    namespace = ""
+    containers: list[ContainerResources] = []
+    for field, _, value in codec.iter_fields(data):
+        if field == 1:
+            name = value.decode("utf-8")
+        elif field == 2:
+            namespace = value.decode("utf-8")
+        elif field == 3:
+            containers.append(decode_container(value))
+    return PodResources(name, namespace, tuple(containers))
+
+
+def encode_list_response(pods: list[PodResources]) -> bytes:
+    return b"".join(codec.field_bytes(1, encode_pod(p)) for p in pods)
+
+
+def decode_list_response(data: bytes) -> list[PodResources]:
+    return [
+        decode_pod(value)
+        for field, _, value in codec.iter_fields(data)
+        if field == 1
+    ]

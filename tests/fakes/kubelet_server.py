@@ -1,0 +1,68 @@
+"""Fake kubelet PodResources v1 server on a unix socket (SURVEY.md §4 fake
+backend #3): canned google.com/tpu allocations for attribution tests."""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from kube_gpu_stats_tpu.proto import podresources as pb
+
+
+class FakeKubeletServer:
+    """`pods` is a list of pb.PodResources; mutate between refreshes to
+    simulate (de)allocations. `fail=True` aborts List with UNAVAILABLE."""
+
+    def __init__(self, socket_path: str, pods: list[pb.PodResources] | None = None):
+        self.pods: list[pb.PodResources] = pods or []
+        self.fail = False
+        self.list_calls = 0
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        handler = grpc.method_handlers_generic_handler(
+            "v1.PodResources",
+            {
+                "List": grpc.unary_unary_rpc_method_handler(
+                    self._list,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(f"unix://{socket_path}")
+        self.socket_path = socket_path
+
+    def _list(self, request_bytes: bytes, context) -> bytes:
+        self.list_calls += 1
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "kubelet injected failure")
+        return pb.encode_list_response(self.pods)
+
+    def start(self) -> "FakeKubeletServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=None)
+
+    def __enter__(self) -> "FakeKubeletServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def tpu_pod(name: str, namespace: str, container: str,
+            device_ids: list[str],
+            resource: str = "google.com/tpu") -> pb.PodResources:
+    return pb.PodResources(
+        name=name,
+        namespace=namespace,
+        containers=(
+            pb.ContainerResources(
+                name=container,
+                devices=(pb.ContainerDevices(resource, tuple(device_ids)),),
+            ),
+        ),
+    )

@@ -1,0 +1,205 @@
+"""Attribution: PodResources client + checkpoint fallback + cached provider
+(SURVEY.md §4; BASELINE.json configs[2])."""
+
+import json
+
+import pytest
+
+from kube_gpu_stats_tpu.attribution import (
+    CachedAttribution,
+    build,
+    candidate_keys,
+    device_probe_keys,
+)
+from kube_gpu_stats_tpu.attribution.checkpoint import CheckpointSource
+from kube_gpu_stats_tpu.attribution.podresources import PodResourcesSource
+from kube_gpu_stats_tpu.collectors import Device
+from kube_gpu_stats_tpu.proto import podresources as pb
+
+from fakes.kubelet_server import FakeKubeletServer, tpu_pod
+
+
+def dev(index, uuid=""):
+    return Device(index, str(index), f"/dev/accel{index}", "tpu", uuid)
+
+
+# -- key normalization (SURVEY.md §7 hard part c) ---------------------------
+
+def test_candidate_keys_plain_index():
+    assert candidate_keys("3") == ["3"]
+
+
+def test_candidate_keys_dev_path():
+    assert "/dev/accel2" in candidate_keys("/dev/accel2")
+    assert "accel2" in candidate_keys("/dev/accel2")
+
+
+def test_candidate_keys_accel_name():
+    assert "5" in candidate_keys("accel5")
+
+
+def test_candidate_keys_range():
+    keys = candidate_keys("4-7")
+    for i in ("4", "5", "6", "7"):
+        assert i in keys
+
+
+def test_device_probe_keys_order_and_dedup():
+    keys = device_probe_keys(dev(0, uuid="tpu-uuid-0"))
+    assert keys[0] == "0"
+    assert "tpu-uuid-0" in keys
+    assert "/dev/accel0" in keys
+    assert "accel0" in keys
+    assert len(keys) == len(set(keys))
+
+
+# -- PodResources source -----------------------------------------------------
+
+@pytest.fixture
+def kubelet(tmp_path):
+    socket = str(tmp_path / "kubelet.sock")
+    pods = [
+        tpu_pod("train-job-abc", "ml", "worker", ["0", "1"]),
+        tpu_pod("infer-xyz", "serving", "model", ["/dev/accel2"]),
+        tpu_pod("gpu-pod", "other", "cuda", ["GPU-uuid-1"], resource="nvidia.com/gpu"),
+        tpu_pod("ignored", "x", "c", ["9"], resource="example.com/fpga"),
+    ]
+    with FakeKubeletServer(socket, pods) as server:
+        yield server
+
+
+def test_podresources_fetch(kubelet):
+    source = PodResourcesSource(kubelet.socket_path)
+    table = source.fetch()
+    assert table["0"]["pod"] == "train-job-abc"
+    assert table["0"]["namespace"] == "ml"
+    assert table["1"]["container"] == "worker"
+    # /dev/accel2 id answered under both raw and normalized keys.
+    assert table["/dev/accel2"]["pod"] == "infer-xyz"
+    assert table["accel2"]["pod"] == "infer-xyz"
+    # nvidia.com/gpu kept (unified schema C12), unknown resources dropped.
+    assert table["GPU-uuid-1"]["pod"] == "gpu-pod"
+    assert "9" not in table
+    source.close()
+
+
+def test_cached_attribution_lookup(kubelet):
+    cached = CachedAttribution(PodResourcesSource(kubelet.socket_path))
+    cached.refresh_once()
+    assert cached.lookup(dev(0))["pod"] == "train-job-abc"
+    assert cached.lookup(dev(2))["pod"] == "infer-xyz"
+    assert cached.lookup(dev(5)) == {}
+    cached.stop()
+
+
+def test_refresh_failure_keeps_last_map(kubelet):
+    cached = CachedAttribution(PodResourcesSource(kubelet.socket_path))
+    cached.refresh_once()
+    kubelet.fail = True
+    cached.refresh_once()
+    assert cached.consecutive_failures == 1
+    assert cached.lookup(dev(0))["pod"] == "train-job-abc"  # stale > empty
+    kubelet.fail = False
+    cached.refresh_once()
+    assert cached.consecutive_failures == 0
+    cached.stop()
+
+
+def test_reallocation_visible_after_refresh(kubelet):
+    cached = CachedAttribution(PodResourcesSource(kubelet.socket_path))
+    cached.refresh_once()
+    kubelet.pods = [tpu_pod("new-owner", "ml2", "c2", ["0"])]
+    cached.refresh_once()
+    assert cached.lookup(dev(0))["pod"] == "new-owner"
+    assert cached.lookup(dev(1)) == {}  # deallocated
+    cached.stop()
+
+
+def test_background_refresh_thread(kubelet):
+    cached = CachedAttribution(
+        PodResourcesSource(kubelet.socket_path), refresh_interval=0.05
+    )
+    cached.start()
+    import time
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and kubelet.list_calls < 2:
+        time.sleep(0.01)
+    cached.stop()
+    assert kubelet.list_calls >= 2
+    assert cached.lookup(dev(0))["pod"] == "train-job-abc"
+
+
+# -- checkpoint fallback -----------------------------------------------------
+
+def checkpoint_doc():
+    return {
+        "Data": {
+            "PodDeviceEntries": [
+                {
+                    "PodUID": "uid-1234",
+                    "ContainerName": "worker",
+                    "ResourceName": "google.com/tpu",
+                    "DeviceIDs": {"-1": ["0", "1"]},
+                },
+                {
+                    "PodUID": "uid-old",
+                    "ContainerName": "legacy",
+                    "ResourceName": "google.com/tpu",
+                    "DeviceIDs": ["2"],  # pre-1.20 flat shape
+                },
+                {
+                    "PodUID": "uid-skip",
+                    "ContainerName": "fpga",
+                    "ResourceName": "example.com/fpga",
+                    "DeviceIDs": {"-1": ["3"]},
+                },
+            ],
+            "RegisteredDevices": {"google.com/tpu": ["0", "1", "2"]},
+        },
+        "Checksum": 12345,
+    }
+
+
+def test_checkpoint_fetch(tmp_path):
+    path = tmp_path / "kubelet_internal_checkpoint"
+    path.write_text(json.dumps(checkpoint_doc()))
+    table = CheckpointSource(str(path)).fetch()
+    assert table["0"] == {"pod": "uid-1234", "namespace": "", "container": "worker"}
+    assert table["2"]["container"] == "legacy"
+    assert "3" not in table
+
+
+def test_checkpoint_missing_file_is_refresh_failure(tmp_path):
+    cached = CachedAttribution(CheckpointSource(str(tmp_path / "nope")))
+    cached.refresh_once()
+    assert cached.consecutive_failures == 1
+    assert cached.lookup(dev(0)) == {}
+
+
+# -- factory -----------------------------------------------------------------
+
+def test_build_auto_prefers_podresources(kubelet, tmp_path):
+    cached = build(
+        mode="auto",
+        kubelet_socket=kubelet.socket_path,
+        checkpoint_path=str(tmp_path / "nope"),
+        refresh_interval=10.0,
+    )
+    cached.refresh_once()
+    assert cached.lookup(dev(0))["pod"] == "train-job-abc"
+    cached.stop()
+
+
+def test_build_auto_falls_back_to_checkpoint(tmp_path):
+    path = tmp_path / "kubelet_internal_checkpoint"
+    path.write_text(json.dumps(checkpoint_doc()))
+    cached = build(
+        mode="auto",
+        kubelet_socket=str(tmp_path / "missing.sock"),
+        checkpoint_path=str(path),
+        refresh_interval=10.0,
+    )
+    cached.refresh_once()
+    assert cached.lookup(dev(0))["pod"] == "uid-1234"
+    cached.stop()

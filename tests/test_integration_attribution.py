@@ -1,0 +1,87 @@
+"""Full-stack configs[2] integration: daemon with TPU backend + PodResources
+attribution; scrape carries pod labels on the right chips, reallocation
+flows through on refresh (SURVEY.md §4 integration tier)."""
+
+import time
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu.config import Config
+from kube_gpu_stats_tpu.daemon import Daemon
+
+from fakes.kubelet_server import FakeKubeletServer, tpu_pod
+from fakes.libtpu_server import FakeLibtpuServer
+from fixtures import make_sysfs
+
+
+@pytest.fixture
+def stack(tmp_path):
+    make_sysfs(tmp_path / "sys", num_chips=4)
+    socket = str(tmp_path / "kubelet.sock")
+    pods = [tpu_pod("train-job", "ml", "worker", ["0", "1"])]
+    with FakeLibtpuServer(num_chips=4) as libtpu, \
+         FakeKubeletServer(socket, pods) as kubelet:
+        cfg = Config(
+            backend="tpu",
+            sysfs_root=str(tmp_path / "sys"),
+            libtpu_ports=(libtpu.port,),
+            interval=0.05,
+            deadline=1.0,
+            listen_host="127.0.0.1",
+            listen_port=0,
+            attribution="podresources",
+            kubelet_socket=socket,
+            attribution_interval=0.05,
+            use_native=False,
+        )
+        daemon = Daemon(cfg)
+        daemon.start()
+        yield daemon, kubelet
+        daemon.stop()
+
+
+def scrape(daemon):
+    url = f"http://127.0.0.1:{daemon.server.port}/metrics"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+def duty_lines(body):
+    return {
+        line.split('chip="')[1].split('"')[0]: line
+        for line in body.splitlines()
+        if line.startswith("accelerator_duty_cycle{")
+    }
+
+
+def test_pod_labels_on_allocated_chips(stack):
+    daemon, _ = stack
+    assert daemon.registry.wait_for_publish(0, timeout=5)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        body = scrape(daemon)
+        lines = duty_lines(body)
+        if len(lines) == 4 and 'pod="train-job"' in lines.get("0", ""):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"attribution never appeared:\n{body}")
+    assert 'namespace="ml"' in lines["0"]
+    assert 'container="worker"' in lines["1"]
+    assert 'pod=""' in lines["2"]
+    assert 'pod=""' in lines["3"]
+
+
+def test_reallocation_updates_labels(stack):
+    daemon, kubelet = stack
+    assert daemon.registry.wait_for_publish(0, timeout=5)
+    kubelet.pods = [tpu_pod("second-job", "batch", "main", ["2"])]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        lines = duty_lines(scrape(daemon))
+        if 'pod="second-job"' in lines.get("2", "") and 'pod=""' in lines.get("0", ""):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("reallocation never propagated")
