@@ -1,0 +1,120 @@
+"""Latency benchmark harness — measures the north-star number
+(BASELINE.md: p50 poll-tick latency, budget 50 ms at 1 Hz).
+
+Two modes, one measurement path (the production PollLoop + TpuCollector):
+
+- **simulated** (any machine): fake libtpu gRPC server with a scripted RPC
+  delay + sysfs fixture tree — the SURVEY.md §4 latency-regression setup
+  with 8 local chips. This measures everything real except the runtime
+  itself: wire decode, per-chip fan-out, rate math, snapshot build.
+- **real** (TPU node): the actual composite backend against the live
+  libtpu metric service and /sys/class/accel; used automatically by
+  bench.py when discovery finds chips.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from pathlib import Path
+
+from .collectors import Collector
+from .collectors.composite import TpuCollector
+from .collectors.libtpu import LibtpuClient
+from .poll import PollLoop
+from .registry import Registry
+
+
+def _percentile(sorted_values: list[float], q: float) -> float:
+    if not sorted_values:
+        return float("nan")
+    idx = min(len(sorted_values) - 1, max(0, round(q * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+def measure_collector(collector: Collector, *, ticks: int, warmup: int,
+                      extra: dict | None = None) -> dict:
+    """Run `warmup + ticks` polls of `collector` through the production loop
+    and report the tick-duration distribution in milliseconds."""
+    registry = Registry()
+    loop = PollLoop(collector, registry, deadline=10.0)
+    durations: list[float] = []
+    try:
+        for _ in range(warmup):
+            loop.tick()
+        for _ in range(ticks):
+            durations.append(loop.tick() * 1000.0)
+    finally:
+        loop.stop()
+    ordered = sorted(durations)
+    result = {
+        "chips": len(loop.devices),
+        "ticks": ticks,
+        "durations_ms": durations,
+        "mean_ms": statistics.mean(durations),
+        "p50_ms": _percentile(ordered, 0.50),
+        "p90_ms": _percentile(ordered, 0.90),
+        "p99_ms": _percentile(ordered, 0.99),
+    }
+    result.update(extra or {})
+    return result
+
+
+def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
+                        ticks: int = 50, rpc_delay: float = 0.010,
+                        warmup: int = 5) -> dict:
+    """Simulated-node harness: fake libtpu server (scripted per-RPC delay)
+    + sysfs fixture tree, measured through the production stack."""
+    from .testing import FakeLibtpuServer, make_sysfs
+
+    workdir = Path(workdir)
+    sysroot = workdir / "sys"
+    if not sysroot.exists():
+        make_sysfs(sysroot, num_chips=num_chips)
+    server = FakeLibtpuServer(num_chips=num_chips)
+    server.delay = rpc_delay
+    server.start()
+    try:
+        collector = TpuCollector(
+            sysfs_root=str(sysroot),
+            libtpu_client=LibtpuClient(ports=(server.port,), rpc_timeout=5.0),
+            use_native=True,
+        )
+        return measure_collector(
+            collector, ticks=ticks, warmup=warmup,
+            extra={"mode": "simulated", "rpc_delay_ms": rpc_delay * 1000.0},
+        )
+    finally:
+        server.stop()
+
+
+def try_real_harness(*, ticks: int = 50, warmup: int = 5) -> dict | None:
+    """Measure against a real TPU node when one is present; else None."""
+    import os
+
+    from .config import parse_libtpu_ports
+
+    ports = parse_libtpu_ports(os.environ.get("TPU_RUNTIME_METRICS_PORTS", "8431"))
+    collector = TpuCollector(libtpu_ports=ports)
+    try:
+        devices = collector.discover()
+        if not devices:
+            return None
+        collector.begin_tick()
+        deadline = time.monotonic() + 2.0
+        probe_ok = False
+        while time.monotonic() < deadline and not probe_ok:
+            try:
+                collector.sample(devices[0])
+                probe_ok = True
+            except Exception:
+                time.sleep(0.2)
+                collector.begin_tick()
+        if not probe_ok:
+            return None
+        return measure_collector(collector, ticks=ticks, warmup=warmup,
+                                 extra={"mode": "real"})
+    except Exception:
+        return None
+    finally:
+        collector.close()

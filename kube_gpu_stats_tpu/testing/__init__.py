@@ -1,0 +1,11 @@
+"""Shippable test-support backends (SURVEY.md §4 "fake backends — the key to
+testing without hardware"): an in-process fake libtpu metric server, a fake
+kubelet PodResources server, and a sysfs fixture-tree builder. Used by the
+test suite, the latency harness (bench.py) and anyone integrating against
+the exporter without a TPU node."""
+
+from .kubelet_server import FakeKubeletServer, tpu_pod
+from .libtpu_server import FakeLibtpuServer
+from .sysfs_fixture import make_sysfs
+
+__all__ = ["FakeKubeletServer", "FakeLibtpuServer", "make_sysfs", "tpu_pod"]

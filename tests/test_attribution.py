@@ -16,7 +16,7 @@ from kube_gpu_stats_tpu.attribution.podresources import PodResourcesSource
 from kube_gpu_stats_tpu.collectors import Device
 from kube_gpu_stats_tpu.proto import podresources as pb
 
-from fakes.kubelet_server import FakeKubeletServer, tpu_pod
+from kube_gpu_stats_tpu.testing.kubelet_server import FakeKubeletServer, tpu_pod
 
 
 def dev(index, uuid=""):

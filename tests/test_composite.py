@@ -10,8 +10,8 @@ from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
 from kube_gpu_stats_tpu.poll import PollLoop
 from kube_gpu_stats_tpu.registry import Registry
 
-from fakes.libtpu_server import FakeLibtpuServer
-from fixtures import make_sysfs
+from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
 
 
 @pytest.fixture

@@ -10,9 +10,9 @@ import pytest
 from kube_gpu_stats_tpu.config import Config
 from kube_gpu_stats_tpu.daemon import Daemon
 
-from fakes.kubelet_server import FakeKubeletServer, tpu_pod
-from fakes.libtpu_server import FakeLibtpuServer
-from fixtures import make_sysfs
+from kube_gpu_stats_tpu.testing.kubelet_server import FakeKubeletServer, tpu_pod
+from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
 
 
 @pytest.fixture

@@ -1,0 +1,36 @@
+"""Latency regression harness (SURVEY.md §4: p50 poll-tick latency under
+the 50 ms budget with 8 local chips and scripted RPC delays; BASELINE.md
+north star). bench.py runs the same harness and reports the number."""
+
+import statistics
+
+from kube_gpu_stats_tpu.bench import run_latency_harness
+
+
+def test_p50_under_budget_with_scripted_delay(tmp_path):
+    result = run_latency_harness(
+        tmp_path, num_chips=8, ticks=30, rpc_delay=0.010, warmup=3
+    )
+    assert result["p50_ms"] < 50.0, result
+    # Sanity: the scripted 10 ms RPC delay is actually inside the measurement.
+    assert result["p50_ms"] > 8.0, result
+
+
+def test_latency_scales_sublinearly_with_chips(tmp_path):
+    """Per-chip fan-out + batched libtpu fetch: 8 chips must not cost ~8x
+    1 chip (the serialized-loop failure mode, SURVEY.md §7 hard part b)."""
+    one = run_latency_harness(tmp_path / "a", num_chips=1, ticks=15,
+                              rpc_delay=0.010, warmup=3)
+    eight = run_latency_harness(tmp_path / "b", num_chips=8, ticks=15,
+                                rpc_delay=0.010, warmup=3)
+    assert eight["p50_ms"] < one["p50_ms"] * 4, (one, eight)
+
+
+def test_harness_reports_full_distribution(tmp_path):
+    result = run_latency_harness(tmp_path, num_chips=2, ticks=10,
+                                 rpc_delay=0.0, warmup=2)
+    for key in ("p50_ms", "p90_ms", "p99_ms", "mean_ms", "ticks", "chips"):
+        assert key in result
+    assert result["ticks"] == 10
+    assert result["p50_ms"] <= result["p99_ms"]
+    assert result["mean_ms"] == statistics.mean(result["durations_ms"])

@@ -8,7 +8,7 @@ from kube_gpu_stats_tpu.collectors import CollectorError
 from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient, LibtpuCollector
 from kube_gpu_stats_tpu.proto import tpumetrics
 
-from fakes.libtpu_server import HBM_TOTAL, LINKS, FakeLibtpuServer
+from kube_gpu_stats_tpu.testing.libtpu_server import HBM_TOTAL, LINKS, FakeLibtpuServer
 
 
 @pytest.fixture

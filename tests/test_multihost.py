@@ -1,0 +1,115 @@
+"""Multi-host slice coverage without a cluster (SURVEY.md §4: N exporter
+instances, distinct worker/topology labels; the union of scrapes covers
+every chip exactly once — BASELINE.json configs[3]).
+
+Per-node DaemonSet pods are independent — that independence is what makes
+the design testable: worker identity comes only from labels, so N local
+exporters model N hosts faithfully.
+"""
+
+import re
+import urllib.request
+
+from kube_gpu_stats_tpu.collectors.composite import TpuCollector
+from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.exposition import MetricsServer
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+_SERIES_RE = re.compile(r'^accelerator_up\{(.*)\} 1$', re.M)
+
+
+def parse_up_series(text):
+    out = []
+    for match in _SERIES_RE.finditer(text):
+        labels = dict(
+            part.split("=", 1) for part in re.findall(r'(\w+="[^"]*")', match.group(1))
+            for part in [part.replace('"', "")]
+        )
+        out.append(labels)
+    return out
+
+
+def worker_chip_pairs(text):
+    pairs = []
+    for line in text.splitlines():
+        if line.startswith("accelerator_up{") and line.endswith(" 1"):
+            worker = re.search(r'worker="([^"]*)"', line).group(1)
+            chip = re.search(r'chip="([^"]*)"', line).group(1)
+            slice_ = re.search(r'slice="([^"]*)"', line).group(1)
+            pairs.append((slice_, worker, chip))
+    return pairs
+
+
+def test_v5p_256_slice_union_mock():
+    """64 workers x 4 chips = 256: every (worker, chip) exactly once across
+    the union of all per-node exports."""
+    chips_per_host, hosts = 4, 64
+    union = []
+    for worker in range(hosts):
+        reg = Registry()
+        loop = PollLoop(
+            MockCollector(num_devices=chips_per_host, accel_type="tpu-v5p"),
+            reg,
+            deadline=5.0,
+            topology_labels={
+                "slice": "v5p-256-slice",
+                "worker": str(worker),
+                "topology": "8x8x4",
+            },
+        )
+        loop.tick()
+        union.extend(worker_chip_pairs(reg.snapshot().render()))
+        loop.stop()
+    assert len(union) == 256
+    assert len(set(union)) == 256  # exactly once
+    assert {p[0] for p in union} == {"v5p-256-slice"}
+
+
+def test_multihost_real_stack_http(tmp_path):
+    """4 workers with real gRPC fake-libtpu backends + real HTTP scrapes."""
+    hosts = 4
+    servers, daemonish = [], []
+    union = []
+    try:
+        for worker in range(hosts):
+            libtpu = FakeLibtpuServer(num_chips=4).start()
+            servers.append(libtpu)
+            sysroot = tmp_path / f"worker{worker}"
+            make_sysfs(sysroot, num_chips=4)
+            reg = Registry()
+            col = TpuCollector(
+                sysfs_root=str(sysroot),
+                libtpu_client=LibtpuClient(ports=(libtpu.port,), rpc_timeout=1.0),
+                use_native=False,
+            )
+            loop = PollLoop(
+                col, reg, deadline=5.0,
+                topology_labels={"slice": "v5p-16", "worker": str(worker),
+                                 "topology": "2x2x4"},
+            )
+            server = MetricsServer(reg, host="127.0.0.1", port=0)
+            server.start()
+            daemonish.append((loop, server))
+            loop.tick()
+            loop.tick()
+        for loop, server in daemonish:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ) as resp:
+                body = resp.read().decode()
+            union.extend(worker_chip_pairs(body))
+            # Each node exports ICI bandwidth for its local chips.
+            assert body.count("accelerator_ici_link_bandwidth_bytes_per_second{") == 24
+        assert len(union) == 16
+        assert len(set(union)) == 16
+    finally:
+        for loop, server in daemonish:
+            loop.stop()
+            server.stop()
+        for s in servers:
+            s.stop()

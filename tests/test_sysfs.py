@@ -7,7 +7,7 @@ from kube_gpu_stats_tpu import schema
 from kube_gpu_stats_tpu.collectors import CollectorError
 from kube_gpu_stats_tpu.collectors.sysfs import SysfsCollector
 
-from fixtures import make_sysfs
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
 
 
 def test_discovery(tmp_path):

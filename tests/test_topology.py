@@ -1,0 +1,37 @@
+"""Topology label sourcing (component C9)."""
+
+from kube_gpu_stats_tpu.topology import accel_type, topology_labels
+
+
+def test_explicit_kts_env_wins():
+    env = {
+        "KTS_SLICE": "my-slice",
+        "KTS_WORKER": "7",
+        "KTS_TOPOLOGY": "4x4x8",
+        "TPU_NAME": "ignored",
+        "TPU_WORKER_ID": "0",
+    }
+    assert topology_labels(env) == {
+        "slice": "my-slice", "worker": "7", "topology": "4x4x8"
+    }
+
+
+def test_gke_tpu_env_fallback():
+    env = {
+        "TPU_NAME": "v5p-slice-a",
+        "TPU_WORKER_ID": "12",
+        "TPU_TOPOLOGY": "8x8x4",
+    }
+    labels = topology_labels(env)
+    assert labels == {"slice": "v5p-slice-a", "worker": "12", "topology": "8x8x4"}
+
+
+def test_empty_env_keeps_keys():
+    assert topology_labels({}) == {"slice": "", "worker": "", "topology": ""}
+
+
+def test_accel_type_from_accelerator_type():
+    assert accel_type({"TPU_ACCELERATOR_TYPE": "v5p-128"}) == "tpu-v5p"
+    assert accel_type({"TPU_ACCELERATOR_TYPE": "v5litepod-16"}) == "tpu-v5litepod"
+    assert accel_type({"KTS_ACCEL_TYPE": "v4-8"}) == "tpu-v4"
+    assert accel_type({}) == "tpu"
