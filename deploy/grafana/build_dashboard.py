@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Generates dashboard.json (component C12 Grafana board).
+
+Design follows the dataviz method: color is assigned by job, not taste —
+per-chip series use a fixed 8-slot categorical palette (validated reference
+instance, dark-surface steps, slot = chip index, never cycled); status
+colors are reserved for up/down; magnitude panels use a single sequential
+hue; one axis per panel. Regenerate with:  python build_dashboard.py
+"""
+
+import json
+from pathlib import Path
+
+# Validated categorical palette (dark-surface steps), slot order is fixed:
+# chip N always wears slot N — a filter that hides chips must not repaint
+# the survivors.
+CHIP_COLORS = [
+    "#3987e5",  # 1 blue
+    "#d95926",  # 2 orange
+    "#199e70",  # 3 aqua
+    "#c98500",  # 4 yellow
+    "#d55181",  # 5 magenta
+    "#008300",  # 6 green
+    "#9085e9",  # 7 violet
+    "#c3c2b7",  # 8 gray
+]
+STATUS_GOOD = "#199e70"
+STATUS_CRITICAL = "#d55181"
+SEQUENTIAL_HUE = "#3987e5"
+
+DS = {"type": "prometheus", "uid": "${datasource}"}
+FILTERS = 'slice=~"$slice",worker=~"$worker",accel_type=~"$accel_type"'
+
+
+def chip_overrides():
+    return [
+        {
+            "matcher": {"id": "byRegexp", "options": f'.*chip="{i}".*'},
+            "properties": [
+                {"id": "color", "value": {"mode": "fixed", "fixedColor": color}}
+            ],
+        }
+        for i, color in enumerate(CHIP_COLORS)
+    ]
+
+
+def timeseries(title, targets, unit, grid, *, per_chip=True, max_val=None,
+               thresholds=None, description=""):
+    field_defaults = {
+        "custom": {
+            "lineWidth": 2,
+            "fillOpacity": 0,
+            "pointSize": 4,
+            "showPoints": "never",
+            "spanNulls": True,
+        },
+        "unit": unit,
+        "min": 0,
+        "color": {"mode": "fixed", "fixedColor": SEQUENTIAL_HUE},
+    }
+    if max_val is not None:
+        field_defaults["max"] = max_val
+    if thresholds:
+        field_defaults["custom"]["thresholdsStyle"] = {"mode": "line"}
+        field_defaults["thresholds"] = {
+            "mode": "absolute",
+            "steps": [{"color": "transparent", "value": None}]
+            + [{"color": STATUS_CRITICAL, "value": v} for v in thresholds],
+        }
+    return {
+        "type": "timeseries",
+        "title": title,
+        "description": description,
+        "datasource": DS,
+        "gridPos": grid,
+        "fieldConfig": {
+            "defaults": field_defaults,
+            "overrides": chip_overrides() if per_chip else [],
+        },
+        "options": {
+            "tooltip": {"mode": "multi", "sort": "desc"},
+            "legend": {"displayMode": "list", "placement": "bottom",
+                       "showLegend": True},
+        },
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i),
+             "datasource": DS}
+            for i, (expr, legend) in enumerate(targets)
+        ],
+    }
+
+
+def stat(title, expr, unit, grid, *, color=SEQUENTIAL_HUE, description=""):
+    return {
+        "type": "stat",
+        "title": title,
+        "description": description,
+        "datasource": DS,
+        "gridPos": grid,
+        "fieldConfig": {
+            "defaults": {
+                "unit": unit,
+                "color": {"mode": "fixed", "fixedColor": color},
+                "thresholds": {"mode": "absolute",
+                               "steps": [{"color": color, "value": None}]},
+            },
+            "overrides": [],
+        },
+        "options": {"reduceOptions": {"calcs": ["lastNotNull"]},
+                    "graphMode": "none", "colorMode": "value"},
+        "targets": [{"expr": expr, "refId": "A", "datasource": DS}],
+    }
+
+
+def template_var(name, label, query):
+    return {
+        "name": name,
+        "label": label,
+        "type": "query",
+        "datasource": DS,
+        "query": {"query": query, "refId": name},
+        "refresh": 2,
+        "includeAll": True,
+        "multi": True,
+        "current": {"text": "All", "value": "$__all"},
+    }
+
+
+panels = [
+    # Row 1 — headline stats (stat tiles, not charts: single numbers).
+    stat("Chips up",
+         f'sum(accelerator_up{{{FILTERS}}})',
+         "none", {"x": 0, "y": 0, "w": 4, "h": 4}, color=STATUS_GOOD,
+         description="Devices whose last poll succeeded, across the slice."),
+    stat("Chips stale",
+         f'count(accelerator_up{{{FILTERS}}} == 0) OR vector(0)',
+         "none", {"x": 4, "y": 0, "w": 4, "h": 4}, color=STATUS_CRITICAL,
+         description="Stale/erroring devices (accelerator_up == 0)."),
+    stat("Mean MXU duty cycle",
+         f'avg(accelerator_duty_cycle{{{FILTERS}}})',
+         "percent", {"x": 8, "y": 0, "w": 4, "h": 4},
+         description="Slice-wide mean over the last sample window."),
+    stat("HBM used",
+         f'sum(accelerator_memory_used_bytes{{{FILTERS}}})',
+         "bytes", {"x": 12, "y": 0, "w": 4, "h": 4}),
+    stat("Total power",
+         f'sum(accelerator_power_watts{{{FILTERS}}})',
+         "watt", {"x": 16, "y": 0, "w": 4, "h": 4}),
+    stat("Collection p50",
+         'histogram_quantile(0.5, sum(rate(collector_poll_duration_seconds_bucket[5m])) by (le))',
+         "s", {"x": 20, "y": 0, "w": 4, "h": 4},
+         description="North-star budget: < 50 ms p50 (BASELINE.md)."),
+
+    # Row 2 — core utilization, identity = chip (fixed categorical slots).
+    timeseries(
+        "MXU duty cycle by chip",
+        [(f'accelerator_duty_cycle{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}}')],
+        "percent", {"x": 0, "y": 4, "w": 12, "h": 8}, max_val=100,
+        description="Percent of time the MXU was executing (per chip)."),
+    timeseries(
+        "HBM used by chip",
+        [(f'accelerator_memory_used_bytes{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}}')],
+        "bytes", {"x": 12, "y": 4, "w": 12, "h": 8},
+        description="High-bandwidth memory allocated per chip; capacity is "
+                    "accelerator_memory_total_bytes."),
+
+    # Row 3 — environment.
+    timeseries(
+        "Chip power",
+        [(f'accelerator_power_watts{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}}')],
+        "watt", {"x": 0, "y": 12, "w": 12, "h": 8}),
+    timeseries(
+        "Chip temperature",
+        [(f'accelerator_temperature_celsius{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}}')],
+        "celsius", {"x": 12, "y": 12, "w": 12, "h": 8}),
+
+    # Row 4 — interconnect (C10).
+    timeseries(
+        "ICI link bandwidth (sum over links, by chip)",
+        [(f'sum by (worker, chip) (accelerator_ici_link_bandwidth_bytes_per_second{{{FILTERS}}})',
+          'w{{worker}} chip {{chip}}')],
+        "Bps", {"x": 0, "y": 20, "w": 12, "h": 8},
+        description="Per-chip total ICI traffic rate; per-link series carry "
+                    "a 'link' label for drill-down."),
+    timeseries(
+        "Collective ops rate",
+        [(f'rate(accelerator_collective_ops_total{{{FILTERS}}}[2m])',
+          'w{{worker}} chip {{chip}}')],
+        "ops", {"x": 12, "y": 20, "w": 12, "h": 8}),
+
+    # Row 5 — exporter self-observability (single series per panel: no
+    # per-chip identity; sequential hue).
+    timeseries(
+        "Collection latency quantiles",
+        [('histogram_quantile(0.5, sum(rate(collector_poll_duration_seconds_bucket[5m])) by (le))', 'p50'),
+         ('histogram_quantile(0.99, sum(rate(collector_poll_duration_seconds_bucket[5m])) by (le))', 'p99')],
+        "s", {"x": 0, "y": 28, "w": 12, "h": 8}, per_chip=False,
+        thresholds=[0.050],
+        description="Poll-tick wall time; threshold line = 50 ms budget."),
+    timeseries(
+        "Poll errors by reason",
+        [('sum by (reason) (rate(collector_poll_errors_total[5m]))',
+          '{{reason}}')],
+        "ops", {"x": 12, "y": 28, "w": 12, "h": 8}, per_chip=False),
+]
+
+dashboard = {
+    "uid": "kube-tpu-stats",
+    "title": "Accelerator telemetry (TPU/GPU unified)",
+    "description": "kube-tpu-stats: per-chip accelerator_* metrics with "
+                   "pod attribution and slice topology. Works for any "
+                   "exporter emitting the unified accelerator_* schema "
+                   "(docs/UNIFIED_SCHEMA.md).",
+    "tags": ["tpu", "accelerator", "kube-tpu-stats"],
+    "schemaVersion": 39,
+    "editable": True,
+    "graphTooltip": 1,
+    "time": {"from": "now-1h", "to": "now"},
+    "refresh": "30s",
+    "templating": {
+        "list": [
+            {"name": "datasource", "label": "Data source", "type": "datasource",
+             "query": "prometheus", "current": {}},
+            template_var("slice", "Slice",
+                         "label_values(accelerator_up, slice)"),
+            template_var("worker", "Worker",
+                         'label_values(accelerator_up{slice=~"$slice"}, worker)'),
+            template_var("accel_type", "Accelerator",
+                         "label_values(accelerator_up, accel_type)"),
+        ]
+    },
+    "panels": panels,
+}
+
+out = Path(__file__).parent / "dashboard.json"
+out.write_text(json.dumps(dashboard, indent=1) + "\n")
+print(f"wrote {out} ({len(panels)} panels)")

@@ -1,0 +1,122 @@
+"""Deployment-asset schema validation (SURVEY.md §4 "e2e manifests:
+dry-run/schema validation only; no TPU nodes in CI") + the zero-NVML
+constraint from BASELINE.md, checked at the artifact level."""
+
+import json
+import pathlib
+import re
+
+import yaml
+
+from kube_gpu_stats_tpu import schema
+
+DEPLOY = pathlib.Path(__file__).parent.parent / "deploy"
+
+
+def load_yaml_docs(name):
+    return [d for d in yaml.safe_load_all((DEPLOY / name).read_text()) if d]
+
+
+def test_daemonset_shape():
+    (ds,) = load_yaml_docs("daemonset.yaml")
+    assert ds["kind"] == "DaemonSet"
+    spec = ds["spec"]["template"]["spec"]
+    # TPU node pools: selector + taint toleration.
+    assert "cloud.google.com/gke-tpu-accelerator" in spec["nodeSelector"]
+    assert any(t["key"] == "google.com/tpu" for t in spec["tolerations"])
+    # Host surfaces the exporter needs (L0 sysfs + C3 attribution).
+    mounts = {m["mountPath"]: m for m in spec["containers"][0]["volumeMounts"]}
+    assert mounts["/sys"]["readOnly"] is True
+    assert "/var/lib/kubelet/pod-resources" in mounts
+    assert "/var/lib/kubelet/device-plugins" in mounts
+    volumes = {v["name"]: v for v in spec["volumes"]}
+    assert volumes["sys"]["hostPath"]["path"] == "/sys"
+    # libtpu metric service is on the node loopback.
+    assert spec["hostNetwork"] is True
+    container = spec["containers"][0]
+    assert container["ports"][0]["containerPort"] == 9400
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["securityContext"]["readOnlyRootFilesystem"] is True
+
+
+def test_rbac_and_service():
+    docs = load_yaml_docs("rbac.yaml")
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["Namespace", "ServiceAccount", "Service"]
+    service = docs[2]
+    assert service["spec"]["clusterIP"] == "None"
+    assert service["spec"]["ports"][0]["port"] == 9400
+
+
+def test_zero_nvml_cuda_userspace():
+    """BASELINE.md binary constraint, applied to the shipped artifacts: no
+    NVML/CUDA anywhere in image or manifests. ('nvidia.com/gpu' is the k8s
+    resource name used for unified attribution, not userspace.)"""
+    for name in ("Dockerfile", "daemonset.yaml", "rbac.yaml"):
+        functional = "\n".join(
+            line for line in (DEPLOY / name).read_text().splitlines()
+            if not line.lstrip().startswith("#")  # prose may *say* "no CUDA"
+        ).lower()
+        for needle in ("nvml", "cuda", "nvidia-smi", "libnvidia"):
+            assert needle not in functional, (name, needle)
+
+
+def test_dockerfile_entrypoint_and_user():
+    text = (DEPLOY / "Dockerfile").read_text()
+    assert '"python", "-m", "kube_gpu_stats_tpu"' in text
+    assert "USER 65532" in text  # non-root
+    assert "EXPOSE 9400" in text
+
+
+METRIC_TOKEN = re.compile(r"\b(accelerator_[a-z_]+|collector_[a-z_]+)\b")
+
+
+def known_exposition_names():
+    names = set()
+    for spec in schema.ALL_METRICS:
+        names.add(spec.name)
+        if spec.type is schema.MetricType.HISTOGRAM:
+            names.update(
+                {f"{spec.name}_bucket", f"{spec.name}_sum", f"{spec.name}_count"}
+            )
+    return names
+
+
+def test_dashboard_references_only_real_metrics():
+    board = json.loads((DEPLOY / "grafana" / "dashboard.json").read_text())
+    known = known_exposition_names()
+    exprs = [
+        t["expr"]
+        for panel in board["panels"]
+        for t in panel.get("targets", [])
+    ]
+    assert exprs, "dashboard has no queries"
+    for expr in exprs:
+        for token in METRIC_TOKEN.findall(expr):
+            assert token in known, f"dashboard references unknown metric {token}"
+
+
+def test_dashboard_chip_colors_fixed_order_not_cycled():
+    board = json.loads((DEPLOY / "grafana" / "dashboard.json").read_text())
+    per_chip_panels = [
+        p for p in board["panels"]
+        if p.get("fieldConfig", {}).get("overrides")
+    ]
+    assert per_chip_panels
+    first = [
+        o["properties"][0]["value"]["fixedColor"]
+        for o in per_chip_panels[0]["fieldConfig"]["overrides"]
+    ]
+    assert len(first) == len(set(first)) == 8
+    for panel in per_chip_panels[1:]:
+        colors = [
+            o["properties"][0]["value"]["fixedColor"]
+            for o in panel["fieldConfig"]["overrides"]
+        ]
+        assert colors == first  # same chip -> same color on every panel
+
+
+def test_dashboard_template_vars():
+    board = json.loads((DEPLOY / "grafana" / "dashboard.json").read_text())
+    names = {v["name"] for v in board["templating"]["list"]}
+    assert {"datasource", "slice", "worker", "accel_type"} <= names
