@@ -1,0 +1,15 @@
+"""TPU load generator — validation workload for the telemetry exporter.
+
+SURVEY.md §7 non-goals: the exporter itself has no JAX dependency;
+"pjit/pallas may appear only in load-generation scripts used to make
+duty-cycle numbers move during manual validation on real TPUs". This
+package is exactly that: a workload that drives the MXU (bf16 matmuls),
+allocates HBM, and runs cross-chip collectives so every accelerator_*
+family the exporter reports visibly responds.
+
+    python -m kube_gpu_stats_tpu.loadgen --seconds 30
+
+JAX is imported lazily so the exporter never pulls it in.
+"""
+
+from .burn import entry_fn, make_sharded_train_step, run_burn  # noqa: F401

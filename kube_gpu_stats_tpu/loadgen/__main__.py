@@ -1,0 +1,3 @@
+from .burn import main
+
+raise SystemExit(main())

@@ -1,0 +1,153 @@
+"""MXU/HBM/ICI load generation.
+
+Single-chip: a jitted bf16 matmul chain sized for the MXU (128-multiple
+static shapes, no data-dependent control flow — one XLA compilation).
+
+Multi-chip: a small MLP "training" step sharded over a Mesh with data- and
+tensor-parallel axes via NamedSharding; XLA inserts the all-reduces, so ICI
+link counters move on real slices. The same function is the driver's
+multi-chip dry-run surface (__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+
+def _mesh_shape(n_devices: int) -> tuple[int, int]:
+    """(data, model) factorization: model axis gets the largest power-of-2
+    divisor up to 4 (matches one-host chip counts), data gets the rest."""
+    model = 1
+    while model < 4 and n_devices % (model * 2) == 0:
+        model *= 2
+    return n_devices // model, model
+
+
+def entry_fn(size: int = 1024):
+    """Returns (fn, example_args): a jit-compilable single-chip burn step.
+
+    fn(x, w) does a chained bf16 matmul with a nonlinearity — MXU-bound,
+    static shapes, fusible elementwise tail.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def burn(x, w):
+        for _ in range(4):
+            x = jnp.tanh(x @ w)
+        return x
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (size, size), dtype=jnp.bfloat16)
+    return burn, (x, w)
+
+
+def make_sharded_train_step(n_devices: int, *, d_model: int = 256,
+                            d_hidden: int = 512, batch: int = 64):
+    """Build (jitted_step, params, batch) sharded over an n_devices mesh.
+
+    Layout: batch is data-parallel over the "data" axis; the MLP's hidden
+    dimension is tensor-parallel over the "model" axis (w1 column-sharded,
+    w2 row-sharded — the standard Megatron split re-expressed as
+    NamedSharding, letting XLA insert the psum for the row-sharded matmul
+    and the gradient all-reduce over "data").
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+    dp, tp = _mesh_shape(n_devices)
+    mesh = Mesh(np.asarray(devices[:n_devices]).reshape(dp, tp), ("data", "model"))
+
+    w1_sharding = NamedSharding(mesh, P(None, "model"))  # columns
+    w2_sharding = NamedSharding(mesh, P("model", None))  # rows
+    batch_sharding = NamedSharding(mesh, P("data", None))
+
+    k1, k2, k3 = (jax.random.PRNGKey(i) for i in range(3))
+    params = {
+        "w1": jax.device_put(
+            jax.random.normal(k1, (d_model, d_hidden), jnp.float32)
+            / math.sqrt(d_model),
+            w1_sharding,
+        ),
+        "w2": jax.device_put(
+            jax.random.normal(k2, (d_hidden, d_model), jnp.float32)
+            / math.sqrt(d_hidden),
+            w2_sharding,
+        ),
+    }
+    x = jax.device_put(
+        jax.random.normal(k3, (batch, d_model), jnp.float32), batch_sharding
+    )
+
+    def loss_fn(params, x):
+        h = jnp.tanh(x @ params["w1"])
+        y = h @ params["w2"]  # row-sharded matmul -> psum over "model"
+        return jnp.mean((y - x) ** 2)  # autoencoding target: self-contained
+
+    @jax.jit
+    def train_step(params, x):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x)
+        new_params = jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+        return new_params, loss
+
+    return mesh, train_step, params, x
+
+
+def run_burn(seconds: float = 10.0, size: int = 2048,
+             report_every: float = 1.0) -> int:
+    """Drive the local chip(s) for `seconds`; returns steps executed."""
+    import jax
+
+    import jax.numpy as jnp
+
+    fn, (x, w) = entry_fn(size)
+    step = jax.jit(fn)
+    float(jnp.sum(step(x, w)))  # compile + force one real execution
+    steps = 0
+    start = time.monotonic()
+    last_report = start
+    inflight = 0
+    while time.monotonic() - start < seconds:
+        x = step(x, w)
+        steps += 1
+        inflight += 1
+        # Bound the async dispatch queue and force materialization before
+        # trusting any rate: some backends defer execution until a value is
+        # actually fetched, so an unbounded dispatch loop measures enqueue
+        # rate, not FLOPs.
+        if inflight >= 32:
+            float(jnp.sum(x))
+            inflight = 0
+        now = time.monotonic()
+        if now - last_report >= report_every:
+            float(jnp.sum(x))
+            inflight = 0
+            now = time.monotonic()
+            rate = steps / (now - start)
+            flops = 2 * 4 * size**3 * rate
+            print(f"loadgen: {steps} steps, {rate:.1f} steps/s, "
+                  f"~{flops / 1e12:.2f} TFLOP/s", flush=True)
+            last_report = now
+    float(jnp.sum(x))
+    return steps
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="TPU duty-cycle load generator for exporter validation"
+    )
+    parser.add_argument("--seconds", type=float, default=10.0)
+    parser.add_argument("--size", type=int, default=2048,
+                        help="matmul dimension (multiple of 128 for the MXU)")
+    args = parser.parse_args(argv)
+    run_burn(args.seconds, args.size)
+    return 0

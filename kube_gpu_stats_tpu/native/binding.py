@@ -1,0 +1,115 @@
+"""ctypes binding for the C++ batched sysfs reader (libktsnative.so).
+
+`NativeSysfsCollector` wraps a SysfsCollector: per device it resolves the
+power/temp candidate globs ONCE (discovery time, off the hot path) into
+concrete paths, then every `read_environment` is a single C call that batch-
+reads and parses all attribute files. Layout knowledge stays in sysfs.py —
+this module only accelerates the file IO.
+
+Falls back loudly (ImportError from loader) when the library is missing or
+has a mismatched ABI; callers use native.maybe_accelerate_sysfs to degrade
+to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+from pathlib import Path
+
+from .. import schema
+from ..collectors import CollectorError, Device, Sample
+from ..collectors.sysfs import (
+    SysfsCollector,
+    _POWER_CANDIDATES,
+    _TEMP_CANDIDATES,
+)
+
+_LIB_PATH = Path(__file__).parent / "libktsnative.so"
+
+
+def load_library() -> ctypes.CDLL:
+    if not _LIB_PATH.exists():
+        raise ImportError(f"{_LIB_PATH} not built (make -C kube_gpu_stats_tpu/native)")
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.kts_abi_version.restype = ctypes.c_int
+    if lib.kts_abi_version() != 1:
+        raise ImportError("libktsnative ABI mismatch")
+    lib.kts_read_scaled.restype = ctypes.c_int
+    lib.kts_read_scaled.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_ubyte),
+    ]
+    return lib
+
+
+class _DevicePlan:
+    """Resolved (metric, path, scale) triples for one device."""
+
+    __slots__ = ("metrics", "paths", "scales")
+
+    def __init__(self, accel_dir: Path) -> None:
+        self.metrics: list[str] = []
+        paths: list[bytes] = []
+        self.scales: list[float] = []
+        for metric, candidates in (
+            (schema.POWER.name, _POWER_CANDIDATES),
+            (schema.TEMPERATURE.name, _TEMP_CANDIDATES),
+        ):
+            for pattern, scale in candidates:
+                hits = sorted(glob.glob(str(accel_dir / pattern)))
+                if hits:
+                    self.metrics.append(metric)
+                    paths.append(hits[0].encode())
+                    self.scales.append(scale)
+                    break
+        n = len(paths)
+        self.paths = (ctypes.c_char_p * n)(*paths)
+
+
+class NativeSysfsCollector(SysfsCollector):
+    name = "sysfs-native"
+
+    def __init__(self, inner: SysfsCollector) -> None:
+        # Share the inner collector's configuration; plans are built lazily
+        # per device and rebuilt on rediscovery.
+        super().__init__(inner._root, inner._accel_type)
+        self._lib = load_library()
+        self._plans: dict[int, _DevicePlan] = {}
+
+    def discover(self):
+        self._plans.clear()  # device set may have changed; re-resolve globs
+        return super().discover()
+
+    def read_environment(self, device: Device) -> dict[str, float]:
+        plan = self._plans.get(device.index)
+        if plan is None:
+            accel = self.accel_dir(device)
+            if not accel.exists():
+                raise CollectorError(f"{accel} vanished")
+            plan = _DevicePlan(accel)
+            self._plans[device.index] = plan
+        n = len(plan.metrics)
+        if n == 0:
+            if not self.accel_dir(device).exists():
+                raise CollectorError(f"{self.accel_dir(device)} vanished")
+            return {}
+        values = (ctypes.c_double * n)()
+        ok = (ctypes.c_ubyte * n)()
+        scales = (ctypes.c_double * n)(*plan.scales)
+        successes = self._lib.kts_read_scaled(plan.paths, scales, n, values, ok)
+        if successes == 0 and not self.accel_dir(device).exists():
+            # Paths went away wholesale: device vanished (hot-unplug /
+            # namespace teardown) — surface staleness, then let the caller
+            # rediscover.
+            self._plans.pop(device.index, None)
+            raise CollectorError(f"{self.accel_dir(device)} vanished")
+        return {
+            plan.metrics[i]: values[i] for i in range(n) if ok[i]
+        }
+
+    def sample(self, device: Device) -> Sample:
+        return Sample(device=device, values=self.read_environment(device))

@@ -1,0 +1,54 @@
+// Native hot-path sampler for kube-tpu-stats.
+//
+// The poll tick's sysfs cost is many tiny file reads; in CPython each one
+// pays open/read/close through the io stack plus float parsing. This shim
+// batches them behind one ctypes call: raw openat/read/close syscalls, a
+// stack buffer, and strtod. The Python side (binding.py) resolves glob
+// patterns once off the hot path and hands a stable path list here every
+// tick. Pure C ABI so ctypes needs no extension-module build.
+//
+// Build: make -C kube_gpu_stats_tpu/native   (-> libktsnative.so)
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ABI version so the Python binding can refuse a stale library.
+int kts_abi_version() { return 1; }
+
+// Read up to n_paths small text files, parse each as a double.
+// out_values[i] = parsed value * scales[i]; out_ok[i] = 1 on success, 0 on
+// any failure (missing file, unreadable, unparsable). Returns the number of
+// successful reads. Never throws/exits; safe for arbitrary paths.
+int kts_read_scaled(const char** paths, const double* scales, int n_paths,
+                    double* out_values, unsigned char* out_ok) {
+  int successes = 0;
+  char buf[256];
+  for (int i = 0; i < n_paths; ++i) {
+    out_ok[i] = 0;
+    out_values[i] = 0.0;
+    if (paths[i] == nullptr) continue;
+    int fd = open(paths[i], O_RDONLY | O_CLOEXEC);
+    if (fd < 0) continue;
+    ssize_t len = read(fd, buf, sizeof(buf) - 1);
+    close(fd);
+    if (len <= 0) continue;
+    buf[len] = '\0';
+    char* end = nullptr;
+    errno = 0;
+    double value = strtod(buf, &end);
+    if (end == buf || errno == ERANGE) continue;
+    out_values[i] = value * scales[i];
+    out_ok[i] = 1;
+    ++successes;
+  }
+  return successes;
+}
+
+}  // extern "C"

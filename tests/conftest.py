@@ -1,12 +1,24 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh before any jax
-import (only the loadgen/graft tests use JAX — the exporter itself has no
-JAX dependency, SURVEY.md §7 non-goals)."""
+"""Test env: force JAX onto a virtual 8-device CPU mesh (only the
+loadgen/graft tests use JAX — the exporter has no JAX dependency,
+SURVEY.md §7 non-goals).
+
+The sandbox's sitecustomize force-registers a single-chip TPU PJRT plugin
+("axon") and overrides JAX_PLATFORMS, so env vars alone don't stick; the
+jax.config update below wins because backends initialize lazily, after
+conftest import."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover - jax is baked into this image
+    pass
