@@ -106,6 +106,11 @@ class LibtpuCollector(Collector):
         self._cache_error: CollectorError | None = CollectorError(
             "no libtpu fetch has completed yet"
         )
+        # Tri-state: None = unknown, True/False = whether the runtime
+        # answers the empty-selector "all metrics" request. One RPC per tick
+        # beats a per-metric fan-out by ~5 round trips; older runtimes that
+        # reject the batched form fall back permanently.
+        self._batched: bool | None = None
 
     # -- discovery ----------------------------------------------------------
 
@@ -127,30 +132,51 @@ class LibtpuCollector(Collector):
     # -- hot path ------------------------------------------------------------
 
     def begin_tick(self) -> None:
-        futures = {
-            name: self._pool.submit(self._client.get_metric, name)
-            for name in tpumetrics.ALL_METRICS
-        }
         cache: dict[int, dict] = {}
         first_error: CollectorError | None = None
-        for name, future in futures.items():
+
+        def ingest(sample: tpumetrics.MetricSample) -> None:
+            entry = cache.setdefault(
+                sample.device_id,
+                {"values": {}, "ici": {}, "collectives": None},
+            )
+            if sample.name == tpumetrics.ICI_TRAFFIC:
+                entry["ici"][sample.link or "link0"] = int(sample.value)
+            elif sample.name == tpumetrics.COLLECTIVES:
+                entry["collectives"] = int(sample.value)
+            elif sample.name in _VALUE_MAP:
+                entry["values"][_VALUE_MAP[sample.name]] = float(sample.value)
+            # Unknown names: runtime newer than our pin — ignore.
+
+        if self._batched is not False:
             try:
-                for s in future.result():
-                    entry = cache.setdefault(
-                        s.device_id,
-                        {"values": {}, "ici": {}, "collectives": None},
-                    )
-                    if name == tpumetrics.ICI_TRAFFIC:
-                        entry["ici"][s.link or "link0"] = int(s.value)
-                    elif name == tpumetrics.COLLECTIVES:
-                        entry["collectives"] = int(s.value)
-                    else:
-                        entry["values"][_VALUE_MAP[name]] = float(s.value)
+                for s in self._client.get_metric(""):
+                    ingest(s)
+                if cache:
+                    self._batched = True
             except CollectorError as exc:
-                # Partial data is fine (e.g. a runtime build without ICI
-                # counters); a fully-failed fetch poisons the tick below.
-                first_error = first_error or exc
-                log.debug("libtpu fetch of %s failed: %s", name, exc)
+                if self._batched is True:
+                    # Batched mode was established and the runtime is now
+                    # failing: a real outage, not a capability gap.
+                    first_error = exc
+                else:
+                    self._batched = False
+                    log.info("libtpu empty-selector fetch unsupported (%s); "
+                             "using per-metric requests", exc)
+        if self._batched is False and first_error is None:
+            futures = {
+                name: self._pool.submit(self._client.get_metric, name)
+                for name in tpumetrics.ALL_METRICS
+            }
+            for name, future in futures.items():
+                try:
+                    for s in future.result():
+                        ingest(s)
+                except CollectorError as exc:
+                    # Partial data is fine (e.g. a runtime build without ICI
+                    # counters); a fully-failed fetch poisons the tick below.
+                    first_error = first_error or exc
+                    log.debug("libtpu fetch of %s failed: %s", name, exc)
         with self._lock:
             if cache:
                 self._cache = cache

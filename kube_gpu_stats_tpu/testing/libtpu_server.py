@@ -25,7 +25,10 @@ class FakeLibtpuServer:
         server.fail = True          # abort with UNAVAILABLE
         server.garble = True        # return undecodable bytes
         server.scripted[(name, chip)] = value        # override a value
-        server.drop_metrics.add(tpumetrics.ICI_TRAFFIC)  # UNIMPLEMENTED
+        server.drop_metrics.add(tpumetrics.ICI_TRAFFIC)  # runtime lacks it:
+                                    # omitted from batched ("" selector)
+                                    # responses, UNIMPLEMENTED when named
+        server.reject_batch = True  # runtime predates the "" selector
     """
 
     def __init__(self, num_chips: int = 4, port: int = 0,
@@ -35,6 +38,7 @@ class FakeLibtpuServer:
         self.delay = 0.0
         self.fail = False
         self.garble = False
+        self.reject_batch = False
         self.scripted: dict[tuple[str, int], float] = {}
         self.drop_metrics: set[str] = set()
         self.requests: list[str] = []
@@ -97,10 +101,17 @@ class FakeLibtpuServer:
         name = tpumetrics.decode_request(request_bytes)
         with self._lock:
             self.requests.append(name)
+        if not name and self.reject_batch:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "metric_name is required")
         if name in self.drop_metrics:
             context.abort(grpc.StatusCode.UNIMPLEMENTED, f"no metric {name}")
         samples = []
-        names = tpumetrics.ALL_METRICS if not name else (name,)
+        if name:
+            names = (name,)
+        else:
+            names = tuple(m for m in tpumetrics.ALL_METRICS
+                          if m not in self.drop_metrics)
         for metric in names:
             if metric == tpumetrics.ICI_TRAFFIC:
                 with self._lock:

@@ -126,3 +126,28 @@ def test_one_port_down_still_serves_other():
         col.begin_tick()
         assert col.sample(devs[1]).values
         col.close()
+
+
+def test_batched_fetch_is_single_rpc(server):
+    col = make_collector(server)
+    devs = col.discover()
+    server.requests.clear()
+    col.begin_tick()
+    assert server.requests == [""]  # one RPC covers all metric families
+    assert col.sample(devs[0]).values
+    col.close()
+
+
+def test_legacy_runtime_falls_back_to_per_metric(server):
+    server.reject_batch = True
+    col = make_collector(server)
+    devs = col.discover()
+    server.requests.clear()
+    col.begin_tick()
+    assert "" in server.requests  # probed once...
+    assert set(server.requests) - {""} == set(tpumetrics.ALL_METRICS)
+    server.requests.clear()
+    col.begin_tick()
+    assert "" not in server.requests  # ...then remembered the answer
+    assert col.sample(devs[0]).values
+    col.close()
