@@ -50,7 +50,15 @@ class LibtpuClient:
         for port in ports:
             channel = grpc.insecure_channel(
                 f"{addr}:{port}",
-                options=[("grpc.enable_http_proxy", 0)],
+                options=[
+                    ("grpc.enable_http_proxy", 0),
+                    # A restarted libtpu must be repolled within ~a tick, not
+                    # after gRPC's default 1s+ exponential reconnect backoff
+                    # (SURVEY.md §5 elastic recovery at 1 Hz).
+                    ("grpc.initial_reconnect_backoff_ms", 100),
+                    ("grpc.min_reconnect_backoff_ms", 100),
+                    ("grpc.max_reconnect_backoff_ms", 1000),
+                ],
             )
             self._channels.append(channel)
             self._methods.append(

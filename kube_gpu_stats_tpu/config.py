@@ -36,6 +36,7 @@ class Config:
     kubelet_socket: str = DEFAULT_KUBELET_SOCKET
     checkpoint_path: str = DEFAULT_CHECKPOINT
     attribution_interval: float = 10.0
+    rediscovery_interval: float = 60.0  # 0 disables hotplug re-enumeration
     mock_devices: int = 4
     use_native: bool = True  # C++ fast path when the shared lib is present
     log_level: str = "info"
@@ -97,6 +98,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("CHECKPOINT_PATH", DEFAULT_CHECKPOINT))
     p.add_argument("--attribution-interval", type=float,
                    default=float(_env("ATTRIBUTION_INTERVAL", "10.0")))
+    p.add_argument("--rediscovery-interval", type=float,
+                   default=float(_env("REDISCOVERY_INTERVAL", "60.0")),
+                   help="device re-enumeration cadence seconds; 0 disables")
     p.add_argument("--mock-devices", type=int,
                    default=int(_env("MOCK_DEVICES", "4")))
     p.add_argument("--no-native", action="store_true",
@@ -122,6 +126,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         kubelet_socket=args.kubelet_socket,
         checkpoint_path=args.checkpoint_path,
         attribution_interval=args.attribution_interval,
+        rediscovery_interval=args.rediscovery_interval,
         mock_devices=args.mock_devices,
         use_native=not args.no_native,
         log_level=args.log_level,

@@ -95,6 +95,7 @@ class Daemon:
             attribution=self.attribution,
             topology_labels=topology.topology_labels(),
             version=__version__,
+            rediscovery_interval=cfg.rediscovery_interval,
         )
         self.server = MetricsServer(self.registry, cfg.listen_host, cfg.listen_port)
         self.textfile = (

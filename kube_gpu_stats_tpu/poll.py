@@ -56,6 +56,7 @@ class PollLoop:
         topology_labels: Mapping[str, str] | None = None,
         max_workers: int | None = None,
         version: str = "dev",
+        rediscovery_interval: float = 60.0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -65,6 +66,7 @@ class PollLoop:
         self._attribution = attribution or NullAttribution()
         self._topology = dict(topology_labels or {})
         self._version = version
+        self._rediscovery_interval = rediscovery_interval
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -99,10 +101,18 @@ class PollLoop:
         return self._hist
 
     def rediscover(self) -> None:
-        """Re-enumerate devices (startup / explicit recovery; not hot path).
-        Purges per-device rate/capacity state for devices that disappeared so
-        a renumbered chip never inherits another chip's counter baseline."""
-        self._devices = self._collector.discover()
+        """Re-enumerate devices (startup, periodic, explicit recovery; never
+        on the tick hot path). Purges per-device rate/capacity state for
+        devices that disappeared so a renumbered chip never inherits another
+        chip's counter baseline. A failing discover keeps the old device
+        list — hotplug detection must not take down a healthy exporter."""
+        try:
+            self._devices = self._collector.discover()
+        except Exception as exc:
+            self._count_error("rediscover")
+            log.warning("rediscovery failed, keeping %d known devices: %s",
+                        len(self._devices), exc)
+            return
         alive = {dev.device_id for dev in self._devices}
         for device_id in list(self._last_totals):
             if device_id not in alive:
@@ -123,9 +133,15 @@ class PollLoop:
         return duration
 
     def run_forever(self) -> None:
-        """Drift-free fixed-rate loop until stop()."""
+        """Drift-free fixed-rate loop until stop(); re-enumerates devices on
+        its own (slower) cadence so hotplug/runtime-restart chip renumbering
+        heals without a pod restart (SURVEY.md §5 elastic recovery)."""
         next_fire = self._clock()
+        next_rediscovery = next_fire + self._rediscovery_interval
         while not self._stop.is_set():
+            if self._rediscovery_interval > 0 and self._clock() >= next_rediscovery:
+                self.rediscover()
+                next_rediscovery = self._clock() + self._rediscovery_interval
             self.tick()
             next_fire += self._interval
             delay = next_fire - self._clock()
