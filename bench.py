@@ -39,6 +39,8 @@ def main() -> int:
         "vs_baseline": round(BUDGET_MS / p50, 3) if p50 > 0 else None,
         "p90_ms": round(result["p90_ms"], 3),
         "p99_ms": round(result["p99_ms"], 3),
+        "metrics_per_sec_per_chip": round(result["metrics_per_chip"], 1),
+        "max_hz": round(result["max_hz"], 1),
         "mode": result["mode"],
         "chips": result["chips"],
     }

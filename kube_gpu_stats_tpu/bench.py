@@ -47,6 +47,13 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
     finally:
         loop.stop()
     ordered = sorted(durations)
+    chips = max(1, len(loop.devices))
+    # Per-chip series actually exported this tick (the north-star's second
+    # figure: "metrics/sec/chip" — at the 1 Hz cadence this IS the rate).
+    device_series = sum(
+        1 for s in registry.snapshot().series
+        if s.spec.name.startswith("accelerator_")
+    )
     result = {
         "chips": len(loop.devices),
         "ticks": ticks,
@@ -55,6 +62,8 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
         "p50_ms": _percentile(ordered, 0.50),
         "p90_ms": _percentile(ordered, 0.90),
         "p99_ms": _percentile(ordered, 0.99),
+        "metrics_per_chip": device_series / chips,
+        "max_hz": 1000.0 / _percentile(ordered, 0.50) if ordered else 0.0,
     }
     result.update(extra or {})
     return result

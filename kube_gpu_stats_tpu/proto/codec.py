@@ -17,8 +17,13 @@ LENGTH = 2
 FIXED32 = 5
 
 
+_SINGLE_BYTE = [bytes((i,)) for i in range(128)]
+
+
 def encode_varint(value: int) -> bytes:
-    if value < 0:
+    if value < 128:
+        if value >= 0:
+            return _SINGLE_BYTE[value]  # hot path: tags + small ints
         # Negative int32/int64 are encoded as 10-byte two's-complement varints.
         value += 1 << 64
     out = bytearray()
@@ -33,8 +38,15 @@ def encode_varint(value: int) -> bytes:
 
 
 def decode_varint(data: bytes, pos: int) -> tuple[int, int]:
-    result = 0
-    shift = 0
+    try:
+        byte = data[pos]
+    except IndexError:
+        raise ValueError("truncated varint") from None
+    if not byte & 0x80:  # hot path: single-byte varint
+        return byte, pos + 1
+    result = byte & 0x7F
+    shift = 7
+    pos += 1
     while True:
         if pos >= len(data):
             raise ValueError("truncated varint")

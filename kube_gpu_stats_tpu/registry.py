@@ -185,10 +185,10 @@ class SnapshotBuilder:
         value: float,
         labels: Mapping[str, str] | Iterable[tuple[str, str]] = (),
     ) -> None:
-        if isinstance(labels, Mapping):
-            labels = tuple(labels.items())
-        else:
-            labels = tuple(labels)
+        # duck-typed (not isinstance Mapping): typing-protocol subclass
+        # checks are measurably slow on the per-series hot path.
+        items = getattr(labels, "items", None)
+        labels = tuple(items()) if items is not None else tuple(labels)
         self._series.append(Series(spec, labels, float(value)))
 
     def add_histogram(self, state: HistogramState) -> None:

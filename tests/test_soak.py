@@ -1,0 +1,80 @@
+"""Short soak: full daemon under backend flapping + scrape load. Catches
+slow structural failures unit tests can't — thread leaks, generation
+stalls, crash-on-flap (SURVEY.md §5 "never crash the DaemonSet pod")."""
+
+import threading
+import time
+import urllib.request
+
+from kube_gpu_stats_tpu.config import Config
+from kube_gpu_stats_tpu.daemon import Daemon
+from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+
+def test_soak_flapping_backend(tmp_path):
+    make_sysfs(tmp_path / "sys", num_chips=4)
+    server = FakeLibtpuServer(num_chips=4).start()
+    cfg = Config(
+        backend="tpu",
+        sysfs_root=str(tmp_path / "sys"),
+        libtpu_ports=(server.port,),
+        interval=0.03,
+        deadline=0.5,
+        listen_host="127.0.0.1",
+        listen_port=0,
+        attribution="off",
+        rediscovery_interval=0.5,
+        use_native=True,
+        textfile_dir=str(tmp_path / "tf"),
+    )
+    daemon = Daemon(cfg)
+    daemon.start()
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{daemon.server.port}/metrics", timeout=2
+                ).read()
+            except Exception:
+                pass
+            time.sleep(0.01)
+
+    scrape_threads = [threading.Thread(target=scraper, daemon=True) for _ in range(3)]
+    for t in scrape_threads:
+        t.start()
+
+    try:
+        assert daemon.registry.wait_for_publish(0, timeout=5)
+        settle = threading.active_count()
+        start_gen = daemon.registry.generation
+        deadline = time.monotonic() + 6.0
+        flip = True
+        while time.monotonic() < deadline:
+            server.fail = flip  # flap the runtime every 500 ms
+            flip = not flip
+            time.sleep(0.5)
+        server.fail = False
+
+        # Liveness: the loop kept publishing through the whole soak.
+        gens = daemon.registry.generation - start_gen
+        assert gens > 100, f"only {gens} publishes in 6s soak"
+        # No thread leak: sampler pool + fixed threads only.
+        assert threading.active_count() <= settle + 2, (
+            settle, threading.active_count()
+        )
+        # Recovery: runtime healthy again -> full metrics return.
+        time.sleep(0.5)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.server.port}/metrics", timeout=2
+        ).read().decode()
+        assert body.count("accelerator_up{") == 4
+        assert "accelerator_duty_cycle{" in body
+    finally:
+        stop.set()
+        for t in scrape_threads:
+            t.join(timeout=2)
+        daemon.stop()
+        server.stop()
