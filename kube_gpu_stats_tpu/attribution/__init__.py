@@ -98,6 +98,7 @@ class CachedAttribution:
         self._source = source
         self._interval = refresh_interval
         self._map: dict[str, Labels] = {}
+        self._allocatable: dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.consecutive_failures = 0
@@ -110,6 +111,20 @@ class CachedAttribution:
             self.consecutive_failures += 1
             log.warning("attribution refresh failed (%d consecutive): %s",
                         self.consecutive_failures, exc)
+            return
+        # Allocatable counts are an optional cross-check (kubelet >= 1.23);
+        # their failure must not fail the attribution refresh.
+        fetch_allocatable = getattr(self._source, "fetch_allocatable", None)
+        if fetch_allocatable is not None:
+            try:
+                self._allocatable = fetch_allocatable()
+            except Exception as exc:
+                log.debug("allocatable fetch unavailable: %s", exc)
+
+    def allocatable(self) -> Mapping[str, int]:
+        """Per-resource allocatable device counts from the last successful
+        refresh (empty until one lands)."""
+        return self._allocatable
 
     def lookup(self, device: Device) -> Labels:
         table = self._map

@@ -48,5 +48,16 @@ class CheckpointSource:
                 allocations.append((device_id, labels))
         return index_allocations(allocations)
 
+    def fetch_allocatable(self) -> dict[str, int]:
+        """RegisteredDevices from the checkpoint file (best-effort analog of
+        GetAllocatableResources)."""
+        doc = json.loads(self._path.read_text())
+        registered = (doc.get("Data") or {}).get("RegisteredDevices") or {}
+        return {
+            resource: len(ids or [])
+            for resource, ids in registered.items()
+            if resource in RESOURCE_NAMES
+        }
+
     def close(self) -> None:
         pass

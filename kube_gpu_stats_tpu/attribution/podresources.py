@@ -21,6 +21,11 @@ class PodResourcesSource:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
+        self._allocatable = self._channel.unary_unary(
+            pb.ALLOCATABLE_METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
         self._timeout = rpc_timeout
 
     def fetch(self) -> dict[str, Labels]:
@@ -40,6 +45,19 @@ class PodResourcesSource:
                     for device_id in devices.device_ids:
                         allocations.append((device_id, labels))
         return index_allocations(allocations)
+
+    def fetch_allocatable(self) -> dict[str, int]:
+        """Per-resource allocatable device counts (GetAllocatableResources;
+        kubelet >= 1.23). Used as a self-metric cross-check against local
+        discovery — not on the poll hot path."""
+        raw = self._allocatable(b"", timeout=self._timeout)
+        counts: dict[str, int] = {}
+        for devices in pb.decode_allocatable_response(raw):
+            if devices.resource_name in RESOURCE_NAMES:
+                counts[devices.resource_name] = (
+                    counts.get(devices.resource_name, 0) + len(devices.device_ids)
+                )
+        return counts
 
     def close(self) -> None:
         self._channel.close()

@@ -250,6 +250,14 @@ class PollLoop:
                 builder.add(schema.COLLECTIVE_OPS, float(sample.collective_ops), base)
 
         builder.add(schema.SELF_DEVICES, float(len(results)))
+        allocatable = getattr(self._attribution, "allocatable", None)
+        if allocatable is not None:
+            for resource, count in sorted(allocatable().items()):
+                builder.add(
+                    schema.SELF_ALLOCATABLE,
+                    float(count),
+                    [("resource", resource)],
+                )
         for reason in sorted(self._errors):
             builder.add(
                 schema.SELF_POLL_ERRORS,

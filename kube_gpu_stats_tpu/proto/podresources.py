@@ -26,6 +26,7 @@ import dataclasses
 from . import codec
 
 LIST_METHOD = "/v1.PodResources/List"
+ALLOCATABLE_METHOD = "/v1.PodResources/GetAllocatableResources"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,6 +117,23 @@ def encode_list_response(pods: list[PodResources]) -> bytes:
 def decode_list_response(data: bytes) -> list[PodResources]:
     return [
         decode_pod(value)
+        for field, _, value in codec.iter_fields(data)
+        if field == 1
+    ]
+
+
+# AllocatableResourcesResponse { repeated ContainerDevices devices = 1;
+#   repeated int64 cpu_ids = 2; ... }  — only devices are read.
+
+def encode_allocatable_response(devices: list[ContainerDevices]) -> bytes:
+    return b"".join(
+        codec.field_bytes(1, encode_container_devices(d)) for d in devices
+    )
+
+
+def decode_allocatable_response(data: bytes) -> list[ContainerDevices]:
+    return [
+        decode_container_devices(value)
         for field, _, value in codec.iter_fields(data)
         if field == 1
     ]

@@ -156,12 +156,21 @@ SELF_INFO = MetricSpec(
     "Constant 1; build/runtime identity in labels.",
     extra_labels=("version", "backend"),
 )
+SELF_ALLOCATABLE = MetricSpec(
+    "collector_allocatable_devices",
+    MetricType.GAUGE,
+    "Accelerator devices the kubelet reports as allocatable on this node, "
+    "per resource class. Divergence from collector_devices signals a "
+    "device-plugin/driver disagreement.",
+    extra_labels=("resource",),
+)
 
 SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_DURATION,
     SELF_POLL_ERRORS,
     SELF_DEVICES,
     SELF_INFO,
+    SELF_ALLOCATABLE,
 )
 
 ALL_METRICS: tuple[MetricSpec, ...] = PER_DEVICE_METRICS + SELF_METRICS

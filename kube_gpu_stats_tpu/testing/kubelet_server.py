@@ -14,8 +14,10 @@ class FakeKubeletServer:
     """`pods` is a list of pb.PodResources; mutate between refreshes to
     simulate (de)allocations. `fail=True` aborts List with UNAVAILABLE."""
 
-    def __init__(self, socket_path: str, pods: list[pb.PodResources] | None = None):
+    def __init__(self, socket_path: str, pods: list[pb.PodResources] | None = None,
+                 allocatable: list[pb.ContainerDevices] | None = None):
         self.pods: list[pb.PodResources] = pods or []
+        self.allocatable: list[pb.ContainerDevices] = allocatable or []
         self.fail = False
         self.list_calls = 0
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
@@ -26,7 +28,12 @@ class FakeKubeletServer:
                     self._list,
                     request_deserializer=lambda b: b,
                     response_serializer=lambda b: b,
-                )
+                ),
+                "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+                    self._get_allocatable,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                ),
             },
         )
         self._server.add_generic_rpc_handlers((handler,))
@@ -38,6 +45,11 @@ class FakeKubeletServer:
         if self.fail:
             context.abort(grpc.StatusCode.UNAVAILABLE, "kubelet injected failure")
         return pb.encode_list_response(self.pods)
+
+    def _get_allocatable(self, request_bytes: bytes, context) -> bytes:
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "kubelet injected failure")
+        return pb.encode_allocatable_response(self.allocatable)
 
     def start(self) -> "FakeKubeletServer":
         self._server.start()

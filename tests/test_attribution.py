@@ -203,3 +203,62 @@ def test_build_auto_falls_back_to_checkpoint(tmp_path):
     cached.refresh_once()
     assert cached.lookup(dev(0))["pod"] == "uid-1234"
     cached.stop()
+
+
+# -- allocatable cross-check (GetAllocatableResources) ----------------------
+
+def test_podresources_fetch_allocatable(tmp_path):
+    socket = str(tmp_path / "kubelet.sock")
+    allocatable = [
+        pb.ContainerDevices("google.com/tpu", ("0", "1", "2", "3")),
+        pb.ContainerDevices("nvidia.com/gpu", ("GPU-a",)),
+        pb.ContainerDevices("example.com/fpga", ("f0",)),
+    ]
+    with FakeKubeletServer(socket, allocatable=allocatable):
+        source = PodResourcesSource(socket)
+        counts = source.fetch_allocatable()
+        assert counts == {"google.com/tpu": 4, "nvidia.com/gpu": 1}
+        source.close()
+
+
+def test_cached_attribution_exposes_allocatable(tmp_path):
+    socket = str(tmp_path / "kubelet.sock")
+    allocatable = [pb.ContainerDevices("google.com/tpu", ("0", "1"))]
+    with FakeKubeletServer(socket, allocatable=allocatable):
+        cached = CachedAttribution(PodResourcesSource(socket))
+        assert cached.allocatable() == {}
+        cached.refresh_once()
+        assert cached.allocatable() == {"google.com/tpu": 2}
+        cached.stop()
+
+
+def test_checkpoint_fetch_allocatable(tmp_path):
+    path = tmp_path / "kubelet_internal_checkpoint"
+    path.write_text(json.dumps(checkpoint_doc()))
+    assert CheckpointSource(str(path)).fetch_allocatable() == {
+        "google.com/tpu": 3
+    }
+
+
+def test_allocatable_gauge_in_snapshot(tmp_path):
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.poll import PollLoop
+    from kube_gpu_stats_tpu.registry import Registry
+
+    socket = str(tmp_path / "kubelet.sock")
+    allocatable = [pb.ContainerDevices("google.com/tpu", ("0", "1", "2", "3"))]
+    with FakeKubeletServer(socket, allocatable=allocatable):
+        cached = CachedAttribution(PodResourcesSource(socket))
+        cached.refresh_once()
+        reg = Registry()
+        loop = PollLoop(MockCollector(num_devices=4), reg, deadline=5.0,
+                        attribution=cached)
+        loop.tick()
+        series = [
+            (dict(s.labels), s.value)
+            for s in reg.snapshot().series
+            if s.spec.name == "collector_allocatable_devices"
+        ]
+        assert series == [({"resource": "google.com/tpu"}, 4.0)]
+        loop.stop()
+        cached.stop()
