@@ -101,13 +101,19 @@ def make_sharded_train_step(n_devices: int, *, d_model: int = 256,
 
 
 def run_burn(seconds: float = 10.0, size: int = 2048,
-             report_every: float = 1.0) -> int:
-    """Drive the local chip(s) for `seconds`; returns steps executed."""
+             report_every: float = 1.0, kernel: str = "xla") -> int:
+    """Drive the local chip(s) for `seconds`; returns steps executed.
+    kernel: "xla" (jnp matmul chain) or "pallas" (hand-tiled MXU kernel)."""
     import jax
 
     import jax.numpy as jnp
 
-    fn, (x, w) = entry_fn(size)
+    if kernel == "pallas":
+        from .pallas_burn import pallas_entry_fn
+
+        fn, (x, w) = pallas_entry_fn(size)
+    else:
+        fn, (x, w) = entry_fn(size)
     step = jax.jit(fn)
     float(jnp.sum(step(x, w)))  # compile + force one real execution
     steps = 0
@@ -148,6 +154,7 @@ def main(argv=None) -> int:
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--size", type=int, default=2048,
                         help="matmul dimension (multiple of 128 for the MXU)")
+    parser.add_argument("--kernel", choices=("xla", "pallas"), default="xla")
     args = parser.parse_args(argv)
-    run_burn(args.seconds, args.size)
+    run_burn(args.seconds, args.size, kernel=args.kernel)
     return 0

@@ -1,0 +1,88 @@
+"""Pallas MXU burn kernel — the hand-scheduled variant of the load
+generator (loadgen only; the exporter has no JAX — SURVEY.md §7).
+
+A classic K-accumulation tiled matmul: grid (M/TM, N/TN, K/TK), bf16 tiles
+in VMEM feeding the 128x128 MXU, f32 accumulation in the output block
+(`preferred_element_type` per the Pallas TPU guide). Tile sizes respect the
+bf16 (16, 128) min-tile constraint. On non-TPU backends the kernel runs in
+interpreter mode so tests validate numerics on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _is_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.cache
+def _build(m: int, n: int, k: int, tile_m: int, tile_n: int, tile_k: int,
+           interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if min(tile_m, tile_n, tile_k) < 128:
+        raise ValueError("tiles must be >=128 to keep the MXU fed")
+    if m % tile_m or n % tile_n or k % tile_k:
+        raise ValueError("shape must divide tile sizes (static shapes only)")
+
+    def kernel(a_ref, b_ref, o_ref):
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+        o_ref[:] += jnp.dot(
+            a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+        )
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=(m // tile_m, n // tile_n, k // tile_k),
+        in_specs=[
+            pl.BlockSpec((tile_m, tile_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((tile_k, tile_n), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, kk: (i, j)),
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def pallas_matmul(a, b, *, tile_m: int = 256, tile_n: int = 256,
+                  tile_k: int = 512, interpret: bool | None = None):
+    """f32 = a @ b with bf16 inputs through the tiled Pallas kernel."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    m, k = a.shape
+    k2, n = b.shape
+    if k != k2:
+        raise ValueError(f"shape mismatch {a.shape} @ {b.shape}")
+    tile_m, tile_n, tile_k = (min(tile_m, m), min(tile_n, n), min(tile_k, k))
+    return _build(m, n, k, tile_m, tile_n, tile_k, interpret)(a, b)
+
+
+def pallas_entry_fn(size: int = 1024):
+    """(fn, example_args) for a Pallas-kernel burn step, mirroring
+    burn.entry_fn's contract."""
+    import jax
+    import jax.numpy as jnp
+
+    interpret = not _is_tpu()
+
+    def burn(x, w):
+        acc = pallas_matmul(x, w, interpret=interpret)
+        return jnp.tanh(acc).astype(jnp.bfloat16)
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (size, size), dtype=jnp.bfloat16)
+    return burn, (x, w)

@@ -1,0 +1,54 @@
+"""Pallas burn kernel numerics in interpreter mode on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kube_gpu_stats_tpu.loadgen.pallas_burn import pallas_entry_fn, pallas_matmul
+
+
+def test_matches_reference_matmul():
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(256, 512), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.randn(512, 384), dtype=jnp.bfloat16)
+    got = pallas_matmul(a, b, tile_m=128, tile_n=128, tile_k=128,
+                        interpret=True)
+    want = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_k_accumulation_across_grid_steps():
+    # K spans several grid steps; accumulation must not lose partials.
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.randn(128, 1024), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.randn(1024, 128), dtype=jnp.bfloat16)
+    got = pallas_matmul(a, b, tile_m=128, tile_n=128, tile_k=256,
+                        interpret=True)
+    want = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_shape_validation():
+    a = jnp.zeros((128, 128), jnp.bfloat16)
+    b = jnp.zeros((256, 128), jnp.bfloat16)
+    with pytest.raises(ValueError):
+        pallas_matmul(a, b, interpret=True)
+    with pytest.raises(ValueError):
+        pallas_matmul(
+            jnp.zeros((100, 128), jnp.bfloat16),
+            jnp.zeros((128, 128), jnp.bfloat16),
+            tile_m=100, interpret=True,
+        )
+
+
+def test_entry_fn_contract():
+    fn, (x, w) = pallas_entry_fn(size=256)
+    out = jax.jit(fn)(x, w)
+    out.block_until_ready()
+    assert out.shape == x.shape
+    assert out.dtype == jnp.bfloat16
