@@ -112,8 +112,12 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
         from .pallas_burn import pallas_entry_fn
 
         fn, (x, w) = pallas_entry_fn(size)
-    else:
+        matmuls_per_step = 1
+    elif kernel == "xla":
         fn, (x, w) = entry_fn(size)
+        matmuls_per_step = 4  # entry_fn chains 4 matmuls
+    else:
+        raise ValueError(f"unknown kernel {kernel!r} (use 'xla' or 'pallas')")
     step = jax.jit(fn)
     float(jnp.sum(step(x, w)))  # compile + force one real execution
     steps = 0
@@ -137,7 +141,7 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
             inflight = 0
             now = time.monotonic()
             rate = steps / (now - start)
-            flops = 2 * 4 * size**3 * rate
+            flops = 2 * matmuls_per_step * size**3 * rate
             print(f"loadgen: {steps} steps, {rate:.1f} steps/s, "
                   f"~{flops / 1e12:.2f} TFLOP/s", flush=True)
             last_report = now

@@ -57,16 +57,30 @@ def _build(m: int, n: int, k: int, tile_m: int, tile_n: int, tile_k: int,
     return jax.jit(call)
 
 
+def _snap_tile(requested: int, dim: int) -> int:
+    """Largest multiple of 128 that divides `dim` and is <= `requested` —
+    any 128-multiple dim gets a legal tile, not just multiples of the
+    default tile sizes."""
+    tile = min(requested, dim)
+    tile -= tile % 128
+    while tile >= 128 and dim % tile:
+        tile -= 128
+    return tile
+
+
 def pallas_matmul(a, b, *, tile_m: int = 256, tile_n: int = 256,
                   tile_k: int = 512, interpret: bool | None = None):
-    """f32 = a @ b with bf16 inputs through the tiled Pallas kernel."""
+    """f32 = a @ b with bf16 inputs through the tiled Pallas kernel.
+    Dims must be multiples of 128 (the MXU tile edge)."""
     if interpret is None:
         interpret = not _is_tpu()
     m, k = a.shape
     k2, n = b.shape
     if k != k2:
         raise ValueError(f"shape mismatch {a.shape} @ {b.shape}")
-    tile_m, tile_n, tile_k = (min(tile_m, m), min(tile_n, n), min(tile_k, k))
+    tile_m = _snap_tile(tile_m, m)
+    tile_n = _snap_tile(tile_n, n)
+    tile_k = _snap_tile(tile_k, k)
     return _build(m, n, k, tile_m, tile_n, tile_k, interpret)(a, b)
 
 

@@ -52,3 +52,22 @@ def test_entry_fn_contract():
     out.block_until_ready()
     assert out.shape == x.shape
     assert out.dtype == jnp.bfloat16
+
+
+def test_non_default_multiple_of_128_sizes():
+    # 384 is a legal MXU size but not a multiple of the default tiles;
+    # tiles must snap to a divisor.
+    rng = np.random.RandomState(2)
+    a = jnp.asarray(rng.randn(384, 384), dtype=jnp.bfloat16)
+    b = jnp.asarray(rng.randn(384, 384), dtype=jnp.bfloat16)
+    got = pallas_matmul(a, b, interpret=True)
+    want = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_unknown_kernel_rejected():
+    from kube_gpu_stats_tpu.loadgen.burn import run_burn
+
+    with pytest.raises(ValueError, match="unknown kernel"):
+        run_burn(seconds=0.1, size=128, kernel="Pallas")
