@@ -57,6 +57,7 @@ class PollLoop:
         max_workers: int | None = None,
         version: str = "dev",
         rediscovery_interval: float = 60.0,
+        process_metrics: bool = True,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -67,6 +68,7 @@ class PollLoop:
         self._topology = dict(topology_labels or {})
         self._version = version
         self._rediscovery_interval = rediscovery_interval
+        self._process_metrics = process_metrics
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -269,5 +271,11 @@ class PollLoop:
             1.0,
             [("version", self._version), ("backend", self._collector.name)],
         )
+        if self._process_metrics:
+            from . import procstats
+
+            by_self = {spec.name: spec for spec in schema.SELF_METRICS}
+            for name, value in procstats.read().items():
+                builder.add(by_self[name], value)
         builder.add_histogram(self._hist)
         return builder.build()

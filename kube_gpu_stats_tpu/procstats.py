@@ -1,0 +1,53 @@
+"""Standard Prometheus process metrics (process_cpu_seconds_total,
+process_resident_memory_bytes, process_start_time_seconds) read from /proc
+once per tick — the conventional exporter self-observability the reference
+genre gets from its client library (SURVEY.md §5 observability item).
+Degrades to nothing on hosts without /proc."""
+
+from __future__ import annotations
+
+import os
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _boot_time() -> float | None:
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("btime "):
+                    return float(line.split()[1])
+    except OSError:
+        return None
+    return None
+
+
+_BOOT_TIME = _boot_time()
+
+
+def read() -> dict[str, float]:
+    """Current process CPU seconds, RSS bytes, start time (unix). Empty on
+    failure — never raises on the poll path."""
+    out: dict[str, float] = {}
+    try:
+        with open("/proc/self/stat") as f:
+            # Field 2 (comm) may contain spaces/parens; split after it.
+            rest = f.read().rpartition(")")[2].split()
+        # rest[0] is field 3 (state); utime=14, stime=15, starttime=22
+        # (1-indexed in proc(5)) -> rest indices 11, 12, 19.
+        utime, stime = int(rest[11]), int(rest[12])
+        out["process_cpu_seconds_total"] = (utime + stime) / _CLK_TCK
+        if _BOOT_TIME is not None:
+            out["process_start_time_seconds"] = (
+                _BOOT_TIME + int(rest[19]) / _CLK_TCK
+            )
+    except (OSError, IndexError, ValueError):
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["process_resident_memory_bytes"] = float(rss_pages * _PAGE_SIZE)
+    except (OSError, IndexError, ValueError):
+        pass
+    return out

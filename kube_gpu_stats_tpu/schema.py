@@ -165,12 +165,31 @@ SELF_ALLOCATABLE = MetricSpec(
     extra_labels=("resource",),
 )
 
+PROCESS_CPU = MetricSpec(
+    "process_cpu_seconds_total",
+    MetricType.COUNTER,
+    "Total user+system CPU time this exporter process has consumed.",
+)
+PROCESS_RSS = MetricSpec(
+    "process_resident_memory_bytes",
+    MetricType.GAUGE,
+    "Resident memory of the exporter process.",
+)
+PROCESS_START = MetricSpec(
+    "process_start_time_seconds",
+    MetricType.GAUGE,
+    "Unix time the exporter process started.",
+)
+
 SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_DURATION,
     SELF_POLL_ERRORS,
     SELF_DEVICES,
     SELF_INFO,
     SELF_ALLOCATABLE,
+    PROCESS_CPU,
+    PROCESS_RSS,
+    PROCESS_START,
 )
 
 ALL_METRICS: tuple[MetricSpec, ...] = PER_DEVICE_METRICS + SELF_METRICS

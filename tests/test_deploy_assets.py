@@ -120,3 +120,15 @@ def test_dashboard_template_vars():
     board = json.loads((DEPLOY / "grafana" / "dashboard.json").read_text())
     names = {v["name"] for v in board["templating"]["list"]}
     assert {"datasource", "slice", "worker", "accel_type"} <= names
+
+
+def test_alert_rules_parse_and_reference_real_metrics():
+    doc = yaml.safe_load((DEPLOY / "alerts.yaml").read_text())
+    rules = [r for g in doc["groups"] for r in g["rules"]]
+    assert len(rules) >= 4
+    known = known_exposition_names()
+    for rule in rules:
+        assert "alert" in rule and "expr" in rule
+        for token in METRIC_TOKEN.findall(rule["expr"]):
+            assert token in known, f"alert references unknown metric {token}"
+        assert rule.get("labels", {}).get("severity") in ("warning", "critical")

@@ -230,3 +230,16 @@ def test_rediscover_purges_vanished_device_state():
     assert ("1", "x0") not in loop._rates._last
     assert ("0", "x0") in loop._rates._last
     loop.stop()
+
+
+def test_process_self_metrics_exported():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    names = {s.spec.name for s in reg.snapshot().series}
+    assert "process_cpu_seconds_total" in names
+    assert "process_resident_memory_bytes" in names
+    rss = [s.value for s in reg.snapshot().series
+           if s.spec.name == "process_resident_memory_bytes"]
+    assert rss[0] > 1024 * 1024  # a real python process is > 1 MiB
+    loop.stop()
