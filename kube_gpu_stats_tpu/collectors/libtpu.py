@@ -39,7 +39,9 @@ _VALUE_MAP: Mapping[str, str] = {
 
 
 class LibtpuClient:
-    """One channel per runtime-metrics port; bytes-level unary calls."""
+    """One channel per runtime-metrics port; bytes-level unary calls. Ports
+    are queried in parallel (multi-process runtimes serve disjoint chip
+    sets per port; one wedged process must cost one rpc_timeout, not N)."""
 
     def __init__(self, addr: str = "127.0.0.1",
                  ports: Sequence[int] = (8431,),
@@ -47,6 +49,13 @@ class LibtpuClient:
         self._rpc_timeout = rpc_timeout
         self._methods = []
         self._channels = []
+        self._port_pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(ports), thread_name_prefix="libtpu-port"
+            )
+            if len(ports) > 1
+            else None
+        )
         for port in ports:
             channel = grpc.insecure_channel(
                 f"{addr}:{port}",
@@ -69,26 +78,50 @@ class LibtpuClient:
                 )
             )
 
+    def _call_one(self, method, request: bytes) -> list[tpumetrics.MetricSample]:
+        raw = method(request, timeout=self._rpc_timeout)
+        return tpumetrics.decode_response(raw)
+
     def get_metric(self, metric_name: str) -> list[tpumetrics.MetricSample]:
-        """Fetch one metric family from every port, merged."""
+        """Fetch one metric family from every port in parallel, merged.
+        Raises CollectorError (with .status_code when the failure was a
+        gRPC status) only if every port failed."""
         request = tpumetrics.encode_request(metric_name)
         samples: list[tpumetrics.MetricSample] = []
-        errors = []
-        for method in self._methods:
-            try:
-                raw = method(request, timeout=self._rpc_timeout)
-                samples.extend(tpumetrics.decode_response(raw))
-            except (grpc.RpcError, ValueError) as exc:
-                # RpcError: transport/deadline; ValueError: undecodable
-                # response bytes (runtime speaking a different schema).
-                errors.append(exc)
-        if errors and not samples:
-            raise CollectorError(
-                f"libtpu metric {metric_name!r} unavailable: {errors[0]}"
+        errors: list[Exception] = []
+        if self._port_pool is not None:
+            outcomes = self._port_pool.map(
+                lambda m: self._safe_call(m, request), self._methods
             )
+        else:
+            outcomes = (self._safe_call(m, request) for m in self._methods)
+        for result, error in outcomes:
+            if error is not None:
+                errors.append(error)
+            else:
+                samples.extend(result)
+        if errors and not samples:
+            first = errors[0]
+            exc = CollectorError(
+                f"libtpu metric {metric_name!r} unavailable: {first}"
+            )
+            exc.status_code = (
+                first.code() if isinstance(first, grpc.Call) else None
+            )
+            raise exc
         return samples
 
+    def _safe_call(self, method, request: bytes):
+        try:
+            return self._call_one(method, request), None
+        except (grpc.RpcError, ValueError) as exc:
+            # RpcError: transport/deadline; ValueError: undecodable
+            # response bytes (runtime speaking a different schema).
+            return None, exc
+
     def close(self) -> None:
+        if self._port_pool is not None:
+            self._port_pool.shutdown(wait=False, cancel_futures=True)
         for channel in self._channels:
             channel.close()
 
@@ -156,6 +189,11 @@ class LibtpuCollector(Collector):
                 entry["values"][_VALUE_MAP[sample.name]] = float(sample.value)
             # Unknown names: runtime newer than our pin — ignore.
 
+        _REJECTED = (
+            grpc.StatusCode.UNIMPLEMENTED,
+            grpc.StatusCode.INVALID_ARGUMENT,
+            grpc.StatusCode.NOT_FOUND,
+        )
         if self._batched is not False:
             try:
                 for s in self._client.get_metric(""):
@@ -163,14 +201,17 @@ class LibtpuCollector(Collector):
                 if cache:
                     self._batched = True
             except CollectorError as exc:
-                if self._batched is True:
-                    # Batched mode was established and the runtime is now
-                    # failing: a real outage, not a capability gap.
-                    first_error = exc
-                else:
+                if getattr(exc, "status_code", None) in _REJECTED:
+                    # The runtime answered and rejected the empty selector:
+                    # a capability gap — switch modes permanently.
                     self._batched = False
                     log.info("libtpu empty-selector fetch unsupported (%s); "
                              "using per-metric requests", exc)
+                else:
+                    # Transport failure / outage (runtime not up yet,
+                    # deadline, garbled): report it but keep probing the
+                    # batched path once the runtime returns.
+                    first_error = exc
         if self._batched is False and first_error is None:
             futures = {
                 name: self._pool.submit(self._client.get_metric, name)

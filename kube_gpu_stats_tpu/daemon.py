@@ -93,11 +93,16 @@ class Daemon:
             interval=cfg.interval,
             deadline=cfg.deadline,
             attribution=self.attribution,
-            topology_labels=topology.topology_labels(),
+            topology_labels=topology.topology_labels(use_metadata=True),
             version=__version__,
             rediscovery_interval=cfg.rediscovery_interval,
         )
-        self.server = MetricsServer(self.registry, cfg.listen_host, cfg.listen_port)
+        self.server = MetricsServer(
+            self.registry, cfg.listen_host, cfg.listen_port,
+            # A few missed intervals = unhealthy (floor for tiny test
+            # intervals where scheduling jitter dominates).
+            healthz_max_age=max(5.0, cfg.interval * 5),
+        )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir)
             if cfg.textfile_enabled

@@ -25,10 +25,17 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class MetricsServer:
-    """Threaded HTTP server for /metrics, /healthz and /."""
+    """Threaded HTTP server for /metrics, /healthz and /.
 
-    def __init__(self, registry: Registry, host: str = "0.0.0.0", port: int = 9400):
+    ``healthz_max_age`` (seconds) makes /healthz return 503 when no snapshot
+    has been published for that long — so a dead poll loop fails the
+    DaemonSet liveness probe instead of serving stale data forever. 0
+    disables the staleness check (bare-registry uses in tests/tools)."""
+
+    def __init__(self, registry: Registry, host: str = "0.0.0.0",
+                 port: int = 9400, healthz_max_age: float = 0.0):
         self._registry = registry
+        self._healthz_max_age = healthz_max_age
 
         outer = self
 
@@ -45,8 +52,21 @@ class MetricsServer:
                     self.send_response(200)
                     self.send_header("Content-Type", CONTENT_TYPE)
                 elif path == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
+                    import time
+
+                    max_age = outer._healthz_max_age
+                    snapshot = outer._registry.snapshot()
+                    stale = (
+                        max_age > 0
+                        and time.time() - snapshot.timestamp > max_age
+                    )
+                    if stale:
+                        age = time.time() - snapshot.timestamp
+                        body = f"stale: no poll for {age:.1f}s\n".encode()
+                        self.send_response(503)
+                    else:
+                        body = b"ok\n"
+                        self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                 elif path == "/":
                     body = (

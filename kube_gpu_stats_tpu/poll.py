@@ -144,7 +144,15 @@ class PollLoop:
             if self._rediscovery_interval > 0 and self._clock() >= next_rediscovery:
                 self.rediscover()
                 next_rediscovery = self._clock() + self._rediscovery_interval
-            self.tick()
+            try:
+                self.tick()
+            except Exception:
+                # A tick must never kill the loop: an exception escaping a
+                # collector (bug, unexpected proto shape) would otherwise
+                # leave the HTTP server serving a stale snapshot forever
+                # while /healthz kept passing. Count, log, keep ticking.
+                self._count_error("tick_crash")
+                log.exception("poll tick crashed; continuing")
             next_fire += self._interval
             delay = next_fire - self._clock()
             if delay <= 0:

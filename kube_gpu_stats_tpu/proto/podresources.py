@@ -62,11 +62,14 @@ def encode_container_devices(d: ContainerDevices) -> bytes:
 def decode_container_devices(data: bytes) -> ContainerDevices:
     resource_name = ""
     ids: list[str] = []
-    for field, _, value in codec.iter_fields(data):
-        if field == 1:
-            resource_name = value.decode("utf-8")
-        elif field == 2:
-            ids.append(value.decode("utf-8"))
+    try:
+        for field, _, value in codec.iter_fields(data):
+            if field == 1:
+                resource_name = value.decode("utf-8")
+            elif field == 2:
+                ids.append(value.decode("utf-8"))
+    except (AttributeError, TypeError, UnicodeDecodeError) as exc:
+        raise ValueError(f"wire-type mismatch in ContainerDevices: {exc}") from exc
     return ContainerDevices(resource_name, tuple(ids))
 
 
@@ -100,13 +103,16 @@ def decode_pod(data: bytes) -> PodResources:
     name = ""
     namespace = ""
     containers: list[ContainerResources] = []
-    for field, _, value in codec.iter_fields(data):
-        if field == 1:
-            name = value.decode("utf-8")
-        elif field == 2:
-            namespace = value.decode("utf-8")
-        elif field == 3:
-            containers.append(decode_container(value))
+    try:
+        for field, _, value in codec.iter_fields(data):
+            if field == 1:
+                name = value.decode("utf-8")
+            elif field == 2:
+                namespace = value.decode("utf-8")
+            elif field == 3:
+                containers.append(decode_container(value))
+    except (AttributeError, TypeError, UnicodeDecodeError) as exc:
+        raise ValueError(f"wire-type mismatch in PodResources: {exc}") from exc
     return PodResources(name, namespace, tuple(containers))
 
 

@@ -62,9 +62,12 @@ def encode_request(metric_name: str = "") -> bytes:
 
 
 def decode_request(data: bytes) -> str:
-    for field, _, value in codec.iter_fields(data):
-        if field == 1:
-            return value.decode("utf-8")
+    try:
+        for field, _, value in codec.iter_fields(data):
+            if field == 1:
+                return value.decode("utf-8")
+    except (AttributeError, TypeError, UnicodeDecodeError) as exc:
+        raise ValueError(f"wire-type mismatch in MetricRequest: {exc}") from exc
     return ""
 
 
@@ -89,19 +92,25 @@ def decode_metric(data: bytes) -> MetricSample:
     int_value: int | None = None
     timestamp_ns = 0
     link = ""
-    for field, _, value in codec.iter_fields(data):
-        if field == 1:
-            name = value.decode("utf-8")
-        elif field == 2:
-            device_id = codec.signed(value)
-        elif field == 3:
-            double_value = float(value)
-        elif field == 4:
-            int_value = codec.signed(value)
-        elif field == 5:
-            timestamp_ns = codec.signed(value)
-        elif field == 6:
-            link = value.decode("utf-8")
+    # Wire-type mismatches (a future runtime encoding a field differently)
+    # must surface as ValueError — the "runtime speaking a different schema"
+    # contract the client catches — not AttributeError/TypeError.
+    try:
+        for field, _, value in codec.iter_fields(data):
+            if field == 1:
+                name = value.decode("utf-8")
+            elif field == 2:
+                device_id = codec.signed(value)
+            elif field == 3:
+                double_value = float(value)
+            elif field == 4:
+                int_value = codec.signed(value)
+            elif field == 5:
+                timestamp_ns = codec.signed(value)
+            elif field == 6:
+                link = value.decode("utf-8")
+    except (AttributeError, TypeError, UnicodeDecodeError) as exc:
+        raise ValueError(f"wire-type mismatch in Metric: {exc}") from exc
     value_out: float | int
     if int_value is not None:
         value_out = int_value
@@ -118,7 +127,11 @@ def encode_response(samples: list[MetricSample]) -> bytes:
 
 def decode_response(data: bytes) -> list[MetricSample]:
     out = []
-    for field, _, value in codec.iter_fields(data):
+    for field, wire_type, value in codec.iter_fields(data):
         if field == 1:
+            if wire_type != codec.LENGTH:
+                raise ValueError(
+                    f"MetricResponse.metrics has wire type {wire_type}"
+                )
             out.append(decode_metric(value))
     return out

@@ -5,19 +5,84 @@ every per-node DaemonSet pod exports those chips labeled with slice + worker
 identity so Prometheus aggregates the full slice (BASELINE.json configs[3]).
 
 Label sources, in priority order (all [T]-tier, SURVEY.md §0):
-1. explicit KTS_* env (set by the DaemonSet via the downward API),
+1. explicit KTS_* env (set on the DaemonSet container),
 2. GKE TPU env vars injected by the device plugin / TPU VM runtime
-   (TPU_WORKER_ID, TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, ...),
-3. empty strings (labels stay present so series identity is stable).
+   (TPU_WORKER_ID, TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, ...) — present on
+   TPU VMs and in TPU-requesting pods, absent in the exporter pod,
+3. the GCE metadata server (hostNetwork pods reach it; TPU node VMs carry
+   accelerator-type / agent-worker-number / tpu-env instance attributes),
+4. empty strings (labels stay present so series identity is stable).
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import re
+import urllib.request
 from typing import Mapping
 
+log = logging.getLogger(__name__)
 
-def topology_labels(environ: Mapping[str, str] | None = None) -> dict[str, str]:
+METADATA_URL = "http://metadata.google.internal/computeMetadata/v1"
+_TPU_ENV_LINE = re.compile(r"^\s*([A-Z_]+):\s*'?([^'\n]*)'?\s*$", re.M)
+
+
+def _metadata_get(base: str, path: str, timeout: float) -> str:
+    req = urllib.request.Request(
+        f"{base}/{path}", headers={"Metadata-Flavor": "Google"}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _on_gce() -> bool:
+    """Cheap GCE detection (DMI product name) so non-GCE hosts never pay
+    metadata-server connect timeouts."""
+    try:
+        with open("/sys/class/dmi/id/product_name") as f:
+            return "Google" in f.read()
+    except OSError:
+        return False
+
+
+def from_gce_metadata(base_url: str | None = None,
+                      timeout: float = 0.5) -> dict[str, str]:
+    """Best-effort topology from GCE instance metadata; {} off-GCE.
+
+    Reads the TPU VM attributes: ``agent-worker-number`` (worker id),
+    ``accelerator-type`` (e.g. "v5p-128"), and the ``tpu-env`` blob
+    (``K: 'v'`` lines) for TPU_TOPOLOGY/slice name.
+    """
+    base = base_url or os.environ.get("KTS_METADATA_URL")
+    if base is None:
+        if not _on_gce():
+            return {}
+        base = METADATA_URL
+    out: dict[str, str] = {}
+    for key, path in (
+        ("worker", "instance/attributes/agent-worker-number"),
+        ("topology", "instance/attributes/accelerator-type"),
+    ):
+        try:
+            out[key] = _metadata_get(base, path, timeout).strip()
+        except Exception:
+            pass
+    try:
+        blob = _metadata_get(base, "instance/attributes/tpu-env", timeout)
+        env = dict(_TPU_ENV_LINE.findall(blob))
+        out.setdefault("worker", env.get("WORKER_ID", ""))
+        if env.get("TPU_TOPOLOGY"):
+            out["topology"] = env["TPU_TOPOLOGY"]
+        if env.get("NODE_ID") or env.get("TPU_NAME"):
+            out["slice"] = env.get("TPU_NAME") or env.get("NODE_ID", "")
+    except Exception:
+        pass
+    return {k: v for k, v in out.items() if v}
+
+
+def topology_labels(environ: Mapping[str, str] | None = None,
+                    use_metadata: bool = False) -> dict[str, str]:
     env = dict(environ) if environ is not None else dict(os.environ)
 
     slice_name = (
@@ -36,7 +101,14 @@ def topology_labels(environ: Mapping[str, str] | None = None) -> dict[str, str]:
         or env.get("TPU_TOPOLOGY")
         or env.get("TPU_ACCELERATOR_TYPE", "")
     )
-    return {"slice": slice_name, "worker": worker, "topology": topo}
+    labels = {"slice": slice_name, "worker": worker, "topology": topo}
+    if use_metadata and not (worker and topo and slice_name):
+        # Startup-only (never on the poll path): the exporter pod has no
+        # TPU env vars, but the node's metadata server knows the topology.
+        for key, value in from_gce_metadata().items():
+            if not labels.get(key):
+                labels[key] = value
+    return labels
 
 
 def accel_type(environ: Mapping[str, str] | None = None) -> str:

@@ -21,8 +21,17 @@ def test_daemonset_shape():
     (ds,) = load_yaml_docs("daemonset.yaml")
     assert ds["kind"] == "DaemonSet"
     spec = ds["spec"]["template"]["spec"]
-    # TPU node pools: selector + taint toleration.
-    assert "cloud.google.com/gke-tpu-accelerator" in spec["nodeSelector"]
+    # TPU node pools: GKE sets the accelerator label VALUE to the type, so
+    # scheduling must match on key existence (Exists), never a value.
+    terms = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"]
+    exprs = [e for t in terms for e in t["matchExpressions"]]
+    assert any(
+        e["key"] == "cloud.google.com/gke-tpu-accelerator"
+        and e["operator"] == "Exists"
+        for e in exprs
+    )
+    assert "nodeSelector" not in spec  # exact-value match would never schedule
     assert any(t["key"] == "google.com/tpu" for t in spec["tolerations"])
     # Host surfaces the exporter needs (L0 sysfs + C3 attribution).
     mounts = {m["mountPath"]: m for m in spec["containers"][0]["volumeMounts"]}

@@ -179,3 +179,63 @@ def test_failing_rediscovery_keeps_serving():
     ]
     assert errors == [1.0]
     loop.stop()
+
+
+def test_tick_crash_does_not_kill_loop():
+    """An unexpected (non-CollectorError) exception inside a tick must not
+    kill the run_forever thread (review finding: silent permanent metrics
+    loss behind a passing healthz)."""
+    class ExplodingCollector(MockCollector):
+        def __init__(self):
+            super().__init__(num_devices=1)
+            self.calls = 0
+
+        def begin_tick(self):
+            self.calls += 1
+            if self.calls == 2:
+                raise TypeError("unexpected proto shape")  # not CollectorError
+
+    col = ExplodingCollector()
+    reg = Registry()
+    loop = PollLoop(col, reg, interval=0.01, deadline=5.0)
+    loop.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and reg.generation < 5:
+            time.sleep(0.01)
+        assert reg.generation >= 5  # kept publishing after the crash tick
+        crash = [
+            s.value for s in reg.snapshot().series
+            if s.spec.name == "collector_poll_errors_total"
+            and dict(s.labels).get("reason") == "tick_crash"
+        ]
+        assert crash == [1.0]
+    finally:
+        loop.stop()
+
+
+def test_healthz_goes_unhealthy_when_poll_dies():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+    reg = Registry()
+    server = MetricsServer(reg, host="127.0.0.1", port=0, healthz_max_age=0.2)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/healthz"
+    try:
+        # No snapshot yet: stale.
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        reg.publish(SnapshotBuilder().build())
+        assert urllib.request.urlopen(url, timeout=2).status == 200
+        time.sleep(0.4)  # poll "died": no publishes for > max_age
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        server.stop()

@@ -151,3 +151,31 @@ def test_legacy_runtime_falls_back_to_per_metric(server):
     assert "" not in server.requests  # ...then remembered the answer
     assert col.sample(devs[0]).values
     col.close()
+
+
+def test_transient_outage_does_not_latch_per_metric_mode(server):
+    """Runtime not up at pod start (UNAVAILABLE) must NOT permanently
+    disable the batched fetch (review finding)."""
+    server.fail = True
+    col = make_collector(server)
+    col.begin_tick()  # outage while probing
+    server.fail = False
+    server.requests.clear()
+    col.begin_tick()
+    assert server.requests == [""]  # batched path retried and won
+    col.close()
+
+
+def test_wire_type_mismatch_is_collector_error(server):
+    """A response whose fields use wrong wire types must become
+    CollectorError, not AttributeError (review finding)."""
+    from kube_gpu_stats_tpu.proto import codec
+
+    # Metric message with name (field 1) encoded as varint.
+    bad_metric = codec.field_varint(1, 99) + codec.field_varint(2, 0)
+    bad_response = codec.field_bytes(1, bad_metric)
+    with pytest.raises(ValueError):
+        tpumetrics.decode_response(bad_response)
+    # And field "metrics" itself as varint:
+    with pytest.raises(ValueError):
+        tpumetrics.decode_response(codec.field_varint(1, 5))

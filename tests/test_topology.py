@@ -35,3 +35,69 @@ def test_accel_type_from_accelerator_type():
     assert accel_type({"TPU_ACCELERATOR_TYPE": "v5litepod-16"}) == "tpu-v5litepod"
     assert accel_type({"KTS_ACCEL_TYPE": "v4-8"}) == "tpu-v4"
     assert accel_type({}) == "tpu"
+
+
+def test_gce_metadata_fallback(monkeypatch):
+    """Topology from a (fake) metadata server when env vars are absent —
+    the exporter pod never carries TPU_* env (review finding)."""
+    import http.server
+    import threading
+
+    from kube_gpu_stats_tpu.topology import from_gce_metadata, topology_labels
+
+    attrs = {
+        "/computeMetadata/v1/instance/attributes/agent-worker-number": "3",
+        "/computeMetadata/v1/instance/attributes/accelerator-type": "v5p-128",
+        "/computeMetadata/v1/instance/attributes/tpu-env":
+            "ACCELERATOR_TYPE: 'v5p-128'\nTPU_TOPOLOGY: '4x4x8'\n"
+            "TPU_NAME: 'my-slice'\nWORKER_ID: '3'\n",
+    }
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.headers.get("Metadata-Flavor") != "Google":
+                self.send_response(403)
+                self.end_headers()
+                return
+            body = attrs.get(self.path)
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}/computeMetadata/v1"
+    try:
+        got = from_gce_metadata(base_url=base)
+        assert got == {"worker": "3", "topology": "4x4x8", "slice": "my-slice"}
+        monkeypatch.setenv("KTS_METADATA_URL", base)
+        for var in ("TPU_NAME", "TPU_WORKER_ID", "TPU_TOPOLOGY",
+                    "TPU_ACCELERATOR_TYPE", "KTS_SLICE", "KTS_WORKER",
+                    "KTS_TOPOLOGY", "MEGASCALE_SLICE_ID", "CLOUD_TPU_TASK_ID"):
+            monkeypatch.delenv(var, raising=False)
+        import os
+        labels = topology_labels(os.environ, use_metadata=True)
+        assert labels == {"slice": "my-slice", "worker": "3", "topology": "4x4x8"}
+        # Env still wins over metadata.
+        monkeypatch.setenv("KTS_WORKER", "9")
+        labels = topology_labels(os.environ, use_metadata=True)
+        assert labels["worker"] == "9"
+    finally:
+        server.shutdown()
+
+
+def test_metadata_disabled_off_gce(monkeypatch):
+    from kube_gpu_stats_tpu import topology
+
+    monkeypatch.delenv("KTS_METADATA_URL", raising=False)
+    monkeypatch.setattr(topology, "_on_gce", lambda: False)
+    assert topology.from_gce_metadata() == {}
