@@ -61,8 +61,11 @@ class MetricsServer:
                         and time.time() - snapshot.timestamp > max_age
                     )
                     if stale:
-                        age = time.time() - snapshot.timestamp
-                        body = f"stale: no poll for {age:.1f}s\n".encode()
+                        if snapshot.timestamp == 0:
+                            body = b"stale: no snapshot published yet\n"
+                        else:
+                            age = time.time() - snapshot.timestamp
+                            body = f"stale: no poll for {age:.1f}s\n".encode()
                         self.send_response(503)
                     else:
                         body = b"ok\n"
