@@ -1,0 +1,23 @@
+.PHONY: test bench native dashboard golden clean run-mock
+
+test:
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+native:
+	$(MAKE) -C kube_gpu_stats_tpu/native
+
+dashboard:
+	cd deploy/grafana && python build_dashboard.py
+
+golden:
+	GOLDEN_UPDATE=1 python -m pytest tests/test_golden.py -q
+
+run-mock: native
+	python -m kube_gpu_stats_tpu --backend mock --listen-port 9400
+
+clean:
+	$(MAKE) -C kube_gpu_stats_tpu/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

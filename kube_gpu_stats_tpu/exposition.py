@@ -71,10 +71,29 @@ class MetricsServer:
                         body = b"ok\n"
                         self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                elif path == "/debug/threads":
+                    # pprof analog (SURVEY.md §5): live stack dump of every
+                    # thread — enough to diagnose a wedged sampler or a
+                    # stuck attribution refresh from outside the pod.
+                    import sys
+                    import traceback
+
+                    frames = sys._current_frames()
+                    names = {t.ident: t.name for t in threading.enumerate()}
+                    parts = []
+                    for ident, frame in frames.items():
+                        parts.append(f"--- thread {names.get(ident, ident)}\n")
+                        parts.extend(traceback.format_stack(frame))
+                    body = "".join(parts).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
                 elif path == "/":
                     body = (
                         b"<html><body>kube-tpu-stats "
-                        b'<a href="/metrics">/metrics</a></body></html>'
+                        b'<a href="/metrics">/metrics</a> '
+                        b'<a href="/healthz">/healthz</a> '
+                        b'<a href="/debug/threads">/debug/threads</a>'
+                        b"</body></html>"
                     )
                     self.send_response(200)
                     self.send_header("Content-Type", "text/html")

@@ -72,3 +72,15 @@ def test_textfile_follows_publishes(tmp_path):
     finally:
         loop.stop()
         writer.stop()
+
+
+def test_debug_threads_endpoint():
+    reg = Registry()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        _, _, body = _served(server.port, "/debug/threads")
+        assert "--- thread" in body
+        assert "MainThread" in body
+    finally:
+        server.stop()
