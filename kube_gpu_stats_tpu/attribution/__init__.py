@@ -155,6 +155,41 @@ class CachedAttribution:
         self._source.close()
 
 
+class AutoSource:
+    """auto mode: prefer the richer PodResources API, re-probing the socket
+    on every refresh — a kubelet that (re)starts after the exporter must be
+    picked up without a pod restart. Falls back to the checkpoint file."""
+
+    def __init__(self, kubelet_socket: str, checkpoint_path: str) -> None:
+        self._socket_path = kubelet_socket
+        self._podresources = None
+        from .checkpoint import CheckpointSource
+
+        self._checkpoint = CheckpointSource(checkpoint_path)
+
+    def _active(self) -> AllocationSource:
+        import os
+
+        if os.path.exists(self._socket_path):
+            if self._podresources is None:
+                from .podresources import PodResourcesSource
+
+                self._podresources = PodResourcesSource(self._socket_path)
+            return self._podresources
+        return self._checkpoint
+
+    def fetch(self) -> dict[str, Labels]:
+        return self._active().fetch()
+
+    def fetch_allocatable(self) -> dict[str, int]:
+        return self._active().fetch_allocatable()
+
+    def close(self) -> None:
+        if self._podresources is not None:
+            self._podresources.close()
+        self._checkpoint.close()
+
+
 def build(mode: str, kubelet_socket: str, checkpoint_path: str,
           refresh_interval: float) -> CachedAttribution:
     """Factory for daemon.build_attribution. mode: auto|podresources|checkpoint."""
@@ -166,11 +201,6 @@ def build(mode: str, kubelet_socket: str, checkpoint_path: str,
         source = PodResourcesSource(kubelet_socket)
     elif mode == "checkpoint":
         source = CheckpointSource(checkpoint_path)
-    else:  # auto: prefer the richer PodResources API when its socket exists
-        import os
-
-        if os.path.exists(kubelet_socket):
-            source = PodResourcesSource(kubelet_socket)
-        else:
-            source = CheckpointSource(checkpoint_path)
+    else:
+        source = AutoSource(kubelet_socket, checkpoint_path)
     return CachedAttribution(source, refresh_interval)

@@ -223,6 +223,31 @@ def validate() -> None:
             raise ValueError(f"bad base label {label!r}")
 
 
+def render_docs() -> str:
+    """Markdown reference for every exported family — docs/METRICS.md is
+    generated from this so the doc can't drift from the code (pinned by
+    tests/test_schema.py)."""
+    lines = [
+        "# Metrics reference",
+        "",
+        "Generated from `kube_gpu_stats_tpu/schema.py` — regenerate with",
+        "`python -m kube_gpu_stats_tpu.schema`.",
+        "",
+        "Per-device base labels: `" + "`, `".join(DEVICE_LABELS) + "`;",
+        "attribution: `" + "`, `".join(ATTRIBUTION_LABELS) + "`;",
+        "topology: `" + "`, `".join(TOPOLOGY_LABELS) + "`.",
+        "",
+        "| Family | Type | Extra labels | Help |",
+        "|--------|------|--------------|------|",
+    ]
+    for spec in ALL_METRICS:
+        extra = ", ".join(f"`{label}`" for label in spec.extra_labels) or "—"
+        lines.append(
+            f"| `{spec.name}` | {spec.type.value} | {extra} | {spec.help} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def escape_label_value(value: str) -> str:
     """Escape a label value per the Prometheus text exposition format."""
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -231,3 +256,11 @@ def escape_label_value(value: str) -> str:
 def render_labels(labels: Iterable[tuple[str, str]]) -> str:
     inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}" if inner else ""
+
+
+if __name__ == "__main__":  # pragma: no cover - doc generator
+    import pathlib
+
+    out = pathlib.Path(__file__).parent.parent / "docs" / "METRICS.md"
+    out.write_text(render_docs())
+    print(f"wrote {out}")

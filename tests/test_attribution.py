@@ -262,3 +262,19 @@ def test_allocatable_gauge_in_snapshot(tmp_path):
         assert series == [({"resource": "google.com/tpu"}, 4.0)]
         loop.stop()
         cached.stop()
+
+
+def test_auto_switches_to_podresources_when_kubelet_appears(tmp_path):
+    """Auto mode must pick up a kubelet that starts AFTER the exporter
+    (kubelet restart / boot ordering) without a pod restart."""
+    path = tmp_path / "kubelet_internal_checkpoint"
+    path.write_text(json.dumps(checkpoint_doc()))
+    socket = str(tmp_path / "kubelet.sock")
+    cached = build(mode="auto", kubelet_socket=socket,
+                   checkpoint_path=str(path), refresh_interval=10.0)
+    cached.refresh_once()
+    assert cached.lookup(dev(0))["pod"] == "uid-1234"  # checkpoint fallback
+    with FakeKubeletServer(socket, [tpu_pod("late-pod", "ml", "c", ["0"])]):
+        cached.refresh_once()
+        assert cached.lookup(dev(0))["pod"] == "late-pod"  # switched
+    cached.stop()

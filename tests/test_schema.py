@@ -28,3 +28,12 @@ def test_label_escaping():
     assert schema.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
     assert schema.render_labels([("pod", 'x"y')]) == '{pod="x\\"y"}'
     assert schema.render_labels([]) == ""
+
+
+def test_metrics_doc_in_sync():
+    import pathlib
+
+    doc = pathlib.Path(__file__).parent.parent / "docs" / "METRICS.md"
+    assert doc.read_text() == schema.render_docs(), (
+        "docs/METRICS.md is stale; run: python -m kube_gpu_stats_tpu.schema"
+    )
