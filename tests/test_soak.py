@@ -49,6 +49,9 @@ def test_soak_flapping_backend(tmp_path):
     try:
         assert daemon.registry.wait_for_publish(0, timeout=5)
         settle = threading.active_count()
+        from kube_gpu_stats_tpu import procstats
+
+        rss_start = procstats.read().get("process_resident_memory_bytes", 0)
         start_gen = daemon.registry.generation
         deadline = time.monotonic() + 6.0
         flip = True
@@ -65,6 +68,10 @@ def test_soak_flapping_backend(tmp_path):
         assert threading.active_count() <= settle + 2, (
             settle, threading.active_count()
         )
+        # No unbounded memory growth across ~200 ticks of flapping.
+        rss_end = procstats.read().get("process_resident_memory_bytes", 0)
+        if rss_start and rss_end:
+            assert rss_end - rss_start < 30 * 1024 * 1024, (rss_start, rss_end)
         # Recovery: runtime healthy again -> full metrics return.
         time.sleep(0.5)
         body = urllib.request.urlopen(
