@@ -64,8 +64,10 @@ def test_soak_flapping_backend(tmp_path):
         # Liveness: the loop kept publishing through the whole soak.
         gens = daemon.registry.generation - start_gen
         assert gens > 100, f"only {gens} publishes in 6s soak"
-        # No thread leak: sampler pool + fixed threads only.
-        assert threading.active_count() <= settle + 2, (
+        # No thread leak: a leaking sampler pool would add ~1 thread/tick
+        # (hundreds over the soak); transient per-request HTTP handler
+        # threads legitimately fluctuate by a few.
+        assert threading.active_count() <= settle + 8, (
             settle, threading.active_count()
         )
         # No unbounded memory growth across ~200 ticks of flapping.
