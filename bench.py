@@ -29,7 +29,8 @@ def main() -> int:
     if result is None:
         with tempfile.TemporaryDirectory() as tmp:
             result = run_latency_harness(
-                tmp, num_chips=8, ticks=50, rpc_delay=0.010, warmup=5
+                tmp, num_chips=8, ticks=50, rpc_delay=0.010, warmup=5,
+                subprocess_server=True,
             )
     p50 = result["p50_ms"]
     line = {

@@ -69,32 +69,69 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
     return result
 
 
+def _spawn_server_subprocess(num_chips: int, rpc_delay: float):
+    """Fake libtpu server in its OWN process — the real runtime doesn't
+    share our GIL, so in-process serving would inflate measured latency.
+    Returns (port, proc) or None if spawning fails (fall back in-process)."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kube_gpu_stats_tpu.testing.libtpu_server",
+             "--chips", str(num_chips), "--delay", str(rpc_delay)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        line = proc.stdout.readline().strip()
+        return int(line), proc
+    except Exception:
+        return None
+
+
 def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
                         ticks: int = 50, rpc_delay: float = 0.010,
-                        warmup: int = 5) -> dict:
+                        warmup: int = 5, subprocess_server: bool = False) -> dict:
     """Simulated-node harness: fake libtpu server (scripted per-RPC delay)
-    + sysfs fixture tree, measured through the production stack."""
+    + sysfs fixture tree, measured through the production stack. With
+    subprocess_server the fake runtime runs out-of-process like the real
+    one (no shared GIL)."""
     from .testing import FakeLibtpuServer, make_sysfs
 
     workdir = Path(workdir)
     sysroot = workdir / "sys"
     if not sysroot.exists():
         make_sysfs(sysroot, num_chips=num_chips)
-    server = FakeLibtpuServer(num_chips=num_chips)
-    server.delay = rpc_delay
-    server.start()
+    server = None
+    proc = None
+    if subprocess_server:
+        spawned = _spawn_server_subprocess(num_chips, rpc_delay)
+        if spawned is not None:
+            port, proc = spawned
+    if proc is None:
+        server = FakeLibtpuServer(num_chips=num_chips)
+        server.delay = rpc_delay
+        server.start()
+        port = server.port
     try:
         collector = TpuCollector(
             sysfs_root=str(sysroot),
-            libtpu_client=LibtpuClient(ports=(server.port,), rpc_timeout=5.0),
+            libtpu_client=LibtpuClient(ports=(port,), rpc_timeout=5.0),
             use_native=True,
         )
         return measure_collector(
             collector, ticks=ticks, warmup=warmup,
-            extra={"mode": "simulated", "rpc_delay_ms": rpc_delay * 1000.0},
+            extra={
+                "mode": "simulated",
+                "rpc_delay_ms": rpc_delay * 1000.0,
+                "server_process": "subprocess" if proc else "in-process",
+            },
         )
     finally:
-        server.stop()
+        if server is not None:
+            server.stop()
+        if proc is not None:
+            proc.terminate()
+            proc.wait(timeout=5)
 
 
 def try_real_harness(*, ticks: int = 50, warmup: int = 5) -> dict | None:

@@ -129,3 +129,33 @@ class FakeLibtpuServer:
                         tpumetrics.MetricSample(metric, chip, self._value(metric, chip))
                     )
         return tpumetrics.encode_response(samples)
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised via subprocess
+    """Run a fake libtpu server standalone (bench harness runs it in a
+    separate process so GIL contention doesn't pollute latency numbers)."""
+    import argparse
+    import signal
+    import sys
+
+    parser = argparse.ArgumentParser(description="fake libtpu metric server")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--chips", type=int, default=4)
+    parser.add_argument("--delay", type=float, default=0.0)
+    args = parser.parse_args(argv)
+    server = FakeLibtpuServer(num_chips=args.chips, port=args.port)
+    server.delay = args.delay
+    server.start()
+    print(server.port, flush=True)  # parent reads the bound port
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -62,3 +62,29 @@ def test_truncated_fixed64():
 def test_unsupported_wire_type():
     with pytest.raises(ValueError):
         list(codec.iter_fields(codec.encode_varint((1 << 3) | 3)))  # start-group
+
+
+def test_fuzz_decoders_raise_only_valueerror():
+    """Arbitrary bytes from a mismatched runtime must surface as ValueError
+    (the catchable contract), never AttributeError/TypeError/IndexError."""
+    import random
+
+    from kube_gpu_stats_tpu.proto import podresources, tpumetrics
+
+    rng = random.Random(1234)
+    decoders = (
+        tpumetrics.decode_response,
+        tpumetrics.decode_request,
+        tpumetrics.decode_metric,
+        podresources.decode_list_response,
+        podresources.decode_allocatable_response,
+        podresources.decode_pod,
+        podresources.decode_container_devices,
+    )
+    for _ in range(500):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+        for decode in decoders:
+            try:
+                decode(blob)
+            except ValueError:
+                pass  # the only allowed failure type
