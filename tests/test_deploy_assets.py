@@ -141,3 +141,14 @@ def test_alert_rules_parse_and_reference_real_metrics():
         for token in METRIC_TOKEN.findall(rule["expr"]):
             assert token in known, f"alert references unknown metric {token}"
         assert rule.get("labels", {}).get("severity") in ("warning", "critical")
+
+
+def test_recording_rules_parse_and_reference_real_metrics():
+    doc = yaml.safe_load((DEPLOY / "recording_rules.yaml").read_text())
+    rules = [r for g in doc["groups"] for r in g["rules"]]
+    assert len(rules) >= 5
+    known = known_exposition_names()
+    for rule in rules:
+        assert "record" in rule and "expr" in rule
+        for token in METRIC_TOKEN.findall(rule["expr"]):
+            assert token in known, f"recording rule references unknown {token}"
