@@ -179,10 +179,25 @@ class AutoSource:
         return self._checkpoint
 
     def fetch(self) -> dict[str, Labels]:
-        return self._active().fetch()
+        # A crashed kubelet leaves its socket file behind (unix sockets are
+        # not unlinked on crash), so existence alone can't gate the choice:
+        # fall back to the checkpoint when the live fetch fails too.
+        source = self._active()
+        try:
+            return source.fetch()
+        except Exception:
+            if source is not self._checkpoint:
+                return self._checkpoint.fetch()
+            raise
 
     def fetch_allocatable(self) -> dict[str, int]:
-        return self._active().fetch_allocatable()
+        source = self._active()
+        try:
+            return source.fetch_allocatable()
+        except Exception:
+            if source is not self._checkpoint:
+                return self._checkpoint.fetch_allocatable()
+            raise
 
     def close(self) -> None:
         if self._podresources is not None:

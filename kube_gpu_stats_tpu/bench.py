@@ -73,19 +73,38 @@ def _spawn_server_subprocess(num_chips: int, rpc_delay: float):
     """Fake libtpu server in its OWN process — the real runtime doesn't
     share our GIL, so in-process serving would inflate measured latency.
     Returns (port, proc) or None if spawning fails (fall back in-process)."""
+    import select
     import subprocess
     import sys
 
+    proc = None
     try:
         proc = subprocess.Popen(
             [sys.executable, "-m", "kube_gpu_stats_tpu.testing.libtpu_server",
              "--chips", str(num_chips), "--delay", str(rpc_delay)],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         )
-        line = proc.stdout.readline().strip()
-        return int(line), proc
+        # Bounded wait for the port line: a wedged child must not hang the
+        # bench (readline alone has no timeout).
+        ready, _, _ = select.select([proc.stdout], [], [], 10.0)
+        if not ready:
+            raise TimeoutError("fake server never reported its port")
+        return int(proc.stdout.readline().strip()), proc
     except Exception:
+        if proc is not None:
+            _terminate(proc)
         return None
+
+
+def _terminate(proc) -> None:
+    import subprocess
+
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
 
 
 def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
@@ -130,8 +149,7 @@ def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
         if server is not None:
             server.stop()
         if proc is not None:
-            proc.terminate()
-            proc.wait(timeout=5)
+            _terminate(proc)
 
 
 def try_real_harness(*, ticks: int = 50, warmup: int = 5) -> dict | None:

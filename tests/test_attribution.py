@@ -278,3 +278,23 @@ def test_auto_switches_to_podresources_when_kubelet_appears(tmp_path):
         cached.refresh_once()
         assert cached.lookup(dev(0))["pod"] == "late-pod"  # switched
     cached.stop()
+
+
+def test_auto_falls_back_when_stale_socket_fetch_fails(tmp_path):
+    """A crashed kubelet leaves its socket file on disk; auto mode must
+    fall back to the checkpoint on fetch failure, not just on absence."""
+    path = tmp_path / "kubelet_internal_checkpoint"
+    path.write_text(json.dumps(checkpoint_doc()))
+    socket = str(tmp_path / "kubelet.sock")
+    # Create a stale socket file with nothing listening.
+    import socket as pysock
+
+    s = pysock.socket(pysock.AF_UNIX)
+    s.bind(socket)
+    s.close()  # file remains, no listener
+    cached = build(mode="auto", kubelet_socket=socket,
+                   checkpoint_path=str(path), refresh_interval=10.0)
+    cached.refresh_once()
+    assert cached.consecutive_failures == 0
+    assert cached.lookup(dev(0))["pod"] == "uid-1234"  # via checkpoint
+    cached.stop()
