@@ -85,6 +85,28 @@ def field_string(field: int, value: str) -> bytes:
     return field_bytes(field, value.encode("utf-8"))
 
 
+def skip_field(data: bytes, pos: int, wire_type: int) -> int:
+    """Advance past one field's value (unknown-field tolerance for manual
+    single-pass parsers). Returns the new position."""
+    if wire_type == VARINT:
+        _, pos = decode_varint(data, pos)
+        return pos
+    if wire_type == FIXED64:
+        if pos + 8 > len(data):
+            raise ValueError("truncated fixed64")
+        return pos + 8
+    if wire_type == LENGTH:
+        length, pos = decode_varint(data, pos)
+        if pos + length > len(data):
+            raise ValueError("truncated length-delimited field")
+        return pos + length
+    if wire_type == FIXED32:
+        if pos + 4 > len(data):
+            raise ValueError("truncated fixed32")
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wire_type}")
+
+
 def iter_fields(data: bytes) -> Iterator[tuple[int, int, object]]:
     """Yield (field_number, wire_type, raw_value) skipping nothing; callers
     ignore field numbers they don't know."""

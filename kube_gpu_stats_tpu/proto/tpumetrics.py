@@ -27,7 +27,8 @@ Wire schema (proto3):
 
 from __future__ import annotations
 
-import dataclasses
+import struct
+from typing import NamedTuple
 
 from . import codec
 
@@ -48,8 +49,10 @@ ALL_METRICS = (DUTY_CYCLE, TC_UTIL, HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVE
 INT_METRICS = frozenset({HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVES})
 
 
-@dataclasses.dataclass(frozen=True)
-class MetricSample:
+class MetricSample(NamedTuple):
+    # NamedTuple, not frozen dataclass: a batched tick decodes ~100 of
+    # these and frozen-dataclass construction (object.__setattr__ per
+    # field) was measurable on the poll hot path.
     name: str
     device_id: int
     value: float | int
@@ -85,31 +88,60 @@ def encode_metric(sample: MetricSample) -> bytes:
     return out
 
 
-def decode_metric(data: bytes) -> MetricSample:
+def decode_metric(data: bytes, _start: int = 0, _end: int | None = None
+                  ) -> MetricSample:
+    """Parse one Metric. The manual single-pass loop (rather than
+    codec.iter_fields) and the _start/_end window exist because a batched
+    tick decodes ~100 of these inside the latency budget; iter_fields'
+    per-field generator overhead and per-message bytes slicing were
+    measurable. Wire-type mismatches (a future runtime encoding a field
+    differently) must surface as ValueError — the "runtime speaking a
+    different schema" contract the client catches."""
     name = ""
     device_id = 0
     double_value: float | None = None
     int_value: int | None = None
     timestamp_ns = 0
     link = ""
-    # Wire-type mismatches (a future runtime encoding a field differently)
-    # must surface as ValueError — the "runtime speaking a different schema"
-    # contract the client catches — not AttributeError/TypeError.
+    pos = _start
+    end = len(data) if _end is None else _end
+    decode_varint = codec.decode_varint
     try:
-        for field, _, value in codec.iter_fields(data):
-            if field == 1:
-                name = value.decode("utf-8")
-            elif field == 2:
-                device_id = codec.signed(value)
-            elif field == 3:
-                double_value = float(value)
-            elif field == 4:
-                int_value = codec.signed(value)
-            elif field == 5:
-                timestamp_ns = codec.signed(value)
-            elif field == 6:
-                link = value.decode("utf-8")
-    except (AttributeError, TypeError, UnicodeDecodeError) as exc:
+        while pos < end:
+            key, pos = decode_varint(data, pos)
+            field, wire_type = key >> 3, key & 0x07
+            if wire_type == codec.VARINT:
+                raw, pos = decode_varint(data, pos)
+                if field == 2:
+                    device_id = raw - (1 << 64) if raw >= 1 << 63 else raw
+                elif field == 4:
+                    int_value = raw - (1 << 64) if raw >= 1 << 63 else raw
+                elif field == 5:
+                    timestamp_ns = raw - (1 << 64) if raw >= 1 << 63 else raw
+                elif field in (1, 6):
+                    raise ValueError(f"field {field} has varint wire type")
+            elif wire_type == codec.LENGTH:
+                length, pos = decode_varint(data, pos)
+                if pos + length > end:
+                    raise ValueError("truncated length-delimited field")
+                if field == 1:
+                    name = data[pos:pos + length].decode("utf-8")
+                elif field == 6:
+                    link = data[pos:pos + length].decode("utf-8")
+                elif field in (2, 3, 4, 5):
+                    raise ValueError(f"field {field} has length wire type")
+                pos += length
+            elif wire_type == codec.FIXED64:
+                if pos + 8 > end:
+                    raise ValueError("truncated fixed64")
+                if field == 3:
+                    double_value = struct.unpack_from("<d", data, pos)[0]
+                elif field != 0:
+                    raise ValueError(f"field {field} has fixed64 wire type")
+                pos += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire_type}")
+    except UnicodeDecodeError as exc:
         raise ValueError(f"wire-type mismatch in Metric: {exc}") from exc
     value_out: float | int
     if int_value is not None:
@@ -127,11 +159,23 @@ def encode_response(samples: list[MetricSample]) -> bytes:
 
 def decode_response(data: bytes) -> list[MetricSample]:
     out = []
-    for field, wire_type, value in codec.iter_fields(data):
+    pos = 0
+    end = len(data)
+    decode_varint = codec.decode_varint
+    while pos < end:
+        key, pos = decode_varint(data, pos)
+        field, wire_type = key >> 3, key & 0x07
         if field == 1:
             if wire_type != codec.LENGTH:
                 raise ValueError(
                     f"MetricResponse.metrics has wire type {wire_type}"
                 )
-            out.append(decode_metric(value))
+            length, pos = decode_varint(data, pos)
+            if pos + length > end:
+                raise ValueError("truncated Metric")
+            # Decode in place — no per-message bytes copy.
+            out.append(decode_metric(data, pos, pos + length))
+            pos += length
+        else:
+            pos = codec.skip_field(data, pos, wire_type)
     return out
