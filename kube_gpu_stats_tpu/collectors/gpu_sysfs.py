@@ -1,0 +1,109 @@
+"""NVML-free GPU collector over /sys/class/drm + hwmon (extends C12).
+
+docs/UNIFIED_SCHEMA.md's relabel recipe converges *existing* GPU exporters
+onto the accelerator_* schema; this collector makes mixed clusters a
+single-binary story where the kernel driver exposes telemetry through
+sysfs — the amdgpu layout (gpu_busy_percent, mem_info_vram_*, hwmon
+power/temp) and any driver following the same conventions. Zero NVML
+symbols, preserving the BASELINE.md binary constraint: on NVIDIA nodes
+without such sysfs files the collector simply discovers the cards and
+exports what's readable (attribution still works via PodResources).
+
+Layout read per card (all optional, missing => gauge omitted):
+
+    /sys/class/drm/card<N>/device/gpu_busy_percent      -> duty cycle (%)
+    /sys/class/drm/card<N>/device/mem_info_vram_used    -> memory used (B)
+    /sys/class/drm/card<N>/device/mem_info_vram_total   -> memory total (B)
+    /sys/class/drm/card<N>/device/hwmon/hwmon*/power1_average -> power (uW)
+    /sys/class/drm/card<N>/device/hwmon/hwmon*/temp1_input    -> temp (mC)
+    /sys/class/drm/card<N>/device/unique_id             -> uuid
+    /sys/class/drm/card<N>/device/vendor                -> accel_type
+"""
+
+from __future__ import annotations
+
+import glob
+import re
+from pathlib import Path
+from typing import Sequence
+
+from . import Collector, CollectorError, Device, Sample
+from .. import schema
+
+_CARD_RE = re.compile(r"card(\d+)$")
+
+_VENDORS = {
+    "0x1002": "gpu-amd",
+    "0x10de": "gpu-nvidia",
+    "0x8086": "gpu-intel",
+}
+
+# (metric, relative candidates, scale)
+_ATTRIBUTES = (
+    (schema.DUTY_CYCLE.name, ("device/gpu_busy_percent",), 1.0),
+    (schema.MEMORY_USED.name, ("device/mem_info_vram_used",), 1.0),
+    (schema.MEMORY_TOTAL.name, ("device/mem_info_vram_total",), 1.0),
+    (schema.POWER.name, ("device/hwmon/hwmon*/power1_average",), 1e-6),
+    (schema.TEMPERATURE.name, ("device/hwmon/hwmon*/temp1_input",), 1e-3),
+)
+
+
+def _read_first(card_dir: Path, patterns, scale: float) -> float | None:
+    for pattern in patterns:
+        for path in sorted(glob.glob(str(card_dir / pattern))):
+            try:
+                return float(Path(path).read_text().strip()) * scale
+            except (OSError, ValueError):
+                continue
+    return None
+
+
+class GpuSysfsCollector(Collector):
+    name = "gpu-sysfs"
+
+    def __init__(self, sysfs_root: str = "/sys") -> None:
+        self._root = Path(sysfs_root)
+
+    def _card_dir(self, device: Device) -> Path:
+        return self._root / "class" / "drm" / f"card{device.index}"
+
+    def discover(self) -> Sequence[Device]:
+        devices = []
+        for path in sorted(glob.glob(str(self._root / "class" / "drm" / "card*"))):
+            match = _CARD_RE.search(path)
+            if not match:  # skips card0-DP-1 style connector nodes
+                continue
+            index = int(match.group(1))
+            card = Path(path)
+            vendor = ""
+            try:
+                vendor = (card / "device" / "vendor").read_text().strip().lower()
+            except OSError:
+                pass
+            uuid = ""
+            try:
+                uuid = (card / "device" / "unique_id").read_text().strip()
+            except OSError:
+                pass
+            devices.append(
+                Device(
+                    index=index,
+                    device_id=str(index),
+                    device_path=f"/dev/dri/card{index}",
+                    accel_type=_VENDORS.get(vendor, "gpu"),
+                    uuid=uuid,
+                )
+            )
+        devices.sort(key=lambda d: d.index)
+        return devices
+
+    def sample(self, device: Device) -> Sample:
+        card = self._card_dir(device)
+        if not card.exists():
+            raise CollectorError(f"{card} vanished")
+        values: dict[str, float] = {}
+        for metric, patterns, scale in _ATTRIBUTES:
+            value = _read_first(card, patterns, scale)
+            if value is not None:
+                values[metric] = value
+        return Sample(device=device, values=values)

@@ -18,7 +18,7 @@ DEFAULT_KUBELET_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
 DEFAULT_CHECKPOINT = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
 DEFAULT_LIBTPU_PORT = 8431  # TPU_RUNTIME_METRICS_PORTS default (SURVEY.md §2 C11)
 
-BACKENDS = ("auto", "tpu", "mock", "null")
+BACKENDS = ("auto", "tpu", "gpu", "mock", "null")
 
 
 @dataclasses.dataclass
@@ -70,7 +70,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--backend", choices=BACKENDS,
                    default=_env("BACKEND", "auto"),
-                   help="device backend; auto probes tpu then falls back to null")
+                   help="device backend; auto probes tpu, then gpu sysfs, "
+                        "then falls back to null")
     p.add_argument("--interval", type=float,
                    default=float(_env("INTERVAL", "1.0")),
                    help="poll interval seconds (default 1.0 = 1 Hz)")

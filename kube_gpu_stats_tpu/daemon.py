@@ -37,14 +37,29 @@ def build_collector(cfg: Config) -> Collector:
         return NullCollector()
     if cfg.backend == "tpu":
         return _tpu_collector(cfg)
-    # auto: TPU when present, else a schema-valid null exporter
-    # (BASELINE.json configs[0] behavior on CPU-only nodes).
+    if cfg.backend == "gpu":
+        return _gpu_collector(cfg)
+    # auto: TPU when present, else sysfs-exposed GPUs (C12 single-binary
+    # mixed clusters), else a schema-valid null exporter (BASELINE.json
+    # configs[0] behavior on CPU-only nodes).
     try:
         if detect_tpu(cfg):
             return _tpu_collector(cfg)
     except Exception as exc:
-        log.warning("TPU probe failed (%s); falling back to null backend", exc)
+        log.warning("TPU probe failed (%s); trying gpu backend", exc)
+    try:
+        gpu = _gpu_collector(cfg)
+        if gpu.discover():
+            return gpu
+    except Exception as exc:
+        log.warning("GPU probe failed (%s); falling back to null backend", exc)
     return NullCollector()
+
+
+def _gpu_collector(cfg: Config) -> Collector:
+    from .collectors.gpu_sysfs import GpuSysfsCollector
+
+    return GpuSysfsCollector(sysfs_root=cfg.sysfs_root)
 
 
 def _tpu_collector(cfg: Config) -> Collector:

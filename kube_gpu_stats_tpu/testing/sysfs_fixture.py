@@ -30,3 +30,35 @@ def make_sysfs(
             (hwmon / "power1_average").write_text(f"{power_uw + i * 1_000_000}\n")
             (hwmon / "temp1_input").write_text(f"{temp_mc + i * 500}\n")
     return root
+
+
+def make_drm_sysfs(
+    root: Path,
+    num_cards: int = 2,
+    vendor: str = "0x1002",
+    busy_percent: int = 37,
+    vram_used: int = 4 * 1024**3,
+    vram_total: int = 16 * 1024**3,
+    power_uw: int = 180_000_000,
+    temp_mc: int = 61_000,
+    with_connector_nodes: bool = True,
+) -> Path:
+    """Create `<root>/class/drm/cardN/...` mimicking an amdgpu-style node
+    (for the NVML-free GPU collector)."""
+    drm = root / "class" / "drm"
+    for i in range(num_cards):
+        device = drm / f"card{i}" / "device"
+        device.mkdir(parents=True)
+        (device / "vendor").write_text(f"{vendor}\n")
+        (device / "unique_id").write_text(f"gpu-uid-{i:04d}\n")
+        (device / "gpu_busy_percent").write_text(f"{busy_percent + i}\n")
+        (device / "mem_info_vram_used").write_text(f"{vram_used + i * 1024**3}\n")
+        (device / "mem_info_vram_total").write_text(f"{vram_total}\n")
+        hwmon = device / "hwmon" / "hwmon1"
+        hwmon.mkdir(parents=True)
+        (hwmon / "power1_average").write_text(f"{power_uw + i * 5_000_000}\n")
+        (hwmon / "temp1_input").write_text(f"{temp_mc + i * 1000}\n")
+        if with_connector_nodes:
+            # Connector nodes like card0-DP-1 must be skipped by discovery.
+            (drm / f"card{i}-DP-1").mkdir(parents=True, exist_ok=True)
+    return root

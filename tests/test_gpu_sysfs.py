@@ -1,0 +1,85 @@
+"""NVML-free GPU collector over /sys/class/drm fixtures (C12 single-binary
+mixed clusters)."""
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import CollectorError
+from kube_gpu_stats_tpu.collectors.gpu_sysfs import GpuSysfsCollector
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_drm_sysfs
+
+
+def test_discovery_skips_connector_nodes(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=2)
+    col = GpuSysfsCollector(tmp_path)
+    devs = col.discover()
+    assert [d.index for d in devs] == [0, 1]
+    assert devs[0].accel_type == "gpu-amd"
+    assert devs[0].device_path == "/dev/dri/card0"
+    assert devs[1].uuid == "gpu-uid-0001"
+
+
+def test_vendor_mapping(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=1, vendor="0x10de")
+    assert GpuSysfsCollector(tmp_path).discover()[0].accel_type == "gpu-nvidia"
+    make_drm_sysfs(tmp_path / "intel", num_cards=1, vendor="0x8086")
+    assert GpuSysfsCollector(tmp_path / "intel").discover()[0].accel_type == "gpu-intel"
+
+
+def test_sample_values_and_scaling(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=1, busy_percent=42,
+                   power_uw=200_000_000, temp_mc=65_500)
+    col = GpuSysfsCollector(tmp_path)
+    s = col.sample(col.discover()[0])
+    assert s.values[schema.DUTY_CYCLE.name] == 42.0
+    assert s.values[schema.MEMORY_USED.name] == 4 * 1024**3
+    assert s.values[schema.MEMORY_TOTAL.name] == 16 * 1024**3
+    assert s.values[schema.POWER.name] == pytest.approx(200.0)
+    assert s.values[schema.TEMPERATURE.name] == pytest.approx(65.5)
+
+
+def test_partial_attributes(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=1)
+    (tmp_path / "class/drm/card0/device/gpu_busy_percent").unlink()
+    col = GpuSysfsCollector(tmp_path)
+    s = col.sample(col.discover()[0])
+    assert schema.DUTY_CYCLE.name not in s.values
+    assert schema.POWER.name in s.values
+
+
+def test_vanished_card_raises(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=1)
+    col = GpuSysfsCollector(tmp_path)
+    dev = col.discover()[0]
+    import shutil
+
+    shutil.rmtree(tmp_path / "class/drm/card0")
+    with pytest.raises(CollectorError):
+        col.sample(dev)
+
+
+def test_daemon_auto_prefers_tpu_then_gpu(tmp_path):
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import build_collector
+
+    # GPU-only node: auto lands on gpu-sysfs.
+    make_drm_sysfs(tmp_path, num_cards=2)
+    cfg = Config(backend="auto", sysfs_root=str(tmp_path), use_native=False)
+    col = build_collector(cfg)
+    assert col.name == "gpu-sysfs"
+    assert len(col.discover()) == 2
+
+
+def test_gpu_through_poll_loop(tmp_path):
+    from kube_gpu_stats_tpu.poll import PollLoop
+    from kube_gpu_stats_tpu.registry import Registry
+
+    make_drm_sysfs(tmp_path, num_cards=2)
+    reg = Registry()
+    loop = PollLoop(GpuSysfsCollector(tmp_path), reg, deadline=5.0)
+    loop.tick()
+    snap = reg.snapshot()
+    duty = [s for s in snap.series if s.spec.name == schema.DUTY_CYCLE.name]
+    assert len(duty) == 2
+    assert dict(duty[0].labels)["accel_type"] == "gpu-amd"
+    loop.stop()
