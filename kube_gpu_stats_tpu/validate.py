@@ -1,0 +1,161 @@
+"""Exposition-contract validator: `python -m kube_gpu_stats_tpu.validate
+<url-or-file>`.
+
+Checks a live scrape (or saved .prom file) against the schema contract
+(schema.py + docs/UNIFIED_SCHEMA.md): every accelerator_* series carries
+the full label set, types/ranges are sane, and — given two scrapes —
+counters are monotone. Exit code 0 = conformant, 1 = violations (printed
+one per line), 2 = usage/fetch error. Useful for CI of deployments and for
+third-party exporters converging on the unified schema.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import urllib.request
+from typing import Iterable
+
+from . import schema
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_RANGES = {
+    schema.DUTY_CYCLE.name: (0.0, 100.0),
+    schema.TENSORCORE_UTIL.name: (0.0, 100.0),
+    schema.DEVICE_UP.name: (0.0, 1.0),
+    schema.TEMPERATURE.name: (-50.0, 150.0),
+}
+
+
+def parse_exposition(text: str) -> list[tuple[str, dict[str, str], float]]:
+    """(name, labels, value) triples; raises ValueError on malformed lines."""
+    out = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _SERIES_RE.match(line)
+        if not match:
+            raise ValueError(f"line {lineno}: unparseable series: {line!r}")
+        labels = dict(_LABEL_RE.findall(match.group("labels") or ""))
+        raw = match.group("value")
+        value = {"NaN": float("nan"), "+Inf": float("inf"),
+                 "-Inf": float("-inf")}.get(raw)
+        if value is None:
+            value = float(raw)
+        out.append((match.group("name"), labels, value))
+    return out
+
+
+def check(text: str, previous: str | None = None) -> list[str]:
+    """Return violations (empty = conformant)."""
+    problems: list[str] = []
+    try:
+        series = parse_exposition(text)
+    except ValueError as exc:
+        return [str(exc)]
+
+    specs = {m.name: m for m in schema.ALL_METRICS}
+    required = set(schema.ALL_BASE_LABELS)
+    seen_identities: set[tuple] = set()
+    for name, labels, value in series:
+        if name.startswith("accelerator_"):
+            spec = specs.get(name)
+            if spec is None:
+                problems.append(f"{name}: not in the accelerator_* contract")
+                continue
+            missing = required - set(labels)
+            if missing:
+                problems.append(
+                    f"{name}: missing labels {sorted(missing)} (empty-string "
+                    f"values are required, absent labels are not allowed)"
+                )
+            extra_expected = set(spec.extra_labels)
+            extra_present = set(labels) - required
+            if not extra_expected >= extra_present:
+                problems.append(
+                    f"{name}: unexpected labels "
+                    f"{sorted(extra_present - extra_expected)}"
+                )
+            lo_hi = _RANGES.get(name)
+            if lo_hi and not (lo_hi[0] <= value <= lo_hi[1]):
+                problems.append(f"{name}{labels}: value {value} outside {lo_hi}")
+            if spec.type is schema.MetricType.COUNTER and value < 0:
+                problems.append(f"{name}{labels}: negative counter")
+            identity = (name, tuple(sorted(labels.items())))
+            if identity in seen_identities:
+                problems.append(f"{name}: duplicate series {labels}")
+            seen_identities.add(identity)
+
+    if previous is not None:
+        problems.extend(_check_monotone(previous, text, specs))
+    return problems
+
+
+def _check_monotone(before: str, after: str, specs) -> Iterable[str]:
+    def counters(text):
+        return {
+            (name, tuple(sorted(labels.items()))): value
+            for name, labels, value in parse_exposition(text)
+            if specs.get(name) is not None
+            and specs[name].type is schema.MetricType.COUNTER
+        }
+
+    earlier = counters(before)
+    problems = []
+    for key, value in counters(after).items():
+        if key in earlier and value < earlier[key]:
+            problems.append(
+                f"{key[0]}: counter went backwards "
+                f"({earlier[key]} -> {value}) for {dict(key[1])}"
+            )
+    return problems
+
+
+def _fetch(target: str) -> str:
+    if target.startswith(("http://", "https://")):
+        with urllib.request.urlopen(target, timeout=10) as resp:
+            return resp.read().decode()
+    with open(target) as f:
+        return f.read()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    two_scrapes = "--two-scrapes" in args
+    if two_scrapes:
+        args.remove("--two-scrapes")
+    if len(args) != 1:
+        print("usage: python -m kube_gpu_stats_tpu.validate [--two-scrapes] "
+              "<http://host:9400/metrics | file.prom>", file=sys.stderr)
+        return 2
+    target = args[0]
+    try:
+        first = _fetch(target)
+        previous = None
+        if two_scrapes:
+            import time
+
+            previous = first
+            time.sleep(1.5)
+            first = _fetch(target)
+    except OSError as exc:
+        print(f"fetch failed: {exc}", file=sys.stderr)
+        return 2
+    problems = check(first, previous)
+    for problem in problems:
+        print(problem)
+    if not problems:
+        count = sum(1 for line in first.splitlines()
+                    if line and not line.startswith("#"))
+        print(f"ok: {count} series conform to the accelerator_* contract")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Exposition-contract validator: our own exporter must pass it, and it
+must catch the violations it exists to catch."""
+
+from kube_gpu_stats_tpu import validate
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+
+def render_ticks(n=1):
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    texts = []
+    for _ in range(n):
+        loop.tick()
+        texts.append(reg.snapshot().render())
+    loop.stop()
+    return texts
+
+
+def test_own_exposition_conforms():
+    (text,) = render_ticks()
+    assert validate.check(text) == []
+
+
+def test_monotone_counters_across_ticks():
+    first, second = render_ticks(2)
+    assert validate.check(second, previous=first) == []
+
+
+def test_counter_regression_detected():
+    first, second = render_ticks(2)
+    # Feed the scrapes in reverse order: counters appear to go backwards.
+    problems = validate.check(first, previous=second)
+    assert any("went backwards" in p for p in problems)
+
+
+def test_missing_label_detected():
+    bad = 'accelerator_duty_cycle{chip="0"} 50\n'
+    problems = validate.check(bad)
+    assert any("missing labels" in p for p in problems)
+
+
+def test_unknown_family_detected():
+    (text,) = render_ticks()
+    bad = text + (
+        "accelerator_bogus_metric"
+        '{accel_type="",chip="",device_path="",uuid="",pod="",namespace="",'
+        'container="",slice="",worker="",topology=""} 1\n'
+    )
+    problems = validate.check(bad)
+    assert any("not in the accelerator_* contract" in p for p in problems)
+
+
+def test_out_of_range_detected():
+    (text,) = render_ticks()
+    bad = text.replace(
+        "accelerator_duty_cycle{", "accelerator_duty_cycle{", 1
+    )
+    line = next(l for l in text.splitlines()
+                if l.startswith("accelerator_duty_cycle{"))
+    bad = text.replace(line, line.rsplit(" ", 1)[0] + " 150")
+    problems = validate.check(bad)
+    assert any("outside" in p for p in problems)
+
+
+def test_duplicate_series_detected():
+    (text,) = render_ticks()
+    line = next(l for l in text.splitlines()
+                if l.startswith("accelerator_duty_cycle{"))
+    problems = validate.check(text + line + "\n")
+    assert any("duplicate series" in p for p in problems)
+
+
+def test_malformed_line_is_a_violation():
+    assert validate.check("accelerator_duty_cycle{chip=0} nope") != []
+
+
+def test_cli_against_file(tmp_path, capsys):
+    (text,) = render_ticks()
+    path = tmp_path / "scrape.prom"
+    path.write_text(text)
+    assert validate.main([str(path)]) == 0
+    assert "ok:" in capsys.readouterr().out
+    path.write_text('accelerator_duty_cycle{chip="0"} 50\n')
+    assert validate.main([str(path)]) == 1
