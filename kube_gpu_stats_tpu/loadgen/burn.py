@@ -159,6 +159,15 @@ def main(argv=None) -> int:
     parser.add_argument("--size", type=int, default=2048,
                         help="matmul dimension (multiple of 128 for the MXU)")
     parser.add_argument("--kernel", choices=("xla", "pallas"), default="xla")
+    parser.add_argument("--mode", choices=("mxu", "ici"), default="mxu",
+                        help="mxu: matmul burn; ici: ring-permute burn that "
+                             "drives inter-chip traffic (C10 validation)")
+    parser.add_argument("--shard-mb", type=float, default=4.0)
     args = parser.parse_args(argv)
-    run_burn(args.seconds, args.size, kernel=args.kernel)
+    if args.mode == "ici":
+        from .ici_burn import run_ici_burn
+
+        run_ici_burn(args.seconds, shard_mb=args.shard_mb)
+    else:
+        run_burn(args.seconds, args.size, kernel=args.kernel)
     return 0

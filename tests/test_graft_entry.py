@@ -41,3 +41,24 @@ def test_loadgen_burn_runs_briefly():
 
     steps = run_burn(seconds=0.5, size=128, report_every=10.0)
     assert steps >= 1
+
+
+def test_ici_ring_burn_numerics():
+    """Ring rotation on the 8-device CPU mesh: after `steps` hops each
+    shard holds the shard from `steps` positions back, plus `steps`."""
+    import numpy as np
+
+    from kube_gpu_stats_tpu.loadgen.ici_burn import make_ici_burn
+
+    n, steps = 8, 3
+    fn, x = make_ici_burn(n, shard_mb=0.001, steps=steps)
+    out = np.asarray(fn(x))
+    original = np.asarray(x).reshape(n, -1)
+    rotated = np.roll(original, steps, axis=0) + steps
+    np.testing.assert_allclose(out.reshape(n, -1), rotated)
+
+
+def test_ici_burn_runs_briefly():
+    from kube_gpu_stats_tpu.loadgen.ici_burn import run_ici_burn
+
+    assert run_ici_burn(0.3, n_devices=4, shard_mb=0.001, steps=2) >= 1
