@@ -29,6 +29,8 @@ class Config:
     listen_host: str = "0.0.0.0"
     listen_port: int = 9400
     textfile_dir: str = ""  # empty = textfile output disabled
+    pushgateway_url: str = ""  # empty = push disabled
+    pushgateway_job: str = "kube-tpu-stats"
     sysfs_root: str = "/sys"
     libtpu_ports: tuple[int, ...] = (DEFAULT_LIBTPU_PORT,)
     libtpu_addr: str = "127.0.0.1"
@@ -83,6 +85,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(_env("LISTEN_PORT", "9400")))
     p.add_argument("--textfile-dir", default=_env("TEXTFILE_DIR", ""),
                    help="node_exporter textfile dir; empty disables")
+    p.add_argument("--pushgateway-url", default=_env("PUSHGATEWAY_URL", ""),
+                   help="Prometheus Pushgateway base URL; empty disables")
+    p.add_argument("--pushgateway-job",
+                   default=_env("PUSHGATEWAY_JOB", "kube-tpu-stats"))
     p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"))
     p.add_argument("--libtpu-addr", default=_env("LIBTPU_ADDR", "127.0.0.1"))
     p.add_argument("--libtpu-ports",
@@ -120,6 +126,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         listen_host=args.listen_host,
         listen_port=args.listen_port,
         textfile_dir=args.textfile_dir,
+        pushgateway_url=args.pushgateway_url,
+        pushgateway_job=args.pushgateway_job,
         sysfs_root=args.sysfs_root,
         libtpu_addr=args.libtpu_addr,
         libtpu_ports=parse_libtpu_ports(args.libtpu_ports),

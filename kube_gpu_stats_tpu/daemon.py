@@ -16,7 +16,7 @@ from . import __version__, topology
 from .config import Config
 from .collectors import Collector
 from .collectors.mock import MockCollector, NullCollector
-from .exposition import MetricsServer, TextfileWriter
+from .exposition import MetricsServer, PushgatewayPusher, TextfileWriter
 from .poll import AttributionProvider, NullAttribution, PollLoop
 from .registry import Registry
 
@@ -123,6 +123,12 @@ class Daemon:
             if cfg.textfile_enabled
             else None
         )
+        self.pusher = (
+            PushgatewayPusher(self.registry, cfg.pushgateway_url,
+                              job=cfg.pushgateway_job)
+            if cfg.pushgateway_url
+            else None
+        )
 
     def start(self) -> None:
         starter = getattr(self.attribution, "start", None)
@@ -131,6 +137,8 @@ class Daemon:
         self.server.start()
         if self.textfile:
             self.textfile.start()
+        if self.pusher:
+            self.pusher.start()
         self.poll.start()
         log.info(
             "kube-tpu-stats %s: backend=%s devices=%d listening on %s:%d",
@@ -142,6 +150,8 @@ class Daemon:
         self.poll.stop()
         if self.textfile:
             self.textfile.stop()
+        if self.pusher:
+            self.pusher.stop()
         self.server.stop()
         stopper = getattr(self.attribution, "stop", None)
         if stopper:

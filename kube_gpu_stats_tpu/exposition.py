@@ -127,6 +127,72 @@ class MetricsServer:
             self._thread.join(timeout=5)
 
 
+class PushgatewayPusher:
+    """Pushes each published snapshot to a Prometheus Pushgateway
+    (PUT <url>/metrics/job/<job>/instance/<instance>) — exposition mode #3
+    for nodes/jobs that Prometheus can't scrape directly. Mirrors the
+    TextfileWriter's publish-following loop; push failures are logged and
+    retried on the next publish (never fatal)."""
+
+    def __init__(self, registry: Registry, url: str, job: str = "kube-tpu-stats",
+                 instance: str = "", min_interval: float = 1.0) -> None:
+        import socket
+        import urllib.parse
+
+        self._registry = registry
+        instance = instance or socket.gethostname()
+        self._target = (
+            url.rstrip("/")
+            + "/metrics/job/" + urllib.parse.quote(job, safe="")
+            + "/instance/" + urllib.parse.quote(instance, safe="")
+        )
+        self._min_interval = min_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.consecutive_failures = 0
+
+    def push_once(self) -> None:
+        import urllib.request
+
+        body = self._registry.snapshot().render().encode()
+        request = urllib.request.Request(
+            self._target, data=body, method="PUT",
+            headers={"Content-Type": CONTENT_TYPE},
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=10):
+                pass
+            self.consecutive_failures = 0
+        except Exception as exc:
+            self.consecutive_failures += 1
+            log.warning("pushgateway push failed (%d consecutive): %s",
+                        self.consecutive_failures, exc)
+
+    def run_forever(self) -> None:
+        import time
+
+        generation = self._registry.generation
+        last_push = 0.0
+        while not self._stop.is_set():
+            if self._registry.wait_for_publish(generation, timeout=0.5):
+                generation = self._registry.generation
+                now = time.monotonic()
+                if now - last_push >= self._min_interval:
+                    self.push_once()
+                    last_push = now
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, name="pushgateway", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
 class TextfileWriter:
     """Writes the snapshot to `<dir>/accelerator.prom` atomically.
 

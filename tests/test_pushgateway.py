@@ -1,0 +1,111 @@
+"""Pushgateway exposition mode against a fake gateway HTTP server."""
+
+import http.server
+import threading
+import time
+
+import pytest
+
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.exposition import CONTENT_TYPE, PushgatewayPusher
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+
+class FakeGateway:
+    def __init__(self):
+        self.requests = []
+        self.fail = False
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                outer.requests.append(
+                    (self.path, self.headers.get("Content-Type"), body)
+                )
+                self.send_response(500 if outer.fail else 200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._server.server_address[1]}"
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+
+
+@pytest.fixture
+def gateway():
+    g = FakeGateway()
+    yield g
+    g.stop()
+
+
+def test_push_once_target_and_body(gateway):
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    pusher = PushgatewayPusher(reg, gateway.url, job="tpu job",
+                               instance="node-1")
+    pusher.push_once()
+    loop.stop()
+    (path, content_type, body) = gateway.requests[0]
+    assert path == "/metrics/job/tpu%20job/instance/node-1"
+    assert content_type == CONTENT_TYPE
+    assert b"accelerator_duty_cycle" in body
+    assert pusher.consecutive_failures == 0
+
+
+def test_push_failure_counted_not_fatal(gateway):
+    reg = Registry()
+    gateway.fail = True
+    pusher = PushgatewayPusher(reg, gateway.url, instance="n")
+    pusher.push_once()
+    assert pusher.consecutive_failures == 1
+    gateway.fail = False
+    pusher.push_once()
+    assert pusher.consecutive_failures == 0
+
+
+def test_follows_publishes(gateway):
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, interval=0.03,
+                    deadline=5.0)
+    pusher = PushgatewayPusher(reg, gateway.url, instance="n",
+                               min_interval=0.0)
+    pusher.start()
+    loop.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(gateway.requests) < 3:
+            time.sleep(0.02)
+        assert len(gateway.requests) >= 3
+    finally:
+        loop.stop()
+        pusher.stop()
+
+
+def test_daemon_wiring(gateway, monkeypatch):
+    from kube_gpu_stats_tpu.config import Config, from_args
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    cfg = from_args(["--backend", "mock", "--listen-port", "0",
+                     "--pushgateway-url", gateway.url,
+                     "--attribution", "off", "--interval", "0.05"])
+    assert cfg.pushgateway_url == gateway.url
+    d = Daemon(cfg)
+    d.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not gateway.requests:
+            time.sleep(0.02)
+        assert gateway.requests
+        assert gateway.requests[0][0].startswith("/metrics/job/kube-tpu-stats/")
+    finally:
+        d.stop()
