@@ -39,6 +39,7 @@ class Config:
     checkpoint_path: str = DEFAULT_CHECKPOINT
     attribution_interval: float = 10.0
     rediscovery_interval: float = 60.0  # 0 disables hotplug re-enumeration
+    drop_labels: tuple[str, ...] = ()  # label keys emitted as "" (cardinality)
     mock_devices: int = 4
     use_native: bool = True  # C++ fast path when the shared lib is present
     log_level: str = "info"
@@ -108,6 +109,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rediscovery-interval", type=float,
                    default=float(_env("REDISCOVERY_INTERVAL", "60.0")),
                    help="device re-enumeration cadence seconds; 0 disables")
+    p.add_argument("--drop-labels", default=_env("DROP_LABELS", ""),
+                   help="comma-separated label keys to blank out (emitted as "
+                        "empty strings for cardinality control, e.g. "
+                        "'pod,namespace,container'); the label SET stays "
+                        "stable so series identity never churns")
     p.add_argument("--mock-devices", type=int,
                    default=int(_env("MOCK_DEVICES", "4")))
     p.add_argument("--no-native", action="store_true",
@@ -136,6 +142,9 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         checkpoint_path=args.checkpoint_path,
         attribution_interval=args.attribution_interval,
         rediscovery_interval=args.rediscovery_interval,
+        drop_labels=tuple(
+            key.strip() for key in args.drop_labels.split(",") if key.strip()
+        ),
         mock_devices=args.mock_devices,
         use_native=not args.no_native,
         log_level=args.log_level,

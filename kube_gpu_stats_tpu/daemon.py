@@ -111,6 +111,7 @@ class Daemon:
             topology_labels=topology.topology_labels(use_metadata=True),
             version=__version__,
             rediscovery_interval=cfg.rediscovery_interval,
+            drop_labels=cfg.drop_labels,
         )
         self.server = MetricsServer(
             self.registry, cfg.listen_host, cfg.listen_port,

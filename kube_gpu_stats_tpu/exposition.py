@@ -71,6 +71,16 @@ class MetricsServer:
                         body = b"ok\n"
                         self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                elif path == "/readyz":
+                    # Readiness = at least one snapshot has been published
+                    # (liveness/staleness is /healthz's job).
+                    if outer._registry.snapshot().timestamp > 0:
+                        body = b"ready\n"
+                        self.send_response(200)
+                    else:
+                        body = b"no snapshot published yet\n"
+                        self.send_response(503)
+                    self.send_header("Content-Type", "text/plain")
                 elif path == "/debug/threads":
                     # pprof analog (SURVEY.md §5): live stack dump of every
                     # thread — enough to diagnose a wedged sampler or a

@@ -58,6 +58,7 @@ class PollLoop:
         version: str = "dev",
         rediscovery_interval: float = 60.0,
         process_metrics: bool = True,
+        drop_labels: Sequence[str] = (),
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -69,6 +70,10 @@ class PollLoop:
         self._version = version
         self._rediscovery_interval = rediscovery_interval
         self._process_metrics = process_metrics
+        # Cardinality control (C6 "label allowlist" analog): listed keys are
+        # emitted as "" rather than removed — the label SET stays constant
+        # so series identity is stable regardless of operator config.
+        self._drop_labels = frozenset(drop_labels)
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -227,6 +232,11 @@ class PollLoop:
             labels.append((key, attribution.get(key, "")))
         for key in schema.TOPOLOGY_LABELS:
             labels.append((key, self._topology.get(key, "")))
+        if self._drop_labels:
+            labels = [
+                (key, "" if key in self._drop_labels else value)
+                for key, value in labels
+            ]
         return labels
 
     def _build_snapshot(

@@ -64,3 +64,9 @@ def test_no_native_env_spellings(monkeypatch):
     ]:
         monkeypatch.setenv("KTS_NO_NATIVE", raw)
         assert from_args([]).use_native is expect_native, raw
+
+
+def test_drop_labels_parsing():
+    assert from_args([]).drop_labels == ()
+    cfg = from_args(["--drop-labels", "pod, namespace ,uuid"])
+    assert cfg.drop_labels == ("pod", "namespace", "uuid")

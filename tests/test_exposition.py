@@ -84,3 +84,22 @@ def test_debug_threads_endpoint():
         assert "MainThread" in body
     finally:
         server.stop()
+
+
+def test_readyz_transitions(tmp_path):
+    from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+    reg = Registry()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        try:
+            _served(server.port, "/readyz")
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        reg.publish(SnapshotBuilder().build())
+        status, _, body = _served(server.port, "/readyz")
+        assert (status, body) == (200, "ready\n")
+    finally:
+        server.stop()

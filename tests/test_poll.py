@@ -243,3 +243,23 @@ def test_process_self_metrics_exported():
            if s.spec.name == "process_resident_memory_bytes"]
     assert rss[0] > 1024 * 1024  # a real python process is > 1 MiB
     loop.stop()
+
+
+def test_drop_labels_blank_but_keep_keys():
+    reg = Registry()
+    loop = PollLoop(
+        MockCollector(num_devices=1),
+        reg,
+        deadline=5.0,
+        attribution=StaticAttribution(
+            {"0": {"pod": "secret-job", "namespace": "ml", "container": "c"}}
+        ),
+        drop_labels=("pod", "namespace", "uuid"),
+    )
+    loop.tick()
+    labels = get(reg.snapshot(), "accelerator_duty_cycle")[0][0]
+    assert labels["pod"] == "" and labels["namespace"] == ""
+    assert labels["uuid"] == ""
+    assert labels["container"] == "c"  # not dropped
+    assert set(labels) >= {"pod", "namespace", "uuid"}  # keys retained
+    loop.stop()
