@@ -97,6 +97,20 @@ class GpuSysfsCollector(Collector):
         devices.sort(key=lambda d: d.index)
         return devices
 
+    def telemetry_capable(self) -> bool:
+        """True if at least one discovered card exposes a compute-telemetry
+        attribute. Mere card existence is NOT enough for auto-detection: a
+        BMC framebuffer or integrated display controller has a
+        /sys/class/drm/card0 with none of these files, and such nodes must
+        fall back to the null backend (BASELINE configs[0])."""
+        for device in self.discover():
+            card = self._card_dir(device)
+            for _, patterns, _ in _ATTRIBUTES:
+                for pattern in patterns:
+                    if glob.glob(str(card / pattern)):
+                        return True
+        return False
+
     def sample(self, device: Device) -> Sample:
         card = self._card_dir(device)
         if not card.exists():

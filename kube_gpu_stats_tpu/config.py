@@ -124,7 +124,20 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def from_args(argv: Sequence[str] | None = None) -> Config:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    drop_labels = tuple(
+        key.strip() for key in args.drop_labels.split(",") if key.strip()
+    )
+    # Blanking the series-identity labels would collapse every chip into
+    # duplicate series — invalid exposition. uuid/accel_type/attribution/
+    # topology are safe to blank (chip still disambiguates).
+    identity = {"chip", "device_path"} & set(drop_labels)
+    if identity:
+        parser.error(
+            f"--drop-labels may not include device-identity labels "
+            f"{sorted(identity)}"
+        )
     return Config(
         backend=args.backend,
         interval=args.interval,
@@ -142,9 +155,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         checkpoint_path=args.checkpoint_path,
         attribution_interval=args.attribution_interval,
         rediscovery_interval=args.rediscovery_interval,
-        drop_labels=tuple(
-            key.strip() for key in args.drop_labels.split(",") if key.strip()
-        ),
+        drop_labels=drop_labels,
         mock_devices=args.mock_devices,
         use_native=not args.no_native,
         log_level=args.log_level,

@@ -49,7 +49,9 @@ def build_collector(cfg: Config) -> Collector:
         log.warning("TPU probe failed (%s); trying gpu backend", exc)
     try:
         gpu = _gpu_collector(cfg)
-        if gpu.discover():
+        # Require real telemetry, not mere card nodes: BMC/integrated
+        # display controllers also appear under /sys/class/drm.
+        if gpu.telemetry_capable():
             return gpu
     except Exception as exc:
         log.warning("GPU probe failed (%s); falling back to null backend", exc)

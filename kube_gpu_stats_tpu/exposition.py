@@ -182,14 +182,21 @@ class PushgatewayPusher:
         import time
 
         generation = self._registry.generation
-        last_push = 0.0
+        last_push = float("-inf")
+        dirty = False
         while not self._stop.is_set():
-            if self._registry.wait_for_publish(generation, timeout=0.5):
+            if self._registry.wait_for_publish(generation, timeout=0.2):
                 generation = self._registry.generation
-                now = time.monotonic()
-                if now - last_push >= self._min_interval:
-                    self.push_once()
-                    last_push = now
+                dirty = True
+            # Defer, never drop: a publish arriving inside the min_interval
+            # window is pushed as soon as the window elapses, so freshness
+            # stays at min_interval regardless of timing jitter.
+            if dirty and time.monotonic() - last_push >= self._min_interval:
+                self.push_once()
+                last_push = time.monotonic()
+                dirty = False
+        if dirty:
+            self.push_once()  # flush the final snapshot on shutdown
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -106,6 +106,9 @@ def decode_metric(data: bytes, _start: int = 0, _end: int | None = None
     pos = _start
     end = len(data) if _end is None else _end
     decode_varint = codec.decode_varint
+    # Contract: KNOWN fields with the wrong wire type raise ValueError
+    # (schema mismatch); UNKNOWN fields are skipped whatever their wire
+    # type (forward compat — the codec module guarantee).
     try:
         while pos < end:
             key, pos = decode_varint(data, pos)
@@ -118,7 +121,7 @@ def decode_metric(data: bytes, _start: int = 0, _end: int | None = None
                     int_value = raw - (1 << 64) if raw >= 1 << 63 else raw
                 elif field == 5:
                     timestamp_ns = raw - (1 << 64) if raw >= 1 << 63 else raw
-                elif field in (1, 6):
+                elif field in (1, 3, 6):
                     raise ValueError(f"field {field} has varint wire type")
             elif wire_type == codec.LENGTH:
                 length, pos = decode_varint(data, pos)
@@ -136,11 +139,21 @@ def decode_metric(data: bytes, _start: int = 0, _end: int | None = None
                     raise ValueError("truncated fixed64")
                 if field == 3:
                     double_value = struct.unpack_from("<d", data, pos)[0]
-                elif field != 0:
+                elif field in (1, 2, 4, 5, 6):
                     raise ValueError(f"field {field} has fixed64 wire type")
                 pos += 8
+            elif wire_type == codec.FIXED32:
+                if pos + 4 > end:
+                    raise ValueError("truncated fixed32")
+                if field in (1, 2, 3, 4, 5, 6):
+                    raise ValueError(f"field {field} has fixed32 wire type")
+                pos += 4
             else:
                 raise ValueError(f"unsupported wire type {wire_type}")
+        if pos != end:
+            # A varint near the window edge consumed the next message's
+            # bytes: corrupt input, not a legal decode.
+            raise ValueError("Metric overran its length window")
     except UnicodeDecodeError as exc:
         raise ValueError(f"wire-type mismatch in Metric: {exc}") from exc
     value_out: float | int

@@ -70,3 +70,11 @@ def test_drop_labels_parsing():
     assert from_args([]).drop_labels == ()
     cfg = from_args(["--drop-labels", "pod, namespace ,uuid"])
     assert cfg.drop_labels == ("pod", "namespace", "uuid")
+
+
+def test_drop_labels_rejects_identity_keys(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        from_args(["--drop-labels", "chip,pod"])
+    assert "device-identity" in capsys.readouterr().err

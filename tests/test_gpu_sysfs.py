@@ -83,3 +83,20 @@ def test_gpu_through_poll_loop(tmp_path):
     assert len(duty) == 2
     assert dict(duty[0].labels)["accel_type"] == "gpu-amd"
     loop.stop()
+
+
+def test_bmc_framebuffer_card_not_selected_by_auto(tmp_path):
+    """A display-only card (BMC/integrated) has /sys/class/drm/cardN but no
+    telemetry files; auto must fall back to null (review finding)."""
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import build_collector
+
+    device = tmp_path / "class" / "drm" / "card0" / "device"
+    device.mkdir(parents=True)
+    (device / "vendor").write_text("0x1a03\n")  # ASPEED BMC
+    col = build_collector(Config(backend="auto", sysfs_root=str(tmp_path),
+                                 use_native=False))
+    assert col.name == "null"
+    # Explicit --backend gpu still allows it (operator override).
+    gpu = build_collector(Config(backend="gpu", sysfs_root=str(tmp_path)))
+    assert gpu.name == "gpu-sysfs"

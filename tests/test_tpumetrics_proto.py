@@ -33,3 +33,54 @@ def test_multiple_samples_preserve_order():
     ]
     decoded = tpumetrics.decode_response(tpumetrics.encode_response(samples))
     assert [s.device_id for s in decoded] == [0, 1, 2, 3, 4]
+
+
+def test_unknown_fields_skipped_all_wire_types():
+    """Forward compat: a future runtime adding fields of ANY wire type must
+    not break decode (review finding)."""
+    import struct
+
+    from kube_gpu_stats_tpu.proto import codec
+
+    metric = (
+        codec.field_string(1, "m")
+        + codec.field_varint(2, 3)
+        + codec.field_double(3, 1.5)
+        + codec.field_varint(99, 7)                       # unknown varint
+        + codec.tag(100, codec.FIXED64) + struct.pack("<d", 2.5)  # unknown f64
+        + codec.tag(101, codec.FIXED32) + struct.pack("<f", 1.0)  # unknown f32
+        + codec.field_bytes(102, b"xyz")                  # unknown bytes
+    )
+    (decoded,) = tpumetrics.decode_response(codec.field_bytes(1, metric))
+    assert decoded.name == "m"
+    assert decoded.device_id == 3
+    assert decoded.value == 1.5
+
+
+def test_varint_overrunning_window_is_valueerror():
+    """A truncated varint at a submessage boundary must not silently eat
+    the next message's bytes (review finding)."""
+    from kube_gpu_stats_tpu.proto import codec
+
+    good = tpumetrics.encode_metric(
+        tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 1, 50.0)
+    )
+    # A metric whose window ends mid-varint: tag for field 2 + continuation
+    # byte with MSB set, window cut right after.
+    bad_metric = codec.field_string(1, "m") + codec.tag(2, codec.VARINT) + b"\xff"
+    blob = codec.field_bytes(1, bad_metric) + codec.field_bytes(1, good)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        tpumetrics.decode_response(blob)
+
+
+def test_known_field_wrong_wire_type_raises():
+    from kube_gpu_stats_tpu.proto import codec
+
+    # double_value (field 3) as varint: schema mismatch, not silence.
+    bad = codec.field_string(1, "m") + codec.field_varint(3, 7)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        tpumetrics.decode_metric(bad)

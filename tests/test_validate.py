@@ -84,3 +84,10 @@ def test_cli_against_file(tmp_path, capsys):
     assert "ok:" in capsys.readouterr().out
     path.write_text('accelerator_duty_cycle{chip="0"} 50\n')
     assert validate.main([str(path)]) == 1
+
+
+def test_trailing_timestamp_accepted():
+    line = ('accelerator_duty_cycle{accel_type="t",chip="0",device_path="d",'
+            'uuid="",pod="",namespace="",container="",slice="",worker="",'
+            'topology=""} 50 1722249600000\n')
+    assert validate.check(line) == []
