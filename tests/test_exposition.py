@@ -103,3 +103,28 @@ def test_readyz_transitions(tmp_path):
         assert (status, body) == (200, "ready\n")
     finally:
         server.stop()
+
+
+def test_official_prometheus_client_parses_our_exposition():
+    """Interop: the official prometheus_client text parser must accept the
+    full exposition (catches format bugs our own golden tests could share)."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    loop.tick()
+    text = reg.snapshot().render()
+    families = {f.name: f for f in text_string_to_metric_families(text)}
+    assert "accelerator_duty_cycle" in families
+    # Counters: parser strips _total; histogram exposed as one family.
+    assert "accelerator_ici_link_traffic_bytes" in families
+    assert families["accelerator_ici_link_traffic_bytes"].type == "counter"
+    assert "collector_poll_duration_seconds" in families
+    assert families["collector_poll_duration_seconds"].type == "histogram"
+    sample = families["accelerator_duty_cycle"].samples[0]
+    assert set(sample.labels) == set(
+        ("accel_type", "chip", "device_path", "uuid", "pod", "namespace",
+         "container", "slice", "worker", "topology")
+    )
+    loop.stop()
