@@ -14,6 +14,7 @@ poll/scrape race impossible by construction instead of by locking.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 import threading
 import time
@@ -21,6 +22,14 @@ from typing import Iterable, Mapping, Sequence
 
 from . import schema
 from .schema import MetricSpec, MetricType
+
+
+@functools.lru_cache(maxsize=8192)
+def _series_prefix(name: str, labels: tuple[tuple[str, str], ...]) -> str:
+    """Cached "name{label="v",...} " prefix: label sets repeat verbatim
+    every tick, so a scrape's render cost should be value formatting, not
+    label escaping. LRU-bounded for label churn (reallocation)."""
+    return name + schema.render_labels(labels) + " "
 
 
 def format_value(value: float) -> str:
@@ -112,8 +121,8 @@ class Snapshot:
             out.append(f"# TYPE {spec.name} {spec.type.value}")
             for s in group:
                 out.append(
-                    f"{s.spec.name}{schema.render_labels(s.labels)} "
-                    f"{format_value(s.value)}"
+                    _series_prefix(s.spec.name, s.labels)
+                    + format_value(s.value)
                 )
         for hist in self.histograms:
             spec = hist.spec
