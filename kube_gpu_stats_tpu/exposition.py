@@ -22,6 +22,9 @@ from .registry import Registry
 log = logging.getLogger(__name__)
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 
 class MetricsServer:
@@ -48,9 +51,20 @@ class MetricsServer:
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = outer._registry.snapshot().render().encode()
+                    # Content negotiation: Prometheus asks for OpenMetrics
+                    # with an explicit Accept; default stays text 0.0.4.
+                    accept = self.headers.get("Accept", "")
+                    use_om = "application/openmetrics-text" in accept
+                    body = (
+                        outer._registry.snapshot()
+                        .render(openmetrics=use_om)
+                        .encode()
+                    )
                     self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header(
+                        "Content-Type",
+                        OPENMETRICS_CONTENT_TYPE if use_om else CONTENT_TYPE,
+                    )
                 elif path == "/healthz":
                     import time
 

@@ -100,8 +100,10 @@ class Snapshot:
     histograms: tuple[HistogramState, ...]
     timestamp: float  # unix seconds at publish
 
-    def render(self) -> str:
-        """Serialize to the Prometheus text exposition format (0.0.4).
+    def render(self, openmetrics: bool = False) -> str:
+        """Serialize to the Prometheus text format (0.0.4), or OpenMetrics
+        1.0 when ``openmetrics`` (counter families declared without the
+        ``_total`` suffix, mandatory ``# EOF`` terminator).
 
         Families render in schema order so output is byte-stable for golden
         tests; series within a family keep insertion order (device order).
@@ -117,8 +119,11 @@ class Snapshot:
             group = by_family.get(spec.name)
             if not group:
                 continue
-            out.append(f"# HELP {spec.name} {spec.help}")
-            out.append(f"# TYPE {spec.name} {spec.type.value}")
+            family = spec.name
+            if openmetrics and spec.type is MetricType.COUNTER:
+                family = spec.name.removesuffix("_total")
+            out.append(f"# HELP {family} {spec.help}")
+            out.append(f"# TYPE {family} {spec.type.value}")
             for s in group:
                 out.append(
                     _series_prefix(s.spec.name, s.labels)
@@ -137,7 +142,9 @@ class Snapshot:
             out.append(f'{spec.name}_bucket{{le="+Inf"}} {hist.total}')
             out.append(f"{spec.name}_sum {format_value(hist.sum)}")
             out.append(f"{spec.name}_count {hist.total}")
-        return "\n".join(out) + "\n" if out else ""
+        if openmetrics:
+            out.append("# EOF")
+        return "\n".join(out) + "\n" if out else ("# EOF\n" if openmetrics else "")
 
 
 EMPTY_SNAPSHOT = Snapshot(series=(), histograms=(), timestamp=0.0)

@@ -128,3 +128,46 @@ def test_official_prometheus_client_parses_our_exposition():
          "container", "slice", "worker", "topology")
     )
     loop.stop()
+
+
+def test_openmetrics_negotiation():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/metrics",
+            headers={"Accept": "application/openmetrics-text; version=1.0.0"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert "openmetrics-text" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert body.endswith("# EOF\n")
+        # Counter family declared without _total; samples keep it.
+        assert "# TYPE accelerator_ici_link_traffic_bytes counter" in body
+        assert "accelerator_ici_link_traffic_bytes_total{" in body
+        # Plain scrape unchanged.
+        _, headers, plain = _served(server.port, "/metrics")
+        assert "version=0.0.4" in headers["Content-Type"]
+        assert "# EOF" not in plain
+        assert "# TYPE accelerator_ici_link_traffic_bytes_total counter" in plain
+    finally:
+        server.stop()
+        loop.stop()
+
+
+def test_openmetrics_parses_with_official_parser():
+    from prometheus_client.openmetrics.parser import text_string_to_metric_families
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    loop.tick()
+    text = reg.snapshot().render(openmetrics=True)
+    families = {f.name: f for f in text_string_to_metric_families(text)}
+    assert "accelerator_duty_cycle" in families
+    assert families["accelerator_ici_link_traffic_bytes"].type == "counter"
+    assert families["collector_poll_duration_seconds"].type == "histogram"
+    loop.stop()
