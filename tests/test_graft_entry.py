@@ -62,3 +62,24 @@ def test_ici_burn_runs_briefly():
     from kube_gpu_stats_tpu.loadgen.ici_burn import run_ici_burn
 
     assert run_ici_burn(0.3, n_devices=4, shard_mb=0.001, steps=2) >= 1
+
+
+def test_with_device_count_rewrites_flags():
+    from __graft_entry__ import _with_device_count
+
+    assert _with_device_count("", 8).endswith("device_count=8")
+    assert "device_count=16" in _with_device_count(
+        "--xla_force_host_platform_device_count=8", 16)
+    # Larger existing value retained.
+    assert "device_count=32" in _with_device_count(
+        "--xla_force_host_platform_device_count=32", 8)
+    assert "--other_flag" in _with_device_count(
+        "--other_flag --xla_force_host_platform_device_count=4", 8)
+
+
+def test_dryrun_16_exceeds_test_mesh_uses_subprocess():
+    """conftest pins 8 CPU devices; dryrun(16) must self-provision a larger
+    mesh via the subprocess fallback (rewriting the existing flag)."""
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(16)
