@@ -206,6 +206,20 @@ panels = [
         [('sum by (reason) (rate(collector_poll_errors_total[5m]))',
           '{{reason}}')],
         "ops", {"x": 12, "y": 28, "w": 12, "h": 8}, per_chip=False),
+
+    # Row 6 — fleet health cross-checks.
+    timeseries(
+        "Discovered vs kubelet-allocatable devices",
+        [('sum(collector_devices)', 'discovered'),
+         ('sum(collector_allocatable_devices{resource="google.com/tpu"})',
+          'allocatable (TPU)')],
+        "none", {"x": 0, "y": 36, "w": 12, "h": 8}, per_chip=False,
+        description="Divergence = device-plugin/driver disagreement "
+                    "(AcceleratorDeviceCountMismatch alert)."),
+    timeseries(
+        "Exporter memory (RSS)",
+        [('process_resident_memory_bytes', '{{instance}}')],
+        "bytes", {"x": 12, "y": 36, "w": 12, "h": 8}, per_chip=False),
 ]
 
 dashboard = {

@@ -120,11 +120,49 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env_bool("NO_NATIVE"),
                    help="disable the C++ fast-path sampler")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", "info"))
+    p.add_argument("--config", default=_env("CONFIG", ""),
+                   help="YAML config file (keys = long flag names); "
+                        "precedence: flags > KTS_* env > file > defaults")
     return p
+
+
+def _apply_config_file(parser: argparse.ArgumentParser, path: str) -> None:
+    """Layer a YAML config file under env/flags: file values become parser
+    defaults for keys whose KTS_ env var is unset (env already seeded the
+    defaults, so skipping env-set keys preserves env > file)."""
+    import yaml
+
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+    except OSError as exc:
+        parser.error(f"--config: {exc}")
+    if not isinstance(doc, dict):
+        parser.error(f"--config: {path} must contain a YAML mapping")
+    valid = {
+        action.dest for action in parser._actions
+        if action.dest not in ("help", "config")
+    }
+    defaults = {}
+    for key, value in doc.items():
+        dest = str(key).replace("-", "_")
+        if dest not in valid:
+            parser.error(
+                f"--config: unknown key {key!r} (valid: {sorted(valid)})"
+            )
+        if "KTS_" + dest.upper() in os.environ:
+            continue  # env beats file
+        if isinstance(value, list):  # libtpu_ports / drop_labels as lists
+            value = ",".join(str(v) for v in value)
+        defaults[dest] = value
+    parser.set_defaults(**defaults)
 
 
 def from_args(argv: Sequence[str] | None = None) -> Config:
     parser = build_parser()
+    pre, _ = parser.parse_known_args(argv)
+    if pre.config:
+        _apply_config_file(parser, pre.config)
     args = parser.parse_args(argv)
     drop_labels = tuple(
         key.strip() for key in args.drop_labels.split(",") if key.strip()

@@ -78,3 +78,42 @@ def test_drop_labels_rejects_identity_keys(capsys):
     with pytest.raises(SystemExit):
         from_args(["--drop-labels", "chip,pod"])
     assert "device-identity" in capsys.readouterr().err
+
+
+def test_config_file_layering(tmp_path, monkeypatch):
+    cfg_file = tmp_path / "kts.yaml"
+    cfg_file.write_text(
+        "backend: mock\n"
+        "mock-devices: 6\n"
+        "interval: 0.25\n"
+        "libtpu-ports: [8431, 8432]\n"
+        "drop-labels: [pod, namespace]\n"
+    )
+    cfg = from_args(["--config", str(cfg_file)])
+    assert cfg.backend == "mock"
+    assert cfg.mock_devices == 6
+    assert cfg.interval == 0.25
+    assert cfg.libtpu_ports == (8431, 8432)
+    assert cfg.drop_labels == ("pod", "namespace")
+    # Flags beat file.
+    assert from_args(["--config", str(cfg_file), "--backend", "null"]).backend == "null"
+    # Env beats file.
+    monkeypatch.setenv("KTS_BACKEND", "null")
+    assert from_args(["--config", str(cfg_file)]).backend == "null"
+
+
+def test_config_file_unknown_key(tmp_path, capsys):
+    import pytest
+
+    cfg_file = tmp_path / "bad.yaml"
+    cfg_file.write_text("no-such-option: 1\n")
+    with pytest.raises(SystemExit):
+        from_args(["--config", str(cfg_file)])
+    assert "unknown key" in capsys.readouterr().err
+
+
+def test_config_file_missing(tmp_path, capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        from_args(["--config", str(tmp_path / "nope.yaml")])
