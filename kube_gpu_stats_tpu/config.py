@@ -139,21 +139,44 @@ def _apply_config_file(parser: argparse.ArgumentParser, path: str) -> None:
         parser.error(f"--config: {exc}")
     if not isinstance(doc, dict):
         parser.error(f"--config: {path} must contain a YAML mapping")
-    valid = {
-        action.dest for action in parser._actions
+    actions = {
+        action.dest: action for action in parser._actions
         if action.dest not in ("help", "config")
     }
+    # Non-KTS env vars that also feed a flag's default (env must beat file
+    # for these too).
+    env_aliases = {"libtpu_ports": ("TPU_RUNTIME_METRICS_PORTS",)}
     defaults = {}
     for key, value in doc.items():
         dest = str(key).replace("-", "_")
-        if dest not in valid:
+        action = actions.get(dest)
+        if action is None:
             parser.error(
-                f"--config: unknown key {key!r} (valid: {sorted(valid)})"
+                f"--config: unknown key {key!r} (valid: {sorted(actions)})"
             )
-        if "KTS_" + dest.upper() in os.environ:
+        if "KTS_" + dest.upper() in os.environ or any(
+            alias in os.environ for alias in env_aliases.get(dest, ())
+        ):
             continue  # env beats file
         if isinstance(value, list):  # libtpu_ports / drop_labels as lists
             value = ",".join(str(v) for v in value)
+        if not isinstance(value, (str, int, float, bool)):
+            parser.error(f"--config: key {key!r} must be a scalar or list")
+        # Defaults bypass argparse validation, so apply the action's type
+        # conversion and choices check here — a typo in the file must fail
+        # as fast as the same typo on the command line.
+        if isinstance(action.const, bool):  # store_true-style flag
+            if not isinstance(value, bool):
+                parser.error(f"--config: key {key!r} must be true/false")
+        else:
+            try:
+                value = action.type(str(value)) if action.type else str(value)
+            except (TypeError, ValueError):
+                parser.error(f"--config: invalid value for {key!r}: {value!r}")
+            if action.choices is not None and value not in action.choices:
+                parser.error(
+                    f"--config: {key!r} must be one of {list(action.choices)}"
+                )
         defaults[dest] = value
     parser.set_defaults(**defaults)
 

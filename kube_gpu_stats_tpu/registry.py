@@ -144,7 +144,7 @@ class Snapshot:
             out.append(f"{spec.name}_count {hist.total}")
         if openmetrics:
             out.append("# EOF")
-        return "\n".join(out) + "\n" if out else ("# EOF\n" if openmetrics else "")
+        return "\n".join(out) + "\n" if out else ""
 
 
 EMPTY_SNAPSHOT = Snapshot(series=(), histograms=(), timestamp=0.0)

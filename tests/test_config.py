@@ -117,3 +117,37 @@ def test_config_file_missing(tmp_path, capsys):
 
     with pytest.raises(SystemExit):
         from_args(["--config", str(tmp_path / "nope.yaml")])
+
+
+def test_config_file_validates_choices_and_types(tmp_path, capsys):
+    import pytest
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("backend: bogus\n")
+    with pytest.raises(SystemExit):
+        from_args(["--config", str(bad)])
+    assert "must be one of" in capsys.readouterr().err
+
+    bad.write_text("interval: {weird: 1}\n")
+    with pytest.raises(SystemExit):
+        from_args(["--config", str(bad)])
+    assert "scalar" in capsys.readouterr().err
+
+    bad.write_text("interval: notafloat\n")
+    with pytest.raises(SystemExit):
+        from_args(["--config", str(bad)])
+    assert "invalid value" in capsys.readouterr().err
+
+    bad.write_text("no-native: yes-please\n")
+    with pytest.raises(SystemExit):
+        from_args(["--config", str(bad)])
+
+
+def test_tpu_runtime_metrics_ports_env_beats_config_file(tmp_path, monkeypatch):
+    cfg_file = tmp_path / "kts.yaml"
+    cfg_file.write_text("libtpu-ports: [9999]\n")
+    monkeypatch.setenv("TPU_RUNTIME_METRICS_PORTS", "8431,8432")
+    cfg = from_args(["--config", str(cfg_file)])
+    assert cfg.libtpu_ports == (8431, 8432)
+    monkeypatch.delenv("TPU_RUNTIME_METRICS_PORTS")
+    assert from_args(["--config", str(cfg_file)]).libtpu_ports == (9999,)
