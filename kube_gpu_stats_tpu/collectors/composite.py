@@ -55,11 +55,22 @@ class TpuCollector(Collector):
     def begin_tick(self) -> None:
         self._libtpu.begin_tick()
 
+    def wait_ready(self, timeout: float | None = None) -> None:
+        self._libtpu.wait_ready(timeout)
+
     def sample(self, device: Device) -> Sample:
         values: dict[str, float] = {}
         ici: dict[str, int] = {}
         collectives = None
         runtime_err = sysfs_err = None
+        # sysfs first: the libtpu sample joins the tick's in-flight batched
+        # RPC, so reading the local files before blocking lets the file IO
+        # overlap the RPC instead of queueing behind it.
+        sysfs_values: dict[str, float] = {}
+        try:
+            sysfs_values = self._sysfs.read_environment(device)
+        except CollectorError as exc:
+            sysfs_err = exc
         try:
             runtime = self._libtpu.sample(device)
             values.update(runtime.values)
@@ -67,10 +78,7 @@ class TpuCollector(Collector):
             collectives = runtime.collective_ops
         except CollectorError as exc:
             runtime_err = exc
-        try:
-            values.update(self._sysfs.read_environment(device))
-        except CollectorError as exc:
-            sysfs_err = exc
+        values.update(sysfs_values)
         if not values:
             raise CollectorError(
                 f"chip {device.index}: libtpu: {runtime_err}; sysfs: {sysfs_err}"

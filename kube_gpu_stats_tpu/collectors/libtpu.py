@@ -38,6 +38,71 @@ _VALUE_MAP: Mapping[str, str] = {
 }
 
 
+def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict]) -> None:
+    """Fold one decoded metric into the per-device cache (the pure-Python
+    reference for the fused native ingest — tests/test_wirefast.py pins the
+    two paths byte-equivalent)."""
+    entry = cache.setdefault(
+        sample.device_id,
+        {"values": {}, "ici": {}, "collectives": None},
+    )
+    if sample.name == tpumetrics.ICI_TRAFFIC:
+        entry["ici"][sample.link or "link0"] = int(sample.value)
+    elif sample.name == tpumetrics.COLLECTIVES:
+        entry["collectives"] = int(sample.value)
+    elif sample.name in _VALUE_MAP:
+        entry["values"][_VALUE_MAP[sample.name]] = float(sample.value)
+    # Unknown names: runtime newer than our pin — ignore.
+
+
+def ingest_response_py(raw: bytes, cache: dict[int, dict]) -> None:
+    """Decode a MetricResponse and ingest every metric (Python fallback for
+    the native _wirefast.ingest). All-or-nothing: staged into a scratch
+    dict so an ingest-time error (e.g. int(NaN) on a counter metric) can't
+    publish the response's leading metrics — same containment as the fused
+    native wrapper."""
+    staged: dict[int, dict] = {}
+    for s in tpumetrics.decode_response(raw):
+        _ingest_sample(s, staged)
+    _merge_cache(staged, cache)
+
+
+def _merge_cache(src: dict[int, dict], dst: dict[int, dict]) -> None:
+    """Fold one response's per-device entries into the tick cache with the
+    same semantics as repeated _ingest_sample calls across ports."""
+    for dev, entry in src.items():
+        existing = dst.get(dev)
+        if existing is None:
+            dst[dev] = entry
+        else:
+            existing["values"].update(entry["values"])
+            existing["ici"].update(entry["ici"])
+            if entry["collectives"] is not None:
+                existing["collectives"] = entry["collectives"]
+
+
+def _make_fused_ingest(wirefast):
+    def ingest_response_native(raw: bytes, cache: dict[int, dict]) -> None:
+        # Stage into a scratch dict so a ValueError mid-response can't
+        # publish a corrupt response's leading metrics (all-or-nothing,
+        # matching the Python path's decode-then-ingest order).
+        staged: dict[int, dict] = {}
+        wirefast.ingest(raw, staged)
+        _merge_cache(staged, cache)
+
+    return ingest_response_native
+
+
+def _load_wirefast():
+    from .. import native
+
+    try:
+        wirefast = native.load_wirefast()
+    except Exception:  # pragma: no cover - defensive: a broken build must
+        return None    # degrade to Python, never break collection
+    return None if wirefast is None else _make_fused_ingest(wirefast)
+
+
 class LibtpuClient:
     """One channel per runtime-metrics port; bytes-level unary calls. Ports
     are queried in parallel (multi-process runtimes serve disjoint chip
@@ -78,46 +143,65 @@ class LibtpuClient:
                 )
             )
 
-    def _call_one(self, method, request: bytes) -> list[tpumetrics.MetricSample]:
-        raw = method(request, timeout=self._rpc_timeout)
-        return tpumetrics.decode_response(raw)
+    @staticmethod
+    def _raise_all_failed(metric_name: str, errors: list[Exception]) -> None:
+        first = errors[0]
+        exc = CollectorError(
+            f"libtpu metric {metric_name!r} unavailable: {first}"
+        )
+        exc.status_code = (
+            first.code() if isinstance(first, grpc.Call) else None
+        )
+        raise exc
+
+    def _fan_out(self, request: bytes) -> list[tuple[bytes | None, Exception | None]]:
+        """Issue the request to every port in parallel (one wedged process
+        must cost one rpc_timeout, not N); per-port (response, error)."""
+
+        def call(method):
+            try:
+                return method(request, timeout=self._rpc_timeout), None
+            except grpc.RpcError as exc:
+                return None, exc
+
+        if self._port_pool is not None:
+            return list(self._port_pool.map(call, self._methods))
+        return [call(m) for m in self._methods]
 
     def get_metric(self, metric_name: str) -> list[tpumetrics.MetricSample]:
         """Fetch one metric family from every port in parallel, merged.
         Raises CollectorError (with .status_code when the failure was a
-        gRPC status) only if every port failed."""
-        request = tpumetrics.encode_request(metric_name)
+        gRPC status) only if every port failed; an undecodable port
+        (runtime speaking a different schema) counts as failed."""
         samples: list[tpumetrics.MetricSample] = []
         errors: list[Exception] = []
-        if self._port_pool is not None:
-            outcomes = self._port_pool.map(
-                lambda m: self._safe_call(m, request), self._methods
-            )
-        else:
-            outcomes = (self._safe_call(m, request) for m in self._methods)
-        for result, error in outcomes:
+        for raw, error in self._fan_out(tpumetrics.encode_request(metric_name)):
+            if error is not None:
+                errors.append(error)
+                continue
+            try:
+                samples.extend(tpumetrics.decode_response(raw))
+            except ValueError as exc:
+                errors.append(exc)
+        if errors and not samples:
+            self._raise_all_failed(metric_name, errors)
+        return samples
+
+    def get_raw(self, metric_name: str) -> list[bytes]:
+        """Fetch one metric family from every port, returning the undecoded
+        response bytes per surviving port (the fused native ingest decodes
+        them). Same error contract as get_metric: raises CollectorError only
+        when every port failed."""
+        raws: list[bytes] = []
+        errors: list[Exception] = []
+        for raw, error in self._fan_out(tpumetrics.encode_request(metric_name)):
             if error is not None:
                 errors.append(error)
             else:
-                samples.extend(result)
-        if errors and not samples:
-            first = errors[0]
-            exc = CollectorError(
-                f"libtpu metric {metric_name!r} unavailable: {first}"
-            )
-            exc.status_code = (
-                first.code() if isinstance(first, grpc.Call) else None
-            )
-            raise exc
-        return samples
-
-    def _safe_call(self, method, request: bytes):
-        try:
-            return self._call_one(method, request), None
-        except (grpc.RpcError, ValueError) as exc:
-            # RpcError: transport/deadline; ValueError: undecodable
-            # response bytes (runtime speaking a different schema).
-            return None, exc
+                raws.append(raw)
+        if errors and not raws:
+            self._raise_all_failed(metric_name, errors)
+        return raws
 
     def close(self) -> None:
         if self._port_pool is not None:
@@ -142,6 +226,18 @@ class LibtpuCollector(Collector):
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=len(tpumetrics.ALL_METRICS), thread_name_prefix="libtpu-rpc"
         )
+        # Single-worker executor for the per-tick batched fetch: begin_tick
+        # dispatches here and returns immediately so the poll loop's sysfs
+        # fan-out overlaps the RPC flight time instead of queueing behind it
+        # (SURVEY.md §3 E2 — the RPC round trip dominates the tick; anything
+        # serialized after it is pure added latency).
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="libtpu-fetch"
+        )
+        self._inflight: concurrent.futures.Future | None = None
+        # Fused native decode+ingest when built (native/wirefast.cc); the
+        # pure-Python path is the pinned-equivalent fallback.
+        self._ingest_response = _load_wirefast() or ingest_response_py
         self._lock = threading.Lock()
         self._cache: dict[int, dict] = {}
         self._cache_error: CollectorError | None = CollectorError(
@@ -173,21 +269,27 @@ class LibtpuCollector(Collector):
     # -- hot path ------------------------------------------------------------
 
     def begin_tick(self) -> None:
+        """Kick off this tick's batched fetch without blocking. If the
+        previous tick's fetch is still in flight (runtime slower than the
+        interval), no new fetch is stacked — samplers will join the one
+        already running; a wedged runtime costs one cache refresh, never an
+        unbounded fetch queue."""
+        if self._inflight is None or self._inflight.done():
+            self._inflight = self._fetch_pool.submit(self._refresh)
+
+    def wait_ready(self, timeout: float | None = None) -> None:
+        """Block until the current tick's fetch (if any) has landed in the
+        cache. sample() does this implicitly; tests and probes that assert
+        on post-fetch state call it explicitly."""
+        inflight = self._inflight
+        if inflight is not None:
+            inflight.result(timeout)
+
+    def _refresh(self) -> None:
+        """The actual fetch+ingest; runs on the fetch thread. Never raises —
+        failures land in _cache_error for sample() to surface per device."""
         cache: dict[int, dict] = {}
         first_error: CollectorError | None = None
-
-        def ingest(sample: tpumetrics.MetricSample) -> None:
-            entry = cache.setdefault(
-                sample.device_id,
-                {"values": {}, "ici": {}, "collectives": None},
-            )
-            if sample.name == tpumetrics.ICI_TRAFFIC:
-                entry["ici"][sample.link or "link0"] = int(sample.value)
-            elif sample.name == tpumetrics.COLLECTIVES:
-                entry["collectives"] = int(sample.value)
-            elif sample.name in _VALUE_MAP:
-                entry["values"][_VALUE_MAP[sample.name]] = float(sample.value)
-            # Unknown names: runtime newer than our pin — ignore.
 
         _REJECTED = (
             grpc.StatusCode.UNIMPLEMENTED,
@@ -196,10 +298,22 @@ class LibtpuCollector(Collector):
         )
         if self._batched is not False:
             try:
-                for s in self._client.get_metric(""):
-                    ingest(s)
+                decode_error: Exception | None = None
+                for raw in self._client.get_raw(""):
+                    try:
+                        self._ingest_response(raw, cache)
+                    except (ValueError, OverflowError) as exc:
+                        # ValueError: different schema / garbled port;
+                        # OverflowError: int(inf) on a counter metric.
+                        # Either way contain it to this port — other ports
+                        # may still be fine.
+                        decode_error = exc
                 if cache:
                     self._batched = True
+                elif decode_error is not None:
+                    first_error = CollectorError(
+                        f"libtpu metric '' unavailable: {decode_error}"
+                    )
             except CollectorError as exc:
                 if getattr(exc, "status_code", None) in _REJECTED:
                     # The runtime answered and rejected the empty selector:
@@ -219,13 +333,23 @@ class LibtpuCollector(Collector):
             }
             for name, future in futures.items():
                 try:
+                    staged: dict[int, dict] = {}
                     for s in future.result():
-                        ingest(s)
+                        _ingest_sample(s, staged)
+                    _merge_cache(staged, cache)
                 except CollectorError as exc:
                     # Partial data is fine (e.g. a runtime build without ICI
                     # counters); a fully-failed fetch poisons the tick below.
                     first_error = first_error or exc
                     log.debug("libtpu fetch of %s failed: %s", name, exc)
+                except (ValueError, OverflowError) as exc:
+                    # Bad value inside one family (int(inf)/int(NaN)):
+                    # contain to that family, staged so its leading metrics
+                    # aren't half-published — same contract as batched mode.
+                    first_error = first_error or CollectorError(
+                        f"libtpu metric {name!r} undecodable: {exc}"
+                    )
+                    log.debug("libtpu ingest of %s failed: %s", name, exc)
         with self._lock:
             if cache:
                 self._cache = cache
@@ -237,6 +361,12 @@ class LibtpuCollector(Collector):
                 )
 
     def sample(self, device: Device) -> Sample:
+        inflight = self._inflight
+        if inflight is not None:
+            # Join the tick's fetch. Bounded by the gRPC deadline inside
+            # _refresh; the poll loop's own per-device deadline also covers
+            # this wait (sample runs on a pool worker).
+            inflight.result()
         with self._lock:
             error = self._cache_error
             entry = self._cache.get(device.index)
@@ -255,4 +385,5 @@ class LibtpuCollector(Collector):
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+        self._fetch_pool.shutdown(wait=False, cancel_futures=True)
         self._client.close()

@@ -18,3 +18,22 @@ def maybe_accelerate_sysfs(sysfs_collector):
         return NativeSysfsCollector(sysfs_collector)
     except Exception:
         return sysfs_collector
+
+
+def load_wirefast():
+    """The fused MetricResponse decode+ingest extension (wirefast.cc), or
+    None when not built — callers fall back to the pure-Python path. The
+    module is configured with the pinned metric-name surface on first load."""
+    try:
+        from . import _wirefast
+    except ImportError:
+        return None
+    from ..collectors.libtpu import _VALUE_MAP
+    from ..proto import tpumetrics
+
+    _wirefast.configure(
+        {name.encode(): schema for name, schema in _VALUE_MAP.items()},
+        tpumetrics.ICI_TRAFFIC.encode(),
+        tpumetrics.COLLECTIVES.encode(),
+    )
+    return _wirefast

@@ -1,0 +1,445 @@
+// _wirefast: fused wire-decode + ingest for the libtpu batched fetch.
+//
+// The poll tick's CPU cost after the RPC lands is decoding ~100 Metric
+// messages and aggregating them into the per-device cache; done in Python
+// that is ~0.35 ms of the <50 ms budget (SURVEY.md §3 E2). This extension
+// does both in one C call: parse the MetricResponse wire bytes and write
+// straight into the cache dict the collector publishes from — no
+// intermediate sample objects.
+//
+// Contract (must match proto/tpumetrics.py decode_metric/decode_response,
+// pinned by the equivalence + fuzz tests in tests/test_wirefast.py):
+//   - known fields with a mismatched wire type -> ValueError
+//   - unknown fields skipped whatever their wire type (forward compat)
+//   - truncated varints / length windows -> ValueError
+//   - metric names / links must be valid UTF-8 -> ValueError otherwise
+//
+// Build: make -C kube_gpu_stats_tpu/native  (-> _wirefast.so, plain-named so
+// the package importer picks it up without the versioned EXT_SUFFIX).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kMaxNames = 16;
+
+// configure() state: pinned runtime-metric names -> interned schema strings.
+struct NameEntry {
+  char name[128];
+  Py_ssize_t len;
+  PyObject* schema;  // owned
+};
+NameEntry g_value_map[kMaxNames];
+int g_n_values = 0;
+char g_ici_name[128];
+Py_ssize_t g_ici_len = 0;
+char g_coll_name[128];
+Py_ssize_t g_coll_len = 0;
+
+// Interned helper strings + link-string cache.
+PyObject* g_s_values = nullptr;       // "values"
+PyObject* g_s_ici = nullptr;          // "ici"
+PyObject* g_s_collectives = nullptr;  // "collectives"
+PyObject* g_s_link0 = nullptr;        // "link0" (empty-link default)
+PyObject* g_link_cache = nullptr;     // dict: bytes -> str
+
+bool decode_varint(const uint8_t* data, Py_ssize_t end, Py_ssize_t* pos,
+                   uint64_t* out) {
+  Py_ssize_t p = *pos;
+  if (p >= end) return false;
+  uint8_t byte = data[p];
+  if (!(byte & 0x80)) {  // hot path: single byte
+    *out = byte;
+    *pos = p + 1;
+    return true;
+  }
+  uint64_t result = byte & 0x7F;
+  int shift = 7;
+  ++p;
+  while (true) {
+    if (p >= end) return false;
+    byte = data[p];
+    ++p;
+    if (shift < 64)  // bits past 63 are dropped: standard 64-bit truncation,
+      result |= (uint64_t)(byte & 0x7F) << shift;  // matches codec.py's mask
+    if (!(byte & 0x80)) {
+      *out = result;
+      *pos = p;
+      return true;
+    }
+    shift += 7;
+    if (shift >= 70) return false;  // "varint too long"
+  }
+}
+
+PyObject* err(const char* msg) {
+  PyErr_SetString(PyExc_ValueError, msg);
+  return nullptr;
+}
+
+// Look up / create the interned str for a link bytes slice. The cache is
+// epoch-evicted at 1024 entries so a runtime emitting pathological unique
+// link names can't grow it without bound.
+PyObject* link_str(const uint8_t* p, Py_ssize_t len) {
+  PyObject* key = PyBytes_FromStringAndSize((const char*)p, len);
+  if (!key) return nullptr;
+  PyObject* cached = PyDict_GetItem(g_link_cache, key);  // borrowed
+  if (cached) {
+    Py_DECREF(key);
+    Py_INCREF(cached);
+    return cached;
+  }
+  if (PyDict_Size(g_link_cache) >= 1024) PyDict_Clear(g_link_cache);
+  PyObject* s = PyUnicode_DecodeUTF8((const char*)p, len, nullptr);
+  if (!s) {
+    Py_DECREF(key);
+    PyErr_Clear();
+    return err("wire-type mismatch in Metric: invalid UTF-8 in link");
+  }
+  if (PyDict_SetItem(g_link_cache, key, s) < 0) {
+    Py_DECREF(key);
+    Py_DECREF(s);
+    return nullptr;
+  }
+  Py_DECREF(key);
+  return s;
+}
+
+// Parse one Metric message in data[pos:end) and fold it into cache.
+// Returns 0 on success, -1 with a Python exception set on error.
+int ingest_metric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
+                  PyObject* cache) {
+  const uint8_t* name_p = nullptr;
+  Py_ssize_t name_len = 0;
+  const uint8_t* link_p = nullptr;
+  Py_ssize_t link_len = -1;  // -1 = absent
+  int64_t device_id = 0;
+  double double_value = 0.0;
+  bool has_double = false;
+  int64_t int_value = 0;
+  bool has_int = false;
+
+  Py_ssize_t pos = start;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) {
+      err("truncated varint");
+      return -1;
+    }
+    uint64_t field = key >> 3;
+    int wire = key & 0x07;
+    if (wire == 0) {  // VARINT
+      uint64_t raw;
+      if (!decode_varint(data, end, &pos, &raw)) {
+        err("truncated varint");
+        return -1;
+      }
+      if (field == 2) {
+        device_id = (int64_t)raw;
+      } else if (field == 4) {
+        int_value = (int64_t)raw;
+        has_int = true;
+      } else if (field == 5) {
+        // timestamp_ns: parsed for wire correctness, unused by ingest
+      } else if (field == 1 || field == 3 || field == 6) {
+        err("known field has varint wire type");
+        return -1;
+      }
+    } else if (wire == 2) {  // LENGTH
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length)) {
+        err("truncated varint");
+        return -1;
+      }
+      if ((uint64_t)(end - pos) < length) {
+        err("truncated length-delimited field");
+        return -1;
+      }
+      if (field == 1 || field == 6) {
+        // Validate UTF-8 per occurrence (not just the last-kept one) so a
+        // repeated field with a garbled earlier occurrence fails exactly
+        // like the Python decoder, which decodes each as it arrives.
+        PyObject* probe = PyUnicode_DecodeUTF8((const char*)(data + pos),
+                                               (Py_ssize_t)length, nullptr);
+        if (!probe) {
+          PyErr_Clear();
+          err("wire-type mismatch in Metric: invalid UTF-8 in string field");
+          return -1;
+        }
+        Py_DECREF(probe);
+        if (field == 1) {
+          name_p = data + pos;
+          name_len = (Py_ssize_t)length;
+        } else {
+          link_p = data + pos;
+          link_len = (Py_ssize_t)length;
+        }
+      } else if (field >= 2 && field <= 5) {
+        err("known field has length wire type");
+        return -1;
+      }
+      pos += (Py_ssize_t)length;
+    } else if (wire == 1) {  // FIXED64
+      if (pos + 8 > end) {
+        err("truncated fixed64");
+        return -1;
+      }
+      if (field == 3) {
+        uint64_t bits;
+        memcpy(&bits, data + pos, 8);
+        memcpy(&double_value, &bits, 8);
+        has_double = true;
+      } else if (field >= 1 && field <= 6) {
+        err("known field has fixed64 wire type");
+        return -1;
+      }
+      pos += 8;
+    } else if (wire == 5) {  // FIXED32
+      if (pos + 4 > end) {
+        err("truncated fixed32");
+        return -1;
+      }
+      if (field >= 1 && field <= 6) {
+        err("known field has fixed32 wire type");
+        return -1;
+      }
+      pos += 4;
+    } else {
+      err("unsupported wire type");
+      return -1;
+    }
+  }
+  if (pos != end) {
+    err("Metric overran its length window");
+    return -1;
+  }
+
+  // Classify the metric name: ici / collectives / value_map / unknown.
+  enum { ICI, COLL, VALUE, UNKNOWN } kind = UNKNOWN;
+  PyObject* schema_name = nullptr;  // borrowed (value_map entry)
+  if (name_len == g_ici_len && memcmp(name_p, g_ici_name, name_len) == 0) {
+    kind = ICI;
+  } else if (name_len == g_coll_len &&
+             memcmp(name_p, g_coll_name, name_len) == 0) {
+    kind = COLL;
+  } else {
+    for (int i = 0; i < g_n_values; ++i) {
+      if (g_value_map[i].len == name_len &&
+          memcmp(g_value_map[i].name, name_p, name_len) == 0) {
+        kind = VALUE;
+        schema_name = g_value_map[i].schema;
+        break;
+      }
+    }
+  }
+  if (kind == UNKNOWN) return 0;  // runtime newer than our pin — ignore
+
+  // entry = cache.setdefault(device_id, {"values": {}, "ici": {},
+  //                                      "collectives": None})
+  PyObject* dev_key = PyLong_FromLongLong(device_id);
+  if (!dev_key) return -1;
+  PyObject* entry = PyDict_GetItem(cache, dev_key);  // borrowed
+  if (!entry) {
+    entry = PyDict_New();
+    PyObject* values = PyDict_New();
+    PyObject* ici = PyDict_New();
+    if (!entry || !values || !ici ||
+        PyDict_SetItem(entry, g_s_values, values) < 0 ||
+        PyDict_SetItem(entry, g_s_ici, ici) < 0 ||
+        PyDict_SetItem(entry, g_s_collectives, Py_None) < 0 ||
+        PyDict_SetItem(cache, dev_key, entry) < 0) {
+      Py_XDECREF(entry);
+      Py_XDECREF(values);
+      Py_XDECREF(ici);
+      Py_DECREF(dev_key);
+      return -1;
+    }
+    Py_DECREF(values);
+    Py_DECREF(ici);
+    Py_DECREF(entry);  // cache holds the reference; entry stays borrowed-valid
+    entry = PyDict_GetItem(cache, dev_key);
+  }
+  Py_DECREF(dev_key);
+
+  // Effective value: int_value wins when present (mirrors decode_metric),
+  // else double_value, else 0.0. Int conversion of a double goes through
+  // PyLong_FromDouble so NaN/inf/huge behave exactly like Python's int().
+  int rc = 0;
+  if (kind == ICI || kind == COLL) {
+    PyObject* v = has_int      ? PyLong_FromLongLong(int_value)
+                  : has_double ? PyLong_FromDouble(double_value)
+                               : PyLong_FromLongLong(0);
+    if (!v) return -1;  // int(NaN)/int(inf) exception, matching Python ingest
+    if (kind == ICI) {
+      PyObject* ici = PyDict_GetItem(entry, g_s_ici);  // borrowed
+      PyObject* link;
+      if (link_len > 0) {
+        link = link_str(link_p, link_len);
+        if (!link) {
+          Py_DECREF(v);
+          return -1;
+        }
+      } else {
+        link = g_s_link0;
+        Py_INCREF(link);
+      }
+      rc = PyDict_SetItem(ici, link, v);
+      Py_DECREF(link);
+    } else {
+      rc = PyDict_SetItem(entry, g_s_collectives, v);
+    }
+    Py_DECREF(v);
+  } else {  // VALUE
+    double fval = has_int      ? (double)int_value
+                  : has_double ? double_value
+                               : 0.0;
+    PyObject* values = PyDict_GetItem(entry, g_s_values);  // borrowed
+    PyObject* v = PyFloat_FromDouble(fval);
+    if (!v) return -1;
+    rc = PyDict_SetItem(values, schema_name, v);
+    Py_DECREF(v);
+  }
+  return rc;
+}
+
+PyObject* py_ingest(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  PyObject* cache;
+  if (!PyArg_ParseTuple(args, "y*O!", &buf, &PyDict_Type, &cache))
+    return nullptr;
+  const uint8_t* data = (const uint8_t*)buf.buf;
+  Py_ssize_t end = buf.len;
+  Py_ssize_t pos = 0;
+  long n = 0;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) {
+      PyBuffer_Release(&buf);
+      return err("truncated varint");
+    }
+    uint64_t field = key >> 3;
+    int wire = key & 0x07;
+    if (field == 1) {
+      if (wire != 2) {
+        PyBuffer_Release(&buf);
+        return err("MetricResponse.metrics has wrong wire type");
+      }
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length) ||
+          (uint64_t)(end - pos) < length) {
+        PyBuffer_Release(&buf);
+        return err("truncated Metric");
+      }
+      if (ingest_metric(data, pos, pos + (Py_ssize_t)length, cache) < 0) {
+        PyBuffer_Release(&buf);
+        return nullptr;
+      }
+      pos += (Py_ssize_t)length;
+      ++n;
+    } else {
+      // skip_field semantics for unknown response-level fields
+      if (wire == 0) {
+        uint64_t skip;
+        if (!decode_varint(data, end, &pos, &skip)) {
+          PyBuffer_Release(&buf);
+          return err("truncated varint");
+        }
+      } else if (wire == 1) {
+        if (pos + 8 > end) {
+          PyBuffer_Release(&buf);
+          return err("truncated fixed64");
+        }
+        pos += 8;
+      } else if (wire == 2) {
+        uint64_t length;
+        if (!decode_varint(data, end, &pos, &length) ||
+            (uint64_t)(end - pos) < length) {
+          PyBuffer_Release(&buf);
+          return err("truncated length-delimited field");
+        }
+        pos += (Py_ssize_t)length;
+      } else if (wire == 5) {
+        if (pos + 4 > end) {
+          PyBuffer_Release(&buf);
+          return err("truncated fixed32");
+        }
+        pos += 4;
+      } else {
+        PyBuffer_Release(&buf);
+        return err("unsupported wire type");
+      }
+    }
+  }
+  PyBuffer_Release(&buf);
+  return PyLong_FromLong(n);
+}
+
+PyObject* py_configure(PyObject*, PyObject* args) {
+  PyObject* value_map;  // dict: bytes -> str
+  const char* ici_name;
+  Py_ssize_t ici_len;
+  const char* coll_name;
+  Py_ssize_t coll_len;
+  if (!PyArg_ParseTuple(args, "O!y#y#", &PyDict_Type, &value_map, &ici_name,
+                        &ici_len, &coll_name, &coll_len))
+    return nullptr;
+  if (ici_len >= 128 || coll_len >= 128)
+    return err("metric name too long");
+  for (int i = 0; i < g_n_values; ++i) Py_CLEAR(g_value_map[i].schema);
+  g_n_values = 0;
+  PyObject *k, *v;
+  Py_ssize_t it = 0;
+  while (PyDict_Next(value_map, &it, &k, &v)) {
+    if (!PyBytes_Check(k) || !PyUnicode_Check(v))
+      return err("value_map must be {bytes: str}");
+    Py_ssize_t klen = PyBytes_GET_SIZE(k);
+    if (klen >= 128) return err("metric name too long");
+    if (g_n_values >= kMaxNames) return err("too many value_map entries");
+    memcpy(g_value_map[g_n_values].name, PyBytes_AS_STRING(k), klen);
+    g_value_map[g_n_values].len = klen;
+    Py_INCREF(v);
+    g_value_map[g_n_values].schema = v;
+    ++g_n_values;
+  }
+  memcpy(g_ici_name, ici_name, ici_len);
+  g_ici_len = ici_len;
+  memcpy(g_coll_name, coll_name, coll_len);
+  g_coll_len = coll_len;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"configure", py_configure, METH_VARARGS,
+     "configure(value_map: dict[bytes, str], ici_name: bytes, "
+     "collectives_name: bytes) — pin the metric-name surface."},
+    {"ingest", py_ingest, METH_VARARGS,
+     "ingest(data: bytes, cache: dict) -> int — decode a MetricResponse and "
+     "fold every metric into cache; returns the metric count."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_wirefast",
+                         "fused libtpu MetricResponse decode+ingest",
+                         -1,  // no per-module state; globals above
+                         methods, nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__wirefast(void) {
+  PyObject* m = PyModule_Create(&moduledef);
+  if (!m) return nullptr;
+  g_s_values = PyUnicode_InternFromString("values");
+  g_s_ici = PyUnicode_InternFromString("ici");
+  g_s_collectives = PyUnicode_InternFromString("collectives");
+  g_s_link0 = PyUnicode_InternFromString("link0");
+  g_link_cache = PyDict_New();
+  if (!g_s_values || !g_s_ici || !g_s_collectives || !g_s_link0 ||
+      !g_link_cache) {
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -54,7 +54,10 @@ def decode_varint(data: bytes, pos: int) -> tuple[int, int]:
         pos += 1
         result |= (byte & 0x7F) << shift
         if not byte & 0x80:
-            return result, pos
+            # Truncate to 64 bits like standard protobuf parsers (a 10-byte
+            # varint can carry bits past 63; they are dropped, not kept as a
+            # Python big int — keeps parity with the native decoder).
+            return result & 0xFFFFFFFFFFFFFFFF, pos
         shift += 7
         if shift >= 70:
             raise ValueError("varint too long")

@@ -92,12 +92,11 @@ class FakeLibtpuServer:
         raise AssertionError(name)
 
     def _handle(self, request_bytes: bytes, context) -> bytes:
-        if self.delay:
-            time.sleep(self.delay)
+        start = time.monotonic()
         if self.fail:
             context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
         if self.garble:
-            return b"\xff\xff\xff\xff"
+            return self._sleep_remaining(start, b"\xff\xff\xff\xff")
         name = tpumetrics.decode_request(request_bytes)
         with self._lock:
             self.requests.append(name)
@@ -128,7 +127,18 @@ class FakeLibtpuServer:
                     samples.append(
                         tpumetrics.MetricSample(metric, chip, self._value(metric, chip))
                     )
-        return tpumetrics.encode_response(samples)
+        return self._sleep_remaining(start, tpumetrics.encode_response(samples))
+
+    def _sleep_remaining(self, start: float, response: bytes) -> bytes:
+        """Make total service time equal the scripted delay: the delay models
+        the real (C++) runtime's end-to-end response time, so this fake's
+        Python encode cost is absorbed into it rather than added on top —
+        otherwise the latency harness measures the fake, not the stack."""
+        if self.delay:
+            remaining = self.delay - (time.monotonic() - start)
+            if remaining > 0:
+                time.sleep(remaining)
+        return response
 
 
 def main(argv=None) -> int:  # pragma: no cover - exercised via subprocess

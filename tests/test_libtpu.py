@@ -133,6 +133,7 @@ def test_batched_fetch_is_single_rpc(server):
     devs = col.discover()
     server.requests.clear()
     col.begin_tick()
+    col.wait_ready()  # begin_tick only dispatches; join before asserting
     assert server.requests == [""]  # one RPC covers all metric families
     assert col.sample(devs[0]).values
     col.close()
@@ -144,10 +145,12 @@ def test_legacy_runtime_falls_back_to_per_metric(server):
     devs = col.discover()
     server.requests.clear()
     col.begin_tick()
+    col.wait_ready()
     assert "" in server.requests  # probed once...
     assert set(server.requests) - {""} == set(tpumetrics.ALL_METRICS)
     server.requests.clear()
     col.begin_tick()
+    col.wait_ready()
     assert "" not in server.requests  # ...then remembered the answer
     assert col.sample(devs[0]).values
     col.close()
@@ -159,9 +162,11 @@ def test_transient_outage_does_not_latch_per_metric_mode(server):
     server.fail = True
     col = make_collector(server)
     col.begin_tick()  # outage while probing
+    col.wait_ready()
     server.fail = False
     server.requests.clear()
     col.begin_tick()
+    col.wait_ready()
     assert server.requests == [""]  # batched path retried and won
     col.close()
 
@@ -179,3 +184,80 @@ def test_wire_type_mismatch_is_collector_error(server):
     # And field "metrics" itself as varint:
     with pytest.raises(ValueError):
         tpumetrics.decode_response(codec.field_varint(1, 5))
+
+
+def _metric_bytes(name, chip, *, double=None, varint=None, link=None):
+    from kube_gpu_stats_tpu.proto import codec
+
+    out = codec.field_string(1, name) + codec.field_varint(2, chip)
+    if double is not None:
+        out += codec.field_double(3, double)
+    if varint is not None:
+        out += codec.field_varint(4, varint)
+    if link is not None:
+        out += codec.field_string(6, link)
+    return codec.field_bytes(1, out)
+
+
+def test_python_ingest_is_all_or_nothing():
+    """int(NaN)/int(inf) mid-response must leave the cache untouched on the
+    pure-Python path too (review finding: it used to publish the leading
+    metrics before raising)."""
+    from kube_gpu_stats_tpu.collectors.libtpu import ingest_response_py
+
+    raw = (_metric_bytes(tpumetrics.DUTY_CYCLE, 0, double=42.0) +
+           _metric_bytes(tpumetrics.ICI_TRAFFIC, 0, double=float("nan"),
+                         link="x0"))
+    cache = {}
+    with pytest.raises(ValueError):
+        ingest_response_py(raw, cache)
+    assert cache == {}
+
+
+def test_bad_port_value_contained_to_that_port():
+    """A port emitting inf for a counter metric (OverflowError on int())
+    must not poison data from healthy ports (review finding: OverflowError
+    escaped _refresh and failed the whole tick)."""
+    good = _metric_bytes(tpumetrics.DUTY_CYCLE, 0, double=42.0)
+    bad = _metric_bytes(tpumetrics.ICI_TRAFFIC, 1, double=float("inf"),
+                        link="x0")
+
+    class StubClient:
+        def get_raw(self, metric_name):
+            return [good, bad]
+
+        def close(self):
+            pass
+
+    col = LibtpuCollector(StubClient(), accel_type="tpu-test")
+    col.begin_tick()
+    col.wait_ready()
+    dev = type("D", (), {"index": 0})
+    assert col.sample(dev).values[schema.DUTY_CYCLE.name] == 42.0
+    col.close()
+
+
+def test_bad_value_in_per_metric_mode_contained():
+    """Same inf-containment contract in the legacy per-metric path: one bad
+    family must not take down the collector (review finding)."""
+    good = _metric_bytes(tpumetrics.DUTY_CYCLE, 0, double=42.0)
+    bad = _metric_bytes(tpumetrics.ICI_TRAFFIC, 0, double=float("inf"),
+                        link="x0")
+
+    class StubClient:
+        def get_metric(self, metric_name):
+            raw = bad if metric_name == tpumetrics.ICI_TRAFFIC else good
+            return tpumetrics.decode_response(raw)
+
+        def close(self):
+            pass
+
+    col = LibtpuCollector(StubClient(), accel_type="tpu-test")
+    col._batched = False  # legacy runtime: per-metric requests
+    col.begin_tick()
+    col.wait_ready()
+    dev = type("D", (), {"index": 0})
+    s = col.sample(dev)
+    assert s.values[schema.DUTY_CYCLE.name] == 42.0
+    assert s.ici_counters == {}
+    col.close()
