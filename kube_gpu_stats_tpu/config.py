@@ -43,6 +43,10 @@ class Config:
     mock_devices: int = 4
     use_native: bool = True  # C++ fast path when the shared lib is present
     log_level: str = "info"
+    tls_cert_file: str = ""  # both set = serve HTTPS
+    tls_key_file: str = ""
+    auth_username: str = ""  # + password hash = basic auth on /metrics
+    auth_password_sha256: str = ""
 
     @property
     def textfile_enabled(self) -> bool:
@@ -120,6 +124,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env_bool("NO_NATIVE"),
                    help="disable the C++ fast-path sampler")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", "info"))
+    p.add_argument("--tls-cert-file", default=_env("TLS_CERT_FILE", ""),
+                   help="PEM certificate; with --tls-key-file serves HTTPS")
+    p.add_argument("--tls-key-file", default=_env("TLS_KEY_FILE", ""))
+    p.add_argument("--auth-username", default=_env("AUTH_USERNAME", ""),
+                   help="basic-auth user for all endpoints except "
+                        "/healthz and /readyz (kubelet probes)")
+    p.add_argument("--auth-password-sha256",
+                   default=_env("AUTH_PASSWORD_SHA256", ""),
+                   help="hex sha256 of the basic-auth password (never the "
+                        "plaintext)")
     p.add_argument("--config", default=_env("CONFIG", ""),
                    help="YAML config file (keys = long flag names); "
                         "precedence: flags > KTS_* env > file > defaults")
@@ -199,6 +213,18 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
             f"--drop-labels may not include device-identity labels "
             f"{sorted(identity)}"
         )
+    if bool(args.tls_cert_file) != bool(args.tls_key_file):
+        parser.error("--tls-cert-file and --tls-key-file must be set together")
+    if bool(args.auth_username) != bool(args.auth_password_sha256):
+        parser.error("--auth-username and --auth-password-sha256 must be "
+                     "set together")
+    if args.auth_password_sha256 and not (
+        len(args.auth_password_sha256) == 64
+        and all(c in "0123456789abcdefABCDEF"
+                for c in args.auth_password_sha256)
+    ):
+        parser.error("--auth-password-sha256 must be a 64-char hex digest "
+                     "(e.g. from `sha256sum`)")
     return Config(
         backend=args.backend,
         interval=args.interval,
@@ -220,4 +246,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         mock_devices=args.mock_devices,
         use_native=not args.no_native,
         log_level=args.log_level,
+        tls_cert_file=args.tls_cert_file,
+        tls_key_file=args.tls_key_file,
+        auth_username=args.auth_username,
+        auth_password_sha256=args.auth_password_sha256,
     )

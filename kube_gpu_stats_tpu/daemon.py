@@ -120,6 +120,10 @@ class Daemon:
             # A few missed intervals = unhealthy (floor for tiny test
             # intervals where scheduling jitter dominates).
             healthz_max_age=max(5.0, cfg.interval * 5),
+            tls_cert_file=cfg.tls_cert_file,
+            tls_key_file=cfg.tls_key_file,
+            auth_username=cfg.auth_username,
+            auth_password_sha256=cfg.auth_password_sha256,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir)

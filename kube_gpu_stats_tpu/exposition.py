@@ -27,18 +27,55 @@ OPENMETRICS_CONTENT_TYPE = (
 )
 
 
+def _gzip_accepted(accept_encoding: str) -> bool:
+    """True when the client's Accept-Encoding allows gzip (a listed gzip
+    with q=0 is an explicit refusal)."""
+    for token in accept_encoding.split(","):
+        parts = token.strip().split(";")
+        if parts[0].strip().lower() in ("gzip", "*"):
+            for param in parts[1:]:
+                key, _, value = param.strip().partition("=")
+                if key.strip() == "q":
+                    try:
+                        return float(value) > 0
+                    except ValueError:
+                        return True
+            return True
+    return False
+
+
 class MetricsServer:
     """Threaded HTTP server for /metrics, /healthz and /.
 
     ``healthz_max_age`` (seconds) makes /healthz return 503 when no snapshot
     has been published for that long — so a dead poll loop fails the
     DaemonSet liveness probe instead of serving stale data forever. 0
-    disables the staleness check (bare-registry uses in tests/tools)."""
+    disables the staleness check (bare-registry uses in tests/tools).
+
+    Web hardening (GPU exporters typically defer this to a sidecar/
+    exporter-toolkit; here it's built in):
+
+    - ``tls_cert_file``/``tls_key_file`` serve HTTPS.
+    - ``auth_username`` + ``auth_password_sha256`` (hex digest) require
+      HTTP basic auth on every path EXCEPT /healthz and /readyz, which
+      kubelet probes hit unauthenticated.
+    - /metrics responses are gzip-compressed when the scraper advertises
+      ``Accept-Encoding: gzip`` (Prometheus always does).
+    """
+
+    # Bodies below this size aren't worth the gzip header overhead.
+    GZIP_MIN_BYTES = 256
 
     def __init__(self, registry: Registry, host: str = "0.0.0.0",
-                 port: int = 9400, healthz_max_age: float = 0.0):
+                 port: int = 9400, healthz_max_age: float = 0.0,
+                 tls_cert_file: str = "", tls_key_file: str = "",
+                 auth_username: str = "", auth_password_sha256: str = ""):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
+        self._auth = (
+            (auth_username, auth_password_sha256.lower())
+            if auth_username else None
+        )
 
         outer = self
 
@@ -48,8 +85,47 @@ class MetricsServer:
             def log_message(self, fmt: str, *args) -> None:
                 log.debug("http: " + fmt, *args)
 
+            def _authorized(self) -> bool:
+                import base64
+                import hashlib
+                import hmac
+
+                expected_user, expected_hash = outer._auth
+                header = self.headers.get("Authorization", "")
+                if not header.startswith("Basic "):
+                    return False
+                try:
+                    decoded = base64.b64decode(header[6:]).decode("utf-8")
+                    user, _, password = decoded.partition(":")
+                except (ValueError, UnicodeDecodeError):
+                    return False
+                digest = hashlib.sha256(password.encode()).hexdigest()
+                # Compare as bytes (compare_digest raises TypeError on
+                # non-ASCII str — a crafted username must 401, not crash
+                # the connection). Both comparisons constant-time; & (not
+                # `and`) avoids the username check short-circuiting into a
+                # timing oracle.
+                return hmac.compare_digest(
+                    user.encode(), expected_user.encode()
+                ) & hmac.compare_digest(
+                    digest.encode(), expected_hash.encode()
+                )
+
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0]
+                encoding = ""
+                if outer._auth is not None and path not in ("/healthz",
+                                                            "/readyz"):
+                    if not self._authorized():
+                        body = b"unauthorized\n"
+                        self.send_response(401)
+                        self.send_header("WWW-Authenticate",
+                                         'Basic realm="kube-tpu-stats"')
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                 if path == "/metrics":
                     # Content negotiation: Prometheus asks for OpenMetrics
                     # with an explicit Accept; default stays text 0.0.4.
@@ -60,11 +136,21 @@ class MetricsServer:
                         .render(openmetrics=use_om)
                         .encode()
                     )
+                    if len(body) >= outer.GZIP_MIN_BYTES and _gzip_accepted(
+                        self.headers.get("Accept-Encoding", "")
+                    ):
+                        import gzip
+
+                        body = gzip.compress(body, compresslevel=6)
+                        encoding = "gzip"
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
                         OPENMETRICS_CONTENT_TYPE if use_om else CONTENT_TYPE,
                     )
+                    self.send_header("Vary", "Accept-Encoding")
+                    if encoding:
+                        self.send_header("Content-Encoding", encoding)
                 elif path == "/healthz":
                     import time
 
@@ -131,6 +217,24 @@ class MetricsServer:
 
         self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
+        if tls_cert_file or tls_key_file:
+            import ssl
+
+            if not (tls_cert_file and tls_key_file):
+                raise ValueError(
+                    "TLS needs both tls_cert_file and tls_key_file"
+                )
+            # Hardened stdlib defaults: TLS >= 1.2, vetted cipher list.
+            context = ssl.create_default_context(ssl.Purpose.CLIENT_AUTH)
+            context.load_cert_chain(tls_cert_file, tls_key_file)
+            # Defer the handshake to the per-connection handler thread —
+            # with the default handshake-on-accept, one client that opens
+            # a TCP connection and sends nothing would wedge the single
+            # accept loop and take down /healthz with it.
+            self._server.socket = context.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self._thread: threading.Thread | None = None
 
     @property

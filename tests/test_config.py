@@ -1,3 +1,5 @@
+import pytest
+
 from kube_gpu_stats_tpu.config import Config, from_args, parse_libtpu_ports
 
 
@@ -151,3 +153,30 @@ def test_tpu_runtime_metrics_ports_env_beats_config_file(tmp_path, monkeypatch):
     assert cfg.libtpu_ports == (8431, 8432)
     monkeypatch.delenv("TPU_RUNTIME_METRICS_PORTS")
     assert from_args(["--config", str(cfg_file)]).libtpu_ports == (9999,)
+
+
+def test_tls_flags_must_come_together():
+    with pytest.raises(SystemExit):
+        from_args(["--tls-cert-file", "/tmp/cert.pem"])
+
+
+def test_auth_flags_must_come_together():
+    with pytest.raises(SystemExit):
+        from_args(["--auth-username", "prom"])
+
+
+def test_auth_hash_must_be_sha256_hex():
+    with pytest.raises(SystemExit):
+        from_args(["--auth-username", "prom",
+                   "--auth-password-sha256", "plaintext-password"])
+
+
+def test_web_hardening_flags_parse():
+    cfg = from_args([
+        "--tls-cert-file", "/etc/tls/cert.pem",
+        "--tls-key-file", "/etc/tls/key.pem",
+        "--auth-username", "prom",
+        "--auth-password-sha256", "a" * 64,
+    ])
+    assert cfg.tls_cert_file == "/etc/tls/cert.pem"
+    assert cfg.auth_username == "prom"

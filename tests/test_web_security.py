@@ -1,0 +1,245 @@
+"""Web-hardening surface of the exposition server: gzip negotiation, HTTP
+basic auth (with kubelet-probe exemptions), and TLS. GPU exporters of this
+genre usually punt these to exporter-toolkit/sidecars; here they're
+built-in (docs/PARITY.md aux table)."""
+
+import base64
+import gzip
+import hashlib
+import ssl
+import subprocess
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu.exposition import MetricsServer, _gzip_accepted
+from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+from kube_gpu_stats_tpu import schema
+
+
+def make_registry(series=300):
+    registry = Registry()
+    builder = SnapshotBuilder()
+    for i in range(series):
+        builder.add(schema.DUTY_CYCLE, float(i), [("chip", str(i))])
+    registry.publish(builder.build())
+    return registry
+
+
+@pytest.fixture
+def server():
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def fetch(port, path="/metrics", headers=None, scheme="http", context=None):
+    request = urllib.request.Request(
+        f"{scheme}://127.0.0.1:{port}{path}", headers=headers or {}
+    )
+    return urllib.request.urlopen(request, timeout=5, context=context)
+
+
+# -- gzip --------------------------------------------------------------------
+
+def test_gzip_when_accepted(server):
+    resp = fetch(server.port, headers={"Accept-Encoding": "gzip"})
+    assert resp.headers["Content-Encoding"] == "gzip"
+    plain = fetch(server.port).read()
+    assert gzip.decompress(resp.read()) == plain
+    assert len(plain) > 1000  # compression actually mattered
+
+
+def test_no_gzip_without_accept(server):
+    resp = fetch(server.port)
+    assert resp.headers.get("Content-Encoding") is None
+
+
+def test_gzip_q0_is_refusal():
+    assert _gzip_accepted("gzip")
+    assert _gzip_accepted("deflate, gzip;q=0.5")
+    assert _gzip_accepted("*")
+    assert not _gzip_accepted("gzip;q=0")
+    assert not _gzip_accepted("deflate")
+    assert not _gzip_accepted("")
+
+
+def test_small_bodies_not_compressed():
+    srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        resp = fetch(srv.port, headers={"Accept-Encoding": "gzip"})
+        assert resp.headers.get("Content-Encoding") is None
+    finally:
+        srv.stop()
+
+
+def test_gzip_composes_with_openmetrics(server):
+    resp = fetch(server.port, headers={
+        "Accept-Encoding": "gzip",
+        "Accept": "application/openmetrics-text;version=1.0.0",
+    })
+    assert resp.headers["Content-Encoding"] == "gzip"
+    text = gzip.decompress(resp.read()).decode()
+    assert text.rstrip().endswith("# EOF")
+
+
+# -- basic auth --------------------------------------------------------------
+
+def auth_header(user, password):
+    token = base64.b64encode(f"{user}:{password}".encode()).decode()
+    return {"Authorization": f"Basic {token}"}
+
+
+@pytest.fixture
+def auth_server():
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_auth_required(auth_server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(auth_server.port)
+    assert err.value.code == 401
+    assert err.value.headers["WWW-Authenticate"].startswith("Basic")
+
+
+def test_auth_wrong_password(auth_server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(auth_server.port, headers=auth_header("prom", "wrong"))
+    assert err.value.code == 401
+
+
+def test_auth_garbage_header(auth_server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(auth_server.port, headers={"Authorization": "Basic !!!not-b64"})
+    assert err.value.code == 401
+
+
+def test_auth_ok(auth_server):
+    resp = fetch(auth_server.port, headers=auth_header("prom", "s3cret"))
+    assert resp.status == 200
+    assert b"accelerator_duty_cycle" in resp.read()
+
+
+def test_probes_exempt_from_auth(auth_server):
+    assert fetch(auth_server.port, "/healthz").status == 200
+    assert fetch(auth_server.port, "/readyz").status == 200
+    # but the debug surface is protected
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(auth_server.port, "/debug/threads")
+    assert err.value.code == 401
+
+
+# -- TLS ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cert_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = d / "cert.pem", d / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    return cert, key
+
+
+def test_tls_scrape(cert_pair):
+    cert, key = cert_pair
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        tls_cert_file=str(cert), tls_key_file=str(key))
+    srv.start()
+    try:
+        context = ssl.create_default_context(cafile=str(cert))
+        resp = fetch(srv.port, scheme="https", context=context)
+        assert b"accelerator_duty_cycle" in resp.read()
+    finally:
+        srv.stop()
+
+
+def test_tls_requires_both_files(cert_pair):
+    cert, _ = cert_pair
+    with pytest.raises(ValueError):
+        MetricsServer(Registry(), host="127.0.0.1", port=0,
+                      tls_cert_file=str(cert))
+
+
+def test_tls_plus_auth(cert_pair):
+    cert, key = cert_pair
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        tls_cert_file=str(cert), tls_key_file=str(key),
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"pw").hexdigest(),
+    )
+    srv.start()
+    try:
+        context = ssl.create_default_context(cafile=str(cert))
+        with pytest.raises(urllib.error.HTTPError) as err:
+            fetch(srv.port, scheme="https", context=context)
+        assert err.value.code == 401
+        resp = fetch(srv.port, scheme="https", context=context,
+                     headers=auth_header("prom", "pw"))
+        assert resp.status == 200
+    finally:
+        srv.stop()
+
+
+def test_auth_non_ascii_username_is_401(auth_server):
+    """compare_digest on str raises TypeError for non-ASCII — a crafted
+    username must produce a clean 401, not a dropped connection (review
+    finding)."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(auth_server.port, headers=auth_header("pröm", "s3cret"))
+    assert err.value.code == 401
+
+
+def test_tls_idle_connection_does_not_block_probes(cert_pair):
+    """A client that connects and never speaks must not wedge the accept
+    loop (review finding: handshake-on-accept serialized all requests
+    behind one silent TCP connection)."""
+    import socket
+
+    cert, key = cert_pair
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        tls_cert_file=str(cert), tls_key_file=str(key))
+    srv.start()
+    try:
+        # Open a raw TCP connection and send nothing.
+        idle = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            context = ssl.create_default_context(cafile=str(cert))
+            resp = fetch(srv.port, "/healthz", scheme="https",
+                         context=context)
+            assert resp.status == 200
+        finally:
+            idle.close()
+    finally:
+        srv.stop()
+
+
+def test_tls_minimum_version_is_modern(cert_pair):
+    """The server context must refuse legacy TLS (create_default_context
+    pins >= 1.2; a bare SSLContext would inherit the system floor)."""
+    cert, key = cert_pair
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        tls_cert_file=str(cert), tls_key_file=str(key))
+    srv.start()
+    try:
+        client = ssl.create_default_context(cafile=str(cert))
+        client.minimum_version = ssl.TLSVersion.TLSv1_2
+        resp = fetch(srv.port, scheme="https", context=client)
+        assert resp.status == 200
+    finally:
+        srv.stop()
