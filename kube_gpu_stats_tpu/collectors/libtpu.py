@@ -29,12 +29,20 @@ from ..proto import tpumetrics
 
 log = logging.getLogger(__name__)
 
-# schema family <- runtime metric name
+# schema value key <- runtime metric name. Percentile families map to
+# schema value keys ("family:pXX") that the snapshot builder expands into
+# the percentile label — the same data-driven table serves the Python and
+# fused-native ingests (native/__init__.py configures _wirefast from it).
 _VALUE_MAP: Mapping[str, str] = {
     tpumetrics.DUTY_CYCLE: schema.DUTY_CYCLE.name,
     tpumetrics.TC_UTIL: schema.TENSORCORE_UTIL.name,
     tpumetrics.HBM_USED: schema.MEMORY_USED.name,
     tpumetrics.HBM_TOTAL: schema.MEMORY_TOTAL.name,
+    tpumetrics.HBM_BW_UTIL: schema.MEMORY_BANDWIDTH_UTIL.name,
+    tpumetrics.UPTIME: schema.UPTIME.name,
+    tpumetrics.DCN_LATENCY_P50: schema.dcn_value_key("p50"),
+    tpumetrics.DCN_LATENCY_P90: schema.dcn_value_key("p90"),
+    tpumetrics.DCN_LATENCY_P99: schema.dcn_value_key("p99"),
 }
 
 

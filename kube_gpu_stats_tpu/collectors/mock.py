@@ -64,8 +64,15 @@ class MockCollector(Collector):
             schema.TENSORCORE_UTIL.name: duty * 0.85,
             schema.MEMORY_USED.name: float(hbm_used),
             schema.MEMORY_TOTAL.name: float(_HBM_TOTAL),
+            schema.MEMORY_BANDWIDTH_UTIL.name: duty * 0.6,
             schema.POWER.name: 90.0 + duty * 2.5,
             schema.TEMPERATURE.name: 35.0 + duty * 0.3,
+            schema.UPTIME.name: float(3600 + tick),
+            # Synthetic multislice DCN latency: a stable spread around a
+            # per-chip base so the percentile ordering p50<p90<p99 holds.
+            schema.dcn_value_key("p50"): 0.0010 + 0.0001 * device.index,
+            schema.dcn_value_key("p90"): 0.0030 + 0.0001 * device.index,
+            schema.dcn_value_key("p99"): 0.0080 + 0.0001 * device.index,
         }
         # Cumulative link counters: constant per-link rate, distinct per chip
         # so multi-host tests can tell series apart.

@@ -256,6 +256,12 @@ class PollLoop:
             for name, value in sample.values.items():
                 spec = by_name.get(name)
                 if spec is None:
+                    expansion = schema.PERCENTILE_VALUE_KEYS.get(name)
+                    if expansion is not None:
+                        pct_spec, percentile = expansion
+                        builder.add(
+                            pct_spec, value, base + [("percentile", percentile)]
+                        )
                     continue
                 builder.add(spec, value, base)
                 if name == schema.MEMORY_TOTAL.name:

@@ -40,13 +40,24 @@ DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
 TC_UTIL = "tpu.runtime.tensorcore.utilization.percent"
 HBM_USED = "tpu.runtime.hbm.memory.usage.bytes"
 HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+HBM_BW_UTIL = "tpu.runtime.hbm.bandwidth.utilization.percent"
 ICI_TRAFFIC = "tpu.runtime.ici.link.traffic.bytes"
 COLLECTIVES = "tpu.runtime.collectives.completed.count"
+UPTIME = "tpu.runtime.uptime.seconds"
+# Multislice (megascale) cross-slice transfer latency; the runtime reports
+# the distribution as one metric per percentile. Absent on single-slice
+# runtimes — the client treats missing families as partial data, not error.
+DCN_LATENCY_P50 = "megascale.dcn.transfer.latency.p50.seconds"
+DCN_LATENCY_P90 = "megascale.dcn.transfer.latency.p90.seconds"
+DCN_LATENCY_P99 = "megascale.dcn.transfer.latency.p99.seconds"
 
-ALL_METRICS = (DUTY_CYCLE, TC_UTIL, HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVES)
+ALL_METRICS = (
+    DUTY_CYCLE, TC_UTIL, HBM_USED, HBM_TOTAL, HBM_BW_UTIL, ICI_TRAFFIC,
+    COLLECTIVES, UPTIME, DCN_LATENCY_P50, DCN_LATENCY_P90, DCN_LATENCY_P99,
+)
 
 # Metrics whose value is integral and arrives in int_value.
-INT_METRICS = frozenset({HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVES})
+INT_METRICS = frozenset({HBM_USED, HBM_TOTAL, ICI_TRAFFIC, COLLECTIVES, UPTIME})
 
 
 class MetricSample(NamedTuple):

@@ -81,6 +81,13 @@ MEMORY_TOTAL = MetricSpec(
     MetricType.GAUGE,
     "Accelerator high-bandwidth memory capacity, in bytes.",
 )
+MEMORY_BANDWIDTH_UTIL = MetricSpec(
+    "accelerator_memory_bandwidth_utilization",
+    MetricType.GAUGE,
+    "Percent of peak accelerator memory (HBM) bandwidth used over the last "
+    "sample window (0-100). Unified-schema analog of DCGM's DRAM-active "
+    "ratio on GPU nodes.",
+)
 POWER = MetricSpec(
     "accelerator_power_watts",
     MetricType.GAUGE,
@@ -111,6 +118,20 @@ COLLECTIVE_OPS = MetricSpec(
     "Cumulative collective operations (all-reduce/all-gather/...) executed "
     "by the runtime on this chip since reset.",
 )
+DCN_LATENCY = MetricSpec(
+    "accelerator_dcn_transfer_latency_seconds",
+    MetricType.GAUGE,
+    "Cross-slice (DCN) buffer-transfer latency distribution over the last "
+    "sample window, in seconds, as runtime-reported percentiles. Only "
+    "present on multislice workloads; single-slice runtimes omit it.",
+    extra_labels=("percentile",),
+)
+UPTIME = MetricSpec(
+    "accelerator_uptime_seconds",
+    MetricType.GAUGE,
+    "Seconds since the accelerator runtime (re)initialized this chip. A "
+    "reset to a small value flags a runtime restart between scrapes.",
+)
 DEVICE_UP = MetricSpec(
     "accelerator_up",
     MetricType.GAUGE,
@@ -122,13 +143,33 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     TENSORCORE_UTIL,
     MEMORY_USED,
     MEMORY_TOTAL,
+    MEMORY_BANDWIDTH_UTIL,
     POWER,
     TEMPERATURE,
     ICI_BANDWIDTH,
     ICI_TRAFFIC_TOTAL,
     COLLECTIVE_OPS,
+    DCN_LATENCY,
+    UPTIME,
     DEVICE_UP,
 )
+
+# DCN latency arrives from the runtime as one metric per percentile. Inside
+# a Sample.values mapping each percentile is carried under a *value key*
+# ("<family>:<percentile>" — ':' keeps the key out of the plain-family
+# namespace); the poll loop expands the key into the percentile label at
+# snapshot-build time. Collectors never construct label pairs themselves.
+DCN_PERCENTILES: tuple[str, ...] = ("p50", "p90", "p99")
+
+
+def dcn_value_key(percentile: str) -> str:
+    return f"{DCN_LATENCY.name}:{percentile}"
+
+
+# value key -> (spec, percentile), for the snapshot builder's expansion.
+PERCENTILE_VALUE_KEYS: dict[str, tuple[MetricSpec, str]] = {
+    dcn_value_key(p): (DCN_LATENCY, p) for p in DCN_PERCENTILES
+}
 
 
 # --- Exporter self-observability (SURVEY.md §5) ----------------------------

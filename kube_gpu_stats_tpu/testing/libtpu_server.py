@@ -87,8 +87,18 @@ class FakeLibtpuServer:
             return float((chip + 1) * 1024**3)
         if name == tpumetrics.HBM_TOTAL:
             return float(HBM_TOTAL)
+        if name == tpumetrics.HBM_BW_UTIL:
+            return 30.0 + chip
         if name == tpumetrics.COLLECTIVES:
             return float(100 * (chip + 1))
+        if name == tpumetrics.UPTIME:
+            return float(7200 + chip)
+        if name == tpumetrics.DCN_LATENCY_P50:
+            return 0.001 * (chip + 1)
+        if name == tpumetrics.DCN_LATENCY_P90:
+            return 0.003 * (chip + 1)
+        if name == tpumetrics.DCN_LATENCY_P99:
+            return 0.008 * (chip + 1)
         raise AssertionError(name)
 
     def _handle(self, request_bytes: bytes, context) -> bytes:

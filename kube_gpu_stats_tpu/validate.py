@@ -28,6 +28,7 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 _RANGES = {
     schema.DUTY_CYCLE.name: (0.0, 100.0),
     schema.TENSORCORE_UTIL.name: (0.0, 100.0),
+    schema.MEMORY_BANDWIDTH_UTIL.name: (0.0, 100.0),
     schema.DEVICE_UP.name: (0.0, 1.0),
     schema.TEMPERATURE.name: (-50.0, 150.0),
 }

@@ -51,6 +51,34 @@ def test_begin_tick_then_sample(server):
     col.close()
 
 
+def test_bandwidth_uptime_and_dcn_families(server):
+    col = make_collector(server)
+    devs = col.discover()
+    col.begin_tick()
+    s = col.sample(devs[1])
+    assert s.values[schema.MEMORY_BANDWIDTH_UTIL.name] == 31.0
+    assert s.values[schema.UPTIME.name] == 7201.0
+    assert s.values[schema.dcn_value_key("p50")] == 0.002
+    assert s.values[schema.dcn_value_key("p90")] == 0.006
+    assert s.values[schema.dcn_value_key("p99")] == 0.016
+    col.close()
+
+
+def test_single_slice_runtime_omits_dcn(server):
+    """A runtime without megascale metrics (single-slice) drops the DCN
+    families; everything else still samples and no percentile keys appear."""
+    for name in (tpumetrics.DCN_LATENCY_P50, tpumetrics.DCN_LATENCY_P90,
+                 tpumetrics.DCN_LATENCY_P99):
+        server.drop_metrics.add(name)
+    col = make_collector(server)
+    devs = col.discover()
+    col.begin_tick()
+    s = col.sample(devs[0])
+    assert not any(key in s.values for key in schema.PERCENTILE_VALUE_KEYS)
+    assert schema.DUTY_CYCLE.name in s.values
+    col.close()
+
+
 def test_sample_before_any_tick_raises(server):
     col = make_collector(server)
     devs = col.discover()

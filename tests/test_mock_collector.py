@@ -21,7 +21,10 @@ def test_sample_schema_valid_and_deterministic():
     sa, sb = a.sample(dev), b.sample(dev)
     assert sa.values == sb.values
     assert sa.ici_counters == sb.ici_counters
-    assert set(sa.values) <= {m.name for m in schema.PER_DEVICE_METRICS}
+    allowed = {m.name for m in schema.PER_DEVICE_METRICS} | set(
+        schema.PERCENTILE_VALUE_KEYS
+    )
+    assert set(sa.values) <= allowed
     assert 0.0 <= sa.values[schema.DUTY_CYCLE.name] <= 100.0
     assert sa.values[schema.MEMORY_USED.name] <= sa.values[schema.MEMORY_TOTAL.name]
 

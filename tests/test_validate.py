@@ -64,6 +64,15 @@ def test_out_of_range_detected():
     assert any("outside" in p for p in problems)
 
 
+def test_out_of_range_bandwidth_util_detected():
+    (text,) = render_ticks()
+    line = next(l for l in text.splitlines()
+                if l.startswith("accelerator_memory_bandwidth_utilization{"))
+    bad = text.replace(line, line.rsplit(" ", 1)[0] + " 250")
+    problems = validate.check(bad)
+    assert any("outside" in p for p in problems)
+
+
 def test_duplicate_series_detected():
     (text,) = render_ticks()
     line = next(l for l in text.splitlines()
