@@ -192,34 +192,51 @@ panels = [
           'w{{worker}} chip {{chip}}')],
         "ops", {"x": 12, "y": 20, "w": 12, "h": 8}),
 
-    # Row 5 — exporter self-observability (single series per panel: no
+    # Row 5 — memory system + multislice (C9 extension).
+    timeseries(
+        "HBM bandwidth utilization by chip",
+        [(f'accelerator_memory_bandwidth_utilization{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}}')],
+        "percent", {"x": 0, "y": 28, "w": 12, "h": 8}, max_val=100,
+        description="Percent of peak HBM bandwidth used; sustained high "
+                    "values with low MXU duty cycle = memory-bound."),
+    timeseries(
+        "DCN transfer latency (cross-slice)",
+        [('max by (percentile) (accelerator_dcn_transfer_latency_seconds'
+          f'{{{FILTERS}}})', '{{percentile}}')],
+        "s", {"x": 12, "y": 28, "w": 12, "h": 8}, per_chip=False,
+        description="Worst-chip multislice DCN buffer-transfer latency per "
+                    "runtime-reported percentile. Absent on single-slice "
+                    "workloads."),
+
+    # Row 6 — exporter self-observability (single series per panel: no
     # per-chip identity; sequential hue).
     timeseries(
         "Collection latency quantiles",
         [('histogram_quantile(0.5, sum(rate(collector_poll_duration_seconds_bucket[5m])) by (le))', 'p50'),
          ('histogram_quantile(0.99, sum(rate(collector_poll_duration_seconds_bucket[5m])) by (le))', 'p99')],
-        "s", {"x": 0, "y": 28, "w": 12, "h": 8}, per_chip=False,
+        "s", {"x": 0, "y": 36, "w": 12, "h": 8}, per_chip=False,
         thresholds=[0.050],
         description="Poll-tick wall time; threshold line = 50 ms budget."),
     timeseries(
         "Poll errors by reason",
         [('sum by (reason) (rate(collector_poll_errors_total[5m]))',
           '{{reason}}')],
-        "ops", {"x": 12, "y": 28, "w": 12, "h": 8}, per_chip=False),
+        "ops", {"x": 12, "y": 36, "w": 12, "h": 8}, per_chip=False),
 
-    # Row 6 — fleet health cross-checks.
+    # Row 7 — fleet health cross-checks.
     timeseries(
         "Discovered vs kubelet-allocatable devices",
         [('sum(collector_devices)', 'discovered'),
          ('sum(collector_allocatable_devices{resource="google.com/tpu"})',
           'allocatable (TPU)')],
-        "none", {"x": 0, "y": 36, "w": 12, "h": 8}, per_chip=False,
+        "none", {"x": 0, "y": 44, "w": 12, "h": 8}, per_chip=False,
         description="Divergence = device-plugin/driver disagreement "
                     "(AcceleratorDeviceCountMismatch alert)."),
     timeseries(
         "Exporter memory (RSS)",
         [('process_resident_memory_bytes', '{{instance}}')],
-        "bytes", {"x": 12, "y": 36, "w": 12, "h": 8}, per_chip=False),
+        "bytes", {"x": 12, "y": 44, "w": 12, "h": 8}, per_chip=False),
 ]
 
 dashboard = {

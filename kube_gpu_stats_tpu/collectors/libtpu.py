@@ -256,6 +256,11 @@ class LibtpuCollector(Collector):
         # beats a per-metric fan-out by ~5 round trips; older runtimes that
         # reject the batched form fall back permanently.
         self._batched: bool | None = None
+        # Per-metric mode: families every port rejected with a capability
+        # status (UNIMPLEMENTED/NOT_FOUND/INVALID_ARGUMENT — e.g. megascale
+        # metrics on a single-slice runtime). Latched like _batched so an
+        # old runtime costs the failing round trips once, not every tick.
+        self._unsupported: set[str] = set()
 
     # -- discovery ----------------------------------------------------------
 
@@ -338,6 +343,7 @@ class LibtpuCollector(Collector):
             futures = {
                 name: self._pool.submit(self._client.get_metric, name)
                 for name in tpumetrics.ALL_METRICS
+                if name not in self._unsupported
             }
             for name, future in futures.items():
                 try:
@@ -346,6 +352,12 @@ class LibtpuCollector(Collector):
                         _ingest_sample(s, staged)
                     _merge_cache(staged, cache)
                 except CollectorError as exc:
+                    if getattr(exc, "status_code", None) in _REJECTED:
+                        # Capability answer, not an outage: the runtime
+                        # lacks this family. Stop asking every tick.
+                        self._unsupported.add(name)
+                        log.info("libtpu metric %s unsupported by this "
+                                 "runtime; not polling it again", name)
                     # Partial data is fine (e.g. a runtime build without ICI
                     # counters); a fully-failed fetch poisons the tick below.
                     first_error = first_error or exc

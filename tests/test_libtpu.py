@@ -64,6 +64,29 @@ def test_bandwidth_uptime_and_dcn_families(server):
     col.close()
 
 
+def test_per_metric_mode_stops_polling_unsupported_families(server):
+    """In per-metric fallback mode a family the runtime rejects with a
+    capability status (UNIMPLEMENTED) is latched and never requested again —
+    an old runtime costs the failing RPCs once, not every tick."""
+    server.reject_batch = True
+    server.drop_metrics.update(
+        {tpumetrics.DCN_LATENCY_P50, tpumetrics.DCN_LATENCY_P90,
+         tpumetrics.DCN_LATENCY_P99}
+    )
+    col = make_collector(server)
+    devs = col.discover()
+    server.requests.clear()
+    for _ in range(3):
+        col.begin_tick()
+        col.wait_ready()
+    dropped_requests = [r for r in server.requests
+                        if r in server.drop_metrics]
+    assert len(dropped_requests) == 3  # one probe per family, first tick only
+    s = col.sample(devs[0])
+    assert schema.DUTY_CYCLE.name in s.values
+    col.close()
+
+
 def test_single_slice_runtime_omits_dcn(server):
     """A runtime without megascale metrics (single-slice) drops the DCN
     families; everything else still samples and no percentile keys appear."""
