@@ -160,6 +160,13 @@ class LibtpuClient:
         exc.status_code = (
             first.code() if isinstance(first, grpc.Call) else None
         )
+        # Per-port statuses (None for decode failures): capability latching
+        # must see EVERY port answer "don't have it" — a transient outage on
+        # one port mixed with UNIMPLEMENTED on another is not a capability
+        # answer.
+        exc.status_codes = tuple(
+            e.code() if isinstance(e, grpc.Call) else None for e in errors
+        )
         raise exc
 
     def _fan_out(self, request: bytes) -> list[tuple[bytes | None, Exception | None]]:
@@ -195,11 +202,12 @@ class LibtpuClient:
             self._raise_all_failed(metric_name, errors)
         return samples
 
-    def get_raw(self, metric_name: str) -> list[bytes]:
-        """Fetch one metric family from every port, returning the undecoded
-        response bytes per surviving port (the fused native ingest decodes
-        them). Same error contract as get_metric: raises CollectorError only
-        when every port failed."""
+    def get_raw_with_errors(
+        self, metric_name: str
+    ) -> tuple[list[bytes], list[Exception]]:
+        """Fetch one metric family from every port: (undecoded response
+        bytes per surviving port, per-port transport errors). Never raises —
+        the caller classifies each port's error (capability vs outage)."""
         raws: list[bytes] = []
         errors: list[Exception] = []
         for raw, error in self._fan_out(tpumetrics.encode_request(metric_name)):
@@ -207,9 +215,7 @@ class LibtpuClient:
                 errors.append(error)
             else:
                 raws.append(raw)
-        if errors and not raws:
-            self._raise_all_failed(metric_name, errors)
-        return raws
+        return raws, errors
 
     def close(self) -> None:
         if self._port_pool is not None:
@@ -303,48 +309,83 @@ class LibtpuCollector(Collector):
         failures land in _cache_error for sample() to surface per device."""
         cache: dict[int, dict] = {}
         first_error: CollectorError | None = None
+        try_per_metric = False
+        # Set when every port rejected the "" selector this tick; _batched
+        # only latches False if the per-metric pass then proves the runtime
+        # is actually up (yields data) — a half-initialized runtime briefly
+        # rejecting everything must not permanently downgrade the 1-RPC
+        # batched mode to the ~N-RPC per-metric fan-out.
+        batch_rejected: CollectorError | None = None
 
         _REJECTED = (
             grpc.StatusCode.UNIMPLEMENTED,
             grpc.StatusCode.INVALID_ARGUMENT,
             grpc.StatusCode.NOT_FOUND,
         )
+
+        def capability_rejection(exc: CollectorError) -> bool:
+            """True iff every port answered with a "don't have it" status —
+            the only evidence that justifies latching a family off."""
+            codes = getattr(exc, "status_codes", None) or (
+                getattr(exc, "status_code", None),
+            )
+            return all(code in _REJECTED for code in codes)
+
         if self._batched is not False:
-            try:
-                decode_error: Exception | None = None
-                for raw in self._client.get_raw(""):
-                    try:
-                        self._ingest_response(raw, cache)
-                    except (ValueError, OverflowError) as exc:
-                        # ValueError: different schema / garbled port;
-                        # OverflowError: int(inf) on a counter metric.
-                        # Either way contain it to this port — other ports
-                        # may still be fine.
-                        decode_error = exc
-                if cache:
+            raws, port_errors = self._client.get_raw_with_errors("")
+            decode_error: Exception | None = None
+            for raw in raws:
+                try:
+                    self._ingest_response(raw, cache)
+                except (ValueError, OverflowError) as exc:
+                    # ValueError: different schema / garbled port;
+                    # OverflowError: int(inf) on a counter metric.
+                    # Either way contain it to this port — other ports
+                    # may still be fine.
+                    decode_error = exc
+            rejecting = [
+                e for e in port_errors
+                if isinstance(e, grpc.Call) and e.code() in _REJECTED
+            ]
+            if cache:
+                if rejecting:
+                    # Mixed runtime versions: some port(s) served the
+                    # batched selector, other(s) rejected it. The rejecting
+                    # ports' chips only exist behind per-metric requests —
+                    # top them up this tick, and leave _batched unlatched so
+                    # both paths keep running every tick.
+                    try_per_metric = True
+                elif not port_errors:
                     self._batched = True
-                elif decode_error is not None:
-                    first_error = CollectorError(
-                        f"libtpu metric '' unavailable: {decode_error}"
-                    )
-            except CollectorError as exc:
-                if getattr(exc, "status_code", None) in _REJECTED:
-                    # The runtime answered and rejected the empty selector:
-                    # a capability gap — switch modes permanently.
-                    self._batched = False
-                    log.info("libtpu empty-selector fetch unsupported (%s); "
-                             "using per-metric requests", exc)
-                else:
-                    # Transport failure / outage (runtime not up yet,
-                    # deadline, garbled): report it but keep probing the
-                    # batched path once the runtime returns.
-                    first_error = exc
-        if self._batched is False and first_error is None:
+                # Ports merely down: serve what landed, keep probing "".
+            elif port_errors and len(rejecting) == len(port_errors):
+                # Every port rejected the selector: probe per-metric now,
+                # latch only on evidence (see batch_rejected above).
+                batch_rejected = CollectorError(
+                    f"libtpu metric '' unavailable: {port_errors[0]}"
+                )
+                try_per_metric = True
+            elif port_errors:
+                first_error = CollectorError(
+                    f"libtpu metric '' unavailable: {port_errors[0]}"
+                )
+                if rejecting:
+                    # Reject + unreachable mix: serve what the rejecting
+                    # (answering) ports have via per-metric this tick
+                    # without latching either way.
+                    try_per_metric = True
+            elif decode_error is not None:
+                first_error = CollectorError(
+                    f"libtpu metric '' unavailable: {decode_error}"
+                )
+        if (self._batched is False and first_error is None) or try_per_metric:
             futures = {
                 name: self._pool.submit(self._client.get_metric, name)
                 for name in tpumetrics.ALL_METRICS
                 if name not in self._unsupported
             }
+            unsupported_families: list[str] = []
+            rejection_error: CollectorError | None = None
             for name, future in futures.items():
                 try:
                     staged: dict[int, dict] = {}
@@ -352,12 +393,13 @@ class LibtpuCollector(Collector):
                         _ingest_sample(s, staged)
                     _merge_cache(staged, cache)
                 except CollectorError as exc:
-                    if getattr(exc, "status_code", None) in _REJECTED:
-                        # Capability answer, not an outage: the runtime
-                        # lacks this family. Stop asking every tick.
-                        self._unsupported.add(name)
-                        log.info("libtpu metric %s unsupported by this "
-                                 "runtime; not polling it again", name)
+                    if capability_rejection(exc):
+                        # Capability answer from every port, not an outage:
+                        # latch candidate, and never the tick's error (the
+                        # batched path treats these statuses the same way).
+                        unsupported_families.append(name)
+                        rejection_error = rejection_error or exc
+                        continue
                     # Partial data is fine (e.g. a runtime build without ICI
                     # counters); a fully-failed fetch poisons the tick below.
                     first_error = first_error or exc
@@ -370,6 +412,29 @@ class LibtpuCollector(Collector):
                         f"libtpu metric {name!r} undecodable: {exc}"
                     )
                     log.debug("libtpu ingest of %s failed: %s", name, exc)
+            if unsupported_families and cache:
+                # Latch only when the same tick proved the runtime is up and
+                # answering (some family returned data): a restarting or
+                # half-initialized server that briefly rejects EVERY family
+                # must stay un-latched so the next tick re-probes it all.
+                self._unsupported.update(unsupported_families)
+                log.info("libtpu metrics unsupported by this runtime, "
+                         "not polling again: %s",
+                         ", ".join(sorted(unsupported_families)))
+            elif not cache:
+                # Nothing landed. If the tick's only answers were capability
+                # rejections, surface one of them (with its gRPC status)
+                # rather than the generic "no samples" message.
+                first_error = first_error or rejection_error
+        if batch_rejected is not None:
+            if cache:
+                # The rejection was corroborated by working per-metric
+                # requests in the same tick: a genuine capability gap.
+                self._batched = False
+                log.info("libtpu empty-selector fetch unsupported (%s); "
+                         "using per-metric requests", batch_rejected)
+            else:
+                first_error = first_error or batch_rejected
         with self._lock:
             if cache:
                 self._cache = cache

@@ -1,0 +1,87 @@
+"""Helm chart sanity (component C8 — deployment assets).
+
+`helm` itself is not available in CI, so these tests pin what is checkable
+statically: chart metadata, values parseability, that every `.Values.*`
+path referenced by a template exists in values.yaml (the drift that breaks
+charts in practice), and that the chart's DaemonSet keeps parity with the
+raw-manifest deployment's host surfaces.
+"""
+
+import pathlib
+import re
+
+import yaml
+
+CHART = pathlib.Path(__file__).parent.parent / "deploy" / "helm" / "kube-tpu-stats"
+
+_VALUES_REF = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+
+
+def template_texts():
+    return {p.name: p.read_text() for p in (CHART / "templates").glob("*")}
+
+
+def test_chart_metadata():
+    chart = yaml.safe_load((CHART / "Chart.yaml").read_text())
+    assert chart["apiVersion"] == "v2"
+    assert chart["name"] == "kube-tpu-stats"
+    assert chart["version"]
+    assert chart["appVersion"]
+
+
+def test_values_parse():
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    assert values["listenPort"] == 9400
+    assert values["backend"] == "auto"
+
+
+def test_every_values_reference_exists():
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    missing = []
+    for name, text in template_texts().items():
+        for ref in _VALUES_REF.findall(text):
+            node = values
+            for part in ref.split("."):
+                if isinstance(node, dict) and part in node:
+                    node = node[part]
+                else:
+                    missing.append(f"{name}: .Values.{ref}")
+                    break
+    assert missing == [], missing
+
+
+def test_template_braces_balanced():
+    for name, text in template_texts().items():
+        assert text.count("{{") == text.count("}}"), name
+
+
+def test_daemonset_parity_with_raw_manifest():
+    """The chart's DaemonSet must keep the raw manifest's host surfaces:
+    sysfs, PodResources socket, device-plugin checkpoint dir, hostNetwork,
+    TPU toleration, and both health probes."""
+    ds = template_texts()["daemonset.yaml"]
+    for needle in (
+        "mountPath: /sys",
+        "mountPath: /var/lib/kubelet/pod-resources",
+        "mountPath: /var/lib/kubelet/device-plugins",
+        "path: /healthz",
+        "path: /readyz",
+        "readOnlyRootFilesystem: true",
+        "hostNetwork:",
+    ):
+        assert needle in ds, needle
+    raw = (CHART.parent.parent / "daemonset.yaml").read_text()
+    raw_mounts = set(re.findall(r"mountPath: (\S+)", raw))
+    chart_mounts = set(re.findall(r"mountPath: (\S+)", ds))
+    assert raw_mounts <= chart_mounts
+
+
+def test_conditional_templates_are_gated():
+    texts = template_texts()
+    assert texts["servicemonitor.yaml"].startswith(
+        "{{- if .Values.serviceMonitor.enabled }}"
+    )
+    assert texts["serviceaccount.yaml"].startswith(
+        "{{- if .Values.serviceAccount.create }}"
+    )
+    assert texts["service.yaml"].startswith("{{- if .Values.service.enabled }}")

@@ -87,6 +87,77 @@ def test_per_metric_mode_stops_polling_unsupported_families(server):
     col.close()
 
 
+def server_requested_count(server, name):
+    return sum(1 for r in server.requests if r == name)
+
+
+def test_mixed_port_statuses_do_not_latch_unsupported():
+    """One port answering UNIMPLEMENTED while another port is down is NOT a
+    capability answer — the family must be re-requested once the dead port
+    returns (it may be the one that serves megascale metrics)."""
+    with FakeLibtpuServer(num_chips=2) as live:
+        dead = FakeLibtpuServer(num_chips=2, chip_offset=2)
+        dead_port = dead.port  # grabs a port but never starts: UNAVAILABLE
+        live.reject_batch = True
+        live.drop_metrics.add(tpumetrics.DCN_LATENCY_P50)
+        col = LibtpuCollector(
+            LibtpuClient(ports=(live.port, dead_port), rpc_timeout=0.5),
+            accel_type="tpu-test",
+        )
+        try:
+            for _ in range(2):
+                col.begin_tick()
+                col.wait_ready()
+            assert server_requested_count(live, tpumetrics.DCN_LATENCY_P50) == 2
+        finally:
+            col.close()
+            dead.stop()
+
+
+def test_mixed_batch_support_serves_both_ports():
+    """Mixed runtime versions: one port serves the batched "" selector,
+    the other rejects it. The rejecting port's chips must still be sampled
+    (via per-metric top-up) — every tick, with nothing latched."""
+    with FakeLibtpuServer(num_chips=2) as new_rt, \
+            FakeLibtpuServer(num_chips=2, chip_offset=2) as old_rt:
+        old_rt.reject_batch = True
+        col = LibtpuCollector(
+            LibtpuClient(ports=(new_rt.port, old_rt.port), rpc_timeout=0.5),
+            accel_type="tpu-test",
+        )
+        try:
+            for _ in range(2):
+                col.begin_tick()
+                col.wait_ready()
+                for chip in range(4):  # chips 0-1 new_rt, 2-3 old_rt
+                    s = col.sample(type("D", (), {"index": chip}))
+                    assert s.values[schema.DUTY_CYCLE.name] == 50.0 + chip
+        finally:
+            col.close()
+
+
+def test_rejecting_every_family_does_not_latch():
+    """A half-initialized runtime that briefly answers UNIMPLEMENTED for
+    every family must not be latched off permanently: once it recovers, the
+    next tick polls and samples normally."""
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.reject_batch = True
+        server.drop_metrics.update(tpumetrics.ALL_METRICS)
+        col = make_collector(server)
+        col.begin_tick()
+        col.wait_ready()
+        dev_stub = type("D", (), {"index": 0})
+        with pytest.raises(CollectorError):
+            col.sample(dev_stub)
+        server.drop_metrics.clear()  # runtime finished initializing
+        col.begin_tick()
+        col.wait_ready()
+        s = col.sample(col.discover()[0])
+        assert schema.DUTY_CYCLE.name in s.values
+        assert s.values[schema.dcn_value_key("p50")] == 0.001
+        col.close()
+
+
 def test_single_slice_runtime_omits_dcn(server):
     """A runtime without megascale metrics (single-slice) drops the DCN
     families; everything else still samples and no percentile keys appear."""
@@ -274,8 +345,8 @@ def test_bad_port_value_contained_to_that_port():
                         link="x0")
 
     class StubClient:
-        def get_raw(self, metric_name):
-            return [good, bad]
+        def get_raw_with_errors(self, metric_name):
+            return [good, bad], []
 
         def close(self):
             pass
