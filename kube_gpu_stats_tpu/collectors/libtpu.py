@@ -461,10 +461,14 @@ class LibtpuCollector(Collector):
             raise CollectorError(
                 f"libtpu reported no metrics for chip {device.index}"
             )
+        # The returned dicts alias the tick cache: every refresh builds a
+        # brand-new cache wholesale (never mutates a published one), and
+        # Sample consumers are read-only, so handing them out copy-free is
+        # safe and keeps 2 dict copies × N chips off the post-RPC tail.
         return Sample(
             device=device,
-            values=dict(entry["values"]),
-            ici_counters=dict(entry["ici"]),
+            values=entry["values"],
+            ici_counters=entry["ici"],
             collective_ops=entry["collectives"],
         )
 

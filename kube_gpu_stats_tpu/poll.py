@@ -96,6 +96,11 @@ class PollLoop:
         # Retained last-known MEMORY_TOTAL per device so a stale tick keeps
         # capacity gauges stable instead of dropping series.
         self._last_totals: dict[str, float] = {}
+        # Label-list cache: attribution changes on the C3 refresh cadence
+        # (~10 s), not per tick, so the per-device label list is identical
+        # tick over tick. Keyed by the attribution items so a pod churn
+        # invalidates exactly that device's entry.
+        self._label_cache: dict[str, tuple[tuple, list[tuple[str, str]]]] = {}
 
     # -- public --------------------------------------------------------------
 
@@ -120,6 +125,10 @@ class PollLoop:
             log.warning("rediscovery failed, keeping %d known devices: %s",
                         len(self._devices), exc)
             return
+        # Device identity (path, uuid, index) may have changed for a
+        # surviving device_id after a runtime restart; rebuild all label
+        # lists rather than reason about which survived (off hot path).
+        self._label_cache.clear()
         alive = {dev.device_id for dev in self._devices}
         for device_id in list(self._last_totals):
             if device_id not in alive:
@@ -222,6 +231,10 @@ class PollLoop:
 
     def _device_labels(self, dev: Device) -> list[tuple[str, str]]:
         attribution = self._attribution.lookup(dev)
+        cache_key = tuple(sorted(attribution.items()))
+        cached = self._label_cache.get(dev.device_id)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1]
         labels = [
             ("accel_type", dev.accel_type),
             ("chip", str(dev.index)),
@@ -237,6 +250,7 @@ class PollLoop:
                 (key, "" if key in self._drop_labels else value)
                 for key, value in labels
             ]
+        self._label_cache[dev.device_id] = (cache_key, labels)
         return labels
 
     def _build_snapshot(

@@ -143,11 +143,17 @@ class FakeLibtpuServer:
         """Make total service time equal the scripted delay: the delay models
         the real (C++) runtime's end-to-end response time, so this fake's
         Python encode cost is absorbed into it rather than added on top —
-        otherwise the latency harness measures the fake, not the stack."""
+        otherwise the latency harness measures the fake, not the stack.
+        The last ~0.5 ms is spun rather than slept: time.sleep() overshoots
+        by the OS timer slack, which would silently inflate every scripted
+        delay (and the measured p50) by a few hundred µs."""
         if self.delay:
-            remaining = self.delay - (time.monotonic() - start)
-            if remaining > 0:
-                time.sleep(remaining)
+            deadline = start + self.delay
+            remaining = deadline - time.monotonic()
+            if remaining > 0.0005:
+                time.sleep(remaining - 0.0005)
+            while time.monotonic() < deadline:
+                pass
         return response
 
 
