@@ -1,5 +1,13 @@
 """CLI entry point (layer L5, SURVEY.md §1): `kube-tpu-stats` / `python -m
-kube_gpu_stats_tpu`."""
+kube_gpu_stats_tpu`.
+
+Bare flags run the exporter daemon (the DaemonSet entry point). Two
+operational subcommands ride the same binary so a `kubectl exec` into the
+pod has them at hand:
+
+    kube-tpu-stats doctor [exporter flags] [--json] [--url TARGET]
+    kube-tpu-stats validate [--two-scrapes] <url-or-file>
+"""
 
 from __future__ import annotations
 
@@ -11,7 +19,16 @@ from .daemon import run
 
 
 def main(argv: Sequence[str] | None = None) -> int:
-    return run(from_args(argv))
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] == "doctor":
+        from .doctor import main as doctor_main
+
+        return doctor_main(args[1:])
+    if args and args[0] == "validate":
+        from .validate import main as validate_main
+
+        return validate_main(args[1:])
+    return run(from_args(args))
 
 
 if __name__ == "__main__":  # pragma: no cover

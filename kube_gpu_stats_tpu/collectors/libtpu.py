@@ -29,6 +29,16 @@ from ..proto import tpumetrics
 
 log = logging.getLogger(__name__)
 
+# gRPC statuses that are a capability answer ("this runtime doesn't have
+# that") rather than an outage. Load-bearing in two places: the collector's
+# per-family/batched-mode latching below, and doctor's healthy-vs-
+# unreachable port classification — keep them agreeing.
+REJECTED_STATUS = (
+    grpc.StatusCode.UNIMPLEMENTED,
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.NOT_FOUND,
+)
+
 # schema value key <- runtime metric name. Percentile families map to
 # schema value keys ("family:pXX") that the snapshot builder expands into
 # the percentile label — the same data-driven table serves the Python and
@@ -317,11 +327,7 @@ class LibtpuCollector(Collector):
         # batched mode to the ~N-RPC per-metric fan-out.
         batch_rejected: CollectorError | None = None
 
-        _REJECTED = (
-            grpc.StatusCode.UNIMPLEMENTED,
-            grpc.StatusCode.INVALID_ARGUMENT,
-            grpc.StatusCode.NOT_FOUND,
-        )
+        _REJECTED = REJECTED_STATUS
 
         def capability_rejection(exc: CollectorError) -> bool:
             """True iff every port answered with a "don't have it" status —

@@ -1,0 +1,449 @@
+"""Node preflight/diagnosis: `kube-tpu-stats doctor` (also
+`python -m kube_gpu_stats_tpu.doctor`).
+
+The operational analog of the GPU genre's "run nvidia-smi to see if the
+node is healthy" (SURVEY.md §0 [G]): one bounded pass over every
+dependency the exporter has — sysfs device class, libtpu runtime-metric
+ports, kubelet attribution sources, topology labels, the native fast
+path — plus a short measured poll (5 ticks, p50 vs the configured
+deadline) through the production loop.
+Designed for `kubectl exec <pod> -- kube-tpu-stats doctor` on a
+misbehaving node and for initContainer-style preflight in CI.
+
+Accepts the exporter's own flags (same config surface, C6) plus:
+  --json         machine-readable output
+  --url TARGET   also scrape TARGET (URL or .prom file) and check it
+                 against the accelerator_* exposition contract
+
+Exit code: 0 = no failures (warns allowed), 1 = at least one failure.
+Every probe is time-bounded; doctor never hangs on a wedged runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from typing import Callable, Sequence
+
+from .config import Config, from_args
+
+OK, WARN, FAIL, SKIP = "ok", "warn", "fail", "skip"
+_ORDER = {FAIL: 0, WARN: 1, OK: 2, SKIP: 3}
+
+
+@dataclasses.dataclass
+class CheckResult:
+    name: str
+    status: str  # ok | warn | fail | skip
+    detail: str
+
+
+def _result(name: str, status: str, detail: str) -> CheckResult:
+    return CheckResult(name, status, detail)
+
+
+# -- individual probes (each bounded, each returns exactly one result) -------
+
+def check_native(cfg: Config) -> CheckResult:
+    from . import native
+
+    if not cfg.use_native:
+        return _result("native", SKIP, "disabled by --no-native")
+    parts = []
+    try:
+        from .native import binding  # noqa: F401 — import probes the .so
+
+        parts.append("sampler loaded")
+    except Exception:
+        parts.append("sampler absent")
+    try:
+        wf = native.load_wirefast()
+        parts.append("wirefast loaded" if wf is not None else "wirefast absent")
+    except Exception as exc:
+        return _result("native", WARN, f"wirefast failed to load: {exc}")
+    status = OK if all("loaded" in p for p in parts) else WARN
+    hint = "" if status is OK else " (pure-Python fallback active; run " \
+                                  "`make -C kube_gpu_stats_tpu/native`)"
+    return _result("native", status, ", ".join(parts) + hint)
+
+
+def check_sysfs(cfg: Config) -> CheckResult:
+    from .collectors.sysfs import SysfsCollector
+
+    try:
+        col = SysfsCollector(cfg.sysfs_root)
+        devices = col.discover()
+    except Exception as exc:
+        return _result("sysfs", FAIL, f"{cfg.sysfs_root}: {exc}")
+    if not devices:
+        return _result(
+            "sysfs", WARN,
+            f"no devices under {cfg.sysfs_root}/class/accel (expected on "
+            f"CPU-only nodes and TPU VM variants without the accel class)",
+        )
+    attrs: set[str] = set()
+    for dev in devices:
+        try:
+            attrs.update(col.read_environment(dev))
+        except Exception:
+            pass
+    if not attrs:
+        return _result(
+            "sysfs", WARN,
+            f"{len(devices)} chip(s) enumerated but no environmental "
+            f"attribute is readable — missing privileges or hostPath "
+            f"mounts? (power/temperature gauges will be absent)",
+        )
+    return _result(
+        "sysfs", OK,
+        f"{len(devices)} chip(s); environmental attributes: "
+        f"{', '.join(sorted(attrs))}",
+    )
+
+
+def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
+    import grpc
+
+    from .collectors.libtpu import (REJECTED_STATUS, LibtpuClient,
+                                    ingest_response_py)
+    from .proto import tpumetrics
+
+    name = f"libtpu:{port}"
+    client = LibtpuClient(cfg.libtpu_addr, (port,), rpc_timeout=2.0)
+    try:
+        raws, errors = client.get_raw_with_errors("")
+        cache: dict[int, dict] = {}
+        decode_failures = 0
+        for raw in raws:
+            try:
+                ingest_response_py(raw, cache)
+            except (ValueError, OverflowError):
+                decode_failures += 1
+        if cache:
+            families: set[str] = set()
+            for entry in cache.values():
+                families.update(entry["values"])
+                if entry["ici"]:
+                    families.add("ici_traffic")
+                if entry["collectives"] is not None:
+                    families.add("collectives")
+            return _result(
+                name, OK,
+                f"{len(cache)} chip(s), {len(families)} famil"
+                f"{'y' if len(families) == 1 else 'ies'} via batched fetch",
+            )
+        if decode_failures:
+            return _result(
+                name, FAIL,
+                "responds but payload is undecodable (runtime speaking a "
+                "different metric-service schema?)",
+            )
+        # Classify the batched failure from the in-hand errors (the
+        # get_raw_with_errors contract): only a capability rejection
+        # justifies burning a second probe on the per-metric path — a
+        # down/wedged port already has its answer.
+        rejected = REJECTED_STATUS
+        codes = [e.code() for e in errors if isinstance(e, grpc.Call)]
+        if codes and all(code in rejected for code in codes):
+            # Runtime predates the batched selector: probe one named
+            # metric so it still diagnoses as healthy.
+            try:
+                samples = client.get_metric(tpumetrics.HBM_TOTAL)
+            except Exception as exc:
+                code = getattr(exc, "status_code", None)
+                return _result(
+                    name, WARN,
+                    f"rejects the batched selector and per-metric fetch "
+                    f"failed ({code.name if code else exc})",
+                )
+            chips = len(set(s.device_id for s in samples))
+            return _result(
+                name, OK if chips else WARN,
+                f"{chips} chip(s) via per-metric fetch (runtime predates "
+                f"the batched selector)"
+                + ("" if chips else " — port answers but no chip is "
+                                    "collectable through it"),
+            )
+        detail = codes[0].name if codes else (
+            str(errors[0]) if errors else "empty response")
+        return _result(
+            name, WARN,
+            f"unreachable ({detail}); the metric service only serves "
+            f"while a TPU workload is running with "
+            f"TPU_RUNTIME_METRICS_PORTS={port}",
+        )
+    finally:
+        client.close()
+
+
+def check_gpu_sysfs(cfg: Config) -> CheckResult:
+    from .collectors.gpu_sysfs import GpuSysfsCollector
+
+    if cfg.backend not in ("gpu", "auto"):
+        return _result("gpu-sysfs", SKIP, f"backend={cfg.backend}")
+    try:
+        col = GpuSysfsCollector(sysfs_root=cfg.sysfs_root)
+        devices = col.discover()
+    except Exception as exc:
+        return _result("gpu-sysfs", FAIL, str(exc))
+    if not devices:
+        return _result("gpu-sysfs", SKIP,
+                       f"no cards under {cfg.sysfs_root}/class/drm")
+    capable = col.telemetry_capable()
+    return _result(
+        "gpu-sysfs", OK if capable else WARN,
+        f"{len(devices)} card(s); "
+        + ("hwmon telemetry readable" if capable else
+           "card nodes present but no hwmon telemetry (BMC/integrated "
+           "display controller?)"),
+    )
+
+
+def check_attribution(cfg: Config) -> CheckResult:
+    import os
+
+    if cfg.attribution == "off":
+        return _result("attribution", SKIP, "disabled by --attribution off")
+    details = []
+    status = WARN
+    if cfg.attribution in ("auto", "podresources"):
+        if os.path.exists(cfg.kubelet_socket):
+            try:
+                from .attribution.podresources import PodResourcesSource
+
+                src = PodResourcesSource(cfg.kubelet_socket, rpc_timeout=2.0)
+                try:
+                    allocations = src.fetch()
+                    allocatable = src.fetch_allocatable()
+                finally:
+                    src.close()
+                details.append(
+                    f"PodResources: {len(allocations)} allocated device(s), "
+                    f"allocatable {dict(sorted(allocatable.items())) or '{}'}"
+                )
+                status = OK
+            except Exception as exc:
+                details.append(f"PodResources socket exists but List() "
+                               f"failed: {exc}")
+        else:
+            details.append(f"no kubelet socket at {cfg.kubelet_socket} "
+                           f"(normal outside Kubernetes)")
+    if cfg.attribution in ("auto", "checkpoint") and status is not OK:
+        try:
+            from .attribution.checkpoint import CheckpointSource
+
+            count = len(CheckpointSource(cfg.checkpoint_path).fetch())
+            details.append(f"checkpoint file: {count} device(s)")
+            status = OK
+        except Exception as exc:
+            details.append(f"checkpoint fallback unavailable: {exc}")
+    return _result("attribution", status, "; ".join(details))
+
+
+def check_topology(cfg: Config) -> CheckResult:
+    from . import topology
+
+    # use_metadata matches the daemon's own startup resolution (daemon.py):
+    # on GKE nodes without TPU env vars the metadata server is the source,
+    # and doctor must diagnose what the daemon would actually export.
+    labels = topology.topology_labels(use_metadata=True)
+    if any(labels.values()):
+        return _result(
+            "topology", OK,
+            ", ".join(f"{k}={v or '(unset)'}" for k, v in sorted(labels.items())),
+        )
+    return _result(
+        "topology", WARN,
+        "no slice/worker/topology labels resolved from the environment; "
+        "multi-host aggregation needs them (set KTS_SLICE/KTS_WORKER/"
+        "KTS_TOPOLOGY or run under the GKE TPU device plugin)",
+    )
+
+
+def check_poll(cfg: Config, ticks: int = 5) -> CheckResult:
+    """A short real collection run (`ticks` ticks) through the production
+    loop; reports the p50 tick duration against the configured deadline."""
+    from .daemon import build_collector
+    from .poll import PollLoop
+    from .registry import Registry
+
+    try:
+        collector = build_collector(cfg)
+    except Exception as exc:
+        return _result("poll", FAIL, f"collector construction failed: {exc}")
+    try:
+        registry = Registry()
+        loop = PollLoop(collector, registry, deadline=cfg.deadline)
+        if not loop.devices:
+            return _result(
+                "poll", WARN,
+                f"backend={collector.name}: 0 devices — exporter would serve "
+                f"self-metrics only",
+            )
+        durations = sorted(loop.tick() for _ in range(ticks))
+        loop.stop()
+        p50 = durations[len(durations) // 2] * 1000.0
+        series = sum(
+            1 for s in registry.snapshot().series
+            if s.spec.name.startswith("accelerator_")
+        )
+        ups = sum(
+            s.value for s in registry.snapshot().series
+            if s.spec.name == "accelerator_up"
+        )
+        status = OK if p50 <= cfg.deadline * 1000.0 else WARN
+        return _result(
+            "poll", status,
+            f"backend={collector.name}: {len(loop.devices)} device(s), "
+            f"{int(ups)} up, {series} accelerator series, tick p50 "
+            f"{p50:.1f} ms (deadline {cfg.deadline * 1000.0:.0f} ms)",
+        )
+    except Exception as exc:
+        return _result("poll", FAIL, f"tick crashed: {exc}")
+    finally:
+        try:
+            collector.close()
+        except Exception:
+            pass
+
+
+def check_scrape(target: str) -> CheckResult:
+    """Validate a live scrape (or saved .prom) against the exposition
+    contract — doctor's hook into the validate tool."""
+    from . import validate
+
+    import http.client
+
+    try:
+        text = validate._fetch(target)
+    except (OSError, ValueError, http.client.HTTPException) as exc:
+        # ValueError covers UnicodeDecodeError (binary body); HTTPException
+        # covers BadStatusLine — both happen when --url points at something
+        # that isn't a metrics endpoint (e.g. the libtpu gRPC port itself).
+        # ascii() keeps raw response bytes in the message terminal-safe.
+        return _result("scrape", FAIL,
+                       f"{target}: fetch failed: {ascii(str(exc))}")
+    problems = validate.check(text)
+    if problems:
+        head = "; ".join(problems[:3])
+        more = f" (+{len(problems) - 3} more)" if len(problems) > 3 else ""
+        return _result("scrape", FAIL,
+                       f"{len(problems)} contract violation(s): {head}{more}")
+    series = sum(1 for line in text.splitlines()
+                 if line and not line.startswith("#"))
+    return _result("scrape", OK, f"{series} series conform "
+                                 f"to the accelerator_* contract")
+
+
+# -- orchestration -----------------------------------------------------------
+
+PROBE_TIMEOUT = 15.0  # generous: every probe's own RPCs are already bounded
+
+
+def _bounded(name: str, probe: Callable[[], object],
+             timeout: float = PROBE_TIMEOUT) -> list[CheckResult]:
+    """Run one probe on a daemon thread with a hard timeout. This is the
+    'doctor never hangs' guarantee for the unbounded dependencies (a
+    D-state sysfs read on a wedged driver has no EINTR to offer): the
+    probe thread is abandoned, marked FAIL, and — being daemonic — never
+    blocks process exit."""
+    import concurrent.futures
+
+    from .workers import DaemonSamplerPool
+
+    pool = DaemonSamplerPool(1, thread_name_prefix=f"doctor-{name}")
+    try:
+        future = pool.submit(probe)
+        try:
+            result = future.result(timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            return [_result(
+                name, FAIL,
+                f"probe hung for {timeout:.0f}s (wedged driver or runtime?)",
+            )]
+        except Exception as exc:  # a probe bug must not abort the pass
+            return [_result(name, FAIL, f"probe crashed: {exc}")]
+        return result if isinstance(result, list) else [result]
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
+    probes: list[tuple[str, Callable[[], object]]] = [
+        ("native", lambda: check_native(cfg)),
+        ("sysfs", lambda: check_sysfs(cfg)),
+    ]
+    if cfg.backend in ("auto", "tpu"):
+        # One bounded probe per port: a blackholed port must cost ITS
+        # timeout, not eat the budget of every port after it.
+        for port in cfg.libtpu_ports:
+            probes.append((f"libtpu:{port}",
+                           lambda port=port: check_libtpu_port(cfg, port)))
+    probes.extend([
+        ("gpu-sysfs", lambda: check_gpu_sysfs(cfg)),
+        ("attribution", lambda: check_attribution(cfg)),
+        ("topology", lambda: check_topology(cfg)),
+        ("poll", lambda: check_poll(cfg)),
+    ])
+    if url:
+        probes.append(("scrape", lambda: check_scrape(url)))
+    results: list[CheckResult] = []
+    for name, probe in probes:
+        results.extend(_bounded(name, probe))
+    return results
+
+
+def render_text(results: Sequence[CheckResult],
+                out: Callable[[str], None] = print) -> None:
+    width = max(len(r.name) for r in results)
+    for r in results:
+        out(f"[{r.status:>4}] {r.name:<{width}}  {r.detail}")
+    counts = {s: sum(1 for r in results if r.status == s)
+              for s in (OK, WARN, FAIL, SKIP)}
+    verdict = "NOT READY" if counts[FAIL] else "READY"
+    out(f"{verdict}: {counts[OK]} ok, {counts[WARN]} warn, "
+        f"{counts[FAIL]} fail, {counts[SKIP]} skipped")
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    raw = list(sys.argv[1:] if argv is None else argv)
+    as_json = False
+    url = ""
+    args: list[str] = []
+    it = iter(raw)
+    for token in it:
+        if token == "--json":
+            as_json = True
+        elif token == "--url":
+            url = next(it, "")
+            if not url or url.startswith("--"):
+                print("--url requires a target (URL or .prom file)",
+                      file=sys.stderr)
+                return 2
+        elif token.startswith("--url="):
+            url = token[len("--url="):]
+            if not url:
+                print("--url requires a target (URL or .prom file)",
+                      file=sys.stderr)
+                return 2
+        else:
+            args.append(token)
+    cfg = from_args(args)
+    started = time.monotonic()
+    results = run_checks(cfg, url=url)
+    results.sort(key=lambda r: _ORDER[r.status])
+    if as_json:
+        print(json.dumps({
+            "ready": not any(r.status == FAIL for r in results),
+            "elapsed_seconds": round(time.monotonic() - started, 3),
+            "checks": [dataclasses.asdict(r) for r in results],
+        }, indent=2))
+    else:
+        render_text(results)
+    return 1 if any(r.status == FAIL for r in results) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -25,6 +25,7 @@ from . import schema
 from .collectors import Collector, Device, Sample
 from .ici import RateTracker
 from .registry import HistogramState, Registry, SnapshotBuilder
+from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
 
@@ -78,9 +79,10 @@ class PollLoop:
 
         self._devices: Sequence[Device] = collector.discover()
         workers = max_workers or max(4, len(self._devices))
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="sampler"
-        )
+        # Daemon-thread pool, NOT ThreadPoolExecutor: its non-daemon workers
+        # are joined by an interpreter-exit hook, so one sample wedged in a
+        # sick backend would make the process unkillable (workers.py).
+        self._pool = DaemonSamplerPool(workers, thread_name_prefix="sampler")
         self._rates = RateTracker()
         # Futures for samples that missed their deadline but are still
         # running: future.cancel() cannot stop a running call, so until it

@@ -1,0 +1,85 @@
+"""Daemon-thread sampler pool.
+
+`concurrent.futures.ThreadPoolExecutor` creates non-daemon workers and
+registers an interpreter-exit hook that joins them — so one sample call
+wedged inside a sick backend (hung sysfs read on a broken driver) makes
+the *process* unkillable by SIGTERM and hangs `doctor` after it has
+printed its verdict. The poll loop already abandons wedged futures at the
+tick deadline (poll.py stuck-guard); this pool makes the exit path match:
+worker threads are daemonic, created directly (never registered with the
+futures atexit machinery), so process exit is never gated on a stuck
+backend call.
+
+API is the subset of ThreadPoolExecutor the poll loop uses — `submit` and
+`shutdown(wait=False, cancel_futures=True)` — returning real
+`concurrent.futures.Future` objects so callers keep their timeout/cancel
+semantics.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+from typing import Callable
+
+
+class DaemonSamplerPool:
+    def __init__(self, max_workers: int, thread_name_prefix: str = "sampler") -> None:
+        self._work: queue.SimpleQueue = queue.SimpleQueue()
+        self._shutdown = False
+        # Guards the shutdown-flag-check-then-enqueue in submit against
+        # shutdown's drain-then-sentinel: without it a racing submit could
+        # land work behind the sentinels, leaving a Future that never
+        # completes (ThreadPoolExecutor's shutdown lock, re-established).
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(
+                target=self._worker,
+                name=f"{thread_name_prefix}-{i}",
+                daemon=True,
+            )
+            for i in range(max_workers)
+        ]
+        for thread in self._threads:
+            thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            future, fn, args = item
+            if future.set_running_or_notify_cancel():
+                try:
+                    future.set_result(fn(*args))
+                except BaseException as exc:  # noqa: BLE001 - to the waiter
+                    future.set_exception(exc)
+            # Idle workers must not pin the last tick's Sample/Future until
+            # the next item arrives (cpython's thread.py does the same).
+            del item, future, fn, args
+
+    def submit(self, fn: Callable, *args) -> concurrent.futures.Future:
+        future: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("cannot submit after shutdown")
+            self._work.put((future, fn, args))
+        return future
+
+    def shutdown(self, wait: bool = True, *, cancel_futures: bool = False) -> None:
+        with self._lock:
+            self._shutdown = True
+            if cancel_futures:
+                while True:
+                    try:
+                        item = self._work.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not None:  # skip a prior shutdown's sentinel
+                        item[0].cancel()  # (shutdown must stay idempotent)
+            for _ in self._threads:
+                self._work.put(None)
+        if wait:
+            for thread in self._threads:
+                thread.join()

@@ -1,0 +1,113 @@
+"""DaemonSamplerPool: futures semantics and the exit-hang regression — a
+sample wedged inside a sick backend must never make the process unkillable
+(ThreadPoolExecutor's atexit hook would join the stuck worker forever)."""
+
+import concurrent.futures
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kube_gpu_stats_tpu.workers import DaemonSamplerPool
+
+
+def test_submit_result_roundtrip():
+    pool = DaemonSamplerPool(2)
+    try:
+        futures = [pool.submit(lambda x: x * x, i) for i in range(10)]
+        assert [f.result(timeout=5) for f in futures] == [i * i for i in range(10)]
+    finally:
+        pool.shutdown(wait=True)
+
+
+def test_exceptions_delivered_to_waiter():
+    pool = DaemonSamplerPool(1)
+    try:
+        future = pool.submit(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            future.result(timeout=5)
+    finally:
+        pool.shutdown(wait=True)
+
+
+def test_timeout_and_late_completion():
+    release = threading.Event()
+    pool = DaemonSamplerPool(1)
+    try:
+        future = pool.submit(release.wait, 10)
+        with pytest.raises(concurrent.futures.TimeoutError):
+            future.result(timeout=0.05)
+        assert not future.cancel()  # already running
+        release.set()
+        assert future.result(timeout=5) is True
+    finally:
+        pool.shutdown(wait=True)
+
+
+def test_cancel_queued_work_on_shutdown():
+    started = threading.Event()
+    block = threading.Event()
+
+    def task():
+        started.set()
+        return block.wait(10)
+
+    pool = DaemonSamplerPool(1)
+    first = pool.submit(task)
+    assert started.wait(5)  # running, so shutdown cannot cancel it
+    queued = pool.submit(lambda: "never")
+    pool.shutdown(wait=False, cancel_futures=True)
+    assert queued.cancelled()
+    block.set()
+    assert first.result(timeout=5) is True
+    with pytest.raises(RuntimeError):
+        pool.submit(lambda: None)
+
+
+def test_shutdown_idempotent_with_wedged_worker():
+    """Second shutdown() must not trip over the first one's sentinel left
+    unconsumed by a wedged worker (Daemon.stop is 'idempotent-ish')."""
+    block = threading.Event()
+    started = threading.Event()
+    pool = DaemonSamplerPool(1)
+
+    def wedge():
+        started.set()
+        block.wait(30)
+
+    pool.submit(wedge)
+    assert started.wait(5)
+    pool.shutdown(wait=False, cancel_futures=True)
+    pool.shutdown(wait=False, cancel_futures=True)  # must not raise
+    block.set()
+
+
+def test_process_exits_with_wedged_sampler():
+    """A PollLoop whose backend wedges forever: the tick deadline abandons
+    the sample, and interpreter exit must not join the stuck worker."""
+    script = """
+import time
+from kube_gpu_stats_tpu.collectors import Collector, Device
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+class Wedged(Collector):
+    name = "wedged"
+    def discover(self):
+        return [Device(index=0, device_id="0", device_path="/dev/accel0",
+                       accel_type="tpu")]
+    def sample(self, device):
+        time.sleep(3600)
+
+loop = PollLoop(Wedged(), Registry(), deadline=0.05)
+loop.tick()
+loop.stop()
+print("CLEAN-EXIT", flush=True)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert "CLEAN-EXIT" in proc.stdout
+    assert proc.returncode == 0
