@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Install kube-tpu-stats as a systemd service on a plain Cloud TPU VM
+# (the non-Kubernetes half of C8; GKE uses deploy/daemonset.yaml).
+#
+#   sudo deploy/systemd/install.sh            # from a repo checkout
+#
+# Installs the package for the system python3, builds the optional C++
+# fast path when a compiler is present, lays down the unit + env file,
+# and starts the service. Idempotent: re-running upgrades in place.
+
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "${HERE}/../.." && pwd)"
+
+if [[ "$(id -u)" -ne 0 ]]; then
+    echo "error: must run as root (installs a system service)" >&2
+    exit 1
+fi
+
+echo ">> installing package"
+python3 -m pip install --quiet "${REPO}" 2>/dev/null \
+    || PYTHONDONTWRITEBYTECODE=1 python3 -m pip install --quiet \
+         --break-system-packages "${REPO}"
+
+echo ">> building native fast path (optional)"
+if command -v g++ >/dev/null && command -v make >/dev/null; then
+    # Resolve the INSTALLED package, not the checkout: run the probe from /
+    # so sys.path[0]='' can't shadow site-packages with ./kube_gpu_stats_tpu
+    # (the unit imports the installed copy, so that's where the .so must go).
+    NATIVE_DIR="$(cd / && python3 - <<'EOF'
+import pathlib
+import kube_gpu_stats_tpu
+print(pathlib.Path(kube_gpu_stats_tpu.__file__).parent / "native")
+EOF
+)"
+    make -C "${NATIVE_DIR}" || echo "   (native build failed; pure-Python path active)"
+else
+    echo "   (no g++/make; pure-Python path active)"
+fi
+
+echo ">> installing unit + default env"
+install -m 0644 "${HERE}/kube-tpu-stats.service" /etc/systemd/system/
+if [[ ! -f /etc/default/kube-tpu-stats ]]; then
+    install -m 0644 "${HERE}/kube-tpu-stats.env" /etc/default/kube-tpu-stats
+else
+    echo "   (keeping existing /etc/default/kube-tpu-stats)"
+fi
+
+echo ">> starting service"
+systemctl daemon-reload
+systemctl enable --now kube-tpu-stats.service
+systemctl --no-pager --lines 0 status kube-tpu-stats.service || true
+
+echo ">> preflight (with the service's own environment)"
+(
+    set -a
+    # shellcheck disable=SC1091
+    [[ -f /etc/default/kube-tpu-stats ]] && . /etc/default/kube-tpu-stats
+    set +a
+    kube-tpu-stats doctor
+) || echo "   (doctor reported failures; see rows above)"
+echo "done — scrape http://$(hostname):9400/metrics"

@@ -315,10 +315,35 @@ def check_scrape(target: str) -> CheckResult:
     from . import validate
 
     import http.client
+    import ssl
+    import urllib.error
 
     try:
         text = validate._fetch(target)
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            # The exporter's own shipped hardening (--auth-username): the
+            # endpoint is up and enforcing auth. Doctor only has the
+            # password's sha256 (by design), so it cannot authenticate —
+            # that's a hardened-healthy state, not a collection failure.
+            return _result(
+                "scrape", WARN,
+                f"{target}: endpoint is up but requires authentication "
+                f"(HTTP {exc.code}); contract not checked",
+            )
+        return _result("scrape", FAIL, f"{target}: HTTP {exc.code}")
     except (OSError, ValueError, http.client.HTTPException) as exc:
+        # urlopen wraps certificate failures as URLError(reason=SSLError):
+        # with the exporter's own --tls-cert-file being self-signed that's
+        # a hardened-healthy state, not a dead endpoint.
+        reason = getattr(exc, "reason", None)
+        if isinstance(exc, ssl.SSLError) or isinstance(reason, ssl.SSLError):
+            return _result(
+                "scrape", WARN,
+                f"{target}: TLS handshake failed ({reason or exc}) — "
+                f"self-signed --tls-cert-file? scrape it with the cert's "
+                f"CA trusted; the endpoint itself is answering TLS",
+            )
         # ValueError covers UnicodeDecodeError (binary body); HTTPException
         # covers BadStatusLine — both happen when --url points at something
         # that isn't a metrics endpoint (e.g. the libtpu gRPC port itself).

@@ -152,3 +152,54 @@ def test_recording_rules_parse_and_reference_real_metrics():
         assert "record" in rule and "expr" in rule
         for token in METRIC_TOKEN.findall(rule["expr"]):
             assert token in known, f"recording rule references unknown {token}"
+
+
+def test_systemd_unit_shape():
+    """The TPU VM (non-Kubernetes) half of C8: unit parses as INI, restarts
+    on failure, points at the real module, and hardening doesn't break the
+    exporter's two filesystem needs (read /sys, write the textfile dir)."""
+    import configparser
+
+    parser = configparser.ConfigParser(strict=True)
+    # systemd allows repeated keys; none are used in this unit, so strict
+    # INI parsing doubles as a lint that we don't start relying on them.
+    parser.read_string((DEPLOY / "systemd" / "kube-tpu-stats.service").read_text())
+    service = parser["Service"]
+    assert "kube_gpu_stats_tpu" in service["ExecStart"]
+    assert service["Restart"] == "always"
+    assert service["EnvironmentFile"].lstrip("-") == "/etc/default/kube-tpu-stats"
+    # ProtectSystem=strict makes / read-only: the textfile dir must be
+    # carved back out or the TextfileWriter would crash-loop the unit.
+    assert service["ProtectSystem"] == "strict"
+    assert "textfile_collector" in service["ReadWritePaths"]
+    assert parser["Install"]["WantedBy"] == "multi-user.target"
+
+
+def test_systemd_env_file_keys_are_real_flags():
+    """Every KTS_* key in the sample env file must correspond to a real
+    flag (config.py reads KTS_<dest-upper>); a typo here ships a silently
+    ignored setting to every TPU VM install."""
+    from kube_gpu_stats_tpu.config import build_parser
+
+    dests = {
+        "KTS_" + a.dest.upper()
+        for a in build_parser()._actions
+        if a.dest != "help"
+    } | {"KTS_NO_NATIVE",
+         # Read by topology.py (topology_labels/accel_type), not config.py.
+         "KTS_SLICE", "KTS_WORKER", "KTS_TOPOLOGY", "KTS_ACCEL_TYPE"}
+    text = (DEPLOY / "systemd" / "kube-tpu-stats.env").read_text()
+    for line in text.splitlines():
+        line = line.strip().lstrip("# ")
+        if "=" in line and line.split("=")[0].startswith("KTS_"):
+            key = line.split("=")[0]
+            assert key in dests, f"env file sets unknown variable {key}"
+
+
+def test_systemd_installer_references_shipped_files():
+    text = (DEPLOY / "systemd" / "install.sh").read_text()
+    assert "set -euo pipefail" in text
+    for shipped in ("kube-tpu-stats.service", "kube-tpu-stats.env"):
+        assert shipped in text
+        assert (DEPLOY / "systemd" / shipped).exists()
+    assert "doctor" in text  # preflight after install

@@ -135,8 +135,50 @@ def test_scrape_check_against_prom_file(tmp_path, capsys):
     assert "missing labels" in result.detail
 
 
+def test_scrape_hardened_endpoints_warn_not_fail(tmp_path):
+    """The exporter's own shipped hardening must not read as broken: basic
+    auth (doctor only holds the password hash) and self-signed TLS both
+    prove the endpoint is alive — WARN, never FAIL."""
+    import hashlib
+    import subprocess
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    auth_srv = MetricsServer(
+        Registry(), host="127.0.0.1", port=0, auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"pw").hexdigest(),
+    )
+    auth_srv.start()
+    try:
+        result = doctor.check_scrape(f"http://127.0.0.1:{auth_srv.port}/metrics")
+        assert result.status == "warn"
+        assert "requires authentication" in result.detail
+    finally:
+        auth_srv.stop()
+
+    cert, key = tmp_path / "cert.pem", tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1", "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    tls_srv = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                            tls_cert_file=str(cert), tls_key_file=str(key))
+    tls_srv.start()
+    try:
+        result = doctor.check_scrape(f"https://127.0.0.1:{tls_srv.port}/metrics")
+        assert result.status == "warn"
+        assert "TLS handshake failed" in result.detail
+    finally:
+        tls_srv.stop()
+
+
 def test_url_flag_requires_target():
     assert doctor.main(["--url"]) == 2
+    assert doctor.main(["--url="]) == 2
+    assert doctor.main(["--url", "--json"]) == 2
 
 
 def test_url_equals_form(tmp_path, capsys):
