@@ -19,9 +19,18 @@ if [[ "$(id -u)" -ne 0 ]]; then
 fi
 
 echo ">> installing package"
-python3 -m pip install --quiet "${REPO}" 2>/dev/null \
-    || PYTHONDONTWRITEBYTECODE=1 python3 -m pip install --quiet \
-         --break-system-packages "${REPO}"
+PIP_LOG="$(mktemp)"
+trap 'rm -f "${PIP_LOG}"' EXIT
+if ! python3 -m pip install --quiet "${REPO}" 2>"${PIP_LOG}"; then
+    # Only a PEP 668 refusal justifies overriding the distro-managed
+    # environment; any other failure surfaces verbatim.
+    if grep -q "externally-managed-environment" "${PIP_LOG}"; then
+        python3 -m pip install --quiet --break-system-packages "${REPO}"
+    else
+        cat "${PIP_LOG}" >&2
+        exit 1
+    fi
+fi
 
 echo ">> building native fast path (optional)"
 if command -v g++ >/dev/null && command -v make >/dev/null; then

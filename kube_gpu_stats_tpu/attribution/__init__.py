@@ -22,10 +22,10 @@ refresh indexes every id under several normalized candidate keys and
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Mapping, Protocol
 
 from ..collectors import Device
+from ..workers import PeriodicRefresher
 
 log = logging.getLogger(__name__)
 
@@ -87,7 +87,7 @@ def index_allocations(
     return table
 
 
-class CachedAttribution:
+class CachedAttribution(PeriodicRefresher):
     """Background-refreshed map; RPC-free lookups (E4 off the hot path).
 
     On refresh failure the previous map is retained and a warning logged —
@@ -95,13 +95,10 @@ class CachedAttribution:
 
     def __init__(self, source: AllocationSource,
                  refresh_interval: float = 10.0) -> None:
+        super().__init__(refresh_interval, thread_name="attribution-refresh")
         self._source = source
-        self._interval = refresh_interval
         self._map: dict[str, Labels] = {}
         self._allocatable: dict[str, int] = {}
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self.consecutive_failures = 0
 
     def refresh_once(self) -> None:
         try:
@@ -134,24 +131,8 @@ class CachedAttribution:
                 return labels
         return {}
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            self.refresh_once()
-            # Exponential-ish backoff on persistent failure, capped: don't
-            # hammer a dead kubelet socket (SURVEY.md §5 retry-with-backoff).
-            wait = self._interval * min(1 + self.consecutive_failures, 6)
-            self._stop.wait(wait)
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, name="attribution-refresh", daemon=True
-        )
-        self._thread.start()
-
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
+        super().stop()
         self._source.close()
 
 

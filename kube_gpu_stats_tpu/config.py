@@ -32,6 +32,8 @@ class Config:
     pushgateway_url: str = ""  # empty = push disabled
     pushgateway_job: str = "kube-tpu-stats"
     sysfs_root: str = "/sys"
+    proc_root: str = "/proc"
+    device_processes: str = "on"  # accelerator_process_open scan (on|off)
     libtpu_ports: tuple[int, ...] = (DEFAULT_LIBTPU_PORT,)
     libtpu_addr: str = "127.0.0.1"
     attribution: str = "auto"  # auto|podresources|checkpoint|off
@@ -95,6 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pushgateway-job",
                    default=_env("PUSHGATEWAY_JOB", "kube-tpu-stats"))
     p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"))
+    p.add_argument("--proc-root", default=_env("PROC_ROOT", "/proc"))
+    p.add_argument("--device-processes", choices=("on", "off"),
+                   default=_env("DEVICE_PROCESSES", "on"),
+                   help="export accelerator_process_open (which processes "
+                        "hold each device node; procfs scan on the "
+                        "attribution cadence). In Kubernetes the pod needs "
+                        "hostPID to see beyond its own namespace")
     p.add_argument("--libtpu-addr", default=_env("LIBTPU_ADDR", "127.0.0.1"))
     p.add_argument("--libtpu-ports",
                    default=_env("LIBTPU_PORTS",
@@ -174,6 +183,12 @@ def _apply_config_file(parser: argparse.ArgumentParser, path: str) -> None:
             continue  # env beats file
         if isinstance(value, list):  # libtpu_ports / drop_labels as lists
             value = ",".join(str(v) for v in value)
+        if (isinstance(value, bool) and action.choices is not None
+                and ("on" in action.choices or "off" in action.choices)):
+            # YAML 1.1 parses a bare `on`/`off` as a boolean before we ever
+            # see it; map it back so the documented spelling works unquoted
+            # (covers both device_processes on|off and attribution ...|off).
+            value = "on" if value else "off"
         if not isinstance(value, (str, int, float, bool)):
             parser.error(f"--config: key {key!r} must be a scalar or list")
         # Defaults bypass argparse validation, so apply the action's type
@@ -235,6 +250,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         pushgateway_url=args.pushgateway_url,
         pushgateway_job=args.pushgateway_job,
         sysfs_root=args.sysfs_root,
+        proc_root=args.proc_root,
+        device_processes=args.device_processes,
         libtpu_addr=args.libtpu_addr,
         libtpu_ports=parse_libtpu_ports(args.libtpu_ports),
         attribution=args.attribution,

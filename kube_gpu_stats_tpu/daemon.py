@@ -18,6 +18,7 @@ from .collectors import Collector
 from .collectors.mock import MockCollector, NullCollector
 from .exposition import MetricsServer, PushgatewayPusher, TextfileWriter
 from .poll import AttributionProvider, NullAttribution, PollLoop
+from .procopen import DeviceProcessWatcher
 from .registry import Registry
 
 log = logging.getLogger(__name__)
@@ -104,6 +105,18 @@ class Daemon:
         self.registry = Registry()
         self.collector = build_collector(cfg)
         self.attribution = build_attribution(cfg)
+        # Per-process device holders (accelerator_process_open): the lazy
+        # paths_fn closes over self.poll, which exists before the watcher's
+        # first refresh (start()).
+        self.procwatch = (
+            DeviceProcessWatcher(
+                lambda: [d.device_path for d in self.poll.devices],
+                proc_root=cfg.proc_root,
+                refresh_interval=cfg.attribution_interval,
+            )
+            if cfg.device_processes == "on"
+            else None
+        )
         self.poll = PollLoop(
             self.collector,
             self.registry,
@@ -114,6 +127,7 @@ class Daemon:
             version=__version__,
             rediscovery_interval=cfg.rediscovery_interval,
             drop_labels=cfg.drop_labels,
+            process_openers=self.procwatch.lookup if self.procwatch else None,
         )
         self.server = MetricsServer(
             self.registry, cfg.listen_host, cfg.listen_port,
@@ -141,6 +155,8 @@ class Daemon:
         starter = getattr(self.attribution, "start", None)
         if starter:
             starter()
+        if self.procwatch:
+            self.procwatch.start()
         self.server.start()
         if self.textfile:
             self.textfile.start()
@@ -155,6 +171,8 @@ class Daemon:
 
     def stop(self) -> None:
         self.poll.stop()
+        if self.procwatch:
+            self.procwatch.stop()
         if self.textfile:
             self.textfile.stop()
         if self.pusher:

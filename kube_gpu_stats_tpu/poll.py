@@ -60,6 +60,7 @@ class PollLoop:
         rediscovery_interval: float = 60.0,
         process_metrics: bool = True,
         drop_labels: Sequence[str] = (),
+        process_openers: Callable[[str], Sequence[tuple[int, str]]] | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -75,6 +76,9 @@ class PollLoop:
         # emitted as "" rather than removed — the label SET stays constant
         # so series identity is stable regardless of operator config.
         self._drop_labels = frozenset(drop_labels)
+        # Cached device→holding-process map (procopen.py); a dict read,
+        # same off-hot-path contract as attribution. None = disabled.
+        self._process_openers = process_openers
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -290,6 +294,14 @@ class PollLoop:
                     builder.add(schema.ICI_BANDWIDTH, rate, link_labels)
             if sample.collective_ops is not None:
                 builder.add(schema.COLLECTIVE_OPS, float(sample.collective_ops), base)
+        if self._process_openers is not None:
+            for dev, _ in results:
+                base = self._device_labels(dev)
+                for pid, comm in self._process_openers(dev.device_path):
+                    builder.add(
+                        schema.PROCESS_OPEN, 1.0,
+                        base + [("pid", str(pid)), ("comm", comm)],
+                    )
 
         builder.add(schema.SELF_DEVICES, float(len(results)))
         allocatable = getattr(self._attribution, "allocatable", None)

@@ -1,0 +1,101 @@
+"""Which processes hold each accelerator device node open (procfs scan).
+
+The GPU genre exports a per-process view (nvidia-smi's process table /
+DCGM per-process accounting) that NVML hands it for free. There is no
+NVML here; the TPU-native equivalent is the kernel's own bookkeeping:
+a process using a chip holds an open fd on ``/dev/accel*`` (or vfio
+device nodes), visible as ``/proc/<pid>/fd/*`` symlinks. On plain TPU
+VMs — where there is no kubelet to attribute against (SURVEY.md §2 C3)
+— this is the only workload attribution available.
+
+Exported as ``accelerator_process_open{..., pid, comm} 1`` per holder.
+Scanning every fd of every process is far too slow for the poll tick, so
+the watcher runs on the attribution cadence (E4, default 10 s) and the
+poll loop reads its cached result — same off-hot-path contract as the
+kubelet join.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Sequence
+
+from .workers import PeriodicRefresher
+
+log = logging.getLogger(__name__)
+
+# Cardinality guard: one series per (chip, pid); a pathological node with
+# thousands of holders must not blow up the registry or the scrape.
+MAX_HOLDERS_PER_DEVICE = 32
+
+
+def scan(proc_root: str, device_paths: Sequence[str]) -> dict[str, list[tuple[int, str]]]:
+    """One pass over ``<proc_root>``: device_path -> [(pid, comm), ...].
+
+    Never raises: unreadable entries (processes exiting mid-scan, fds we
+    lack permission for) are skipped; missing /proc yields {}.
+    """
+    wanted = set(device_paths)
+    out: dict[str, list[tuple[int, str]]] = {path: [] for path in wanted}
+    if not wanted:
+        return out
+    try:
+        pids = [e for e in os.listdir(proc_root) if e.isdigit()]
+    except OSError:
+        return out
+    for pid in pids:
+        fd_dir = os.path.join(proc_root, pid, "fd")
+        try:
+            fds = os.listdir(fd_dir)
+        except OSError:
+            continue  # gone, or not ours to read (no hostPID / not root)
+        held: set[str] = set()
+        for fd in fds:
+            try:
+                target = os.readlink(os.path.join(fd_dir, fd))
+            except OSError:
+                continue
+            if target in wanted:
+                held.add(target)
+        if not held:
+            continue
+        try:
+            with open(os.path.join(proc_root, pid, "comm")) as f:
+                comm = f.read().strip()
+        except OSError:
+            comm = ""
+        for path in held:
+            holders = out[path]
+            if len(holders) < MAX_HOLDERS_PER_DEVICE:
+                holders.append((int(pid), comm))
+    return out
+
+
+class DeviceProcessWatcher(PeriodicRefresher):
+    """Cached device→holders map, refreshed on its own thread (never on
+    the poll path). ``lookup`` is a dict read; a failing scan keeps the
+    previous map and backs off (same last-good + backoff semantics as the
+    attribution watcher, via the shared PeriodicRefresher scaffold)."""
+
+    def __init__(
+        self,
+        paths_fn: Callable[[], Sequence[str]],
+        proc_root: str = "/proc",
+        refresh_interval: float = 10.0,
+    ) -> None:
+        super().__init__(refresh_interval, thread_name="procopen-watcher")
+        self._paths_fn = paths_fn
+        self._proc_root = proc_root
+        self._cache: dict[str, list[tuple[int, str]]] = {}
+
+    def refresh_once(self) -> None:
+        try:
+            self._cache = scan(self._proc_root, list(self._paths_fn()))
+            self.consecutive_failures = 0
+        except Exception as exc:  # defensive: watcher must never die
+            self.consecutive_failures += 1
+            log.warning("device-process scan failed (keeping last map): %s", exc)
+
+    def lookup(self, device_path: str) -> list[tuple[int, str]]:
+        return self._cache.get(device_path, [])

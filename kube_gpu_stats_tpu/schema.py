@@ -137,6 +137,15 @@ DEVICE_UP = MetricSpec(
     MetricType.GAUGE,
     "1 if the last poll of this device succeeded, 0 if it is stale/erroring.",
 )
+PROCESS_OPEN = MetricSpec(
+    "accelerator_process_open",
+    MetricType.GAUGE,
+    "Constant 1 per process currently holding this device node open "
+    "(procfs fd scan — the NVML-free analog of nvidia-smi's process "
+    "table). The workload attribution that works on plain TPU VMs with "
+    "no kubelet; refreshed on the attribution cadence, not per tick.",
+    extra_labels=("pid", "comm"),
+)
 
 PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     DUTY_CYCLE,
@@ -152,6 +161,7 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     DCN_LATENCY,
     UPTIME,
     DEVICE_UP,
+    PROCESS_OPEN,
 )
 
 # DCN latency arrives from the runtime as one metric per percentile. Inside

@@ -24,6 +24,41 @@ import threading
 from typing import Callable
 
 
+class PeriodicRefresher:
+    """Background cache-refresh scaffold shared by the attribution watcher
+    and the device-process watcher (E4-cadence jobs, never on the poll
+    path): daemon thread, `refresh_once()` per period, capped backoff on
+    persistent failure so a dead dependency isn't hammered. Subclasses
+    implement refresh_once() and maintain `consecutive_failures`."""
+
+    def __init__(self, refresh_interval: float, thread_name: str) -> None:
+        self._interval = refresh_interval
+        self._thread_name = thread_name
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.consecutive_failures = 0
+
+    def refresh_once(self) -> None:
+        raise NotImplementedError
+
+    def _run(self) -> None:
+        while not self._stop_event.is_set():
+            self.refresh_once()
+            wait = self._interval * min(1 + self.consecutive_failures, 6)
+            self._stop_event.wait(wait)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=self._thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
 class DaemonSamplerPool:
     def __init__(self, max_workers: int, thread_name_prefix: str = "sampler") -> None:
         self._work: queue.SimpleQueue = queue.SimpleQueue()

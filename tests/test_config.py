@@ -180,3 +180,22 @@ def test_web_hardening_flags_parse():
     ])
     assert cfg.tls_cert_file == "/etc/tls/cert.pem"
     assert cfg.auth_username == "prom"
+
+
+def test_config_file_yaml11_on_off_booleans(tmp_path):
+    """YAML 1.1 parses bare on/off as booleans; the documented spelling
+    `device_processes: on` must still work unquoted."""
+    cfg_file = tmp_path / "kts.yaml"
+    cfg_file.write_text("device_processes: off\n")
+    cfg = from_args(["--config", str(cfg_file)])
+    assert cfg.device_processes == "off"
+    cfg_file.write_text("device_processes: on\n")
+    assert from_args(["--config", str(cfg_file)]).device_processes == "on"
+
+
+def test_config_file_yaml11_off_for_non_on_choices(tmp_path):
+    """`attribution: off` — choices without an 'on' member — must also
+    survive the YAML 1.1 boolean parse."""
+    cfg_file = tmp_path / "kts.yaml"
+    cfg_file.write_text("attribution: off\n")
+    assert from_args(["--config", str(cfg_file)]).attribution == "off"

@@ -1,0 +1,120 @@
+"""accelerator_process_open: procfs fd-scan correctness against a fixture
+/proc tree, cardinality bounding, watcher last-good semantics, and the
+poll-loop emission path."""
+
+import os
+
+from kube_gpu_stats_tpu import procopen, schema
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+
+def make_proc(root, pids):
+    """pids: {pid: (comm, [fd targets])}."""
+    for pid, (comm, targets) in pids.items():
+        fd_dir = root / str(pid) / "fd"
+        fd_dir.mkdir(parents=True)
+        (root / str(pid) / "comm").write_text(comm + "\n")
+        for i, target in enumerate(targets):
+            os.symlink(target, fd_dir / str(i))
+    # Non-pid entries a real /proc has; the scanner must skip them.
+    (root / "self").mkdir(exist_ok=True)
+    (root / "meminfo").write_text("MemTotal: 1 kB\n")
+
+
+def test_scan_maps_holders_to_devices(tmp_path):
+    make_proc(tmp_path, {
+        101: ("python3", ["/dev/accel0", "/dev/null", "/dev/accel1"]),
+        102: ("libtpu_worker", ["/dev/accel1"]),
+        103: ("bash", ["/dev/pts/0"]),
+    })
+    result = procopen.scan(str(tmp_path), ["/dev/accel0", "/dev/accel1"])
+    assert result["/dev/accel0"] == [(101, "python3")]
+    assert sorted(result["/dev/accel1"]) == [(101, "python3"),
+                                            (102, "libtpu_worker")]
+
+
+def test_scan_survives_unreadable_and_vanishing_entries(tmp_path):
+    make_proc(tmp_path, {201: ("worker", ["/dev/accel0"])})
+    # A pid dir with no fd dir (process exited mid-scan).
+    (tmp_path / "202").mkdir()
+    # A dangling fd symlink target is still a string match candidate.
+    result = procopen.scan(str(tmp_path), ["/dev/accel0"])
+    assert result["/dev/accel0"] == [(201, "worker")]
+    # Missing /proc entirely: empty map for every device, no raise.
+    assert procopen.scan(str(tmp_path / "nope"), ["/dev/accel0"]) == {
+        "/dev/accel0": []
+    }
+    assert procopen.scan(str(tmp_path), []) == {}
+
+
+def test_scan_caps_holder_cardinality(tmp_path):
+    make_proc(tmp_path, {
+        1000 + i: (f"w{i}", ["/dev/accel0"])
+        for i in range(procopen.MAX_HOLDERS_PER_DEVICE + 10)
+    })
+    result = procopen.scan(str(tmp_path), ["/dev/accel0"])
+    assert len(result["/dev/accel0"]) == procopen.MAX_HOLDERS_PER_DEVICE
+
+
+def test_missing_comm_yields_empty_string(tmp_path):
+    make_proc(tmp_path, {301: ("x", ["/dev/accel0"])})
+    (tmp_path / "301" / "comm").unlink()
+    result = procopen.scan(str(tmp_path), ["/dev/accel0"])
+    assert result["/dev/accel0"] == [(301, "")]
+
+
+def test_watcher_keeps_last_good_map(tmp_path):
+    make_proc(tmp_path, {401: ("train", ["/dev/accel0"])})
+    watcher = procopen.DeviceProcessWatcher(
+        lambda: ["/dev/accel0"], proc_root=str(tmp_path))
+    watcher.refresh_once()
+    assert watcher.lookup("/dev/accel0") == [(401, "train")]
+
+    def boom():
+        raise RuntimeError("discover broke")
+
+    watcher._paths_fn = boom
+    watcher.refresh_once()  # must not raise; keeps the last map
+    assert watcher.lookup("/dev/accel0") == [(401, "train")]
+    assert watcher.lookup("/dev/other") == []
+
+
+def test_poll_loop_emits_process_open_series(tmp_path):
+    registry = Registry()
+    openers = {"/dev/accel0": [(7, "jax_worker")], "/dev/accel1": []}
+    loop = PollLoop(
+        MockCollector(num_devices=2), registry, deadline=5.0,
+        process_openers=lambda path: openers.get(path, []),
+    )
+    loop.tick()
+    loop.stop()
+    series = [s for s in registry.snapshot().series
+              if s.spec.name == schema.PROCESS_OPEN.name]
+    assert len(series) == 1
+    labels = dict(series[0].labels)
+    assert labels["pid"] == "7"
+    assert labels["comm"] == "jax_worker"
+    assert labels["chip"] == "0"
+    assert series[0].value == 1.0
+    # Full base label set rides along (exposition contract).
+    assert set(schema.ALL_BASE_LABELS) <= set(labels)
+
+
+def test_daemon_wires_watcher_only_when_enabled(tmp_path):
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    on = Daemon(Config(backend="mock", attribution="off",
+                       proc_root=str(tmp_path), listen_port=0))
+    try:
+        assert on.procwatch is not None
+    finally:
+        on.collector.close()
+    off = Daemon(Config(backend="mock", attribution="off",
+                        device_processes="off", listen_port=0))
+    try:
+        assert off.procwatch is None
+    finally:
+        off.collector.close()
