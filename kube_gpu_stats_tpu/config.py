@@ -31,6 +31,10 @@ class Config:
     textfile_dir: str = ""  # empty = textfile output disabled
     pushgateway_url: str = ""  # empty = push disabled
     pushgateway_job: str = "kube-tpu-stats"
+    remote_write_url: str = ""  # empty = remote_write disabled
+    remote_write_job: str = "kube-tpu-stats"
+    remote_write_interval: float = 15.0
+    remote_write_bearer_token_file: str = ""
     sysfs_root: str = "/sys"
     proc_root: str = "/proc"
     device_processes: str = "on"  # accelerator_process_open scan (on|off)
@@ -96,6 +100,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Prometheus Pushgateway base URL; empty disables")
     p.add_argument("--pushgateway-job",
                    default=_env("PUSHGATEWAY_JOB", "kube-tpu-stats"))
+    p.add_argument("--remote-write-url",
+                   default=_env("REMOTE_WRITE_URL", ""),
+                   help="Prometheus remote_write 1.0 receiver endpoint "
+                        "(Mimir/Thanos/GMP); empty disables")
+    p.add_argument("--remote-write-job",
+                   default=_env("REMOTE_WRITE_JOB", "kube-tpu-stats"),
+                   help="job label stamped on every remote-written series")
+    p.add_argument("--remote-write-interval", type=float,
+                   default=float(_env("REMOTE_WRITE_INTERVAL", "15.0")),
+                   help="minimum seconds between remote-write pushes")
+    p.add_argument("--remote-write-bearer-token-file",
+                   default=_env("REMOTE_WRITE_BEARER_TOKEN_FILE", ""),
+                   help="file with a bearer token for the receiver "
+                        "(re-read per push; rotating tokens work)")
     p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"))
     p.add_argument("--proc-root", default=_env("PROC_ROOT", "/proc"))
     p.add_argument("--device-processes", choices=("on", "off"),
@@ -249,6 +267,10 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         textfile_dir=args.textfile_dir,
         pushgateway_url=args.pushgateway_url,
         pushgateway_job=args.pushgateway_job,
+        remote_write_url=args.remote_write_url,
+        remote_write_job=args.remote_write_job,
+        remote_write_interval=args.remote_write_interval,
+        remote_write_bearer_token_file=args.remote_write_bearer_token_file,
         sysfs_root=args.sysfs_root,
         proc_root=args.proc_root,
         device_processes=args.device_processes,

@@ -150,6 +150,16 @@ class Daemon:
             if cfg.pushgateway_url
             else None
         )
+        self.remote_writer = None
+        if cfg.remote_write_url:
+            from .remote_write import RemoteWriter
+
+            self.remote_writer = RemoteWriter(
+                self.registry, cfg.remote_write_url,
+                job=cfg.remote_write_job,
+                min_interval=cfg.remote_write_interval,
+                bearer_token_file=cfg.remote_write_bearer_token_file,
+            )
 
     def start(self) -> None:
         starter = getattr(self.attribution, "start", None)
@@ -162,6 +172,8 @@ class Daemon:
             self.textfile.start()
         if self.pusher:
             self.pusher.start()
+        if self.remote_writer:
+            self.remote_writer.start()
         self.poll.start()
         log.info(
             "kube-tpu-stats %s: backend=%s devices=%d listening on %s:%d",
@@ -177,6 +189,8 @@ class Daemon:
             self.textfile.stop()
         if self.pusher:
             self.pusher.stop()
+        if self.remote_writer:
+            self.remote_writer.stop()
         self.server.stop()
         stopper = getattr(self.attribution, "stop", None)
         if stopper:

@@ -18,6 +18,7 @@ import threading
 from pathlib import Path
 
 from .registry import Registry
+from .workers import PublishFollower
 
 log = logging.getLogger(__name__)
 
@@ -255,29 +256,25 @@ class MetricsServer:
             self._thread.join(timeout=5)
 
 
-class PushgatewayPusher:
+class PushgatewayPusher(PublishFollower):
     """Pushes each published snapshot to a Prometheus Pushgateway
     (PUT <url>/metrics/job/<job>/instance/<instance>) — exposition mode #3
-    for nodes/jobs that Prometheus can't scrape directly. Mirrors the
-    TextfileWriter's publish-following loop; push failures are logged and
-    retried on the next publish (never fatal)."""
+    for nodes/jobs that Prometheus can't scrape directly. Push failures
+    are logged and retried with the scaffold's capped backoff (never
+    fatal)."""
 
     def __init__(self, registry: Registry, url: str, job: str = "kube-tpu-stats",
                  instance: str = "", min_interval: float = 1.0) -> None:
         import socket
         import urllib.parse
 
-        self._registry = registry
+        super().__init__(registry, min_interval, thread_name="pushgateway")
         instance = instance or socket.gethostname()
         self._target = (
             url.rstrip("/")
             + "/metrics/job/" + urllib.parse.quote(job, safe="")
             + "/instance/" + urllib.parse.quote(instance, safe="")
         )
-        self._min_interval = min_interval
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self.consecutive_failures = 0
 
     def push_once(self) -> None:
         import urllib.request
@@ -295,37 +292,6 @@ class PushgatewayPusher:
             self.consecutive_failures += 1
             log.warning("pushgateway push failed (%d consecutive): %s",
                         self.consecutive_failures, exc)
-
-    def run_forever(self) -> None:
-        import time
-
-        generation = self._registry.generation
-        last_push = float("-inf")
-        dirty = False
-        while not self._stop.is_set():
-            if self._registry.wait_for_publish(generation, timeout=0.2):
-                generation = self._registry.generation
-                dirty = True
-            # Defer, never drop: a publish arriving inside the min_interval
-            # window is pushed as soon as the window elapses, so freshness
-            # stays at min_interval regardless of timing jitter.
-            if dirty and time.monotonic() - last_push >= self._min_interval:
-                self.push_once()
-                last_push = time.monotonic()
-                dirty = False
-        if dirty:
-            self.push_once()  # flush the final snapshot on shutdown
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self.run_forever, name="pushgateway", daemon=True
-        )
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
 
 
 class TextfileWriter:

@@ -1,0 +1,147 @@
+"""Prometheus remote_write client (exposition mode #4).
+
+Ships the latest published snapshot (at most once per configured
+interval; superseded ticks are deferred-then-dropped) to a remote-write
+1.0 receiver
+(Mimir, Thanos Receive, VictoriaMetrics, Grafana Cloud, GMP) — no
+scraping Prometheus needed, which on ephemeral TPU slices is often the
+difference between having telemetry and not. Spec:
+https://prometheus.io/docs/specs/remote_write_spec/
+
+Semantics per the spec: snappy-compressed protobuf WriteRequest, samples
+in-order per series, retry on 5xx/transport errors (the next publish is
+the retry — self-backoff via the pusher loop), never retry 4xx (drop and
+log: the payload is wrong, not the network). The exporter's gauges are
+trivially in-order because each push carries exactly one timestamp per
+series (the tick's publish time).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from . import snappy
+from .proto import prompb
+from .registry import HistogramState, Registry, Snapshot, format_value
+from .workers import PublishFollower
+
+log = logging.getLogger(__name__)
+
+HEADERS = {
+    "Content-Type": "application/x-protobuf",
+    "Content-Encoding": "snappy",
+    "X-Prometheus-Remote-Write-Version": "0.1.0",
+    "User-Agent": "kube-tpu-stats",
+}
+
+
+def _histogram_series(hist: HistogramState, labels, ts: int) -> list[bytes]:
+    name = hist.spec.name
+    out = []
+    cumulative = 0
+    for i, bound in enumerate(hist.buckets):
+        cumulative += hist.counts[i]
+        # format_value, not repr: the le string must match the scrape
+        # path's rendering or receivers see two distinct bucket series.
+        out.append(prompb.encode_series(
+            name + "_bucket", labels + [("le", format_value(bound))],
+            float(cumulative), ts,
+        ))
+    out.append(prompb.encode_series(
+        name + "_bucket", labels + [("le", "+Inf")], float(hist.total), ts))
+    out.append(prompb.encode_series(name + "_sum", labels, hist.sum, ts))
+    out.append(prompb.encode_series(
+        name + "_count", labels, float(hist.total), ts))
+    return out
+
+
+def build_write_request(snapshot: Snapshot, job: str, instance: str) -> bytes:
+    """Uncompressed WriteRequest for one snapshot: every series + expanded
+    histograms, each stamped with the snapshot's publish time and carrying
+    the target-identity labels (job/instance) the spec expects the sender
+    to provide."""
+    ts = int(snapshot.timestamp * 1000.0)
+    identity = [("job", job), ("instance", instance)]
+    series = []
+    for s in snapshot.series:
+        series.append(prompb.encode_series(
+            s.spec.name, identity + list(s.labels), s.value, ts))
+    for hist in snapshot.histograms:
+        series.extend(_histogram_series(hist, identity, ts))
+    return prompb.encode_write_request(series)
+
+
+class RemoteWriter(PublishFollower):
+    """Publish-following push loop (PublishFollower scaffold, shared with
+    PushgatewayPusher): waits for a new snapshot, rate-limits to
+    ``min_interval`` with failure backoff, POSTs the compressed
+    WriteRequest. Failures never propagate — the DaemonSet must outlive
+    its receiver."""
+
+    def __init__(self, registry: Registry, url: str, *,
+                 job: str = "kube-tpu-stats", instance: str = "",
+                 min_interval: float = 15.0,
+                 bearer_token_file: str = "") -> None:
+        import socket
+
+        super().__init__(registry, min_interval, thread_name="remote-write")
+        self._url = url
+        self._job = job
+        self._instance = instance or socket.gethostname()
+        self._bearer_token_file = bearer_token_file
+        self.dropped_4xx = 0
+
+    def _headers(self) -> dict[str, str] | None:
+        """Request headers, or None when the configured token is
+        unreadable — pushing unauthenticated would turn a transient token
+        rotation into a permanent-looking 401 sample drop."""
+        headers = dict(HEADERS)
+        if self._bearer_token_file:
+            try:
+                # Re-read per push: mounted tokens rotate (k8s projected
+                # service-account tokens do, hourly).
+                with open(self._bearer_token_file) as f:
+                    headers["Authorization"] = "Bearer " + f.read().strip()
+            except OSError as exc:
+                log.warning("remote-write token unreadable (will retry): %s",
+                            exc)
+                return None
+        return headers
+
+    def push_once(self) -> None:
+        import urllib.error
+        import urllib.request
+
+        snapshot = self._registry.snapshot()
+        if not snapshot.series and not snapshot.histograms:
+            return
+        headers = self._headers()
+        if headers is None:
+            self.consecutive_failures += 1  # retryable: token will be back
+            return
+        body = snappy.compress(
+            build_write_request(snapshot, self._job, self._instance))
+        request = urllib.request.Request(
+            self._url, data=body, method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(request, timeout=10):
+                pass
+            self.consecutive_failures = 0
+        except urllib.error.HTTPError as exc:
+            if 400 <= exc.code < 500 and exc.code != 429:
+                # Spec: 4xx (except 429) must not be retried.
+                self.dropped_4xx += 1
+                try:
+                    detail = exc.read(200).decode(errors="replace")
+                except Exception:  # body read can itself die (conn reset)
+                    detail = "<error body unreadable>"
+                log.warning("remote write rejected (HTTP %d), dropping "
+                            "sample set: %s", exc.code, detail)
+            else:
+                self.consecutive_failures += 1
+                log.warning("remote write failed (HTTP %d, %d consecutive)",
+                            exc.code, self.consecutive_failures)
+        except Exception as exc:
+            self.consecutive_failures += 1
+            log.warning("remote write failed (%d consecutive): %s",
+                        self.consecutive_failures, exc)

@@ -59,6 +59,69 @@ class PeriodicRefresher:
             self._thread.join(timeout=5)
 
 
+class PublishFollower:
+    """Publish-following push scaffold shared by the Pushgateway and
+    remote-write senders: wait for a snapshot publish, rate-limit to
+    ``min_interval`` (scaled up under consecutive failures, capped — a
+    down receiver is not hammered), push, and flush the final snapshot on
+    shutdown so stopping isn't a data gap. Defer-never-drop: a publish
+    landing inside the interval window is pushed when the window elapses.
+
+    Subclasses implement ``push_once()`` (which must never raise — but a
+    bug in it is contained anyway) and maintain ``consecutive_failures``.
+    """
+
+    def __init__(self, registry, min_interval: float, thread_name: str) -> None:
+        self._registry = registry
+        self._min_interval = min_interval
+        self._thread_name = thread_name
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.consecutive_failures = 0
+
+    def push_once(self) -> None:
+        raise NotImplementedError
+
+    def _guarded_push(self) -> None:
+        import logging
+
+        try:
+            self.push_once()
+        except Exception:  # a push bug must not kill the shipping thread
+            self.consecutive_failures += 1
+            logging.getLogger(__name__).exception(
+                "%s push crashed; continuing", self._thread_name)
+
+    def run_forever(self) -> None:
+        import time
+
+        generation = self._registry.generation
+        last_push = float("-inf")
+        dirty = False
+        while not self._stop_event.is_set():
+            if self._registry.wait_for_publish(generation, timeout=0.2):
+                generation = self._registry.generation
+                dirty = True
+            interval = self._min_interval * min(1 + self.consecutive_failures, 6)
+            if dirty and time.monotonic() - last_push >= interval:
+                self._guarded_push()
+                last_push = time.monotonic()
+                dirty = False
+        if dirty:
+            self._guarded_push()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, name=self._thread_name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
 class DaemonSamplerPool:
     def __init__(self, max_workers: int, thread_name_prefix: str = "sampler") -> None:
         self._work: queue.SimpleQueue = queue.SimpleQueue()

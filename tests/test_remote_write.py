@@ -1,0 +1,200 @@
+"""Remote-write client: WriteRequest correctness against a fake receiver
+(snappy+prompb decoded), spec retry semantics (5xx retried, 4xx dropped),
+bearer-token refresh, and daemon wiring."""
+
+import http.server
+import threading
+
+import pytest
+
+from kube_gpu_stats_tpu import schema, snappy
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.proto import prompb
+from kube_gpu_stats_tpu.registry import Registry
+from kube_gpu_stats_tpu.remote_write import RemoteWriter, build_write_request
+
+
+class FakeReceiver:
+    """Minimal remote-write receiver: records decoded WriteRequests; can
+    be scripted to answer with an HTTP error code."""
+
+    def __init__(self):
+        self.requests = []
+        self.headers = []
+        self.fail_codes = []  # pop-front script of status codes
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                outer.headers.append(dict(self.headers))
+                if outer.fail_codes:
+                    self.send_response(outer.fail_codes.pop(0))
+                    self.end_headers()
+                    return
+                outer.requests.append(
+                    prompb.decode_write_request(snappy.decompress(body)))
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}/api/v1/push"
+
+
+@pytest.fixture
+def registry():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    loop.stop()
+    return reg
+
+
+def test_write_request_carries_all_series(registry):
+    snapshot = registry.snapshot()
+    decoded = prompb.decode_write_request(
+        build_write_request(snapshot, "kts", "node-1"))
+    names = {labels["__name__"] for labels, _ in decoded}
+    assert schema.DUTY_CYCLE.name in names
+    assert schema.SELF_POLL_DURATION.name + "_bucket" in names
+    assert schema.SELF_POLL_DURATION.name + "_count" in names
+    for labels, samples in decoded:
+        assert labels["job"] == "kts"
+        assert labels["instance"] == "node-1"
+        assert list(labels) == sorted(labels)  # spec: sorted by name
+        assert "" not in labels.values()  # spec: no empty label values
+        assert len(samples) == 1
+        assert samples[0][1] == int(snapshot.timestamp * 1000)
+    # Histogram le values must match the scrape path's text rendering.
+    les = {labels["le"] for labels, _ in decoded if "le" in labels}
+    assert "0.05" in les and "+Inf" in les
+
+
+def test_push_end_to_end(registry):
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, job="kts",
+                              instance="n0", min_interval=0.0)
+        writer.push_once()
+        assert writer.consecutive_failures == 0
+        (request,) = receiver.requests
+        duty = [s for labels, s in request
+                if labels["__name__"] == schema.DUTY_CYCLE.name
+                and labels["chip"] == "0"]
+        assert len(duty) == 1
+        headers = receiver.headers[0]
+        assert headers["Content-Encoding"] == "snappy"
+        assert headers["Content-Type"] == "application/x-protobuf"
+        assert headers["X-Prometheus-Remote-Write-Version"] == "0.1.0"
+
+
+def test_5xx_counts_failure_4xx_drops(registry):
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, min_interval=0.0)
+        receiver.fail_codes.append(503)
+        writer.push_once()
+        assert writer.consecutive_failures == 1
+        assert writer.dropped_4xx == 0
+        receiver.fail_codes.append(400)
+        writer.push_once()
+        assert writer.consecutive_failures == 1  # not a retryable failure
+        assert writer.dropped_4xx == 1
+        writer.push_once()  # receiver healthy again
+        assert writer.consecutive_failures == 0
+
+
+def test_429_is_retryable(registry):
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, min_interval=0.0)
+        receiver.fail_codes.append(429)
+        writer.push_once()
+        assert writer.consecutive_failures == 1
+        assert writer.dropped_4xx == 0
+
+
+def test_bearer_token_reread_per_push(registry, tmp_path):
+    token = tmp_path / "token"
+    token.write_text("first\n")
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, min_interval=0.0,
+                              bearer_token_file=str(token))
+        writer.push_once()
+        token.write_text("second\n")  # rotation
+        writer.push_once()
+    assert receiver.headers[0]["Authorization"] == "Bearer first"
+    assert receiver.headers[1]["Authorization"] == "Bearer second"
+
+
+def test_unreadable_token_skips_push_and_backs_off(registry, tmp_path):
+    """A missing/rotating token must not push unauthenticated (and then
+    treat the 401 as a permanent drop) — it skips the push as a retryable
+    failure."""
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, min_interval=0.0,
+                              bearer_token_file=str(tmp_path / "absent"))
+        writer.push_once()
+        assert receiver.requests == [] and receiver.headers == []
+        assert writer.consecutive_failures == 1
+        assert writer.dropped_4xx == 0
+        (tmp_path / "absent").write_text("tok")  # token appears
+        writer.push_once()
+        assert writer.consecutive_failures == 0
+        assert receiver.headers[0]["Authorization"] == "Bearer tok"
+
+
+def test_empty_snapshot_not_pushed():
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(Registry(), receiver.url, min_interval=0.0)
+        writer.push_once()
+        assert receiver.requests == []
+
+
+def test_follows_publishes(registry):
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, min_interval=0.0)
+        writer.start()
+        loop = PollLoop(MockCollector(num_devices=1), registry, deadline=5.0)
+        loop.tick()
+        loop.stop()
+        deadline = threading.Event()
+        for _ in range(50):
+            if receiver.requests:
+                break
+            deadline.wait(0.1)
+        writer.stop()
+    assert receiver.requests
+
+
+def test_daemon_wires_remote_writer():
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    d = Daemon(Config(backend="mock", attribution="off",
+                      remote_write_url="http://127.0.0.1:1/api/v1/push",
+                      listen_port=0))
+    try:
+        assert d.remote_writer is not None
+    finally:
+        d.collector.close()
+    d2 = Daemon(Config(backend="mock", attribution="off", listen_port=0))
+    try:
+        assert d2.remote_writer is None
+    finally:
+        d2.collector.close()
