@@ -11,7 +11,7 @@ when *neither* source yields anything.
 from __future__ import annotations
 
 import logging
-from typing import Sequence
+from typing import Mapping, Sequence
 
 from . import Collector, CollectorError, Device, Sample
 from .libtpu import LibtpuClient, LibtpuCollector
@@ -59,20 +59,43 @@ class TpuCollector(Collector):
         self._libtpu.wait_ready(timeout)
 
     def sample(self, device: Device) -> Sample:
-        values: dict[str, float] = {}
-        ici: dict[str, int] = {}
-        collectives = None
-        runtime_err = sysfs_err = None
         # sysfs first: the libtpu sample joins the tick's in-flight batched
         # RPC, so reading the local files before blocking lets the file IO
         # overlap the RPC instead of queueing behind it.
         sysfs_values: dict[str, float] = {}
+        sysfs_err = None
         try:
-            sysfs_values = self._sysfs.read_environment(device)
+            sysfs_values = self.read_environment(device)
         except CollectorError as exc:
             sysfs_err = exc
+        self._libtpu.wait_ready()
+        return self.assemble(device, sysfs_values, sysfs_err)
+
+    # -- split-sampling fast path (poll.py): the poll workers run only the
+    # -- wedge-prone file IO; the loop thread joins the fetch once via
+    # -- wait_ready() and assembles every device in-memory.
+
+    def read_environment(self, device: Device) -> dict[str, float]:
+        """The blocking half: local sysfs attribute reads."""
+        return dict(self._sysfs.read_environment(device))
+
+    def assemble(self, device: Device, sysfs_values: Mapping[str, float],
+                 sysfs_err: Exception | None = None,
+                 runtime_ready: bool = True) -> Sample:
+        """The in-memory half; call after ``wait_ready``. Failure
+        semantics per the module docstring: the two sources degrade
+        independently, a chip only raises when both yielded nothing.
+        ``runtime_ready=False`` (this tick's fetch missed the deadline)
+        skips the cache read entirely — peeking would silently serve the
+        PREVIOUS tick's counters as if they were fresh."""
+        values: dict[str, float] = {}
+        ici: dict[str, int] = {}
+        collectives = None
+        runtime_err = None
         try:
-            runtime = self._libtpu.sample(device)
+            if not runtime_ready:
+                raise CollectorError("runtime fetch not ready this tick")
+            runtime = self._libtpu.peek(device)
             values.update(runtime.values)
             ici.update(runtime.ici_counters)
             collectives = runtime.collective_ops
@@ -86,6 +109,9 @@ class TpuCollector(Collector):
         if runtime_err is not None:
             log.debug("chip %d: runtime counters missing: %s",
                       device.index, runtime_err)
+        if sysfs_err is not None:
+            log.debug("chip %d: environment missing: %s",
+                      device.index, sysfs_err)
         return Sample(
             device=device,
             values=values,

@@ -458,6 +458,13 @@ class LibtpuCollector(Collector):
             # _refresh; the poll loop's own per-device deadline also covers
             # this wait (sample runs on a pool worker).
             inflight.result()
+        return self.peek(device)
+
+    def peek(self, device: Device) -> Sample:
+        """Read this device out of the tick cache WITHOUT joining the
+        in-flight fetch — the split-sampling fast path calls wait_ready()
+        once on the loop thread, then peeks every device in-memory
+        (poll.py), instead of paying one thread-wake per device."""
         with self._lock:
             error = self._cache_error
             entry = self._cache.get(device.index)

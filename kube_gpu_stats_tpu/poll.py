@@ -22,7 +22,7 @@ import time
 from typing import Callable, Mapping, Protocol, Sequence
 
 from . import schema
-from .collectors import Collector, Device, Sample
+from .collectors import Collector, CollectorError, Device, Sample
 from .ici import RateTracker
 from .registry import HistogramState, Registry, SnapshotBuilder
 from .workers import DaemonSamplerPool
@@ -200,6 +200,17 @@ class PollLoop:
         if not self._devices:
             return []
         self._collector.begin_tick()
+        # Split fast path (TpuCollector): pool workers run only the
+        # wedge-prone file IO (overlapping the in-flight RPC); the loop
+        # thread joins the fetch ONCE and assembles every device
+        # in-memory — versus one thread-wake per device on the generic
+        # path, which is pure added latency after the response lands.
+        split = (
+            hasattr(self._collector, "read_environment")
+            and hasattr(self._collector, "assemble")
+        )
+        work = (self._collector.read_environment if split
+                else self._collector.sample)
         futures: dict[concurrent.futures.Future, Device] = {}
         results: list[tuple[Device, Sample | None]] = []
         for dev in self._devices:
@@ -212,12 +223,27 @@ class PollLoop:
                     results.append((dev, None))
                     continue
                 del self._outstanding[dev.device_id]  # finally finished
-            futures[self._pool.submit(self._collector.sample, dev)] = dev
+            futures[self._pool.submit(work, dev)] = dev
         deadline = self._clock() + self._deadline
+        runtime_ready = False
+        if split:
+            try:
+                self._collector.wait_ready(
+                    max(0.0, deadline - self._clock()))
+                runtime_ready = True
+            except Exception as exc:
+                # Fetch missed the tick deadline (or died): assemble with
+                # sysfs only — composite degraded mode, never a crash.
+                self._count_error("fetch_deadline")
+                log.warning("runtime fetch not ready within %gs: %s",
+                            self._deadline, exc)
         for future, dev in futures.items():
             remaining = max(0.0, deadline - self._clock())
             try:
-                results.append((dev, future.result(timeout=remaining)))
+                outcome = future.result(timeout=remaining)
+                if split:
+                    outcome = self._assemble(dev, outcome, None, runtime_ready)
+                results.append((dev, outcome))
             except concurrent.futures.TimeoutError:
                 if not future.cancel():
                     self._outstanding[dev.device_id] = future
@@ -226,11 +252,36 @@ class PollLoop:
                             dev.device_path, self._deadline)
                 results.append((dev, None))
             except Exception as exc:  # CollectorError and anything else
+                if split and not isinstance(exc, concurrent.futures.CancelledError):
+                    # Env read failed; runtime counters may still carry
+                    # the chip (independent-degradation contract). A
+                    # CollectorError is expected degradation (e.g. no
+                    # accel sysfs class on this VM variant); anything
+                    # else is a fast-path bug and must stay visible to
+                    # alerting even when the runtime keeps the chip up.
+                    if not isinstance(exc, CollectorError):
+                        self._count_error(type(exc).__name__)
+                        log.warning("environment read of %s failed: %s",
+                                    dev.device_path, exc)
+                    results.append(
+                        (dev, self._assemble(dev, {}, exc, runtime_ready)))
+                    continue
                 self._count_error(type(exc).__name__)
                 log.warning("sample of %s failed: %s", dev.device_path, exc)
                 results.append((dev, None))
         results.sort(key=lambda pair: pair[0].index)
         return results
+
+    def _assemble(self, dev: Device, env, env_err,
+                  runtime_ready: bool) -> Sample | None:
+        """In-memory merge for the split fast path; None marks stale."""
+        try:
+            return self._collector.assemble(dev, env, env_err,
+                                            runtime_ready=runtime_ready)
+        except Exception as exc:
+            self._count_error(type(exc).__name__)
+            log.warning("sample of %s failed: %s", dev.device_path, exc)
+            return None
 
     def _count_error(self, reason: str) -> None:
         self._errors[reason] = self._errors.get(reason, 0) + 1
@@ -273,6 +324,13 @@ class PollLoop:
                     builder.add(schema.MEMORY_TOTAL, total, base)
                 continue
             builder.add(schema.DEVICE_UP, 1.0, base)
+            if schema.MEMORY_TOTAL.name not in sample.values:
+                # Degraded (runtime-not-ready) samples lack HBM capacity;
+                # re-emit the retained total so used/total ratios and
+                # capacity recording rules don't flap on slow ticks.
+                total = self._last_totals.get(dev.device_id)
+                if total is not None:
+                    builder.add(schema.MEMORY_TOTAL, total, base)
             for name, value in sample.values.items():
                 spec = by_name.get(name)
                 if spec is None:

@@ -239,3 +239,48 @@ def test_healthz_goes_unhealthy_when_poll_dies():
             assert e.code == 503
     finally:
         server.stop()
+
+
+def test_slow_runtime_degrades_fresh_not_stale(tmp_path):
+    """Runtime slower than the tick deadline: chips must degrade to this
+    tick's sysfs-only values — the split fast path must NOT peek the
+    previous tick's runtime cache and serve stale duty/HBM as fresh."""
+    make_sysfs(tmp_path, num_chips=2)
+    server = FakeLibtpuServer(num_chips=2).start()
+    col = TpuCollector(
+        sysfs_root=str(tmp_path),
+        libtpu_client=LibtpuClient(ports=(server.port,), rpc_timeout=5.0),
+        use_native=False,
+    )
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=0.4)
+    try:
+        loop.tick()  # healthy tick primes the runtime cache
+        names = {s.spec.name for s in reg.snapshot().series}
+        assert schema.DUTY_CYCLE.name in names
+
+        server.delay = 2.0  # now slower than the 0.4s deadline
+        loop.tick()
+        snapshot = reg.snapshot()
+        names = {s.spec.name for s in snapshot.series}
+        # Fresh environmental values still export; runtime families must
+        # vanish rather than repeat the previous tick's cache.
+        assert schema.POWER.name in names
+        assert schema.DUTY_CYCLE.name not in names
+        assert up_values(snapshot) == [1.0, 1.0]  # degraded, not stale
+        # Retained capacity: used/total ratios must not flap on slow ticks.
+        assert schema.MEMORY_TOTAL.name in names
+
+        server.delay = 0.0
+        # The wedged 2s fetch from the slow tick must drain before a new
+        # one can land; wait it out, then confirm recovery.
+        col.wait_ready(5.0)
+        loop.tick()
+        loop.tick()
+        assert schema.DUTY_CYCLE.name in {
+            s.spec.name for s in reg.snapshot().series
+        }
+    finally:
+        loop.stop()
+        server.stop()
+        col.close()
