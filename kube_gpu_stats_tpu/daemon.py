@@ -128,6 +128,7 @@ class Daemon:
             rediscovery_interval=cfg.rediscovery_interval,
             drop_labels=cfg.drop_labels,
             process_openers=self.procwatch.lookup if self.procwatch else None,
+            push_stats=self._push_stats,
         )
         self.server = MetricsServer(
             self.registry, cfg.listen_host, cfg.listen_port,
@@ -160,6 +161,22 @@ class Daemon:
                 min_interval=cfg.remote_write_interval,
                 bearer_token_file=cfg.remote_write_bearer_token_file,
             )
+
+    def _push_stats(self) -> dict[str, dict[str, int]]:
+        """Shipping-health counters for the collector_push_* self metrics.
+        Wired into the poll loop at construction; the senders are created
+        after the loop, so this resolves them late (each tick)."""
+        stats: dict[str, dict[str, int]] = {}
+        for mode, sender in (("pushgateway", getattr(self, "pusher", None)),
+                             ("remote_write",
+                              getattr(self, "remote_writer", None))):
+            if sender is not None:
+                stats[mode] = {
+                    "pushes": sender.pushes_total,
+                    "failures": sender.failures_total,
+                    "dropped": sender.dropped_total,
+                }
+        return stats
 
     def start(self) -> None:
         starter = getattr(self.attribution, "start", None)

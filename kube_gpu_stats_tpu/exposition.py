@@ -288,8 +288,10 @@ class PushgatewayPusher(PublishFollower):
             with urllib.request.urlopen(request, timeout=10):
                 pass
             self.consecutive_failures = 0
+            self.pushes_total += 1
         except Exception as exc:
             self.consecutive_failures += 1
+            self.failures_total += 1
             log.warning("pushgateway push failed (%d consecutive): %s",
                         self.consecutive_failures, exc)
 

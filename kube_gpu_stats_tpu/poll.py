@@ -61,6 +61,7 @@ class PollLoop:
         process_metrics: bool = True,
         drop_labels: Sequence[str] = (),
         process_openers: Callable[[str], Sequence[tuple[int, str]]] | None = None,
+        push_stats: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -79,6 +80,9 @@ class PollLoop:
         # Cached device→holding-process map (procopen.py); a dict read,
         # same off-hot-path contract as attribution. None = disabled.
         self._process_openers = process_openers
+        # Shipping-health counters from the push senders (daemon-wired
+        # callable; reads plain ints, safe from this thread).
+        self._push_stats = push_stats
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -376,6 +380,15 @@ class PollLoop:
                 float(self._errors[reason]),
                 [("reason", reason)],
             )
+        if self._push_stats is not None:
+            for mode, stats in sorted(self._push_stats().items()):
+                mode_label = [("mode", mode)]
+                builder.add(schema.SELF_PUSH_TOTAL,
+                            float(stats.get("pushes", 0)), mode_label)
+                builder.add(schema.SELF_PUSH_FAILURES,
+                            float(stats.get("failures", 0)), mode_label)
+                builder.add(schema.SELF_PUSH_DROPPED,
+                            float(stats.get("dropped", 0)), mode_label)
         builder.add(
             schema.SELF_INFO,
             1.0,

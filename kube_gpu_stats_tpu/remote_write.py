@@ -89,7 +89,6 @@ class RemoteWriter(PublishFollower):
         self._job = job
         self._instance = instance or socket.gethostname()
         self._bearer_token_file = bearer_token_file
-        self.dropped_4xx = 0
 
     def _headers(self) -> dict[str, str] | None:
         """Request headers, or None when the configured token is
@@ -118,6 +117,7 @@ class RemoteWriter(PublishFollower):
         headers = self._headers()
         if headers is None:
             self.consecutive_failures += 1  # retryable: token will be back
+            self.failures_total += 1
             return
         body = snappy.compress(
             build_write_request(snapshot, self._job, self._instance))
@@ -127,10 +127,11 @@ class RemoteWriter(PublishFollower):
             with urllib.request.urlopen(request, timeout=10):
                 pass
             self.consecutive_failures = 0
+            self.pushes_total += 1
         except urllib.error.HTTPError as exc:
             if 400 <= exc.code < 500 and exc.code != 429:
                 # Spec: 4xx (except 429) must not be retried.
-                self.dropped_4xx += 1
+                self.dropped_total += 1
                 try:
                     detail = exc.read(200).decode(errors="replace")
                 except Exception:  # body read can itself die (conn reset)
@@ -139,9 +140,11 @@ class RemoteWriter(PublishFollower):
                             "sample set: %s", exc.code, detail)
             else:
                 self.consecutive_failures += 1
+                self.failures_total += 1
                 log.warning("remote write failed (HTTP %d, %d consecutive)",
                             exc.code, self.consecutive_failures)
         except Exception as exc:
             self.consecutive_failures += 1
+            self.failures_total += 1
             log.warning("remote write failed (%d consecutive): %s",
                         self.consecutive_failures, exc)

@@ -216,6 +216,27 @@ SELF_ALLOCATABLE = MetricSpec(
     extra_labels=("resource",),
 )
 
+SELF_PUSH_TOTAL = MetricSpec(
+    "collector_push_total",
+    MetricType.COUNTER,
+    "Completed pushes per shipping mode (pushgateway, remote_write).",
+    extra_labels=("mode",),
+)
+SELF_PUSH_FAILURES = MetricSpec(
+    "collector_push_failures_total",
+    MetricType.COUNTER,
+    "Failed (retryable) pushes per shipping mode — receiver down, "
+    "transport error, 5xx/429.",
+    extra_labels=("mode",),
+)
+SELF_PUSH_DROPPED = MetricSpec(
+    "collector_push_dropped_total",
+    MetricType.COUNTER,
+    "Sample sets dropped as non-retryable per shipping mode (remote-write "
+    "spec: 4xx other than 429 means the payload, not the network).",
+    extra_labels=("mode",),
+)
+
 PROCESS_CPU = MetricSpec(
     "process_cpu_seconds_total",
     MetricType.COUNTER,
@@ -238,6 +259,9 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_DEVICES,
     SELF_INFO,
     SELF_ALLOCATABLE,
+    SELF_PUSH_TOTAL,
+    SELF_PUSH_FAILURES,
+    SELF_PUSH_DROPPED,
     PROCESS_CPU,
     PROCESS_RSS,
     PROCESS_START,

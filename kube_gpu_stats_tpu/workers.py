@@ -78,6 +78,13 @@ class PublishFollower:
         self._stop_event = threading.Event()
         self._thread: threading.Thread | None = None
         self.consecutive_failures = 0
+        # Shipping-health counters, exported as collector_push_* self
+        # metrics: subclasses bump pushes_total on success and
+        # failures_total on retryable failure; dropped_total counts
+        # non-retryable payload rejections (remote-write 4xx).
+        self.pushes_total = 0
+        self.failures_total = 0
+        self.dropped_total = 0
 
     def push_once(self) -> None:
         raise NotImplementedError
@@ -89,6 +96,7 @@ class PublishFollower:
             self.push_once()
         except Exception:  # a push bug must not kill the shipping thread
             self.consecutive_failures += 1
+            self.failures_total += 1
             logging.getLogger(__name__).exception(
                 "%s push crashed; continuing", self._thread_name)
 

@@ -22,6 +22,7 @@ class FakeReceiver:
     def __init__(self):
         self.requests = []
         self.headers = []
+        self.puts = []
         self.fail_codes = []  # pop-front script of status codes
         outer = self
 
@@ -36,6 +37,12 @@ class FakeReceiver:
                 outer.requests.append(
                     prompb.decode_write_request(snappy.decompress(body)))
                 self.send_response(204)
+                self.end_headers()
+
+            def do_PUT(self):  # pushgateway-style target for mode tests
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                outer.puts.append(self.path)
+                self.send_response(200)
                 self.end_headers()
 
             def log_message(self, *args):
@@ -111,11 +118,11 @@ def test_5xx_counts_failure_4xx_drops(registry):
         receiver.fail_codes.append(503)
         writer.push_once()
         assert writer.consecutive_failures == 1
-        assert writer.dropped_4xx == 0
+        assert writer.dropped_total == 0
         receiver.fail_codes.append(400)
         writer.push_once()
         assert writer.consecutive_failures == 1  # not a retryable failure
-        assert writer.dropped_4xx == 1
+        assert writer.dropped_total == 1
         writer.push_once()  # receiver healthy again
         assert writer.consecutive_failures == 0
 
@@ -126,7 +133,7 @@ def test_429_is_retryable(registry):
         receiver.fail_codes.append(429)
         writer.push_once()
         assert writer.consecutive_failures == 1
-        assert writer.dropped_4xx == 0
+        assert writer.dropped_total == 0
 
 
 def test_bearer_token_reread_per_push(registry, tmp_path):
@@ -152,7 +159,7 @@ def test_unreadable_token_skips_push_and_backs_off(registry, tmp_path):
         writer.push_once()
         assert receiver.requests == [] and receiver.headers == []
         assert writer.consecutive_failures == 1
-        assert writer.dropped_4xx == 0
+        assert writer.dropped_total == 0
         (tmp_path / "absent").write_text("tok")  # token appears
         writer.push_once()
         assert writer.consecutive_failures == 0
@@ -180,6 +187,38 @@ def test_follows_publishes(registry):
             deadline.wait(0.1)
         writer.stop()
     assert receiver.requests
+
+
+def test_push_health_self_metrics(registry):
+    """collector_push_* families surface shipping health on the scrape."""
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    with FakeReceiver() as receiver:
+        d = Daemon(Config(backend="mock", attribution="off",
+                          remote_write_url=receiver.url,
+                          pushgateway_url=f"http://127.0.0.1:{receiver.port}",
+                          listen_port=0))
+        try:
+            d.poll.tick()  # non-empty snapshot so the push actually fires
+            receiver.fail_codes.append(503)
+            d.remote_writer.push_once()  # one failure on record
+            d.pusher.push_once()  # one pushgateway success
+            d.poll.tick()
+            series = {
+                (s.spec.name, dict(s.labels).get("mode")): s.value
+                for s in d.registry.snapshot().series
+                if s.spec.name.startswith("collector_push_")
+            }
+        finally:
+            d.poll.stop()
+            d.collector.close()
+    assert series[("collector_push_failures_total", "remote_write")] == 1.0
+    assert series[("collector_push_total", "remote_write")] == 0.0
+    assert series[("collector_push_dropped_total", "remote_write")] == 0.0
+    assert series[("collector_push_total", "pushgateway")] == 1.0
+    assert series[("collector_push_failures_total", "pushgateway")] == 0.0
+    assert receiver.puts  # the PUT actually landed
 
 
 def test_daemon_wires_remote_writer():
