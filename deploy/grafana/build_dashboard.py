@@ -112,6 +112,33 @@ def stat(title, expr, unit, grid, *, color=SEQUENTIAL_HUE, description=""):
     }
 
 
+def table(title, expr, grid, *, hide_columns=(), description=""):
+    """Instant-query table (label-valued data like the process holders —
+    a timeseries of constant 1s would be noise)."""
+    return {
+        "type": "table",
+        "title": title,
+        "description": description,
+        "datasource": DS,
+        "gridPos": grid,
+        "fieldConfig": {"defaults": {"custom": {"align": "auto"}},
+                        "overrides": []},
+        "options": {"showHeader": True},
+        "targets": [{"expr": expr, "refId": "A", "datasource": DS,
+                     "format": "table", "instant": True}],
+        "transformations": [{
+            "id": "organize",
+            "options": {
+                "excludeByName": dict.fromkeys(
+                    ("Time", "Value", "__name__") + tuple(hide_columns), True
+                ),
+                "indexByName": {},
+                "renameByName": {},
+            },
+        }],
+    }
+
+
 def template_var(name, label, query):
     return {
         "name": name,
@@ -237,6 +264,29 @@ panels = [
         "Exporter memory (RSS)",
         [('process_resident_memory_bytes', '{{instance}}')],
         "bytes", {"x": 12, "y": 44, "w": 12, "h": 8}, per_chip=False),
+
+    # Row 8 — workload view + shipping health.
+    table(
+        "Processes holding devices (nvidia-smi table analog)",
+        f'accelerator_process_open{{{FILTERS}}}',
+        {"x": 0, "y": 52, "w": 12, "h": 8},
+        hide_columns=("device_path", "uuid", "instance", "job",
+                      "accel_type", "slice", "topology"),
+        description="Which process (pid/comm) holds each device node open, "
+                    "with pod attribution where kubelet data exists. "
+                    "Refreshed on the attribution cadence (~10 s)."),
+    timeseries(
+        "Metric shipping (pushgateway / remote_write)",
+        [('sum by (mode) (rate(collector_push_total[5m]))',
+          '{{mode}} ok'),
+         ('sum by (mode) (rate(collector_push_failures_total[5m]))',
+          '{{mode}} failing'),
+         ('sum by (mode) (rate(collector_push_dropped_total[5m]))',
+          '{{mode}} rejected')],
+        "ops", {"x": 12, "y": 52, "w": 12, "h": 8}, per_chip=False,
+        description="Push-mode delivery health; failing/rejected map to the "
+                    "AcceleratorMetricShipping* alerts. Absent when neither "
+                    "push mode is configured."),
 ]
 
 dashboard = {
