@@ -309,6 +309,75 @@ def check_poll(cfg: Config, ticks: int = 5) -> CheckResult:
             pass
 
 
+def check_remote_write(cfg: Config) -> CheckResult:
+    """Probe the configured remote-write receiver with an EMPTY
+    WriteRequest (zero timeseries — nothing lands in storage): proves
+    reachability, TLS, auth token, and content negotiation without
+    polluting the receiver."""
+    import urllib.error
+    import urllib.request
+
+    from . import snappy
+    from .remote_write import build_headers
+
+    headers = build_headers(cfg.remote_write_bearer_token_file)
+    if headers is None:
+        return _result(
+            "remote-write", FAIL,
+            f"bearer token file {cfg.remote_write_bearer_token_file!r} "
+            f"unreadable",
+        )
+    request = urllib.request.Request(
+        cfg.remote_write_url, data=snappy.compress(b""), method="POST",
+        headers=headers)
+    try:
+        with urllib.request.urlopen(request, timeout=5):
+            pass
+        return _result("remote-write", OK,
+                       f"{cfg.remote_write_url}: receiver accepts writes")
+    except urllib.error.HTTPError as exc:
+        if exc.code == 400:
+            # Many receivers reject an empty write with 400 — which still
+            # proves endpoint, TLS, auth and content negotiation all work.
+            return _result(
+                "remote-write", OK,
+                f"{cfg.remote_write_url}: receiver answered 400 to the "
+                f"empty probe write (endpoint + auth OK)",
+            )
+        if exc.code in (401, 403):
+            return _result(
+                "remote-write", FAIL,
+                f"{cfg.remote_write_url}: auth rejected (HTTP {exc.code}) "
+                f"with the configured credentials",
+            )
+        if exc.code >= 500 or exc.code == 429:
+            return _result(
+                "remote-write", WARN,
+                f"{cfg.remote_write_url}: receiver unhealthy "
+                f"(HTTP {exc.code}); exporter will retry with backoff",
+            )
+        return _result("remote-write", FAIL,
+                       f"{cfg.remote_write_url}: HTTP {exc.code}")
+    except OSError as exc:
+        # URLError wraps BOTH transient network failures (reason is an
+        # OSError: refused/timeout/DNS) and permanent config errors
+        # (reason is a str, e.g. "unknown url type" for a scheme-less
+        # --remote-write-url). Only the former deserves "will retry".
+        if isinstance(getattr(exc, "reason", None), str):
+            return _result("remote-write", FAIL,
+                           f"{cfg.remote_write_url}: {exc.reason}")
+        return _result(
+            "remote-write", WARN,
+            f"{cfg.remote_write_url}: unreachable ({exc}); exporter will "
+            f"retry with backoff",
+        )
+    except Exception as exc:
+        # e.g. ValueError from a malformed URL that fails before urllib
+        # wraps it: a config error retrying can never fix.
+        return _result("remote-write", FAIL,
+                       f"{cfg.remote_write_url}: {exc}")
+
+
 def check_scrape(target: str) -> CheckResult:
     """Validate a live scrape (or saved .prom) against the exposition
     contract — doctor's hook into the validate tool."""
@@ -412,6 +481,8 @@ def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
         ("topology", lambda: check_topology(cfg)),
         ("poll", lambda: check_poll(cfg)),
     ])
+    if cfg.remote_write_url:
+        probes.append(("remote-write", lambda: check_remote_write(cfg)))
     if url:
         probes.append(("scrape", lambda: check_scrape(url)))
     results: list[CheckResult] = []

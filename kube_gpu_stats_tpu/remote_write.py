@@ -35,6 +35,24 @@ HEADERS = {
 }
 
 
+def build_headers(bearer_token_file: str = "") -> dict[str, str] | None:
+    """Remote-write request headers, or None when the configured token is
+    unreadable — pushing unauthenticated would turn a transient token
+    rotation into a permanent-looking 401 sample drop. Shared by the
+    sender and doctor's receiver probe."""
+    headers = dict(HEADERS)
+    if bearer_token_file:
+        try:
+            # Re-read per push: mounted tokens rotate (k8s projected
+            # service-account tokens do, hourly).
+            with open(bearer_token_file) as f:
+                headers["Authorization"] = "Bearer " + f.read().strip()
+        except OSError as exc:
+            log.warning("remote-write token unreadable (will retry): %s", exc)
+            return None
+    return headers
+
+
 def _histogram_series(hist: HistogramState, labels, ts: int) -> list[bytes]:
     name = hist.spec.name
     out = []
@@ -91,21 +109,7 @@ class RemoteWriter(PublishFollower):
         self._bearer_token_file = bearer_token_file
 
     def _headers(self) -> dict[str, str] | None:
-        """Request headers, or None when the configured token is
-        unreadable — pushing unauthenticated would turn a transient token
-        rotation into a permanent-looking 401 sample drop."""
-        headers = dict(HEADERS)
-        if self._bearer_token_file:
-            try:
-                # Re-read per push: mounted tokens rotate (k8s projected
-                # service-account tokens do, hourly).
-                with open(self._bearer_token_file) as f:
-                    headers["Authorization"] = "Bearer " + f.read().strip()
-            except OSError as exc:
-                log.warning("remote-write token unreadable (will retry): %s",
-                            exc)
-                return None
-        return headers
+        return build_headers(self._bearer_token_file)
 
     def push_once(self) -> None:
         import urllib.error

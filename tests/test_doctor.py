@@ -175,6 +175,54 @@ def test_scrape_hardened_endpoints_warn_not_fail(tmp_path):
         tls_srv.stop()
 
 
+def test_remote_write_probe(tmp_path):
+    """Empty-WriteRequest probe: 2xx/400 = ok, 401 with creds = fail,
+    receiver down = warn (exporter retries), 5xx = warn."""
+    import http.server
+    import threading
+
+    codes = {"next": 204}
+    bodies = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            bodies.append(self.rfile.read(
+                int(self.headers["Content-Length"])))
+            self.send_response(codes["next"])
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/push"
+    try:
+        cfg = Config(remote_write_url=url)
+        assert doctor.check_remote_write(cfg).status == "ok"
+        from kube_gpu_stats_tpu import snappy
+        assert snappy.decompress(bodies[0]) == b""  # nothing written
+        codes["next"] = 400
+        result = doctor.check_remote_write(cfg)
+        assert result.status == "ok" and "endpoint + auth OK" in result.detail
+        codes["next"] = 401
+        assert doctor.check_remote_write(cfg).status == "fail"
+        codes["next"] = 503
+        assert doctor.check_remote_write(cfg).status == "warn"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert doctor.check_remote_write(
+        Config(remote_write_url="http://127.0.0.1:1/push")).status == "warn"
+    # Malformed URL (no scheme) is a config error, not a transient blip.
+    assert doctor.check_remote_write(
+        Config(remote_write_url="localhost:9009/push")).status == "fail"
+    assert doctor.check_remote_write(Config(
+        remote_write_url=url,
+        remote_write_bearer_token_file=str(tmp_path / "gone"),
+    )).status == "fail"
+
+
 def test_url_flag_requires_target():
     assert doctor.main(["--url"]) == 2
     assert doctor.main(["--url="]) == 2
