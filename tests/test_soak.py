@@ -2,6 +2,7 @@
 slow structural failures unit tests can't — thread leaks, generation
 stalls, crash-on-flap (SURVEY.md §5 "never crash the DaemonSet pod")."""
 
+import http.server
 import threading
 import time
 import urllib.request
@@ -12,9 +13,38 @@ from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
 from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
 
 
+class FlakyReceiver(http.server.ThreadingHTTPServer):
+    """Remote-write/pushgateway sink that fails half the time — the soak
+    must show the senders neither leak nor wedge under receiver flap."""
+
+    def __init__(self):
+        outer = self
+        self.hits = {"POST": 0, "PUT": 0}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _serve(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                # Per-method parity, first attempt succeeds: deterministic
+                # for each sender regardless of how their streams interleave
+                # (the pushgateway pusher only gets a few backoff-spaced
+                # attempts in the soak window — attempt #1 must not 503).
+                outer.hits[self.command] += 1
+                self.send_response(204 if outer.hits[self.command] % 2 else 503)
+                self.end_headers()
+
+            do_POST = do_PUT = _serve
+
+            def log_message(self, *args):
+                pass
+
+        super().__init__(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+
 def test_soak_flapping_backend(tmp_path):
     make_sysfs(tmp_path / "sys", num_chips=4)
     server = FakeLibtpuServer(num_chips=4).start()
+    receiver = FlakyReceiver()
     cfg = Config(
         backend="tpu",
         sysfs_root=str(tmp_path / "sys"),
@@ -27,6 +57,10 @@ def test_soak_flapping_backend(tmp_path):
         rediscovery_interval=0.5,
         use_native=True,
         textfile_dir=str(tmp_path / "tf"),
+        remote_write_url=(
+            f"http://127.0.0.1:{receiver.server_address[1]}/push"),
+        remote_write_interval=0.1,
+        pushgateway_url=f"http://127.0.0.1:{receiver.server_address[1]}",
     )
     daemon = Daemon(cfg)
     daemon.start()
@@ -81,9 +115,17 @@ def test_soak_flapping_backend(tmp_path):
         ).read().decode()
         assert body.count("accelerator_up{") == 4
         assert "accelerator_duty_cycle{" in body
+        # Both senders survived the flaky receiver and kept shipping:
+        # successes and failures both recorded, threads accounted above.
+        assert daemon.remote_writer.pushes_total > 0
+        assert daemon.remote_writer.failures_total > 0
+        assert daemon.pusher.pushes_total > 0
+        assert 'collector_push_total{mode="remote_write"}' in body
     finally:
         stop.set()
         for t in scrape_threads:
             t.join(timeout=2)
         daemon.stop()
         server.stop()
+        receiver.shutdown()
+        receiver.server_close()
