@@ -215,11 +215,43 @@ class Daemon:
         self.collector.close()
 
 
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line, keys aligned with Cloud Logging's
+    structured-log parsing (severity/message/timestamp); exception text
+    folded into the message so every record stays single-line."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+        import time as _time
+
+        message = record.getMessage()
+        if record.exc_info:
+            message += "\n" + self.formatException(record.exc_info)
+        return json.dumps({
+            "timestamp": _time.strftime(
+                "%Y-%m-%dT%H:%M:%S", _time.gmtime(record.created)
+            ) + f".{int(record.msecs):03d}Z",
+            "severity": record.levelname,
+            "logger": record.name,
+            "message": message,
+        })
+
+
+def setup_logging(cfg: Config) -> None:
+    level = getattr(logging, cfg.log_level.upper(), logging.INFO)
+    if cfg.log_format == "json":
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonLogFormatter())
+        logging.basicConfig(level=level, handlers=[handler])
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        )
+
+
 def run(cfg: Config) -> int:
-    logging.basicConfig(
-        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    setup_logging(cfg)
     daemon = Daemon(cfg)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
