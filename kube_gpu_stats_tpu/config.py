@@ -49,6 +49,7 @@ class Config:
     mock_devices: int = 4
     use_native: bool = True  # C++ fast path when the shared lib is present
     log_level: str = "info"
+    log_format: str = "text"  # text|json (json = Cloud Logging structured)
     tls_cert_file: str = ""  # both set = serve HTTPS
     tls_key_file: str = ""
     auth_username: str = ""  # + password hash = basic auth on /metrics
@@ -151,6 +152,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env_bool("NO_NATIVE"),
                    help="disable the C++ fast-path sampler")
     p.add_argument("--log-level", default=_env("LOG_LEVEL", "info"))
+    p.add_argument("--log-format", choices=("text", "json"),
+                   default=_env("LOG_FORMAT", "text"),
+                   help="log record format; json emits one Cloud-Logging-"
+                        "style object per line")
     p.add_argument("--tls-cert-file", default=_env("TLS_CERT_FILE", ""),
                    help="PEM certificate; with --tls-key-file serves HTTPS")
     p.add_argument("--tls-key-file", default=_env("TLS_KEY_FILE", ""))
@@ -285,6 +290,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         mock_devices=args.mock_devices,
         use_native=not args.no_native,
         log_level=args.log_level,
+        log_format=args.log_format,
         tls_cert_file=args.tls_cert_file,
         tls_key_file=args.tls_key_file,
         auth_username=args.auth_username,

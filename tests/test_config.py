@@ -199,3 +199,37 @@ def test_config_file_yaml11_off_for_non_on_choices(tmp_path):
     cfg_file = tmp_path / "kts.yaml"
     cfg_file.write_text("attribution: off\n")
     assert from_args(["--config", str(cfg_file)]).attribution == "off"
+
+
+def test_log_format_defaults_text_and_setup_logging_runs():
+    """Regression: the daemon entrypoint calls setup_logging(cfg) before
+    anything else; a Config missing log_format crash-looped the DaemonSet
+    (round-1 advisor finding). Exercise the real entry path."""
+    from kube_gpu_stats_tpu.daemon import setup_logging
+
+    cfg = from_args([])
+    assert cfg.log_format == "text"
+    setup_logging(cfg)  # must not raise
+
+    cfg = from_args(["--log-format", "json"])
+    assert cfg.log_format == "json"
+    setup_logging(cfg)  # must not raise
+
+
+def test_log_format_rejects_unknown():
+    with pytest.raises(SystemExit):
+        from_args(["--log-format", "xml"])
+
+
+def test_json_log_formatter_single_line():
+    import json
+    import logging
+
+    from kube_gpu_stats_tpu.daemon import JsonLogFormatter
+
+    rec = logging.LogRecord("kts", logging.WARNING, __file__, 1,
+                            "tick overran by %dms", (7,), None)
+    doc = json.loads(JsonLogFormatter().format(rec))
+    assert doc["severity"] == "WARNING"
+    assert doc["message"] == "tick overran by 7ms"
+    assert "\n" not in JsonLogFormatter().format(rec)
