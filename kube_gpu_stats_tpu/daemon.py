@@ -25,10 +25,16 @@ log = logging.getLogger(__name__)
 
 
 def detect_tpu(cfg: Config) -> bool:
-    """Is a TPU visible on this node? Cheap sysfs probe (SURVEY.md §1 L0)."""
-    from .collectors.sysfs import SysfsCollector
-
-    return bool(SysfsCollector(cfg.sysfs_root).discover())
+    """Is a TPU visible on this node? Shares the production definition of
+    "TPU present" — ``TpuCollector.discover`` probes the accel sysfs class
+    first and, when that is absent (TPU VM variants without it), falls back
+    to one bounded libtpu discovery RPC per configured port (the round-1
+    hole: sysfs-less TPU VMs silently landed on the null backend)."""
+    probe = _tpu_collector(cfg)
+    try:
+        return bool(probe.discover())
+    finally:
+        probe.close()
 
 
 def build_collector(cfg: Config) -> Collector:
@@ -42,10 +48,18 @@ def build_collector(cfg: Config) -> Collector:
         return _gpu_collector(cfg)
     # auto: TPU when present, else sysfs-exposed GPUs (C12 single-binary
     # mixed clusters), else a schema-valid null exporter (BASELINE.json
-    # configs[0] behavior on CPU-only nodes).
+    # configs[0] behavior on CPU-only nodes). The probe instance IS the
+    # production collector when devices are found — probing and serving
+    # must never disagree about what "TPU present" means.
     try:
-        if detect_tpu(cfg):
-            return _tpu_collector(cfg)
+        tpu = _tpu_collector(cfg)
+        try:
+            if tpu.discover():
+                return tpu
+        except Exception:
+            tpu.close()
+            raise
+        tpu.close()
     except Exception as exc:
         log.warning("TPU probe failed (%s); trying gpu backend", exc)
     try:

@@ -124,3 +124,37 @@ def test_libtpu_only_node_discovers_via_runtime(tmp_path, server):
     assert schema.DUTY_CYCLE.name in s.values
     assert schema.POWER.name not in s.values
     col.close()
+
+
+def test_daemon_auto_detects_tpu_without_sysfs(tmp_path, server):
+    """Round-1 hole: on TPU VM variants without /sys/class/accel, --backend
+    auto must still land on the tpu backend via the bounded libtpu probe —
+    detect_tpu and TpuCollector.discover share one definition of "present"."""
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import build_collector, detect_tpu
+
+    cfg = Config(backend="auto", sysfs_root=str(tmp_path),  # empty tree
+                 libtpu_ports=(server.port,), use_native=False)
+    assert detect_tpu(cfg) is True
+    col = build_collector(cfg)
+    assert col.name == "tpu"
+    assert len(col.discover()) == 2
+    col.close()
+
+
+def test_daemon_auto_falls_to_null_when_nothing_present(tmp_path):
+    """No sysfs, no libtpu listener: auto must settle on null quickly
+    (bounded probe), never hang or crash."""
+    import time
+
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import build_collector
+
+    cfg = Config(backend="auto", sysfs_root=str(tmp_path),
+                 libtpu_ports=(1,),  # nothing listens on port 1
+                 use_native=False)
+    t0 = time.monotonic()
+    col = build_collector(cfg)
+    assert col.name == "null"
+    assert time.monotonic() - t0 < 5.0
+    col.close()
