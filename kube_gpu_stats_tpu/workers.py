@@ -21,6 +21,7 @@ from __future__ import annotations
 import concurrent.futures
 import queue
 import threading
+import time
 from typing import Callable
 
 
@@ -173,7 +174,15 @@ class DaemonSamplerPool:
             self._work.put((future, fn, args))
         return future
 
-    def shutdown(self, wait: bool = True, *, cancel_futures: bool = False) -> None:
+    def shutdown(self, wait: bool = False, *,
+                 cancel_futures: bool = False,
+                 timeout: float | None = 5.0) -> None:
+        """Stop the pool. ``wait=False`` (the default) never blocks — the
+        daemon threads die with the process, which is the whole point of
+        this class: a wedged backend call must not wedge teardown too.
+        ``wait=True`` joins the workers under one shared ``timeout``-second
+        deadline for the whole pool (``timeout=None`` restores an unbounded
+        join; use it only when the submitted work is known to terminate)."""
         with self._lock:
             self._shutdown = True
             if cancel_futures:
@@ -187,5 +196,8 @@ class DaemonSamplerPool:
             for _ in self._threads:
                 self._work.put(None)
         if wait:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
             for thread in self._threads:
-                thread.join()
+                thread.join(None if deadline is None
+                            else max(0.0, deadline - time.monotonic()))

@@ -237,3 +237,34 @@ def test_daemon_wires_remote_writer():
         assert d2.remote_writer is None
     finally:
         d2.collector.close()
+
+
+def test_prompb_known_answer_against_real_protobuf():
+    """Round-1 advisor finding: the hand-rolled prompb encoder was only
+    validated against its own decoder. This golden byte string was
+    generated with protoc 3.21 + the google.protobuf runtime from the
+    remote-write 1.0 WriteRequest schema (two timeseries, sorted labels,
+    one sample each) — byte-for-byte what a real receiver parses."""
+    from kube_gpu_stats_tpu.proto import prompb
+
+    golden = bytes.fromhex(
+        "0a580a220a085f5f6e616d655f5f1216616363656c657261746f725f64757479"
+        "5f6379636c650a090a04636869701201300a150a036a6f62120e6b7562652d74"
+        "70752d73746174731210090000000000c049401080d8a5de8f320a1e0a0e0a08"
+        "5f5f6e616d655f5f12027570120c09000000000000f03f10e807"
+    )
+    got = prompb.encode_write_request([
+        prompb.encode_series(
+            "accelerator_duty_cycle",
+            [("chip", "0"), ("job", "kube-tpu-stats")],
+            51.5, 1722211200000,
+        ),
+        prompb.encode_series("up", [], 1.0, 1000),
+    ])
+    assert got == golden
+    # And the test-side decoder reads the real-protobuf bytes too.
+    decoded = prompb.decode_write_request(golden)
+    assert decoded[0][0]["__name__"] == "accelerator_duty_cycle"
+    assert decoded[0][1] == [(51.5, 1722211200000)]
+    assert decoded[1][0] == {"__name__": "up"}
+    assert decoded[1][1] == [(1.0, 1000)]

@@ -67,3 +67,83 @@ def test_decoder_rejects_garbage():
     ):
         with pytest.raises(ValueError):
             snappy.decompress(bad)
+
+
+# -- Known-answer compressor vectors (round-1 advisor finding: roundtrip
+# -- tests alone can't catch a symmetric misreading of the format). Each
+# -- expected byte string is derived BY HAND from format_description.txt,
+# -- independent of the module under test.
+
+def test_compress_known_answer_empty():
+    # Spec: a compressed stream is the uvarint uncompressed length followed
+    # by elements; empty input = uvarint 0 and nothing else.
+    assert snappy.compress(b"") == b"\x00"
+
+
+def test_compress_known_answer_single_literal():
+    # uvarint 1, literal tag (1-1)<<2|00 = 0x00, payload.
+    assert snappy.compress(b"a") == b"\x01\x00a"
+
+
+def test_compress_known_answer_short_string():
+    # uvarint 5, literal tag (5-1)<<2 = 0x10 — the same stream the spec's
+    # worked example produces; any conformant decoder accepts it.
+    assert snappy.compress(b"Hello") == b"\x05\x10Hello"
+
+
+def _walk_spec_elements(blob: bytes) -> tuple[int, int]:
+    """Independent minimal verifier written straight from the snappy
+    format grammar (NOT the module's decoder): returns (claimed, produced)
+    decompressed lengths — the preamble's uvarint and the length implied
+    by walking the element stream — raising on any malformed tag."""
+    # uvarint preamble
+    shift = claimed = i = 0
+    while True:
+        byte = blob[i]
+        claimed |= (byte & 0x7F) << shift
+        i += 1
+        if not byte & 0x80:
+            break
+        shift += 7
+    produced = 0
+    while i < len(blob):
+        tag = blob[i]
+        kind = tag & 0b11
+        if kind == 0b00:  # literal
+            length = (tag >> 2) + 1
+            i += 1
+            if length > 60:
+                extra = length - 60
+                length = int.from_bytes(blob[i:i + extra], "little") + 1
+                i += extra
+            assert i + length <= len(blob), "literal overruns stream"
+            i += length
+        elif kind == 0b01:  # copy, 1-byte offset, len 4..11
+            length = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | blob[i + 1]
+            i += 2
+            assert 0 < offset <= produced, "copy-1 offset out of window"
+        elif kind == 0b10:  # copy, 2-byte little-endian offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(blob[i + 1:i + 3], "little")
+            i += 3
+            assert 0 < offset <= produced, "copy-2 offset out of window"
+        else:  # copy, 4-byte offset (never needed at our sizes)
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(blob[i + 1:i + 5], "little")
+            i += 5
+            assert 0 < offset <= produced, "copy-4 offset out of window"
+        produced += length
+    assert i == len(blob), "trailing garbage after final element"
+    return claimed, produced
+
+
+@pytest.mark.parametrize("payload", [
+    b"ab" * 50,
+    b"accelerator_duty_cycle{chip=\"0\"} 51.5\n" * 40,
+    bytes(range(256)) * 3,
+])
+def test_compressor_output_conforms_to_spec_grammar(payload):
+    claimed, produced = _walk_spec_elements(snappy.compress(payload))
+    assert claimed == len(payload)
+    assert produced == len(payload)

@@ -111,3 +111,27 @@ print("CLEAN-EXIT", flush=True)
     )
     assert "CLEAN-EXIT" in proc.stdout
     assert proc.returncode == 0
+
+
+def test_shutdown_default_never_blocks_on_wedged_worker():
+    """Advisor finding: the old wait=True default joined without timeout —
+    the exact hang the pool exists to avoid. The default must return even
+    while a worker is wedged; wait=True must honor its join timeout."""
+    import threading
+    import time
+
+    from kube_gpu_stats_tpu.workers import DaemonSamplerPool
+
+    release = threading.Event()
+    pool = DaemonSamplerPool(max_workers=1, thread_name_prefix="wedge")
+    pool.submit(release.wait)  # wedges the single worker
+    t0 = time.monotonic()
+    pool.shutdown()  # default: no join at all
+    assert time.monotonic() - t0 < 1.0
+
+    pool2 = DaemonSamplerPool(max_workers=1, thread_name_prefix="wedge2")
+    pool2.submit(release.wait)
+    t0 = time.monotonic()
+    pool2.shutdown(wait=True, timeout=0.2)
+    assert time.monotonic() - t0 < 2.0
+    release.set()
