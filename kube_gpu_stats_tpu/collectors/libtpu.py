@@ -59,18 +59,22 @@ _VALUE_MAP: Mapping[str, str] = {
 def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict]) -> None:
     """Fold one decoded metric into the per-device cache (the pure-Python
     reference for the fused native ingest — tests/test_wirefast.py pins the
-    two paths byte-equivalent)."""
+    two paths byte-equivalent). Unknown names (runtime newer than our pin)
+    are dropped BEFORE the entry is created: a device that only ever
+    reports unknown metrics must not materialize as a phantom chip."""
+    name = sample.name
+    if (name != tpumetrics.ICI_TRAFFIC and name != tpumetrics.COLLECTIVES
+            and name not in _VALUE_MAP):
+        return
     entry = cache.setdefault(
-        sample.device_id,
-        {"values": {}, "ici": {}, "collectives": None},
+        sample.device_id, {"values": {}, "ici": {}, "collectives": None}
     )
-    if sample.name == tpumetrics.ICI_TRAFFIC:
+    if name == tpumetrics.ICI_TRAFFIC:
         entry["ici"][sample.link or "link0"] = int(sample.value)
-    elif sample.name == tpumetrics.COLLECTIVES:
+    elif name == tpumetrics.COLLECTIVES:
         entry["collectives"] = int(sample.value)
-    elif sample.name in _VALUE_MAP:
-        entry["values"][_VALUE_MAP[sample.name]] = float(sample.value)
-    # Unknown names: runtime newer than our pin — ignore.
+    else:
+        entry["values"][_VALUE_MAP[name]] = float(sample.value)
 
 
 def ingest_response_py(raw: bytes, cache: dict[int, dict]) -> None:
@@ -130,6 +134,11 @@ class LibtpuClient:
                  ports: Sequence[int] = (8431,),
                  rpc_timeout: float = 0.040) -> None:
         self._rpc_timeout = rpc_timeout
+        self.ports = tuple(ports)
+        # port -> tpumetrics.FLAT/NESTED, latched on the first successfully
+        # scanned response from that port (a runtime never switches
+        # dialects mid-life; doctor and logs report this for diagnosis).
+        self.port_dialects: dict[int, str] = {}
         self._methods = []
         self._channels = []
         self._port_pool = (
@@ -181,7 +190,10 @@ class LibtpuClient:
 
     def _fan_out(self, request: bytes) -> list[tuple[bytes | None, Exception | None]]:
         """Issue the request to every port in parallel (one wedged process
-        must cost one rpc_timeout, not N); per-port (response, error)."""
+        must cost one rpc_timeout, not N); per-port (response, error).
+        Results are in ``self.ports`` order. Each port's wire dialect is
+        latched into ``port_dialects`` on its first non-empty response —
+        a one-time structural scan, not a per-tick cost."""
 
         def call(method):
             try:
@@ -190,8 +202,18 @@ class LibtpuClient:
                 return None, exc
 
         if self._port_pool is not None:
-            return list(self._port_pool.map(call, self._methods))
-        return [call(m) for m in self._methods]
+            results = list(self._port_pool.map(call, self._methods))
+        else:
+            results = [call(m) for m in self._methods]
+        for port, (raw, _) in zip(self.ports, results):
+            if raw and port not in self.port_dialects:
+                try:
+                    dialect = tpumetrics.detect_dialect(raw)
+                except ValueError:
+                    continue  # garbled port; decode paths will classify it
+                if dialect != tpumetrics.AMBIGUOUS:
+                    self.port_dialects[port] = dialect
+        return results
 
     def get_metric(self, metric_name: str) -> list[tpumetrics.MetricSample]:
         """Fetch one metric family from every port in parallel, merged.
@@ -206,7 +228,10 @@ class LibtpuClient:
                 continue
             try:
                 samples.extend(tpumetrics.decode_response(raw))
-            except ValueError as exc:
+            except (ValueError, OverflowError) as exc:
+                # OverflowError: the nested dialect converts attribute
+                # values with int() (e.g. device double_attr=inf). Either
+                # way this PORT is undecodable — the others still count.
                 errors.append(exc)
         if errors and not samples:
             self._raise_all_failed(metric_name, errors)

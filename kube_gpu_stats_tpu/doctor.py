@@ -129,10 +129,12 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                     families.add("ici_traffic")
                 if entry["collectives"] is not None:
                     families.add("collectives")
+            dialect = client.port_dialects.get(port, "unknown")
             return _result(
                 name, OK,
                 f"{len(cache)} chip(s), {len(families)} famil"
-                f"{'y' if len(families) == 1 else 'ies'} via batched fetch",
+                f"{'y' if len(families) == 1 else 'ies'} via batched fetch, "
+                f"{dialect} dialect",
             )
         if decode_failures:
             return _result(
@@ -159,10 +161,11 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                     f"failed ({code.name if code else exc})",
                 )
             chips = len(set(s.device_id for s in samples))
+            dialect = client.port_dialects.get(port, "unknown")
             return _result(
                 name, OK if chips else WARN,
-                f"{chips} chip(s) via per-metric fetch (runtime predates "
-                f"the batched selector)"
+                f"{chips} chip(s) via per-metric fetch, {dialect} dialect "
+                f"(runtime rejects the batched selector)"
                 + ("" if chips else " — port answers but no chip is "
                                     "collectable through it"),
             )

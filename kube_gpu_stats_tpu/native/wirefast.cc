@@ -7,12 +7,21 @@
 // straight into the cache dict the collector publishes from — no
 // intermediate sample objects.
 //
+// Both wire dialects are handled, auto-detected per response exactly like
+// proto/tpumetrics.py detect_dialect: the round-1 FLAT shape (one
+// self-contained Metric per chip/metric/link) and the NESTED tpu-info-style
+// shape (TPUMetric{name, repeated Metric{repeated Attribute, Timestamp,
+// Gauge oneof}}) — see the tpumetrics module docstring for both schemas.
+//
 // Contract (must match proto/tpumetrics.py decode_metric/decode_response,
 // pinned by the equivalence + fuzz tests in tests/test_wirefast.py):
 //   - known fields with a mismatched wire type -> ValueError
 //   - unknown fields skipped whatever their wire type (forward compat)
 //   - truncated varints / length windows -> ValueError
 //   - metric names / links must be valid UTF-8 -> ValueError otherwise
+//   - nested attr conversions use the CPython object protocols
+//     (PyNumber_Long / PyObject_Str), so int("abc") / int(nan) fail with
+//     exactly Python's exception types
 //
 // Build: make -C kube_gpu_stats_tpu/native  (-> _wirefast.so, plain-named so
 // the package importer picks it up without the versioned EXT_SUFFIX).
@@ -107,6 +116,560 @@ PyObject* link_str(const uint8_t* p, Py_ssize_t len) {
   }
   Py_DECREF(key);
   return s;
+}
+
+// Skip an unknown field's value (codec.skip_field semantics: ValueError on
+// truncation or an unsupported wire type). Returns false with exception set.
+bool skip_unknown(const uint8_t* data, Py_ssize_t end, Py_ssize_t* pos,
+                  int wire) {
+  if (wire == 0) {
+    uint64_t v;
+    if (!decode_varint(data, end, pos, &v)) {
+      err("truncated varint");
+      return false;
+    }
+  } else if (wire == 1) {
+    if (*pos + 8 > end) {
+      err("truncated fixed64");
+      return false;
+    }
+    *pos += 8;
+  } else if (wire == 2) {
+    uint64_t length;
+    if (!decode_varint(data, end, pos, &length) ||
+        (uint64_t)(end - *pos) < length) {
+      err("truncated length-delimited field");
+      return false;
+    }
+    *pos += (Py_ssize_t)length;
+  } else if (wire == 5) {
+    if (*pos + 4 > end) {
+      err("truncated fixed32");
+      return false;
+    }
+    *pos += 4;
+  } else {
+    err("unsupported wire type");
+    return false;
+  }
+  return true;
+}
+
+// Mirror of tpumetrics.detect_dialect: scan every top-level field-1
+// payload's (field, wire-type) pairs. Returns 0 = flat, 1 = nested,
+// 2 = ambiguous (no markers at all: name-only/empty — caller ingests
+// nothing), -1 = error with exception set (mixed markers or malformed
+// scan).
+int scan_dialect(const uint8_t* data, Py_ssize_t end) {
+  long flat_markers = 0, nested_markers = 0;
+  Py_ssize_t pos = 0;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) {
+      err("truncated varint");
+      return -1;
+    }
+    uint64_t field = key >> 3;
+    int wire = key & 0x07;
+    if (field == 1 && wire != 2) {
+      // Field 1 is length-delimited in BOTH dialects; any other wire type
+      // is a schema violation, not an empty answer.
+      err("MetricResponse.metrics has wrong wire type");
+      return -1;
+    }
+    if (field != 1) {
+      if (!skip_unknown(data, end, &pos, wire)) return -1;
+      continue;
+    }
+    uint64_t length;
+    if (!decode_varint(data, end, &pos, &length) ||
+        (uint64_t)(end - pos) < length) {
+      err("truncated MetricResponse entry");
+      return -1;
+    }
+    Py_ssize_t mend = pos + (Py_ssize_t)length;
+    Py_ssize_t mpos = pos;
+    pos = mend;
+    while (mpos < mend) {
+      uint64_t mkey;
+      if (!decode_varint(data, mend, &mpos, &mkey)) {
+        err("truncated varint");
+        return -1;
+      }
+      uint64_t mfield = mkey >> 3;
+      int mwire = mkey & 0x07;
+      if (mfield == 2) {
+        if (mwire == 0)
+          ++flat_markers;  // Metric.device_id
+        else if (mwire == 2)
+          ++nested_markers;  // TPUMetric.description
+      } else if (mfield == 3) {
+        if (mwire == 1)
+          ++flat_markers;  // Metric.double_value
+        else if (mwire == 2)
+          ++nested_markers;  // TPUMetric.metrics
+      } else if ((mfield == 4 || mfield == 5) && mwire == 0) {
+        ++flat_markers;  // Metric.int_value / timestamp_ns
+      } else if (mfield == 6 && mwire == 2) {
+        ++flat_markers;  // Metric.link
+      }
+      if (!skip_unknown(data, mend, &mpos, mwire)) return -1;
+    }
+  }
+  if (flat_markers && nested_markers) {
+    err("MetricResponse mixes flat and nested dialect markers");
+    return -1;
+  }
+  if (nested_markers) return 1;
+  return flat_markers ? 0 : 2;
+}
+
+// Attribute-key spellings accepted for the chip id / ICI link — keep in
+// sync with DEVICE_ATTR_KEYS / LINK_ATTR_KEYS in proto/tpumetrics.py
+// (pinned per-spelling by tests/test_wirefast.py).
+const char* kDeviceKeys[] = {"device_id", "core_id", "chip_id", "device",
+                             "global_device_id", "accelerator_id", nullptr};
+// "direction" is intentionally absent: it is a sibling dimension (tx/rx),
+// not a link-id spelling — see LINK_ATTR_KEYS in proto/tpumetrics.py.
+const char* kLinkKeys[] = {"link", "link_id", "link_name", nullptr};
+
+bool key_in(const uint8_t* p, Py_ssize_t len, const char** set) {
+  for (int i = 0; set[i]; ++i) {
+    if ((Py_ssize_t)strlen(set[i]) == len && memcmp(set[i], p, len) == 0)
+      return true;
+  }
+  return false;
+}
+
+// Parse one nested-dialect Attribute{key, AttrValue oneof}. On success
+// *key_p/*key_len point into data and *value holds a new reference
+// (str/int/float) or NULL when the AttrValue carried nothing.
+int parse_attribute(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
+                    const uint8_t** key_p, Py_ssize_t* key_len,
+                    PyObject** value) {
+  *key_p = nullptr;
+  *key_len = 0;
+  *value = nullptr;
+  Py_ssize_t pos = start;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) {
+      err("truncated varint");
+      goto fail;
+    }
+    {
+      uint64_t field = key >> 3;
+      int wire = key & 0x07;
+      if (field == 1 && wire == 2) {
+        uint64_t length;
+        if (!decode_varint(data, end, &pos, &length) ||
+            (uint64_t)(end - pos) < length) {
+          err("truncated Attribute.key");
+          goto fail;
+        }
+        // Python decodes the key eagerly; invalid UTF-8 must fail here.
+        PyObject* probe = PyUnicode_DecodeUTF8((const char*)(data + pos),
+                                               (Py_ssize_t)length, nullptr);
+        if (!probe) {
+          PyErr_Clear();
+          err("wire-type mismatch in Attribute: invalid UTF-8 in key");
+          goto fail;
+        }
+        Py_DECREF(probe);
+        *key_p = data + pos;
+        *key_len = (Py_ssize_t)length;
+        pos += (Py_ssize_t)length;
+      } else if (field == 2 && wire == 2) {
+        uint64_t length;
+        if (!decode_varint(data, end, &pos, &length) ||
+            (uint64_t)(end - pos) < length) {
+          err("truncated AttrValue");
+          goto fail;
+        }
+        Py_ssize_t vend = pos + (Py_ssize_t)length;
+        while (pos < vend) {
+          uint64_t vkey;
+          if (!decode_varint(data, vend, &pos, &vkey)) {
+            err("truncated varint");
+            goto fail;
+          }
+          uint64_t vfield = vkey >> 3;
+          int vwire = vkey & 0x07;
+          if (vfield == 1 && vwire == 2) {
+            uint64_t vlen;
+            if (!decode_varint(data, vend, &pos, &vlen) ||
+                (uint64_t)(vend - pos) < vlen) {
+              err("truncated string_attr");
+              goto fail;
+            }
+            PyObject* s = PyUnicode_DecodeUTF8((const char*)(data + pos),
+                                               (Py_ssize_t)vlen, nullptr);
+            if (!s) {
+              PyErr_Clear();
+              err("wire-type mismatch in AttrValue: invalid UTF-8");
+              goto fail;
+            }
+            Py_XSETREF(*value, s);
+            pos += (Py_ssize_t)vlen;
+          } else if ((vfield == 2 || vfield == 3) && vwire == 0) {
+            uint64_t raw;
+            if (!decode_varint(data, vend, &pos, &raw)) {
+              err("truncated varint");
+              goto fail;
+            }
+            PyObject* v = PyLong_FromLongLong((int64_t)raw);
+            if (!v) goto fail;
+            Py_XSETREF(*value, v);
+          } else if (vfield == 4 && vwire == 1) {
+            if (pos + 8 > vend) {
+              err("truncated double_attr");
+              goto fail;
+            }
+            double d;
+            memcpy(&d, data + pos, 8);
+            PyObject* v = PyFloat_FromDouble(d);
+            if (!v) goto fail;
+            Py_XSETREF(*value, v);
+            pos += 8;
+          } else {
+            if (!skip_unknown(data, vend, &pos, vwire)) goto fail;
+          }
+        }
+      } else if (field == 1 || field == 2) {
+        err("Attribute field has mismatched wire type");
+        goto fail;
+      } else {
+        if (!skip_unknown(data, end, &pos, wire)) goto fail;
+      }
+    }
+  }
+  return 0;
+fail:
+  Py_CLEAR(*value);
+  return -1;
+}
+
+// Metric-family kinds; kUnknown families are parsed but not folded.
+enum Kind { kIci = 0, kColl = 1, kValue = 2, kUnknown = -1 };
+
+// Classify a metric name against the configure()d surface. On kValue,
+// *schema_name receives the borrowed interned schema string.
+int classify_name(const uint8_t* name_p, Py_ssize_t name_len,
+                  PyObject** schema_name) {
+  *schema_name = nullptr;
+  if (name_len == g_ici_len && memcmp(name_p, g_ici_name, name_len) == 0)
+    return kIci;
+  if (name_len == g_coll_len && memcmp(name_p, g_coll_name, name_len) == 0)
+    return kColl;
+  for (int i = 0; i < g_n_values; ++i) {
+    if (g_value_map[i].len == name_len &&
+        memcmp(g_value_map[i].name, name_p, name_len) == 0) {
+      *schema_name = g_value_map[i].schema;
+      return kValue;
+    }
+  }
+  return kUnknown;
+}
+
+// Fold one decoded value into the cache — the shared tail of both
+// dialects' ingest. dev_key is borrowed; link_obj may be NULL (or empty,
+// both mean the "link0" default, mirroring `sample.link or "link0"`).
+int fold_value(PyObject* cache, PyObject* dev_key, int kind,
+               PyObject* schema_name, PyObject* link_obj, bool has_int,
+               int64_t int_value, bool has_double, double double_value) {
+  // entry = cache.setdefault(dev_key, {"values": {}, "ici": {},
+  //                                    "collectives": None})
+  PyObject* entry = PyDict_GetItem(cache, dev_key);  // borrowed
+  if (!entry) {
+    entry = PyDict_New();
+    PyObject* values = PyDict_New();
+    PyObject* ici = PyDict_New();
+    if (!entry || !values || !ici ||
+        PyDict_SetItem(entry, g_s_values, values) < 0 ||
+        PyDict_SetItem(entry, g_s_ici, ici) < 0 ||
+        PyDict_SetItem(entry, g_s_collectives, Py_None) < 0 ||
+        PyDict_SetItem(cache, dev_key, entry) < 0) {
+      Py_XDECREF(entry);
+      Py_XDECREF(values);
+      Py_XDECREF(ici);
+      return -1;
+    }
+    Py_DECREF(values);
+    Py_DECREF(ici);
+    Py_DECREF(entry);  // cache holds the reference; entry stays borrowed-valid
+    entry = PyDict_GetItem(cache, dev_key);
+  }
+
+  // Effective value: int_value wins when present (mirrors decode_metric),
+  // else double_value, else 0.0. Int conversion of a double goes through
+  // PyLong_FromDouble so NaN/inf/huge behave exactly like Python's int().
+  int rc = 0;
+  if (kind == kIci || kind == kColl) {
+    PyObject* v = has_int      ? PyLong_FromLongLong(int_value)
+                  : has_double ? PyLong_FromDouble(double_value)
+                               : PyLong_FromLongLong(0);
+    if (!v) return -1;  // int(NaN)/int(inf) exception, matching Python ingest
+    if (kind == kIci) {
+      PyObject* ici = PyDict_GetItem(entry, g_s_ici);  // borrowed
+      PyObject* link;
+      int truthy = link_obj ? PyObject_IsTrue(link_obj) : 0;
+      if (truthy < 0) {
+        Py_DECREF(v);
+        return -1;
+      }
+      if (truthy) {
+        link = link_obj;
+        Py_INCREF(link);
+      } else {
+        link = g_s_link0;
+        Py_INCREF(link);
+      }
+      rc = PyDict_SetItem(ici, link, v);
+      Py_DECREF(link);
+    } else {
+      rc = PyDict_SetItem(entry, g_s_collectives, v);
+    }
+    Py_DECREF(v);
+  } else {  // kValue
+    double fval = has_int      ? (double)int_value
+                  : has_double ? double_value
+                               : 0.0;
+    PyObject* values = PyDict_GetItem(entry, g_s_values);  // borrowed
+    PyObject* v = PyFloat_FromDouble(fval);
+    if (!v) return -1;
+    rc = PyDict_SetItem(values, schema_name, v);
+    Py_DECREF(v);
+  }
+  return rc;
+}
+
+// Parse one nested-dialect Metric{repeated attribute, timestamp, gauge} in
+// data[start:end) and fold it into cache under the classified kind
+// (kind < 0 = unknown family: parse fully for error parity, fold nothing).
+int ingest_metric_nested(const uint8_t* data, Py_ssize_t start,
+                         Py_ssize_t end, PyObject* cache, int kind,
+                         PyObject* schema_name) {
+  PyObject* dev_obj = nullptr;   // int() of the device attribute
+  PyObject* link_obj = nullptr;  // str() of the link attribute
+  bool has_int = false, has_double = false;
+  int64_t int_value = 0;
+  double double_value = 0.0;
+  int rc = -1;
+
+  Py_ssize_t pos = start;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) {
+      err("truncated varint");
+      goto done;
+    }
+    {
+      uint64_t field = key >> 3;
+      int wire = key & 0x07;
+      if (field == 1 && wire == 2) {  // Attribute
+        uint64_t length;
+        if (!decode_varint(data, end, &pos, &length) ||
+            (uint64_t)(end - pos) < length) {
+          err("truncated Attribute");
+          goto done;
+        }
+        const uint8_t* key_p;
+        Py_ssize_t key_len;
+        PyObject* value;
+        if (parse_attribute(data, pos, pos + (Py_ssize_t)length, &key_p,
+                            &key_len, &value) < 0)
+          goto done;
+        pos += (Py_ssize_t)length;
+        if (value && key_in(key_p, key_len, kDeviceKeys)) {
+          PyObject* as_int = PyNumber_Long(value);  // int(value) semantics
+          Py_DECREF(value);
+          if (!as_int) goto done;
+          Py_XSETREF(dev_obj, as_int);
+        } else if (value && key_in(key_p, key_len, kLinkKeys)) {
+          PyObject* as_str = PyObject_Str(value);  // str(value) semantics
+          Py_DECREF(value);
+          if (!as_str) goto done;
+          Py_XSETREF(link_obj, as_str);
+        } else {
+          Py_XDECREF(value);
+        }
+      } else if (field == 2 && wire == 2) {  // Timestamp (walked, unused)
+        uint64_t length;
+        if (!decode_varint(data, end, &pos, &length) ||
+            (uint64_t)(end - pos) < length) {
+          err("truncated Timestamp");
+          goto done;
+        }
+        Py_ssize_t tend = pos + (Py_ssize_t)length;
+        while (pos < tend) {
+          uint64_t tkey;
+          if (!decode_varint(data, tend, &pos, &tkey)) {
+            err("truncated varint");
+            goto done;
+          }
+          uint64_t tfield = tkey >> 3;
+          int twire = tkey & 0x07;
+          if ((tfield == 1 || tfield == 2) && twire == 0) {
+            uint64_t v;
+            if (!decode_varint(data, tend, &pos, &v)) {
+              err("truncated varint");
+              goto done;
+            }
+          } else {
+            if (!skip_unknown(data, tend, &pos, twire)) goto done;
+          }
+        }
+      } else if (field == 3 && wire == 2) {  // Gauge oneof
+        uint64_t length;
+        if (!decode_varint(data, end, &pos, &length) ||
+            (uint64_t)(end - pos) < length) {
+          err("truncated Gauge");
+          goto done;
+        }
+        Py_ssize_t gend = pos + (Py_ssize_t)length;
+        while (pos < gend) {
+          uint64_t gkey;
+          if (!decode_varint(data, gend, &pos, &gkey)) {
+            err("truncated varint");
+            goto done;
+          }
+          uint64_t gfield = gkey >> 3;
+          int gwire = gkey & 0x07;
+          if (gfield == 1 && gwire == 1) {
+            if (pos + 8 > gend) {
+              err("truncated as_double");
+              goto done;
+            }
+            memcpy(&double_value, data + pos, 8);
+            has_double = true;
+            has_int = false;  // last-parsed wins, like the Python decoder
+            pos += 8;
+          } else if (gfield == 2 && gwire == 0) {
+            uint64_t raw;
+            if (!decode_varint(data, gend, &pos, &raw)) {
+              err("truncated varint");
+              goto done;
+            }
+            int_value = (int64_t)raw;
+            has_int = true;
+            has_double = false;
+          } else {
+            if (!skip_unknown(data, gend, &pos, gwire)) goto done;
+          }
+        }
+      } else if (field == 1 || field == 2 || field == 3) {
+        err("nested Metric field has mismatched wire type");
+        goto done;
+      } else {
+        if (!skip_unknown(data, end, &pos, wire)) goto done;
+      }
+    }
+  }
+  if (pos != end) {
+    err("nested Metric overran its length window");
+    goto done;
+  }
+  if (kind < 0) {
+    rc = 0;  // unknown family: validated, nothing to fold
+    goto done;
+  }
+  {
+    PyObject* dev_key = dev_obj;
+    if (dev_key) {
+      Py_INCREF(dev_key);
+    } else {
+      dev_key = PyLong_FromLong(0);
+      if (!dev_key) goto done;
+    }
+    rc = fold_value(cache, dev_key, kind, schema_name, link_obj, has_int,
+                    int_value, has_double, double_value);
+    Py_DECREF(dev_key);
+  }
+done:
+  Py_XDECREF(dev_obj);
+  Py_XDECREF(link_obj);
+  return rc;
+}
+
+// Parse one nested-dialect TPUMetric{name, description, repeated Metric}
+// in data[start:end) and fold every inner Metric into cache. Two passes:
+// the name may be serialized after the metrics, and classification must
+// happen before folding (matching _decode_tpumetric, which records metric
+// windows and decodes them once the name is known).
+int ingest_tpumetric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
+                     PyObject* cache) {
+  const uint8_t* name_p = nullptr;
+  Py_ssize_t name_len = 0;
+
+  // Pass 1: structure validation + name.
+  Py_ssize_t pos = start;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) return err("truncated varint"), -1;
+    uint64_t field = key >> 3;
+    int wire = key & 0x07;
+    if (field == 1 && wire == 2) {
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length) ||
+          (uint64_t)(end - pos) < length)
+        return err("truncated TPUMetric.name"), -1;
+      PyObject* probe = PyUnicode_DecodeUTF8((const char*)(data + pos),
+                                             (Py_ssize_t)length, nullptr);
+      if (!probe) {
+        PyErr_Clear();
+        return err("wire-type mismatch in TPUMetric: invalid UTF-8 in name"),
+               -1;
+      }
+      Py_DECREF(probe);
+      name_p = data + pos;
+      name_len = (Py_ssize_t)length;
+      pos += (Py_ssize_t)length;
+    } else if (field == 2 && wire == 2) {  // description: skipped
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length) ||
+          (uint64_t)(end - pos) < length)
+        return err("truncated TPUMetric.description"), -1;
+      pos += (Py_ssize_t)length;
+    } else if (field == 3 && wire == 2) {
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length) ||
+          (uint64_t)(end - pos) < length)
+        return err("truncated nested Metric"), -1;
+      pos += (Py_ssize_t)length;
+    } else if (field == 1 || field == 2 || field == 3) {
+      return err("TPUMetric field has mismatched wire type"), -1;
+    } else {
+      if (!skip_unknown(data, end, &pos, wire)) return -1;
+    }
+  }
+
+  PyObject* schema_name = nullptr;  // borrowed
+  int kind = classify_name(name_p, name_len, &schema_name);
+
+  // Pass 2: fold each metric window (structure already validated, so only
+  // field-3 windows need re-walking; lengths re-read, errors impossible).
+  pos = start;
+  while (pos < end) {
+    uint64_t key;
+    if (!decode_varint(data, end, &pos, &key)) return err("truncated varint"), -1;
+    uint64_t field = key >> 3;
+    int wire = key & 0x07;
+    if (field == 3 && wire == 2) {
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length)) return -1;
+      if (ingest_metric_nested(data, pos, pos + (Py_ssize_t)length, cache,
+                               kind, schema_name) < 0)
+        return -1;
+      pos += (Py_ssize_t)length;
+    } else if ((field == 1 || field == 2) && wire == 2) {
+      uint64_t length;
+      if (!decode_varint(data, end, &pos, &length)) return -1;
+      pos += (Py_ssize_t)length;
+    } else {
+      if (!skip_unknown(data, end, &pos, wire)) return -1;
+    }
+  }
+  return 0;
 }
 
 // Parse one Metric message in data[pos:end) and fold it into cache.
@@ -219,90 +782,24 @@ int ingest_metric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
   }
 
   // Classify the metric name: ici / collectives / value_map / unknown.
-  enum { ICI, COLL, VALUE, UNKNOWN } kind = UNKNOWN;
   PyObject* schema_name = nullptr;  // borrowed (value_map entry)
-  if (name_len == g_ici_len && memcmp(name_p, g_ici_name, name_len) == 0) {
-    kind = ICI;
-  } else if (name_len == g_coll_len &&
-             memcmp(name_p, g_coll_name, name_len) == 0) {
-    kind = COLL;
-  } else {
-    for (int i = 0; i < g_n_values; ++i) {
-      if (g_value_map[i].len == name_len &&
-          memcmp(g_value_map[i].name, name_p, name_len) == 0) {
-        kind = VALUE;
-        schema_name = g_value_map[i].schema;
-        break;
-      }
-    }
-  }
-  if (kind == UNKNOWN) return 0;  // runtime newer than our pin — ignore
+  int kind = classify_name(name_p, name_len, &schema_name);
+  if (kind < 0) return 0;  // runtime newer than our pin — ignore
 
-  // entry = cache.setdefault(device_id, {"values": {}, "ici": {},
-  //                                      "collectives": None})
   PyObject* dev_key = PyLong_FromLongLong(device_id);
   if (!dev_key) return -1;
-  PyObject* entry = PyDict_GetItem(cache, dev_key);  // borrowed
-  if (!entry) {
-    entry = PyDict_New();
-    PyObject* values = PyDict_New();
-    PyObject* ici = PyDict_New();
-    if (!entry || !values || !ici ||
-        PyDict_SetItem(entry, g_s_values, values) < 0 ||
-        PyDict_SetItem(entry, g_s_ici, ici) < 0 ||
-        PyDict_SetItem(entry, g_s_collectives, Py_None) < 0 ||
-        PyDict_SetItem(cache, dev_key, entry) < 0) {
-      Py_XDECREF(entry);
-      Py_XDECREF(values);
-      Py_XDECREF(ici);
+  PyObject* link_obj = nullptr;
+  if (kind == kIci && link_len > 0) {
+    link_obj = link_str(link_p, link_len);
+    if (!link_obj) {
       Py_DECREF(dev_key);
       return -1;
     }
-    Py_DECREF(values);
-    Py_DECREF(ici);
-    Py_DECREF(entry);  // cache holds the reference; entry stays borrowed-valid
-    entry = PyDict_GetItem(cache, dev_key);
   }
+  int rc = fold_value(cache, dev_key, kind, schema_name, link_obj, has_int,
+                      int_value, has_double, double_value);
+  Py_XDECREF(link_obj);
   Py_DECREF(dev_key);
-
-  // Effective value: int_value wins when present (mirrors decode_metric),
-  // else double_value, else 0.0. Int conversion of a double goes through
-  // PyLong_FromDouble so NaN/inf/huge behave exactly like Python's int().
-  int rc = 0;
-  if (kind == ICI || kind == COLL) {
-    PyObject* v = has_int      ? PyLong_FromLongLong(int_value)
-                  : has_double ? PyLong_FromDouble(double_value)
-                               : PyLong_FromLongLong(0);
-    if (!v) return -1;  // int(NaN)/int(inf) exception, matching Python ingest
-    if (kind == ICI) {
-      PyObject* ici = PyDict_GetItem(entry, g_s_ici);  // borrowed
-      PyObject* link;
-      if (link_len > 0) {
-        link = link_str(link_p, link_len);
-        if (!link) {
-          Py_DECREF(v);
-          return -1;
-        }
-      } else {
-        link = g_s_link0;
-        Py_INCREF(link);
-      }
-      rc = PyDict_SetItem(ici, link, v);
-      Py_DECREF(link);
-    } else {
-      rc = PyDict_SetItem(entry, g_s_collectives, v);
-    }
-    Py_DECREF(v);
-  } else {  // VALUE
-    double fval = has_int      ? (double)int_value
-                  : has_double ? double_value
-                               : 0.0;
-    PyObject* values = PyDict_GetItem(entry, g_s_values);  // borrowed
-    PyObject* v = PyFloat_FromDouble(fval);
-    if (!v) return -1;
-    rc = PyDict_SetItem(values, schema_name, v);
-    Py_DECREF(v);
-  }
   return rc;
 }
 
@@ -313,6 +810,17 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
     return nullptr;
   const uint8_t* data = (const uint8_t*)buf.buf;
   Py_ssize_t end = buf.len;
+  // Per-response dialect auto-detection (mirrors detect_dialect): one
+  // linear field-key scan, no allocation.
+  int dialect = scan_dialect(data, end);
+  if (dialect < 0) {
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  if (dialect == 2) {  // ambiguous: scan validated every byte, nothing to fold
+    PyBuffer_Release(&buf);
+    return PyLong_FromLong(0);
+  }
   Py_ssize_t pos = 0;
   long n = 0;
   while (pos < end) {
@@ -334,7 +842,12 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
         PyBuffer_Release(&buf);
         return err("truncated Metric");
       }
-      if (ingest_metric(data, pos, pos + (Py_ssize_t)length, cache) < 0) {
+      int rc = dialect
+                   ? ingest_tpumetric(data, pos, pos + (Py_ssize_t)length,
+                                      cache)
+                   : ingest_metric(data, pos, pos + (Py_ssize_t)length,
+                                   cache);
+      if (rc < 0) {
         PyBuffer_Release(&buf);
         return nullptr;
       }

@@ -4,14 +4,19 @@ The libtpu runtime serves chip counters over localhost gRPC (ports from
 ``TPU_RUNTIME_METRICS_PORTS``, default 8431 — SURVEY.md §2 C11). The exact
 proto surface is version-sensitive (SURVEY.md §7 hard part a), so the whole
 contract is isolated here + pinned by the fake server in
-tests/fakes/libtpu_server.py: one method
+kube_gpu_stats_tpu/testing/libtpu_server.py: one method
 
     /tpu.monitoring.runtime.MetricService/GetRuntimeMetric
 
 taking a metric-name selector and returning one sample per (chip, metric[,
 link]). Adapting to a different libtpu build means editing only this module.
 
-Wire schema (proto3):
+**Two wire dialects are supported, auto-detected per response** (round-1
+verdict item 1: different libtpu builds are reported to serve structurally
+different response bodies on the same method path; guessing wrong must not
+silently zero out the product). :func:`decode_response` accepts either.
+
+FLAT dialect (proto3) — one self-contained Metric per (chip, metric, link):
 
     message MetricRequest  { string metric_name = 1; }   // "" = all metrics
     message Metric {
@@ -23,6 +28,41 @@ Wire schema (proto3):
       string link        = 6;   // per-ICI-link metrics only ("x0".."z1")
     }
     message MetricResponse { repeated Metric metrics = 1; }
+
+NESTED dialect (proto3) — tpu-info-style: one TPUMetric wrapper named after
+the requested family, with the chip id / link carried as key-value
+attributes and the value in a Gauge oneof:
+
+    message AttrValue {
+      oneof attr { string string_attr = 1; bool bool_attr = 2;
+                   int64 int_attr = 3; double double_attr = 4; }
+    }
+    message Attribute { string key = 1; AttrValue value = 2; }
+    message Gauge { oneof value { double as_double = 1; int64 as_int = 2; } }
+    message Timestamp { int64 seconds = 1; int32 nanos = 2; }  // well-known
+    message Metric {
+      repeated Attribute attribute = 1;   // device_id, link, ...
+      Timestamp timestamp = 2;
+      Gauge gauge = 3;
+    }
+    message TPUMetric {
+      string name = 1; string description = 2; repeated Metric metrics = 3;
+    }
+    message MetricResponse { TPUMetric metric = 1; }
+
+Runtimes speaking the nested dialect answer one family per RPC (the
+request must name a metric); the collector's per-metric fan-out mode
+covers that, and such runtimes reject the batched "" selector, which the
+collector already latches (see collectors/libtpu.py ``_batched``).
+
+Auto-detection is structural and unambiguous except for a name-only
+message: within the top-level field-1 payload, flat uses field 2 as varint
+/ field 3 as fixed64 / fields 4-5 varint / field 6 string, while nested
+TPUMetric uses fields 2-3 as length-delimited submessages — the wire types
+are disjoint. A response carrying only field-1 names (no values anywhere)
+is AMBIGUOUS and decodes to zero samples: fabricating a chip-0/value-0
+flat sample from what may be an empty nested answer would materialize
+phantom devices (see the AMBIGUOUS constant for the trade-off).
 """
 
 from __future__ import annotations
@@ -178,11 +218,308 @@ def decode_metric(data: bytes, _start: int = 0, _end: int | None = None
 
 
 def encode_response(samples: list[MetricSample]) -> bytes:
+    """Flat-dialect MetricResponse."""
     return b"".join(codec.field_bytes(1, encode_metric(s)) for s in samples)
 
 
+# -- nested dialect -----------------------------------------------------------
+
+# Attribute keys that carry the chip id / ICI link in the nested dialect.
+# Accepting the handful of plausible spellings costs nothing and keeps one
+# runtime build's naming choice from zeroing the product.
+DEVICE_ATTR_KEYS = frozenset({
+    "device_id", "core_id", "chip_id", "device", "global_device_id",
+    "accelerator_id",
+})
+# "direction" is deliberately NOT a link spelling: it is a sibling
+# dimension (tx/rx) that would collapse distinct links if treated as the
+# link id (round-2 review finding); unknown attributes are ignored.
+LINK_ATTR_KEYS = frozenset({"link", "link_id", "link_name"})
+
+
+def _encode_attr(key: str, value: str | int) -> bytes:
+    if isinstance(value, str):
+        attr_value = codec.field_string(1, value)      # string_attr
+    else:
+        attr_value = codec.field_varint(3, int(value))  # int_attr
+    return codec.field_string(1, key) + codec.field_bytes(2, attr_value)
+
+
+def encode_metric_nested(sample: MetricSample) -> bytes:
+    """One nested-dialect Metric (attributes + Timestamp + Gauge oneof)."""
+    out = codec.field_bytes(1, _encode_attr("device_id", sample.device_id))
+    if sample.link:
+        out += codec.field_bytes(1, _encode_attr("link", sample.link))
+    if sample.timestamp_ns:
+        ts = codec.field_varint(1, sample.timestamp_ns // 1_000_000_000)
+        ts += codec.field_varint(2, sample.timestamp_ns % 1_000_000_000)
+        out += codec.field_bytes(2, ts)
+    if sample.name in INT_METRICS:
+        gauge = codec.field_varint(2, int(sample.value))     # as_int
+    else:
+        gauge = codec.field_double(1, float(sample.value))   # as_double
+    return out + codec.field_bytes(3, gauge)
+
+
+def encode_response_nested(name: str, samples: list[MetricSample]) -> bytes:
+    """Nested-dialect MetricResponse: one TPUMetric wrapping every sample.
+    All samples must belong to the ``name`` family (the nested dialect has
+    nowhere to carry a second family in one response)."""
+    body = codec.field_string(1, name)
+    for s in samples:
+        if s.name != name:
+            raise ValueError(
+                f"nested response for {name!r} cannot carry {s.name!r}"
+            )
+        body += codec.field_bytes(3, encode_metric_nested(s))
+    return codec.field_bytes(1, body)
+
+
+def _decode_attribute(data: bytes, start: int, end: int) -> tuple[str, str | int | float | None]:
+    """Attribute{key, AttrValue oneof} -> (key, value)."""
+    key_name = ""
+    value: str | int | float | None = None
+    pos = start
+    decode_varint = codec.decode_varint
+    while pos < end:
+        key, pos = decode_varint(data, pos)
+        field, wire_type = key >> 3, key & 0x07
+        if field == 1 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            if pos + length > end:
+                raise ValueError("truncated Attribute.key")
+            key_name = data[pos:pos + length].decode("utf-8")
+            pos += length
+        elif field == 2 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            vend = pos + length
+            if vend > end:
+                raise ValueError("truncated AttrValue")
+            while pos < vend:
+                vkey, pos = decode_varint(data, pos)
+                vfield, vwire = vkey >> 3, vkey & 0x07
+                if vfield == 1 and vwire == codec.LENGTH:
+                    vlen, pos = decode_varint(data, pos)
+                    if pos + vlen > vend:
+                        raise ValueError("truncated string_attr")
+                    value = data[pos:pos + vlen].decode("utf-8")
+                    pos += vlen
+                elif vfield in (2, 3) and vwire == codec.VARINT:
+                    raw, pos = decode_varint(data, pos)
+                    value = raw - (1 << 64) if raw >= 1 << 63 else raw
+                elif vfield == 4 and vwire == codec.FIXED64:
+                    if pos + 8 > vend:
+                        raise ValueError("truncated double_attr")
+                    value = struct.unpack_from("<d", data, pos)[0]
+                    pos += 8
+                else:
+                    pos = codec.skip_field(data, pos, vwire)
+            if pos != vend:
+                raise ValueError("AttrValue overran its length window")
+        elif field in (1, 2):
+            raise ValueError(f"Attribute field {field} has wire type {wire_type}")
+        else:
+            pos = codec.skip_field(data, pos, wire_type)
+    if pos != end:
+        raise ValueError("Attribute overran its length window")
+    return key_name, value
+
+
+def _decode_metric_nested(data: bytes, start: int, end: int, name: str
+                          ) -> MetricSample:
+    """Nested Metric{repeated attribute, timestamp, gauge} -> MetricSample."""
+    device_id = 0
+    link = ""
+    timestamp_ns = 0
+    value: float | int = 0.0
+    pos = start
+    decode_varint = codec.decode_varint
+    while pos < end:
+        key, pos = decode_varint(data, pos)
+        field, wire_type = key >> 3, key & 0x07
+        if field == 1 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            if pos + length > end:
+                raise ValueError("truncated Attribute")
+            attr_key, attr_value = _decode_attribute(data, pos, pos + length)
+            pos += length
+            if attr_key in DEVICE_ATTR_KEYS and attr_value is not None:
+                device_id = int(attr_value)
+            elif attr_key in LINK_ATTR_KEYS and attr_value is not None:
+                link = str(attr_value)
+            # Unknown attribute keys: carry data we don't label — skip.
+        elif field == 2 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            tend = pos + length
+            if tend > end:
+                raise ValueError("truncated Timestamp")
+            seconds = nanos = 0
+            while pos < tend:
+                tkey, pos = decode_varint(data, pos)
+                tfield, twire = tkey >> 3, tkey & 0x07
+                if tfield == 1 and twire == codec.VARINT:
+                    seconds, pos = decode_varint(data, pos)
+                elif tfield == 2 and twire == codec.VARINT:
+                    nanos, pos = decode_varint(data, pos)
+                else:
+                    pos = codec.skip_field(data, pos, twire)
+            if pos != tend:
+                raise ValueError("Timestamp overran its length window")
+            timestamp_ns = seconds * 1_000_000_000 + nanos
+        elif field == 3 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            gend = pos + length
+            if gend > end:
+                raise ValueError("truncated Gauge")
+            while pos < gend:
+                gkey, pos = decode_varint(data, pos)
+                gfield, gwire = gkey >> 3, gkey & 0x07
+                if gfield == 1 and gwire == codec.FIXED64:
+                    if pos + 8 > gend:
+                        raise ValueError("truncated as_double")
+                    value = struct.unpack_from("<d", data, pos)[0]
+                    pos += 8
+                elif gfield == 2 and gwire == codec.VARINT:
+                    raw, pos = decode_varint(data, pos)
+                    value = raw - (1 << 64) if raw >= 1 << 63 else raw
+                else:
+                    pos = codec.skip_field(data, pos, gwire)
+            if pos != gend:
+                raise ValueError("Gauge overran its length window")
+        elif field in (1, 2, 3):
+            raise ValueError(f"nested Metric field {field} has wire type "
+                             f"{wire_type}")
+        else:
+            pos = codec.skip_field(data, pos, wire_type)
+    if pos != end:
+        raise ValueError("nested Metric overran its length window")
+    return MetricSample(name, device_id, value, timestamp_ns, link)
+
+
+def _decode_tpumetric(data: bytes, start: int, end: int,
+                      out: list[MetricSample]) -> None:
+    """TPUMetric{name, description, repeated Metric} -> samples appended."""
+    name = ""
+    metric_windows: list[tuple[int, int]] = []
+    pos = start
+    decode_varint = codec.decode_varint
+    while pos < end:
+        key, pos = decode_varint(data, pos)
+        field, wire_type = key >> 3, key & 0x07
+        if field == 1 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            if pos + length > end:
+                raise ValueError("truncated TPUMetric.name")
+            name = data[pos:pos + length].decode("utf-8")
+            pos += length
+        elif field == 2 and wire_type == codec.LENGTH:  # description
+            length, pos = decode_varint(data, pos)
+            pos += length
+            if pos > end:
+                raise ValueError("truncated TPUMetric.description")
+        elif field == 3 and wire_type == codec.LENGTH:
+            length, pos = decode_varint(data, pos)
+            if pos + length > end:
+                raise ValueError("truncated nested Metric")
+            # Window recorded, decoded after the name is known (a runtime
+            # is free to serialize fields in any order).
+            metric_windows.append((pos, pos + length))
+            pos += length
+        elif field in (1, 2, 3):
+            raise ValueError(f"TPUMetric field {field} has wire type "
+                             f"{wire_type}")
+        else:
+            pos = codec.skip_field(data, pos, wire_type)
+    if pos != end:
+        raise ValueError("TPUMetric overran its length window")
+    for mstart, mend in metric_windows:
+        out.append(_decode_metric_nested(data, mstart, mend, name))
+
+
+FLAT, NESTED = "flat", "nested"
+# A response whose payloads carry only field-1 names (or nothing) has no
+# structural evidence for either dialect. It decodes to zero samples:
+# under flat it *could* mean "chip 0, value 0.0" from a zero-omitting
+# proto3 encoder, but fabricating a phantom chip from an empty nested
+# answer is worse than dropping one zero reading (review finding) — and
+# any response with a second chip or a nonzero value disambiguates.
+AMBIGUOUS = "ambiguous"
+
+
+def detect_dialect(data: bytes) -> str:
+    """Classify a MetricResponse body as FLAT, NESTED or AMBIGUOUS by
+    scanning the field numbers/wire types inside every top-level field-1
+    payload — the two schemas are disjoint there (see module docstring).
+    Raises ValueError when markers for both dialects appear (garbled
+    response). A response with no top-level payloads, or only name-only
+    payloads, is AMBIGUOUS: no structural evidence either way, and it
+    decodes to zero samples (see the AMBIGUOUS constant)."""
+    flat_markers = nested_markers = 0
+    pos = 0
+    end = len(data)
+    decode_varint = codec.decode_varint
+    while pos < end:
+        key, pos = decode_varint(data, pos)
+        field, wire_type = key >> 3, key & 0x07
+        if field == 1 and wire_type != codec.LENGTH:
+            # Field 1 is length-delimited in BOTH dialects; any other wire
+            # type is a schema violation, not an empty answer.
+            raise ValueError(f"MetricResponse.metrics has wire type "
+                             f"{wire_type}")
+        if field != 1:
+            pos = codec.skip_field(data, pos, wire_type)
+            continue
+        length, pos = decode_varint(data, pos)
+        mend = pos + length
+        if mend > end:
+            raise ValueError("truncated MetricResponse entry")
+        mpos = pos
+        pos = mend
+        while mpos < mend:
+            mkey, mpos = decode_varint(data, mpos)
+            mfield, mwire = mkey >> 3, mkey & 0x07
+            if mfield == 2:
+                if mwire == codec.VARINT:
+                    flat_markers += 1    # Metric.device_id
+                elif mwire == codec.LENGTH:
+                    nested_markers += 1  # TPUMetric.description
+            elif mfield == 3:
+                if mwire == codec.FIXED64:
+                    flat_markers += 1    # Metric.double_value
+                elif mwire == codec.LENGTH:
+                    nested_markers += 1  # TPUMetric.metrics
+            elif mfield in (4, 5) and mwire == codec.VARINT:
+                flat_markers += 1        # Metric.int_value / timestamp_ns
+            elif mfield == 6 and mwire == codec.LENGTH:
+                flat_markers += 1        # Metric.link
+            mpos = codec.skip_field(data, mpos, mwire)
+        if mpos != mend:
+            raise ValueError("MetricResponse entry overran its window")
+    if flat_markers and nested_markers:
+        raise ValueError(
+            f"MetricResponse mixes flat ({flat_markers}) and nested "
+            f"({nested_markers}) dialect markers"
+        )
+    if nested_markers:
+        return NESTED
+    return FLAT if flat_markers else AMBIGUOUS
+
+
 def decode_response(data: bytes) -> list[MetricSample]:
-    out = []
+    """Decode a MetricResponse in whichever dialect it arrived in."""
+    return decode_response_ex(data)[0]
+
+
+def decode_response_ex(data: bytes) -> tuple[list[MetricSample], str]:
+    """(samples, dialect) — dialect is FLAT, NESTED or AMBIGUOUS
+    (name-only/empty response → no samples). Per-port runtimes never mix
+    dialects; the collector and doctor report the value for diagnosis."""
+    dialect = detect_dialect(data)
+    out: list[MetricSample] = []
+    if dialect == AMBIGUOUS:
+        # The detection scan already walked (and bounds-checked) every
+        # byte; there is nothing decodable either way.
+        return out, dialect
     pos = 0
     end = len(data)
     decode_varint = codec.decode_varint
@@ -198,8 +535,11 @@ def decode_response(data: bytes) -> list[MetricSample]:
             if pos + length > end:
                 raise ValueError("truncated Metric")
             # Decode in place — no per-message bytes copy.
-            out.append(decode_metric(data, pos, pos + length))
+            if dialect == NESTED:
+                _decode_tpumetric(data, pos, pos + length, out)
+            else:
+                out.append(decode_metric(data, pos, pos + length))
             pos += length
         else:
             pos = codec.skip_field(data, pos, wire_type)
-    return out
+    return out, dialect

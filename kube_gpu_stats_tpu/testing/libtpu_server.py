@@ -29,12 +29,21 @@ class FakeLibtpuServer:
                                     # omitted from batched ("" selector)
                                     # responses, UNIMPLEMENTED when named
         server.reject_batch = True  # runtime predates the "" selector
+
+    ``dialect`` selects the wire shape served (proto/tpumetrics.py module
+    docstring): "flat" (round-1 shape, batched "" selector supported) or
+    "nested" (tpu-info-style TPUMetric wrapper; one family per RPC, so the
+    "" selector is rejected with INVALID_ARGUMENT like a real per-metric
+    service).
     """
 
     def __init__(self, num_chips: int = 4, port: int = 0,
-                 chip_offset: int = 0) -> None:
+                 chip_offset: int = 0, dialect: str = "flat") -> None:
+        if dialect not in (tpumetrics.FLAT, tpumetrics.NESTED):
+            raise ValueError(f"unknown dialect {dialect!r}")
         self.num_chips = num_chips
         self.chip_offset = chip_offset  # multi-process runtimes: chips per port
+        self.dialect = dialect
         self.delay = 0.0
         self.fail = False
         self.garble = False
@@ -110,7 +119,8 @@ class FakeLibtpuServer:
         name = tpumetrics.decode_request(request_bytes)
         with self._lock:
             self.requests.append(name)
-        if not name and self.reject_batch:
+        if not name and (self.reject_batch
+                         or self.dialect == tpumetrics.NESTED):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "metric_name is required")
         if name in self.drop_metrics:
@@ -137,7 +147,13 @@ class FakeLibtpuServer:
                     samples.append(
                         tpumetrics.MetricSample(metric, chip, self._value(metric, chip))
                     )
-        return self._sleep_remaining(start, tpumetrics.encode_response(samples))
+        if self.dialect == tpumetrics.NESTED:
+            # One family per RPC in this dialect (the "" selector was
+            # rejected above), so every sample shares the requested name.
+            response = tpumetrics.encode_response_nested(name, samples)
+        else:
+            response = tpumetrics.encode_response(samples)
+        return self._sleep_remaining(start, response)
 
     def _sleep_remaining(self, start: float, response: bytes) -> bytes:
         """Make total service time equal the scripted delay: the delay models
@@ -168,8 +184,11 @@ def main(argv=None) -> int:  # pragma: no cover - exercised via subprocess
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--chips", type=int, default=4)
     parser.add_argument("--delay", type=float, default=0.0)
+    parser.add_argument("--dialect", choices=("flat", "nested"),
+                        default="flat")
     args = parser.parse_args(argv)
-    server = FakeLibtpuServer(num_chips=args.chips, port=args.port)
+    server = FakeLibtpuServer(num_chips=args.chips, port=args.port,
+                              dialect=args.dialect)
     server.delay = args.delay
     server.start()
     print(server.port, flush=True)  # parent reads the bound port

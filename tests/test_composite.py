@@ -14,9 +14,9 @@ from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
 from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
 
 
-@pytest.fixture
-def server():
-    with FakeLibtpuServer(num_chips=2) as s:
+@pytest.fixture(params=["flat", "nested"])
+def server(request):
+    with FakeLibtpuServer(num_chips=2, dialect=request.param) as s:
         yield s
 
 

@@ -255,3 +255,22 @@ def test_crashing_probe_is_fail_row_not_traceback():
     results = doctor._bounded("broken", boom)
     assert results[0].status == "fail"
     assert "kaput" in results[0].detail
+
+
+def test_doctor_reports_wire_dialect_per_port(tmp_path):
+    """Round-1 verdict item 1: doctor must say which dialect each metric
+    port speaks — the first question when a node exports nothing."""
+    from kube_gpu_stats_tpu.doctor import check_libtpu_port
+
+    with FakeLibtpuServer(num_chips=2, dialect="flat") as flat_srv, \
+         FakeLibtpuServer(num_chips=2, dialect="nested") as nested_srv:
+        cfg = Config(backend="tpu",
+                     libtpu_ports=(flat_srv.port, nested_srv.port))
+        flat_res = check_libtpu_port(cfg, flat_srv.port)
+        nested_res = check_libtpu_port(cfg, nested_srv.port)
+    assert flat_res.status == "ok"
+    assert "flat dialect" in flat_res.detail
+    assert "batched fetch" in flat_res.detail
+    assert nested_res.status == "ok"
+    assert "nested dialect" in nested_res.detail
+    assert "per-metric fetch" in nested_res.detail

@@ -11,9 +11,12 @@ from kube_gpu_stats_tpu.proto import tpumetrics
 from kube_gpu_stats_tpu.testing.libtpu_server import HBM_TOTAL, LINKS, FakeLibtpuServer
 
 
-@pytest.fixture
-def server():
-    with FakeLibtpuServer(num_chips=4) as s:
+@pytest.fixture(params=["flat", "nested"])
+def server(request):
+    """Every client/collector test runs under BOTH wire dialects (round-1
+    verdict item 1): the flat round-1 shape and the nested tpu-info-style
+    shape, which also rejects the batched "" selector."""
+    with FakeLibtpuServer(num_chips=4, dialect=request.param) as s:
         yield s
 
 
@@ -250,15 +253,18 @@ def test_one_port_down_still_serves_other():
         col.close()
 
 
-def test_batched_fetch_is_single_rpc(server):
-    col = make_collector(server)
-    devs = col.discover()
-    server.requests.clear()
-    col.begin_tick()
-    col.wait_ready()  # begin_tick only dispatches; join before asserting
-    assert server.requests == [""]  # one RPC covers all metric families
-    assert col.sample(devs[0]).values
-    col.close()
+def test_batched_fetch_is_single_rpc():
+    # Flat-only: the batched "" selector is a flat-dialect capability
+    # (nested runtimes answer one family per RPC by construction).
+    with FakeLibtpuServer(num_chips=4, dialect="flat") as server:
+        col = make_collector(server)
+        devs = col.discover()
+        server.requests.clear()
+        col.begin_tick()
+        col.wait_ready()  # begin_tick only dispatches; join before asserting
+        assert server.requests == [""]  # one RPC covers all metric families
+        assert col.sample(devs[0]).values
+        col.close()
 
 
 def test_legacy_runtime_falls_back_to_per_metric(server):
@@ -278,19 +284,21 @@ def test_legacy_runtime_falls_back_to_per_metric(server):
     col.close()
 
 
-def test_transient_outage_does_not_latch_per_metric_mode(server):
+def test_transient_outage_does_not_latch_per_metric_mode():
     """Runtime not up at pod start (UNAVAILABLE) must NOT permanently
-    disable the batched fetch (review finding)."""
-    server.fail = True
-    col = make_collector(server)
-    col.begin_tick()  # outage while probing
-    col.wait_ready()
-    server.fail = False
-    server.requests.clear()
-    col.begin_tick()
-    col.wait_ready()
-    assert server.requests == [""]  # batched path retried and won
-    col.close()
+    disable the batched fetch (review finding). Flat-only: asserts on the
+    batched selector's retry behavior."""
+    with FakeLibtpuServer(num_chips=4, dialect="flat") as server:
+        server.fail = True
+        col = make_collector(server)
+        col.begin_tick()  # outage while probing
+        col.wait_ready()
+        server.fail = False
+        server.requests.clear()
+        col.begin_tick()
+        col.wait_ready()
+        assert server.requests == [""]  # batched path retried and won
+        col.close()
 
 
 def test_wire_type_mismatch_is_collector_error(server):
@@ -383,3 +391,57 @@ def test_bad_value_in_per_metric_mode_contained():
     assert s.values[schema.DUTY_CYCLE.name] == 42.0
     assert s.ici_counters == {}
     col.close()
+
+
+def test_mixed_dialect_multi_port_merge():
+    """Round-1 verdict item 1 done-criterion: a node whose runtime
+    processes speak DIFFERENT wire dialects on different ports (e.g. a
+    mid-upgrade multi-process runtime) must still merge every chip, and
+    the client must report each port's dialect for diagnosis."""
+    with FakeLibtpuServer(num_chips=2, chip_offset=0, dialect="flat") as s1, \
+         FakeLibtpuServer(num_chips=2, chip_offset=2, dialect="nested") as s2:
+        client = LibtpuClient(ports=(s1.port, s2.port), rpc_timeout=1.0)
+        col = LibtpuCollector(client, accel_type="tpu-test")
+        devs = col.discover()
+        assert [d.index for d in devs] == [0, 1, 2, 3]
+        col.begin_tick()
+        # Chips behind the flat port and the nested port in one tick.
+        assert col.sample(devs[0]).values[schema.DUTY_CYCLE.name] == 50.0
+        assert col.sample(devs[3]).values[schema.DUTY_CYCLE.name] == 53.0
+        assert set(col.sample(devs[1]).ici_counters) == set(LINKS)
+        assert set(col.sample(devs[2]).ici_counters) == set(LINKS)
+        assert client.port_dialects == {s1.port: "flat", s2.port: "nested"}
+        col.close()
+
+
+def test_client_latches_port_dialect(server):
+    client = LibtpuClient(ports=(server.port,), rpc_timeout=1.0)
+    client.get_metric(tpumetrics.DUTY_CYCLE)
+    assert client.port_dialects == {server.port: server.dialect}
+    client.close()
+
+
+def test_overflow_in_one_port_decode_contained():
+    """Review finding: a nested port whose device attribute is
+    double_attr=inf raises OverflowError from int(); that must count as
+    ONE failed port, not abort the multi-port merge."""
+    from kube_gpu_stats_tpu.proto import codec
+
+    inf_attr = (codec.field_string(1, "device_id")
+                + codec.field_bytes(2, codec.field_double(4, float("inf"))))
+    metric = (codec.field_bytes(1, inf_attr)
+              + codec.field_bytes(3, codec.field_varint(2, 1)))
+    poisoned = codec.field_bytes(1, (
+        codec.field_string(1, tpumetrics.DUTY_CYCLE)
+        + codec.field_bytes(3, metric)
+    ))
+    with pytest.raises(OverflowError):
+        tpumetrics.decode_response(poisoned)
+    good = tpumetrics.encode_response(
+        [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 50.0)]
+    )
+    client = LibtpuClient(ports=(1, 2), rpc_timeout=0.1)
+    client._fan_out = lambda req: [(good, None), (poisoned, None)]
+    samples = client.get_metric(tpumetrics.DUTY_CYCLE)
+    assert len(samples) == 1 and samples[0].value == 50.0
+    client.close()

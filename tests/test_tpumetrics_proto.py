@@ -1,4 +1,6 @@
-from kube_gpu_stats_tpu.proto import tpumetrics
+import pytest
+
+from kube_gpu_stats_tpu.proto import codec, tpumetrics
 
 
 def test_request_roundtrip():
@@ -84,3 +86,183 @@ def test_known_field_wrong_wire_type_raises():
 
     with _pytest.raises(ValueError):
         tpumetrics.decode_metric(bad)
+
+
+# -- nested dialect (round-1 verdict item 1) ---------------------------------
+
+# Golden bytes generated with protoc 3.21 + the google.protobuf runtime
+# from the nested schema documented in the tpumetrics module docstring
+# (AttrValue/Attribute/Gauge/Timestamp/Metric/TPUMetric/MetricResponse) —
+# real-protobuf serializations, not our own encoder's output, so a
+# symmetric misreading of the format cannot pass.
+NESTED_GOLDEN_HBM = bytes.fromhex(
+    "0a8b010a227470752e72756e74696d652e68626d2e6d656d6f72792e75736167"
+    "652e6279746573121948424d206d656d6f727920757361676520696e20627974"
+    "65731a240a0f0a096465766963655f69641202180012090880b79bb50610f403"
+    "1a061080808080041a240a0f0a096465766963655f69641202180112090880b7"
+    "9bb50610f4031a06108080808008"
+)
+NESTED_GOLDEN_ICI = bytes.fromhex(
+    "0aba010a227470752e72756e74696d652e6963692e6c696e6b2e747261666669"
+    "632e62797465731a230a0f0a096465766963655f6964120218000a0c0a046c69"
+    "6e6b12040a0278301a0210021a230a0f0a096465766963655f6964120218000a"
+    "0c0a046c696e6b12040a0279311a0210021a240a0f0a096465766963655f6964"
+    "120218010a0c0a046c696e6b12040a0278301a0310ea071a240a0f0a09646576"
+    "6963655f6964120218010a0c0a046c696e6b12040a0279311a0310ea07"
+)
+NESTED_GOLDEN_DUTY = bytes.fromhex(
+    "0a460a287470752e72756e74696d652e74656e736f72636f72652e6475747963"
+    "79636c652e70657263656e741a1a0a0d0a07636f72655f6964120218031a0909"
+    "0000000000e05540"
+)
+
+
+def test_nested_golden_hbm_decodes():
+    samples, dialect = tpumetrics.decode_response_ex(NESTED_GOLDEN_HBM)
+    assert dialect == tpumetrics.NESTED
+    assert samples == [
+        tpumetrics.MetricSample(tpumetrics.HBM_USED, 0, 1024**3,
+                                1722211200_000000500, ""),
+        tpumetrics.MetricSample(tpumetrics.HBM_USED, 1, 2 * 1024**3,
+                                1722211200_000000500, ""),
+    ]
+
+
+def test_nested_golden_ici_links_decode():
+    samples, dialect = tpumetrics.decode_response_ex(NESTED_GOLDEN_ICI)
+    assert dialect == tpumetrics.NESTED
+    assert len(samples) == 4
+    assert {(s.device_id, s.link) for s in samples} == {
+        (0, "x0"), (0, "y1"), (1, "x0"), (1, "y1")
+    }
+
+
+def test_nested_golden_core_id_double_gauge():
+    samples, dialect = tpumetrics.decode_response_ex(NESTED_GOLDEN_DUTY)
+    assert dialect == tpumetrics.NESTED
+    assert samples == [
+        tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 3, 87.5, 0, "")
+    ]
+
+
+def test_nested_encoder_roundtrip():
+    original = [
+        tpumetrics.MetricSample(tpumetrics.ICI_TRAFFIC, c, 1000 * c + li,
+                                link=link)
+        for c in range(3) for li, link in enumerate(("x0", "x1"))
+    ]
+    raw = tpumetrics.encode_response_nested(tpumetrics.ICI_TRAFFIC, original)
+    decoded, dialect = tpumetrics.decode_response_ex(raw)
+    assert dialect == tpumetrics.NESTED
+    assert decoded == original
+
+
+def test_nested_encoder_rejects_mixed_families():
+    with pytest.raises(ValueError):
+        tpumetrics.encode_response_nested(
+            tpumetrics.DUTY_CYCLE,
+            [tpumetrics.MetricSample(tpumetrics.HBM_USED, 0, 1)],
+        )
+
+
+def test_flat_detects_flat():
+    raw = tpumetrics.encode_response(
+        [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 50.0)]
+    )
+    assert tpumetrics.detect_dialect(raw) == tpumetrics.FLAT
+    assert tpumetrics.decode_response_ex(raw)[1] == tpumetrics.FLAT
+
+
+def test_mixed_dialect_markers_rejected():
+    flat_entry = codec.field_bytes(1, (
+        codec.field_string(1, tpumetrics.DUTY_CYCLE)
+        + codec.field_varint(2, 0) + codec.field_double(3, 1.0)
+    ))
+    nested_entry = codec.field_bytes(1, (
+        codec.field_string(1, tpumetrics.DUTY_CYCLE)
+        + codec.field_bytes(3, tpumetrics.encode_metric_nested(
+            tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 1.0)))
+    ))
+    with pytest.raises(ValueError):
+        tpumetrics.detect_dialect(flat_entry + nested_entry)
+
+
+def test_alternate_attribute_key_spellings():
+    for dkey in sorted(tpumetrics.DEVICE_ATTR_KEYS):
+        metric = (
+            codec.field_bytes(1, codec.field_string(1, dkey)
+                              + codec.field_bytes(2, codec.field_varint(3, 7)))
+            + codec.field_bytes(3, codec.field_varint(2, 42))
+        )
+        body = (codec.field_string(1, tpumetrics.HBM_USED)
+                + codec.field_bytes(3, metric))
+        samples, _ = tpumetrics.decode_response_ex(codec.field_bytes(1, body))
+        assert samples[0].device_id == 7, dkey
+    for lkey in sorted(tpumetrics.LINK_ATTR_KEYS):
+        metric = (
+            codec.field_bytes(1, codec.field_string(1, "device_id")
+                              + codec.field_bytes(2, codec.field_varint(3, 0)))
+            + codec.field_bytes(1, codec.field_string(1, lkey)
+                                + codec.field_bytes(2, codec.field_string(1, "z1")))
+            + codec.field_bytes(3, codec.field_varint(2, 9))
+        )
+        body = (codec.field_string(1, tpumetrics.ICI_TRAFFIC)
+                + codec.field_bytes(3, metric))
+        samples, _ = tpumetrics.decode_response_ex(codec.field_bytes(1, body))
+        assert samples[0].link == "z1", lkey
+
+
+def test_nested_varint_cannot_overrun_its_window():
+    """Fuzz-found regression: a varint whose continuation bytes cross a
+    sub-message window boundary must fail, not silently consume the next
+    field's bytes (the round-1 decoder relied only on the outer check)."""
+    # AttrValue window of length 2 containing `18 bd`: field 3 varint whose
+    # payload byte has the continuation bit set — it would terminate only
+    # past the window.
+    attr = (codec.field_string(1, "device_id")
+            + bytes([0x12, 0x02, 0x18, 0xBD]))
+    metric = (codec.field_bytes(1, attr)
+              + codec.field_bytes(3, codec.field_varint(2, 1)))
+    body = (codec.field_string(1, tpumetrics.HBM_USED)
+            + codec.field_bytes(3, metric))
+    with pytest.raises(ValueError):
+        tpumetrics.decode_response_ex(codec.field_bytes(1, body))
+
+
+def test_name_only_response_is_ambiguous_and_empty():
+    """Review finding: an empty nested answer (TPUMetric with a name and
+    no metrics) must NOT decode as a flat chip-0/value-0 sample — that
+    fabricated phantom devices (discover() would even materialize a
+    Device 0 from an empty HBM_TOTAL answer)."""
+    raw = tpumetrics.encode_response_nested(tpumetrics.HBM_TOTAL, [])
+    assert tpumetrics.detect_dialect(raw) == tpumetrics.AMBIGUOUS
+    samples, dialect = tpumetrics.decode_response_ex(raw)
+    assert samples == [] and dialect == tpumetrics.AMBIGUOUS
+    # Flat name-only (a zero-omitting proto3 encoder at chip 0 / value 0)
+    # is the deliberate cost of that choice: also no samples.
+    flat_name_only = codec.field_bytes(
+        1, codec.field_string(1, tpumetrics.DUTY_CYCLE))
+    assert tpumetrics.decode_response(flat_name_only) == []
+    # Any second chip or nonzero value disambiguates back to flat.
+    two_chips = flat_name_only + tpumetrics.encode_response(
+        [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 1, 2.0)])
+    samples, dialect = tpumetrics.decode_response_ex(two_chips)
+    assert dialect == tpumetrics.FLAT and len(samples) == 2
+
+
+def test_direction_attribute_does_not_overwrite_link():
+    """Review finding: 'direction' is a sibling dimension, not a link-id
+    spelling — it must not collapse distinct links."""
+    metric = (
+        codec.field_bytes(1, codec.field_string(1, "device_id")
+                          + codec.field_bytes(2, codec.field_varint(3, 0)))
+        + codec.field_bytes(1, codec.field_string(1, "link_id")
+                            + codec.field_bytes(2, codec.field_string(1, "x0")))
+        + codec.field_bytes(1, codec.field_string(1, "direction")
+                            + codec.field_bytes(2, codec.field_string(1, "tx")))
+        + codec.field_bytes(3, codec.field_varint(2, 9))
+    )
+    body = (codec.field_string(1, tpumetrics.ICI_TRAFFIC)
+            + codec.field_bytes(3, metric))
+    samples, _ = tpumetrics.decode_response_ex(codec.field_bytes(1, body))
+    assert samples[0].link == "x0"

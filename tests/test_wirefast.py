@@ -121,3 +121,95 @@ def test_collector_fused_ingest_is_all_or_nothing():
     assert cache == {}
     fused(good, cache)
     assert cache[0]["values"]
+
+
+def _nested_payload(name, samples):
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    return tpumetrics.encode_response_nested(name, samples)
+
+
+def test_wirefast_nested_dialect_matches_python(loaded_wirefast):
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    ici = [tpumetrics.MetricSample(tpumetrics.ICI_TRAFFIC, c, 1000 * c + li,
+                                   link=link)
+           for c in range(4) for li, link in enumerate(("x0", "x1", "y0"))]
+    for raw in (
+        _nested_payload(tpumetrics.ICI_TRAFFIC, ici),
+        _nested_payload(tpumetrics.HBM_USED, [
+            tpumetrics.MetricSample(tpumetrics.HBM_USED, c, (c + 1) * 1024**3)
+            for c in range(4)
+        ]),
+        _nested_payload(tpumetrics.DUTY_CYCLE, [
+            tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, c, 50.0 + c,
+                                    timestamp_ns=123456789)
+            for c in range(4)
+        ]),
+        _nested_payload(tpumetrics.COLLECTIVES, [
+            tpumetrics.MetricSample(tpumetrics.COLLECTIVES, 0, 512)
+        ]),
+    ):
+        fused, py = _both(loaded_wirefast, raw)
+        assert fused[0] == "ok" and fused == py
+
+
+def test_wirefast_nested_server_payload_equivalence(loaded_wirefast):
+    """A full per-metric sweep from the nested fake server must ingest
+    identically on both paths."""
+    from kube_gpu_stats_tpu.proto import tpumetrics
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+
+    srv = FakeLibtpuServer(num_chips=4, dialect="nested")
+
+    class _Ctx:
+        def abort(self, code, detail):
+            raise AssertionError((code, detail))
+
+    for name in tpumetrics.ALL_METRICS:
+        raw = srv._handle(tpumetrics.encode_request(name), _Ctx())
+        fused, py = _both(loaded_wirefast, raw)
+        assert fused[0] == "ok" and fused == py, name
+
+
+def test_wirefast_nested_attr_key_spellings(loaded_wirefast):
+    """Every accepted device/link attribute spelling must behave the same
+    in C and Python (the C table is a hand-synced copy)."""
+    from kube_gpu_stats_tpu.proto import codec, tpumetrics
+
+    for dkey in sorted(tpumetrics.DEVICE_ATTR_KEYS):
+        for lkey in sorted(tpumetrics.LINK_ATTR_KEYS):
+            metric = (
+                codec.field_bytes(1, codec.field_string(1, dkey)
+                                  + codec.field_bytes(2, codec.field_string(1, "5")))
+                + codec.field_bytes(1, codec.field_string(1, lkey)
+                                    + codec.field_bytes(2, codec.field_varint(3, 2)))
+                + codec.field_bytes(3, codec.field_varint(2, 77))
+            )
+            body = (codec.field_string(1, tpumetrics.ICI_TRAFFIC)
+                    + codec.field_bytes(3, metric))
+            raw = codec.field_bytes(1, body)
+            fused, py = _both(loaded_wirefast, raw)
+            assert fused == py, (dkey, lkey)
+            assert fused[0] == "ok"
+            assert fused[1][5]["ici"] == {"2": 77}
+
+
+def test_wirefast_nested_fuzz_equivalence(loaded_wirefast):
+    import random
+
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    rng = random.Random(20260730)
+    ici = [tpumetrics.MetricSample(tpumetrics.ICI_TRAFFIC, c, 1000 * c + li,
+                                   link=link)
+           for c in range(4) for li, link in enumerate(("x0", "x1", "y0"))]
+    base = _nested_payload(tpumetrics.ICI_TRAFFIC, ici)
+    for trial in range(400):
+        blob = bytearray(base)
+        for _ in range(rng.randrange(1, 6)):
+            blob[rng.randrange(len(blob))] = rng.randrange(256)
+        fused, py = _both(loaded_wirefast, bytes(blob))
+        if fused[0] == "err" and py[0] == "err":
+            continue
+        assert fused == py, (trial, bytes(blob))
