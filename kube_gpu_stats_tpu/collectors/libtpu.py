@@ -77,16 +77,25 @@ def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict]) -> N
         entry["values"][_VALUE_MAP[name]] = float(sample.value)
 
 
-def ingest_response_py(raw: bytes, cache: dict[int, dict]) -> None:
+def ingest_response_py(raw: bytes, cache: dict[int, dict],
+                       assume: str | None = None) -> str:
     """Decode a MetricResponse and ingest every metric (Python fallback for
     the native _wirefast.ingest). All-or-nothing: staged into a scratch
     dict so an ingest-time error (e.g. int(NaN) on a counter metric) can't
     publish the response's leading metrics — same containment as the fused
-    native wrapper."""
+    native wrapper. ``assume`` is the port's latched dialect (resolves
+    structurally ambiguous name-only responses — see
+    tpumetrics.decode_response_ex). Returns the dialect the response
+    decoded under — AMBIGUOUS means it was discarded unresolved; the
+    caller feeds this to LibtpuClient.note_dialect for (re)latching and
+    drop logging, which keeps the structural scan a once-per-response
+    cost instead of a second pre-pass."""
     staged: dict[int, dict] = {}
-    for s in tpumetrics.decode_response(raw):
+    samples, dialect = tpumetrics.decode_response_ex(raw, assume)
+    for s in samples:
         _ingest_sample(s, staged)
     _merge_cache(staged, cache)
+    return dialect
 
 
 def _merge_cache(src: dict[int, dict], dst: dict[int, dict]) -> None:
@@ -104,13 +113,21 @@ def _merge_cache(src: dict[int, dict], dst: dict[int, dict]) -> None:
 
 
 def _make_fused_ingest(wirefast):
-    def ingest_response_native(raw: bytes, cache: dict[int, dict]) -> None:
+    def ingest_response_native(raw: bytes, cache: dict[int, dict],
+                               assume: str | None = None) -> str:
         # Stage into a scratch dict so a ValueError mid-response can't
         # publish a corrupt response's leading metrics (all-or-nothing,
         # matching the Python path's decode-then-ingest order).
         staged: dict[int, dict] = {}
-        wirefast.ingest(raw, staged)
+        _n, dcode = wirefast.ingest(raw, staged)
+        if dcode == 2:
+            # Ambiguous: the C scan folded nothing. Delegate the whole
+            # resolution contract (assume, staging, dialect return) to the
+            # Python path — a cold branch that only runs on name-only
+            # responses, which carry at most a handful of samples.
+            return ingest_response_py(raw, cache, assume)
         _merge_cache(staged, cache)
+        return tpumetrics.FLAT if dcode == 0 else tpumetrics.NESTED
 
     return ingest_response_native
 
@@ -139,6 +156,9 @@ class LibtpuClient:
         # scanned response from that port (a runtime never switches
         # dialects mid-life; doctor and logs report this for diagnosis).
         self.port_dialects: dict[int, str] = {}
+        # Ports already warned about discarding an ambiguous response —
+        # the drop is per-tick, the log line is once per port.
+        self._ambiguous_warned: set[int] = set()
         self._methods = []
         self._channels = []
         self._port_pool = (
@@ -191,9 +211,9 @@ class LibtpuClient:
     def _fan_out(self, request: bytes) -> list[tuple[bytes | None, Exception | None]]:
         """Issue the request to every port in parallel (one wedged process
         must cost one rpc_timeout, not N); per-port (response, error).
-        Results are in ``self.ports`` order. Each port's wire dialect is
-        latched into ``port_dialects`` on its first non-empty response —
-        a one-time structural scan, not a per-tick cost."""
+        Results are in ``self.ports`` order. Dialect latching happens in
+        the decode/ingest paths via :meth:`note_dialect` — they run the
+        structural scan anyway, so no second pre-pass here."""
 
         def call(method):
             try:
@@ -202,54 +222,94 @@ class LibtpuClient:
                 return None, exc
 
         if self._port_pool is not None:
-            results = list(self._port_pool.map(call, self._methods))
-        else:
-            results = [call(m) for m in self._methods]
-        for port, (raw, _) in zip(self.ports, results):
-            if raw and port not in self.port_dialects:
-                try:
-                    dialect = tpumetrics.detect_dialect(raw)
-                except ValueError:
-                    continue  # garbled port; decode paths will classify it
-                if dialect != tpumetrics.AMBIGUOUS:
-                    self.port_dialects[port] = dialect
-        return results
+            return list(self._port_pool.map(call, self._methods))
+        return [call(m) for m in self._methods]
+
+    def note_dialect(self, port: int, dialect: str, raw: bytes) -> None:
+        """Record the dialect a port's response decoded under (callers:
+        get_metric, the collector's batched ingest, doctor). Latches
+        FLAT/NESTED into ``port_dialects`` — and RE-latches when later
+        evidence contradicts the stored value, because a restarted
+        workload may bring a different runtime build to the same port; a
+        stale latch would make ambiguous resolution fabricate flat chip-0
+        zeros from empty nested answers, or keep silently dropping a new
+        flat runtime's idle readings. AMBIGUOUS on a non-empty response
+        means an unresolved name-only answer was discarded — logged once
+        per port (see warn_ambiguous)."""
+        if dialect == tpumetrics.AMBIGUOUS:
+            if raw:
+                self.warn_ambiguous(port)
+            return
+        previous = self.port_dialects.get(port)
+        if previous != dialect:
+            if previous is not None:
+                log.warning(
+                    "libtpu port %d: wire dialect changed %s -> %s "
+                    "(runtime restarted with a different build?); "
+                    "re-latching", port, previous, dialect)
+                self._ambiguous_warned.discard(port)
+            self.port_dialects[port] = dialect
+
+    def warn_ambiguous(self, port: int) -> None:
+        """Log (once per port) that a non-empty response was discarded as
+        structurally ambiguous. Until any response from the port carries a
+        dialect marker, a zero-omitting flat runtime's idle readings are
+        being dropped — the one silent data-loss mode of the dual-dialect
+        design, so it must be visible (round-2 advisor finding)."""
+        if port not in self._ambiguous_warned:
+            self._ambiguous_warned.add(port)
+            log.warning(
+                "libtpu port %d: discarded a name-only response (no "
+                "structural dialect evidence yet); if this runtime speaks "
+                "the flat dialect with zero-omission, idle zero readings "
+                "are dropped until any nonzero value latches the dialect",
+                port,
+            )
 
     def get_metric(self, metric_name: str) -> list[tpumetrics.MetricSample]:
         """Fetch one metric family from every port in parallel, merged.
         Raises CollectorError (with .status_code when the failure was a
         gRPC status) only if every port failed; an undecodable port
-        (runtime speaking a different schema) counts as failed."""
+        (runtime speaking a different schema) counts as failed. A port's
+        latched dialect resolves its ambiguous (name-only) responses."""
         samples: list[tpumetrics.MetricSample] = []
         errors: list[Exception] = []
-        for raw, error in self._fan_out(tpumetrics.encode_request(metric_name)):
+        results = self._fan_out(tpumetrics.encode_request(metric_name))
+        for port, (raw, error) in zip(self.ports, results):
             if error is not None:
                 errors.append(error)
                 continue
             try:
-                samples.extend(tpumetrics.decode_response(raw))
+                decoded, dialect = tpumetrics.decode_response_ex(
+                    raw, self.port_dialects.get(port)
+                )
             except (ValueError, OverflowError) as exc:
                 # OverflowError: the nested dialect converts attribute
                 # values with int() (e.g. device double_attr=inf). Either
                 # way this PORT is undecodable — the others still count.
                 errors.append(exc)
+                continue
+            self.note_dialect(port, dialect, raw)
+            samples.extend(decoded)
         if errors and not samples:
             self._raise_all_failed(metric_name, errors)
         return samples
 
     def get_raw_with_errors(
         self, metric_name: str
-    ) -> tuple[list[bytes], list[Exception]]:
-        """Fetch one metric family from every port: (undecoded response
-        bytes per surviving port, per-port transport errors). Never raises —
-        the caller classifies each port's error (capability vs outage)."""
-        raws: list[bytes] = []
+    ) -> tuple[list[tuple[int, bytes]], list[Exception]]:
+        """Fetch one metric family from every port: ((port, undecoded
+        response bytes) per surviving port, per-port transport errors).
+        Never raises — the caller classifies each port's error (capability
+        vs outage) and resolves dialect ambiguity with the port id."""
+        raws: list[tuple[int, bytes]] = []
         errors: list[Exception] = []
-        for raw, error in self._fan_out(tpumetrics.encode_request(metric_name)):
+        results = self._fan_out(tpumetrics.encode_request(metric_name))
+        for port, (raw, error) in zip(self.ports, results):
             if error is not None:
                 errors.append(error)
             else:
-                raws.append(raw)
+                raws.append((port, raw))
         return raws, errors
 
     def close(self) -> None:
@@ -365,9 +425,12 @@ class LibtpuCollector(Collector):
         if self._batched is not False:
             raws, port_errors = self._client.get_raw_with_errors("")
             decode_error: Exception | None = None
-            for raw in raws:
+            for port, raw in raws:
                 try:
-                    self._ingest_response(raw, cache)
+                    dialect = self._ingest_response(
+                        raw, cache, self._client.port_dialects.get(port)
+                    )
+                    self._client.note_dialect(port, dialect, raw)
                 except (ValueError, OverflowError) as exc:
                     # ValueError: different schema / garbled port;
                     # OverflowError: int(inf) on a counter metric.

@@ -20,6 +20,7 @@ from .exposition import MetricsServer, PushgatewayPusher, TextfileWriter
 from .poll import AttributionProvider, NullAttribution, PollLoop
 from .procopen import DeviceProcessWatcher
 from .registry import Registry
+from .workers import PeriodicRefresher
 
 log = logging.getLogger(__name__)
 
@@ -48,9 +49,18 @@ def build_collector(cfg: Config) -> Collector:
         return _gpu_collector(cfg)
     # auto: TPU when present, else sysfs-exposed GPUs (C12 single-binary
     # mixed clusters), else a schema-valid null exporter (BASELINE.json
-    # configs[0] behavior on CPU-only nodes). The probe instance IS the
-    # production collector when devices are found — probing and serving
-    # must never disagree about what "TPU present" means.
+    # configs[0] behavior on CPU-only nodes; the daemon keeps re-probing
+    # while on null — see BackendUpgradeWatcher).
+    return probe_accelerator(cfg) or NullCollector()
+
+
+def probe_accelerator(cfg: Config, loglevel: int = logging.WARNING
+                      ) -> Collector | None:
+    """One pass of the auto-backend probe order: TPU, then GPU, else None.
+    The probe instance IS the production collector when devices are found —
+    probing and serving must never disagree about what "present" means.
+    ``loglevel`` lets the periodic re-probe demote the expected "nothing
+    here yet" outcomes to debug instead of logging a warning per cycle."""
     try:
         tpu = _tpu_collector(cfg)
         try:
@@ -61,7 +71,7 @@ def build_collector(cfg: Config) -> Collector:
             raise
         tpu.close()
     except Exception as exc:
-        log.warning("TPU probe failed (%s); trying gpu backend", exc)
+        log.log(loglevel, "TPU probe failed (%s); trying gpu backend", exc)
     try:
         gpu = _gpu_collector(cfg)
         # Require real telemetry, not mere card nodes: BMC/integrated
@@ -69,8 +79,9 @@ def build_collector(cfg: Config) -> Collector:
         if gpu.telemetry_capable():
             return gpu
     except Exception as exc:
-        log.warning("GPU probe failed (%s); falling back to null backend", exc)
-    return NullCollector()
+        log.log(loglevel, "GPU probe failed (%s); falling back to null "
+                "backend", exc)
+    return None
 
 
 def _gpu_collector(cfg: Config) -> Collector:
@@ -108,6 +119,40 @@ def build_attribution(cfg: Config) -> AttributionProvider:
         log.warning("attribution unavailable (%s); exporting without pod labels",
                     exc)
         return NullAttribution()
+
+
+class BackendUpgradeWatcher(PeriodicRefresher):
+    """Re-probe for an accelerator while --backend auto latched the null
+    backend (round-2 advisor finding: the libtpu metric service only
+    serves while a TPU workload is running, so a daemon started before
+    the workload on a sysfs-less TPU VM would otherwise export nulls for
+    its whole lifetime). Runs on the rediscovery cadence with capped
+    backoff; on the first successful probe it hands the new collector to
+    the poll loop and retires itself."""
+
+    def __init__(self, daemon: "Daemon", interval: float) -> None:
+        super().__init__(interval, "backend-upgrade")
+        self._daemon = daemon
+
+    def refresh_once(self) -> None:
+        try:
+            new = probe_accelerator(self._daemon.cfg, loglevel=logging.DEBUG)
+        except Exception:  # noqa: BLE001 - probe bug must not kill the thread
+            log.debug("backend re-probe crashed", exc_info=True)
+            new = None
+        if new is None:
+            # Modest backoff cap: a workload can start any time, so keep
+            # probing at most ~3x the base cadence (PeriodicRefresher
+            # scales the wait by 1 + consecutive_failures).
+            self.consecutive_failures = min(self.consecutive_failures + 1, 2)
+            return
+        log.info("auto backend: %s accelerator now present; upgrading "
+                 "from null backend", new.name)
+        self._daemon.collector = new
+        self._daemon.poll.replace_collector(new)
+        # Applied between ticks; retire this watcher (set, don't join —
+        # we ARE the watcher thread).
+        self._stop_event.set()
 
 
 class Daemon:
@@ -165,6 +210,13 @@ class Daemon:
             if cfg.pushgateway_url
             else None
         )
+        self.upgrade_watcher = (
+            BackendUpgradeWatcher(self, cfg.rediscovery_interval)
+            if cfg.backend == "auto"
+            and isinstance(self.collector, NullCollector)
+            and cfg.rediscovery_interval > 0
+            else None
+        )
         self.remote_writer = None
         if cfg.remote_write_url:
             from .remote_write import RemoteWriter
@@ -205,6 +257,8 @@ class Daemon:
             self.pusher.start()
         if self.remote_writer:
             self.remote_writer.start()
+        if self.upgrade_watcher:
+            self.upgrade_watcher.start()
         self.poll.start()
         log.info(
             "kube-tpu-stats %s: backend=%s devices=%d listening on %s:%d",
@@ -213,6 +267,8 @@ class Daemon:
         )
 
     def stop(self) -> None:
+        if self.upgrade_watcher:
+            self.upgrade_watcher.stop()
         self.poll.stop()
         if self.procwatch:
             self.procwatch.stop()

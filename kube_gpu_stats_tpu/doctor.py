@@ -116,11 +116,17 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
         raws, errors = client.get_raw_with_errors("")
         cache: dict[int, dict] = {}
         decode_failures = 0
-        for raw in raws:
+        ambiguous_discards = 0
+        for rport, raw in raws:
             try:
-                ingest_response_py(raw, cache)
+                dialect = ingest_response_py(raw, cache,
+                                             client.port_dialects.get(rport))
             except (ValueError, OverflowError):
                 decode_failures += 1
+                continue
+            client.note_dialect(rport, dialect, raw)
+            if dialect == tpumetrics.AMBIGUOUS and raw:
+                ambiguous_discards += 1
         if cache:
             families: set[str] = set()
             for entry in cache.values():
@@ -141,6 +147,18 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                 name, FAIL,
                 "responds but payload is undecodable (runtime speaking a "
                 "different metric-service schema?)",
+            )
+        if ambiguous_discards:
+            # The port IS answering — with name-only payloads that carry no
+            # structural dialect evidence (e.g. an idle zero-omitting flat
+            # runtime). Misreporting this as "unreachable" would send the
+            # operator chasing the wrong problem.
+            return _result(
+                name, WARN,
+                "answers with name-only responses (no dialect evidence "
+                "yet); an idle zero-omitting flat runtime looks like this "
+                "— readings resume once any nonzero value latches the "
+                "dialect",
             )
         # Classify the batched failure from the in-hand errors (the
         # get_raw_with_errors contract): only a capability rejection

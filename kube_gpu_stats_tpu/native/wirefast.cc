@@ -156,12 +156,15 @@ bool skip_unknown(const uint8_t* data, Py_ssize_t end, Py_ssize_t* pos,
 }
 
 // Mirror of tpumetrics.detect_dialect: scan every top-level field-1
-// payload's (field, wire-type) pairs. Returns 0 = flat, 1 = nested,
-// 2 = ambiguous (no markers at all: name-only/empty — caller ingests
-// nothing), -1 = error with exception set (mixed markers or malformed
-// scan).
+// payload's (field, wire-type) pairs. Fields 2/3 are hard discriminators
+// (wire types disjoint between the schemas); fields 4-6 are only weak
+// flat evidence, ignored when hard nested markers exist anywhere (a newer
+// nested runtime may extend TPUMetric with such fields — proto3 forward
+// compat). Returns 0 = flat, 1 = nested, 2 = ambiguous (no markers at
+// all: name-only/empty — caller ingests nothing), -1 = error with
+// exception set (hard-vs-hard marker conflict or malformed scan).
 int scan_dialect(const uint8_t* data, Py_ssize_t end) {
-  long flat_markers = 0, nested_markers = 0;
+  long flat_hard = 0, flat_weak = 0, nested_markers = 0;
   Py_ssize_t pos = 0;
   while (pos < end) {
     uint64_t key;
@@ -200,28 +203,28 @@ int scan_dialect(const uint8_t* data, Py_ssize_t end) {
       int mwire = mkey & 0x07;
       if (mfield == 2) {
         if (mwire == 0)
-          ++flat_markers;  // Metric.device_id
+          ++flat_hard;  // Metric.device_id
         else if (mwire == 2)
           ++nested_markers;  // TPUMetric.description
       } else if (mfield == 3) {
         if (mwire == 1)
-          ++flat_markers;  // Metric.double_value
+          ++flat_hard;  // Metric.double_value
         else if (mwire == 2)
           ++nested_markers;  // TPUMetric.metrics
       } else if ((mfield == 4 || mfield == 5) && mwire == 0) {
-        ++flat_markers;  // Metric.int_value / timestamp_ns
+        ++flat_weak;  // Metric.int_value / timestamp_ns
       } else if (mfield == 6 && mwire == 2) {
-        ++flat_markers;  // Metric.link
+        ++flat_weak;  // Metric.link
       }
       if (!skip_unknown(data, mend, &mpos, mwire)) return -1;
     }
   }
-  if (flat_markers && nested_markers) {
+  if (flat_hard && nested_markers) {
     err("MetricResponse mixes flat and nested dialect markers");
     return -1;
   }
-  if (nested_markers) return 1;
-  return flat_markers ? 0 : 2;
+  if (nested_markers) return 1;  // weak flat = unknown TPUMetric extensions
+  return (flat_hard || flat_weak) ? 0 : 2;
 }
 
 // Attribute-key spellings accepted for the chip id / ICI link — keep in
@@ -819,7 +822,7 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
   }
   if (dialect == 2) {  // ambiguous: scan validated every byte, nothing to fold
     PyBuffer_Release(&buf);
-    return PyLong_FromLong(0);
+    return Py_BuildValue("(li)", 0L, 2);
   }
   Py_ssize_t pos = 0;
   long n = 0;
@@ -888,7 +891,10 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
     }
   }
   PyBuffer_Release(&buf);
-  return PyLong_FromLong(n);
+  // (entries folded, dialect 0=flat/1=nested/2=ambiguous): the caller
+  // latches the port's dialect from this — the scan already ran here, so
+  // reporting it avoids a second Python-side structural scan per tick.
+  return Py_BuildValue("(li)", n, dialect);
 }
 
 PyObject* py_configure(PyObject*, PyObject* args) {
@@ -930,8 +936,9 @@ PyMethodDef methods[] = {
      "configure(value_map: dict[bytes, str], ici_name: bytes, "
      "collectives_name: bytes) — pin the metric-name surface."},
     {"ingest", py_ingest, METH_VARARGS,
-     "ingest(data: bytes, cache: dict) -> int — decode a MetricResponse and "
-     "fold every metric into cache; returns the metric count."},
+     "ingest(data: bytes, cache: dict) -> (int, int) — decode a "
+     "MetricResponse and fold every metric into cache; returns (entry "
+     "count, dialect 0=flat/1=nested/2=ambiguous)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_wirefast",

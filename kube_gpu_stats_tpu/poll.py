@@ -103,6 +103,9 @@ class PollLoop:
         self._errors: dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Backend swap requested by replace_collector; applied between
+        # ticks on whichever thread runs tick().
+        self._pending_collector = None
         # Retained last-known MEMORY_TOTAL per device so a stale tick keeps
         # capacity gauges stable instead of dropping series.
         self._last_totals: dict[str, float] = {}
@@ -121,6 +124,31 @@ class PollLoop:
     @property
     def poll_histogram(self) -> HistogramState:
         return self._hist
+
+    def replace_collector(self, collector) -> None:
+        """Hand the loop a new backend; applied at the top of the next
+        tick, never mid-tick (auto-mode backend upgrade: the daemon's
+        re-probe watcher swaps the null backend for a real one when an
+        accelerator appears after startup — the libtpu metric service
+        only serves while a workload runs, so starting before the
+        workload must not latch null for the process lifetime). Intended
+        for upgrading FROM the null backend, which never has samples
+        outstanding; the old collector is closed on the loop thread."""
+        self._pending_collector = collector
+
+    def _apply_pending_collector(self) -> None:
+        pending = self._pending_collector
+        if pending is None:
+            return
+        self._pending_collector = None
+        old = self._collector
+        self._collector = pending
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001 - old backend teardown is best-effort
+            log.warning("old backend close failed during upgrade", exc_info=True)
+        log.info("backend upgraded: %s -> %s", old.name, pending.name)
+        self.rediscover()
 
     def rediscover(self) -> None:
         """Re-enumerate devices (startup, periodic, explicit recovery; never
@@ -150,6 +178,7 @@ class PollLoop:
     def tick(self) -> float:
         """Run one poll over all devices; publish a snapshot; return tick
         duration in seconds."""
+        self._apply_pending_collector()
         start = self._clock()
         results = self._sample_all()
         duration = self._clock() - start

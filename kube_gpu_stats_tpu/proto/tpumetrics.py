@@ -125,12 +125,18 @@ def decode_request(data: bytes) -> str:
     return ""
 
 
-def encode_metric(sample: MetricSample) -> bytes:
+def encode_metric(sample: MetricSample, zero_omit: bool = False) -> bytes:
+    """``zero_omit`` mimics a standard proto3 encoder, which omits every
+    default-valued field: an idle chip 0 then serializes as a name-only
+    Metric (the AMBIGUOUS shape — the fake server uses this to exercise
+    the latched-dialect resolution path)."""
     out = codec.field_string(1, sample.name)
-    out += codec.field_varint(2, sample.device_id)
+    if sample.device_id or not zero_omit:
+        out += codec.field_varint(2, sample.device_id)
     if sample.name in INT_METRICS:
-        out += codec.field_varint(4, int(sample.value))
-    else:
+        if int(sample.value) or not zero_omit:
+            out += codec.field_varint(4, int(sample.value))
+    elif float(sample.value) or not zero_omit:
         out += codec.field_double(3, float(sample.value))
     if sample.timestamp_ns:
         out += codec.field_varint(5, sample.timestamp_ns)
@@ -217,9 +223,12 @@ def decode_metric(data: bytes, _start: int = 0, _end: int | None = None
     return MetricSample(name, device_id, value_out, timestamp_ns, link)
 
 
-def encode_response(samples: list[MetricSample]) -> bytes:
+def encode_response(samples: list[MetricSample],
+                    zero_omit: bool = False) -> bytes:
     """Flat-dialect MetricResponse."""
-    return b"".join(codec.field_bytes(1, encode_metric(s)) for s in samples)
+    return b"".join(
+        codec.field_bytes(1, encode_metric(s, zero_omit)) for s in samples
+    )
 
 
 # -- nested dialect -----------------------------------------------------------
@@ -450,11 +459,18 @@ def detect_dialect(data: bytes) -> str:
     """Classify a MetricResponse body as FLAT, NESTED or AMBIGUOUS by
     scanning the field numbers/wire types inside every top-level field-1
     payload — the two schemas are disjoint there (see module docstring).
-    Raises ValueError when markers for both dialects appear (garbled
-    response). A response with no top-level payloads, or only name-only
-    payloads, is AMBIGUOUS: no structural evidence either way, and it
-    decodes to zero samples (see the AMBIGUOUS constant)."""
-    flat_markers = nested_markers = 0
+
+    Fields 2/3 are HARD discriminators (their wire types cannot collide:
+    varint/fixed64 = flat Metric, length-delimited = nested TPUMetric).
+    Fields 4-6 are only WEAK flat evidence: a newer nested runtime may
+    legally extend TPUMetric with such fields (proto3 forward compat —
+    round-2 advisor finding), so they count toward flat only when no hard
+    nested marker exists anywhere in the response. Raises ValueError only
+    on a hard-vs-hard conflict (garbled response). A response with no
+    markers at all (name-only/empty payloads) is AMBIGUOUS: no structural
+    evidence either way, and it decodes to zero samples (see the
+    AMBIGUOUS constant)."""
+    flat_hard = flat_weak = nested_markers = 0
     pos = 0
     end = len(data)
     decode_varint = codec.decode_varint
@@ -480,29 +496,32 @@ def detect_dialect(data: bytes) -> str:
             mfield, mwire = mkey >> 3, mkey & 0x07
             if mfield == 2:
                 if mwire == codec.VARINT:
-                    flat_markers += 1    # Metric.device_id
+                    flat_hard += 1       # Metric.device_id
                 elif mwire == codec.LENGTH:
                     nested_markers += 1  # TPUMetric.description
             elif mfield == 3:
                 if mwire == codec.FIXED64:
-                    flat_markers += 1    # Metric.double_value
+                    flat_hard += 1       # Metric.double_value
                 elif mwire == codec.LENGTH:
                     nested_markers += 1  # TPUMetric.metrics
             elif mfield in (4, 5) and mwire == codec.VARINT:
-                flat_markers += 1        # Metric.int_value / timestamp_ns
+                flat_weak += 1           # Metric.int_value / timestamp_ns
             elif mfield == 6 and mwire == codec.LENGTH:
-                flat_markers += 1        # Metric.link
+                flat_weak += 1           # Metric.link
             mpos = codec.skip_field(data, mpos, mwire)
         if mpos != mend:
             raise ValueError("MetricResponse entry overran its window")
-    if flat_markers and nested_markers:
+    if flat_hard and nested_markers:
         raise ValueError(
-            f"MetricResponse mixes flat ({flat_markers}) and nested "
+            f"MetricResponse mixes flat ({flat_hard}) and nested "
             f"({nested_markers}) dialect markers"
         )
     if nested_markers:
+        # Weak flat markers (fields 4-6) alongside hard nested evidence
+        # are unknown TPUMetric extension fields, skipped per the
+        # forward-compat contract.
         return NESTED
-    return FLAT if flat_markers else AMBIGUOUS
+    return FLAT if (flat_hard or flat_weak) else AMBIGUOUS
 
 
 def decode_response(data: bytes) -> list[MetricSample]:
@@ -510,16 +529,27 @@ def decode_response(data: bytes) -> list[MetricSample]:
     return decode_response_ex(data)[0]
 
 
-def decode_response_ex(data: bytes) -> tuple[list[MetricSample], str]:
+def decode_response_ex(data: bytes, assume: str | None = None
+                       ) -> tuple[list[MetricSample], str]:
     """(samples, dialect) — dialect is FLAT, NESTED or AMBIGUOUS
     (name-only/empty response → no samples). Per-port runtimes never mix
-    dialects; the collector and doctor report the value for diagnosis."""
+    dialects; the collector and doctor report the value for diagnosis.
+
+    ``assume`` resolves AMBIGUOUS only (round-2 advisor finding: a
+    zero-omitting flat runtime sending a name-only Metric — idle chip 0,
+    value 0.0, no timestamp — was silently dropped every tick). A caller
+    that has latched the port's dialect from earlier structural evidence
+    passes it here: FLAT recovers the chip-0/value-0 reading, NESTED
+    decodes the empty answer to nothing. Structurally unambiguous
+    responses ignore ``assume`` entirely — real evidence always wins."""
     dialect = detect_dialect(data)
     out: list[MetricSample] = []
     if dialect == AMBIGUOUS:
-        # The detection scan already walked (and bounds-checked) every
-        # byte; there is nothing decodable either way.
-        return out, dialect
+        if assume not in (FLAT, NESTED):
+            # The detection scan already walked (and bounds-checked) every
+            # byte; there is nothing decodable either way.
+            return out, dialect
+        dialect = assume
     pos = 0
     end = len(data)
     decode_varint = codec.decode_varint

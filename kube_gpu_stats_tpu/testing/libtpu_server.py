@@ -48,6 +48,10 @@ class FakeLibtpuServer:
         self.fail = False
         self.garble = False
         self.reject_batch = False
+        # Flat dialect only: omit default-valued fields like a standard
+        # proto3 encoder (an idle chip then serializes name-only — the
+        # AMBIGUOUS wire shape).
+        self.zero_omit = False
         self.scripted: dict[tuple[str, int], float] = {}
         self.drop_metrics: set[str] = set()
         self.requests: list[str] = []
@@ -152,7 +156,7 @@ class FakeLibtpuServer:
             # rejected above), so every sample shares the requested name.
             response = tpumetrics.encode_response_nested(name, samples)
         else:
-            response = tpumetrics.encode_response(samples)
+            response = tpumetrics.encode_response(samples, self.zero_omit)
         return self._sleep_remaining(start, response)
 
     def _sleep_remaining(self, start: float, response: bytes) -> bytes:

@@ -13,7 +13,11 @@ backend call.
 API is the subset of ThreadPoolExecutor the poll loop uses — `submit` and
 `shutdown(wait=False, cancel_futures=True)` — returning real
 `concurrent.futures.Future` objects so callers keep their timeout/cancel
-semantics.
+semantics. Two deliberate divergences from the Executor contract (the
+wedged-backend rationale above): `shutdown` defaults to ``wait=False``
+(ThreadPoolExecutor defaults to True), and even ``wait=True`` joins under
+a bounded pool-wide deadline, reporting rather than hanging when workers
+stay wedged past it.
 """
 
 from __future__ import annotations
@@ -176,13 +180,19 @@ class DaemonSamplerPool:
 
     def shutdown(self, wait: bool = False, *,
                  cancel_futures: bool = False,
-                 timeout: float | None = 5.0) -> None:
+                 timeout: float | None = 5.0) -> bool:
         """Stop the pool. ``wait=False`` (the default) never blocks — the
         daemon threads die with the process, which is the whole point of
         this class: a wedged backend call must not wedge teardown too.
         ``wait=True`` joins the workers under one shared ``timeout``-second
         deadline for the whole pool (``timeout=None`` restores an unbounded
-        join; use it only when the submitted work is known to terminate)."""
+        join; use it only when the submitted work is known to terminate).
+
+        Returns True when every worker has exited; False (with a warning
+        logged) when the deadline expired with workers still wedged — so a
+        ``wait=True`` caller can tell a clean drain from a timed-out one
+        (round-2 advisor finding) — and trivially False for ``wait=False``
+        callers, who asked not to know."""
         with self._lock:
             self._shutdown = True
             if cancel_futures:
@@ -195,9 +205,20 @@ class DaemonSamplerPool:
                         item[0].cancel()  # (shutdown must stay idempotent)
             for _ in self._threads:
                 self._work.put(None)
-        if wait:
-            deadline = (None if timeout is None
-                        else time.monotonic() + timeout)
-            for thread in self._threads:
-                thread.join(None if deadline is None
-                            else max(0.0, deadline - time.monotonic()))
+        if not wait:
+            return False
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for thread in self._threads:
+            thread.join(None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+        wedged = [t.name for t in self._threads if t.is_alive()]
+        if wedged:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "sampler pool shutdown timed out after %.1fs with %d "
+                "worker(s) still wedged: %s (daemon threads — they die "
+                "with the process)", timeout, len(wedged), ", ".join(wedged))
+            return False
+        return True

@@ -68,3 +68,47 @@ def test_null_daemon_schema_valid(tmp_path):
         assert "accelerator_up" not in body
     finally:
         d.stop()
+
+
+def test_auto_backend_upgrades_from_null_when_tpu_appears(tmp_path):
+    """Round-2 advisor finding: the libtpu metric service only serves while
+    a workload runs, so --backend auto on a sysfs-less TPU VM used to latch
+    null for the process lifetime when the daemon started first. The
+    upgrade watcher must re-probe and swap in the real backend once the
+    service appears."""
+    import time
+
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+
+    server = FakeLibtpuServer(num_chips=2)  # port bound, NOT serving yet
+    cfg = Config(
+        backend="auto",
+        interval=0.05,
+        rediscovery_interval=0.1,  # re-probe cadence under test
+        listen_host="127.0.0.1",
+        listen_port=0,
+        sysfs_root=str(tmp_path / "no-sysfs"),
+        libtpu_ports=(server.port,),
+        attribution="off",
+    )
+    d = Daemon(cfg)
+    assert d.collector.name == "null"
+    assert d.upgrade_watcher is not None
+    d.start()
+    try:
+        assert d.registry.wait_for_publish(0, timeout=5)
+        assert "accelerator_up" not in scrape(d.server.port)
+        server.start()  # the TPU workload arrives
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            body = scrape(d.server.port)
+            if body.count("accelerator_up{") == 2:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("auto backend never upgraded from null")
+        assert 'backend="tpu"' in body
+        assert d.collector.name == "tpu"
+    finally:
+        d.stop()
+        server.stop()

@@ -274,3 +274,23 @@ def test_doctor_reports_wire_dialect_per_port(tmp_path):
     assert nested_res.status == "ok"
     assert "nested dialect" in nested_res.detail
     assert "per-metric fetch" in nested_res.detail
+
+
+def test_doctor_reports_name_only_port_as_answering_not_unreachable():
+    """Review finding: an idle zero-omitting flat runtime answers with
+    name-only (AMBIGUOUS) payloads; doctor used to fall through to
+    'unreachable (empty response)' — wrong on both counts. It must say the
+    port answers but carries no dialect evidence yet."""
+    from kube_gpu_stats_tpu.doctor import check_libtpu_port
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    with FakeLibtpuServer(num_chips=1, dialect="flat") as srv:
+        srv.zero_omit = True
+        srv.drop_metrics.add(tpumetrics.ICI_TRAFFIC)  # counters never zero
+        for m in tpumetrics.ALL_METRICS:
+            srv.scripted[(m, 0)] = 0.0
+        cfg = Config(backend="tpu", libtpu_ports=(srv.port,))
+        res = check_libtpu_port(cfg, srv.port)
+    assert res.status == "warn"
+    assert "name-only" in res.detail
+    assert "unreachable" not in res.detail

@@ -353,8 +353,13 @@ def test_bad_port_value_contained_to_that_port():
                         link="x0")
 
     class StubClient:
+        port_dialects: dict[int, str] = {}
+
         def get_raw_with_errors(self, metric_name):
-            return [good, bad], []
+            return [(8431, good), (8432, bad)], []
+
+        def note_dialect(self, port, dialect, raw):
+            pass
 
         def close(self):
             pass
@@ -445,3 +450,89 @@ def test_overflow_in_one_port_decode_contained():
     samples = client.get_metric(tpumetrics.DUTY_CYCLE)
     assert len(samples) == 1 and samples[0].value == 50.0
     client.close()
+
+
+def test_latched_dialect_resolves_zero_omitted_idle_readings(caplog):
+    """Round-2 advisor finding: a zero-omitting flat runtime serializes an
+    idle chip 0 as a name-only Metric (the AMBIGUOUS wire shape). Before
+    any dialect evidence the reading is dropped (with one warning per
+    port); once a nonzero value latches the port as flat, subsequent
+    ambiguous responses must resolve to the chip-0/value-0.0 reading
+    instead of silently losing it every tick."""
+    import logging
+
+    from kube_gpu_stats_tpu.collectors import Device
+
+    dev = Device(index=0, device_id="0", device_path="/dev/accel0",
+                 accel_type="tpu-test")
+    with FakeLibtpuServer(num_chips=1, dialect="flat") as server:
+        server.zero_omit = True
+        # ICI counters advance per fetch (never zero) — drop the family so
+        # the all-idle response really is name-only throughout.
+        server.drop_metrics.add(tpumetrics.ICI_TRAFFIC)
+        for m in tpumetrics.ALL_METRICS:
+            server.scripted[(m, 0)] = 0.0
+        col = make_collector(server)
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_gpu_stats_tpu.collectors.libtpu"):
+            for _ in range(2):  # two ambiguous ticks, ONE warning
+                col.begin_tick()
+                col.wait_ready()
+        with pytest.raises(CollectorError):
+            col.peek(dev)  # unlatched: idle reading dropped
+        drops = [r for r in caplog.records if "name-only" in r.message]
+        assert len(drops) == 1
+
+        server.scripted[(tpumetrics.DUTY_CYCLE, 0)] = 12.5
+        col.begin_tick()
+        col.wait_ready()
+        assert col.peek(dev).values[schema.DUTY_CYCLE.name] == 12.5
+        assert col._client.port_dialects == {server.port: tpumetrics.FLAT}
+
+        server.scripted[(tpumetrics.DUTY_CYCLE, 0)] = 0.0
+        col.begin_tick()
+        col.wait_ready()
+        # Latched flat: the ambiguous response now yields the idle zeros.
+        s = col.peek(dev)
+        assert s.values[schema.DUTY_CYCLE.name] == 0.0
+        assert s.values[schema.MEMORY_TOTAL.name] == 0.0
+        col.close()
+
+
+def test_decode_response_ex_assume_resolves_only_ambiguous():
+    from kube_gpu_stats_tpu.proto import codec
+
+    name_only = codec.field_bytes(
+        1, codec.field_string(1, tpumetrics.DUTY_CYCLE))
+    # assume=FLAT recovers the zero-omitted reading
+    samples, dialect = tpumetrics.decode_response_ex(
+        name_only, tpumetrics.FLAT)
+    assert dialect == tpumetrics.FLAT
+    assert samples == [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 0.0)]
+    # assume=NESTED reads it as an empty nested answer
+    samples, dialect = tpumetrics.decode_response_ex(
+        name_only, tpumetrics.NESTED)
+    assert dialect == tpumetrics.NESTED and samples == []
+    # assume must NOT override real structural evidence
+    nested = tpumetrics.encode_response_nested(
+        tpumetrics.DUTY_CYCLE,
+        [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 2, 7.0)])
+    samples, dialect = tpumetrics.decode_response_ex(nested, tpumetrics.FLAT)
+    assert dialect == tpumetrics.NESTED
+    assert samples[0].device_id == 2 and samples[0].value == 7.0
+
+
+def test_dialect_relatches_when_runtime_restart_switches_builds():
+    """Review finding: the latch must track contradicting structural
+    evidence — a restarted workload can bring a different runtime build to
+    the same port, and a stale FLAT latch would make ambiguous resolution
+    fabricate chip-0 zeros from empty nested answers."""
+    with FakeLibtpuServer(num_chips=1, dialect="flat") as server:
+        client = LibtpuClient(ports=(server.port,), rpc_timeout=1.0)
+        client.get_metric(tpumetrics.DUTY_CYCLE)
+        assert client.port_dialects == {server.port: tpumetrics.FLAT}
+        server.dialect = tpumetrics.NESTED  # "restart" with another build
+        samples = client.get_metric(tpumetrics.DUTY_CYCLE)
+        assert client.port_dialects == {server.port: tpumetrics.NESTED}
+        assert samples and samples[0].value == 50.0  # still decodes right
+        client.close()

@@ -187,6 +187,65 @@ def test_mixed_dialect_markers_rejected():
         tpumetrics.detect_dialect(flat_entry + nested_entry)
 
 
+def test_nested_with_unknown_extension_fields_stays_nested():
+    """Round-2 advisor finding (medium): a newer nested runtime may extend
+    TPUMetric with fields 4-6 (legal proto3 forward compat). Those wire
+    shapes overlap flat Metric's int_value/timestamp/link, but they are
+    only WEAK flat evidence — with hard nested markers present they must
+    be skipped as unknown fields, not trip the mixed-markers error."""
+    sample = tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 3, 87.5)
+    body = (
+        codec.field_string(1, tpumetrics.DUTY_CYCLE)
+        + codec.field_bytes(3, tpumetrics.encode_metric_nested(sample))
+        + codec.field_varint(4, 7)            # future varint extension
+        + codec.field_varint(5, 123456789)    # future varint extension
+        + codec.field_string(6, "v2-extra")   # future string extension
+    )
+    raw = codec.field_bytes(1, body)
+    assert tpumetrics.detect_dialect(raw) == tpumetrics.NESTED
+    samples, dialect = tpumetrics.decode_response_ex(raw)
+    assert dialect == tpumetrics.NESTED
+    assert samples == [sample]
+
+
+def test_weak_flat_markers_alone_still_decode_flat():
+    """Without any nested marker, fields 4-6 remain flat evidence: a flat
+    runtime emitting only name+int_value (zero-omitting encoder, chip 0)
+    must keep decoding as flat, exactly as before the weak/hard split."""
+    raw = codec.field_bytes(1, (
+        codec.field_string(1, tpumetrics.HBM_USED)
+        + codec.field_varint(4, 2048)
+    ))
+    assert tpumetrics.detect_dialect(raw) == tpumetrics.FLAT
+    samples, dialect = tpumetrics.decode_response_ex(raw)
+    assert dialect == tpumetrics.FLAT
+    assert samples == [tpumetrics.MetricSample(tpumetrics.HBM_USED, 0, 2048)]
+
+
+def test_hard_flat_vs_nested_conflict_still_rejected():
+    """The weak/hard split must not weaken garble detection: hard flat
+    markers (field 2 varint / field 3 fixed64) alongside hard nested
+    markers are still an error, in the same response AND in the same
+    entry."""
+    nested_entry = codec.field_bytes(1, (
+        codec.field_string(1, tpumetrics.DUTY_CYCLE)
+        + codec.field_bytes(3, tpumetrics.encode_metric_nested(
+            tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 1.0)))
+    ))
+    one_entry_both = codec.field_bytes(1, (
+        codec.field_string(1, tpumetrics.DUTY_CYCLE)
+        + codec.field_varint(2, 1)                       # flat device_id
+        + codec.field_bytes(3, b"\x11" + b"\x00" * 8)    # nested-shaped metrics
+    ))
+    for raw in (
+        codec.field_bytes(1, codec.field_string(1, "x")
+                          + codec.field_double(3, 1.0)) + nested_entry,
+        one_entry_both,
+    ):
+        with pytest.raises(ValueError):
+            tpumetrics.detect_dialect(raw)
+
+
 def test_alternate_attribute_key_spellings():
     for dkey in sorted(tpumetrics.DEVICE_ATTR_KEYS):
         metric = (

@@ -135,3 +135,28 @@ def test_shutdown_default_never_blocks_on_wedged_worker():
     pool2.shutdown(wait=True, timeout=0.2)
     assert time.monotonic() - t0 < 2.0
     release.set()
+
+
+def test_shutdown_reports_clean_vs_wedged_drain(caplog):
+    """wait=True returns True on a clean drain, False (with a warning) when
+    the deadline expires with a worker still wedged (round-2 advisor
+    finding: callers couldn't tell the two apart)."""
+    import logging
+    import threading
+
+    from kube_gpu_stats_tpu.workers import DaemonSamplerPool
+
+    pool = DaemonSamplerPool(max_workers=1)
+    pool.submit(lambda: None).result(timeout=5)
+    assert pool.shutdown(wait=True, timeout=5.0) is True
+
+    wedge = threading.Event()
+    pool2 = DaemonSamplerPool(max_workers=1)
+    pool2.submit(wedge.wait)
+    with caplog.at_level(logging.WARNING, logger="kube_gpu_stats_tpu.workers"):
+        assert pool2.shutdown(wait=True, timeout=0.2) is False
+    assert any("wedged" in r.message for r in caplog.records)
+    wedge.set()  # let the worker exit
+
+    pool3 = DaemonSamplerPool(max_workers=1)
+    assert pool3.shutdown(wait=False) is False  # asked not to know
