@@ -287,6 +287,27 @@ panels = [
         description="Push-mode delivery health; failing/rejected map to the "
                     "AcceleratorMetricShipping* alerts. Absent when neither "
                     "push mode is configured."),
+
+    # Row 9 — scrape/render self-observability (the render half of the
+    # north-star scrape latency; collect half is row 6).
+    timeseries(
+        "Scrape render latency by output (p99)",
+        [('histogram_quantile(0.99, sum by (output, le) '
+          '(rate(collector_scrape_duration_seconds_bucket[5m])))',
+          '{{output}} p99')],
+        "s", {"x": 0, "y": 60, "w": 12, "h": 8}, per_chip=False,
+        thresholds=[0.025],
+        description="Snapshot render (+gzip/snappy) wall time per output "
+                    "path; threshold line = ScrapeRenderLatencyHigh alert "
+                    "(25 ms p99 on the http path)."),
+    timeseries(
+        "Rendered bytes by output",
+        [('sum by (output) (rate(collector_rendered_bytes_total[5m]))',
+          '{{output}}')],
+        "Bps", {"x": 12, "y": 60, "w": 12, "h": 8}, per_chip=False,
+        description="Output volume per render path (post-compression). A "
+                    "rising trend at constant scrape rate means series "
+                    "growth — cardinality eating the scrape budget."),
 ]
 
 dashboard = {

@@ -38,6 +38,8 @@ class Config:
     sysfs_root: str = "/sys"
     proc_root: str = "/proc"
     device_processes: str = "on"  # accelerator_process_open scan (on|off)
+    max_process_series: int = 32  # process_open holders per device; excess
+    #                               folds into one comm="_overflow" series
     libtpu_ports: tuple[int, ...] = (DEFAULT_LIBTPU_PORT,)
     libtpu_addr: str = "127.0.0.1"
     attribution: str = "auto"  # auto|podresources|checkpoint|off
@@ -123,6 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "hold each device node; procfs scan on the "
                         "attribution cadence). In Kubernetes the pod needs "
                         "hostPID to see beyond its own namespace")
+    p.add_argument("--max-process-series", type=int,
+                   default=int(_env("MAX_PROCESS_SERIES", "32")),
+                   help="max accelerator_process_open holders exported per "
+                        "device; the excess is folded into one "
+                        '{comm="_overflow"} series carrying the folded '
+                        "count (a fork-heavy node must not blow up "
+                        "Prometheus)")
     p.add_argument("--libtpu-addr", default=_env("LIBTPU_ADDR", "127.0.0.1"))
     p.add_argument("--libtpu-ports",
                    default=_env("LIBTPU_PORTS",
@@ -251,6 +260,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
             f"--drop-labels may not include device-identity labels "
             f"{sorted(identity)}"
         )
+    if args.max_process_series < 1:
+        parser.error("--max-process-series must be >= 1")
     if bool(args.tls_cert_file) != bool(args.tls_key_file):
         parser.error("--tls-cert-file and --tls-key-file must be set together")
     if bool(args.auth_username) != bool(args.auth_password_sha256):
@@ -279,6 +290,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         sysfs_root=args.sysfs_root,
         proc_root=args.proc_root,
         device_processes=args.device_processes,
+        max_process_series=args.max_process_series,
         libtpu_addr=args.libtpu_addr,
         libtpu_ports=parse_libtpu_ports(args.libtpu_ports),
         attribution=args.attribution,

@@ -16,7 +16,8 @@ from . import __version__, topology
 from .config import Config
 from .collectors import Collector
 from .collectors.mock import MockCollector, NullCollector
-from .exposition import MetricsServer, PushgatewayPusher, TextfileWriter
+from .exposition import (MetricsServer, PushgatewayPusher, RenderStats,
+                         TextfileWriter)
 from .poll import AttributionProvider, NullAttribution, PollLoop
 from .procopen import DeviceProcessWatcher
 from .registry import Registry
@@ -162,6 +163,7 @@ class Daemon:
     def __init__(self, cfg: Config) -> None:
         self.cfg = cfg
         self.registry = Registry()
+        self.render_stats = RenderStats()
         self.collector = build_collector(cfg)
         self.attribution = build_attribution(cfg)
         # Per-process device holders (accelerator_process_open): the lazy
@@ -172,6 +174,7 @@ class Daemon:
                 lambda: [d.device_path for d in self.poll.devices],
                 proc_root=cfg.proc_root,
                 refresh_interval=cfg.attribution_interval,
+                max_holders=cfg.max_process_series,
             )
             if cfg.device_processes == "on"
             else None
@@ -188,6 +191,7 @@ class Daemon:
             drop_labels=cfg.drop_labels,
             process_openers=self.procwatch.lookup if self.procwatch else None,
             push_stats=self._push_stats,
+            render_stats=self.render_stats.contribute,
         )
         self.server = MetricsServer(
             self.registry, cfg.listen_host, cfg.listen_port,
@@ -198,15 +202,18 @@ class Daemon:
             tls_key_file=cfg.tls_key_file,
             auth_username=cfg.auth_username,
             auth_password_sha256=cfg.auth_password_sha256,
+            render_stats=self.render_stats,
         )
         self.textfile = (
-            TextfileWriter(self.registry, cfg.textfile_dir)
+            TextfileWriter(self.registry, cfg.textfile_dir,
+                           render_stats=self.render_stats)
             if cfg.textfile_enabled
             else None
         )
         self.pusher = (
             PushgatewayPusher(self.registry, cfg.pushgateway_url,
-                              job=cfg.pushgateway_job)
+                              job=cfg.pushgateway_job,
+                              render_stats=self.render_stats)
             if cfg.pushgateway_url
             else None
         )
@@ -226,6 +233,7 @@ class Daemon:
                 job=cfg.remote_write_job,
                 min_interval=cfg.remote_write_interval,
                 bearer_token_file=cfg.remote_write_bearer_token_file,
+                render_stats=self.render_stats,
             )
 
     def _push_stats(self) -> dict[str, dict[str, int]]:

@@ -17,7 +17,8 @@ import os
 import threading
 from pathlib import Path
 
-from .registry import Registry
+from . import schema
+from .registry import HistogramState, Registry
 from .workers import PublishFollower
 
 log = logging.getLogger(__name__)
@@ -45,6 +46,45 @@ def _gzip_accepted(accept_encoding: str) -> bool:
     return False
 
 
+class RenderStats:
+    """Scrape-side self-observability shared by every render site (HTTP
+    scrape, textfile, pushgateway, remote_write — round-1 verdict item 5:
+    collect-side latency was measured, the render+compress half of the
+    north-star scrape metric wasn't). Writers call :meth:`observe` from
+    their own threads; the poll loop folds the state into each snapshot
+    via :meth:`contribute` — the same one-writer-per-structure discipline
+    as push_stats, with a lock only around this small accumulator, never
+    around a render."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hists: dict[str, HistogramState] = {}
+        self._bytes: dict[str, int] = {}
+
+    def observe(self, output: str, seconds: float, nbytes: int) -> None:
+        with self._lock:
+            hist = self._hists.get(output)
+            if hist is None:
+                hist = HistogramState.empty(
+                    schema.SELF_SCRAPE_DURATION,
+                    schema.SCRAPE_DURATION_BUCKETS,
+                    labels=(("output", output),),
+                )
+            self._hists[output] = hist.observe(seconds)
+            self._bytes[output] = self._bytes.get(output, 0) + nbytes
+
+    def contribute(self, builder) -> None:
+        """Fold current state into a SnapshotBuilder (poll-loop thread)."""
+        with self._lock:
+            hists = [self._hists[k] for k in sorted(self._hists)]
+            sizes = sorted(self._bytes.items())
+        for hist in hists:
+            builder.add_histogram(hist)
+        for output, total in sizes:
+            builder.add(schema.SELF_RENDERED_BYTES, float(total),
+                        (("output", output),))
+
+
 class MetricsServer:
     """Threaded HTTP server for /metrics, /healthz and /.
 
@@ -70,9 +110,11 @@ class MetricsServer:
     def __init__(self, registry: Registry, host: str = "0.0.0.0",
                  port: int = 9400, healthz_max_age: float = 0.0,
                  tls_cert_file: str = "", tls_key_file: str = "",
-                 auth_username: str = "", auth_password_sha256: str = ""):
+                 auth_username: str = "", auth_password_sha256: str = "",
+                 render_stats: RenderStats | None = None):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
+        self._render_stats = render_stats
         self._auth = (
             (auth_username, auth_password_sha256.lower())
             if auth_username else None
@@ -128,10 +170,13 @@ class MetricsServer:
                         self.wfile.write(body)
                         return
                 if path == "/metrics":
+                    import time as _time
+
                     # Content negotiation: Prometheus asks for OpenMetrics
                     # with an explicit Accept; default stays text 0.0.4.
                     accept = self.headers.get("Accept", "")
                     use_om = "application/openmetrics-text" in accept
+                    render_start = _time.monotonic()
                     body = (
                         outer._registry.snapshot()
                         .render(openmetrics=use_om)
@@ -144,6 +189,12 @@ class MetricsServer:
 
                         body = gzip.compress(body, compresslevel=6)
                         encoding = "gzip"
+                    if outer._render_stats is not None:
+                        # Render + gzip, post-compression size: the cost a
+                        # scrape actually pays and the bytes it ships.
+                        outer._render_stats.observe(
+                            "http", _time.monotonic() - render_start,
+                            len(body))
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
@@ -264,11 +315,13 @@ class PushgatewayPusher(PublishFollower):
     fatal)."""
 
     def __init__(self, registry: Registry, url: str, job: str = "kube-tpu-stats",
-                 instance: str = "", min_interval: float = 1.0) -> None:
+                 instance: str = "", min_interval: float = 1.0,
+                 render_stats: RenderStats | None = None) -> None:
         import socket
         import urllib.parse
 
         super().__init__(registry, min_interval, thread_name="pushgateway")
+        self._render_stats = render_stats
         instance = instance or socket.gethostname()
         self._target = (
             url.rstrip("/")
@@ -277,9 +330,14 @@ class PushgatewayPusher(PublishFollower):
         )
 
     def push_once(self) -> None:
+        import time
         import urllib.request
 
+        render_start = time.monotonic()
         body = self._registry.snapshot().render().encode()
+        if self._render_stats is not None:
+            self._render_stats.observe(
+                "pushgateway", time.monotonic() - render_start, len(body))
         request = urllib.request.Request(
             self._target, data=body, method="PUT",
             headers={"Content-Type": CONTENT_TYPE},
@@ -305,8 +363,10 @@ class TextfileWriter:
     """
 
     def __init__(self, registry: Registry, directory: str | os.PathLike,
-                 filename: str = "accelerator.prom") -> None:
+                 filename: str = "accelerator.prom",
+                 render_stats: RenderStats | None = None) -> None:
         self._registry = registry
+        self._render_stats = render_stats
         self._dir = Path(directory)
         self._path = self._dir / filename
         self._tmp = self._dir / (filename + ".tmp")
@@ -318,9 +378,18 @@ class TextfileWriter:
         return self._path
 
     def write_once(self) -> None:
+        import time
+
         self._dir.mkdir(parents=True, exist_ok=True)
-        text = self._registry.snapshot().render()
-        self._tmp.write_text(text)
+        render_start = time.monotonic()
+        # Encode once: the rendered-bytes counter must report true bytes
+        # (comm labels can be multi-byte UTF-8), same unit as the other
+        # output paths, and write_bytes reuses the encoding.
+        data = self._registry.snapshot().render().encode()
+        if self._render_stats is not None:
+            self._render_stats.observe(
+                "textfile", time.monotonic() - render_start, len(data))
+        self._tmp.write_bytes(data)
         os.replace(self._tmp, self._path)
 
     def run_forever(self) -> None:

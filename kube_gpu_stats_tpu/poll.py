@@ -60,8 +60,9 @@ class PollLoop:
         rediscovery_interval: float = 60.0,
         process_metrics: bool = True,
         drop_labels: Sequence[str] = (),
-        process_openers: Callable[[str], Sequence[tuple[int, str]]] | None = None,
+        process_openers: Callable[[str], Sequence[tuple[str, str, float]]] | None = None,
         push_stats: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
+        render_stats: Callable[[SnapshotBuilder], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -83,6 +84,10 @@ class PollLoop:
         # Shipping-health counters from the push senders (daemon-wired
         # callable; reads plain ints, safe from this thread).
         self._push_stats = push_stats
+        # Scrape/render self-observability contributor (daemon wires
+        # RenderStats.contribute): folds scrape-duration histograms and
+        # rendered-bytes counters into each snapshot.
+        self._render_stats = render_stats
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -388,10 +393,13 @@ class PollLoop:
         if self._process_openers is not None:
             for dev, _ in results:
                 base = self._device_labels(dev)
-                for pid, comm in self._process_openers(dev.device_path):
+                # Holder entries are (pid, comm, value): 1 per real holder,
+                # the fold count on the capped {comm="_overflow"} series
+                # (procopen.scan bounds cardinality).
+                for pid, comm, value in self._process_openers(dev.device_path):
                     builder.add(
-                        schema.PROCESS_OPEN, 1.0,
-                        base + [("pid", str(pid)), ("comm", comm)],
+                        schema.PROCESS_OPEN, value,
+                        base + [("pid", pid), ("comm", comm)],
                     )
 
         builder.add(schema.SELF_DEVICES, float(len(results)))
@@ -430,4 +438,6 @@ class PollLoop:
             for name, value in procstats.read().items():
                 builder.add(by_self[name], value)
         builder.add_histogram(self._hist)
+        if self._render_stats is not None:
+            self._render_stats(builder)
         return builder.build()

@@ -54,17 +54,22 @@ class Series:
 
 @dataclasses.dataclass(frozen=True)
 class HistogramState:
-    """Cumulative histogram state owned by the poll loop, published by value."""
+    """Cumulative histogram state owned by its writer, published by value.
+    ``labels`` dimension the family (e.g. collector_scrape_duration_seconds
+    per output path); () renders the classic bare le-only form."""
 
     spec: MetricSpec
     buckets: tuple[float, ...]
     counts: tuple[int, ...]  # len(buckets) + 1, cumulative-by-render not stored
     total: int
     sum: float
+    labels: tuple[tuple[str, str], ...] = ()
 
     @staticmethod
-    def empty(spec: MetricSpec, buckets: Sequence[float]) -> "HistogramState":
-        return HistogramState(spec, tuple(buckets), (0,) * (len(buckets) + 1), 0, 0.0)
+    def empty(spec: MetricSpec, buckets: Sequence[float],
+              labels: Iterable[tuple[str, str]] = ()) -> "HistogramState":
+        return HistogramState(spec, tuple(buckets), (0,) * (len(buckets) + 1),
+                              0, 0.0, tuple(labels))
 
     def observe(self, value: float) -> "HistogramState":
         counts = list(self.counts)
@@ -75,7 +80,8 @@ class HistogramState:
         else:
             counts[-1] += 1
         return HistogramState(
-            self.spec, self.buckets, tuple(counts), self.total + 1, self.sum + value
+            self.spec, self.buckets, tuple(counts), self.total + 1,
+            self.sum + value, self.labels
         )
 
     def quantile(self, q: float) -> float:
@@ -129,19 +135,32 @@ class Snapshot:
                     _series_prefix(s.spec.name, s.labels)
                     + format_value(s.value)
                 )
+        # Histograms grouped by family: one HELP/TYPE header even when the
+        # family is dimensioned into several labeled states (e.g.
+        # collector_scrape_duration_seconds{output=...}).
+        hists_by_family: dict[str, list[HistogramState]] = {}
         for hist in self.histograms:
-            spec = hist.spec
+            hists_by_family.setdefault(hist.spec.name, []).append(hist)
+        for group in hists_by_family.values():
+            spec = group[0].spec
             out.append(f"# HELP {spec.name} {spec.help}")
             out.append(f"# TYPE {spec.name} histogram")
-            cumulative = 0
-            for i, bound in enumerate(hist.buckets):
-                cumulative += hist.counts[i]
-                out.append(
-                    f'{spec.name}_bucket{{le="{format_value(bound)}"}} {cumulative}'
-                )
-            out.append(f'{spec.name}_bucket{{le="+Inf"}} {hist.total}')
-            out.append(f"{spec.name}_sum {format_value(hist.sum)}")
-            out.append(f"{spec.name}_count {hist.total}")
+            bucket_name = spec.name + "_bucket"
+            for hist in group:
+                # _series_prefix-cached like plain series: bucket label
+                # tuples repeat verbatim every render.
+                cumulative = 0
+                for i, bound in enumerate(hist.buckets):
+                    cumulative += hist.counts[i]
+                    le = hist.labels + (("le", format_value(bound)),)
+                    out.append(_series_prefix(bucket_name, le)
+                               + str(cumulative))
+                le = hist.labels + (("le", "+Inf"),)
+                out.append(_series_prefix(bucket_name, le) + str(hist.total))
+                out.append(_series_prefix(spec.name + "_sum", hist.labels)
+                           + format_value(hist.sum))
+                out.append(_series_prefix(spec.name + "_count", hist.labels)
+                           + str(hist.total))
         if openmetrics:
             out.append("# EOF")
         return "\n".join(out) + "\n" if out else ""

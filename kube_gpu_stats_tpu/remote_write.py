@@ -85,7 +85,9 @@ def build_write_request(snapshot: Snapshot, job: str, instance: str) -> bytes:
         series.append(prompb.encode_series(
             s.spec.name, identity + list(s.labels), s.value, ts))
     for hist in snapshot.histograms:
-        series.extend(_histogram_series(hist, identity, ts))
+        # hist.labels dimension the family (e.g. scrape duration per
+        # output); they ride every expanded series like scrape rendering.
+        series.extend(_histogram_series(hist, identity + list(hist.labels), ts))
     return prompb.encode_write_request(series)
 
 
@@ -99,7 +101,8 @@ class RemoteWriter(PublishFollower):
     def __init__(self, registry: Registry, url: str, *,
                  job: str = "kube-tpu-stats", instance: str = "",
                  min_interval: float = 15.0,
-                 bearer_token_file: str = "") -> None:
+                 bearer_token_file: str = "",
+                 render_stats=None) -> None:
         import socket
 
         super().__init__(registry, min_interval, thread_name="remote-write")
@@ -107,6 +110,7 @@ class RemoteWriter(PublishFollower):
         self._job = job
         self._instance = instance or socket.gethostname()
         self._bearer_token_file = bearer_token_file
+        self._render_stats = render_stats
 
     def _headers(self) -> dict[str, str] | None:
         return build_headers(self._bearer_token_file)
@@ -123,8 +127,15 @@ class RemoteWriter(PublishFollower):
             self.consecutive_failures += 1  # retryable: token will be back
             self.failures_total += 1
             return
+        import time
+
+        serialize_start = time.monotonic()
         body = snappy.compress(
             build_write_request(snapshot, self._job, self._instance))
+        if self._render_stats is not None:
+            # prompb serialize + snappy: this path's render equivalent.
+            self._render_stats.observe(
+                "remote_write", time.monotonic() - serialize_start, len(body))
         request = urllib.request.Request(
             self._url, data=body, method="POST", headers=headers)
         try:

@@ -140,10 +140,13 @@ DEVICE_UP = MetricSpec(
 PROCESS_OPEN = MetricSpec(
     "accelerator_process_open",
     MetricType.GAUGE,
-    "Constant 1 per process currently holding this device node open "
-    "(procfs fd scan — the NVML-free analog of nvidia-smi's process "
-    "table). The workload attribution that works on plain TPU VMs with "
-    "no kubelet; refreshed on the attribution cadence, not per tick.",
+    "1 per process currently holding this device node open (procfs fd "
+    "scan — the NVML-free analog of nvidia-smi's process table). The "
+    "workload attribution that works on plain TPU VMs with no kubelet; "
+    "refreshed on the attribution cadence, not per tick. Cardinality is "
+    "capped at --max-process-series holders per device; the excess is "
+    'folded into one {pid="",comm="_overflow"} series whose value is the '
+    "folded holder count.",
     extra_labels=("pid", "comm"),
 )
 
@@ -189,6 +192,24 @@ SELF_POLL_DURATION = MetricSpec(
     MetricType.HISTOGRAM,
     "Wall time of one full poll tick over all local devices. The north-star "
     "budget is p50 < 0.050s at 1 Hz (BASELINE.md).",
+)
+SELF_SCRAPE_DURATION = MetricSpec(
+    "collector_scrape_duration_seconds",
+    MetricType.HISTOGRAM,
+    "Wall time to render (and, for HTTP, compress) one snapshot per output "
+    "path (http scrape, textfile, pushgateway, remote_write). The render "
+    "half of the north-star scrape-latency metric; collect-side wall time "
+    "is collector_poll_duration_seconds.",
+    extra_labels=("output",),
+)
+SELF_RENDERED_BYTES = MetricSpec(
+    "collector_rendered_bytes_total",
+    MetricType.COUNTER,
+    "Cumulative bytes produced by snapshot rendering per output path "
+    "(post-compression where the path compresses). Rising per-render size "
+    "means series growth — the thing that silently eats the scrape "
+    "budget.",
+    extra_labels=("output",),
 )
 SELF_POLL_ERRORS = MetricSpec(
     "collector_poll_errors_total",
@@ -255,6 +276,8 @@ PROCESS_START = MetricSpec(
 
 SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_DURATION,
+    SELF_SCRAPE_DURATION,
+    SELF_RENDERED_BYTES,
     SELF_POLL_ERRORS,
     SELF_DEVICES,
     SELF_INFO,
@@ -273,6 +296,12 @@ ALL_METRICS: tuple[MetricSpec, ...] = PER_DEVICE_METRICS + SELF_METRICS
 # resolve the 50 ms budget from both sides.
 POLL_DURATION_BUCKETS: tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+# Buckets for collector_scrape_duration_seconds: renders are ~10x faster
+# than a full poll tick, so the range shifts down one decade.
+SCRAPE_DURATION_BUCKETS: tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 )
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")

@@ -268,3 +268,27 @@ def test_prompb_known_answer_against_real_protobuf():
     assert decoded[0][1] == [(51.5, 1722211200000)]
     assert decoded[1][0] == {"__name__": "up"}
     assert decoded[1][1] == [(1.0, 1000)]
+
+
+def test_labeled_histogram_states_carry_their_labels():
+    """Scrape-duration histograms are dimensioned by output; every expanded
+    remote-write series must carry that label next to le/job/instance."""
+    from kube_gpu_stats_tpu import schema
+    from kube_gpu_stats_tpu.registry import HistogramState, SnapshotBuilder
+
+    builder = SnapshotBuilder()
+    hist = HistogramState.empty(
+        schema.SELF_SCRAPE_DURATION, schema.SCRAPE_DURATION_BUCKETS,
+        labels=(("output", "http"),),
+    ).observe(0.004)
+    builder.add_histogram(hist)
+    decoded = prompb.decode_write_request(
+        build_write_request(builder.build(), "kts", "node-1"))
+    hist_series = [
+        (labels, samples) for labels, samples in decoded
+        if labels["__name__"].startswith("collector_scrape_duration_seconds")
+    ]
+    assert hist_series
+    for labels, _ in hist_series:
+        assert labels["output"] == "http"
+        assert labels["job"] == "kts"
