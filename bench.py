@@ -23,9 +23,15 @@ BUDGET_MS = 50.0
 
 
 def main() -> int:
-    from kube_gpu_stats_tpu.bench import run_latency_harness, try_real_harness
+    from kube_gpu_stats_tpu.bench import (run_latency_harness,
+                                          try_embedded_harness,
+                                          try_real_harness)
 
-    result = try_real_harness(ticks=50, warmup=5)
+    result, probe = try_real_harness(ticks=50, warmup=5)
+    if result is None:
+        # No external metric surface (the probe says exactly why): the
+        # embedded in-process collector is the remaining real-chip path.
+        result = try_embedded_harness(probe, ticks=50, warmup=5)
     if result is None:
         with tempfile.TemporaryDirectory() as tmp:
             result = run_latency_harness(
@@ -43,8 +49,17 @@ def main() -> int:
         "metrics_per_sec_per_chip": round(result["metrics_per_chip"], 1),
         "max_hz": round(result["max_hz"], 1),
         "mode": result["mode"],
+        "path": result.get("path", "fake-grpc"),
         "chips": result["chips"],
+        # Machine-checked evidence of why mode is (or isn't) real —
+        # present in every run so a fallback explains itself.
+        "real_probe": probe,
     }
+    if "device_kind" in result:
+        line["device_kind"] = result["device_kind"]
+    if "workload_steps_per_s_during_bench" in result:
+        line["workload_steps_per_s_during_bench"] = (
+            result["workload_steps_per_s_during_bench"])
     print(json.dumps(line))
     return 0
 

@@ -101,9 +101,12 @@ def make_sharded_train_step(n_devices: int, *, d_model: int = 256,
 
 
 def run_burn(seconds: float = 10.0, size: int = 2048,
-             report_every: float = 1.0, kernel: str = "xla") -> int:
+             report_every: float = 1.0, kernel: str = "xla",
+             step_hook=None) -> int:
     """Drive the local chip(s) for `seconds`; returns steps executed.
-    kernel: "xla" (jnp matmul chain) or "pallas" (hand-tiled MXU kernel)."""
+    kernel: "xla" (jnp matmul chain) or "pallas" (hand-tiled MXU kernel).
+    step_hook(n): called per executed step — the embedded exporter's
+    workload-steps counter (embedded.EmbeddedExporter.record_step)."""
     import jax
 
     import jax.numpy as jnp
@@ -128,6 +131,8 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
         x = step(x, w)
         steps += 1
         inflight += 1
+        if step_hook is not None:
+            step_hook(1)
         # Bound the async dispatch queue and force materialization before
         # trusting any rate: some backends defer execution until a value is
         # actually fetched, so an unbounded dispatch loop measures enqueue
@@ -163,11 +168,33 @@ def main(argv=None) -> int:
                         help="mxu: matmul burn; ici: ring-permute burn that "
                              "drives inter-chip traffic (C10 validation)")
     parser.add_argument("--shard-mb", type=float, default=4.0)
+    parser.add_argument("--embedded-port", type=int, default=None,
+                        help="serve the embedded in-process exporter on "
+                             "this port while burning (0 = pick a free "
+                             "port, printed on stdout)")
+    parser.add_argument("--embedded-textfile", default="",
+                        help="embedded exporter textfile output dir")
     args = parser.parse_args(argv)
-    if args.mode == "ici":
-        from .ici_burn import run_ici_burn
+    exporter = None
+    step_hook = None
+    if args.embedded_port is not None:
+        from .. import embedded
 
-        run_ici_burn(args.seconds, shard_mb=args.shard_mb)
-    else:
-        run_burn(args.seconds, args.size, kernel=args.kernel)
+        exporter = embedded.start(
+            args.embedded_port,
+            textfile=args.embedded_textfile or None,
+        )
+        step_hook = exporter.record_step
+        print(f"embedded-exporter-port: {exporter.port}", flush=True)
+    try:
+        if args.mode == "ici":
+            from .ici_burn import run_ici_burn
+
+            run_ici_burn(args.seconds, shard_mb=args.shard_mb)
+        else:
+            run_burn(args.seconds, args.size, kernel=args.kernel,
+                     step_hook=step_hook)
+    finally:
+        if exporter is not None:
+            exporter.stop()
     return 0

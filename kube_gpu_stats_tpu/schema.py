@@ -150,6 +150,15 @@ PROCESS_OPEN = MetricSpec(
     extra_labels=("pid", "comm"),
 )
 
+WORKLOAD_STEPS = MetricSpec(
+    "accelerator_workload_steps_total",
+    MetricType.COUNTER,
+    "Training/serving steps the co-located workload reported via the "
+    "embedded exporter's step hook (kube_gpu_stats_tpu.embedded). In SPMD "
+    "every local device participates in each step, so the counter rides "
+    "each device's label set. Only present in embedded mode.",
+)
+
 PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     DUTY_CYCLE,
     TENSORCORE_UTIL,
@@ -165,6 +174,7 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     UPTIME,
     DEVICE_UP,
     PROCESS_OPEN,
+    WORKLOAD_STEPS,
 )
 
 # DCN latency arrives from the runtime as one metric per percentile. Inside
