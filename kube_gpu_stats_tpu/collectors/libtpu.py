@@ -19,7 +19,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import threading
-from typing import Mapping, Sequence
+from typing import Mapping, NamedTuple, Sequence
 
 import grpc
 
@@ -77,25 +77,42 @@ def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict]) -> N
         entry["values"][_VALUE_MAP[name]] = float(sample.value)
 
 
+class IngestReport(NamedTuple):
+    """What one response's ingest saw, for the caller's diagnostics:
+    ``dialect`` feeds LibtpuClient.note_dialect (AMBIGUOUS = discarded
+    unresolved); ``unknown`` counts payloads whose family name is outside
+    the pinned surface (they fold nothing — a runtime speaking different
+    names would otherwise present as a clean, green, empty exporter);
+    ``unknown_names`` carries the actual names where the decode path had
+    them (Python; the native fast path reports only the count)."""
+
+    dialect: str
+    unknown: int = 0
+    unknown_names: tuple[str, ...] = ()
+
+
 def ingest_response_py(raw: bytes, cache: dict[int, dict],
-                       assume: str | None = None) -> str:
+                       assume: str | None = None) -> IngestReport:
     """Decode a MetricResponse and ingest every metric (Python fallback for
     the native _wirefast.ingest). All-or-nothing: staged into a scratch
     dict so an ingest-time error (e.g. int(NaN) on a counter metric) can't
     publish the response's leading metrics — same containment as the fused
     native wrapper. ``assume`` is the port's latched dialect (resolves
     structurally ambiguous name-only responses — see
-    tpumetrics.decode_response_ex). Returns the dialect the response
-    decoded under — AMBIGUOUS means it was discarded unresolved; the
-    caller feeds this to LibtpuClient.note_dialect for (re)latching and
-    drop logging, which keeps the structural scan a once-per-response
-    cost instead of a second pre-pass."""
+    tpumetrics.decode_response_ex)."""
     staged: dict[int, dict] = {}
     samples, dialect = tpumetrics.decode_response_ex(raw, assume)
+    unknown_names: list[str] = []
     for s in samples:
+        name = s.name
+        if (name and name != tpumetrics.ICI_TRAFFIC
+                and name != tpumetrics.COLLECTIVES
+                and name not in _VALUE_MAP):
+            unknown_names.append(name)
+            continue
         _ingest_sample(s, staged)
     _merge_cache(staged, cache)
-    return dialect
+    return IngestReport(dialect, len(unknown_names), tuple(unknown_names))
 
 
 def _merge_cache(src: dict[int, dict], dst: dict[int, dict]) -> None:
@@ -114,12 +131,12 @@ def _merge_cache(src: dict[int, dict], dst: dict[int, dict]) -> None:
 
 def _make_fused_ingest(wirefast):
     def ingest_response_native(raw: bytes, cache: dict[int, dict],
-                               assume: str | None = None) -> str:
+                               assume: str | None = None) -> IngestReport:
         # Stage into a scratch dict so a ValueError mid-response can't
         # publish a corrupt response's leading metrics (all-or-nothing,
         # matching the Python path's decode-then-ingest order).
         staged: dict[int, dict] = {}
-        _n, dcode = wirefast.ingest(raw, staged)
+        _n, dcode, unknown = wirefast.ingest(raw, staged)
         if dcode == 2:
             # Ambiguous: the C scan folded nothing. Delegate the whole
             # resolution contract (assume, staging, dialect return) to the
@@ -127,7 +144,11 @@ def _make_fused_ingest(wirefast):
             # responses, which carry at most a handful of samples.
             return ingest_response_py(raw, cache, assume)
         _merge_cache(staged, cache)
-        return tpumetrics.FLAT if dcode == 0 else tpumetrics.NESTED
+        # Names stay in C (no per-payload allocation on the hot path);
+        # the count alone triggers the collector's one-time warning, and
+        # doctor's Python decode supplies the names on demand.
+        return IngestReport(
+            tpumetrics.FLAT if dcode == 0 else tpumetrics.NESTED, unknown)
 
     return ingest_response_native
 
@@ -362,6 +383,31 @@ class LibtpuCollector(Collector):
         # metrics on a single-slice runtime). Latched like _batched so an
         # old runtime costs the failing round trips once, not every tick.
         self._unsupported: set[str] = set()
+        # port -> cumulative unknown-family payload count (families the
+        # runtime serves that are outside our pinned name surface; the
+        # data is dropped but the drop must be visible — round-2 verdict
+        # item 6). Warned once per port.
+        self.unknown_family_samples: dict[int, int] = {}
+        self._unknown_warned: set[int] = set()
+
+    def _note_unknown(self, port: int, report: IngestReport) -> None:
+        """Count + warn (once per port) about families outside the pinned
+        name surface. A real runtime serving different metric names used
+        to yield a clean, green, EMPTY exporter with nothing to diagnose
+        from; the warning and the doctor row are that diagnostic."""
+        self.unknown_family_samples[port] = (
+            self.unknown_family_samples.get(port, 0) + report.unknown)
+        if port in self._unknown_warned:
+            return
+        self._unknown_warned.add(port)
+        names = ", ".join(sorted(set(report.unknown_names)))
+        log.warning(
+            "libtpu port %d: %d payload(s) from metric families outside "
+            "the pinned name surface were ignored this tick (%s); if the "
+            "exporter is unexpectedly empty, this runtime speaks a "
+            "different metric-name surface — run `kube-tpu-stats doctor` "
+            "for the full list", port, report.unknown,
+            names or "run doctor for the names")
 
     # -- discovery ----------------------------------------------------------
 
@@ -427,10 +473,12 @@ class LibtpuCollector(Collector):
             decode_error: Exception | None = None
             for port, raw in raws:
                 try:
-                    dialect = self._ingest_response(
+                    report = self._ingest_response(
                         raw, cache, self._client.port_dialects.get(port)
                     )
-                    self._client.note_dialect(port, dialect, raw)
+                    self._client.note_dialect(port, report.dialect, raw)
+                    if report.unknown:
+                        self._note_unknown(port, report)
                 except (ValueError, OverflowError) as exc:
                     # ValueError: different schema / garbled port;
                     # OverflowError: int(inf) on a counter metric.

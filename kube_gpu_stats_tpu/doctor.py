@@ -117,15 +117,17 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
         cache: dict[int, dict] = {}
         decode_failures = 0
         ambiguous_discards = 0
+        alien_names: set[str] = set()
         for rport, raw in raws:
             try:
-                dialect = ingest_response_py(raw, cache,
-                                             client.port_dialects.get(rport))
+                report = ingest_response_py(raw, cache,
+                                            client.port_dialects.get(rport))
             except (ValueError, OverflowError):
                 decode_failures += 1
                 continue
-            client.note_dialect(rport, dialect, raw)
-            if dialect == tpumetrics.AMBIGUOUS and raw:
+            client.note_dialect(rport, report.dialect, raw)
+            alien_names.update(report.unknown_names)
+            if report.dialect == tpumetrics.AMBIGUOUS and raw:
                 ambiguous_discards += 1
         if cache:
             families: set[str] = set()
@@ -136,11 +138,31 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                 if entry["collectives"] is not None:
                     families.add("collectives")
             dialect = client.port_dialects.get(port, "unknown")
+            alien_note = (
+                f"; ignoring {len(alien_names)} unrecognized famil"
+                f"{'y' if len(alien_names) == 1 else 'ies'}: "
+                + ", ".join(sorted(alien_names))
+                if alien_names else ""
+            )
             return _result(
                 name, OK,
                 f"{len(cache)} chip(s), {len(families)} famil"
                 f"{'y' if len(families) == 1 else 'ies'} via batched fetch, "
-                f"{dialect} dialect",
+                f"{dialect} dialect{alien_note}",
+            )
+        if alien_names:
+            # The port answers, but EVERY family it serves is outside our
+            # pinned name surface: the exporter would be green and empty.
+            # Name the families so the mismatch diagnoses itself (round-2
+            # verdict item 6).
+            return _result(
+                name, FAIL,
+                "responds, but every served metric family is outside the "
+                "pinned name surface: "
+                + ", ".join(sorted(alien_names))
+                + " — runtime speaking a different metric-name surface; "
+                  "the exporter will be empty until proto/tpumetrics.py "
+                  "is re-pinned",
             )
         if decode_failures:
             return _result(

@@ -600,7 +600,7 @@ done:
 // happen before folding (matching _decode_tpumetric, which records metric
 // windows and decodes them once the name is known).
 int ingest_tpumetric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
-                     PyObject* cache) {
+                     PyObject* cache, long* unknown) {
   const uint8_t* name_p = nullptr;
   Py_ssize_t name_len = 0;
 
@@ -660,6 +660,8 @@ int ingest_tpumetric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
     if (field == 3 && wire == 2) {
       uint64_t length;
       if (!decode_varint(data, end, &pos, &length)) return -1;
+      if (kind < 0 && name_len > 0)
+        ++*unknown;  // one per dropped metric, matching the Python count
       if (ingest_metric_nested(data, pos, pos + (Py_ssize_t)length, cache,
                                kind, schema_name) < 0)
         return -1;
@@ -678,7 +680,7 @@ int ingest_tpumetric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
 // Parse one Metric message in data[pos:end) and fold it into cache.
 // Returns 0 on success, -1 with a Python exception set on error.
 int ingest_metric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
-                  PyObject* cache) {
+                  PyObject* cache, long* unknown) {
   const uint8_t* name_p = nullptr;
   Py_ssize_t name_len = 0;
   const uint8_t* link_p = nullptr;
@@ -787,7 +789,10 @@ int ingest_metric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
   // Classify the metric name: ici / collectives / value_map / unknown.
   PyObject* schema_name = nullptr;  // borrowed (value_map entry)
   int kind = classify_name(name_p, name_len, &schema_name);
-  if (kind < 0) return 0;  // runtime newer than our pin — ignore
+  if (kind < 0) {
+    if (name_len > 0) ++*unknown;  // family outside the pin — count, drop
+    return 0;
+  }
 
   PyObject* dev_key = PyLong_FromLongLong(device_id);
   if (!dev_key) return -1;
@@ -822,10 +827,11 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
   }
   if (dialect == 2) {  // ambiguous: scan validated every byte, nothing to fold
     PyBuffer_Release(&buf);
-    return Py_BuildValue("(li)", 0L, 2);
+    return Py_BuildValue("(lil)", 0L, 2, 0L);
   }
   Py_ssize_t pos = 0;
   long n = 0;
+  long unknown = 0;
   while (pos < end) {
     uint64_t key;
     if (!decode_varint(data, end, &pos, &key)) {
@@ -847,9 +853,9 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
       }
       int rc = dialect
                    ? ingest_tpumetric(data, pos, pos + (Py_ssize_t)length,
-                                      cache)
+                                      cache, &unknown)
                    : ingest_metric(data, pos, pos + (Py_ssize_t)length,
-                                   cache);
+                                   cache, &unknown);
       if (rc < 0) {
         PyBuffer_Release(&buf);
         return nullptr;
@@ -891,10 +897,12 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
     }
   }
   PyBuffer_Release(&buf);
-  // (entries folded, dialect 0=flat/1=nested/2=ambiguous): the caller
-  // latches the port's dialect from this — the scan already ran here, so
-  // reporting it avoids a second Python-side structural scan per tick.
-  return Py_BuildValue("(li)", n, dialect);
+  // (entries folded, dialect 0=flat/1=nested/2=ambiguous, unknown-family
+  // payload count): the caller latches the port's dialect from this — the
+  // scan already ran here, so reporting it avoids a second Python-side
+  // structural scan per tick — and surfaces name-surface mismatches that
+  // would otherwise present as a clean, green, empty exporter.
+  return Py_BuildValue("(lil)", n, dialect, unknown);
 }
 
 PyObject* py_configure(PyObject*, PyObject* args) {
@@ -936,9 +944,10 @@ PyMethodDef methods[] = {
      "configure(value_map: dict[bytes, str], ici_name: bytes, "
      "collectives_name: bytes) — pin the metric-name surface."},
     {"ingest", py_ingest, METH_VARARGS,
-     "ingest(data: bytes, cache: dict) -> (int, int) — decode a "
+     "ingest(data: bytes, cache: dict) -> (int, int, int) — decode a "
      "MetricResponse and fold every metric into cache; returns (entry "
-     "count, dialect 0=flat/1=nested/2=ambiguous)."},
+     "count, dialect 0=flat/1=nested/2=ambiguous, unknown-family payload "
+     "count)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_wirefast",

@@ -54,6 +54,10 @@ class FakeLibtpuServer:
         self.zero_omit = False
         self.scripted: dict[tuple[str, int], float] = {}
         self.drop_metrics: set[str] = set()
+        # Families served IN ADDITION to the pinned surface (name ->
+        # per-chip value): models a runtime speaking a different/newer
+        # metric-name surface (unknown-family visibility tests).
+        self.extra_metrics: dict[str, float] = {}
         self.requests: list[str] = []
         self._ici_fetches = 0
         self._lock = threading.Lock()
@@ -92,6 +96,8 @@ class FakeLibtpuServer:
     def _value(self, name: str, chip: int) -> float:
         if (name, chip) in self.scripted:
             return self.scripted[(name, chip)]
+        if name in self.extra_metrics:
+            return self.extra_metrics[name]
         if name == tpumetrics.DUTY_CYCLE:
             return 50.0 + chip
         if name == tpumetrics.TC_UTIL:
@@ -135,6 +141,8 @@ class FakeLibtpuServer:
         else:
             names = tuple(m for m in tpumetrics.ALL_METRICS
                           if m not in self.drop_metrics)
+            names += tuple(m for m in self.extra_metrics
+                           if m not in self.drop_metrics)
         for metric in names:
             if metric == tpumetrics.ICI_TRAFFIC:
                 with self._lock:

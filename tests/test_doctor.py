@@ -294,3 +294,30 @@ def test_doctor_reports_name_only_port_as_answering_not_unreachable():
     assert res.status == "warn"
     assert "name-only" in res.detail
     assert "unreachable" not in res.detail
+
+
+def test_doctor_names_alien_families():
+    """Round-2 verdict item 6 done-criterion: doctor against a fake
+    server speaking alien names must report them. Mixed surface -> OK
+    with an ignore note; alien-only surface -> FAIL naming every family
+    (the green-and-empty exporter now diagnoses itself)."""
+    from kube_gpu_stats_tpu.doctor import check_libtpu_port
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    with FakeLibtpuServer(num_chips=2) as mixed:
+        mixed.extra_metrics["tpu.runtime.novel.metric"] = 1.0
+        cfg = Config(backend="tpu", libtpu_ports=(mixed.port,))
+        res = check_libtpu_port(cfg, mixed.port)
+    assert res.status == "ok"
+    assert "ignoring 1 unrecognized family" in res.detail
+    assert "tpu.runtime.novel.metric" in res.detail
+
+    with FakeLibtpuServer(num_chips=2) as alien:
+        alien.drop_metrics.update(tpumetrics.ALL_METRICS)
+        alien.extra_metrics.update({
+            "tpu.v7.dutycycle": 50.0, "tpu.v7.hbm.used": 1.0})
+        cfg = Config(backend="tpu", libtpu_ports=(alien.port,))
+        res = check_libtpu_port(cfg, alien.port)
+    assert res.status == "fail"
+    assert "tpu.v7.dutycycle" in res.detail and "tpu.v7.hbm.used" in res.detail
+    assert "different metric-name surface" in res.detail

@@ -536,3 +536,31 @@ def test_dialect_relatches_when_runtime_restart_switches_builds():
         assert client.port_dialects == {server.port: tpumetrics.NESTED}
         assert samples and samples[0].value == 50.0  # still decodes right
         client.close()
+
+
+def test_unknown_families_counted_and_warned_once(caplog):
+    """Round-2 verdict item 6: a runtime serving families outside the
+    pinned name surface must not present as a silently-empty collector —
+    the drop is counted, warned once per port, and the known families
+    still ingest cleanly (no phantom cache entries from alien names)."""
+    import logging
+
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.extra_metrics["tpu.runtime.novel.percentile"] = 7.0
+        col = make_collector(server)
+        devs = col.discover()
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_gpu_stats_tpu.collectors.libtpu"):
+            for _ in range(3):
+                col.begin_tick()
+                col.wait_ready()
+        s = col.sample(devs[0])
+        assert s.values[schema.DUTY_CYCLE.name] == 50.0
+        assert not any("novel" in k for k in s.values)
+        port = server.port
+        # 2 chips x 1 alien family x 3 ticks
+        assert col.unknown_family_samples[port] == 6
+        warns = [r for r in caplog.records if "name surface" in r.message]
+        assert len(warns) == 1  # once per port, not per tick
+        assert "novel" in warns[0].message or "doctor" in warns[0].message
+        col.close()
