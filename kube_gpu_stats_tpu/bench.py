@@ -397,6 +397,7 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
             stop.wait(5.0)
             return None
         steps_before = collector._steps
+        busy_before = collector._busy_seconds
         window_start = time.monotonic()
         result = measure_collector(
             collector, ticks=ticks, warmup=warmup,
@@ -412,6 +413,11 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
         elapsed = time.monotonic() - window_start
         result["workload_steps_per_s_during_bench"] = round(
             (collector._steps - steps_before) / elapsed, 1) if elapsed else 0.0
+        # Busy fraction over the same window — the duty-cycle analog the
+        # embedded hook measures (≈1.0 while the burn loop runs).
+        result["workload_busy_fraction_during_bench"] = round(
+            (collector._busy_seconds - busy_before) / elapsed, 3
+        ) if elapsed else 0.0
         stop.wait(burn_seconds + 60.0)
         burner.join(timeout=5.0)
         return result

@@ -17,15 +17,17 @@ collects what in-process JAX can see without any gRPC surface:
 - HBM capacity, from memory_stats or a device-kind table;
 - a workload step hook (``exporter.record_step()``) exported as
   ``accelerator_workload_steps_total`` — the duty-cycle analog that in-
-  process code can report honestly.
+  process code can report honestly. Timed steps additionally feed
+  ``accelerator_workload_busy_seconds_total`` (rate() = busy fraction)
+  and the ``accelerator_workload_step_duration_seconds`` histogram.
 
 Usage (one call in the training script)::
 
     from kube_gpu_stats_tpu import embedded
     exporter = embedded.start(port=9400)        # or port=0 = pick free
     for batch in data:
-        step(batch)
-        exporter.record_step()
+        with exporter.step_timer():             # or exporter.record_step()
+            step(batch)
 
 The scrape surface, schema, labels, self-metrics and textfile output are
 identical to the daemon's — Prometheus cannot tell the modes apart, which
@@ -35,16 +37,17 @@ real-chip telemetry where no metric service is reachable).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
-from typing import Sequence
+from typing import Iterator, Sequence
 
 from . import schema, topology
 from .collectors import Collector, CollectorError, Device, Sample
 from .exposition import MetricsServer, RenderStats, TextfileWriter
 from .poll import PollLoop
-from .registry import Registry
+from .registry import HistogramState, Registry
 
 log = logging.getLogger(__name__)
 
@@ -85,7 +88,19 @@ class JaxIntrospectCollector(Collector):
 
         self._jax = jax
         self._start_monotonic = time.monotonic()
-        self._steps = 0  # int += under the GIL; single aggregate counter
+        # Workload-thread counters: += under the GIL. One workload thread
+        # reports steps in practice; concurrent reporters would only race
+        # the float add, never corrupt the exposition.
+        self._steps = 0
+        self._busy_seconds = 0.0
+        # Step-duration histogram, published to the poll thread by
+        # reference swap (HistogramState is immutable).
+        self._step_hist = HistogramState.empty(
+            schema.WORKLOAD_STEP_DURATION, schema.STEP_DURATION_BUCKETS
+        )
+        # Running per-device high-water mark for the live_arrays fallback
+        # (memory_stats-capable plugins report the runtime's own peak).
+        self._peak_live: dict[int, int] = {}
         self._devices = list(jax.local_devices())
         # memory_stats capability probed once: the axon/tunneled plugin
         # returns None, real Cloud TPU PJRT returns a dict.
@@ -97,8 +112,31 @@ class JaxIntrospectCollector(Collector):
 
     # -- workload hook -------------------------------------------------------
 
-    def record_step(self, n: int = 1) -> None:
+    def record_step(self, n: int = 1, seconds: float | None = None) -> None:
+        """Report n completed steps; ``seconds`` is the wall time they
+        took (feeds the busy counter and the step-duration histogram as
+        seconds/n per step)."""
         self._steps += n
+        if seconds is not None and n > 0:
+            self._busy_seconds += seconds
+            hist, per_step = self._step_hist, seconds / n
+            for _ in range(n):
+                hist = hist.observe(per_step)
+            self._step_hist = hist
+
+    @contextlib.contextmanager
+    def step_timer(self) -> Iterator[None]:
+        """Time one step: ``with collector.step_timer(): train_step()``."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_step(1, seconds=time.perf_counter() - start)
+
+    def extra_histograms(self) -> tuple[HistogramState, ...]:
+        """Poll-loop hook: fold the step-duration histogram into each
+        snapshot (see PollLoop._build_snapshot)."""
+        return (self._step_hist,)
 
     # -- Collector interface -------------------------------------------------
 
@@ -148,14 +186,22 @@ class JaxIntrospectCollector(Collector):
                 "bytes_reservable_limit")
             if limit:
                 values[schema.MEMORY_TOTAL.name] = float(limit)
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                values[schema.MEMORY_PEAK.name] = float(peak)
         else:
             live = self._live_bytes_by_device()
-            values[schema.MEMORY_USED.name] = float(live.get(device.index, 0))
+            used = live.get(device.index, 0)
+            values[schema.MEMORY_USED.name] = float(used)
+            peak = max(self._peak_live.get(device.index, 0), used)
+            self._peak_live[device.index] = peak
+            values[schema.MEMORY_PEAK.name] = float(peak)
             capacity = _kind_capacity(jdev.device_kind)
             if capacity is not None:
                 values[schema.MEMORY_TOTAL.name] = float(capacity)
         values[schema.UPTIME.name] = time.monotonic() - self._start_monotonic
         values[schema.WORKLOAD_STEPS.name] = float(self._steps)
+        values[schema.WORKLOAD_BUSY_SECONDS.name] = self._busy_seconds
         return Sample(device=device, values=values)
 
     def close(self) -> None:
@@ -199,8 +245,11 @@ class EmbeddedExporter:
     def port(self) -> int:
         return self.server.port
 
-    def record_step(self, n: int = 1) -> None:
-        self.collector.record_step(n)
+    def record_step(self, n: int = 1, seconds: float | None = None) -> None:
+        self.collector.record_step(n, seconds=seconds)
+
+    def step_timer(self) -> contextlib.AbstractContextManager[None]:
+        return self.collector.step_timer()
 
     def start(self) -> "EmbeddedExporter":
         self.server.start()

@@ -105,8 +105,8 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
              step_hook=None) -> int:
     """Drive the local chip(s) for `seconds`; returns steps executed.
     kernel: "xla" (jnp matmul chain) or "pallas" (hand-tiled MXU kernel).
-    step_hook(n): called per executed step — the embedded exporter's
-    workload-steps counter (embedded.EmbeddedExporter.record_step)."""
+    step_hook(n, seconds=dt): called per executed step with its wall time —
+    the embedded exporter's step hook (embedded.EmbeddedExporter.record_step)."""
     import jax
 
     import jax.numpy as jnp
@@ -127,12 +127,18 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
     start = time.monotonic()
     last_report = start
     inflight = 0
+    last_step_t = time.perf_counter()
     while time.monotonic() - start < seconds:
         x = step(x, w)
         steps += 1
         inflight += 1
         if step_hook is not None:
-            step_hook(1)
+            # Per-iteration wall time (dispatch + amortized sync) feeds the
+            # busy counter / step-duration histogram honestly: the burn
+            # loop never sleeps, so wall == busy here.
+            now_t = time.perf_counter()
+            step_hook(1, seconds=now_t - last_step_t)
+            last_step_t = now_t
         # Bound the async dispatch queue and force materialization before
         # trusting any rate: some backends defer execution until a value is
         # actually fetched, so an unbounded dispatch loop measures enqueue

@@ -438,6 +438,12 @@ class PollLoop:
             for name, value in procstats.read().items():
                 builder.add(by_self[name], value)
         builder.add_histogram(self._hist)
+        # Collector-owned histograms (embedded mode's step-duration family):
+        # published by reference swap on the workload thread, read here.
+        extra_hists = getattr(self._collector, "extra_histograms", None)
+        if extra_hists is not None:
+            for hist in extra_hists():
+                builder.add_histogram(hist)
         if self._render_stats is not None:
             self._render_stats(builder)
         return builder.build()

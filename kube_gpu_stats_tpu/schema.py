@@ -81,6 +81,13 @@ MEMORY_TOTAL = MetricSpec(
     MetricType.GAUGE,
     "Accelerator high-bandwidth memory capacity, in bytes.",
 )
+MEMORY_PEAK = MetricSpec(
+    "accelerator_memory_peak_bytes",
+    MetricType.GAUGE,
+    "High-water mark of accelerator memory allocated since the runtime "
+    "(re)initialized this chip, in bytes. The OOM-debugging companion to "
+    "accelerator_memory_used_bytes; a drop signals a runtime restart.",
+)
 MEMORY_BANDWIDTH_UTIL = MetricSpec(
     "accelerator_memory_bandwidth_utilization",
     MetricType.GAUGE,
@@ -159,11 +166,32 @@ WORKLOAD_STEPS = MetricSpec(
     "each device's label set. Only present in embedded mode.",
 )
 
+WORKLOAD_BUSY_SECONDS = MetricSpec(
+    "accelerator_workload_busy_seconds_total",
+    MetricType.COUNTER,
+    "Cumulative seconds the co-located workload reported spending inside "
+    "timed steps (embedded exporter's step_timer/record_step hook). "
+    "rate() of this counter is the workload-busy fraction — the honest "
+    "in-process analog of accelerator_duty_cycle, measured from the code "
+    "that owns the chip rather than the runtime. Only present in "
+    "embedded mode.",
+)
+
+WORKLOAD_STEP_DURATION = MetricSpec(
+    "accelerator_workload_step_duration_seconds",
+    MetricType.HISTOGRAM,
+    "Distribution of timed workload step durations reported via the "
+    "embedded exporter's step hook. Workload-global (SPMD steps span "
+    "every local device), so it carries no per-device labels. Only "
+    "present in embedded mode.",
+)
+
 PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     DUTY_CYCLE,
     TENSORCORE_UTIL,
     MEMORY_USED,
     MEMORY_TOTAL,
+    MEMORY_PEAK,
     MEMORY_BANDWIDTH_UTIL,
     POWER,
     TEMPERATURE,
@@ -175,7 +203,13 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     DEVICE_UP,
     PROCESS_OPEN,
     WORKLOAD_STEPS,
+    WORKLOAD_BUSY_SECONDS,
 )
+
+# Workload-global histogram families (embedded mode): enter snapshots via
+# the poll loop's collector extra_histograms() hook, not Sample.values, so
+# they live outside PER_DEVICE_METRICS (whose names key Sample.values).
+WORKLOAD_HISTOGRAMS: tuple[MetricSpec, ...] = (WORKLOAD_STEP_DURATION,)
 
 # DCN latency arrives from the runtime as one metric per percentile. Inside
 # a Sample.values mapping each percentile is carried under a *value key*
@@ -300,7 +334,9 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     PROCESS_START,
 )
 
-ALL_METRICS: tuple[MetricSpec, ...] = PER_DEVICE_METRICS + SELF_METRICS
+ALL_METRICS: tuple[MetricSpec, ...] = (
+    PER_DEVICE_METRICS + WORKLOAD_HISTOGRAMS + SELF_METRICS
+)
 
 # Default histogram buckets for collector_poll_duration_seconds. Chosen to
 # resolve the 50 ms budget from both sides.
@@ -312,6 +348,13 @@ POLL_DURATION_BUCKETS: tuple[float, ...] = (
 # than a full poll tick, so the range shifts down one decade.
 SCRAPE_DURATION_BUCKETS: tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+)
+
+# Buckets for accelerator_workload_step_duration_seconds: training/serving
+# steps span ~1 ms (small serving batches) to ~10 s (large-model training).
+STEP_DURATION_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
 )
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")

@@ -40,6 +40,41 @@ def test_sample_reports_live_array_memory_and_steps():
     del keepalive
 
 
+def test_step_timer_feeds_busy_counter_and_histogram():
+    import time
+
+    col = JaxIntrospectCollector()
+    with col.step_timer():
+        time.sleep(0.02)
+    col.record_step(2, seconds=0.5)  # two steps, 0.25 s each
+    devices = col.discover()
+    s = col.sample(devices[0])
+    assert s.values[schema.WORKLOAD_STEPS.name] == 3.0
+    busy = s.values[schema.WORKLOAD_BUSY_SECONDS.name]
+    assert 0.52 <= busy < 5.0
+    (hist,) = col.extra_histograms()
+    assert hist.total == 3
+    assert abs(hist.sum - busy) < 1e-9
+    # The two 0.25 s observations land in the (0.1, 0.25] bucket.
+    assert hist.counts[schema.STEP_DURATION_BUCKETS.index(0.25)] == 2
+
+
+def test_sample_reports_peak_memory_high_water_mark():
+    import jax.numpy as jnp
+
+    col = JaxIntrospectCollector()
+    devices = col.discover()
+    keepalive = jnp.ones((512, 512), jnp.float32)  # 1 MiB on device 0
+    high = col.sample(devices[0])
+    assert high.values[schema.MEMORY_PEAK.name] >= 1024 * 1024
+    del keepalive
+    jnp.zeros(()).block_until_ready()
+    low = col.sample(devices[0])
+    # Used drops with the allocation; the peak must not.
+    assert low.values[schema.MEMORY_PEAK.name] >= \
+        high.values[schema.MEMORY_PEAK.name]
+
+
 def test_kind_capacity_table():
     assert _kind_capacity("TPU v5 lite") == 16 * 1024**3
     assert _kind_capacity("TPU v5p chip") == 95 * 1024**3
@@ -63,7 +98,10 @@ def test_embedded_exporter_end_to_end():
             body = resp.read().decode()
         assert body.count("accelerator_up{") == 8
         assert "accelerator_workload_steps_total{" in body
+        assert "accelerator_workload_busy_seconds_total{" in body
         assert "accelerator_memory_used_bytes{" in body
+        assert "accelerator_memory_peak_bytes{" in body
+        assert "accelerator_workload_step_duration_seconds_bucket" in body
         assert 'backend="jax-embedded"' in body
         # Self-observability rides along like the daemon.
         assert "collector_poll_duration_seconds_bucket" in body
