@@ -1,12 +1,13 @@
 """CLI entry point (layer L5, SURVEY.md §1): `kube-tpu-stats` / `python -m
 kube_gpu_stats_tpu`.
 
-Bare flags run the exporter daemon (the DaemonSet entry point). Two
+Bare flags run the exporter daemon (the DaemonSet entry point). Three
 operational subcommands ride the same binary so a `kubectl exec` into the
 pod has them at hand:
 
     kube-tpu-stats doctor [exporter flags] [--json] [--url TARGET]
     kube-tpu-stats validate [--two-scrapes] <url-or-file>
+    kube-tpu-stats top [targets...] [--interval N] [--once] [--json]
 """
 
 from __future__ import annotations
@@ -28,6 +29,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         from .validate import main as validate_main
 
         return validate_main(args[1:])
+    if args and args[0] == "top":
+        from .top import main as top_main
+
+        return top_main(args[1:])
     return run(from_args(args))
 
 

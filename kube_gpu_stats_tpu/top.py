@@ -1,0 +1,296 @@
+"""`kube-tpu-stats top` — live per-chip terminal view over scrape targets.
+
+The nvidia-smi-shaped operator view the GPU exporter genre pairs with its
+DaemonSet (SURVEY.md §2 C5 analog; no reference file to cite — mount empty,
+SURVEY.md §0): point it at one or more exporter `/metrics` URLs (or saved
+`.prom` textfiles) and it renders a refreshing table of every chip those
+targets export — duty cycle, HBM, power, temperature, ICI traffic, the
+owning pod, and workload step rate.
+
+Counters (steps, busy-seconds) need two frames for a rate, so those
+columns fill in from the second refresh; `--once` prints a single frame
+with rates blank. `--json` emits one machine-readable frame per refresh
+(one JSON object per line) for scripting instead of the table.
+
+Works against the daemon, the embedded exporter, and any third-party
+exporter conforming to the unified `accelerator_*` schema
+(docs/UNIFIED_SCHEMA.md) — the view only assumes the schema contract.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import json
+import sys
+import time
+from typing import Mapping, Sequence
+
+from . import schema
+from .validate import fetch_exposition, parse_exposition
+
+DEFAULT_TARGET = "http://127.0.0.1:9400/metrics"
+
+# Families the table reads. Keyed by short column id.
+_GAUGES = {
+    "duty": schema.DUTY_CYCLE.name,
+    "mem_used": schema.MEMORY_USED.name,
+    "mem_total": schema.MEMORY_TOTAL.name,
+    "power": schema.POWER.name,
+    "temp": schema.TEMPERATURE.name,
+    "up": schema.DEVICE_UP.name,
+}
+_COUNTERS = {
+    "steps": schema.WORKLOAD_STEPS.name,
+    "busy": schema.WORKLOAD_BUSY_SECONDS.name,
+}
+
+
+@dataclasses.dataclass
+class ChipRow:
+    """One chip's latest values across every family the view renders.
+
+    Keyed by (target index, slice, worker, chip): per-node exporters only
+    export local chips, so chips from different targets are different
+    hardware even when their topology labels are identical or empty —
+    without the target in the key, two dev-VM embedded exporters (all
+    labels "") would silently fold into one chimera row."""
+
+    key: tuple[int, str, str, str]
+    at: float = 0.0  # this target's fetch timestamp (rate denominator)
+    accel_type: str = ""
+    pod: str = ""
+    namespace: str = ""
+    up: float | None = None
+    duty: float | None = None
+    mem_used: float | None = None
+    mem_total: float | None = None
+    power: float | None = None
+    temp: float | None = None
+    ici_bps: float = 0.0  # summed over links
+    holders: int = 0  # accelerator_process_open series (excl. overflow fold)
+    # Raw counter values; rates derive from frame-over-frame deltas.
+    steps_total: float | None = None
+    busy_total: float | None = None
+    # Filled by Frame.rates():
+    steps_per_s: float | None = None
+    busy_pct: float | None = None
+
+
+class Frame:
+    """One fetch round over every target."""
+
+    def __init__(self, rows: dict[tuple, ChipRow], errors: list[str]) -> None:
+        self.rows = rows
+        self.errors = errors
+
+    def rates(self, previous: "Frame | None") -> None:
+        if previous is None:
+            return
+        for key, row in self.rows.items():
+            prev = previous.rows.get(key)
+            if prev is None:
+                continue
+            # Per-target timestamps: a slow sibling target must not skew
+            # this target's counter-delta denominator.
+            dt = row.at - prev.at
+            if dt <= 0:
+                continue
+            if (row.steps_total is not None and prev.steps_total is not None
+                    and row.steps_total >= prev.steps_total):
+                row.steps_per_s = (row.steps_total - prev.steps_total) / dt
+            if (row.busy_total is not None and prev.busy_total is not None
+                    and row.busy_total >= prev.busy_total):
+                row.busy_pct = min(
+                    100.0, 100.0 * (row.busy_total - prev.busy_total) / dt)
+
+
+def build_frame(texts: Sequence[str], errors: list[str],
+                ats: Sequence[float] | None = None) -> Frame:
+    """Fold parsed exposition text from every target into chip rows.
+    ``ats[i]`` is target i's fetch timestamp (defaults to now)."""
+    rows: dict[tuple, ChipRow] = {}
+    now = time.monotonic()
+
+    by_id = {name: col for col, name in _GAUGES.items()}
+    counter_by_id = {name: col for col, name in _COUNTERS.items()}
+    for tidx, text in enumerate(texts):
+        at = ats[tidx] if ats is not None else now
+
+        def row(labels: Mapping[str, str]) -> ChipRow:
+            key = (tidx, labels.get("slice", ""), labels.get("worker", ""),
+                   labels.get("chip", ""))
+            r = rows.get(key)
+            if r is None:
+                r = rows[key] = ChipRow(key, at=at)
+            if labels.get("accel_type"):
+                r.accel_type = labels["accel_type"]
+            if labels.get("pod"):
+                r.pod = labels["pod"]
+                r.namespace = labels.get("namespace", "")
+            return r
+
+        try:
+            series = parse_exposition(text)
+        except ValueError as exc:
+            errors.append(str(exc))
+            continue
+        for name, labels, value in series:
+            if not name.startswith("accelerator_"):
+                continue
+            col = by_id.get(name)
+            if col is not None:
+                setattr(row(labels), col, value)
+                continue
+            col = counter_by_id.get(name)
+            if col is not None:
+                setattr(row(labels), f"{col}_total", value)
+                continue
+            if name == schema.ICI_BANDWIDTH.name:
+                row(labels).ici_bps += value
+            elif name == schema.PROCESS_OPEN.name:
+                if labels.get("comm") != "_overflow":
+                    row(labels).holders += 1
+    return Frame(rows, errors)
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _fmt_bytes(n: float | None) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "Ki", "Mi", "Gi", "Ti"):
+        if abs(n) < 1024 or unit == "Ti":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "?"
+
+
+def _fmt(v: float | None, pattern: str = "{:.1f}") -> str:
+    return "-" if v is None else pattern.format(v)
+
+
+_HEADER = (f"{'CHIP':<10} {'TYPE':<10} {'UP':<3} {'DUTY%':>6} {'BUSY%':>6} "
+           f"{'MEM USED/TOTAL':>17} {'MEM%':>5} {'PWR W':>6} {'TEMP':>5} "
+           f"{'ICI B/s':>9} {'STEP/S':>7} {'PROC':>4}  POD")
+
+
+def render_table(frame: Frame) -> str:
+    lines = []
+    slices = sorted({k[1] for k in frame.rows})
+    stamp = time.strftime("%H:%M:%S")
+    up = sum(1 for r in frame.rows.values() if r.up == 1.0)
+    lines.append(
+        f"kube-tpu-stats top  {stamp}  chips: {len(frame.rows)} "
+        f"({up} up)  slices: {', '.join(s or '-' for s in slices)}")
+    lines.append(_HEADER)
+    for key in sorted(frame.rows, key=lambda k: (k[1], _numeric(k[2]),
+                                                 _numeric(k[3]), k[0])):
+        r = frame.rows[key]
+        chip = f"{key[3]}" + (f"/w{key[2]}" if key[2] else "")
+        mem = f"{_fmt_bytes(r.mem_used)}/{_fmt_bytes(r.mem_total)}"
+        mem_pct = ("-" if not r.mem_total or r.mem_used is None
+                   else f"{100 * r.mem_used / r.mem_total:.0f}")
+        pod = f"{r.namespace}/{r.pod}" if r.pod else "-"
+        lines.append(
+            f"{chip:<10} {r.accel_type:<10} "
+            f"{'ok' if r.up == 1.0 else ('DN' if r.up == 0.0 else '-'):<3} "
+            f"{_fmt(r.duty):>6} {_fmt(r.busy_pct):>6} {mem:>17} "
+            f"{mem_pct:>5} {_fmt(r.power):>6} {_fmt(r.temp, '{:.0f}'):>5} "
+            f"{_fmt_bytes(r.ici_bps if r.ici_bps else None):>9} "
+            f"{_fmt(r.steps_per_s):>7} {r.holders or '-':>4}  {pod}")
+    for err in frame.errors:
+        lines.append(f"! {err}")
+    return "\n".join(lines)
+
+
+def _numeric(s: str):
+    try:
+        return (0, int(s))
+    except ValueError:
+        return (1, s)
+
+
+def render_json(frame: Frame) -> str:
+    rows = []
+    for key in sorted(frame.rows):
+        r = frame.rows[key]
+        d = dataclasses.asdict(r)
+        d["target"], d["slice"], d["worker"], d["chip"] = key
+        del d["key"], d["at"]
+        rows.append(d)
+    return json.dumps({"chips": rows, "errors": frame.errors})
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def snapshot_frame(targets: Sequence[str], previous: Frame | None) -> Frame:
+    """Fetch every target concurrently (one slow target must not stall
+    the others or skew their rate windows) and fold into a Frame. Any
+    fetch/decode failure becomes an error line, never a crash — this is
+    a long-running terminal view."""
+    errors: list[str] = []
+    texts: list[str] = []
+    ats: list[float] = []
+
+    def fetch(target: str) -> tuple[str, float]:
+        text = fetch_exposition(target, timeout=5.0)
+        return text, time.monotonic()
+
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(16, len(targets))
+    ) as pool:
+        for target, future in [(t, pool.submit(fetch, t)) for t in targets]:
+            try:
+                text, at = future.result()
+                texts.append(text)
+                ats.append(at)
+            except Exception as exc:  # noqa: BLE001 - rendered, not raised
+                errors.append(f"{target}: {exc}")
+    frame = build_frame(texts, errors, ats)
+    frame.rates(previous)
+    return frame
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="kube-tpu-stats top",
+        description="live per-chip view over exporter scrape targets")
+    parser.add_argument("targets", nargs="*", default=None,
+                        help=f"metric URLs or .prom files "
+                             f"(default {DEFAULT_TARGET})")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--once", action="store_true",
+                        help="print one frame and exit (rates blank)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="one JSON frame per line instead of the table")
+    parser.add_argument("--no-clear", action="store_true",
+                        help="append frames instead of clearing the screen")
+    args = parser.parse_args(argv)
+    targets = args.targets or [DEFAULT_TARGET]
+
+    previous: Frame | None = None
+    try:
+        while True:
+            frame = snapshot_frame(targets, previous)
+            if not frame.rows and frame.errors and previous is None:
+                for err in frame.errors:
+                    print(f"! {err}", file=sys.stderr)
+                if args.once:
+                    return 2
+            out = render_json(frame) if args.as_json else render_table(frame)
+            if not (args.once or args.as_json or args.no_clear):
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(out, flush=True)
+            if args.once:
+                return 0
+            previous = frame
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""`kube-tpu-stats top` — the live per-chip operator view (cli.py). Frames
+are built from real rendered snapshots (mock collector through the real
+poll loop + registry) so the view is pinned to the actual exposition, not
+hand-written fixture text."""
+
+import json
+
+from kube_gpu_stats_tpu import schema, top
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+
+
+def rendered(worker="0", ticks=2):
+    reg = Registry()
+    loop = PollLoop(
+        MockCollector(num_devices=2, accel_type="tpu-v5p"),
+        reg,
+        deadline=5.0,
+        topology_labels={"slice": "v5p-16", "worker": worker,
+                         "topology": "2x2x4"},
+    )
+    for _ in range(ticks):
+        loop.tick()
+    loop.stop()
+    return reg.snapshot().render()
+
+
+def test_build_frame_folds_targets_into_chip_rows():
+    frame = top.build_frame([rendered("0"), rendered("1")], [], ats=[0.0, 0.0])
+    assert len(frame.rows) == 4  # 2 workers x 2 chips
+    row = frame.rows[(0, "v5p-16", "0", "0")]
+    assert row.accel_type == "tpu-v5p"
+    assert row.up == 1.0
+    assert row.duty is not None and 0.0 <= row.duty <= 100.0
+    assert row.mem_total and row.mem_used is not None
+    assert row.ici_bps > 0  # mock exports per-link rates from tick 2
+
+
+def test_rates_need_two_frames():
+    text_a = (
+        'accelerator_workload_steps_total{chip="0",worker="0",slice="s"} 100\n'
+        'accelerator_workload_busy_seconds_total{chip="0",worker="0",slice="s"} 5\n'
+    )
+    text_b = (
+        'accelerator_workload_steps_total{chip="0",worker="0",slice="s"} 150\n'
+        'accelerator_workload_busy_seconds_total{chip="0",worker="0",slice="s"} 9\n'
+    )
+    first = top.build_frame([text_a], [], ats=[100.0])
+    first.rates(None)
+    row = first.rows[(0, "s", "0", "0")]
+    assert row.steps_per_s is None and row.busy_pct is None
+    second = top.build_frame([text_b], [], ats=[110.0])
+    second.rates(first)
+    row = second.rows[(0, "s", "0", "0")]
+    assert row.steps_per_s == 5.0
+    assert row.busy_pct == 40.0
+
+
+def test_counter_reset_yields_no_rate():
+    before = top.build_frame(
+        ['accelerator_workload_steps_total{chip="0",worker="",slice=""} 100\n'],
+        [], ats=[0.0])
+    after = top.build_frame(
+        ['accelerator_workload_steps_total{chip="0",worker="",slice=""} 3\n'],
+        [], ats=[10.0])
+    after.rates(before)
+    assert after.rows[(0, "", "", "0")].steps_per_s is None
+
+
+def test_render_table_shows_every_chip_and_pod():
+    text = rendered().replace('pod=""', 'pod="train-abc"').replace(
+        'namespace=""', 'namespace="ml"')
+    frame = top.build_frame([text], [], ats=[0.0])
+    out = top.render_table(frame)
+    assert "CHIP" in out and "DUTY%" in out
+    assert "0/w0" in out and "1/w0" in out
+    assert "tpu-v5p" in out
+    assert "ml/train-abc" in out
+    assert "chips: 2 (2 up)" in out
+
+
+def test_render_json_frame():
+    frame = top.build_frame([rendered()], [], ats=[0.0])
+    parsed = json.loads(top.render_json(frame))
+    assert len(parsed["chips"]) == 2
+    chip = parsed["chips"][0]
+    assert chip["chip"] == "0" and chip["slice"] == "v5p-16"
+    assert chip["up"] == 1.0 and "steps_per_s" in chip
+
+
+def test_process_open_counts_holders_excluding_overflow_fold():
+    text = (
+        'accelerator_process_open{chip="0",worker="",slice="",pid="1",comm="a"} 1\n'
+        'accelerator_process_open{chip="0",worker="",slice="",pid="2",comm="b"} 1\n'
+        'accelerator_process_open{chip="0",worker="",slice="",pid="",comm="_overflow"} 7\n'
+    )
+    frame = top.build_frame([text], [], ats=[0.0])
+    assert frame.rows[(0, "", "", "0")].holders == 2
+
+
+def test_identical_labels_from_two_targets_stay_distinct():
+    """Two dev-VM embedded exporters with empty topology labels must not
+    fold into one chimera row — the target index keys them apart."""
+    text = 'accelerator_up{chip="0",worker="",slice="",accel_type="tpu-v5e"} 1\n'
+    frame = top.build_frame([text, text], [], ats=[0.0, 0.0])
+    assert len(frame.rows) == 2
+    assert {k[0] for k in frame.rows} == {0, 1}
+
+
+def test_validate_accepts_embedded_exposition():
+    """The embedded exporter's own output (incl. the workload step
+    histogram) must pass the schema validator it ships next to."""
+    from kube_gpu_stats_tpu import validate
+
+    text = rendered() + (
+        'accelerator_workload_step_duration_seconds_bucket{le="0.001"} 2\n'
+        'accelerator_workload_step_duration_seconds_bucket{le="+Inf"} 3\n'
+        'accelerator_workload_step_duration_seconds_sum 1.5\n'
+        'accelerator_workload_step_duration_seconds_count 3\n'
+    )
+    assert validate.check(text) == []
+
+
+def test_main_once_against_prom_file(tmp_path, capsys):
+    prom = tmp_path / "snap.prom"
+    prom.write_text(rendered())
+    assert top.main([str(prom), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "0/w0" in out and "DUTY%" in out
+
+
+def test_main_once_json_against_http(tmp_path, capsys):
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    loop.stop()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        rc = top.main([f"http://127.0.0.1:{server.port}/metrics",
+                       "--once", "--json"])
+    finally:
+        server.stop()
+    assert rc == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert len(parsed["chips"]) == 1
+
+
+def test_main_once_unreachable_target_exits_2(capsys):
+    assert top.main(["http://127.0.0.1:1/metrics", "--once"]) == 2
+    assert "!" in capsys.readouterr().err
+
+
+def test_cli_dispatches_top(tmp_path, capsys):
+    from kube_gpu_stats_tpu.cli import main
+
+    prom = tmp_path / "snap.prom"
+    prom.write_text(rendered())
+    assert main(["top", str(prom), "--once"]) == 0
+    assert "CHIP" in capsys.readouterr().out
+
+
+def test_top_reads_schema_families_it_claims():
+    """The column map must reference real schema names only."""
+    known = {m.name for m in schema.ALL_METRICS}
+    for name in list(top._GAUGES.values()) + list(top._COUNTERS.values()):
+        assert name in known
