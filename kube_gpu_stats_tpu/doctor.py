@@ -431,7 +431,7 @@ def check_scrape(target: str) -> CheckResult:
     import urllib.error
 
     try:
-        text = validate._fetch(target)
+        text = validate.fetch_exposition(target)
     except urllib.error.HTTPError as exc:
         if exc.code in (401, 403):
             # The exporter's own shipped hardening (--auth-username): the

@@ -119,10 +119,7 @@ class JaxIntrospectCollector(Collector):
         self._steps += n
         if seconds is not None and n > 0:
             self._busy_seconds += seconds
-            hist, per_step = self._step_hist, seconds / n
-            for _ in range(n):
-                hist = hist.observe(per_step)
-            self._step_hist = hist
+            self._step_hist = self._step_hist.observe(seconds / n, count=n)
 
     @contextlib.contextmanager
     def step_timer(self) -> Iterator[None]:

@@ -105,8 +105,9 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
              step_hook=None) -> int:
     """Drive the local chip(s) for `seconds`; returns steps executed.
     kernel: "xla" (jnp matmul chain) or "pallas" (hand-tiled MXU kernel).
-    step_hook(n, seconds=dt): called per executed step with its wall time —
-    the embedded exporter's step hook (embedded.EmbeddedExporter.record_step)."""
+    step_hook(n, seconds=dt): called at each materialization point with the
+    steps since the last call and their combined wall time — the embedded
+    exporter's step hook (embedded.EmbeddedExporter.record_step)."""
     import jax
 
     import jax.numpy as jnp
@@ -127,18 +128,27 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
     start = time.monotonic()
     last_report = start
     inflight = 0
-    last_step_t = time.perf_counter()
+    pending_steps = 0
+    last_hook_t = time.perf_counter()
+
+    def report_pending():
+        # Steps are dispatched asynchronously, so per-iteration wall time
+        # is enqueue latency, not device time. Report to the hook only at
+        # materialization points: the batch wall time divided over the
+        # batch is the honest per-step duration, and the burn loop never
+        # sleeps so wall == busy.
+        nonlocal pending_steps, last_hook_t
+        now_t = time.perf_counter()
+        if step_hook is not None and pending_steps:
+            step_hook(pending_steps, seconds=now_t - last_hook_t)
+        pending_steps = 0
+        last_hook_t = now_t
+
     while time.monotonic() - start < seconds:
         x = step(x, w)
         steps += 1
         inflight += 1
-        if step_hook is not None:
-            # Per-iteration wall time (dispatch + amortized sync) feeds the
-            # busy counter / step-duration histogram honestly: the burn
-            # loop never sleeps, so wall == busy here.
-            now_t = time.perf_counter()
-            step_hook(1, seconds=now_t - last_step_t)
-            last_step_t = now_t
+        pending_steps += 1
         # Bound the async dispatch queue and force materialization before
         # trusting any rate: some backends defer execution until a value is
         # actually fetched, so an unbounded dispatch loop measures enqueue
@@ -146,10 +156,12 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
         if inflight >= 32:
             float(jnp.sum(x))
             inflight = 0
+            report_pending()
         now = time.monotonic()
         if now - last_report >= report_every:
             float(jnp.sum(x))
             inflight = 0
+            report_pending()
             now = time.monotonic()
             rate = steps / (now - start)
             flops = 2 * matmuls_per_step * size**3 * rate
@@ -157,6 +169,7 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
                   f"~{flops / 1e12:.2f} TFLOP/s", flush=True)
             last_report = now
     float(jnp.sum(x))
+    report_pending()
     return steps
 
 

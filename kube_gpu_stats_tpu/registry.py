@@ -71,17 +71,20 @@ class HistogramState:
         return HistogramState(spec, tuple(buckets), (0,) * (len(buckets) + 1),
                               0, 0.0, tuple(labels))
 
-    def observe(self, value: float) -> "HistogramState":
+    def observe(self, value: float, count: int = 1) -> "HistogramState":
+        """Record `count` observations of `value` (weighted observe: one
+        allocation regardless of count — batched reporters like
+        embedded.record_step(n, seconds) fold n same-valued steps)."""
         counts = list(self.counts)
         for i, bound in enumerate(self.buckets):
             if value <= bound:
-                counts[i] += 1
+                counts[i] += count
                 break
         else:
-            counts[-1] += 1
+            counts[-1] += count
         return HistogramState(
-            self.spec, self.buckets, tuple(counts), self.total + 1,
-            self.sum + value, self.labels
+            self.spec, self.buckets, tuple(counts), self.total + count,
+            self.sum + value * count, self.labels
         )
 
     def quantile(self, q: float) -> float:

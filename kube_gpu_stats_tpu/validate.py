@@ -63,12 +63,34 @@ def check(text: str, previous: str | None = None) -> list[str]:
         return [str(exc)]
 
     specs = {m.name: m for m in schema.ALL_METRICS}
+    # Histogram families render as <name>_bucket/_sum/_count; map each
+    # rendered name back to its spec. Workload histograms are global
+    # (schema.WORKLOAD_HISTOGRAMS): no per-device base labels, only "le".
+    hist_suffix: dict[str, tuple[schema.MetricSpec, bool]] = {}
+    for m in schema.ALL_METRICS:
+        if m.type is schema.MetricType.HISTOGRAM:
+            hist_suffix[f"{m.name}_bucket"] = (m, True)
+            hist_suffix[f"{m.name}_sum"] = (m, False)
+            hist_suffix[f"{m.name}_count"] = (m, False)
     required = set(schema.ALL_BASE_LABELS)
     seen_identities: set[tuple] = set()
     for name, labels, value in series:
         if name.startswith("accelerator_"):
+            hist = hist_suffix.get(name)
+            if hist is not None:
+                spec, is_bucket = hist
+                allowed = set(spec.extra_labels) | ({"le"} if is_bucket else set())
+                unexpected = set(labels) - allowed
+                if unexpected:
+                    problems.append(
+                        f"{name}: unexpected labels {sorted(unexpected)}")
+                identity = (name, tuple(sorted(labels.items())))
+                if identity in seen_identities:
+                    problems.append(f"{name}: duplicate series {labels}")
+                seen_identities.add(identity)
+                continue
             spec = specs.get(name)
-            if spec is None:
+            if spec is None or spec.type is schema.MetricType.HISTOGRAM:
                 problems.append(f"{name}: not in the accelerator_* contract")
                 continue
             missing = required - set(labels)
@@ -119,9 +141,11 @@ def _check_monotone(before: str, after: str, specs) -> Iterable[str]:
     return problems
 
 
-def _fetch(target: str) -> str:
+def fetch_exposition(target: str, timeout: float = 10.0) -> str:
+    """Read a scrape target: http(s) URL or a saved .prom file path.
+    Shared by this validator and the `top` view."""
     if target.startswith(("http://", "https://")):
-        with urllib.request.urlopen(target, timeout=10) as resp:
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
             return resp.read().decode()
     with open(target) as f:
         return f.read()
@@ -138,14 +162,14 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     target = args[0]
     try:
-        first = _fetch(target)
+        first = fetch_exposition(target)
         previous = None
         if two_scrapes:
             import time
 
             previous = first
             time.sleep(1.5)
-            first = _fetch(target)
+            first = fetch_exposition(target)
     except OSError as exc:
         print(f"fetch failed: {exc}", file=sys.stderr)
         return 2

@@ -103,6 +103,10 @@ def test_embedded_exporter_end_to_end():
         assert "accelerator_memory_peak_bytes{" in body
         assert "accelerator_workload_step_duration_seconds_bucket" in body
         assert 'backend="jax-embedded"' in body
+        # The embedded output must pass the shipped schema validator
+        # (review finding: histogram families once failed the contract).
+        from kube_gpu_stats_tpu import validate
+        assert validate.check(body) == []
         # Self-observability rides along like the daemon.
         assert "collector_poll_duration_seconds_bucket" in body
         with urllib.request.urlopen(
