@@ -35,6 +35,7 @@ class Config:
     remote_write_job: str = "kube-tpu-stats"
     remote_write_interval: float = 15.0
     remote_write_bearer_token_file: str = ""
+    remote_write_protocol: str = "1.0"  # 1.0 | 2.0 (415 downgrades to 1.0)
     sysfs_root: str = "/sys"
     proc_root: str = "/proc"
     device_processes: str = "on"  # accelerator_process_open scan (on|off)
@@ -117,6 +118,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("REMOTE_WRITE_BEARER_TOKEN_FILE", ""),
                    help="file with a bearer token for the receiver "
                         "(re-read per push; rotating tokens work)")
+    p.add_argument("--remote-write-protocol", choices=("1.0", "2.0"),
+                   default=_env("REMOTE_WRITE_PROTOCOL", "1.0"),
+                   help="remote-write wire protocol; 2.0 interns label "
+                        "strings and sends typed metadata, and falls "
+                        "back to 1.0 if the receiver answers 415")
     p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"))
     p.add_argument("--proc-root", default=_env("PROC_ROOT", "/proc"))
     p.add_argument("--device-processes", choices=("on", "off"),
@@ -262,6 +268,13 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         )
     if args.max_process_series < 1:
         parser.error("--max-process-series must be >= 1")
+    if args.remote_write_protocol not in ("1.0", "2.0"):
+        # argparse `choices` only validates CLI-supplied values; a bad
+        # KTS_REMOTE_WRITE_PROTOCOL env default would otherwise crash the
+        # daemon later with a traceback instead of a usage error.
+        parser.error(
+            f"--remote-write-protocol must be 1.0 or 2.0 "
+            f"(got {args.remote_write_protocol!r})")
     if bool(args.tls_cert_file) != bool(args.tls_key_file):
         parser.error("--tls-cert-file and --tls-key-file must be set together")
     if bool(args.auth_username) != bool(args.auth_password_sha256):
@@ -287,6 +300,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         remote_write_job=args.remote_write_job,
         remote_write_interval=args.remote_write_interval,
         remote_write_bearer_token_file=args.remote_write_bearer_token_file,
+        remote_write_protocol=args.remote_write_protocol,
         sysfs_root=args.sysfs_root,
         proc_root=args.proc_root,
         device_processes=args.device_processes,

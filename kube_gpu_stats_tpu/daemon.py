@@ -233,6 +233,7 @@ class Daemon:
                 job=cfg.remote_write_job,
                 min_interval=cfg.remote_write_interval,
                 bearer_token_file=cfg.remote_write_bearer_token_file,
+                protocol=cfg.remote_write_protocol,
                 render_stats=self.render_stats,
             )
 

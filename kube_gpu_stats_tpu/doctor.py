@@ -363,7 +363,10 @@ def check_remote_write(cfg: Config) -> CheckResult:
     from . import snappy
     from .remote_write import build_headers
 
-    headers = build_headers(cfg.remote_write_bearer_token_file)
+    # Probe with the protocol the daemon will actually use: a 2.0 config
+    # must negotiate 2.0 here, or doctor proves the wrong content type.
+    headers = build_headers(cfg.remote_write_bearer_token_file,
+                            cfg.remote_write_protocol)
     if headers is None:
         return _result(
             "remote-write", FAIL,

@@ -14,15 +14,22 @@ the retry — self-backoff via the pusher loop), never retry 4xx (drop and
 log: the payload is wrong, not the network). The exporter's gauges are
 trivially in-order because each push carries exactly one timestamp per
 series (the tick's publish time).
+
+Remote-write **2.0** (``io.prometheus.write.v2.Request``, proto/prompb2)
+is supported alongside 1.0: symbol-table interning sends every label
+string once per request instead of once per series, and each series
+carries typed metadata (gauge/counter/histogram + help). Per the 2.0
+spec, a 415 from the receiver downgrades the sender to 1.0 for the rest
+of the process lifetime.
 """
 
 from __future__ import annotations
 
 import logging
 
-from . import snappy
-from .proto import prompb
-from .registry import HistogramState, Registry, Snapshot, format_value
+from . import schema, snappy
+from .proto import prompb, prompb2
+from .registry import Registry, Snapshot, format_value
 from .workers import PublishFollower
 
 log = logging.getLogger(__name__)
@@ -34,13 +41,27 @@ HEADERS = {
     "User-Agent": "kube-tpu-stats",
 }
 
+HEADERS_V2 = {
+    "Content-Type": "application/x-protobuf;proto=io.prometheus.write.v2.Request",
+    "Content-Encoding": "snappy",
+    "X-Prometheus-Remote-Write-Version": "2.0.0",
+    "User-Agent": "kube-tpu-stats",
+}
 
-def build_headers(bearer_token_file: str = "") -> dict[str, str] | None:
+_V2_TYPES = {
+    schema.MetricType.GAUGE: prompb2.TYPE_GAUGE,
+    schema.MetricType.COUNTER: prompb2.TYPE_COUNTER,
+    schema.MetricType.HISTOGRAM: prompb2.TYPE_HISTOGRAM,
+}
+
+
+def build_headers(bearer_token_file: str = "",
+                  protocol: str = "1.0") -> dict[str, str] | None:
     """Remote-write request headers, or None when the configured token is
     unreadable — pushing unauthenticated would turn a transient token
     rotation into a permanent-looking 401 sample drop. Shared by the
     sender and doctor's receiver probe."""
-    headers = dict(HEADERS)
+    headers = dict(HEADERS_V2 if protocol == "2.0" else HEADERS)
     if bearer_token_file:
         try:
             # Re-read per push: mounted tokens rotate (k8s projected
@@ -53,42 +74,57 @@ def build_headers(bearer_token_file: str = "") -> dict[str, str] | None:
     return headers
 
 
-def _histogram_series(hist: HistogramState, labels, ts: int) -> list[bytes]:
-    name = hist.spec.name
-    out = []
-    cumulative = 0
-    for i, bound in enumerate(hist.buckets):
-        cumulative += hist.counts[i]
-        # format_value, not repr: the le string must match the scrape
-        # path's rendering or receivers see two distinct bucket series.
-        out.append(prompb.encode_series(
-            name + "_bucket", labels + [("le", format_value(bound))],
-            float(cumulative), ts,
-        ))
-    out.append(prompb.encode_series(
-        name + "_bucket", labels + [("le", "+Inf")], float(hist.total), ts))
-    out.append(prompb.encode_series(name + "_sum", labels, hist.sum, ts))
-    out.append(prompb.encode_series(
-        name + "_count", labels, float(hist.total), ts))
-    return out
-
-
-def build_write_request(snapshot: Snapshot, job: str, instance: str) -> bytes:
-    """Uncompressed WriteRequest for one snapshot: every series + expanded
-    histograms, each stamped with the snapshot's publish time and carrying
-    the target-identity labels (job/instance) the spec expects the sender
-    to provide."""
+def _snapshot_series(snapshot: Snapshot, job: str, instance: str):
+    """Yield every remote-written sample as (spec, name, labels, value,
+    ts_ms) — the one walk both wire protocols consume, so histogram
+    expansion can never drift between 1.0 and 2.0. Each sample is stamped
+    with the snapshot's publish time and carries the target-identity
+    labels (job/instance) the spec expects the sender to provide."""
     ts = int(snapshot.timestamp * 1000.0)
     identity = [("job", job), ("instance", instance)]
-    series = []
     for s in snapshot.series:
-        series.append(prompb.encode_series(
-            s.spec.name, identity + list(s.labels), s.value, ts))
+        yield s.spec, s.spec.name, identity + list(s.labels), s.value, ts
     for hist in snapshot.histograms:
         # hist.labels dimension the family (e.g. scrape duration per
         # output); they ride every expanded series like scrape rendering.
-        series.extend(_histogram_series(hist, identity + list(hist.labels), ts))
-    return prompb.encode_write_request(series)
+        spec = hist.spec
+        labels = identity + list(hist.labels)
+        bucket = spec.name + "_bucket"
+        cumulative = 0
+        for i, bound in enumerate(hist.buckets):
+            cumulative += hist.counts[i]
+            # format_value, not repr: the le string must match the scrape
+            # path's rendering or receivers see two distinct bucket series.
+            yield (spec, bucket, labels + [("le", format_value(bound))],
+                   float(cumulative), ts)
+        yield spec, bucket, labels + [("le", "+Inf")], float(hist.total), ts
+        yield spec, spec.name + "_sum", labels, hist.sum, ts
+        yield spec, spec.name + "_count", labels, float(hist.total), ts
+
+
+def build_write_request(snapshot: Snapshot, job: str, instance: str) -> bytes:
+    """Uncompressed 1.0 WriteRequest for one snapshot."""
+    return prompb.encode_write_request([
+        prompb.encode_series(name, labels, value, ts)
+        for _, name, labels, value, ts
+        in _snapshot_series(snapshot, job, instance)
+    ])
+
+
+def build_write_request_v2(snapshot: Snapshot, job: str,
+                           instance: str) -> bytes:
+    """Uncompressed 2.0 Request: same series set as 1.0 plus per-series
+    typed metadata, with every string interned once per request. Expanded
+    histogram series inherit TYPE_HISTOGRAM from their spec."""
+    table = prompb2.SymbolTable()
+    series = [
+        prompb2.encode_series(
+            table, name, labels, value, ts,
+            _V2_TYPES.get(spec.type, prompb2.TYPE_UNSPECIFIED), spec.help)
+        for spec, name, labels, value, ts
+        in _snapshot_series(snapshot, job, instance)
+    ]
+    return prompb2.encode_request(table, series)
 
 
 class RemoteWriter(PublishFollower):
@@ -102,18 +138,27 @@ class RemoteWriter(PublishFollower):
                  job: str = "kube-tpu-stats", instance: str = "",
                  min_interval: float = 15.0,
                  bearer_token_file: str = "",
+                 protocol: str = "1.0",
                  render_stats=None) -> None:
         import socket
 
+        if protocol not in ("1.0", "2.0"):
+            raise ValueError(f"remote-write protocol {protocol!r} "
+                             f"(use '1.0' or '2.0')")
         super().__init__(registry, min_interval, thread_name="remote-write")
         self._url = url
         self._job = job
         self._instance = instance or socket.gethostname()
         self._bearer_token_file = bearer_token_file
+        self._protocol = protocol
         self._render_stats = render_stats
 
+    @property
+    def protocol(self) -> str:
+        return self._protocol
+
     def _headers(self) -> dict[str, str] | None:
-        return build_headers(self._bearer_token_file)
+        return build_headers(self._bearer_token_file, self._protocol)
 
     def push_once(self) -> None:
         import urllib.error
@@ -130,8 +175,9 @@ class RemoteWriter(PublishFollower):
         import time
 
         serialize_start = time.monotonic()
-        body = snappy.compress(
-            build_write_request(snapshot, self._job, self._instance))
+        build = (build_write_request_v2 if self._protocol == "2.0"
+                 else build_write_request)
+        body = snappy.compress(build(snapshot, self._job, self._instance))
         if self._render_stats is not None:
             # prompb serialize + snappy: this path's render equivalent.
             self._render_stats.observe(
@@ -144,7 +190,17 @@ class RemoteWriter(PublishFollower):
             self.consecutive_failures = 0
             self.pushes_total += 1
         except urllib.error.HTTPError as exc:
-            if 400 <= exc.code < 500 and exc.code != 429:
+            if exc.code == 415 and self._protocol == "2.0":
+                # 2.0 spec: an unsupported-media-type receiver means it
+                # only speaks 1.0 — downgrade for the process lifetime
+                # rather than dropping every subsequent sample set. The
+                # next publish retries as 1.0.
+                self._protocol = "1.0"
+                self.consecutive_failures += 1
+                self.failures_total += 1
+                log.warning("receiver rejected remote-write 2.0 (HTTP 415); "
+                            "downgrading to 1.0")
+            elif 400 <= exc.code < 500 and exc.code != 429:
                 # Spec: 4xx (except 429) must not be retried.
                 self.dropped_total += 1
                 try:
