@@ -45,12 +45,16 @@ class Sample:
     ``ici_counters`` maps link name -> cumulative traffic bytes; the poll
     loop turns deltas into bandwidth gauges (C10 rate math lives OFF the
     collector so every backend gets wraparound handling for free).
+    ``raw_values`` maps runtime-native family names outside the pinned
+    schema -> value (libtpu passthrough mode, --passthrough-unknown); the
+    poll loop exports them as sanitized ``tpu_runtime_*`` gauges.
     """
 
     device: Device
     values: Mapping[str, float]
     ici_counters: Mapping[str, int] = dataclasses.field(default_factory=dict)
     collective_ops: int | None = None
+    raw_values: Mapping[str, float] = dataclasses.field(default_factory=dict)
 
 
 class CollectorError(RuntimeError):

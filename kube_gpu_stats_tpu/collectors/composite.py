@@ -31,6 +31,7 @@ class TpuCollector(Collector):
         use_native: bool = True,
         libtpu_client: LibtpuClient | None = None,
         rpc_timeout: float = 0.040,
+        passthrough_unknown: bool = False,
     ) -> None:
         self._sysfs = SysfsCollector(sysfs_root)
         if use_native:
@@ -40,6 +41,7 @@ class TpuCollector(Collector):
         self._libtpu = LibtpuCollector(
             libtpu_client, addr=libtpu_addr, ports=libtpu_ports,
             rpc_timeout=rpc_timeout,
+            passthrough_unknown=passthrough_unknown,
         )
 
     def discover(self) -> Sequence[Device]:
@@ -91,6 +93,7 @@ class TpuCollector(Collector):
         values: dict[str, float] = {}
         ici: dict[str, int] = {}
         collectives = None
+        raw: Mapping[str, float] = {}
         runtime_err = None
         try:
             if not runtime_ready:
@@ -99,10 +102,11 @@ class TpuCollector(Collector):
             values.update(runtime.values)
             ici.update(runtime.ici_counters)
             collectives = runtime.collective_ops
+            raw = runtime.raw_values
         except CollectorError as exc:
             runtime_err = exc
         values.update(sysfs_values)
-        if not values:
+        if not values and not raw:
             raise CollectorError(
                 f"chip {device.index}: libtpu: {runtime_err}; sysfs: {sysfs_err}"
             )
@@ -117,6 +121,7 @@ class TpuCollector(Collector):
             values=values,
             ici_counters=ici,
             collective_ops=collectives,
+            raw_values=raw,
         )
 
     def close(self) -> None:

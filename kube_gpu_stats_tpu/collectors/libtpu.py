@@ -17,7 +17,9 @@ cache refresh, not one hang per chip.
 from __future__ import annotations
 
 import concurrent.futures
+import functools
 import logging
+import math
 import threading
 from typing import Mapping, NamedTuple, Sequence
 
@@ -56,15 +58,31 @@ _VALUE_MAP: Mapping[str, str] = {
 }
 
 
-def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict]) -> None:
+def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict],
+                   passthrough: bool = False) -> None:
     """Fold one decoded metric into the per-device cache (the pure-Python
     reference for the fused native ingest — tests/test_wirefast.py pins the
     two paths byte-equivalent). Unknown names (runtime newer than our pin)
     are dropped BEFORE the entry is created: a device that only ever
-    reports unknown metrics must not materialize as a phantom chip."""
+    reports unknown metrics must not materialize as a phantom chip.
+
+    ``passthrough`` (--passthrough-unknown) reverses that drop: unknown
+    finite scalars land in the entry's ``raw`` dict — and an unknown-only
+    device DOES materialize, which is the point of the mode (a runtime
+    speaking a different name surface still yields data, not an empty
+    exporter)."""
     name = sample.name
     if (name != tpumetrics.ICI_TRAFFIC and name != tpumetrics.COLLECTIVES
             and name not in _VALUE_MAP):
+        if not passthrough or not name:
+            return
+        value = float(sample.value)
+        if math.isnan(value) or math.isinf(value):
+            return
+        entry = cache.setdefault(
+            sample.device_id, {"values": {}, "ici": {}, "collectives": None}
+        )
+        entry.setdefault("raw", {})[name] = value
         return
     entry = cache.setdefault(
         sample.device_id, {"values": {}, "ici": {}, "collectives": None}
@@ -92,14 +110,17 @@ class IngestReport(NamedTuple):
 
 
 def ingest_response_py(raw: bytes, cache: dict[int, dict],
-                       assume: str | None = None) -> IngestReport:
+                       assume: str | None = None,
+                       passthrough: bool = False) -> IngestReport:
     """Decode a MetricResponse and ingest every metric (Python fallback for
     the native _wirefast.ingest). All-or-nothing: staged into a scratch
     dict so an ingest-time error (e.g. int(NaN) on a counter metric) can't
     publish the response's leading metrics — same containment as the fused
     native wrapper. ``assume`` is the port's latched dialect (resolves
     structurally ambiguous name-only responses — see
-    tpumetrics.decode_response_ex)."""
+    tpumetrics.decode_response_ex). ``passthrough`` additionally folds
+    unknown families into per-device ``raw`` dicts (still reported as
+    unknown for visibility)."""
     staged: dict[int, dict] = {}
     samples, dialect = tpumetrics.decode_response_ex(raw, assume)
     unknown_names: list[str] = []
@@ -109,8 +130,7 @@ def ingest_response_py(raw: bytes, cache: dict[int, dict],
                 and name != tpumetrics.COLLECTIVES
                 and name not in _VALUE_MAP):
             unknown_names.append(name)
-            continue
-        _ingest_sample(s, staged)
+        _ingest_sample(s, staged, passthrough)
     _merge_cache(staged, cache)
     return IngestReport(dialect, len(unknown_names), tuple(unknown_names))
 
@@ -127,6 +147,9 @@ def _merge_cache(src: dict[int, dict], dst: dict[int, dict]) -> None:
             existing["ici"].update(entry["ici"])
             if entry["collectives"] is not None:
                 existing["collectives"] = entry["collectives"]
+            raw = entry.get("raw")
+            if raw:
+                existing.setdefault("raw", {}).update(raw)
 
 
 def _make_fused_ingest(wirefast):
@@ -350,7 +373,8 @@ class LibtpuCollector(Collector):
     def __init__(self, client: LibtpuClient | None = None, *,
                  addr: str = "127.0.0.1", ports: Sequence[int] = (8431,),
                  accel_type: str | None = None,
-                 rpc_timeout: float = 0.040) -> None:
+                 rpc_timeout: float = 0.040,
+                 passthrough_unknown: bool = False) -> None:
         self._client = client or LibtpuClient(addr, ports, rpc_timeout)
         self._accel_type = accel_type if accel_type is not None else topology.accel_type()
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -366,8 +390,17 @@ class LibtpuCollector(Collector):
         )
         self._inflight: concurrent.futures.Future | None = None
         # Fused native decode+ingest when built (native/wirefast.cc); the
-        # pure-Python path is the pinned-equivalent fallback.
-        self._ingest_response = _load_wirefast() or ingest_response_py
+        # pure-Python path is the pinned-equivalent fallback. Passthrough
+        # mode pins the Python path: the C scan drops unknown names by
+        # design (hot-path allocation freedom), and an operator running a
+        # name-surface-mismatched runtime has already traded speed for
+        # visibility by turning the mode on.
+        self._passthrough = passthrough_unknown
+        if passthrough_unknown:
+            self._ingest_response = functools.partial(
+                ingest_response_py, passthrough=True)
+        else:
+            self._ingest_response = _load_wirefast() or ingest_response_py
         self._lock = threading.Lock()
         self._cache: dict[int, dict] = {}
         self._cache_error: CollectorError | None = CollectorError(
@@ -401,12 +434,20 @@ class LibtpuCollector(Collector):
             return
         self._unknown_warned.add(port)
         names = ", ".join(sorted(set(report.unknown_names)))
+        if self._passthrough:
+            log.info(
+                "libtpu port %d: %d payload(s) from metric families "
+                "outside the pinned name surface are being exported as "
+                "tpu_runtime_* passthrough gauges (%s)", port,
+                report.unknown, names or "run doctor for the names")
+            return
         log.warning(
             "libtpu port %d: %d payload(s) from metric families outside "
             "the pinned name surface were ignored this tick (%s); if the "
             "exporter is unexpectedly empty, this runtime speaks a "
             "different metric-name surface — run `kube-tpu-stats doctor` "
-            "for the full list", port, report.unknown,
+            "for the full list, or set --passthrough-unknown on to "
+            "export them as tpu_runtime_* gauges", port, report.unknown,
             names or "run doctor for the names")
 
     # -- discovery ----------------------------------------------------------
@@ -414,17 +455,43 @@ class LibtpuCollector(Collector):
     def discover(self) -> Sequence[Device]:
         """Devices are whatever chips the runtime reports HBM capacity for.
         (When composed with sysfs, the sysfs enumeration wins and this is
-        unused.)"""
-        samples = self._client.get_metric(tpumetrics.HBM_TOTAL)
+        unused.) In passthrough mode an alien name surface must still
+        yield chips — the whole point of the mode — so when the pinned
+        HBM family fails, fall back to the batched fetch and take every
+        device id that reported ANY family, known or not."""
+        try:
+            samples = self._client.get_metric(tpumetrics.HBM_TOTAL)
+            ids = sorted({s.device_id for s in samples})
+        except CollectorError:
+            if not self._passthrough:
+                raise
+            ids = sorted(self._passthrough_discover_ids())
+            if not ids:
+                raise
         return [
             Device(
-                index=s.device_id,
-                device_id=str(s.device_id),
-                device_path=f"/dev/accel{s.device_id}",
+                index=device_id,
+                device_id=str(device_id),
+                device_path=f"/dev/accel{device_id}",
                 accel_type=self._accel_type,
             )
-            for s in sorted(samples, key=lambda s: s.device_id)
+            for device_id in ids
         ]
+
+    def _passthrough_discover_ids(self) -> set[int]:
+        """Device ids from a batched fetch ingested with passthrough —
+        discovery-time only, never the hot path."""
+        raws, _errors = self._client.get_raw_with_errors("")
+        cache: dict[int, dict] = {}
+        for port, raw in raws:
+            try:
+                report = ingest_response_py(
+                    raw, cache, self._client.port_dialects.get(port),
+                    passthrough=True)
+                self._client.note_dialect(port, report.dialect, raw)
+            except (ValueError, OverflowError):
+                continue
+        return set(cache)
 
     # -- hot path ------------------------------------------------------------
 
@@ -619,6 +686,7 @@ class LibtpuCollector(Collector):
             values=entry["values"],
             ici_counters=entry["ici"],
             collective_ops=entry["collectives"],
+            raw_values=entry.get("raw") or {},
         )
 
     def close(self) -> None:

@@ -39,6 +39,8 @@ class Config:
     sysfs_root: str = "/sys"
     proc_root: str = "/proc"
     device_processes: str = "on"  # accelerator_process_open scan (on|off)
+    passthrough_unknown: str = "off"  # export unknown libtpu families as
+    #                                   tpu_runtime_* gauges (on|off)
     max_process_series: int = 32  # process_open holders per device; excess
     #                               folds into one comm="_overflow" series
     libtpu_ports: tuple[int, ...] = (DEFAULT_LIBTPU_PORT,)
@@ -131,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "hold each device node; procfs scan on the "
                         "attribution cadence). In Kubernetes the pod needs "
                         "hostPID to see beyond its own namespace")
+    p.add_argument("--passthrough-unknown", choices=("on", "off"),
+                   default=_env("PASSTHROUGH_UNKNOWN", "off"),
+                   help="export libtpu metric families outside the pinned "
+                        "schema as tpu_runtime_* gauges (sanitized names, "
+                        "capped family count). For runtimes speaking a "
+                        "different metric-name surface; uses the Python "
+                        "decode path")
     p.add_argument("--max-process-series", type=int,
                    default=int(_env("MAX_PROCESS_SERIES", "32")),
                    help="max accelerator_process_open holders exported per "
@@ -304,6 +313,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         sysfs_root=args.sysfs_root,
         proc_root=args.proc_root,
         device_processes=args.device_processes,
+        passthrough_unknown=args.passthrough_unknown,
         max_process_series=args.max_process_series,
         libtpu_addr=args.libtpu_addr,
         libtpu_ports=parse_libtpu_ports(args.libtpu_ports),

