@@ -57,9 +57,10 @@ def main() -> int:
     }
     if "device_kind" in result:
         line["device_kind"] = result["device_kind"]
-    if "workload_steps_per_s_during_bench" in result:
-        line["workload_steps_per_s_during_bench"] = (
-            result["workload_steps_per_s_during_bench"])
+    for key in ("workload_steps_per_s_during_bench",
+                "workload_busy_fraction_during_bench"):
+        if key in result:
+            line[key] = result[key]
     print(json.dumps(line))
     return 0
 

@@ -308,6 +308,36 @@ panels = [
         description="Output volume per render path (post-compression). A "
                     "rising trend at constant scrape rate means series "
                     "growth — cardinality eating the scrape budget."),
+
+    # Row 10 — workload view (embedded-exporter step hook; absent unless
+    # a workload runs kube_gpu_stats_tpu.embedded).
+    timeseries(
+        "Workload step rate / busy fraction",
+        # max, not sum, by worker: in SPMD the counter rides every local
+        # device's labels with the same value — summing would overcount
+        # by the chip count.
+        [(f'max by (worker) (rate(accelerator_workload_steps_total{{{FILTERS}}}[2m]))',
+          'w{{worker}} steps/s'),
+         (f'max by (worker) (rate(accelerator_workload_busy_seconds_total{{{FILTERS}}}[2m]))',
+          'w{{worker}} busy fraction')],
+        "none", {"x": 0, "y": 68, "w": 12, "h": 8}, per_chip=False,
+        description="Embedded-mode workload hook: reported step rate and "
+                    "the fraction of wall time inside timed steps (the "
+                    "in-process duty-cycle analog)."),
+    timeseries(
+        "Workload step duration quantiles",
+        [('histogram_quantile(0.5, sum(rate(accelerator_workload_step_duration_seconds_bucket[5m])) by (le))', 'p50'),
+         ('histogram_quantile(0.99, sum(rate(accelerator_workload_step_duration_seconds_bucket[5m])) by (le))', 'p99')],
+        "s", {"x": 12, "y": 68, "w": 12, "h": 8}, per_chip=False,
+        description="Timed workload step durations (embedded step_timer)."),
+    timeseries(
+        "HBM peak (high-water mark) by chip",
+        [(f'accelerator_memory_peak_bytes{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}}')],
+        "bytes", {"x": 0, "y": 76, "w": 12, "h": 8},
+        description="Peak HBM allocated since runtime init — the OOM-"
+                    "debugging companion to HBM used; a drop marks a "
+                    "runtime restart."),
 ]
 
 dashboard = {

@@ -82,7 +82,9 @@ def _ingest_sample(sample: tpumetrics.MetricSample, cache: dict[int, dict],
         entry = cache.setdefault(
             sample.device_id, {"values": {}, "ici": {}, "collectives": None}
         )
-        entry.setdefault("raw", {})[name] = value
+        # Keyed by (family, link): an alien per-link family (ICI-style)
+        # must not collapse to whichever link decoded last.
+        entry.setdefault("raw", {})[(name, sample.link or "")] = value
         return
     entry = cache.setdefault(
         sample.device_id, {"values": {}, "ici": {}, "collectives": None}
@@ -459,15 +461,22 @@ class LibtpuCollector(Collector):
         yield chips — the whole point of the mode — so when the pinned
         HBM family fails, fall back to the batched fetch and take every
         device id that reported ANY family, known or not."""
+        error: CollectorError | None = None
         try:
             samples = self._client.get_metric(tpumetrics.HBM_TOTAL)
             ids = sorted({s.device_id for s in samples})
-        except CollectorError:
+        except CollectorError as exc:
             if not self._passthrough:
                 raise
+            error = exc
+            ids = []
+        if not ids and self._passthrough:
+            # Covers both failure AND empty success on the pinned family —
+            # an alien runtime may answer the unknown name with a clean
+            # zero-sample response rather than an error status.
             ids = sorted(self._passthrough_discover_ids())
-            if not ids:
-                raise
+            if not ids and error is not None:
+                raise error
         return [
             Device(
                 index=device_id,

@@ -119,12 +119,11 @@ class PollLoop:
         # tick over tick. Keyed by the attribution items so a pod churn
         # invalidates exactly that device's entry.
         self._label_cache: dict[str, tuple[tuple, list[tuple[str, str]]]] = {}
-        # Passthrough families (Sample.raw_values): runtime name ->
-        # dynamically-minted gauge spec, capped so a hostile/buggy runtime
-        # can't mint unbounded series (or, via unique-name churn, grow
-        # this dict unboundedly — over-cap names are NOT memoized).
-        self._raw_specs: dict[str, schema.MetricSpec] = {}
-        self._raw_names_used: set[str] = set()
+        # Passthrough families (Sample.raw_values) admitted so far, capped
+        # so a hostile/buggy runtime can't mint unbounded series or grow
+        # this set unboundedly via unique-name churn (over-cap names are
+        # dropped, counted, and never stored).
+        self._raw_families: set[str] = set()
         self._raw_cap_warned = False
 
     # -- public --------------------------------------------------------------
@@ -333,41 +332,23 @@ class PollLoop:
 
     _MAX_RAW_FAMILIES = 64
 
-    def _raw_spec(self, runtime_name: str) -> schema.MetricSpec | None:
-        """Gauge spec for one passthrough family (--passthrough-unknown),
-        minted on first sight and cached; None once the family cap is hit
-        (the drop is counted as a poll error so it stays visible).
-        Over-cap names are never memoized — a runtime churning unique
-        names each tick must not grow this cache (or the log) unboundedly.
-        Sanitization is not injective ('a.b-c' and 'a.b_c' collide), so a
-        collision gets a stable crc suffix instead of minting a duplicate
-        Prometheus series that would fail the whole scrape."""
-        spec = self._raw_specs.get(runtime_name)
-        if spec is not None:
-            return spec
-        if len(self._raw_specs) >= self._MAX_RAW_FAMILIES:
+    def _admit_raw_family(self, family: str) -> bool:
+        """Cap the distinct passthrough family names (--passthrough-
+        unknown). Over-cap names are dropped, counted as raw_family_cap
+        poll errors, and never stored — a runtime churning unique names
+        each tick must not grow the set (or the log) unboundedly."""
+        if family in self._raw_families:
+            return True
+        if len(self._raw_families) >= self._MAX_RAW_FAMILIES:
             if not self._raw_cap_warned:
                 self._raw_cap_warned = True
                 log.warning(
                     "passthrough family cap (%d) reached; dropping %r and "
                     "any further new families (counted as raw_family_cap "
-                    "poll errors)", self._MAX_RAW_FAMILIES, runtime_name)
-            return None
-        name = schema.sanitize_passthrough_name(runtime_name)
-        if name in self._raw_names_used:
-            import zlib
-
-            name = f"{name}_{zlib.crc32(runtime_name.encode()) & 0xFFFFFF:06x}"
-        self._raw_names_used.add(name)
-        spec = schema.MetricSpec(
-            name,
-            schema.MetricType.GAUGE,
-            f"Passthrough of unrecognized libtpu family {runtime_name!r} "
-            f"(--passthrough-unknown; semantics are the runtime's, not "
-            f"part of the accelerator_* contract).",
-        )
-        self._raw_specs[runtime_name] = spec
-        return spec
+                    "poll errors)", self._MAX_RAW_FAMILIES, family)
+            return False
+        self._raw_families.add(family)
+        return True
 
     def _device_labels(self, dev: Device) -> list[tuple[str, str]]:
         attribution = self._attribution.lookup(dev)
@@ -436,12 +417,18 @@ class PollLoop:
             if sample.collective_ops is not None:
                 builder.add(schema.COLLECTIVE_OPS, float(sample.collective_ops), base)
             if sample.raw_values:
-                for name in sorted(sample.raw_values):
-                    spec = self._raw_spec(name)
-                    if spec is None:
+                # Keys are (family, link) pairs; all passthrough data
+                # rides ONE static gauge family with the raw runtime name
+                # in the 'family' label — series identity is deterministic
+                # across restarts and collision-free by construction.
+                for key in sorted(sample.raw_values):
+                    family, link = key
+                    if not self._admit_raw_family(family):
                         self._count_error("raw_family_cap")
                         continue
-                    builder.add(spec, sample.raw_values[name], base)
+                    builder.add(
+                        schema.PASSTHROUGH, sample.raw_values[key],
+                        base + [("family", family), ("link", link)])
         if self._process_openers is not None:
             for dev, _ in results:
                 base = self._device_labels(dev)

@@ -122,8 +122,12 @@ class Snapshot:
             by_family.setdefault(s.spec.name, []).append(s)
 
         out: list[str] = []
-
-        def render_family(spec: MetricSpec, group: list[Series]) -> None:
+        for spec in schema.ALL_METRICS:
+            if spec.type is MetricType.HISTOGRAM:
+                continue
+            group = by_family.get(spec.name)
+            if not group:
+                continue
             family = spec.name
             if openmetrics and spec.type is MetricType.COUNTER:
                 family = spec.name.removesuffix("_total")
@@ -134,21 +138,6 @@ class Snapshot:
                     _series_prefix(s.spec.name, s.labels)
                     + format_value(s.value)
                 )
-
-        schema_names = set()
-        for spec in schema.ALL_METRICS:
-            schema_names.add(spec.name)
-            if spec.type is MetricType.HISTOGRAM:
-                continue
-            group = by_family.get(spec.name)
-            if group:
-                render_family(spec, group)
-        # Families outside the schema tables (passthrough mode's
-        # dynamically-minted tpu_runtime_* gauges): after the contract
-        # families, sorted by name for byte-stable goldens.
-        for name in sorted(by_family.keys() - schema_names):
-            group = by_family[name]
-            render_family(group[0].spec, group)
         # Histograms grouped by family: one HELP/TYPE header even when the
         # family is dimensioned into several labeled states (e.g.
         # collector_scrape_duration_seconds{output=...}).

@@ -166,6 +166,19 @@ WORKLOAD_STEPS = MetricSpec(
     "each device's label set. Only present in embedded mode.",
 )
 
+PASSTHROUGH = MetricSpec(
+    "tpu_runtime_passthrough",
+    MetricType.GAUGE,
+    "Value of a libtpu metric family outside the pinned accelerator_* "
+    "schema, exported verbatim under the 'family' label "
+    "(--passthrough-unknown). Series identity is the raw runtime name — "
+    "deterministic across restarts, collision-free by construction; "
+    "per-link samples carry the 'link' label. Semantics are the "
+    "runtime's, not part of the accelerator_* contract; distinct family "
+    "count is capped (overflow counted as raw_family_cap poll errors).",
+    extra_labels=("family", "link"),
+)
+
 WORKLOAD_BUSY_SECONDS = MetricSpec(
     "accelerator_workload_busy_seconds_total",
     MetricType.COUNTER,
@@ -204,6 +217,7 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     PROCESS_OPEN,
     WORKLOAD_STEPS,
     WORKLOAD_BUSY_SECONDS,
+    PASSTHROUGH,
 )
 
 # Workload-global histogram families (embedded mode): enter snapshots via
@@ -403,19 +417,6 @@ def render_docs() -> str:
             f"| `{spec.name}` | {spec.type.value} | {extra} | {spec.help} |"
         )
     return "\n".join(lines) + "\n"
-
-
-def sanitize_passthrough_name(raw: str) -> str:
-    """Map a runtime-native metric name (e.g.
-    ``tpu.runtime.tensorcore.dutycycle.percent``) onto a valid Prometheus
-    name under the ``tpu_runtime_`` prefix. The prefix keeps passthrough
-    series out of the ``accelerator_*`` contract namespace (validate.py
-    ignores them) while making their origin obvious."""
-    cleaned = re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
-    cleaned = re.sub(r"_+", "_", cleaned).strip("_").lower() or "unnamed"
-    if cleaned.startswith("tpu_runtime"):
-        return cleaned
-    return "tpu_runtime_" + cleaned
 
 
 def escape_label_value(value: str) -> str:
