@@ -45,16 +45,19 @@ class Sample:
     ``ici_counters`` maps link name -> cumulative traffic bytes; the poll
     loop turns deltas into bandwidth gauges (C10 rate math lives OFF the
     collector so every backend gets wraparound handling for free).
-    ``raw_values`` maps runtime-native family names outside the pinned
-    schema -> value (libtpu passthrough mode, --passthrough-unknown); the
-    poll loop exports them as sanitized ``tpu_runtime_*`` gauges.
+    ``raw_values`` maps ``(family, link)`` pairs — the runtime-native
+    family name outside the pinned schema, and its link attribute or ""
+    — to values (libtpu passthrough mode, --passthrough-unknown); the
+    poll loop exports them under the ``tpu_runtime_passthrough`` gauge
+    with the pair as the ``family``/``link`` labels.
     """
 
     device: Device
     values: Mapping[str, float]
     ici_counters: Mapping[str, int] = dataclasses.field(default_factory=dict)
     collective_ops: int | None = None
-    raw_values: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    raw_values: Mapping[tuple[str, str], float] = dataclasses.field(
+        default_factory=dict)
 
 
 class CollectorError(RuntimeError):

@@ -57,6 +57,7 @@ class Config:
     log_format: str = "text"  # text|json (json = Cloud Logging structured)
     tls_cert_file: str = ""  # both set = serve HTTPS
     tls_key_file: str = ""
+    tls_client_ca_file: str = ""  # set = require client certs (mTLS)
     auth_username: str = ""  # + password hash = basic auth on /metrics
     auth_password_sha256: str = ""
 
@@ -87,6 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="kube-tpu-stats",
         description="TPU-native accelerator telemetry exporter for Kubernetes",
     )
+    from . import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"kube-tpu-stats {__version__}")
     p.add_argument("--backend", choices=BACKENDS,
                    default=_env("BACKEND", "auto"),
                    help="device backend; auto probes tpu, then gpu sysfs, "
@@ -136,10 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--passthrough-unknown", choices=("on", "off"),
                    default=_env("PASSTHROUGH_UNKNOWN", "off"),
                    help="export libtpu metric families outside the pinned "
-                        "schema as tpu_runtime_* gauges (sanitized names, "
-                        "capped family count). For runtimes speaking a "
-                        "different metric-name surface; uses the Python "
-                        "decode path")
+                        "schema as tpu_runtime_passthrough{family=...} "
+                        "gauges (capped distinct-family count). For "
+                        "runtimes speaking a different metric-name "
+                        "surface; uses the Python decode path")
     p.add_argument("--max-process-series", type=int,
                    default=int(_env("MAX_PROCESS_SERIES", "32")),
                    help="max accelerator_process_open holders exported per "
@@ -183,6 +188,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tls-cert-file", default=_env("TLS_CERT_FILE", ""),
                    help="PEM certificate; with --tls-key-file serves HTTPS")
     p.add_argument("--tls-key-file", default=_env("TLS_KEY_FILE", ""))
+    p.add_argument("--tls-client-ca-file",
+                   default=_env("TLS_CLIENT_CA_FILE", ""),
+                   help="CA bundle; set = require and verify a client "
+                        "certificate on every connection (mTLS). Needs "
+                        "--tls-cert-file/--tls-key-file")
     p.add_argument("--auth-username", default=_env("AUTH_USERNAME", ""),
                    help="basic-auth user for all endpoints except "
                         "/healthz and /readyz (kubelet probes)")
@@ -286,6 +296,9 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
             f"(got {args.remote_write_protocol!r})")
     if bool(args.tls_cert_file) != bool(args.tls_key_file):
         parser.error("--tls-cert-file and --tls-key-file must be set together")
+    if args.tls_client_ca_file and not args.tls_cert_file:
+        parser.error("--tls-client-ca-file requires --tls-cert-file/"
+                     "--tls-key-file")
     if bool(args.auth_username) != bool(args.auth_password_sha256):
         parser.error("--auth-username and --auth-password-sha256 must be "
                      "set together")
@@ -329,6 +342,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         log_format=args.log_format,
         tls_cert_file=args.tls_cert_file,
         tls_key_file=args.tls_key_file,
+        tls_client_ca_file=args.tls_client_ca_file,
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
     )

@@ -201,6 +201,7 @@ class Daemon:
             healthz_max_age=max(5.0, cfg.interval * 5),
             tls_cert_file=cfg.tls_cert_file,
             tls_key_file=cfg.tls_key_file,
+            tls_client_ca_file=cfg.tls_client_ca_file,
             auth_username=cfg.auth_username,
             auth_password_sha256=cfg.auth_password_sha256,
             render_stats=self.render_stats,

@@ -162,7 +162,8 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                 + ", ".join(sorted(alien_names))
                 + " — runtime speaking a different metric-name surface; "
                   "the exporter will be empty until proto/tpumetrics.py "
-                  "is re-pinned",
+                  "is re-pinned, or run with --passthrough-unknown on to "
+                  "export these as tpu_runtime_passthrough gauges now",
             )
         if decode_failures:
             return _result(

@@ -96,7 +96,10 @@ class MetricsServer:
     Web hardening (GPU exporters typically defer this to a sidecar/
     exporter-toolkit; here it's built in):
 
-    - ``tls_cert_file``/``tls_key_file`` serve HTTPS.
+    - ``tls_cert_file``/``tls_key_file`` serve HTTPS;
+      ``tls_client_ca_file`` additionally REQUIRES a client certificate
+      signed by that CA (mTLS — the exporter-toolkit ``client_auth_type:
+      RequireAndVerifyClientCert`` analog).
     - ``auth_username`` + ``auth_password_sha256`` (hex digest) require
       HTTP basic auth on every path EXCEPT /healthz and /readyz, which
       kubelet probes hit unauthenticated.
@@ -110,6 +113,7 @@ class MetricsServer:
     def __init__(self, registry: Registry, host: str = "0.0.0.0",
                  port: int = 9400, healthz_max_age: float = 0.0,
                  tls_cert_file: str = "", tls_key_file: str = "",
+                 tls_client_ca_file: str = "",
                  auth_username: str = "", auth_password_sha256: str = "",
                  render_stats: RenderStats | None = None):
         self._registry = registry
@@ -267,18 +271,31 @@ class MetricsServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+        # Validate the TLS config BEFORE binding: raising after
+        # ThreadingHTTPServer() would leak the bound listener socket.
+        if tls_client_ca_file and not tls_cert_file:
+            raise ValueError(
+                "tls_client_ca_file (mTLS) requires tls_cert_file/"
+                "tls_key_file — client certs only exist inside TLS"
+            )
+        if (tls_cert_file or tls_key_file) and not (
+                tls_cert_file and tls_key_file):
+            raise ValueError("TLS needs both tls_cert_file and tls_key_file")
         self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
-        if tls_cert_file or tls_key_file:
+        if tls_cert_file:
             import ssl
 
-            if not (tls_cert_file and tls_key_file):
-                raise ValueError(
-                    "TLS needs both tls_cert_file and tls_key_file"
-                )
             # Hardened stdlib defaults: TLS >= 1.2, vetted cipher list.
             context = ssl.create_default_context(ssl.Purpose.CLIENT_AUTH)
             context.load_cert_chain(tls_cert_file, tls_key_file)
+            if tls_client_ca_file:
+                # mTLS: every connection must present a cert chaining to
+                # this CA; the handshake itself rejects strangers, so no
+                # per-path enforcement is needed (kubelet probes must be
+                # given a cert or probe a separate plain listener).
+                context.verify_mode = ssl.CERT_REQUIRED
+                context.load_verify_locations(cafile=tls_client_ca_file)
             # Defer the handshake to the per-connection handler thread —
             # with the default handshake-on-accept, one client that opens
             # a TCP connection and sends nothing would wedge the single

@@ -1,8 +1,9 @@
 """Standard Prometheus process metrics (process_cpu_seconds_total,
-process_resident_memory_bytes, process_start_time_seconds) read from /proc
-once per tick — the conventional exporter self-observability the reference
-genre gets from its client library (SURVEY.md §5 observability item).
-Degrades to nothing on hosts without /proc."""
+process_resident_memory_bytes, process_virtual_memory_bytes,
+process_start_time_seconds, process_open_fds, process_max_fds) read from
+/proc once per tick — the conventional exporter self-observability the
+reference genre gets from its client library (SURVEY.md §5 observability
+item). Degrades to nothing on hosts without /proc."""
 
 from __future__ import annotations
 
@@ -46,8 +47,21 @@ def read() -> dict[str, float]:
         pass
     try:
         with open("/proc/self/statm") as f:
-            rss_pages = int(f.read().split()[1])
-        out["process_resident_memory_bytes"] = float(rss_pages * _PAGE_SIZE)
+            fields = f.read().split()
+        out["process_virtual_memory_bytes"] = float(int(fields[0]) * _PAGE_SIZE)
+        out["process_resident_memory_bytes"] = float(int(fields[1]) * _PAGE_SIZE)
     except (OSError, IndexError, ValueError):
+        pass
+    try:
+        out["process_open_fds"] = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    try:
+        import resource
+
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft != resource.RLIM_INFINITY:
+            out["process_max_fds"] = float(soft)
+    except (ImportError, OSError, ValueError):
         pass
     return out

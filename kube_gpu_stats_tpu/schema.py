@@ -331,6 +331,22 @@ PROCESS_START = MetricSpec(
     MetricType.GAUGE,
     "Unix time the exporter process started.",
 )
+PROCESS_VMEM = MetricSpec(
+    "process_virtual_memory_bytes",
+    MetricType.GAUGE,
+    "Virtual memory size of the exporter process.",
+)
+PROCESS_OPEN_FDS = MetricSpec(
+    "process_open_fds",
+    MetricType.GAUGE,
+    "File descriptors the exporter process holds open. Rising toward "
+    "process_max_fds means an fd leak (sockets, procfs scans).",
+)
+PROCESS_MAX_FDS = MetricSpec(
+    "process_max_fds",
+    MetricType.GAUGE,
+    "Soft limit on open file descriptors for the exporter process.",
+)
 
 SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_DURATION,
@@ -346,6 +362,9 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     PROCESS_CPU,
     PROCESS_RSS,
     PROCESS_START,
+    PROCESS_VMEM,
+    PROCESS_OPEN_FDS,
+    PROCESS_MAX_FDS,
 )
 
 ALL_METRICS: tuple[MetricSpec, ...] = (

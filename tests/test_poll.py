@@ -239,9 +239,16 @@ def test_process_self_metrics_exported():
     names = {s.spec.name for s in reg.snapshot().series}
     assert "process_cpu_seconds_total" in names
     assert "process_resident_memory_bytes" in names
-    rss = [s.value for s in reg.snapshot().series
-           if s.spec.name == "process_resident_memory_bytes"]
-    assert rss[0] > 1024 * 1024  # a real python process is > 1 MiB
+    assert "process_virtual_memory_bytes" in names
+    assert "process_open_fds" in names
+    values = {s.spec.name: s.value for s in reg.snapshot().series}
+    assert values["process_resident_memory_bytes"] > 1024 * 1024
+    assert values["process_virtual_memory_bytes"] >= \
+        values["process_resident_memory_bytes"]
+    assert values["process_open_fds"] > 0
+    # Deliberately absent when the soft limit is RLIM_INFINITY.
+    if "process_max_fds" in values:
+        assert values["process_open_fds"] <= values["process_max_fds"]
     loop.stop()
 
 

@@ -243,3 +243,68 @@ def test_tls_minimum_version_is_modern(cert_pair):
         assert resp.status == 200
     finally:
         srv.stop()
+
+
+# -- mTLS (client-certificate verification) ----------------------------------
+
+@pytest.fixture(scope="module")
+def client_cert_pair(tmp_path_factory):
+    """A second self-signed pair acting as the client identity AND the CA
+    the server trusts (self-signed = its own chain)."""
+    d = tmp_path_factory.mktemp("mtls")
+    cert, key = d / "client.pem", d / "client-key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=prometheus-scraper"],
+        check=True, capture_output=True,
+    )
+    return cert, key
+
+
+def test_mtls_rejects_certless_client(cert_pair, client_cert_pair):
+    cert, key = cert_pair
+    client_ca, _ = client_cert_pair
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        tls_cert_file=str(cert), tls_key_file=str(key),
+                        tls_client_ca_file=str(client_ca))
+    srv.start()
+    try:
+        context = ssl.create_default_context(cafile=str(cert))
+        with pytest.raises((ssl.SSLError, urllib.error.URLError,
+                            ConnectionResetError, OSError)):
+            fetch(srv.port, scheme="https", context=context)
+    finally:
+        srv.stop()
+
+
+def test_mtls_accepts_client_with_cert(cert_pair, client_cert_pair):
+    cert, key = cert_pair
+    client_cert, client_key = client_cert_pair
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        tls_cert_file=str(cert), tls_key_file=str(key),
+                        tls_client_ca_file=str(client_cert))
+    srv.start()
+    try:
+        context = ssl.create_default_context(cafile=str(cert))
+        context.load_cert_chain(str(client_cert), str(client_key))
+        resp = fetch(srv.port, scheme="https", context=context)
+        assert b"accelerator_duty_cycle" in resp.read()
+    finally:
+        srv.stop()
+
+
+def test_mtls_requires_server_tls(client_cert_pair):
+    client_ca, _ = client_cert_pair
+    with pytest.raises(ValueError):
+        MetricsServer(Registry(), host="127.0.0.1", port=0,
+                      tls_client_ca_file=str(client_ca))
+
+
+def test_mtls_flag_validation():
+    import pytest as _pytest
+
+    from kube_gpu_stats_tpu.config import from_args
+
+    with _pytest.raises(SystemExit):
+        from_args(["--backend", "mock", "--tls-client-ca-file", "/ca.pem"])
