@@ -16,6 +16,7 @@ decode, fan-out, rate math, snapshot build) on any machine.
 """
 
 import json
+import os
 import sys
 import tempfile
 
@@ -48,6 +49,10 @@ def main() -> int:
         "p99_ms": round(result["p99_ms"], 3),
         "metrics_per_sec_per_chip": round(result["metrics_per_chip"], 1),
         "max_hz": round(result["max_hz"], 1),
+        # End-to-end HTTP scrape (render + gzip-negotiation + socket) over
+        # the same snapshots — the render half of the north-star metric.
+        "scrape_p50_ms": round(result.get("scrape_p50_ms", 0.0), 3),
+        "scrape_p99_ms": round(result.get("scrape_p99_ms", 0.0), 3),
         "mode": result["mode"],
         "path": result.get("path", "fake-grpc"),
         "chips": result["chips"],
@@ -62,6 +67,11 @@ def main() -> int:
         if key in result:
             line[key] = result[key]
     print(json.dumps(line))
+    # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or
+    # PJRT atexit hook) blocked in native code; the JSON line is already
+    # out, and the driver must get its exit code, not a hang.
+    sys.stdout.flush()
+    os._exit(0)
     return 0
 
 

@@ -40,18 +40,43 @@ def _percentile(sorted_values: list[float], q: float) -> float:
 def measure_collector(collector: Collector, *, ticks: int, warmup: int,
                       extra: dict | None = None) -> dict:
     """Run `warmup + ticks` polls of `collector` through the production loop
-    and report the tick-duration distribution in milliseconds."""
+    and report the tick-duration distribution in milliseconds, plus the
+    HTTP-scrape distribution over the same snapshots (the OTHER half of
+    the north-star "scrape p50 latency": render + gzip + HTTP through the
+    production MetricsServer, measured with real socket round-trips)."""
+    import urllib.request
+
+    from .exposition import MetricsServer
+
     registry = Registry()
     loop = PollLoop(collector, registry, deadline=10.0)
     durations: list[float] = []
+    scrape_ms: list[float] = []
+    server = MetricsServer(registry, host="127.0.0.1", port=0)
+    server.start()
+
+    def scrape() -> None:
+        # Advertise gzip like a real Prometheus scraper so the measured
+        # path includes the compression cost, not just the render.
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/metrics",
+            headers={"Accept-Encoding": "gzip"})
+        urllib.request.urlopen(request, timeout=10).read()
+
     try:
         for _ in range(warmup):
             loop.tick()
+            scrape()
         for _ in range(ticks):
             durations.append(loop.tick() * 1000.0)
+            scrape_start = time.monotonic()
+            scrape()
+            scrape_ms.append((time.monotonic() - scrape_start) * 1000.0)
     finally:
         loop.stop()
+        server.stop()
     ordered = sorted(durations)
+    scrape_sorted = sorted(scrape_ms)
     chips = max(1, len(loop.devices))
     # Per-chip series actually exported this tick (the north-star's second
     # figure: "metrics/sec/chip" — at the 1 Hz cadence this IS the rate).
@@ -69,6 +94,8 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
         "p99_ms": _percentile(ordered, 0.99),
         "metrics_per_chip": device_series / chips,
         "max_hz": 1000.0 / _percentile(ordered, 0.50) if ordered else 0.0,
+        "scrape_p50_ms": _percentile(scrape_sorted, 0.50),
+        "scrape_p99_ms": _percentile(scrape_sorted, 0.99),
     }
     result.update(extra or {})
     return result
@@ -229,7 +256,15 @@ def _probe_jax_platform(timeout: float = 90.0) -> str | None:
     try:
         out = subprocess.run(
             [sys.executable, "-c",
-             "import jax; ds = jax.devices(); "
+             # Honor JAX_PLATFORMS the way tests/conftest.py does: the
+             # sandbox's sitecustomize force-registers the TPU plugin, so
+             # the env var alone doesn't stick — the config update wins.
+             # Without this, a CPU-forced test run would probe the real
+             # chip tunnel (and hang the suite when the tunnel is down).
+             "import os, jax\n"
+             "p = os.environ.get('JAX_PLATFORMS')\n"
+             "if p: jax.config.update('jax_platforms', p)\n"
+             "ds = jax.devices()\n"
              "print(ds[0].platform if ds else '')"],
             capture_output=True, text=True, timeout=timeout,
         )
@@ -352,6 +387,24 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
 
     record: dict = {"jax_platform": None, "device_kind": None, "error": None}
     probe["embedded_attempt"] = record
+    # Gate the in-process jax init on the BOUNDED subprocess probe: a
+    # wedged chip tunnel makes `jax.devices()` hang forever (observed:
+    # axon tunnel outage mid-session), and an in-process hang here would
+    # hang the driver's whole bench run instead of falling back to
+    # simulated mode. try_real_harness usually probed already; reuse it.
+    if "jax_platform" in probe:
+        # Reuse try_real_harness's probe result — including a stored
+        # None (probe timed out: wedged tunnel); re-probing would just
+        # double the 90 s hang window this gate exists to bound.
+        platform = probe["jax_platform"]
+    else:
+        platform = _probe_jax_platform()
+        record["jax_platform"] = platform
+    if platform not in ("tpu", "gpu"):
+        record["error"] = (
+            f"no accelerator platform (bounded subprocess probe saw "
+            f"{platform!r}; None can mean jax init hung — wedged tunnel)")
+        return None
     try:
         import jax
 

@@ -286,24 +286,31 @@ class MetricsServer:
         if tls_cert_file:
             import ssl
 
-            # Hardened stdlib defaults: TLS >= 1.2, vetted cipher list.
-            context = ssl.create_default_context(ssl.Purpose.CLIENT_AUTH)
-            context.load_cert_chain(tls_cert_file, tls_key_file)
-            if tls_client_ca_file:
-                # mTLS: every connection must present a cert chaining to
-                # this CA; the handshake itself rejects strangers, so no
-                # per-path enforcement is needed (kubelet probes must be
-                # given a cert or probe a separate plain listener).
-                context.verify_mode = ssl.CERT_REQUIRED
-                context.load_verify_locations(cafile=tls_client_ca_file)
-            # Defer the handshake to the per-connection handler thread —
-            # with the default handshake-on-accept, one client that opens
-            # a TCP connection and sends nothing would wedge the single
-            # accept loop and take down /healthz with it.
-            self._server.socket = context.wrap_socket(
-                self._server.socket, server_side=True,
-                do_handshake_on_connect=False,
-            )
+            try:
+                # Hardened stdlib defaults: TLS >= 1.2, vetted ciphers.
+                context = ssl.create_default_context(ssl.Purpose.CLIENT_AUTH)
+                context.load_cert_chain(tls_cert_file, tls_key_file)
+                if tls_client_ca_file:
+                    # mTLS: every connection must present a cert chaining
+                    # to this CA; the handshake itself rejects strangers,
+                    # so no per-path enforcement is needed (kubelet probes
+                    # must be given a cert or probe a separate listener).
+                    context.verify_mode = ssl.CERT_REQUIRED
+                    context.load_verify_locations(cafile=tls_client_ca_file)
+                # Defer the handshake to the per-connection handler
+                # thread — with the default handshake-on-accept, one
+                # client that opens a TCP connection and sends nothing
+                # would wedge the single accept loop and take down
+                # /healthz with it.
+                self._server.socket = context.wrap_socket(
+                    self._server.socket, server_side=True,
+                    do_handshake_on_connect=False,
+                )
+            except Exception:
+                # An unreadable cert/key/CA must not leak the listener
+                # already bound above.
+                self._server.server_close()
+                raise
         self._thread: threading.Thread | None = None
 
     @property

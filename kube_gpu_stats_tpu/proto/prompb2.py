@@ -116,6 +116,12 @@ def decode_request(
     if symbols and symbols[0] != "":
         raise ValueError("symbols[0] must be the empty string (2.0 spec)")
 
+    def symbol(ref: int) -> str:
+        if ref >= len(symbols):
+            raise ValueError(
+                f"symbol ref {ref} out of range ({len(symbols)} symbols)")
+        return symbols[ref]
+
     out = []
     for ts_raw in series_raw:
         labels: dict[str, str] = {}
@@ -131,7 +137,7 @@ def decode_request(
                 if len(refs) % 2:
                     raise ValueError("odd labels_refs count")
                 for i in range(0, len(refs), 2):
-                    labels[symbols[refs[i]]] = symbols[refs[i + 1]]
+                    labels[symbol(refs[i])] = symbol(refs[i + 1])
             elif field == 2 and wire_type == codec.LENGTH:
                 sample_value, sample_ts = 0.0, 0
                 for sf, sw, sv in codec.iter_fields(value):
@@ -145,6 +151,6 @@ def decode_request(
                     if mf == 1 and mw == codec.VARINT:
                         metadata["type"] = mv
                     elif mf == 3 and mw == codec.VARINT:
-                        metadata["help"] = symbols[mv]
+                        metadata["help"] = symbol(mv)
         out.append((labels, samples, metadata))
     return out

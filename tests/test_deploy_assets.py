@@ -203,3 +203,20 @@ def test_systemd_installer_references_shipped_files():
         assert shipped in text
         assert (DEPLOY / "systemd" / shipped).exists()
     assert "doctor" in text  # preflight after install
+
+
+def test_podmonitor_matches_daemonset():
+    """The optional prometheus-operator PodMonitor must select the
+    DaemonSet's pods and scrape the port the container actually names."""
+    (pm,) = load_yaml_docs("podmonitor.yaml")
+    assert pm["kind"] == "PodMonitor"
+    (ds,) = [d for d in load_yaml_docs("daemonset.yaml") if d["kind"] == "DaemonSet"]
+    pod_labels = ds["spec"]["template"]["metadata"]["labels"]
+    for key, value in pm["spec"]["selector"]["matchLabels"].items():
+        assert pod_labels.get(key) == value
+    container = ds["spec"]["template"]["spec"]["containers"][0]
+    port_names = {p["name"] for p in container["ports"]}
+    for endpoint in pm["spec"]["podMetricsEndpoints"]:
+        assert endpoint["port"] in port_names
+        assert endpoint.get("path", "/metrics") == "/metrics"
+    assert pm["metadata"]["namespace"] == ds["metadata"]["namespace"]

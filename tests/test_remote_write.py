@@ -431,3 +431,42 @@ def test_doctor_probe_negotiates_configured_protocol():
     assert "io.prometheus.write.v2" in v2["Content-Type"]
     v1 = build_headers("", "1.0")
     assert v1["X-Prometheus-Remote-Write-Version"] == "0.1.0"
+
+
+def test_prompb2_decoder_fuzz_raises_only_valueerror():
+    """Garbage and mutated-valid inputs must yield ValueError or a clean
+    result — never IndexError/KeyError/hangs (the decoder backs the test
+    receiver, and a symbol ref can point past the symbol table)."""
+    import random
+
+    rng = random.Random(20260729)
+    table = prompb2.SymbolTable()
+    valid = prompb2.encode_request(table, [
+        prompb2.encode_series(table, "up", [("chip", "0")], 1.0, 1000,
+                              prompb2.TYPE_GAUGE, "help text"),
+    ])
+    for trial in range(3000):
+        if trial % 3 == 0:
+            raw = bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(0, 80)))
+        else:
+            mutated = bytearray(valid)
+            for _ in range(rng.randrange(1, 4)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            raw = bytes(mutated[:rng.randrange(1, len(mutated) + 1)])
+        try:
+            prompb2.decode_request(raw)
+        except ValueError:
+            pass
+        except IndexError as exc:  # noqa: PERF203
+            raise AssertionError(f"IndexError on {raw.hex()}") from exc
+
+
+def test_prompb2_out_of_range_symbol_ref_is_valueerror():
+    from kube_gpu_stats_tpu.proto import codec
+
+    body = codec.field_bytes(
+        1, codec.encode_varint(5) + codec.encode_varint(6))
+    raw = codec.field_string(4, "") + codec.field_bytes(5, body)
+    with pytest.raises(ValueError, match="symbol ref"):
+        prompb2.decode_request(raw)

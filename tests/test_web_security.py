@@ -302,9 +302,28 @@ def test_mtls_requires_server_tls(client_cert_pair):
 
 
 def test_mtls_flag_validation():
-    import pytest as _pytest
-
     from kube_gpu_stats_tpu.config import from_args
 
-    with _pytest.raises(SystemExit):
+    with pytest.raises(SystemExit):
         from_args(["--backend", "mock", "--tls-client-ca-file", "/ca.pem"])
+
+
+def test_unreadable_tls_files_do_not_leak_listener(cert_pair):
+    """A bad cert path raises AFTER the socket binds — the constructor
+    must close the listener on its way out (review finding)."""
+    import socket
+
+    cert, key = cert_pair
+    # Dynamically pick a free port (a hardcoded one races parallel runs).
+    with socket.socket() as probe_sock:
+        probe_sock.bind(("127.0.0.1", 0))
+        port = probe_sock.getsockname()[1]
+    for _ in range(3):
+        with pytest.raises(FileNotFoundError):
+            MetricsServer(Registry(), host="127.0.0.1", port=port,
+                          tls_cert_file=str(cert), tls_key_file=str(key),
+                          tls_client_ca_file="/nonexistent/ca.pem")
+    # Port must be immediately rebindable: nothing leaked.
+    srv = MetricsServer(Registry(), host="127.0.0.1", port=port)
+    srv.start()
+    srv.stop()
