@@ -85,3 +85,26 @@ def test_conditional_templates_are_gated():
         "{{- if .Values.serviceAccount.create }}"
     )
     assert texts["service.yaml"].startswith("{{- if .Values.service.enabled }}")
+
+
+def test_template_control_structures_balance():
+    """No helm binary in CI: at least pin that every {{ if }}/{{ range }}
+    has a matching {{ end }} per template (the typo class that makes
+    `helm template` fail at install time)."""
+    for name, text in template_texts().items():
+        opens = len(re.findall(r"\{\{-?\s*(?:if|range|with|define|block)\b",
+                               text))
+        ends = len(re.findall(r"\{\{-?\s*end\s*-?\}\}", text))
+        assert opens == ends, (
+            f"{name}: {opens} if/range/with vs {ends} end")
+
+
+def test_daemonset_probe_scheme_follows_tls():
+    """TLS wraps the one listener that also serves the probes: the chart
+    must switch httpGet probes to HTTPS under TLS and to tcpSocket under
+    mTLS (kubelet presents no client cert) — review finding."""
+    text = template_texts()["daemonset.yaml"]
+    assert "scheme: HTTPS" in text
+    assert "tcpSocket:" in text
+    # mTLS branch must come first (clientCaFile implies certFile).
+    assert text.index(".Values.tls.clientCaFile") < text.index("scheme: HTTPS")

@@ -60,6 +60,10 @@ def test_soak_flapping_backend(tmp_path):
         remote_write_url=(
             f"http://127.0.0.1:{receiver.server_address[1]}/push"),
         remote_write_interval=0.1,
+        # 2.0 in the soak: the symbol-interning encoder takes the same
+        # retry/flap beating as 1.0 (the receiver never 415s, so no
+        # downgrade — every push exercises the v2 path).
+        remote_write_protocol="2.0",
         pushgateway_url=f"http://127.0.0.1:{receiver.server_address[1]}",
     )
     daemon = Daemon(cfg)
