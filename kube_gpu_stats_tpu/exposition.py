@@ -191,7 +191,11 @@ class MetricsServer:
                     ):
                         import gzip
 
-                        body = gzip.compress(body, compresslevel=6)
+                        # Level 3, not 6: measured on a 32-chip 161 KB
+                        # exposition, 0.4 ms vs 1.1 ms for only ~1 KB more
+                        # wire (10.0 vs 8.9 KB) — compression latency sits
+                        # on the north-star scrape path, the bytes don't.
+                        body = gzip.compress(body, compresslevel=3)
                         encoding = "gzip"
                     if outer._render_stats is not None:
                         # Render + gzip, post-compression size: the cost a

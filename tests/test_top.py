@@ -163,6 +163,46 @@ def test_cli_dispatches_top(tmp_path, capsys):
     assert "CHIP" in capsys.readouterr().out
 
 
+def test_live_rates_against_ticking_exporter():
+    """Integration: two snapshot_frame() rounds against a live HTTP
+    exporter whose workload counter advances between them produce a
+    positive steps/s — the whole fetch->parse->key->rate pipeline."""
+    import time
+
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.collectors import Sample
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    class SteppingCollector(MockCollector):
+        steps = 0.0
+
+        def sample(self, device):
+            s = super().sample(device)
+            values = dict(s.values)
+            values[schema.WORKLOAD_STEPS.name] = SteppingCollector.steps
+            return Sample(device=s.device, values=values,
+                          ici_counters=s.ici_counters,
+                          collective_ops=s.collective_ops)
+
+    reg = Registry()
+    loop = PollLoop(SteppingCollector(num_devices=1), reg, deadline=5.0)
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    try:
+        loop.tick()
+        first = top.snapshot_frame([url], None)
+        SteppingCollector.steps = 500.0
+        time.sleep(0.05)
+        loop.tick()
+        second = top.snapshot_frame([url], first)
+        (row,) = second.rows.values()
+        assert row.steps_per_s is not None and row.steps_per_s > 0
+    finally:
+        loop.stop()
+        server.stop()
+
+
 def test_top_reads_schema_families_it_claims():
     """The column map must reference real schema names only."""
     known = {m.name for m in schema.ALL_METRICS}
