@@ -371,7 +371,11 @@ class PushgatewayPusher(PublishFollower):
             headers={"Content-Type": CONTENT_TYPE},
         )
         try:
-            with urllib.request.urlopen(request, timeout=10):
+            from .workers import push_opener
+
+            # No-redirect opener: a 302 must surface as a failure, not
+            # degrade the PUT into a body-less GET (see workers.push_opener).
+            with push_opener().open(request, timeout=10):
                 pass
             self.consecutive_failures = 0
             self.pushes_total += 1

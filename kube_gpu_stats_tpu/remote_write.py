@@ -185,7 +185,13 @@ class RemoteWriter(PublishFollower):
         request = urllib.request.Request(
             self._url, data=body, method="POST", headers=headers)
         try:
-            with urllib.request.urlopen(request, timeout=10):
+            from .workers import push_opener
+
+            # No-redirect opener: a 302 (e.g. an auth proxy) must land in
+            # the failure accounting below, not silently convert the POST
+            # into a body-less GET (see workers.push_opener). It also
+            # keeps the Authorization header off cross-origin redirects.
+            with push_opener().open(request, timeout=10):
                 pass
             self.consecutive_failures = 0
             self.pushes_total += 1

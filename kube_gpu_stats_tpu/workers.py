@@ -64,6 +64,30 @@ class PeriodicRefresher:
             self._thread.join(timeout=5)
 
 
+_PUSH_OPENER = None
+
+
+def push_opener():
+    """urllib opener for the push senders that REFUSES redirects. The
+    default handler converts a redirected POST/PUT into a body-less GET
+    (RFC-sanctioned for 301/302), so an auth proxy answering 302 would
+    make every push "succeed" while writing nothing — silent total data
+    loss counted as pushes_total. A 3xx now raises HTTPError and lands
+    in the senders' retryable-failure accounting, where a misconfigured
+    receiver is visible. Built once (OpenerDirector.open is safe for
+    this concurrent use); both senders push every interval forever."""
+    global _PUSH_OPENER
+    if _PUSH_OPENER is None:
+        import urllib.request
+
+        class _NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, req, fp, code, msg, headers, newurl):
+                return None
+
+        _PUSH_OPENER = urllib.request.build_opener(_NoRedirect)
+    return _PUSH_OPENER
+
+
 class PublishFollower:
     """Publish-following push scaffold shared by the Pushgateway and
     remote-write senders: wait for a snapshot publish, rate-limit to

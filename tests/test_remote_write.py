@@ -470,3 +470,60 @@ def test_prompb2_out_of_range_symbol_ref_is_valueerror():
     raw = codec.field_string(4, "") + codec.field_bytes(5, body)
     with pytest.raises(ValueError, match="symbol ref"):
         prompb2.decode_request(raw)
+
+
+def test_redirect_is_a_failure_not_a_silent_get(registry):
+    """urllib's default redirect handler converts a redirected POST into
+    a body-less GET — an auth proxy answering 302 would count total data
+    loss as pushes_total. The no-redirect opener must surface 3xx as a
+    retryable failure and never issue the GET."""
+    import http.server
+
+    events = []
+
+    class Redirector(http.server.ThreadingHTTPServer):
+        pass
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            events.append(self.command)
+            self.send_response(302)
+            self.send_header("Location", "/login")
+            self.end_headers()
+
+        do_PUT = do_POST  # pushgateway pushes PUT; same 302 trap
+
+        def do_GET(self):
+            events.append("GET")
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"login page")
+
+        def log_message(self, *args):
+            pass
+
+    srv = Redirector(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        writer = RemoteWriter(
+            registry, f"http://127.0.0.1:{srv.server_address[1]}/push",
+            min_interval=0.0)
+        writer.push_once()
+        assert writer.pushes_total == 0
+        assert writer.consecutive_failures == 1  # retryable, visible
+        assert writer.dropped_total == 0
+        assert events == ["POST"]  # no silent GET to /login
+
+        from kube_gpu_stats_tpu.exposition import PushgatewayPusher
+
+        pusher = PushgatewayPusher(
+            registry, f"http://127.0.0.1:{srv.server_address[1]}",
+            min_interval=0.0)
+        pusher.push_once()
+        assert pusher.pushes_total == 0
+        assert pusher.consecutive_failures == 1
+        assert events == ["POST", "PUT"]  # both redirected, neither GET
+    finally:
+        srv.shutdown()
+        srv.server_close()
