@@ -321,3 +321,57 @@ def test_doctor_names_alien_families():
     assert res.status == "fail"
     assert "tpu.v7.dutycycle" in res.detail and "tpu.v7.hbm.used" in res.detail
     assert "different metric-name surface" in res.detail
+
+
+def test_embedded_viability_hint(tmp_path, monkeypatch):
+    """When nothing external is collectable but in-process JAX would see
+    a chip, doctor points at the embedded exporter; on a truly chip-less
+    box the row is a skip. Healthy nodes never run the probe."""
+    from kube_gpu_stats_tpu import doctor as doc
+
+    cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "nosys"),
+                 libtpu_ports=(1,))  # closed port
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform",
+                        lambda timeout=60.0: "tpu")
+    results = doc.run_checks(cfg)
+    row = next(r for r in results if r.name == "embedded")
+    assert row.status == doc.WARN
+    assert "embedded.start" in row.detail
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform",
+                        lambda timeout=60.0: "cpu")
+    results = doc.run_checks(cfg)
+    row = next(r for r in results if r.name == "embedded")
+    assert row.status == doc.SKIP
+
+
+def test_embedded_hint_absent_on_healthy_node(tmp_path, monkeypatch):
+    from kube_gpu_stats_tpu import doctor as doc
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    def boom(timeout=60.0):
+        raise AssertionError("probe must not run when sysfs is healthy")
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform", boom)
+    make_sysfs(tmp_path / "sys", num_chips=2)
+    with FakeLibtpuServer(num_chips=2) as server:
+        cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "sys"),
+                     libtpu_ports=(server.port,))
+        results = doc.run_checks(cfg)
+    assert not any(r.name == "embedded" for r in results)
+
+
+def test_embedded_hint_inconclusive_probe_is_not_an_all_clear(tmp_path,
+                                                              monkeypatch):
+    from kube_gpu_stats_tpu import doctor as doc
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform",
+                        lambda timeout=60.0: None)
+    cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "nosys"),
+                 libtpu_ports=(1,))
+    results = doc.run_checks(cfg)
+    row = next(r for r in results if r.name == "embedded")
+    assert row.status == doc.SKIP
+    assert "inconclusive" in row.detail
+    assert "nothing to export" not in row.detail

@@ -248,6 +248,44 @@ def test_discovery_fallback_covers_empty_success():
         col.close()
 
 
+def test_passthrough_ingests_nested_dialect_responses():
+    """The nested (tpu-info-style) DECODE path passes unknown families
+    through like the flat one — pinned at the ingest layer, because the
+    modeled nested runtime rejects the batched '' selector entirely
+    (per-metric mode can only request pinned names, so there is nothing
+    to pass through on such a runtime; see the next test)."""
+    from kube_gpu_stats_tpu.collectors.libtpu import ingest_response_py
+
+    raw = tpumetrics.encode_response_nested(
+        "tpu.v7.novel", [tpumetrics.MetricSample("tpu.v7.novel", 0, 7.5)])
+    cache: dict = {}
+    report = ingest_response_py(raw, cache, None, passthrough=True)
+    assert report.dialect == tpumetrics.NESTED
+    assert cache[0]["raw"] == {("tpu.v7.novel", ""): 7.5}
+
+
+def test_passthrough_inert_on_per_metric_only_runtime():
+    """A runtime that rejects the batched selector (our nested model)
+    serves only explicitly-requested families — unknown names are never
+    on the wire, so passthrough collects nothing and the exporter still
+    works through the pinned per-metric path. Pinned so the limitation
+    is a documented behavior, not a surprise."""
+    with FakeLibtpuServer(num_chips=2, dialect="nested") as server:
+        server.extra_metrics["tpu.v7.novel"] = 7.5
+        col = LibtpuCollector(LibtpuClient(ports=(server.port,),
+                                           rpc_timeout=1.0),
+                              passthrough_unknown=True)
+        try:
+            devices = col.discover()
+            col.begin_tick()
+            col.wait_ready(5.0)
+            sample = col.sample(devices[0])
+            assert sample.raw_values == {}
+            assert schema.DUTY_CYCLE.name in sample.values  # pinned path OK
+        finally:
+            col.close()
+
+
 def test_passthrough_flag_plumbs():
     from kube_gpu_stats_tpu.config import from_args
 
