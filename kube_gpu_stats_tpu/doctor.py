@@ -580,6 +580,17 @@ def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
                                 or r.name.startswith("libtpu:"))
             for r in results)
         if not external_ok:
+            # A WARN sysfs row can still mean chips ARE enumerable (e.g.
+            # attributes unreadable for lack of privileges) — that is an
+            # external surface whose fix is mounts/permissions, not
+            # embedded mode. Check discovery itself before suggesting.
+            try:
+                from .collectors.sysfs import SysfsCollector
+
+                external_ok = bool(SysfsCollector(cfg.sysfs_root).discover())
+            except Exception:  # noqa: BLE001 - advisory gate, best-effort
+                pass
+        if not external_ok:
             results.extend(_bounded(
                 "embedded", lambda: check_embedded_viability(cfg),
                 timeout=90.0))

@@ -60,7 +60,7 @@ class PollLoop:
         rediscovery_interval: float = 60.0,
         process_metrics: bool = True,
         drop_labels: Sequence[str] = (),
-        process_openers: Callable[[str], Sequence[tuple[str, str, float]]] | None = None,
+        process_openers: Callable[[str], Sequence[tuple[str, str, str, float]]] | None = None,
         push_stats: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
         render_stats: Callable[[SnapshotBuilder], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
@@ -432,13 +432,16 @@ class PollLoop:
         if self._process_openers is not None:
             for dev, _ in results:
                 base = self._device_labels(dev)
-                # Holder entries are (pid, comm, value): 1 per real holder,
-                # the fold count on the capped {comm="_overflow"} series
-                # (procopen.scan bounds cardinality).
-                for pid, comm, value in self._process_openers(dev.device_path):
+                # Holder entries are (pid, comm, pod_uid, value): 1 per
+                # real holder, the fold count on the capped
+                # {comm="_overflow"} series (procopen.scan bounds
+                # cardinality; pod_uid from the holder's cgroup path).
+                for pid, comm, pod_uid, value in \
+                        self._process_openers(dev.device_path):
                     builder.add(
                         schema.PROCESS_OPEN, value,
-                        base + [("pid", pid), ("comm", comm)],
+                        base + [("pid", pid), ("comm", comm),
+                                ("pod_uid", pod_uid)],
                     )
 
         builder.add(schema.SELF_DEVICES, float(len(results)))

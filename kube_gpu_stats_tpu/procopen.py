@@ -8,7 +8,11 @@ device nodes), visible as ``/proc/<pid>/fd/*`` symlinks. On plain TPU
 VMs — where there is no kubelet to attribute against (SURVEY.md §2 C3)
 — this is the only workload attribution available.
 
-Exported as ``accelerator_process_open{..., pid, comm} 1`` per holder.
+Exported as ``accelerator_process_open{..., pid, comm, pod_uid} 1`` per
+holder. ``pod_uid`` comes from the holder's cgroup path (the
+``...podXXXX...`` component kubelet drivers put there, systemd or
+cgroupfs layout) — pod attribution for the process table with no kubelet
+API at all, and the cross-check key against the PodResources join.
 Scanning every fd of every process is far too slow for the poll tick, so
 the watcher runs on the attribution cadence (E4, default 10 s) and the
 poll loop reads its cached result — same off-hot-path contract as the
@@ -19,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 from typing import Callable, Sequence
 
 from .workers import PeriodicRefresher
@@ -37,10 +42,30 @@ MAX_HOLDERS_PER_DEVICE = 32
 # the old cap silently truncated).
 OVERFLOW_COMM = "_overflow"
 
-# One exported holder entry: (pid label value, comm label value, gauge
-# value). Normal holders are (str(pid), comm, 1.0); the overflow entry is
-# ("", "_overflow", <folded holder count>).
-Holder = tuple[str, str, float]
+# One exported holder entry: (pid label value, comm label value, pod_uid
+# label value, gauge value). Normal holders are (str(pid), comm, uid, 1.0);
+# the overflow entry is ("", "_overflow", "", <folded holder count>).
+Holder = tuple[str, str, str, float]
+
+# Pod UID inside a kubelet-managed cgroup path. Two layouts exist:
+# systemd driver:  .../kubepods-burstable-pod0a1b2c3d_e4f5_....slice/...
+# cgroupfs driver: /kubepods/burstable/pod0a1b2c3d-e4f5-.../...
+_POD_UID_RE = re.compile(
+    r"pod([0-9a-f]{8}[-_][0-9a-f]{4}[-_][0-9a-f]{4}[-_][0-9a-f]{4}"
+    r"[-_][0-9a-f]{12})")
+
+
+def _pod_uid(proc_root: str, pid: str) -> str:
+    """Pod UID owning `pid` per its cgroup path, "" when not in a pod
+    (plain VM process) or unreadable. Read only for holders that survive
+    the cardinality cap — never one file per process on the node."""
+    try:
+        with open(os.path.join(proc_root, pid, "cgroup")) as f:
+            data = f.read()
+    except OSError:
+        return ""
+    match = _POD_UID_RE.search(data)
+    return match.group(1).replace("_", "-") if match else ""
 
 
 def scan(proc_root: str, device_paths: Sequence[str],
@@ -86,14 +111,18 @@ def scan(proc_root: str, device_paths: Sequence[str],
         for path in held:
             raw[path].append((int(pid), comm))
     out: dict[str, list[Holder]] = {}
+    uid_cache: dict[int, str] = {}
     for path, holders in raw.items():
         holders.sort()  # deterministic keep-set under the cap
-        kept: list[Holder] = [
-            (str(pid), comm, 1.0) for pid, comm in holders[:max_holders]
-        ]
+        kept: list[Holder] = []
+        for pid, comm in holders[:max_holders]:
+            uid = uid_cache.get(pid)
+            if uid is None:
+                uid = uid_cache[pid] = _pod_uid(proc_root, str(pid))
+            kept.append((str(pid), comm, uid, 1.0))
         overflow = len(holders) - max_holders
         if overflow > 0:
-            kept.append(("", OVERFLOW_COMM, float(overflow)))
+            kept.append(("", OVERFLOW_COMM, "", float(overflow)))
         out[path] = kept
     return out
 

@@ -150,11 +150,13 @@ PROCESS_OPEN = MetricSpec(
     "1 per process currently holding this device node open (procfs fd "
     "scan — the NVML-free analog of nvidia-smi's process table). The "
     "workload attribution that works on plain TPU VMs with no kubelet; "
-    "refreshed on the attribution cadence, not per tick. Cardinality is "
-    "capped at --max-process-series holders per device; the excess is "
-    'folded into one {pid="",comm="_overflow"} series whose value is the '
-    "folded holder count.",
-    extra_labels=("pid", "comm"),
+    "refreshed on the attribution cadence, not per tick. pod_uid is "
+    "parsed from the holder's cgroup path (kubelet systemd or cgroupfs "
+    "layout; empty outside Kubernetes) — pod attribution with no kubelet "
+    "API. Cardinality is capped at --max-process-series holders per "
+    'device; the excess is folded into one {pid="",comm="_overflow"} '
+    "series whose value is the folded holder count.",
+    extra_labels=("pid", "comm", "pod_uid"),
 )
 
 WORKLOAD_STEPS = MetricSpec(

@@ -375,3 +375,24 @@ def test_embedded_hint_inconclusive_probe_is_not_an_all_clear(tmp_path,
     assert row.status == doc.SKIP
     assert "inconclusive" in row.detail
     assert "nothing to export" not in row.detail
+
+
+def test_embedded_hint_absent_when_sysfs_discovers_despite_warn(tmp_path,
+                                                                monkeypatch):
+    """Chips enumerable but attributes unreadable (privilege problem):
+    that's an external surface needing mounts, not embedded mode — the
+    probe must not run (review finding)."""
+    from kube_gpu_stats_tpu import doctor as doc
+
+    def boom(timeout=60.0):
+        raise AssertionError("probe must not run when sysfs enumerates")
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform", boom)
+    # Bare accel dirs: discovery succeeds, attribute reads don't.
+    for i in range(2):
+        (tmp_path / "sys" / "class" / "accel" / f"accel{i}").mkdir(
+            parents=True)
+    cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "sys"),
+                 libtpu_ports=(1,))
+    results = doc.run_checks(cfg)
+    assert not any(r.name == "embedded" for r in results)

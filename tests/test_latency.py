@@ -54,7 +54,7 @@ def test_render_cost_bounded_at_32_chip_full_label_scale():
             return {"pod": f"train-{device.index}", "namespace": "ml",
                     "container": "worker"}
 
-    holders = [(str(1000 + i), f"proc{i}", 1.0) for i in range(8)]
+    holders = [(str(1000 + i), f"proc{i}", "", 1.0) for i in range(8)]
     reg = Registry()
     loop = PollLoop(
         MockCollector(num_devices=32, accel_type="tpu-v5p"),

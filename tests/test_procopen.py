@@ -30,9 +30,9 @@ def test_scan_maps_holders_to_devices(tmp_path):
         103: ("bash", ["/dev/pts/0"]),
     })
     result = procopen.scan(str(tmp_path), ["/dev/accel0", "/dev/accel1"])
-    assert result["/dev/accel0"] == [("101", "python3", 1.0)]
-    assert result["/dev/accel1"] == [("101", "python3", 1.0),
-                                     ("102", "libtpu_worker", 1.0)]
+    assert result["/dev/accel0"] == [("101", "python3", "", 1.0)]
+    assert result["/dev/accel1"] == [("101", "python3", "", 1.0),
+                                     ("102", "libtpu_worker", "", 1.0)]
 
 
 def test_scan_survives_unreadable_and_vanishing_entries(tmp_path):
@@ -41,7 +41,7 @@ def test_scan_survives_unreadable_and_vanishing_entries(tmp_path):
     (tmp_path / "202").mkdir()
     # A dangling fd symlink target is still a string match candidate.
     result = procopen.scan(str(tmp_path), ["/dev/accel0"])
-    assert result["/dev/accel0"] == [("201", "worker", 1.0)]
+    assert result["/dev/accel0"] == [("201", "worker", "", 1.0)]
     # Missing /proc entirely: empty map for every device, no raise.
     assert procopen.scan(str(tmp_path / "nope"), ["/dev/accel0"]) == {
         "/dev/accel0": []
@@ -60,23 +60,23 @@ def test_scan_caps_holder_cardinality_with_visible_overflow(tmp_path):
     holders = result["/dev/accel0"]
     assert len(holders) == procopen.MAX_HOLDERS_PER_DEVICE + 1
     real, overflow = holders[:-1], holders[-1]
-    assert real == [(str(1000 + i), f"w{i}", 1.0)
+    assert real == [(str(1000 + i), f"w{i}", "", 1.0)
                     for i in range(procopen.MAX_HOLDERS_PER_DEVICE)]
-    assert overflow == ("", procopen.OVERFLOW_COMM,
+    assert overflow == ("", procopen.OVERFLOW_COMM, "",
                         float(100 - procopen.MAX_HOLDERS_PER_DEVICE))
     # Identity is stable scan-over-scan for a fixed population.
     assert procopen.scan(str(tmp_path), ["/dev/accel0"]) == result
     # A custom cap bounds the same way.
     capped = procopen.scan(str(tmp_path), ["/dev/accel0"], max_holders=5)
     assert len(capped["/dev/accel0"]) == 6
-    assert capped["/dev/accel0"][-1] == ("", "_overflow", 95.0)
+    assert capped["/dev/accel0"][-1] == ("", "_overflow", "", 95.0)
 
 
 def test_missing_comm_yields_empty_string(tmp_path):
     make_proc(tmp_path, {301: ("x", ["/dev/accel0"])})
     (tmp_path / "301" / "comm").unlink()
     result = procopen.scan(str(tmp_path), ["/dev/accel0"])
-    assert result["/dev/accel0"] == [("301", "", 1.0)]
+    assert result["/dev/accel0"] == [("301", "", "", 1.0)]
 
 
 def test_watcher_keeps_last_good_map(tmp_path):
@@ -84,20 +84,20 @@ def test_watcher_keeps_last_good_map(tmp_path):
     watcher = procopen.DeviceProcessWatcher(
         lambda: ["/dev/accel0"], proc_root=str(tmp_path))
     watcher.refresh_once()
-    assert watcher.lookup("/dev/accel0") == [("401", "train", 1.0)]
+    assert watcher.lookup("/dev/accel0") == [("401", "train", "", 1.0)]
 
     def boom():
         raise RuntimeError("discover broke")
 
     watcher._paths_fn = boom
     watcher.refresh_once()  # must not raise; keeps the last map
-    assert watcher.lookup("/dev/accel0") == [("401", "train", 1.0)]
+    assert watcher.lookup("/dev/accel0") == [("401", "train", "", 1.0)]
     assert watcher.lookup("/dev/other") == []
 
 
 def test_poll_loop_emits_process_open_series(tmp_path):
     registry = Registry()
-    openers = {"/dev/accel0": [("7", "jax_worker", 1.0)], "/dev/accel1": []}
+    openers = {"/dev/accel0": [("7", "jax_worker", "", 1.0)], "/dev/accel1": []}
     loop = PollLoop(
         MockCollector(num_devices=2), registry, deadline=5.0,
         process_openers=lambda path: openers.get(path, []),
@@ -136,8 +136,8 @@ def test_daemon_wires_watcher_only_when_enabled(tmp_path):
 
 def test_poll_loop_emits_overflow_series(tmp_path):
     registry = Registry()
-    openers = {"/dev/accel0": [("7", "jax_worker", 1.0),
-                               ("", procopen.OVERFLOW_COMM, 68.0)]}
+    openers = {"/dev/accel0": [("7", "jax_worker", "", 1.0),
+                               ("", procopen.OVERFLOW_COMM, "", 68.0)]}
     loop = PollLoop(
         MockCollector(num_devices=1), registry, deadline=5.0,
         process_openers=lambda path: openers.get(path, []),
@@ -150,3 +150,42 @@ def test_poll_loop_emits_overflow_series(tmp_path):
     overflow = series[procopen.OVERFLOW_COMM]
     assert overflow.value == 68.0
     assert dict(overflow.labels)["pid"] == ""
+
+
+def test_pod_uid_from_cgroup_both_drivers(tmp_path):
+    """The pod UID lands in the holder entry from either kubelet cgroup
+    layout; non-pod processes get an empty string."""
+    make_proc(tmp_path, {
+        501: ("systemd-style", ["/dev/accel0"]),
+        502: ("cgroupfs-style", ["/dev/accel0"]),
+        503: ("plain-vm", ["/dev/accel0"]),
+    })
+    (tmp_path / "501" / "cgroup").write_text(
+        "0::/kubepods.slice/kubepods-burstable.slice/"
+        "kubepods-burstable-pod0a1b2c3d_e4f5_6789_abcd_ef0123456789.slice/"
+        "cri-containerd-deadbeef.scope\n")
+    (tmp_path / "502" / "cgroup").write_text(
+        "11:memory:/kubepods/besteffort/"
+        "pod11223344-5566-7788-99aa-bbccddeeff00/deadbeef\n")
+    (tmp_path / "503" / "cgroup").write_text("0::/user.slice\n")
+    result = procopen.scan(str(tmp_path), ["/dev/accel0"])
+    by_pid = {h[0]: h[2] for h in result["/dev/accel0"]}
+    assert by_pid["501"] == "0a1b2c3d-e4f5-6789-abcd-ef0123456789"
+    assert by_pid["502"] == "11223344-5566-7788-99aa-bbccddeeff00"
+    assert by_pid["503"] == ""
+
+
+def test_pod_uid_label_reaches_exposition(tmp_path):
+    registry = Registry()
+    openers = {"/dev/accel0": [
+        ("7", "jax_worker", "0a1b2c3d-e4f5-6789-abcd-ef0123456789", 1.0)]}
+    loop = PollLoop(
+        MockCollector(num_devices=1), registry, deadline=5.0,
+        process_openers=lambda path: openers.get(path, []),
+    )
+    loop.tick()
+    loop.stop()
+    text = registry.snapshot().render()
+    assert 'pod_uid="0a1b2c3d-e4f5-6789-abcd-ef0123456789"' in text
+    from kube_gpu_stats_tpu import validate
+    assert validate.check(text) == []
