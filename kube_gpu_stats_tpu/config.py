@@ -58,6 +58,7 @@ class Config:
     tls_cert_file: str = ""  # both set = serve HTTPS
     tls_key_file: str = ""
     tls_client_ca_file: str = ""  # set = require client certs (mTLS)
+    max_concurrent_scrapes: int = 16  # parallel /metrics renders; 0 = off
     auth_username: str = ""  # + password hash = basic auth on /metrics
     auth_password_sha256: str = ""
 
@@ -193,6 +194,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CA bundle; set = require and verify a client "
                         "certificate on every connection (mTLS). Needs "
                         "--tls-cert-file/--tls-key-file")
+    p.add_argument("--max-concurrent-scrapes", type=int,
+                   default=int(_env("MAX_CONCURRENT_SCRAPES", "16")),
+                   help="parallel /metrics renders before answering 503 "
+                        "(scrape-storm guard; probes exempt; 0 disables)")
     p.add_argument("--auth-username", default=_env("AUTH_USERNAME", ""),
                    help="basic-auth user for all endpoints except "
                         "/healthz and /readyz (kubelet probes)")
@@ -343,6 +348,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         tls_cert_file=args.tls_cert_file,
         tls_key_file=args.tls_key_file,
         tls_client_ca_file=args.tls_client_ca_file,
+        max_concurrent_scrapes=args.max_concurrent_scrapes,
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
     )

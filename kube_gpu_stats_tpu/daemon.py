@@ -202,6 +202,7 @@ class Daemon:
             tls_cert_file=cfg.tls_cert_file,
             tls_key_file=cfg.tls_key_file,
             tls_client_ca_file=cfg.tls_client_ca_file,
+            max_concurrent_scrapes=cfg.max_concurrent_scrapes,
             auth_username=cfg.auth_username,
             auth_password_sha256=cfg.auth_password_sha256,
             render_stats=self.render_stats,

@@ -60,6 +60,8 @@ class RenderStats:
         self._lock = threading.Lock()
         self._hists: dict[str, HistogramState] = {}
         self._bytes: dict[str, int] = {}
+        self._rejected = 0
+        self._rejected_warned = False
 
     def observe(self, output: str, seconds: float, nbytes: int) -> None:
         with self._lock:
@@ -73,16 +75,32 @@ class RenderStats:
             self._hists[output] = hist.observe(seconds)
             self._bytes[output] = self._bytes.get(output, 0) + nbytes
 
+    def reject(self) -> None:
+        """Count a scrape the storm guard answered 503 — the guard must
+        be diagnosable from the exposition, not just from gaps."""
+        with self._lock:
+            self._rejected += 1
+            first = not self._rejected_warned
+            self._rejected_warned = True
+        if first:
+            log.warning("scrape-storm guard fired: a /metrics request was "
+                        "answered 503 (max-concurrent-scrapes); further "
+                        "rejections count in "
+                        "collector_scrapes_rejected_total")
+
     def contribute(self, builder) -> None:
         """Fold current state into a SnapshotBuilder (poll-loop thread)."""
         with self._lock:
             hists = [self._hists[k] for k in sorted(self._hists)]
             sizes = sorted(self._bytes.items())
+            rejected = self._rejected
         for hist in hists:
             builder.add_histogram(hist)
         for output, total in sizes:
             builder.add(schema.SELF_RENDERED_BYTES, float(total),
                         (("output", output),))
+        if rejected:
+            builder.add(schema.SELF_SCRAPES_REJECTED, float(rejected))
 
 
 class MetricsServer:
@@ -115,6 +133,7 @@ class MetricsServer:
                  tls_cert_file: str = "", tls_key_file: str = "",
                  tls_client_ca_file: str = "",
                  auth_username: str = "", auth_password_sha256: str = "",
+                 max_concurrent_scrapes: int = 16,
                  render_stats: RenderStats | None = None):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
@@ -122,6 +141,16 @@ class MetricsServer:
         self._auth = (
             (auth_username, auth_password_sha256.lower())
             if auth_username else None
+        )
+        # Scrape-storm guard (exporter-toolkit web.max-requests analog):
+        # ThreadingHTTPServer spawns one thread per connection with no
+        # ceiling, so N misbehaving scrapers = N concurrent renders.
+        # Renders beyond the cap get an immediate 503 (Retry-After: 1)
+        # instead of queueing; /healthz and /readyz stay exempt so
+        # kubelet probes always land. 0 disables the cap.
+        self._scrape_slots = (
+            threading.BoundedSemaphore(max_concurrent_scrapes)
+            if max_concurrent_scrapes > 0 else None
         )
 
         outer = self
@@ -176,33 +205,52 @@ class MetricsServer:
                 if path == "/metrics":
                     import time as _time
 
-                    # Content negotiation: Prometheus asks for OpenMetrics
-                    # with an explicit Accept; default stays text 0.0.4.
-                    accept = self.headers.get("Accept", "")
-                    use_om = "application/openmetrics-text" in accept
-                    render_start = _time.monotonic()
-                    body = (
-                        outer._registry.snapshot()
-                        .render(openmetrics=use_om)
-                        .encode()
-                    )
-                    if len(body) >= outer.GZIP_MIN_BYTES and _gzip_accepted(
-                        self.headers.get("Accept-Encoding", "")
-                    ):
-                        import gzip
+                    slots = outer._scrape_slots
+                    if slots is not None and not slots.acquire(blocking=False):
+                        if outer._render_stats is not None:
+                            outer._render_stats.reject()
+                        body = b"too many concurrent scrapes\n"
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    try:
+                        # Content negotiation: Prometheus asks for
+                        # OpenMetrics with an explicit Accept; default
+                        # stays text 0.0.4.
+                        accept = self.headers.get("Accept", "")
+                        use_om = "application/openmetrics-text" in accept
+                        render_start = _time.monotonic()
+                        body = (
+                            outer._registry.snapshot()
+                            .render(openmetrics=use_om)
+                            .encode()
+                        )
+                        if len(body) >= outer.GZIP_MIN_BYTES and \
+                                _gzip_accepted(
+                                    self.headers.get("Accept-Encoding", "")):
+                            import gzip
 
-                        # Level 3, not 6: measured on a 32-chip 161 KB
-                        # exposition, 0.4 ms vs 1.1 ms for only ~1 KB more
-                        # wire (10.0 vs 8.9 KB) — compression latency sits
-                        # on the north-star scrape path, the bytes don't.
-                        body = gzip.compress(body, compresslevel=3)
-                        encoding = "gzip"
-                    if outer._render_stats is not None:
-                        # Render + gzip, post-compression size: the cost a
-                        # scrape actually pays and the bytes it ships.
-                        outer._render_stats.observe(
-                            "http", _time.monotonic() - render_start,
-                            len(body))
+                            # Level 3, not 6: measured on a 32-chip 161 KB
+                            # exposition, 0.4 ms vs 1.1 ms for only ~1 KB
+                            # more wire (10.0 vs 8.9 KB) — compression
+                            # latency sits on the north-star scrape path,
+                            # the bytes don't.
+                            body = gzip.compress(body, compresslevel=3)
+                            encoding = "gzip"
+                        if outer._render_stats is not None:
+                            # Render + gzip, post-compression size: the
+                            # cost a scrape actually pays and the bytes
+                            # it ships.
+                            outer._render_stats.observe(
+                                "http", _time.monotonic() - render_start,
+                                len(body))
+                    finally:
+                        if slots is not None:
+                            slots.release()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",

@@ -271,6 +271,13 @@ SELF_RENDERED_BYTES = MetricSpec(
     "budget.",
     extra_labels=("output",),
 )
+SELF_SCRAPES_REJECTED = MetricSpec(
+    "collector_scrapes_rejected_total",
+    MetricType.COUNTER,
+    "Scrapes answered 503 by the --max-concurrent-scrapes storm guard. "
+    "A nonzero rate means something is scraping far too hard (second "
+    "Prometheus, misconfigured SD) and real scrapes are seeing gaps.",
+)
 SELF_POLL_ERRORS = MetricSpec(
     "collector_poll_errors_total",
     MetricType.COUNTER,
@@ -354,6 +361,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_DURATION,
     SELF_SCRAPE_DURATION,
     SELF_RENDERED_BYTES,
+    SELF_SCRAPES_REJECTED,
     SELF_POLL_ERRORS,
     SELF_DEVICES,
     SELF_INFO,

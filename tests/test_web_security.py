@@ -327,3 +327,110 @@ def test_unreadable_tls_files_do_not_leak_listener(cert_pair):
     srv = MetricsServer(Registry(), host="127.0.0.1", port=port)
     srv.start()
     srv.stop()
+
+
+# -- scrape-storm concurrency cap --------------------------------------------
+
+def test_scrape_cap_503s_excess_concurrent_renders():
+    """Renders beyond max_concurrent_scrapes answer 503 immediately;
+    probes stay exempt; the slots recycle once the storm passes."""
+    import concurrent.futures
+    import threading as _threading
+    import urllib.request
+
+    class SlowSnapshot:
+        timestamp = 1.0
+
+        def __init__(self, gate):
+            self._gate = gate
+
+        def render(self, openmetrics=False):
+            self._gate.wait(5)
+            return "accelerator_up 1\n" * 20
+
+    class SlowRegistry(Registry):
+        def __init__(self, gate):
+            super().__init__()
+            self._gate = gate
+
+        def snapshot(self):
+            return SlowSnapshot(self._gate)
+
+    gate = _threading.Event()
+    started = _threading.Semaphore(0)  # released once per render begun
+    srv = MetricsServer(SlowRegistry(gate), host="127.0.0.1", port=0,
+                        max_concurrent_scrapes=2)
+    # Signal render starts deterministically (no sleeps): wrap render.
+    real_snapshot = srv._registry.snapshot
+
+    def snapshot():
+        snap = real_snapshot()
+        real_render = snap.render
+
+        def render(openmetrics=False):
+            started.release()
+            return real_render(openmetrics)
+
+        snap.render = render
+        return snap
+
+    srv._registry.snapshot = snapshot
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}/metrics"
+
+    def fetch_code():
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status
+        except urllib.error.HTTPError as exc:
+            return exc.code
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=6) as pool:
+            first_two = [pool.submit(fetch_code) for _ in range(2)]
+            # Both slots provably occupied (renders started, gated).
+            assert started.acquire(timeout=10)
+            assert started.acquire(timeout=10)
+            # Every further scrape must bounce off the cap synchronously.
+            rejected = [pool.submit(fetch_code).result(timeout=10)
+                        for _ in range(4)]
+            gate.set()
+            held = sorted(f.result(timeout=10) for f in first_two)
+        assert rejected == [503, 503, 503, 503], rejected
+        assert held == [200, 200], held
+        # Probes were never subject to the cap.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            assert r.status == 200
+        # Slots recycled: a lone scrape succeeds now.
+        assert fetch_code() == 200
+    finally:
+        srv.stop()
+
+
+def test_scrape_cap_zero_disables():
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        max_concurrent_scrapes=0)
+    srv.start()
+    try:
+        assert fetch(srv.port).status == 200
+    finally:
+        srv.stop()
+
+
+def test_rejected_scrapes_surface_as_self_metric():
+    from kube_gpu_stats_tpu.exposition import RenderStats
+    from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+    rs = RenderStats()
+    builder = SnapshotBuilder()
+    rs.contribute(builder)
+    assert not any(s.spec.name == schema.SELF_SCRAPES_REJECTED.name
+                   for s in builder.build().series)  # absent until it fires
+    rs.reject()
+    rs.reject()
+    builder = SnapshotBuilder()
+    rs.contribute(builder)
+    (series,) = [s for s in builder.build().series
+                 if s.spec.name == schema.SELF_SCRAPES_REJECTED.name]
+    assert series.value == 2.0
