@@ -184,13 +184,14 @@ def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
             _terminate(proc)
 
 
-def _tcp_open(port: int, timeout: float = 0.5) -> bool:
+def _tcp_open(port: int, timeout: float = 0.5,
+              host: str = "127.0.0.1") -> bool:
     import socket
 
     s = socket.socket()
     s.settimeout(timeout)
     try:
-        s.connect(("127.0.0.1", port))
+        s.connect((host, port))
         return True
     except OSError:
         return False

@@ -292,6 +292,14 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         )
     if args.max_process_series < 1:
         parser.error("--max-process-series must be >= 1")
+    if args.interval <= 0:
+        parser.error("--interval must be > 0 seconds")
+    if args.deadline <= 0:
+        parser.error("--deadline must be > 0 seconds")
+    if args.max_concurrent_scrapes < 0:
+        parser.error("--max-concurrent-scrapes must be >= 0 (0 disables)")
+    if args.remote_write_interval <= 0:
+        parser.error("--remote-write-interval must be > 0 seconds")
     if args.remote_write_protocol not in ("1.0", "2.0"):
         # argparse `choices` only validates CLI-supplied values; a bad
         # KTS_REMOTE_WRITE_PROTOCOL env default would otherwise crash the

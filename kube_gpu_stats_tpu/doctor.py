@@ -511,6 +511,40 @@ def _bounded(name: str, probe: Callable[[], object],
         pool.shutdown(wait=False, cancel_futures=True)
 
 
+def check_port_scan(cfg: Config) -> CheckResult:
+    """Advisory, reached only when every CONFIGURED libtpu port is down:
+    scan the conventional runtime-metrics port neighborhood (default
+    8431 + the next few — multi-process runtimes bind consecutive ports)
+    for anything listening, so a runtime serving on a nonstandard port
+    diagnoses itself instead of presenting as 'service down'."""
+    from .bench import _tcp_open
+
+    base = min(cfg.libtpu_ports) if cfg.libtpu_ports else 8431
+    candidates = sorted(
+        (set(range(base, base + 8)) | {8431}) - set(cfg.libtpu_ports))
+    if not candidates:
+        # Configured ports already cover the whole neighborhood.
+        return _result(
+            "port-scan", SKIP,
+            "configured ports span the conventional neighborhood; "
+            "nothing further to scan")
+    # Scan the host the libtpu client actually targets — not always
+    # loopback (cfg.libtpu_addr exists for tunneled/remote runtimes).
+    open_ports = [p for p in candidates
+                  if _tcp_open(p, timeout=0.3, host=cfg.libtpu_addr)]
+    if open_ports:
+        return _result(
+            "port-scan", WARN,
+            f"configured port(s) {list(cfg.libtpu_ports)} are down, but "
+            f"{cfg.libtpu_addr} listens on {open_ports} — a runtime on a "
+            f"nonstandard port? Try TPU_RUNTIME_METRICS_PORTS="
+            f"{','.join(map(str, open_ports))}")
+    return _result(
+        "port-scan", SKIP,
+        f"nothing listening on the conventional neighborhood "
+        f"({candidates[0]}-{candidates[-1]}) either")
+
+
 def check_embedded_viability(cfg: Config) -> CheckResult:
     """Only reached when no external metric surface exists (sysfs absent,
     every libtpu port down): ask a BOUNDED subprocess whether in-process
@@ -591,6 +625,10 @@ def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
             except Exception:  # noqa: BLE001 - advisory gate, best-effort
                 pass
         if not external_ok:
+            # external_ok False already implies no libtpu:* row was OK.
+            if cfg.libtpu_ports:
+                results.extend(_bounded(
+                    "port-scan", lambda: check_port_scan(cfg)))
             results.extend(_bounded(
                 "embedded", lambda: check_embedded_viability(cfg),
                 timeout=90.0))

@@ -396,3 +396,62 @@ def test_embedded_hint_absent_when_sysfs_discovers_despite_warn(tmp_path,
                  libtpu_ports=(1,))
     results = doc.run_checks(cfg)
     assert not any(r.name == "embedded" for r in results)
+
+
+def test_port_scan_finds_runtime_on_nonstandard_port(tmp_path, monkeypatch):
+    """Configured port down + a fake runtime on a neighbor port: doctor
+    names the open port and the env var to point at it."""
+    from kube_gpu_stats_tpu import doctor as doc
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform",
+                        lambda timeout=60.0: "cpu")
+    with FakeLibtpuServer(num_chips=1) as server:
+        # Configure a dead port whose +8 neighborhood contains the live one.
+        base = server.port - 3
+        cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "nosys"),
+                     libtpu_ports=(base,))
+        results = doc.run_checks(cfg)
+    row = next(r for r in results if r.name == "port-scan")
+    assert row.status == doc.WARN
+    assert str(server.port) in row.detail
+    assert "TPU_RUNTIME_METRICS_PORTS" in row.detail
+
+
+def test_port_scan_skip_when_neighborhood_quiet(tmp_path, monkeypatch):
+    from kube_gpu_stats_tpu import doctor as doc
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform",
+                        lambda timeout=60.0: "cpu")
+    cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "nosys"),
+                 libtpu_ports=(1,))
+    results = doc.run_checks(cfg)
+    row = next(r for r in results if r.name == "port-scan")
+    assert row.status == doc.SKIP
+
+
+def test_flag_value_validation():
+    import pytest
+
+    from kube_gpu_stats_tpu.config import from_args
+
+    for bad in (["--interval", "0"], ["--deadline", "-1"],
+                ["--max-concurrent-scrapes", "-1"],
+                ["--remote-write-interval", "0"]):
+        with pytest.raises(SystemExit):
+            from_args(["--backend", "mock"] + bad)
+
+
+def test_port_scan_skips_cleanly_when_config_covers_neighborhood(
+        tmp_path, monkeypatch):
+    """8 consecutive configured ports (the multi-process layout) must not
+    crash the advisory scan (review finding: empty candidate set)."""
+    from kube_gpu_stats_tpu import doctor as doc
+
+    monkeypatch.setattr("kube_gpu_stats_tpu.bench._probe_jax_platform",
+                        lambda timeout=60.0: "cpu")
+    cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "nosys"),
+                 libtpu_ports=tuple(range(8431, 8439)))
+    results = doc.run_checks(cfg)
+    row = next(r for r in results if r.name == "port-scan")
+    assert row.status == doc.SKIP
+    assert "crash" not in row.detail
