@@ -246,9 +246,11 @@ panels = [
         thresholds=[0.050],
         description="Poll-tick wall time; threshold line = 50 ms budget."),
     timeseries(
-        "Poll errors by reason",
+        "Poll errors / rejected scrapes",
         [('sum by (reason) (rate(collector_poll_errors_total[5m]))',
-          '{{reason}}')],
+          '{{reason}}'),
+         ('sum(rate(collector_scrapes_rejected_total[5m]))',
+          'scrapes rejected (storm guard)')],
         "ops", {"x": 12, "y": 36, "w": 12, "h": 8}, per_chip=False),
 
     # Row 7 — fleet health cross-checks.

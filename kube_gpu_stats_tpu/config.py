@@ -114,8 +114,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("PUSHGATEWAY_JOB", "kube-tpu-stats"))
     p.add_argument("--remote-write-url",
                    default=_env("REMOTE_WRITE_URL", ""),
-                   help="Prometheus remote_write 1.0 receiver endpoint "
-                        "(Mimir/Thanos/GMP); empty disables")
+                   help="Prometheus remote_write receiver endpoint "
+                        "(Mimir/Thanos/GMP); empty disables; see "
+                        "--remote-write-protocol")
     p.add_argument("--remote-write-job",
                    default=_env("REMOTE_WRITE_JOB", "kube-tpu-stats"),
                    help="job label stamped on every remote-written series")
