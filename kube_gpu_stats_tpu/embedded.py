@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from typing import Iterator, Sequence
 

@@ -19,7 +19,7 @@ from pathlib import Path
 
 from . import schema
 from .registry import HistogramState, Registry
-from .workers import PublishFollower
+from .workers import PublishFollower, push_opener
 
 log = logging.getLogger(__name__)
 
@@ -419,8 +419,6 @@ class PushgatewayPusher(PublishFollower):
             headers={"Content-Type": CONTENT_TYPE},
         )
         try:
-            from .workers import push_opener
-
             # No-redirect opener: a 302 must surface as a failure, not
             # degrade the PUT into a body-less GET (see workers.push_opener).
             with push_opener().open(request, timeout=10):

@@ -30,7 +30,7 @@ import logging
 from . import schema, snappy
 from .proto import prompb, prompb2
 from .registry import Registry, Snapshot, format_value
-from .workers import PublishFollower
+from .workers import PublishFollower, push_opener
 
 log = logging.getLogger(__name__)
 
@@ -185,8 +185,6 @@ class RemoteWriter(PublishFollower):
         request = urllib.request.Request(
             self._url, data=body, method="POST", headers=headers)
         try:
-            from .workers import push_opener
-
             # No-redirect opener: a 302 (e.g. an auth proxy) must land in
             # the failure accounting below, not silently convert the POST
             # into a body-less GET (see workers.push_opener). It also

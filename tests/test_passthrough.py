@@ -6,8 +6,6 @@ waiting for a schema pin update). One family + a label for the raw name
 makes series identity deterministic across restarts and collision-free
 by construction."""
 
-import pytest
-
 from kube_gpu_stats_tpu import schema
 from kube_gpu_stats_tpu.collectors import Sample
 from kube_gpu_stats_tpu.collectors.composite import TpuCollector

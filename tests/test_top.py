@@ -169,7 +169,6 @@ def test_live_rates_against_ticking_exporter():
     positive steps/s — the whole fetch->parse->key->rate pipeline."""
     import time
 
-    from kube_gpu_stats_tpu.collectors.mock import MockCollector
     from kube_gpu_stats_tpu.collectors import Sample
     from kube_gpu_stats_tpu.exposition import MetricsServer
 
