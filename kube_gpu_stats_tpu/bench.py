@@ -55,13 +55,19 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
     server = MetricsServer(registry, host="127.0.0.1", port=0)
     server.start()
 
+    # Bound the scrape sampling: in real mode a burn thread contends for
+    # the (possibly single) host CPU, and an unbounded per-tick scrape
+    # loop could stretch the whole bench past the driver's patience. ~15
+    # samples give a stable p50; the tick loop stays full-length.
+    max_scrapes = min(ticks, 15)
+
     def scrape() -> None:
         # Advertise gzip like a real Prometheus scraper so the measured
         # path includes the compression cost, not just the render.
         request = urllib.request.Request(
             f"http://127.0.0.1:{server.port}/metrics",
             headers={"Accept-Encoding": "gzip"})
-        urllib.request.urlopen(request, timeout=10).read()
+        urllib.request.urlopen(request, timeout=5).read()
 
     try:
         for _ in range(warmup):
@@ -69,9 +75,11 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
             scrape()
         for _ in range(ticks):
             durations.append(loop.tick() * 1000.0)
-            scrape_start = time.monotonic()
-            scrape()
-            scrape_ms.append((time.monotonic() - scrape_start) * 1000.0)
+            if len(scrape_ms) < max_scrapes:
+                scrape_start = time.monotonic()
+                scrape()
+                scrape_ms.append(
+                    (time.monotonic() - scrape_start) * 1000.0)
     finally:
         loop.stop()
         server.stop()
