@@ -64,6 +64,11 @@ def make_sharded_train_step(n_devices: int, *, d_model: int = 256,
         raise ValueError(f"need {n_devices} devices, have {len(devices)}")
     dp, tp = _mesh_shape(n_devices)
     mesh = Mesh(np.asarray(devices[:n_devices]).reshape(dp, tp), ("data", "model"))
+    # Sharded dims must divide by their mesh axis — a non-power-of-two
+    # device count (dp=3 for 6 devices) must not crash the dryrun over
+    # the DEFAULT tiny shapes. Round up, never down (keep >= requested).
+    batch = -(-batch // dp) * dp
+    d_hidden = -(-d_hidden // tp) * tp
 
     w1_sharding = NamedSharding(mesh, P(None, "model"))  # columns
     w2_sharding = NamedSharding(mesh, P("model", None))  # rows

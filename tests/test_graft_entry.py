@@ -83,3 +83,12 @@ def test_dryrun_16_exceeds_test_mesh_uses_subprocess():
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(16)
+
+
+def test_dryrun_handles_non_power_of_two_device_counts():
+    """The driver chooses n_devices; dp=3 (6 devices) must not crash on
+    indivisible default shapes — make_sharded_train_step rounds the
+    sharded dims up to the mesh factors."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(6)
