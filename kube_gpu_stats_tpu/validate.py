@@ -122,12 +122,23 @@ def check(text: str, previous: str | None = None) -> list[str]:
 
 
 def _check_monotone(before: str, after: str, specs) -> Iterable[str]:
+    # Histogram _bucket/_count series are cumulative too — a backwards
+    # step there is the same counter-reset bug class.
+    monotone_names = {
+        name for name, spec in specs.items()
+        if spec.type is schema.MetricType.COUNTER
+    } | {
+        f"{spec.name}{suffix}"
+        for spec in specs.values()
+        if spec.type is schema.MetricType.HISTOGRAM
+        for suffix in ("_bucket", "_count")
+    }
+
     def counters(text):
         return {
             (name, tuple(sorted(labels.items()))): value
             for name, labels, value in parse_exposition(text)
-            if specs.get(name) is not None
-            and specs[name].type is schema.MetricType.COUNTER
+            if name in monotone_names
         }
 
     earlier = counters(before)

@@ -100,3 +100,21 @@ def test_trailing_timestamp_accepted():
             'uuid="",pod="",namespace="",container="",slice="",worker="",'
             'topology=""} 50 1722249600000\n')
     assert validate.check(line) == []
+
+
+def test_histogram_buckets_checked_for_monotonicity():
+    """_bucket/_count series are cumulative; going backwards between two
+    scrapes is the counter-reset bug class and must be flagged."""
+    from kube_gpu_stats_tpu import validate
+
+    before = (
+        'collector_poll_duration_seconds_bucket{le="0.05"} 10\n'
+        'collector_poll_duration_seconds_count 12\n'
+    )
+    after = (
+        'collector_poll_duration_seconds_bucket{le="0.05"} 4\n'
+        'collector_poll_duration_seconds_count 12\n'
+    )
+    problems = validate.check(after, previous=before)
+    assert any("went backwards" in p for p in problems), problems
+    assert validate.check(before, previous=before) == []
