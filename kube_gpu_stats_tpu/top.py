@@ -36,6 +36,7 @@ _GAUGES = {
     "duty": schema.DUTY_CYCLE.name,
     "mem_used": schema.MEMORY_USED.name,
     "mem_total": schema.MEMORY_TOTAL.name,
+    "mem_peak": schema.MEMORY_PEAK.name,
     "power": schema.POWER.name,
     "temp": schema.TEMPERATURE.name,
     "up": schema.DEVICE_UP.name,
@@ -65,6 +66,7 @@ class ChipRow:
     duty: float | None = None
     mem_used: float | None = None
     mem_total: float | None = None
+    mem_peak: float | None = None  # JSON only; the table stays 80-col
     power: float | None = None
     temp: float | None = None
     ici_bps: float = 0.0  # summed over links

@@ -12,12 +12,13 @@ import pathlib
 import subprocess
 
 # Build the native fast paths once per session so a fresh checkout is
-# green without a manual `make` step. Best-effort: if the toolchain is
-# missing, the native tests fail loudly with their own ImportError.
+# green without a manual `make` step — and an EDITED .cc never tests
+# against a stale .so (make's own mtime check makes this a no-op when
+# current). Best-effort: if the toolchain is missing, the native tests
+# fail loudly with their own ImportError.
 _NATIVE = pathlib.Path(__file__).resolve().parent.parent / "kube_gpu_stats_tpu" / "native"
-if not (_NATIVE / "libktsnative.so").exists() or not (_NATIVE / "_wirefast.so").exists():
-    subprocess.run(["make", "-C", str(_NATIVE)], check=False,
-                   capture_output=True, timeout=120)
+subprocess.run(["make", "-C", str(_NATIVE)], check=False,
+               capture_output=True, timeout=120)
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -87,6 +87,7 @@ def test_render_json_frame():
     chip = parsed["chips"][0]
     assert chip["chip"] == "0" and chip["slice"] == "v5p-16"
     assert chip["up"] == 1.0 and "steps_per_s" in chip
+    assert "mem_peak" in chip
 
 
 def test_process_open_counts_holders_excluding_overflow_fold():
