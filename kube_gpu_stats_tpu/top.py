@@ -283,13 +283,16 @@ def main(argv: Sequence[str] | None = None) -> int:
                 if args.once:
                     return 2
             out = render_json(frame) if args.as_json else render_table(frame)
-            if not (args.once or args.as_json or args.no_clear):
+            if not (args.once or args.as_json or args.no_clear) \
+                    and sys.stdout.isatty():
+                # ANSI clear only on a real terminal — piped/redirected
+                # output gets appended frames like --no-clear.
                 sys.stdout.write("\x1b[2J\x1b[H")
             print(out, flush=True)
             if args.once:
                 return 0
             previous = frame
-            time.sleep(args.interval)
+            time.sleep(max(0.2, args.interval))
     except KeyboardInterrupt:
         return 0
 
