@@ -148,6 +148,7 @@ class MetricsServer:
         # Renders beyond the cap get an immediate 503 (Retry-After: 1)
         # instead of queueing; /healthz and /readyz stay exempt so
         # kubelet probes always land. 0 disables the cap.
+        self._profile_lock = threading.Lock()  # /debug/profile single-flight
         self._scrape_slots = (
             threading.BoundedSemaphore(max_concurrent_scrapes)
             if max_concurrent_scrapes > 0 else None
@@ -187,20 +188,26 @@ class MetricsServer:
                     digest.encode(), expected_hash.encode()
                 )
 
+            def _send_plain(self, code: int, body: bytes,
+                            headers: dict | None = None) -> None:
+                self.send_response(code)
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0]
                 encoding = ""
                 if outer._auth is not None and path not in ("/healthz",
                                                             "/readyz"):
                     if not self._authorized():
-                        body = b"unauthorized\n"
-                        self.send_response(401)
-                        self.send_header("WWW-Authenticate",
-                                         'Basic realm="kube-tpu-stats"')
-                        self.send_header("Content-Type", "text/plain")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._send_plain(
+                            401, b"unauthorized\n",
+                            {"WWW-Authenticate":
+                             'Basic realm="kube-tpu-stats"'})
                         return
                 if path == "/metrics":
                     import time as _time
@@ -209,13 +216,8 @@ class MetricsServer:
                     if slots is not None and not slots.acquire(blocking=False):
                         if outer._render_stats is not None:
                             outer._render_stats.reject()
-                        body = b"too many concurrent scrapes\n"
-                        self.send_response(503)
-                        self.send_header("Retry-After", "1")
-                        self.send_header("Content-Type", "text/plain")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._send_plain(503, b"too many concurrent scrapes\n",
+                                         {"Retry-After": "1"})
                         return
                     try:
                         # Content negotiation: Prometheus asks for
@@ -289,6 +291,39 @@ class MetricsServer:
                         body = b"no snapshot published yet\n"
                         self.send_response(503)
                     self.send_header("Content-Type", "text/plain")
+                elif path == "/debug/profile":
+                    # Statistical profile over a bounded window, emitted
+                    # as flamegraph-ready folded stacks (profiler.py).
+                    # Auth-protected like every non-probe path; single-
+                    # flight so two requests can't double the sampling
+                    # overhead.
+                    from . import profiler
+
+                    query = self.path.partition("?")[2]
+                    seconds = 5.0
+                    for part in query.split("&"):
+                        key, _, value = part.partition("=")
+                        if key == "seconds":
+                            try:
+                                seconds = float(value)
+                            except ValueError:
+                                pass
+                    # Comparison-based clamp: min/max pass NaN through,
+                    # and a NaN deadline would return an empty profile.
+                    if not seconds >= 0.1:
+                        seconds = 0.1
+                    if seconds > 30.0:
+                        seconds = 30.0
+                    if not outer._profile_lock.acquire(blocking=False):
+                        self._send_plain(409, b"a profile is already running\n")
+                        return
+                    try:
+                        body = profiler.render_folded(
+                            profiler.sample_stacks(seconds)).encode()
+                    finally:
+                        outer._profile_lock.release()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
                 elif path == "/debug/threads":
                     # pprof analog (SURVEY.md §5): live stack dump of every
                     # thread — enough to diagnose a wedged sampler or a
@@ -310,7 +345,8 @@ class MetricsServer:
                         b"<html><body>kube-tpu-stats "
                         b'<a href="/metrics">/metrics</a> '
                         b'<a href="/healthz">/healthz</a> '
-                        b'<a href="/debug/threads">/debug/threads</a>'
+                        b'<a href="/debug/threads">/debug/threads</a> '
+                        b'<a href="/debug/profile?seconds=5">/debug/profile</a>'
                         b"</body></html>"
                     )
                     self.send_response(200)

@@ -265,3 +265,82 @@ def test_render_stats_labeled_histogram_rendered_form():
     buckets = [s for s in families["collector_scrape_duration_seconds"].samples
                if s.name.endswith("_bucket")]
     assert {s.labels["output"] for s in buckets} == {"http", "textfile"}
+
+
+def test_debug_profile_emits_folded_stacks():
+    """/debug/profile samples every thread for a bounded window and
+    returns flamegraph-ready folded stacks naming the hot function."""
+    import re
+    import threading
+    import time
+    import urllib.request
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    stop = threading.Event()
+
+    def recognizable_busy_function():
+        while not stop.is_set():
+            sum(range(2000))
+
+    worker = threading.Thread(target=recognizable_busy_function,
+                              name="busy-worker", daemon=True)
+    worker.start()
+    srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/profile?seconds=0.4",
+            timeout=15).read().decode()
+    finally:
+        srv.stop()
+        stop.set()
+        worker.join(timeout=5)
+    assert "recognizable_busy_function" in body
+    assert "busy-worker" in body
+    # Folded format: every line is "stack... count".
+    for line in body.splitlines():
+        assert re.fullmatch(r".+ \d+", line), line
+
+
+def test_debug_profile_seconds_clamped_and_single_flight():
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    srv = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}/debug/profile"
+    try:
+        # A nonsense duration clamps (0.1s floor) and still answers.
+        assert urllib.request.urlopen(
+            f"{url}?seconds=banana", timeout=15).status == 200
+        codes = []
+
+        def long_profile():
+            codes.append(urllib.request.urlopen(
+                f"{url}?seconds=1.5", timeout=15).status)
+
+        t = threading.Thread(target=long_profile)
+        t.start()
+        # Deterministic: wait until the long profile observably HOLDS the
+        # lock (a fixed sleep races thread start + connect on loaded CI).
+        import time
+        deadline = time.monotonic() + 10
+        while not srv._profile_lock.locked():
+            assert time.monotonic() < deadline, "profile never took the lock"
+            time.sleep(0.01)
+        try:
+            urllib.request.urlopen(f"{url}?seconds=0.1", timeout=15)
+            second = 200
+        except urllib.error.HTTPError as exc:
+            second = exc.code
+        t.join(timeout=10)
+        assert codes == [200]
+        assert second == 409  # single-flight
+    finally:
+        srv.stop()
