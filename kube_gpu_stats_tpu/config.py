@@ -301,6 +301,13 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         parser.error("--max-concurrent-scrapes must be >= 0 (0 disables)")
     if args.remote_write_interval <= 0:
         parser.error("--remote-write-interval must be > 0 seconds")
+    if args.passthrough_unknown not in ("on", "off"):
+        # Same env-bypasses-argparse-choices class as the protocol check:
+        # KTS_PASSTHROUGH_UNKNOWN=true must fail loudly, not silently
+        # mean "off" on the one node where the operator wanted data.
+        parser.error(
+            f"--passthrough-unknown must be on or off "
+            f"(got {args.passthrough_unknown!r})")
     if args.remote_write_protocol not in ("1.0", "2.0"):
         # argparse `choices` only validates CLI-supplied values; a bad
         # KTS_REMOTE_WRITE_PROTOCOL env default would otherwise crash the

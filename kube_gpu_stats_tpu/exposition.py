@@ -99,8 +99,9 @@ class RenderStats:
         for output, total in sizes:
             builder.add(schema.SELF_RENDERED_BYTES, float(total),
                         (("output", output),))
-        if rejected:
-            builder.add(schema.SELF_SCRAPES_REJECTED, float(rejected))
+        # Unconditional, born at 0: increase()-based alerting misses a
+        # burst entirely if the series first appears already at N.
+        builder.add(schema.SELF_SCRAPES_REJECTED, float(rejected))
 
 
 class MetricsServer:

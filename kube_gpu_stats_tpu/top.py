@@ -51,13 +51,16 @@ _COUNTERS = {
 class ChipRow:
     """One chip's latest values across every family the view renders.
 
-    Keyed by (target index, slice, worker, chip): per-node exporters only
+    Keyed by (target, slice, worker, chip): per-node exporters only
     export local chips, so chips from different targets are different
     hardware even when their topology labels are identical or empty —
     without the target in the key, two dev-VM embedded exporters (all
-    labels "") would silently fold into one chimera row."""
+    labels "") would silently fold into one chimera row. The key uses
+    the target's NAME (url/path), not its position in the fetch list: a
+    transient fetch failure must not shift every later target onto a
+    different identity and cross-match their rate windows."""
 
-    key: tuple[int, str, str, str]
+    key: tuple[object, str, str, str]
     at: float = 0.0  # this target's fetch timestamp (rate denominator)
     accel_type: str = ""
     pod: str = ""
@@ -108,9 +111,11 @@ class Frame:
 
 
 def build_frame(texts: Sequence[str], errors: list[str],
-                ats: Sequence[float] | None = None) -> Frame:
+                ats: Sequence[float] | None = None,
+                targets: Sequence[object] | None = None) -> Frame:
     """Fold parsed exposition text from every target into chip rows.
-    ``ats[i]`` is target i's fetch timestamp (defaults to now)."""
+    ``ats[i]`` is target i's fetch timestamp (defaults to now);
+    ``targets[i]`` its stable identity in row keys (defaults to i)."""
     rows: dict[tuple, ChipRow] = {}
     now = time.monotonic()
 
@@ -118,9 +123,10 @@ def build_frame(texts: Sequence[str], errors: list[str],
     counter_by_id = {name: col for col, name in _COUNTERS.items()}
     for tidx, text in enumerate(texts):
         at = ats[tidx] if ats is not None else now
+        tkey = targets[tidx] if targets is not None else tidx
 
         def row(labels: Mapping[str, str]) -> ChipRow:
-            key = (tidx, labels.get("slice", ""), labels.get("worker", ""),
+            key = (tkey, labels.get("slice", ""), labels.get("worker", ""),
                    labels.get("chip", ""))
             r = rows.get(key)
             if r is None:
@@ -226,7 +232,9 @@ def render_json(frame: Frame) -> str:
 
 # -- CLI ---------------------------------------------------------------------
 
-def snapshot_frame(targets: Sequence[str], previous: Frame | None) -> Frame:
+def snapshot_frame(targets: Sequence[str], previous: Frame | None,
+                   pool: concurrent.futures.ThreadPoolExecutor | None = None
+                   ) -> Frame:
     """Fetch every target concurrently (one slow target must not stall
     the others or skew their rate windows) and fold into a Frame. Any
     fetch/decode failure becomes an error line, never a crash — this is
@@ -234,22 +242,29 @@ def snapshot_frame(targets: Sequence[str], previous: Frame | None) -> Frame:
     errors: list[str] = []
     texts: list[str] = []
     ats: list[float] = []
+    names: list[str] = []
 
     def fetch(target: str) -> tuple[str, float]:
         text = fetch_exposition(target, timeout=5.0)
         return text, time.monotonic()
 
-    with concurrent.futures.ThreadPoolExecutor(
-        max_workers=min(16, len(targets))
-    ) as pool:
+    own_pool = pool is None
+    if own_pool:
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(targets)))
+    try:
         for target, future in [(t, pool.submit(fetch, t)) for t in targets]:
             try:
                 text, at = future.result()
                 texts.append(text)
                 ats.append(at)
+                names.append(target)
             except Exception as exc:  # noqa: BLE001 - rendered, not raised
                 errors.append(f"{target}: {exc}")
-    frame = build_frame(texts, errors, ats)
+    finally:
+        if own_pool:
+            pool.shutdown(wait=False)
+    frame = build_frame(texts, errors, ats, targets=names)
     frame.rates(previous)
     return frame
 
@@ -274,9 +289,13 @@ def main(argv: Sequence[str] | None = None) -> int:
     targets = args.targets or [DEFAULT_TARGET]
 
     previous: Frame | None = None
+    # One executor for the watch loop's lifetime — not 16 threads built
+    # and torn down per refresh.
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(16, len(targets)))
     try:
         while True:
-            frame = snapshot_frame(targets, previous)
+            frame = snapshot_frame(targets, previous, pool)
             if not frame.rows and frame.errors and previous is None:
                 for err in frame.errors:
                     print(f"! {err}", file=sys.stderr)
@@ -295,6 +314,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             time.sleep(max(0.2, args.interval))
     except KeyboardInterrupt:
         return 0
+    finally:
+        pool.shutdown(wait=False)
 
 
 if __name__ == "__main__":  # pragma: no cover

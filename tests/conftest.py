@@ -17,8 +17,11 @@ import subprocess
 # current). Best-effort: if the toolchain is missing, the native tests
 # fail loudly with their own ImportError.
 _NATIVE = pathlib.Path(__file__).resolve().parent.parent / "kube_gpu_stats_tpu" / "native"
-subprocess.run(["make", "-C", str(_NATIVE)], check=False,
-               capture_output=True, timeout=120)
+try:
+    subprocess.run(["make", "-C", str(_NATIVE)], check=False,
+                   capture_output=True, timeout=120)
+except (OSError, subprocess.TimeoutExpired):
+    pass  # no make / slow box: the native tests explain themselves
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -203,6 +203,43 @@ def test_live_rates_against_ticking_exporter():
         server.stop()
 
 
+def test_transient_fetch_failure_does_not_shift_row_identity():
+    """Review finding: rows were keyed by position in the SUCCESSFUL
+    fetch list, so one target timing out shifted every later target onto
+    a different identity and cross-matched their rate windows. Keys now
+    carry the target name."""
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    regs = []
+    servers = []
+    for steps in (1000.0, 50.0):
+        reg = Registry()
+        builder_loop = PollLoop(MockCollector(num_devices=1), reg,
+                                deadline=5.0)
+        builder_loop.tick()
+        builder_loop.stop()
+        regs.append(reg)
+        srv = MetricsServer(reg, host="127.0.0.1", port=0)
+        srv.start()
+        servers.append(srv)
+    url_a = f"http://127.0.0.1:{servers[0].port}/metrics"
+    url_b = f"http://127.0.0.1:{servers[1].port}/metrics"
+    try:
+        first = top.snapshot_frame([url_a, url_b], None)
+        assert len(first.rows) == 2
+        # Target A "goes down": its frame-2 fetch fails.
+        servers[0].stop()
+        second = top.snapshot_frame([url_a, url_b], first)
+        assert any(url_a in e for e in second.errors)
+        (key_b,) = second.rows
+        assert key_b[0] == url_b  # B keeps ITS identity, not A's slot
+        # And B's previous row is matched by name for rates.
+        assert key_b in first.rows
+    finally:
+        for srv in servers[1:]:
+            srv.stop()
+
+
 def test_top_reads_schema_families_it_claims():
     """The column map must reference real schema names only."""
     known = {m.name for m in schema.ALL_METRICS}

@@ -425,8 +425,11 @@ def test_rejected_scrapes_surface_as_self_metric():
     rs = RenderStats()
     builder = SnapshotBuilder()
     rs.contribute(builder)
-    assert not any(s.spec.name == schema.SELF_SCRAPES_REJECTED.name
-                   for s in builder.build().series)  # absent until it fires
+    # Born at 0 (not absent): increase()-based alerting would miss a
+    # burst entirely if the series first appeared already at N.
+    (series,) = [s for s in builder.build().series
+                 if s.spec.name == schema.SELF_SCRAPES_REJECTED.name]
+    assert series.value == 0.0
     rs.reject()
     rs.reject()
     builder = SnapshotBuilder()
