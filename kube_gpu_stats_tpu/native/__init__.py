@@ -8,6 +8,10 @@ through — the pure-Python path is always available.
 
 from __future__ import annotations
 
+import logging
+
+log = logging.getLogger(__name__)
+
 
 def maybe_accelerate_sysfs(sysfs_collector):
     """Wrap a SysfsCollector with the C++ batched reader when the shared
@@ -16,7 +20,14 @@ def maybe_accelerate_sysfs(sysfs_collector):
         from .binding import NativeSysfsCollector
 
         return NativeSysfsCollector(sysfs_collector)
+    except ImportError:
+        # Library simply not built: the documented pure-Python default.
+        return sysfs_collector
     except Exception:
+        # Built but BROKEN (stale ABI, binding bug): degrading silently
+        # would hide it forever — say so once at startup.
+        log.warning("native sysfs fast path failed to initialize; "
+                    "using pure Python", exc_info=True)
         return sysfs_collector
 
 

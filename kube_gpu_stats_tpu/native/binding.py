@@ -47,9 +47,15 @@ def load_library() -> ctypes.CDLL:
 
 
 class _DevicePlan:
-    """Resolved (metric, path, scale) triples for one device."""
+    """Resolved (metric, path, scale) triples for one device.
 
-    __slots__ = ("metrics", "paths", "scales")
+    Pins the first hit that actually READS AND PARSES, not merely the
+    first glob hit: hwmon attributes commonly exist but return -EIO, and
+    a later hit or the next candidate pattern may be the readable one —
+    the pure-Python path retries the whole chain per tick, so a plan
+    that pinned a dead file would diverge from it permanently."""
+
+    __slots__ = ("metrics", "paths", "scales", "c_scales")
 
     def __init__(self, accel_dir: Path) -> None:
         self.metrics: list[str] = []
@@ -59,15 +65,25 @@ class _DevicePlan:
             (schema.POWER.name, _POWER_CANDIDATES),
             (schema.TEMPERATURE.name, _TEMP_CANDIDATES),
         ):
+            pinned = None
             for pattern, scale in candidates:
-                hits = sorted(glob.glob(str(accel_dir / pattern)))
-                if hits:
-                    self.metrics.append(metric)
-                    paths.append(hits[0].encode())
-                    self.scales.append(scale)
+                for hit in sorted(glob.glob(str(accel_dir / pattern))):
+                    try:
+                        float(Path(hit).read_text().strip())
+                    except (OSError, ValueError):
+                        continue
+                    pinned = (hit, scale)
                     break
+                if pinned:
+                    break
+            if pinned:
+                self.metrics.append(metric)
+                paths.append(pinned[0].encode())
+                self.scales.append(pinned[1])
         n = len(paths)
         self.paths = (ctypes.c_char_p * n)(*paths)
+        # Constant per plan — built once, not per tick.
+        self.c_scales = (ctypes.c_double * n)(*self.scales)
 
 
 class NativeSysfsCollector(SysfsCollector):
@@ -94,18 +110,28 @@ class NativeSysfsCollector(SysfsCollector):
             self._plans[device.index] = plan
         n = len(plan.metrics)
         if n == 0:
+            # Empty plan (boot race: accel dir registered before hwmon
+            # bound): drop it so the NEXT tick re-globs instead of
+            # staying blind until rediscovery (or forever with
+            # --rediscovery-interval 0).
+            self._plans.pop(device.index, None)
             if not self.accel_dir(device).exists():
                 raise CollectorError(f"{self.accel_dir(device)} vanished")
             return {}
         values = (ctypes.c_double * n)()
         ok = (ctypes.c_ubyte * n)()
-        scales = (ctypes.c_double * n)(*plan.scales)
-        successes = self._lib.kts_read_scaled(plan.paths, scales, n, values, ok)
+        successes = self._lib.kts_read_scaled(plan.paths, plan.c_scales, n,
+                                              values, ok)
+        if successes < n:
+            # Any pinned file failing (hwmon renumbering, -EIO onset):
+            # rebuild next tick so the plan re-probes alternates — the
+            # per-tick cost is one Python glob pass only while degraded,
+            # restoring the pure-Python path's self-healing.
+            self._plans.pop(device.index, None)
         if successes == 0 and not self.accel_dir(device).exists():
             # Paths went away wholesale: device vanished (hot-unplug /
             # namespace teardown) — surface staleness, then let the caller
             # rediscover.
-            self._plans.pop(device.index, None)
             raise CollectorError(f"{self.accel_dir(device)} vanished")
         return {
             plan.metrics[i]: values[i] for i in range(n) if ok[i]

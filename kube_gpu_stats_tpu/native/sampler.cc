@@ -34,9 +34,18 @@ int kts_read_scaled(const char** paths, const double* scales, int n_paths,
     out_ok[i] = 0;
     out_values[i] = 0.0;
     if (paths[i] == nullptr) continue;
-    int fd = open(paths[i], O_RDONLY | O_CLOEXEC);
+    int fd;
+    do {
+      fd = open(paths[i], O_RDONLY | O_CLOEXEC);
+    } while (fd < 0 && errno == EINTR);
     if (fd < 0) continue;
-    ssize_t len = read(fd, buf, sizeof(buf) - 1);
+    // EINTR retry (PEP-475 parity with Path.read_text): this sampler
+    // also runs embedded inside user workloads whose signal handlers
+    // may not set SA_RESTART.
+    ssize_t len;
+    do {
+      len = read(fd, buf, sizeof(buf) - 1);
+    } while (len < 0 && errno == EINTR);
     close(fd);
     if (len <= 0) continue;
     buf[len] = '\0';

@@ -383,6 +383,12 @@ int fold_value(PyObject* cache, PyObject* dev_key, int kind,
   // entry = cache.setdefault(dev_key, {"values": {}, "ici": {},
   //                                    "collectives": None})
   PyObject* entry = PyDict_GetItem(cache, dev_key);  // borrowed
+  if (entry && !PyDict_Check(entry)) {
+    // A caller-prepopulated cache with a non-dict entry must raise, not
+    // feed NULLs into PyDict_* below (public extension entry point).
+    PyErr_SetString(PyExc_TypeError, "cache entry must be a dict");
+    return -1;
+  }
   if (!entry) {
     entry = PyDict_New();
     PyObject* values = PyDict_New();
@@ -406,6 +412,14 @@ int fold_value(PyObject* cache, PyObject* dev_key, int kind,
   // Effective value: int_value wins when present (mirrors decode_metric),
   // else double_value, else 0.0. Int conversion of a double goes through
   // PyLong_FromDouble so NaN/inf/huge behave exactly like Python's int().
+  PyObject* entry_values = PyDict_GetItem(entry, g_s_values);  // borrowed
+  PyObject* entry_ici = PyDict_GetItem(entry, g_s_ici);        // borrowed
+  if (!entry_values || !PyDict_Check(entry_values) || !entry_ici ||
+      !PyDict_Check(entry_ici)) {
+    PyErr_SetString(PyExc_TypeError,
+                    "cache entry lacks 'values'/'ici' dicts");
+    return -1;
+  }
   int rc = 0;
   if (kind == kIci || kind == kColl) {
     PyObject* v = has_int      ? PyLong_FromLongLong(int_value)
@@ -413,7 +427,7 @@ int fold_value(PyObject* cache, PyObject* dev_key, int kind,
                                : PyLong_FromLongLong(0);
     if (!v) return -1;  // int(NaN)/int(inf) exception, matching Python ingest
     if (kind == kIci) {
-      PyObject* ici = PyDict_GetItem(entry, g_s_ici);  // borrowed
+      PyObject* ici = entry_ici;
       PyObject* link;
       int truthy = link_obj ? PyObject_IsTrue(link_obj) : 0;
       if (truthy < 0) {
@@ -437,10 +451,9 @@ int fold_value(PyObject* cache, PyObject* dev_key, int kind,
     double fval = has_int      ? (double)int_value
                   : has_double ? double_value
                                : 0.0;
-    PyObject* values = PyDict_GetItem(entry, g_s_values);  // borrowed
     PyObject* v = PyFloat_FromDouble(fval);
     if (!v) return -1;
-    rc = PyDict_SetItem(values, schema_name, v);
+    rc = PyDict_SetItem(entry_values, schema_name, v);
     Py_DECREF(v);
   }
   return rc;
@@ -659,7 +672,10 @@ int ingest_tpumetric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
     int wire = key & 0x07;
     if (field == 3 && wire == 2) {
       uint64_t length;
-      if (!decode_varint(data, end, &pos, &length)) return -1;
+      // Unreachable while pass 1 validates identical bytes, but a bare
+      // -1 without an exception set would become SystemError.
+      if (!decode_varint(data, end, &pos, &length))
+        return err("truncated varint"), -1;
       if (kind < 0 && name_len > 0)
         ++*unknown;  // one per dropped metric, matching the Python count
       if (ingest_metric_nested(data, pos, pos + (Py_ssize_t)length, cache,
@@ -668,7 +684,8 @@ int ingest_tpumetric(const uint8_t* data, Py_ssize_t start, Py_ssize_t end,
       pos += (Py_ssize_t)length;
     } else if ((field == 1 || field == 2) && wire == 2) {
       uint64_t length;
-      if (!decode_varint(data, end, &pos, &length)) return -1;
+      if (!decode_varint(data, end, &pos, &length))
+        return err("truncated varint"), -1;
       pos += (Py_ssize_t)length;
     } else {
       if (!skip_unknown(data, end, &pos, wire)) return -1;
@@ -863,36 +880,12 @@ PyObject* py_ingest(PyObject*, PyObject* args) {
       pos += (Py_ssize_t)length;
       ++n;
     } else {
-      // skip_field semantics for unknown response-level fields
-      if (wire == 0) {
-        uint64_t skip;
-        if (!decode_varint(data, end, &pos, &skip)) {
-          PyBuffer_Release(&buf);
-          return err("truncated varint");
-        }
-      } else if (wire == 1) {
-        if (pos + 8 > end) {
-          PyBuffer_Release(&buf);
-          return err("truncated fixed64");
-        }
-        pos += 8;
-      } else if (wire == 2) {
-        uint64_t length;
-        if (!decode_varint(data, end, &pos, &length) ||
-            (uint64_t)(end - pos) < length) {
-          PyBuffer_Release(&buf);
-          return err("truncated length-delimited field");
-        }
-        pos += (Py_ssize_t)length;
-      } else if (wire == 5) {
-        if (pos + 4 > end) {
-          PyBuffer_Release(&buf);
-          return err("truncated fixed32");
-        }
-        pos += 4;
-      } else {
+      // skip_field semantics for unknown response-level fields (shared
+      // helper: one copy of the wire-type walk to keep error-message
+      // parity with codec.skip_field in exactly one place).
+      if (!skip_unknown(data, end, &pos, wire)) {
         PyBuffer_Release(&buf);
-        return err("unsupported wire type");
+        return nullptr;
       }
     }
   }
@@ -916,16 +909,23 @@ PyObject* py_configure(PyObject*, PyObject* args) {
     return nullptr;
   if (ici_len >= 128 || coll_len >= 128)
     return err("metric name too long");
-  for (int i = 0; i < g_n_values; ++i) Py_CLEAR(g_value_map[i].schema);
-  g_n_values = 0;
+  // Validate EVERYTHING before touching any global: a failed configure
+  // must leave the previous configuration fully intact, never a mix of
+  // partial new value_map and stale ici/collectives names.
   PyObject *k, *v;
   Py_ssize_t it = 0;
+  Py_ssize_t n_entries = 0;
   while (PyDict_Next(value_map, &it, &k, &v)) {
     if (!PyBytes_Check(k) || !PyUnicode_Check(v))
       return err("value_map must be {bytes: str}");
+    if (PyBytes_GET_SIZE(k) >= 128) return err("metric name too long");
+    if (++n_entries > kMaxNames) return err("too many value_map entries");
+  }
+  for (int i = 0; i < g_n_values; ++i) Py_CLEAR(g_value_map[i].schema);
+  g_n_values = 0;
+  it = 0;
+  while (PyDict_Next(value_map, &it, &k, &v)) {
     Py_ssize_t klen = PyBytes_GET_SIZE(k);
-    if (klen >= 128) return err("metric name too long");
-    if (g_n_values >= kMaxNames) return err("too many value_map entries");
     memcpy(g_value_map[g_n_values].name, PyBytes_AS_STRING(k), klen);
     g_value_map[g_n_values].len = klen;
     Py_INCREF(v);

@@ -83,3 +83,106 @@ def test_native_not_slower(tree):
 
     t_python, t_native = clock(python), clock(fast)
     assert t_native < t_python * 1.5, (t_python, t_native)
+
+
+def test_plan_skips_unparsable_hit_for_readable_fallback(tmp_path):
+    """Review finding: the plan pinned the first glob hit even when it
+    couldn't be read/parsed, losing the pure-Python fallback chain. An
+    hwmon file serving garbage must yield to the flat fallback file."""
+    from kube_gpu_stats_tpu.collectors.sysfs import SysfsCollector
+    from kube_gpu_stats_tpu.native.binding import NativeSysfsCollector
+
+    accel = tmp_path / "class" / "accel" / "accel0"
+    hwmon = accel / "device" / "hwmon" / "hwmon0"
+    hwmon.mkdir(parents=True)
+    (hwmon / "power1_average").write_text("not-a-number\n")  # dead first hit
+    (accel / "power_usage_uw").write_text("120000000\n")     # readable fallback
+    col = NativeSysfsCollector(SysfsCollector(str(tmp_path)))
+    (dev,) = col.discover()
+    env = col.read_environment(dev)
+    assert env["accelerator_power_watts"] == 120.0
+
+
+def test_plan_heals_when_files_appear_later(tmp_path):
+    """Boot race: accel dir exists before hwmon binds. The empty plan
+    must not blind the collector until rediscovery — the next tick
+    re-globs (review finding)."""
+    from kube_gpu_stats_tpu.collectors.sysfs import SysfsCollector
+    from kube_gpu_stats_tpu.native.binding import NativeSysfsCollector
+
+    accel = tmp_path / "class" / "accel" / "accel0"
+    accel.mkdir(parents=True)
+    col = NativeSysfsCollector(SysfsCollector(str(tmp_path)))
+    (dev,) = col.discover()
+    assert col.read_environment(dev) == {}  # nothing there yet
+    (accel / "power_usage_uw").write_text("90000000\n")  # driver binds
+    env = col.read_environment(dev)  # next tick: plan rebuilt
+    assert env["accelerator_power_watts"] == 90.0
+
+
+def test_plan_reprobes_after_pinned_file_dies(tmp_path):
+    """hwmon renumbering: the pinned path dying must trigger a re-probe
+    next tick instead of a permanent metric loss (review finding)."""
+    from kube_gpu_stats_tpu.collectors.sysfs import SysfsCollector
+    from kube_gpu_stats_tpu.native.binding import NativeSysfsCollector
+
+    accel = tmp_path / "class" / "accel" / "accel0"
+    hwmon0 = accel / "device" / "hwmon" / "hwmon0"
+    hwmon0.mkdir(parents=True)
+    (hwmon0 / "power1_average").write_text("100000000\n")
+    col = NativeSysfsCollector(SysfsCollector(str(tmp_path)))
+    (dev,) = col.discover()
+    assert col.read_environment(dev)["accelerator_power_watts"] == 100.0
+    # Driver rebind renumbers hwmon0 -> hwmon1.
+    hwmon1 = accel / "device" / "hwmon" / "hwmon1"
+    hwmon1.mkdir()
+    (hwmon1 / "power1_average").write_text("110000000\n")
+    (hwmon0 / "power1_average").unlink()
+    hwmon0.rmdir()
+    col.read_environment(dev)  # degraded tick: pinned path gone
+    env = col.read_environment(dev)  # re-probed plan
+    assert env["accelerator_power_watts"] == 110.0
+
+
+def test_wirefast_rejects_bad_prepopulated_cache():
+    """Review finding: a non-dict or shape-less cache entry segfaulted
+    the process; it must raise from Python instead."""
+    import pytest
+
+    from kube_gpu_stats_tpu import native
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    wirefast = native.load_wirefast()
+    if wirefast is None:
+        pytest.skip("native extension not built")
+    raw = tpumetrics.encode_response(
+        [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 50.0)])
+    with pytest.raises(TypeError):
+        wirefast.ingest(raw, {0: "not-a-dict"})
+    with pytest.raises(TypeError):
+        wirefast.ingest(raw, {0: {}})  # dict but missing values/ici
+
+
+def test_wirefast_failed_configure_leaves_state_intact():
+    """Review finding: a failed configure() half-cleared the name table,
+    silently misclassifying every later family. It must be atomic."""
+    import pytest
+
+    from kube_gpu_stats_tpu import native
+    from kube_gpu_stats_tpu.proto import tpumetrics
+
+    wirefast = native.load_wirefast()
+    if wirefast is None:
+        pytest.skip("native extension not built")
+    with pytest.raises(ValueError):
+        wirefast.configure({b"a.b": "x", b"bad": 3}, b"i", b"c")
+    try:
+        # Old configuration still classifies the pinned names.
+        raw = tpumetrics.encode_response(
+            [tpumetrics.MetricSample(tpumetrics.DUTY_CYCLE, 0, 50.0)])
+        cache = {}
+        n, _dialect, unknown = wirefast.ingest(raw, cache)
+        assert n == 1 and unknown == 0
+        assert cache[0]["values"]  # classified, not dropped as unknown
+    finally:
+        native.load_wirefast()  # restore canonical configuration
