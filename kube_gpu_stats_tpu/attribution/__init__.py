@@ -139,11 +139,26 @@ class CachedAttribution(PeriodicRefresher):
 class AutoSource:
     """auto mode: prefer the richer PodResources API, re-probing the socket
     on every refresh — a kubelet that (re)starts after the exporter must be
-    picked up without a pod restart. Falls back to the checkpoint file."""
+    picked up without a pod restart. Falls back to the checkpoint file —
+    with hysteresis once PodResources has succeeded: the checkpoint labels
+    pods by UID while PodResources labels them by name, so flip-flopping on
+    a kubelet blip would churn every series' label identity. After the
+    first PodResources success, a failure (RPC error or vanished socket)
+    raises — CachedAttribution keeps the last-good name-labeled map — and
+    only ``_FALLBACK_AFTER`` consecutive failures switch to the checkpoint
+    (kubelet genuinely gone beats frozen stale names eventually)."""
+
+    _FALLBACK_AFTER = 3
 
     def __init__(self, kubelet_socket: str, checkpoint_path: str) -> None:
         self._socket_path = kubelet_socket
         self._podresources = None
+        self._podresources_ever_ok = False
+        self._pr_failures = 0  # consecutive, counted only after first success
+        # Set by fetch() when this cycle was served by the checkpoint, so
+        # fetch_allocatable (called right after) goes straight there
+        # instead of paying the PodResources rpc deadline a second time.
+        self._cycle_used_checkpoint = False
         from .checkpoint import CheckpointSource
 
         self._checkpoint = CheckpointSource(checkpoint_path)
@@ -164,19 +179,41 @@ class AutoSource:
         # not unlinked on crash), so existence alone can't gate the choice:
         # fall back to the checkpoint when the live fetch fails too.
         source = self._active()
+        self._cycle_used_checkpoint = source is self._checkpoint
+        if source is self._checkpoint and self._podresources_ever_ok:
+            # Socket vanished after PodResources was healthy: hysteresis
+            # (see class docstring) before remapping names to UIDs.
+            self._pr_failures += 1
+            if self._pr_failures < self._FALLBACK_AFTER:
+                raise RuntimeError(
+                    f"podresources socket vanished; keeping last-good map "
+                    f"({self._pr_failures}/{self._FALLBACK_AFTER} before "
+                    f"checkpoint fallback)")
+            return self._checkpoint.fetch()
         try:
-            return source.fetch()
+            result = source.fetch()
         except Exception:
-            if source is not self._checkpoint:
-                return self._checkpoint.fetch()
-            raise
+            if source is self._checkpoint:
+                raise
+            if self._podresources_ever_ok:
+                self._pr_failures += 1
+                if self._pr_failures < self._FALLBACK_AFTER:
+                    raise
+            self._cycle_used_checkpoint = True
+            return self._checkpoint.fetch()
+        if source is not self._checkpoint:
+            self._podresources_ever_ok = True
+            self._pr_failures = 0
+        return result
 
     def fetch_allocatable(self) -> dict[str, int]:
+        if self._cycle_used_checkpoint:
+            return self._checkpoint.fetch_allocatable()
         source = self._active()
         try:
             return source.fetch_allocatable()
         except Exception:
-            if source is not self._checkpoint:
+            if source is not self._checkpoint and not self._podresources_ever_ok:
                 return self._checkpoint.fetch_allocatable()
             raise
 
@@ -188,14 +225,17 @@ class AutoSource:
 
 def build(mode: str, kubelet_socket: str, checkpoint_path: str,
           refresh_interval: float) -> CachedAttribution:
-    """Factory for daemon.build_attribution. mode: auto|podresources|checkpoint."""
-    from .checkpoint import CheckpointSource
-    from .podresources import PodResourcesSource
-
+    """Factory for daemon.build_attribution. mode: auto|podresources|checkpoint.
+    Imports are per-mode: the checkpoint path is pure stdlib and must work
+    on grpcio-less installs without dragging the PodResources module in."""
     source: AllocationSource
     if mode == "podresources":
+        from .podresources import PodResourcesSource
+
         source = PodResourcesSource(kubelet_socket)
     elif mode == "checkpoint":
+        from .checkpoint import CheckpointSource
+
         source = CheckpointSource(checkpoint_path)
     else:
         source = AutoSource(kubelet_socket, checkpoint_path)

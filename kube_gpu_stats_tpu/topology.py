@@ -27,12 +27,18 @@ log = logging.getLogger(__name__)
 METADATA_URL = "http://metadata.google.internal/computeMetadata/v1"
 _TPU_ENV_LINE = re.compile(r"^\s*([A-Z_]+):\s*'?([^'\n]*)'?\s*$", re.M)
 
+# Proxy-free opener: HTTP_PROXY env (common on egress-proxied clusters)
+# must not route metadata.google.internal through a proxy that cannot
+# reach it — same reason the gRPC channels set grpc.enable_http_proxy=0.
+_METADATA_OPENER = urllib.request.build_opener(
+    urllib.request.ProxyHandler({}))
+
 
 def _metadata_get(base: str, path: str, timeout: float) -> str:
     req = urllib.request.Request(
         f"{base}/{path}", headers={"Metadata-Flavor": "Google"}
     )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
+    with _METADATA_OPENER.open(req, timeout=timeout) as resp:
         return resp.read().decode()
 
 
@@ -47,14 +53,17 @@ def _on_gce() -> bool:
 
 
 def from_gce_metadata(base_url: str | None = None,
-                      timeout: float = 0.5) -> dict[str, str]:
+                      timeout: float = 0.5,
+                      environ: Mapping[str, str] | None = None
+                      ) -> dict[str, str]:
     """Best-effort topology from GCE instance metadata; {} off-GCE.
 
     Reads the TPU VM attributes: ``agent-worker-number`` (worker id),
     ``accelerator-type`` (e.g. "v5p-128"), and the ``tpu-env`` blob
     (``K: 'v'`` lines) for TPU_TOPOLOGY/slice name.
     """
-    base = base_url or os.environ.get("KTS_METADATA_URL")
+    proc_env = environ if environ is not None else os.environ
+    base = base_url or proc_env.get("KTS_METADATA_URL")
     if base is None:
         if not _on_gce():
             return {}
@@ -71,7 +80,10 @@ def from_gce_metadata(base_url: str | None = None,
     try:
         blob = _metadata_get(base, "instance/attributes/tpu-env", timeout)
         env = dict(_TPU_ENV_LINE.findall(blob))
-        out.setdefault("worker", env.get("WORKER_ID", ""))
+        if not out.get("worker") and env.get("WORKER_ID"):
+            # Not setdefault: a present-but-EMPTY agent-worker-number
+            # attribute must not block this fallback.
+            out["worker"] = env["WORKER_ID"]
         if env.get("TPU_TOPOLOGY"):
             out["topology"] = env["TPU_TOPOLOGY"]
         if env.get("NODE_ID") or env.get("TPU_NAME"):
@@ -105,7 +117,7 @@ def topology_labels(environ: Mapping[str, str] | None = None,
     if use_metadata and not (worker and topo and slice_name):
         # Startup-only (never on the poll path): the exporter pod has no
         # TPU env vars, but the node's metadata server knows the topology.
-        for key, value in from_gce_metadata().items():
+        for key, value in from_gce_metadata(environ=env).items():
             if not labels.get(key):
                 labels[key] = value
     return labels
@@ -118,5 +130,10 @@ def accel_type(environ: Mapping[str, str] | None = None) -> str:
     raw = env.get("KTS_ACCEL_TYPE") or env.get("TPU_ACCELERATOR_TYPE", "")
     if not raw:
         return "tpu"
-    family = raw.split("-", 1)[0].lower()
-    return f"tpu-{family}" if not family.startswith("tpu") else family
+    lowered = raw.lower()
+    if lowered.startswith(("tpu", "gpu")):
+        # Already a final label ("tpu-v5p", "gpu-h100"): pass through
+        # verbatim — deriving would truncate it to the bare family.
+        return lowered
+    family = lowered.split("-", 1)[0]
+    return f"tpu-{family}"

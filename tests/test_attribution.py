@@ -298,3 +298,48 @@ def test_auto_falls_back_when_stale_socket_fetch_fails(tmp_path):
     assert cached.consecutive_failures == 0
     assert cached.lookup(dev(0))["pod"] == "uid-1234"  # via checkpoint
     cached.stop()
+
+
+def test_auto_keeps_podresources_identity_after_kubelet_blip(kubelet, tmp_path):
+    """Review finding: once PodResources has succeeded, a transient
+    kubelet failure must RAISE (cached last-good map with pod NAMES is
+    kept) instead of remapping every series to checkpoint pod UIDs."""
+    import pytest as _pytest
+
+    from kube_gpu_stats_tpu.attribution import AutoSource
+
+    checkpoint = tmp_path / "kubelet_internal_checkpoint"
+    checkpoint.write_text('{"Data":{"PodDeviceEntries":[]},"Checksum":1}')
+    source = AutoSource(kubelet.socket_path, str(checkpoint))
+    try:
+        assert source.fetch()  # PodResources succeeds and latches
+        kubelet.stop()
+        # Blip hysteresis: the first failures RAISE (cached name-labeled
+        # map retained) instead of silently remapping to checkpoint UIDs.
+        for _ in range(AutoSource._FALLBACK_AFTER - 1):
+            with _pytest.raises(Exception):
+                source.fetch()
+        # Kubelet genuinely gone: eventually the checkpoint takes over.
+        assert source.fetch() == {}
+        assert source._cycle_used_checkpoint
+    finally:
+        source.close()
+
+
+def test_build_checkpoint_mode_needs_no_grpc(tmp_path, monkeypatch):
+    """Review finding: build(mode='checkpoint') imported the grpc-backed
+    module unconditionally, so grpcio-less installs silently lost even
+    checkpoint attribution."""
+    import sys
+
+    from kube_gpu_stats_tpu import attribution
+
+    monkeypatch.setitem(
+        sys.modules, "kube_gpu_stats_tpu.attribution.podresources", None)
+    checkpoint = tmp_path / "ckpt"
+    checkpoint.write_text('{"Data":{"PodDeviceEntries":[]},"Checksum":1}')
+    cached = attribution.build(
+        mode="checkpoint", kubelet_socket="/nonexistent.sock",
+        checkpoint_path=str(checkpoint), refresh_interval=10.0)
+    cached.refresh_once()
+    cached.stop()

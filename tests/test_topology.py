@@ -101,3 +101,57 @@ def test_metadata_disabled_off_gce(monkeypatch):
     monkeypatch.delenv("KTS_METADATA_URL", raising=False)
     monkeypatch.setattr(topology, "_on_gce", lambda: False)
     assert topology.from_gce_metadata() == {}
+
+
+def test_accel_type_final_labels_pass_through():
+    """Review finding: an explicit final label was truncated to its
+    family ("tpu-v5p" -> "tpu"); final forms now pass through while
+    capacity forms still derive."""
+    assert accel_type({"KTS_ACCEL_TYPE": "tpu-v5p"}) == "tpu-v5p"
+    assert accel_type({"KTS_ACCEL_TYPE": "gpu-h100"}) == "gpu-h100"
+    assert accel_type({"TPU_ACCELERATOR_TYPE": "tpu-v5litepod"}) == \
+        "tpu-v5litepod"
+    # Capacity forms unchanged (pinned above too).
+    assert accel_type({"KTS_ACCEL_TYPE": "v4-8"}) == "tpu-v4"
+
+
+def test_metadata_empty_worker_attribute_falls_back_to_tpu_env(tmp_path):
+    """Review finding: a present-but-empty agent-worker-number blocked
+    the tpu-env WORKER_ID fallback via setdefault."""
+    import http.server
+    import threading
+
+    from kube_gpu_stats_tpu.topology import from_gce_metadata
+
+    class Meta(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            answers = {
+                "/instance/attributes/agent-worker-number": "",
+                "/instance/attributes/accelerator-type": "v5p-128",
+                "/instance/attributes/tpu-env":
+                    "WORKER_ID: '3'\nTPU_TOPOLOGY: '8x8x4'\n",
+            }
+            body = answers.get(self.path)
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Meta)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        out = from_gce_metadata(
+            base_url=f"http://127.0.0.1:{srv.server_address[1]}")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert out["worker"] == "3"
+    assert out["topology"] == "8x8x4"
