@@ -107,7 +107,15 @@ class GpuSysfsCollector(Collector):
             card = self._card_dir(device)
             for _, patterns, _ in _ATTRIBUTES:
                 for pattern in patterns:
-                    if glob.glob(str(card / pattern)):
+                    for hit in glob.glob(str(card / pattern)):
+                        # Readability, not mere existence: a file that
+                        # EPERMs on read (restricted container) would
+                        # latch a backend that exports nothing, while
+                        # null keeps the auto re-probe loop alive.
+                        try:
+                            float(Path(hit).read_text().strip())
+                        except (OSError, ValueError):
+                            continue
                         return True
         return False
 

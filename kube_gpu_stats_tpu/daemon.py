@@ -123,38 +123,61 @@ def build_attribution(cfg: Config) -> AttributionProvider:
         return NullAttribution()
 
 
+def _backend_priority(collector) -> int:
+    """auto-mode upgrade ordering: tpu beats gpu beats null. A gpu-sysfs
+    latch must not suppress the TPU re-probe — a display-adjacent card
+    passing the capability check would otherwise permanently mask a TPU
+    whose metric service starts with the workload."""
+    name = getattr(collector, "name", "")
+    if name in ("tpu", "libtpu", "sysfs", "sysfs-native"):
+        return 2
+    if name.startswith("gpu"):
+        return 1
+    return 0
+
+
 class BackendUpgradeWatcher(PeriodicRefresher):
-    """Re-probe for an accelerator while --backend auto latched the null
-    backend (round-2 advisor finding: the libtpu metric service only
-    serves while a TPU workload is running, so a daemon started before
-    the workload on a sysfs-less TPU VM would otherwise export nulls for
-    its whole lifetime). Runs on the rediscovery cadence with capped
-    backoff; on the first successful probe it hands the new collector to
-    the poll loop and retires itself."""
+    """Re-probe for a better accelerator while --backend auto latched the
+    null OR gpu backend (round-2 advisor finding: the libtpu metric
+    service only serves while a TPU workload is running, so a daemon
+    started before the workload on a sysfs-less TPU VM would otherwise
+    export nulls — or a bystander GPU — for its whole lifetime). Runs on
+    the rediscovery cadence with capped backoff; upgrades apply between
+    ticks, and the watcher retires once the top-priority (TPU) backend
+    is in place. The first probe waits one interval: construction just
+    probed milliseconds ago."""
 
     def __init__(self, daemon: "Daemon", interval: float) -> None:
-        super().__init__(interval, "backend-upgrade")
+        super().__init__(interval, "backend-upgrade",
+                         first_refresh_immediately=False)
         self._daemon = daemon
 
     def refresh_once(self) -> None:
+        current_priority = _backend_priority(self._daemon.collector)
+        if current_priority >= 2:
+            self._stop_event.set()  # TPU latched (e.g. via rediscovery)
+            return
         try:
             new = probe_accelerator(self._daemon.cfg, loglevel=logging.DEBUG)
         except Exception:  # noqa: BLE001 - probe bug must not kill the thread
             log.debug("backend re-probe crashed", exc_info=True)
             new = None
-        if new is None:
+        if new is None or _backend_priority(new) <= current_priority:
+            if new is not None:
+                new.close()
             # Modest backoff cap: a workload can start any time, so keep
             # probing at most ~3x the base cadence (PeriodicRefresher
             # scales the wait by 1 + consecutive_failures).
             self.consecutive_failures = min(self.consecutive_failures + 1, 2)
             return
-        log.info("auto backend: %s accelerator now present; upgrading "
-                 "from null backend", new.name)
+        log.info("auto backend: %s now present; upgrading from %s",
+                 new.name, self._daemon.collector.name)
         self._daemon.collector = new
         self._daemon.poll.replace_collector(new)
-        # Applied between ticks; retire this watcher (set, don't join —
-        # we ARE the watcher thread).
-        self._stop_event.set()
+        if _backend_priority(new) >= 2:
+            # Applied between ticks; retire this watcher (set, don't
+            # join — we ARE the watcher thread).
+            self._stop_event.set()
 
 
 class Daemon:
@@ -223,7 +246,7 @@ class Daemon:
         self.upgrade_watcher = (
             BackendUpgradeWatcher(self, cfg.rediscovery_interval)
             if cfg.backend == "auto"
-            and isinstance(self.collector, NullCollector)
+            and _backend_priority(self.collector) < 2
             and cfg.rediscovery_interval > 0
             else None
         )
@@ -338,8 +361,10 @@ def run(cfg: Config) -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
-    daemon.start()
     try:
+        # Inside the try: a partial start (unwritable textfile dir, a
+        # sender failing to spawn) must still tear down what DID start.
+        daemon.start()
         stop.wait()
     finally:
         daemon.stop()

@@ -25,14 +25,26 @@ class RateTracker:
     (device_id, link) tuples so the tracker also serves collective-op rates.
     """
 
+    # Link-name churn guard: per-device entries beyond this are not
+    # tracked (no rate, no stored state) — the poll loop caps exported
+    # links separately, but churn WITHIN its cap must not grow this dict
+    # for the device's lifetime either.
+    MAX_LINKS_PER_DEVICE = 128
+
     def __init__(self) -> None:
         self._last: dict[tuple[str, str], _Last] = {}
+        self._per_device: dict[str, int] = {}
 
     def rate(self, device_id: str, link: str, value: int, now: float) -> float | None:
         """Return bytes/sec since the previous observation, or None when no
-        rate can be computed (first sample, reset/wraparound, zero dt)."""
+        rate can be computed (first sample, reset/wraparound, zero dt,
+        or the device's link-name budget is exhausted)."""
         key = (device_id, link)
         prev = self._last.get(key)
+        if prev is None:
+            if self._per_device.get(device_id, 0) >= self.MAX_LINKS_PER_DEVICE:
+                return None
+            self._per_device[device_id] = self._per_device.get(device_id, 0) + 1
         self._last[key] = _Last(value, now)
         if prev is None:
             return None
@@ -49,3 +61,4 @@ class RateTracker:
     def forget_device(self, device_id: str) -> None:
         for key in [k for k in self._last if k[0] == device_id]:
             del self._last[key]
+        self._per_device.pop(device_id, None)

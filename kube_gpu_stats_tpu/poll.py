@@ -331,6 +331,9 @@ class PollLoop:
         self._errors[reason] = self._errors.get(reason, 0) + 1
 
     _MAX_RAW_FAMILIES = 64
+    # Real topologies have ~6 ICI links per chip; 64 is far beyond any
+    # hardware and well below a churn blowup.
+    _MAX_ICI_LINKS = 64
 
     def _admit_raw_family(self, family: str) -> bool:
         """Cap the distinct passthrough family names (--passthrough-
@@ -408,7 +411,16 @@ class PollLoop:
                 builder.add(spec, value, base)
                 if name == schema.MEMORY_TOTAL.name:
                     self._last_totals[dev.device_id] = value
-            for link, counter in sorted(sample.ici_counters.items()):
+            ici_items = sorted(sample.ici_counters.items())
+            if len(ici_items) > self._MAX_ICI_LINKS:
+                # Same threat class as the passthrough family cap: a
+                # buggy/hostile runtime minting unique link names per
+                # tick must not mint unbounded series (or grow the rate
+                # tracker unboundedly). Sorted-first-N keeps a stable
+                # subset for a fixed name population.
+                self._count_error("ici_link_cap")
+                ici_items = ici_items[:self._MAX_ICI_LINKS]
+            for link, counter in ici_items:
                 link_labels = base + [("link", link)]
                 builder.add(schema.ICI_TRAFFIC_TOTAL, float(counter), link_labels)
                 rate = self._rates.rate(dev.device_id, link, counter, now)

@@ -134,6 +134,11 @@ def decompress(data: bytes) -> bytes:
             length += 1
             if pos + length > n:
                 raise ValueError("truncated literal body")
+            if len(out) + length > expected:
+                # Same bound as copies: literals are input-limited, but
+                # the check keeps the "never exceed the preamble" rule in
+                # one consistent place.
+                raise ValueError("snappy output exceeds declared length")
             out += data[pos:pos + length]
             pos += length
             continue
@@ -157,6 +162,11 @@ def decompress(data: bytes) -> bytes:
             pos += 4
         if offset == 0 or offset > len(out):
             raise ValueError("copy offset out of range")
+        if len(out) + length > expected:
+            # Bound BEFORE materializing: a tiny crafted stream of RLE
+            # copies declaring a small preamble must not expand without
+            # limit before the final length check (decompression bomb).
+            raise ValueError("snappy output exceeds declared length")
         # Copies may overlap their own output (RLE-style); byte-by-byte
         # semantics are the spec'd behavior.
         start = len(out) - offset

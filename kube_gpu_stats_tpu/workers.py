@@ -24,9 +24,12 @@ from __future__ import annotations
 
 import concurrent.futures
 import queue
+import logging
 import threading
 import time
 from typing import Callable
+
+log = logging.getLogger(__name__)
 
 
 class PeriodicRefresher:
@@ -36,9 +39,11 @@ class PeriodicRefresher:
     persistent failure so a dead dependency isn't hammered. Subclasses
     implement refresh_once() and maintain `consecutive_failures`."""
 
-    def __init__(self, refresh_interval: float, thread_name: str) -> None:
+    def __init__(self, refresh_interval: float, thread_name: str,
+                 first_refresh_immediately: bool = True) -> None:
         self._interval = refresh_interval
         self._thread_name = thread_name
+        self._first_immediately = first_refresh_immediately
         self._stop_event = threading.Event()
         self._thread: threading.Thread | None = None
         self.consecutive_failures = 0
@@ -47,8 +52,20 @@ class PeriodicRefresher:
         raise NotImplementedError
 
     def _run(self) -> None:
+        if not self._first_immediately:
+            # e.g. the backend-upgrade watcher: construction just probed,
+            # an immediate re-probe would be a duplicate.
+            self._stop_event.wait(self._interval)
         while not self._stop_event.is_set():
-            self.refresh_once()
+            try:
+                self.refresh_once()
+            except Exception:  # noqa: BLE001 - a raising subclass must not
+                # silently kill its watcher thread (stale cache forever);
+                # containment lives HERE, once, not in every subclass.
+                self.consecutive_failures += 1
+                log.warning("%s refresh crashed (%d consecutive)",
+                            self._thread_name, self.consecutive_failures,
+                            exc_info=True)
             wait = self._interval * min(1 + self.consecutive_failures, 6)
             self._stop_event.wait(wait)
 

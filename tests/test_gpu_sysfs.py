@@ -100,3 +100,17 @@ def test_bmc_framebuffer_card_not_selected_by_auto(tmp_path):
     # Explicit --backend gpu still allows it (operator override).
     gpu = build_collector(Config(backend="gpu", sysfs_root=str(tmp_path)))
     assert gpu.name == "gpu-sysfs"
+
+
+def test_telemetry_capable_requires_readable_values(tmp_path):
+    """Review finding: existence-only capability check latched a backend
+    that exports nothing when the attribute files can't be parsed."""
+    from kube_gpu_stats_tpu.collectors.gpu_sysfs import GpuSysfsCollector
+
+    card = tmp_path / "class" / "drm" / "card0" / "device"
+    card.mkdir(parents=True)
+    (card / "gpu_busy_percent").write_text("not a number\n")
+    col = GpuSysfsCollector(sysfs_root=str(tmp_path))
+    assert col.telemetry_capable() is False
+    (card / "gpu_busy_percent").write_text("42\n")
+    assert col.telemetry_capable() is True

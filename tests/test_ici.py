@@ -45,3 +45,19 @@ def test_forget_device():
     rt.rate("0", "x0", 100, now=1.0)
     rt.forget_device("0")
     assert rt.rate("0", "x0", 200, now=2.0) is None
+
+
+def test_link_name_churn_bounded():
+    """Review finding: unique link names per tick grew the tracker
+    unboundedly; past the per-device budget new links get no state."""
+    from kube_gpu_stats_tpu.ici import RateTracker
+
+    tracker = RateTracker()
+    for i in range(RateTracker.MAX_LINKS_PER_DEVICE * 3):
+        tracker.rate("dev0", f"churn{i}", i, float(i))
+    assert len(tracker._last) == RateTracker.MAX_LINKS_PER_DEVICE
+    # Known links keep producing rates.
+    tracker.rate("dev0", "churn0", 100, 1000.0)
+    assert tracker.rate("dev0", "churn0", 200, 1001.0) == 100.0
+    tracker.forget_device("dev0")
+    assert tracker._last == {} and tracker._per_device == {}

@@ -147,3 +147,23 @@ def test_compressor_output_conforms_to_spec_grammar(payload):
     claimed, produced = _walk_spec_elements(snappy.compress(payload))
     assert claimed == len(payload)
     assert produced == len(payload)
+
+
+def test_decompression_bomb_bounded():
+    """Review finding: a tiny stream of RLE copies claiming a small
+    preamble materialized gigabytes before the final length check. The
+    bound now trips at the declared length."""
+    import pytest
+
+    from kube_gpu_stats_tpu import snappy as s
+
+    # preamble: 100 bytes; body: literal "ab" then RLE copy-2 elements
+    # (len 64, offset 1) repeated far past the declared length.
+    body = bytearray()
+    body += bytes([100])            # varint preamble = 100
+    body += bytes([(2 - 1) << 2])   # literal, length 2
+    body += b"ab"
+    for _ in range(5000):
+        body += bytes([((64 - 1) << 2) | 0b10, 1, 0])  # copy-2 len 64 off 1
+    with pytest.raises(ValueError, match="exceeds declared length"):
+        s.decompress(bytes(body))

@@ -160,3 +160,28 @@ def test_shutdown_reports_clean_vs_wedged_drain(caplog):
 
     pool3 = DaemonSamplerPool(max_workers=1)
     assert pool3.shutdown(wait=False) is False  # asked not to know
+
+
+def test_periodic_refresher_survives_raising_subclass():
+    """Review finding: an exception escaping refresh_once killed the
+    watcher thread silently; containment now lives in the scaffold."""
+    import threading
+    import time
+
+    from kube_gpu_stats_tpu.workers import PeriodicRefresher
+
+    calls = []
+
+    class Raising(PeriodicRefresher):
+        def refresh_once(self):
+            calls.append(1)
+            raise RuntimeError("subclass bug")
+
+    watcher = Raising(0.01, "raising-test")
+    watcher.start()
+    deadline = time.monotonic() + 5
+    while len(calls) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    watcher.stop()
+    assert len(calls) >= 3  # kept refreshing after each crash
+    assert watcher.consecutive_failures >= 3
