@@ -8,6 +8,7 @@ pod has them at hand:
     kube-tpu-stats doctor [exporter flags] [--json] [--url TARGET]
     kube-tpu-stats validate [--two-scrapes] <url-or-file>
     kube-tpu-stats top [targets...] [--interval N] [--once] [--json]
+    kube-tpu-stats hub [targets...] [--listen-port N] [--rollups-only]
 """
 
 from __future__ import annotations
@@ -33,6 +34,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         from .top import main as top_main
 
         return top_main(args[1:])
+    if args and args[0] == "hub":
+        from .hub import main as hub_main
+
+        return hub_main(args[1:])
     return run(from_args(args))
 
 

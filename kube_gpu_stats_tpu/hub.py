@@ -1,0 +1,364 @@
+"""`kube-tpu-stats hub` — slice-level aggregation service (component C9,
+SURVEY.md §2, upgraded from labels-only to an actual aggregator; no
+reference file to cite — mount empty, SURVEY.md §0).
+
+Per-node DaemonSet pods each export only their local chips; Prometheus is
+the intended aggregator (SURVEY.md §2 C9). When there is no Prometheus —
+dev slices, ad-hoc multi-VM runs, CI — the hub fills that gap: it scrapes
+every per-node exporter of a slice concurrently on a fixed cadence,
+merges the per-chip ``accelerator_*`` series into one exposition, and
+computes slice-level rollups no single node can see:
+
+- ``slice_target_up{target}`` — which worker VMs answered the last refresh;
+- ``slice_chips`` / ``slice_chips_up`` / ``slice_workers`` (+ expected);
+- duty-cycle mean/min/max, HBM + power sums, aggregate ICI bandwidth;
+- ``slice_worker_steps_per_second{worker}`` and ``slice_straggler_ratio``
+  — per-worker step rates from frame-over-frame counter deltas; in an
+  SPMD job the slowest worker gates everyone, so min() over workers (or
+  a low straggler ratio) is the signal the job is wedged or unbalanced.
+
+The hub is a thin composition of existing parts: fetch/parse from
+validate.py, per-chip folding + rate math from top.py, and the full
+exposition stack (Registry snapshot-swap, MetricsServer with TLS/auth/
+storm-guard/gzip, RenderStats self-metrics) — so `kube-tpu-stats top`,
+`validate`, Prometheus, and plain curl all work against the hub's own
+``/metrics`` unchanged. /healthz turns 503 when refreshes stop, so the
+hub is itself probe-able when deployed as a Service.
+
+Self-metric families re-exported from the source exporters
+(``collector_*``/``process_*``) are deliberately NOT merged: they carry
+no worker identity, so series from different targets would collide.
+Scrape each exporter directly for those, or run rollups-only
+(``--rollups-only``) and keep per-chip cardinality out of the hub too.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Mapping, Sequence
+
+from . import schema
+from .registry import HistogramState, Registry, SnapshotBuilder
+from .top import Frame, build_frame
+from .validate import fetch_exposition, parse_exposition
+
+log = logging.getLogger(__name__)
+
+# Per-chip families the hub re-exports verbatim. Histogram families are
+# excluded: they render as _bucket/_sum/_count series that would need
+# state reconstruction, and the rollups carry the aggregate signal.
+PER_CHIP_SPECS: dict[str, schema.MetricSpec] = {
+    m.name: m
+    for m in schema.PER_DEVICE_METRICS
+    if m.type is not schema.MetricType.HISTOGRAM
+}
+
+DEFAULT_PORT = 9401
+
+
+class Hub:
+    """Owns the refresh loop and the merged registry.
+
+    Single-writer discipline: only the refresh loop (or refresh_once in
+    tests/--once) builds and publishes snapshots; the HTTP server only
+    reads — the same concurrency contract as the exporter daemon
+    (registry.py).
+    """
+
+    def __init__(self, targets: Sequence[str], interval: float = 10.0,
+                 expect_workers: int = 0, rollups_only: bool = False,
+                 fetch_timeout: float = 5.0,
+                 registry: Registry | None = None,
+                 render_stats=None) -> None:
+        if not targets:
+            raise ValueError("hub needs at least one target")
+        # Order-preserving dedup: a target listed twice (positional +
+        # --targets-file overlap) would emit duplicate slice_target_up
+        # series and make the whole exposition invalid to Prometheus.
+        self._targets = list(dict.fromkeys(targets))
+        if len(self._targets) < len(targets):
+            log.warning("hub: %d duplicate target(s) dropped",
+                        len(targets) - len(self._targets))
+        self._interval = interval
+        self._expect_workers = expect_workers
+        self._rollups_only = rollups_only
+        self._fetch_timeout = fetch_timeout
+        self._render_stats = render_stats
+        self.registry = registry if registry is not None else Registry()
+        self._previous: Frame | None = None
+        self._refresh_hist = HistogramState.empty(
+            schema.HUB_REFRESH_DURATION, schema.HUB_REFRESH_BUCKETS)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, len(self._targets)),
+            thread_name_prefix="hub-fetch")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one refresh ---------------------------------------------------------
+
+    def refresh_once(self) -> Frame:
+        start = time.monotonic()
+        errors: list[str] = []
+        parsed: list[list] = []
+        ats: list[float] = []
+        names: list[str] = []
+        reachable: dict[str, bool] = {}
+
+        def fetch(target: str):
+            series = parse_exposition(
+                fetch_exposition(target, timeout=self._fetch_timeout))
+            return series, time.monotonic()
+
+        # Submit all before collecting any: one slow target must not
+        # serialize the rest (same shape as top.snapshot_frame).
+        futures = [(t, self._pool.submit(fetch, t)) for t in self._targets]
+        for target, future in futures:
+            try:
+                series, at = future.result()
+                parsed.append(series)
+                ats.append(at)
+                names.append(target)
+                reachable[target] = True
+            except Exception as exc:  # noqa: BLE001 - per-target degradation
+                reachable[target] = False
+                errors.append(f"{target}: {exc}")
+
+        frame = build_frame(parsed, errors, ats, targets=names)
+        frame.rates(self._previous)
+        self._previous = frame
+
+        builder = SnapshotBuilder()
+        for target in self._targets:
+            builder.add(schema.HUB_TARGET_UP,
+                        1.0 if reachable.get(target) else 0.0,
+                        (("target", target),))
+        builder.add(schema.HUB_WORKERS_EXPECTED, float(self._expect_workers))
+        self._add_rollups(builder, frame)
+        if not self._rollups_only:
+            self._add_chip_series(builder, parsed, names)
+        self._refresh_hist = self._refresh_hist.observe(
+            time.monotonic() - start)
+        builder.add_histogram(self._refresh_hist)
+        if self._render_stats is not None:
+            self._render_stats.contribute(builder)
+        self.registry.publish(builder.build())
+        for err in errors:
+            log.warning("hub refresh: %s", err)
+        return frame
+
+    @staticmethod
+    def _worker_id(row) -> str:
+        """Worker identity for rollups: the worker topology label, or the
+        target itself when the exporter carries no worker label (dev VMs,
+        embedded exporters) — two unlabeled targets are still two
+        workers."""
+        return row.key[2] or str(row.key[0])
+
+    def _add_rollups(self, builder: SnapshotBuilder, frame: Frame) -> None:
+        by_slice: dict[str, list] = {}
+        for row in frame.rows.values():
+            by_slice.setdefault(row.key[1], []).append(row)
+        for slice_name in sorted(by_slice):
+            rows = by_slice[slice_name]
+            labels = (("slice", slice_name),)
+            builder.add(schema.HUB_CHIPS, float(len(rows)), labels)
+            builder.add(schema.HUB_CHIPS_UP,
+                        float(sum(1 for r in rows if r.up == 1.0)), labels)
+            workers = {self._worker_id(r) for r in rows}
+            builder.add(schema.HUB_WORKERS, float(len(workers)), labels)
+            duties = [r.duty for r in rows if r.duty is not None]
+            if duties:
+                builder.add(schema.HUB_DUTY_MEAN,
+                            sum(duties) / len(duties), labels)
+                builder.add(schema.HUB_DUTY_MIN, min(duties), labels)
+                builder.add(schema.HUB_DUTY_MAX, max(duties), labels)
+            used = [r.mem_used for r in rows if r.mem_used is not None]
+            if used:
+                builder.add(schema.HUB_MEMORY_USED, sum(used), labels)
+            total = [r.mem_total for r in rows if r.mem_total is not None]
+            if total:
+                builder.add(schema.HUB_MEMORY_TOTAL, sum(total), labels)
+            power = [r.power for r in rows if r.power is not None]
+            if power:
+                builder.add(schema.HUB_POWER, sum(power), labels)
+            ici = sum(r.ici_bps for r in rows)
+            if ici:
+                builder.add(schema.HUB_ICI_BANDWIDTH, ici, labels)
+            # Per-worker step rate = mean over the worker's chips (SPMD:
+            # every chip participates in each step, so chips of one
+            # worker report the same counter — mean, not sum).
+            worker_rates: dict[str, list[float]] = {}
+            for row in rows:
+                if row.steps_per_s is not None:
+                    worker_rates.setdefault(
+                        self._worker_id(row), []).append(row.steps_per_s)
+            rates = []
+            for worker in sorted(worker_rates):
+                rate = (sum(worker_rates[worker])
+                        / len(worker_rates[worker]))
+                rates.append(rate)
+                builder.add(schema.HUB_WORKER_STEPS, rate,
+                            labels + (("worker", worker),))
+            if rates and max(rates) > 0:
+                builder.add(schema.HUB_STRAGGLER_RATIO,
+                            min(rates) / max(rates), labels)
+
+    def _add_chip_series(self, builder: SnapshotBuilder,
+                         parsed: Sequence[Sequence],
+                         names: Sequence[str]) -> None:
+        """Re-export every known per-chip series, first target wins on
+        identity collisions (Prometheus rejects an exposition with
+        duplicate series, so dedup is correctness, not tidiness).
+
+        Two disambiguation rules keep legitimate setups collision-free:
+        series whose ``worker`` label is present-but-empty get the target
+        as their worker value when the hub has multiple targets (two
+        dev-VM/embedded exporters both exporting chip 0 are different
+        hardware — same rule _worker_id applies to rollups), and the
+        dedup key sorts labels so a third-party exporter rendering the
+        same label set in a different order still collides instead of
+        slipping through as a Prometheus-identical duplicate."""
+        seen: set[tuple] = set()
+        duplicates = 0
+        multi = len(self._targets) > 1
+        for target, series in zip(names, parsed):
+            for name, labels, value in series:
+                spec = PER_CHIP_SPECS.get(name)
+                if spec is None:
+                    continue
+                items: Mapping[str, str] = labels
+                if multi and items.get("worker", None) == "":
+                    items = dict(items)
+                    items["worker"] = str(target)
+                label_tuple = tuple(items.items())
+                key = (name, tuple(sorted(label_tuple)))
+                if key in seen:
+                    duplicates += 1
+                    continue
+                seen.add(key)
+                builder.add(spec, value, label_tuple)
+        builder.add(schema.HUB_DUPLICATE_SERIES, float(duplicates))
+        if duplicates:
+            log.warning(
+                "hub: dropped %d duplicate per-chip series (two targets "
+                "export the same chip identity — check topology labels)",
+                duplicates)
+
+    # -- loop ----------------------------------------------------------------
+
+    def run_forever(self) -> None:
+        # Fixed-cadence like poll.py: sleep the remainder of the interval
+        # so a slow refresh doesn't push the next one further out.
+        while not self._stop.is_set():
+            started = time.monotonic()
+            try:
+                self.refresh_once()
+            except Exception:  # noqa: BLE001 - the hub must never die
+                log.exception("hub refresh failed")
+            elapsed = time.monotonic() - started
+            self._stop.wait(max(0.1, self._interval - elapsed))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, name="hub-refresh", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._pool.shutdown(wait=False)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def main(argv: Sequence[str] | None = None) -> int:
+    import argparse
+    import sys
+
+    from .exposition import MetricsServer, RenderStats
+
+    parser = argparse.ArgumentParser(
+        prog="kube-tpu-stats hub",
+        description="aggregate per-node exporters into one slice-level "
+                    "/metrics with rollups and straggler detection")
+    parser.add_argument("targets", nargs="*",
+                        help="per-node exporter /metrics URLs or .prom files")
+    parser.add_argument("--targets-file", default="",
+                        help="file with one target per line (# comments ok); "
+                             "appended to positional targets")
+    parser.add_argument("--interval", type=float, default=10.0,
+                        help="refresh cadence in seconds (default 10)")
+    parser.add_argument("--fetch-timeout", type=float, default=5.0)
+    parser.add_argument("--expect-workers", type=int, default=0,
+                        help="workers the slice should have; exported as "
+                             "slice_workers_expected for alerting")
+    parser.add_argument("--rollups-only", action="store_true",
+                        help="serve only slice_* rollups, not the merged "
+                             "per-chip accelerator_* series")
+    parser.add_argument("--listen-host", default="0.0.0.0")
+    parser.add_argument("--listen-port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--once", action="store_true",
+                        help="one refresh, print the merged exposition to "
+                             "stdout, exit (rates need two refreshes)")
+    parser.add_argument("--tls-cert-file", default="")
+    parser.add_argument("--tls-key-file", default="")
+    parser.add_argument("--auth-username", default="")
+    parser.add_argument("--auth-password-sha256", default="")
+    args = parser.parse_args(argv)
+
+    targets = list(args.targets)
+    if args.targets_file:
+        try:
+            with open(args.targets_file, encoding="utf-8") as handle:
+                for line in handle:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        targets.append(line)
+        except OSError as exc:
+            print(f"--targets-file: {exc}", file=sys.stderr)
+            return 2
+    if not targets:
+        parser.error("no targets (positional or --targets-file)")
+
+    render_stats = RenderStats()
+    hub = Hub(targets, interval=args.interval,
+              expect_workers=args.expect_workers,
+              rollups_only=args.rollups_only,
+              fetch_timeout=args.fetch_timeout,
+              render_stats=render_stats)
+
+    if args.once:
+        frame = hub.refresh_once()
+        sys.stdout.write(hub.registry.snapshot().render())
+        # All targets down = nothing aggregated: signal it like top --once.
+        return 2 if not frame.rows and frame.errors else 0
+
+    server = MetricsServer(
+        hub.registry, host=args.listen_host, port=args.listen_port,
+        healthz_max_age=max(3 * args.interval, 30.0),
+        tls_cert_file=args.tls_cert_file, tls_key_file=args.tls_key_file,
+        auth_username=args.auth_username,
+        auth_password_sha256=args.auth_password_sha256,
+        render_stats=render_stats)
+    server.start()
+    hub.start()
+    log.info("hub serving %d target(s) on %s:%d",
+             len(targets), args.listen_host, server.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        hub.stop()
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -245,6 +245,155 @@ PERCENTILE_VALUE_KEYS: dict[str, tuple[MetricSpec, str]] = {
 }
 
 
+# --- Slice hub rollups (C9 aggregation service, hub.py) --------------------
+# Families exported by `kube-tpu-stats hub`, which scrapes every per-node
+# exporter of a multi-host slice and serves one merged view. slice_* names
+# carry cross-node rollups; hub_* names are the hub's own health.
+
+HUB_TARGET_UP = MetricSpec(
+    "slice_target_up",
+    MetricType.GAUGE,
+    "1 if the hub's last refresh scraped this per-node exporter target "
+    "successfully, 0 if the fetch or parse failed. One series per "
+    "configured target — a 0 names the exact worker VM that dropped out "
+    "of the slice view.",
+    extra_labels=("target",),
+)
+HUB_WORKERS_EXPECTED = MetricSpec(
+    "slice_workers_expected",
+    MetricType.GAUGE,
+    "Worker count the hub was told to expect (--expect-workers); 0 when "
+    "unset. Exported unlabeled (it is a property of the hub config, not "
+    "of one slice), so alert with `slice_workers < on() group_left() "
+    "slice_workers_expected` to catch missing DaemonSet pods that never "
+    "appear as a failing target.",
+)
+HUB_DUPLICATE_SERIES = MetricSpec(
+    "slice_duplicate_series",
+    MetricType.GAUGE,
+    "Per-chip series dropped from the merged view in the last refresh "
+    "because another target already exported the identical name+labels. "
+    "Nonzero means two exporters claim the same chip identity "
+    "(misconfigured topology labels or a target listed twice).",
+)
+HUB_CHIPS = MetricSpec(
+    "slice_chips",
+    MetricType.GAUGE,
+    "Chips the hub observed across all targets of this slice in the last "
+    "refresh.",
+    extra_labels=("slice",),
+)
+HUB_CHIPS_UP = MetricSpec(
+    "slice_chips_up",
+    MetricType.GAUGE,
+    "Observed chips whose exporter reported accelerator_up 1.",
+    extra_labels=("slice",),
+)
+HUB_WORKERS = MetricSpec(
+    "slice_workers",
+    MetricType.GAUGE,
+    "Distinct workers observed for this slice in the last refresh "
+    "(worker label; targets with no worker label count individually).",
+    extra_labels=("slice",),
+)
+HUB_DUTY_MEAN = MetricSpec(
+    "slice_duty_cycle_mean",
+    MetricType.GAUGE,
+    "Mean accelerator_duty_cycle over every observed chip of the slice "
+    "(0-100).",
+    extra_labels=("slice",),
+)
+HUB_DUTY_MIN = MetricSpec(
+    "slice_duty_cycle_min",
+    MetricType.GAUGE,
+    "Minimum per-chip duty cycle across the slice — the idle straggler "
+    "in an SPMD job where every chip should be equally busy.",
+    extra_labels=("slice",),
+)
+HUB_DUTY_MAX = MetricSpec(
+    "slice_duty_cycle_max",
+    MetricType.GAUGE,
+    "Maximum per-chip duty cycle across the slice.",
+    extra_labels=("slice",),
+)
+HUB_MEMORY_USED = MetricSpec(
+    "slice_memory_used_bytes",
+    MetricType.GAUGE,
+    "Sum of accelerator_memory_used_bytes over every observed chip of "
+    "the slice.",
+    extra_labels=("slice",),
+)
+HUB_MEMORY_TOTAL = MetricSpec(
+    "slice_memory_total_bytes",
+    MetricType.GAUGE,
+    "Sum of accelerator_memory_total_bytes over every observed chip of "
+    "the slice.",
+    extra_labels=("slice",),
+)
+HUB_POWER = MetricSpec(
+    "slice_power_watts",
+    MetricType.GAUGE,
+    "Sum of per-chip power draw over the slice, in watts.",
+    extra_labels=("slice",),
+)
+HUB_ICI_BANDWIDTH = MetricSpec(
+    "slice_ici_bandwidth_bytes_per_second",
+    MetricType.GAUGE,
+    "Sum of per-link ICI traffic rates over every observed chip of the "
+    "slice.",
+    extra_labels=("slice",),
+)
+HUB_WORKER_STEPS = MetricSpec(
+    "slice_worker_steps_per_second",
+    MetricType.GAUGE,
+    "Per-worker workload step rate (mean over the worker's chips), "
+    "computed by the hub from frame-over-frame counter deltas of "
+    "accelerator_workload_steps_total. Appears from the second refresh. "
+    "min() over workers is the slice's effective (straggler-bound) rate.",
+    extra_labels=("slice", "worker"),
+)
+HUB_STRAGGLER_RATIO = MetricSpec(
+    "slice_straggler_ratio",
+    MetricType.GAUGE,
+    "min/max of per-worker step rates for the slice (1.0 = perfectly "
+    "balanced; low values mean a straggling worker is gating the SPMD "
+    "job). Appears once step rates exist.",
+    extra_labels=("slice",),
+)
+HUB_REFRESH_DURATION = MetricSpec(
+    "hub_refresh_duration_seconds",
+    MetricType.HISTOGRAM,
+    "Wall time of one hub refresh: concurrent scrape of every target plus "
+    "merge and rollup computation.",
+)
+
+HUB_METRICS: tuple[MetricSpec, ...] = (
+    HUB_TARGET_UP,
+    HUB_WORKERS_EXPECTED,
+    HUB_DUPLICATE_SERIES,
+    HUB_CHIPS,
+    HUB_CHIPS_UP,
+    HUB_WORKERS,
+    HUB_DUTY_MEAN,
+    HUB_DUTY_MIN,
+    HUB_DUTY_MAX,
+    HUB_MEMORY_USED,
+    HUB_MEMORY_TOTAL,
+    HUB_POWER,
+    HUB_ICI_BANDWIDTH,
+    HUB_WORKER_STEPS,
+    HUB_STRAGGLER_RATIO,
+    HUB_REFRESH_DURATION,
+)
+
+# Buckets for hub_refresh_duration_seconds: a refresh crosses the network
+# once per target, so the range sits above the render buckets and below
+# typical refresh intervals.
+HUB_REFRESH_BUCKETS: tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
 # --- Exporter self-observability (SURVEY.md §5) ----------------------------
 
 SELF_POLL_DURATION = MetricSpec(
@@ -378,7 +527,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
 )
 
 ALL_METRICS: tuple[MetricSpec, ...] = (
-    PER_DEVICE_METRICS + WORKLOAD_HISTOGRAMS + SELF_METRICS
+    PER_DEVICE_METRICS + WORKLOAD_HISTOGRAMS + HUB_METRICS + SELF_METRICS
 )
 
 # Default histogram buckets for collector_poll_duration_seconds. Chosen to

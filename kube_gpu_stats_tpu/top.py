@@ -110,10 +110,12 @@ class Frame:
                     100.0, 100.0 * (row.busy_total - prev.busy_total) / dt)
 
 
-def build_frame(texts: Sequence[str], errors: list[str],
+def build_frame(texts: Sequence[object], errors: list[str],
                 ats: Sequence[float] | None = None,
                 targets: Sequence[object] | None = None) -> Frame:
-    """Fold parsed exposition text from every target into chip rows.
+    """Fold exposition output from every target into chip rows.
+    ``texts[i]`` is either raw exposition text or an already-parsed
+    ``parse_exposition`` series list (hub.py parses once and shares);
     ``ats[i]`` is target i's fetch timestamp (defaults to now);
     ``targets[i]`` its stable identity in row keys (defaults to i)."""
     rows: dict[tuple, ChipRow] = {}
@@ -138,11 +140,14 @@ def build_frame(texts: Sequence[str], errors: list[str],
                 r.namespace = labels.get("namespace", "")
             return r
 
-        try:
-            series = parse_exposition(text)
-        except ValueError as exc:
-            errors.append(str(exc))
-            continue
+        if isinstance(text, str):
+            try:
+                series = parse_exposition(text)
+            except ValueError as exc:
+                errors.append(str(exc))
+                continue
+        else:
+            series = text
         for name, labels, value in series:
             if not name.startswith("accelerator_"):
                 continue

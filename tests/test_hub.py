@@ -1,0 +1,279 @@
+"""`kube-tpu-stats hub` — the slice aggregation service (hub.py). Sources
+are real exporter stacks (mock collector → poll loop → registry → HTTP
+server) so the merge and rollups are pinned to the actual exposition, not
+hand-written fixture text."""
+
+import time
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu import hub as hub_mod
+from kube_gpu_stats_tpu import validate
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.exposition import MetricsServer
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+from kube_gpu_stats_tpu.validate import fetch_exposition, parse_exposition
+
+DEAD_TARGET = "http://127.0.0.1:1/metrics"
+
+
+@pytest.fixture
+def node_stack():
+    """Factory for real per-node exporter stacks serving on port 0."""
+    stacks = []
+
+    def make(worker, slice_name="v5p-16", devices=2):
+        reg = Registry()
+        loop = PollLoop(
+            MockCollector(num_devices=devices, accel_type="tpu-v5p"),
+            reg,
+            deadline=5.0,
+            topology_labels={"slice": slice_name, "worker": worker,
+                             "topology": "2x2x4"},
+        )
+        loop.tick()
+        loop.tick()  # second tick: ICI rates need a delta
+        server = MetricsServer(reg, host="127.0.0.1", port=0)
+        server.start()
+        stacks.append((loop, server))
+        return f"http://127.0.0.1:{server.port}/metrics"
+
+    yield make
+    for loop, server in stacks:
+        loop.stop()
+        server.stop()
+
+
+def series_map(text):
+    return {(name, tuple(sorted(labels.items()))): value
+            for name, labels, value in parse_exposition(text)}
+
+
+def values(text, family):
+    return [value for name, labels, value in parse_exposition(text)
+            if name == family]
+
+
+def test_hub_merges_two_workers_and_rolls_up(node_stack):
+    targets = [node_stack("0"), node_stack("1")]
+    source_totals = sum(
+        sum(values(fetch_exposition(t), "accelerator_memory_total_bytes"))
+        for t in targets)
+
+    hub = hub_mod.Hub(targets, expect_workers=2)
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+
+    assert values(text, "slice_target_up") == [1.0, 1.0]
+    assert values(text, "slice_workers_expected") == [2.0]
+    assert values(text, "slice_chips") == [4.0]
+    assert values(text, "slice_chips_up") == [4.0]
+    assert values(text, "slice_workers") == [2.0]
+    [mean] = values(text, "slice_duty_cycle_mean")
+    [lo] = values(text, "slice_duty_cycle_min")
+    [hi] = values(text, "slice_duty_cycle_max")
+    assert 0.0 <= lo <= mean <= hi <= 100.0
+    assert values(text, "slice_memory_total_bytes") == [source_totals]
+    assert values(text, "slice_ici_bandwidth_bytes_per_second")[0] > 0
+    # Per-chip series pass through with their worker identity intact.
+    ups = [labels for name, labels, _ in parse_exposition(text)
+           if name == "accelerator_up"]
+    assert {lbl["worker"] for lbl in ups} == {"0", "1"}
+    assert values(text, "hub_refresh_duration_seconds_count") == [1.0]
+    # The merged exposition still honors the accelerator_* contract.
+    assert validate.check(text) == []
+
+
+def test_hub_rollup_labels_carry_slice(node_stack):
+    hub = hub_mod.Hub([node_stack("0", slice_name="v5p-a"),
+                       node_stack("0", slice_name="v5p-b")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    chips = {labels["slice"]: value
+             for name, labels, value in parse_exposition(text)
+             if name == "slice_chips"}
+    assert chips == {"v5p-a": 2.0, "v5p-b": 2.0}
+
+
+def test_hub_dead_target_degrades_not_crashes(node_stack):
+    live = node_stack("0")
+    hub = hub_mod.Hub([live, DEAD_TARGET])
+    try:
+        frame = hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    up = {labels["target"]: value
+          for name, labels, value in parse_exposition(text)
+          if name == "slice_target_up"}
+    assert up == {live: 1.0, DEAD_TARGET: 0.0}
+    assert values(text, "slice_chips") == [2.0]  # live worker still rolls up
+    assert frame.errors  # the failure is reported, not swallowed
+
+
+def test_hub_duplicate_chip_identity_folds(node_stack, tmp_path):
+    # Two distinct targets claiming the same chip identity (topology
+    # misconfig) = every per-chip series collides.
+    text = fetch_exposition(node_stack("0"))
+    (tmp_path / "a.prom").write_text(text)
+    (tmp_path / "b.prom").write_text(text)
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    [dups] = values(text, "slice_duplicate_series")
+    assert dups > 0
+    # Dedup is correctness: the merged exposition has no duplicate series.
+    assert validate.check(text) == []
+    # Rollups deliberately count the chimera twice — that IS the signal
+    # (2 real chips, 4 claimed).
+    assert values(text, "slice_chips") == [4.0]
+
+
+def test_hub_same_target_listed_twice_is_deduped(node_stack):
+    target = node_stack("0")
+    hub = hub_mod.Hub([target, target])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    # One slice_target_up series, one copy of each chip — a repeated URL
+    # must not render an exposition Prometheus would reject.
+    assert values(text, "slice_target_up") == [1.0]
+    assert values(text, "slice_duplicate_series") == [0.0]
+    assert validate.check(text) == []
+
+
+def test_hub_dedup_is_label_order_insensitive(tmp_path):
+    # A third-party exporter may render the same Prometheus series
+    # identity with labels in a different order.
+    (tmp_path / "a.prom").write_text(
+        'accelerator_power_watts{chip="0",worker="3",slice="s"} 100\n')
+    (tmp_path / "b.prom").write_text(
+        'accelerator_power_watts{worker="3",slice="s",chip="0"} 100\n')
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert len(values(text, "accelerator_power_watts")) == 1
+    assert values(text, "slice_duplicate_series") == [1.0]
+
+
+def test_hub_empty_worker_label_disambiguated_by_target(tmp_path):
+    # Two dev-VM/embedded exporters with no topology labels both export
+    # chip 0 — different hardware, must both survive the merge.
+    line = 'accelerator_power_watts{chip="0",worker="",slice=""} {v}\n'
+    a, b = tmp_path / "a.prom", tmp_path / "b.prom"
+    a.write_text(line.replace("{v}", "100"))
+    b.write_text(line.replace("{v}", "200"))
+    hub = hub_mod.Hub([str(a), str(b)])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    series = [(labels, value)
+              for name, labels, value in parse_exposition(text)
+              if name == "accelerator_power_watts"]
+    assert sorted(value for _, value in series) == [100.0, 200.0]
+    assert {labels["worker"] for labels, _ in series} == {str(a), str(b)}
+    assert values(text, "slice_duplicate_series") == [0.0]
+    assert values(text, "slice_power_watts") == [300.0]
+
+
+def test_hub_step_rates_and_straggler_ratio(tmp_path):
+    base = ('accelerator_workload_steps_total'
+            '{chip="0",worker="{w}",slice="s"} {v}\n')
+
+    def write(steps_a, steps_b):
+        (tmp_path / "a.prom").write_text(
+            base.replace("{w}", "0").replace("{v}", str(steps_a)))
+        (tmp_path / "b.prom").write_text(
+            base.replace("{w}", "1").replace("{v}", str(steps_b)))
+
+    write(100, 200)
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom")])
+    try:
+        hub.refresh_once()
+        first = hub.registry.snapshot().render()
+        assert values(first, "slice_worker_steps_per_second") == []
+        time.sleep(0.25)
+        write(150, 300)  # worker 0 gains 50, worker 1 gains 100
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    rates = {labels["worker"]: value
+             for name, labels, value in parse_exposition(text)
+             if name == "slice_worker_steps_per_second"}
+    assert set(rates) == {"0", "1"}
+    assert rates["0"] > 0 and rates["1"] > rates["0"]
+    [ratio] = values(text, "slice_straggler_ratio")
+    # Deltas are 50 vs 100 over near-identical windows.
+    assert 0.4 < ratio < 0.6
+
+
+def test_hub_rollups_only_drops_per_chip_series(node_stack):
+    hub = hub_mod.Hub([node_stack("0")], rollups_only=True)
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(text, "slice_chips") == [2.0]
+    assert not any(name.startswith("accelerator_")
+                   for name, _, _ in parse_exposition(text))
+
+
+def test_hub_serves_http_with_healthz_staleness(node_stack):
+    hub = hub_mod.Hub([node_stack("0")])
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           healthz_max_age=30.0)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        # No refresh yet -> no snapshot -> liveness fails loudly.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url + "/healthz", timeout=5)
+        assert err.value.code == 503
+        hub.refresh_once()
+        with urllib.request.urlopen(url + "/healthz", timeout=5) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(url + "/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+        assert "slice_chips" in body
+    finally:
+        hub.stop()
+        server.stop()
+
+
+def test_hub_once_cli(node_stack, capsys):
+    assert hub_mod.main([node_stack("0"), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert values(out, "slice_chips") == [2.0]
+
+
+def test_hub_once_cli_all_targets_down(capsys):
+    assert hub_mod.main([DEAD_TARGET, "--once"]) == 2
+    out = capsys.readouterr().out
+    assert values(out, "slice_target_up") == [0.0]
+
+
+def test_hub_targets_file(node_stack, tmp_path, capsys):
+    listing = tmp_path / "targets.txt"
+    listing.write_text(f"# slice workers\n{node_stack('0')}\n")
+    assert hub_mod.main(["--targets-file", str(listing), "--once"]) == 0
+    assert "slice_chips" in capsys.readouterr().out
