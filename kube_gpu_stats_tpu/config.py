@@ -51,6 +51,9 @@ class Config:
     attribution_interval: float = 10.0
     rediscovery_interval: float = 60.0  # 0 disables hotplug re-enumeration
     drop_labels: tuple[str, ...] = ()  # label keys emitted as "" (cardinality)
+    metrics_include: tuple[str, ...] = ()  # family allowlist (() = all)
+    metrics_exclude: tuple[str, ...] = ()  # family denylist
+    disabled_metrics: frozenset = frozenset()  # resolved from the two above
     mock_devices: int = 4
     use_native: bool = True  # C++ fast path when the shared lib is present
     log_level: str = "info"
@@ -177,6 +180,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "empty strings for cardinality control, e.g. "
                         "'pod,namespace,container'); the label SET stays "
                         "stable so series identity never churns")
+    p.add_argument("--metrics-include", default=_env("METRICS_INCLUDE", ""),
+                   help="comma-separated allowlist of device metric "
+                        "families to export (exact names or globs, e.g. "
+                        "'accelerator_duty_cycle,accelerator_memory_*'); "
+                        "empty = all. accelerator_up and the collector's "
+                        "own self metrics always export (health "
+                        "contracts). The DCGM-exporter collectors-file "
+                        "analog")
+    p.add_argument("--metrics-exclude", default=_env("METRICS_EXCLUDE", ""),
+                   help="comma-separated denylist of device metric "
+                        "families (names or globs), applied after "
+                        "--metrics-include; a typo fails at startup")
     p.add_argument("--mock-devices", type=int,
                    default=int(_env("MOCK_DEVICES", "4")))
     p.add_argument("--no-native", action="store_true",
@@ -291,6 +306,19 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
             f"--drop-labels may not include device-identity labels "
             f"{sorted(identity)}"
         )
+    metrics_include = tuple(
+        key.strip() for key in args.metrics_include.split(",") if key.strip()
+    )
+    metrics_exclude = tuple(
+        key.strip() for key in args.metrics_exclude.split(",") if key.strip()
+    )
+    try:
+        from . import schema
+
+        disabled_metrics = schema.resolve_metric_filter(
+            metrics_include, metrics_exclude)
+    except ValueError as exc:
+        parser.error(str(exc))
     if args.max_process_series < 1:
         parser.error("--max-process-series must be >= 1")
     if args.interval <= 0:
@@ -357,6 +385,9 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         attribution_interval=args.attribution_interval,
         rediscovery_interval=args.rediscovery_interval,
         drop_labels=drop_labels,
+        metrics_include=metrics_include,
+        metrics_exclude=metrics_exclude,
+        disabled_metrics=disabled_metrics,
         mock_devices=args.mock_devices,
         use_native=not args.no_native,
         log_level=args.log_level,

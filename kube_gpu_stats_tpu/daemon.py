@@ -213,6 +213,7 @@ class Daemon:
             version=__version__,
             rediscovery_interval=cfg.rediscovery_interval,
             drop_labels=cfg.drop_labels,
+            disabled_metrics=cfg.disabled_metrics,
             process_openers=self.procwatch.lookup if self.procwatch else None,
             push_stats=self._push_stats,
             render_stats=self.render_stats.contribute,

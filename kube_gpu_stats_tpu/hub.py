@@ -44,6 +44,7 @@ from . import schema
 from .registry import HistogramState, Registry, SnapshotBuilder
 from .top import Frame, build_frame
 from .validate import fetch_exposition, parse_exposition
+from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
 
@@ -91,9 +92,16 @@ class Hub:
         self._previous: Frame | None = None
         self._refresh_hist = HistogramState.empty(
             schema.HUB_REFRESH_DURATION, schema.HUB_REFRESH_BUCKETS)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(32, len(self._targets)),
-            thread_name_prefix="hub-fetch")
+        # Daemon-thread pool (workers.py), not ThreadPoolExecutor: a fetch
+        # wedged in a slow-drip target must not make shutdown unkillable.
+        self._pool = DaemonSamplerPool(
+            min(32, len(self._targets)), thread_name_prefix="hub-fetch")
+        # Fetches that blew the refresh deadline but are still running:
+        # a running future can't be cancelled, so until it finishes we
+        # must not submit another fetch for that target or one wedged
+        # target would leak a pool worker per refresh (poll.py's
+        # stuck-sampler guard, applied to scraping).
+        self._outstanding: dict[str, concurrent.futures.Future] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -113,15 +121,37 @@ class Hub:
             return series, time.monotonic()
 
         # Submit all before collecting any: one slow target must not
-        # serialize the rest (same shape as top.snapshot_frame).
-        futures = [(t, self._pool.submit(fetch, t)) for t in self._targets]
+        # serialize the rest (same shape as top.snapshot_frame). The
+        # refresh as a whole is deadlined too — urlopen's timeout bounds
+        # individual socket operations, so a slow-drip target (headers,
+        # then a byte every few seconds) would otherwise wedge the loop
+        # forever while each recv stays under the per-op timeout.
+        futures: list[tuple[str, concurrent.futures.Future]] = []
+        for target in self._targets:
+            stuck = self._outstanding.get(target)
+            if stuck is not None:
+                if not stuck.done():
+                    reachable[target] = False
+                    errors.append(f"{target}: previous fetch still running")
+                    continue
+                del self._outstanding[target]  # finished late; result stale
+            futures.append((target, self._pool.submit(fetch, target)))
+        deadline = time.monotonic() + 2 * self._fetch_timeout
         for target, future in futures:
             try:
-                series, at = future.result()
+                series, at = future.result(
+                    timeout=max(0.0, deadline - time.monotonic()))
                 parsed.append(series)
                 ats.append(at)
                 names.append(target)
                 reachable[target] = True
+            except concurrent.futures.TimeoutError:
+                if not future.cancel():
+                    self._outstanding[target] = future
+                reachable[target] = False
+                errors.append(
+                    f"{target}: fetch exceeded the refresh deadline "
+                    f"({2 * self._fetch_timeout:g}s)")
             except Exception as exc:  # noqa: BLE001 - per-target degradation
                 reachable[target] = False
                 errors.append(f"{target}: {exc}")
@@ -137,8 +167,8 @@ class Hub:
                         (("target", target),))
         builder.add(schema.HUB_WORKERS_EXPECTED, float(self._expect_workers))
         self._add_rollups(builder, frame)
-        if not self._rollups_only:
-            self._add_chip_series(builder, parsed, names)
+        self._merge_chip_series(builder, parsed, names,
+                                emit_series=not self._rollups_only)
         self._refresh_hist = self._refresh_hist.observe(
             time.monotonic() - start)
         builder.add_histogram(self._refresh_hist)
@@ -184,9 +214,11 @@ class Hub:
             power = [r.power for r in rows if r.power is not None]
             if power:
                 builder.add(schema.HUB_POWER, sum(power), labels)
-            ici = sum(r.ici_bps for r in rows)
-            if ici:
-                builder.add(schema.HUB_ICI_BANDWIDTH, ici, labels)
+            # Gate on series presence, not value: an idle interconnect is
+            # a 0 reading, not a vanished series (absent() alerting).
+            if any(r.ici_links for r in rows):
+                builder.add(schema.HUB_ICI_BANDWIDTH,
+                            sum(r.ici_bps for r in rows), labels)
             # Per-worker step rate = mean over the worker's chips (SPMD:
             # every chip participates in each step, so chips of one
             # worker report the same counter — mean, not sum).
@@ -206,12 +238,17 @@ class Hub:
                 builder.add(schema.HUB_STRAGGLER_RATIO,
                             min(rates) / max(rates), labels)
 
-    def _add_chip_series(self, builder: SnapshotBuilder,
-                         parsed: Sequence[Sequence],
-                         names: Sequence[str]) -> None:
+    def _merge_chip_series(self, builder: SnapshotBuilder,
+                           parsed: Sequence[Sequence],
+                           names: Sequence[str],
+                           emit_series: bool = True) -> None:
         """Re-export every known per-chip series, first target wins on
         identity collisions (Prometheus rejects an exposition with
         duplicate series, so dedup is correctness, not tidiness).
+        With ``emit_series`` False (--rollups-only) the merge still runs
+        for its collision count — slice_duplicate_series is the
+        documented detector for two targets claiming one chip, and the
+        rollups-only mode is where the per-chip series can't reveal it.
 
         Two disambiguation rules keep legitimate setups collision-free:
         series whose ``worker`` label is present-but-empty get the target
@@ -239,7 +276,8 @@ class Hub:
                     duplicates += 1
                     continue
                 seen.add(key)
-                builder.add(spec, value, label_tuple)
+                if emit_series:
+                    builder.add(spec, value, label_tuple)
         builder.add(schema.HUB_DUPLICATE_SERIES, float(duplicates))
         if duplicates:
             log.warning(

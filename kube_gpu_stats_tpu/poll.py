@@ -24,7 +24,8 @@ from typing import Callable, Mapping, Protocol, Sequence
 from . import schema
 from .collectors import Collector, CollectorError, Device, Sample
 from .ici import RateTracker
-from .registry import HistogramState, Registry, SnapshotBuilder
+from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
+                       SnapshotBuilder)
 from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
@@ -60,6 +61,7 @@ class PollLoop:
         rediscovery_interval: float = 60.0,
         process_metrics: bool = True,
         drop_labels: Sequence[str] = (),
+        disabled_metrics: frozenset[str] = frozenset(),
         process_openers: Callable[[str], Sequence[tuple[str, str, str, float]]] | None = None,
         push_stats: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
         render_stats: Callable[[SnapshotBuilder], None] | None = None,
@@ -78,6 +80,10 @@ class PollLoop:
         # emitted as "" rather than removed — the label SET stays constant
         # so series identity is stable regardless of operator config.
         self._drop_labels = frozenset(drop_labels)
+        # Family selection (--metrics-include/--metrics-exclude): names
+        # the builder silently drops. Resolved + validated by
+        # schema.resolve_metric_filter at config time.
+        self._disabled_metrics = frozenset(disabled_metrics)
         # Cached device→holding-process map (procopen.py); a dict read,
         # same off-hot-path contract as attribution. None = disabled.
         self._process_openers = process_openers
@@ -380,7 +386,8 @@ class PollLoop:
     def _build_snapshot(
         self, results: list[tuple[Device, Sample | None]], now: float
     ):
-        builder = SnapshotBuilder()
+        builder = (FilteredSnapshotBuilder(self._disabled_metrics)
+                   if self._disabled_metrics else SnapshotBuilder())
         by_name = _METRICS_BY_NAME
         for dev, sample in results:
             base = self._device_labels(dev)

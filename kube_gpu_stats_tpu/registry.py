@@ -238,3 +238,23 @@ class SnapshotBuilder:
             histograms=tuple(self._histograms),
             timestamp=time.time(),
         )
+
+
+class FilteredSnapshotBuilder(SnapshotBuilder):
+    """SnapshotBuilder that drops families the operator disabled
+    (``--metrics-include``/``--metrics-exclude``, schema.FILTERABLE_METRICS).
+    Filtering at build time — not render time — keeps every output path
+    (scrape, textfile, pushgateway, remote_write) consistent and skips the
+    per-series label work for disabled families on the poll hot path."""
+
+    def __init__(self, disabled: frozenset[str]) -> None:
+        super().__init__()
+        self._disabled = disabled
+
+    def add(self, spec, value, labels=()) -> None:
+        if spec.name not in self._disabled:
+            super().add(spec, value, labels)
+
+    def add_histogram(self, state: HistogramState) -> None:
+        if state.spec.name not in self._disabled:
+            super().add_histogram(state)

@@ -549,6 +549,64 @@ STEP_DURATION_BUCKETS: tuple[float, ...] = (
     2.5, 5.0, 10.0,
 )
 
+# --- Metric family selection (--metrics-include/--metrics-exclude) --------
+# The DCGM-exporter collectors-CSV analog: operators choose which device
+# families to export (cardinality/cost control per cluster). Self metrics
+# (collector_*/process_*) are never filterable — they are the exporter's
+# own health contract — and neither is accelerator_up, the per-device
+# health contract every dashboard and alert joins against.
+
+FILTERABLE_METRICS: frozenset[str] = frozenset(
+    spec.name for spec in PER_DEVICE_METRICS + WORKLOAD_HISTOGRAMS
+    if spec is not DEVICE_UP
+)
+
+
+def resolve_metric_filter(include: Iterable[str],
+                          exclude: Iterable[str]) -> frozenset[str]:
+    """Turn include/exclude family lists into the set of DISABLED names.
+
+    Entries are exact family names or fnmatch globs (e.g.
+    ``accelerator_memory_*``). A non-empty include list enables only the
+    named families (plus the unfilterable ones); exclude then subtracts.
+    Raises ValueError naming the offending entry — a typo must fail at
+    startup, not silently export everything (or nothing).
+    """
+    import fnmatch
+
+    def expand(patterns: Iterable[str], flag: str) -> set[str]:
+        chosen: set[str] = set()
+        for raw in patterns:
+            pattern = raw.strip()
+            if not pattern:
+                continue
+            if pattern == DEVICE_UP.name:
+                raise ValueError(
+                    f"{flag}: {DEVICE_UP.name} cannot be filtered — it is "
+                    f"the per-device health contract")
+            if any(ch in pattern for ch in "*?["):
+                hits = fnmatch.filter(FILTERABLE_METRICS, pattern)
+                if not hits:
+                    raise ValueError(
+                        f"{flag}: pattern {pattern!r} matches no filterable "
+                        f"metric family")
+                chosen.update(hits)
+            elif pattern in FILTERABLE_METRICS:
+                chosen.add(pattern)
+            else:
+                raise ValueError(
+                    f"{flag}: unknown metric family {pattern!r}; filterable "
+                    f"families: {', '.join(sorted(FILTERABLE_METRICS))}")
+        return chosen
+
+    disabled: set[str] = set()
+    included = expand(include, "--metrics-include")
+    if included:
+        disabled = set(FILTERABLE_METRICS) - included
+    disabled |= expand(exclude, "--metrics-exclude")
+    return frozenset(disabled)
+
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 

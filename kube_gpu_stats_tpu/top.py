@@ -73,6 +73,7 @@ class ChipRow:
     power: float | None = None
     temp: float | None = None
     ici_bps: float = 0.0  # summed over links
+    ici_links: int = 0  # ICI rate series seen ("no data" vs "0 B/s")
     holders: int = 0  # accelerator_process_open series (excl. overflow fold)
     # Raw counter values; rates derive from frame-over-frame deltas.
     steps_total: float | None = None
@@ -160,7 +161,9 @@ def build_frame(texts: Sequence[object], errors: list[str],
                 setattr(row(labels), f"{col}_total", value)
                 continue
             if name == schema.ICI_BANDWIDTH.name:
-                row(labels).ici_bps += value
+                r = row(labels)
+                r.ici_bps += value
+                r.ici_links += 1
             elif name == schema.PROCESS_OPEN.name:
                 if labels.get("comm") != "_overflow":
                     row(labels).holders += 1

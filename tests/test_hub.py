@@ -226,6 +226,102 @@ def test_hub_step_rates_and_straggler_ratio(tmp_path):
     assert 0.4 < ratio < 0.6
 
 
+def test_hub_rollups_only_still_detects_duplicates(node_stack, tmp_path):
+    # --rollups-only is exactly the mode where the per-chip series can't
+    # reveal a chip-identity collision, so the detector must still run.
+    text = fetch_exposition(node_stack("0"))
+    (tmp_path / "a.prom").write_text(text)
+    (tmp_path / "b.prom").write_text(text)
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom")],
+                      rollups_only=True)
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    [dups] = values(text, "slice_duplicate_series")
+    assert dups > 0
+
+
+def test_hub_ici_rollup_zero_traffic_keeps_series(tmp_path):
+    # An idle interconnect is a 0 reading; a source with no ICI series at
+    # all gets no rollup. Conflating them would churn absent() alerts.
+    ici = ('accelerator_ici_link_bandwidth_bytes_per_second'
+           '{chip="0",worker="0",slice="s",link="0"} 0\n')
+    bare = 'accelerator_power_watts{chip="0",worker="0",slice="s"} 5\n'
+    (tmp_path / "ici.prom").write_text(ici)
+    (tmp_path / "bare.prom").write_text(bare)
+
+    hub = hub_mod.Hub([str(tmp_path / "ici.prom")])
+    try:
+        hub.refresh_once()
+        with_ici = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(with_ici, "slice_ici_bandwidth_bytes_per_second") == [0.0]
+
+    hub = hub_mod.Hub([str(tmp_path / "bare.prom")])
+    try:
+        hub.refresh_once()
+        without = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(without, "slice_ici_bandwidth_bytes_per_second") == []
+
+
+def test_hub_slow_drip_target_cannot_wedge_refresh():
+    # A target that accepts the connection but never completes a response
+    # within the refresh deadline must be marked down, not block forever
+    # (urlopen's timeout is per socket op, so a slow drip evades it).
+    import socket
+    import threading
+
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    release = threading.Event()
+    conns = []
+
+    def tarpit():
+        # Drip one byte per 0.1s: every socket recv succeeds inside the
+        # per-op timeout, but the response never completes — the evasion
+        # a bare urlopen timeout cannot catch.
+        try:
+            conn, _ = listener.accept()
+        except OSError:
+            return
+        conns.append(conn)
+        while not release.is_set():
+            try:
+                conn.sendall(b"x")
+            except OSError:
+                return
+            release.wait(0.1)
+
+    thread = threading.Thread(target=tarpit, daemon=True)
+    thread.start()
+    hub = hub_mod.Hub([f"http://127.0.0.1:{port}/metrics"],
+                      fetch_timeout=0.3)
+    try:
+        start = time.monotonic()
+        frame = hub.refresh_once()
+        assert time.monotonic() - start < 3.0
+        assert frame.errors and "deadline" in frame.errors[0]
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_target_up") == [0.0]
+        # The wedged fetch stays outstanding: the next refresh must not
+        # stack another worker on the same target.
+        frame2 = hub.refresh_once()
+        assert frame2.errors and "still running" in frame2.errors[0]
+    finally:
+        release.set()
+        listener.close()
+        for conn in conns:
+            conn.close()
+        hub.stop()
+
+
 def test_hub_rollups_only_drops_per_chip_series(node_stack):
     hub = hub_mod.Hub([node_stack("0")], rollups_only=True)
     try:

@@ -1,0 +1,160 @@
+"""Metric family selection (--metrics-include/--metrics-exclude) — the
+DCGM-exporter collectors-file analog (schema.resolve_metric_filter,
+registry.FilteredSnapshotBuilder, wired through config + poll loop)."""
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.config import from_args
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import (FilteredSnapshotBuilder,
+                                         HistogramState, Registry)
+
+
+def families(text):
+    return {line.split("{")[0].split(" ")[0]
+            for line in text.splitlines() if not line.startswith("#")}
+
+
+# -- resolve_metric_filter ---------------------------------------------------
+
+def test_exclude_names_disable_exactly_those():
+    disabled = schema.resolve_metric_filter(
+        (), ("accelerator_power_watts", "accelerator_temperature_celsius"))
+    assert disabled == {"accelerator_power_watts",
+                        "accelerator_temperature_celsius"}
+
+
+def test_include_list_disables_everything_else():
+    disabled = schema.resolve_metric_filter(("accelerator_duty_cycle",), ())
+    assert "accelerator_duty_cycle" not in disabled
+    assert "accelerator_power_watts" in disabled
+    # The health contract is never disabled even under a narrow include.
+    assert "accelerator_up" not in disabled
+
+
+def test_globs_expand_and_exclude_beats_include():
+    disabled = schema.resolve_metric_filter(
+        ("accelerator_memory_*", "accelerator_duty_cycle"),
+        ("accelerator_memory_peak_bytes",))
+    assert "accelerator_memory_used_bytes" not in disabled
+    assert "accelerator_memory_total_bytes" not in disabled
+    assert "accelerator_memory_peak_bytes" in disabled  # exclude wins
+    assert "accelerator_ici_link_bandwidth_bytes_per_second" in disabled
+
+
+def test_unknown_family_and_dead_glob_fail_loudly():
+    with pytest.raises(ValueError, match="unknown metric family"):
+        schema.resolve_metric_filter((), ("accelerator_duty_cylce",))
+    with pytest.raises(ValueError, match="matches no filterable"):
+        schema.resolve_metric_filter(("nvidia_*",), ())
+
+
+def test_accelerator_up_is_not_filterable():
+    with pytest.raises(ValueError, match="health contract"):
+        schema.resolve_metric_filter((), ("accelerator_up",))
+    with pytest.raises(ValueError, match="health contract"):
+        schema.resolve_metric_filter(("accelerator_up",), ())
+
+
+def test_self_metrics_are_not_filterable():
+    with pytest.raises(ValueError, match="unknown metric family"):
+        schema.resolve_metric_filter((), ("collector_poll_duration_seconds",))
+
+
+# -- FilteredSnapshotBuilder -------------------------------------------------
+
+def test_filtered_builder_drops_series_and_histograms():
+    builder = FilteredSnapshotBuilder(
+        frozenset({schema.POWER.name,
+                   schema.WORKLOAD_STEP_DURATION.name}))
+    builder.add(schema.POWER, 100.0)
+    builder.add(schema.DUTY_CYCLE, 50.0)
+    builder.add_histogram(HistogramState.empty(
+        schema.WORKLOAD_STEP_DURATION, schema.STEP_DURATION_BUCKETS))
+    builder.add_histogram(HistogramState.empty(
+        schema.SELF_POLL_DURATION, schema.POLL_DURATION_BUCKETS))
+    text = builder.build().render()
+    got = families(text)
+    assert schema.DUTY_CYCLE.name in got
+    assert schema.POWER.name not in got
+    assert "collector_poll_duration_seconds_count" in got
+    assert not any(f.startswith(schema.WORKLOAD_STEP_DURATION.name)
+                   for f in got)
+
+
+# -- through the poll loop ---------------------------------------------------
+
+def test_poll_loop_respects_disabled_metrics():
+    reg = Registry()
+    loop = PollLoop(
+        MockCollector(num_devices=2), reg, deadline=5.0,
+        disabled_metrics=schema.resolve_metric_filter(
+            (), ("accelerator_power_watts", "accelerator_ici_*")),
+    )
+    loop.tick()
+    loop.tick()
+    loop.stop()
+    got = families(reg.snapshot().render())
+    assert "accelerator_power_watts" not in got
+    assert "accelerator_ici_link_traffic_bytes_total" not in got
+    assert "accelerator_ici_link_bandwidth_bytes_per_second" not in got
+    assert "accelerator_duty_cycle" in got
+    assert "accelerator_up" in got
+    assert "collector_devices" in got
+
+
+def test_poll_loop_include_mode_filters_memory_retention():
+    # The stale-tick MEMORY_TOTAL retention re-emit must obey the filter
+    # too — an include list without memory families exports no capacity
+    # gauges even for a device that just went stale.
+    from kube_gpu_stats_tpu.collectors import CollectorError
+
+    class FlakyMock(MockCollector):
+        failing = False
+
+        def sample(self, device):
+            if self.failing:
+                raise CollectorError("injected")
+            return super().sample(device)
+
+    collector = FlakyMock(num_devices=1)
+    reg = Registry()
+    loop = PollLoop(
+        collector, reg, deadline=5.0,
+        disabled_metrics=schema.resolve_metric_filter(
+            ("accelerator_duty_cycle",), ()),
+    )
+    loop.tick()  # healthy: seeds the retained-capacity map
+    got = families(reg.snapshot().render())
+    assert "accelerator_duty_cycle" in got
+    assert "accelerator_memory_total_bytes" not in got
+    assert "accelerator_memory_used_bytes" not in got
+    collector.failing = True
+    loop.tick()  # stale: the retention re-emit path runs
+    loop.stop()
+    text = reg.snapshot().render()
+    got = families(text)
+    assert "accelerator_memory_total_bytes" not in got
+    # The device is reported down, proving the stale path actually ran.
+    up_lines = [line for line in text.splitlines()
+                if line.startswith("accelerator_up{")]
+    assert up_lines and all(line.endswith(" 0") for line in up_lines)
+
+
+# -- through config ----------------------------------------------------------
+
+def test_config_resolves_and_validates_filter():
+    cfg = from_args(["--metrics-exclude", "accelerator_process_open",
+                     "--backend", "mock"])
+    assert cfg.metrics_exclude == ("accelerator_process_open",)
+    assert cfg.disabled_metrics == {"accelerator_process_open"}
+    with pytest.raises(SystemExit):
+        from_args(["--metrics-exclude", "not_a_family"])
+    with pytest.raises(SystemExit):
+        from_args(["--metrics-include", "accelerator_up"])
+
+
+def test_config_default_is_everything_enabled():
+    assert from_args(["--backend", "mock"]).disabled_metrics == frozenset()
