@@ -19,7 +19,9 @@ collects what in-process JAX can see without any gRPC surface:
   ``accelerator_workload_steps_total`` — the duty-cycle analog that in-
   process code can report honestly. Timed steps additionally feed
   ``accelerator_workload_busy_seconds_total`` (rate() = busy fraction)
-  and the ``accelerator_workload_step_duration_seconds`` histogram.
+  and the ``accelerator_workload_step_duration_seconds`` histogram;
+  steps reporting ``flops=`` also feed the per-chip FLOPs counter and a
+  live MFU gauge against the device kind's peak bf16 rate.
 
 Usage (one call in the training script)::
 
@@ -75,6 +77,30 @@ def _kind_capacity(device_kind: str) -> int | None:
     return None
 
 
+# Peak dense bf16 FLOP/s per chip by PJRT device_kind substring (public
+# per-chip specs; same match discipline as _HBM_BY_KIND: specific
+# spellings first, unknown kinds omit the gauge — never a guess). The
+# MFU denominator.
+_PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),  # v6e / Trillium
+    ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _kind_peak_flops(device_kind: str) -> float | None:
+    lowered = device_kind.lower()
+    for needle, peak in _PEAK_FLOPS_BY_KIND:
+        if needle in lowered:
+            return peak
+    return None
+
+
 class JaxIntrospectCollector(Collector):
     """Collector over in-process JAX device introspection. No RPC, no
     sysfs — everything comes from the live JAX client, so it works on any
@@ -92,6 +118,11 @@ class JaxIntrospectCollector(Collector):
         # the float add, never corrupt the exposition.
         self._steps = 0
         self._busy_seconds = 0.0
+        self._flops = 0.0
+        # MFU window state, advanced once per tick in begin_tick (poll
+        # thread); sample() only reads the precomputed value.
+        self._mfu: float | None = None
+        self._mfu_prev: tuple[float, float] | None = None  # (flops, at)
         # Step-duration histogram, published to the poll thread by
         # reference swap (HistogramState is immutable).
         self._step_hist = HistogramState.empty(
@@ -111,23 +142,53 @@ class JaxIntrospectCollector(Collector):
 
     # -- workload hook -------------------------------------------------------
 
-    def record_step(self, n: int = 1, seconds: float | None = None) -> None:
+    def record_step(self, n: int = 1, seconds: float | None = None,
+                    flops: float | None = None) -> None:
         """Report n completed steps; ``seconds`` is the wall time they
         took (feeds the busy counter and the step-duration histogram as
-        seconds/n per step)."""
+        seconds/n per step); ``flops`` is the model FLOPs those n steps
+        executed across the whole workload (feeds the FLOPs counter and
+        the in-process MFU gauge)."""
         self._steps += n
         if seconds is not None and n > 0:
             self._busy_seconds += seconds
             self._step_hist = self._step_hist.observe(seconds / n, count=n)
+        if flops is not None and flops > 0:
+            self._flops += flops
 
     @contextlib.contextmanager
-    def step_timer(self) -> Iterator[None]:
-        """Time one step: ``with collector.step_timer(): train_step()``."""
+    def step_timer(self, flops: float | None = None) -> Iterator[None]:
+        """Time one step: ``with collector.step_timer(): train_step()``.
+        ``flops`` = model FLOPs this step executes (for MFU)."""
         start = time.perf_counter()
         try:
             yield
         finally:
-            self.record_step(1, seconds=time.perf_counter() - start)
+            self.record_step(1, seconds=time.perf_counter() - start,
+                             flops=flops)
+
+    def begin_tick(self) -> None:
+        """Advance the MFU window once per poll tick (poll thread): the
+        delta of workload-reported FLOPs over the tick interval, per
+        local device, against the device kind's peak."""
+        # Single read: the training thread may record_step(flops=) at any
+        # point in here; reading twice would count those FLOPs in both
+        # this window (the delta) and the next (the stored baseline).
+        flops = self._flops
+        if flops <= 0:
+            return
+        now = time.monotonic()
+        prev = self._mfu_prev
+        self._mfu_prev = (flops, now)
+        if prev is None:
+            return
+        kind = self._devices[0].device_kind if self._devices else ""
+        peak = _kind_peak_flops(kind)
+        dt = now - prev[1]
+        if peak is None or dt <= 0:
+            return
+        per_device = (flops - prev[0]) / max(1, len(self._devices))
+        self._mfu = 100.0 * per_device / dt / peak
 
     def extra_histograms(self) -> tuple[HistogramState, ...]:
         """Poll-loop hook: fold the step-duration histogram into each
@@ -198,6 +259,14 @@ class JaxIntrospectCollector(Collector):
         values[schema.UPTIME.name] = time.monotonic() - self._start_monotonic
         values[schema.WORKLOAD_STEPS.name] = float(self._steps)
         values[schema.WORKLOAD_BUSY_SECONDS.name] = self._busy_seconds
+        peak = _kind_peak_flops(jdev.device_kind)
+        if peak is not None:
+            values[schema.PEAK_FLOPS.name] = peak
+        if self._flops > 0:
+            values[schema.WORKLOAD_FLOPS.name] = (
+                self._flops / max(1, len(self._devices)))
+            if self._mfu is not None:
+                values[schema.WORKLOAD_MFU.name] = self._mfu
         return Sample(device=device, values=values)
 
     def close(self) -> None:
@@ -241,11 +310,13 @@ class EmbeddedExporter:
     def port(self) -> int:
         return self.server.port
 
-    def record_step(self, n: int = 1, seconds: float | None = None) -> None:
-        self.collector.record_step(n, seconds=seconds)
+    def record_step(self, n: int = 1, seconds: float | None = None,
+                    flops: float | None = None) -> None:
+        self.collector.record_step(n, seconds=seconds, flops=flops)
 
-    def step_timer(self) -> contextlib.AbstractContextManager[None]:
-        return self.collector.step_timer()
+    def step_timer(self, flops: float | None = None
+                   ) -> contextlib.AbstractContextManager[None]:
+        return self.collector.step_timer(flops=flops)
 
     def start(self) -> "EmbeddedExporter":
         self.server.start()

@@ -145,7 +145,8 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
         nonlocal pending_steps, last_hook_t
         now_t = time.perf_counter()
         if step_hook is not None and pending_steps:
-            step_hook(pending_steps, seconds=now_t - last_hook_t)
+            step_hook(pending_steps, seconds=now_t - last_hook_t,
+                      flops=2 * matmuls_per_step * size**3 * pending_steps)
         pending_steps = 0
         last_hook_t = now_t
 

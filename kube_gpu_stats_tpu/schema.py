@@ -192,6 +192,36 @@ WORKLOAD_BUSY_SECONDS = MetricSpec(
     "embedded mode.",
 )
 
+WORKLOAD_FLOPS = MetricSpec(
+    "accelerator_workload_flops_total",
+    MetricType.COUNTER,
+    "Cumulative model FLOPs this chip executed, as reported by the "
+    "workload via the embedded exporter's step hook (record_step(flops=)/"
+    "step_timer(flops=)); the workload-global figure is divided evenly "
+    "over the local devices (SPMD). rate() of this counter divided by "
+    "accelerator_peak_flops_per_second, times 100, is MFU in percent "
+    "(matching accelerator_workload_model_flops_utilization). Only "
+    "present in embedded mode when the workload reports FLOPs.",
+)
+PEAK_FLOPS = MetricSpec(
+    "accelerator_peak_flops_per_second",
+    MetricType.GAUGE,
+    "Peak dense bf16 FLOP rate of this chip, from a device-kind table "
+    "(public per-chip specs). The MFU denominator for any FLOPs source; "
+    "absent for unknown device kinds (never a guess).",
+)
+WORKLOAD_MFU = MetricSpec(
+    "accelerator_workload_model_flops_utilization",
+    MetricType.GAUGE,
+    "Model FLOPs utilization (MFU) over the last poll interval, percent "
+    "of peak dense bf16: workload-reported FLOPs per local device per "
+    "second divided by accelerator_peak_flops_per_second. Computed "
+    "in-process so `top`/dashboards get it without a Prometheus rate(). "
+    "Values over 100 mean the workload over-reports FLOPs. Only present "
+    "in embedded mode when FLOPs are reported and the device kind is "
+    "known.",
+)
+
 WORKLOAD_STEP_DURATION = MetricSpec(
     "accelerator_workload_step_duration_seconds",
     MetricType.HISTOGRAM,
@@ -219,6 +249,9 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     PROCESS_OPEN,
     WORKLOAD_STEPS,
     WORKLOAD_BUSY_SECONDS,
+    WORKLOAD_FLOPS,
+    PEAK_FLOPS,
+    WORKLOAD_MFU,
     PASSTHROUGH,
 )
 

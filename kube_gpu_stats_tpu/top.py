@@ -40,6 +40,7 @@ _GAUGES = {
     "power": schema.POWER.name,
     "temp": schema.TEMPERATURE.name,
     "up": schema.DEVICE_UP.name,
+    "mfu": schema.WORKLOAD_MFU.name,
 }
 _COUNTERS = {
     "steps": schema.WORKLOAD_STEPS.name,
@@ -70,6 +71,7 @@ class ChipRow:
     mem_used: float | None = None
     mem_total: float | None = None
     mem_peak: float | None = None  # JSON only; the table stays 80-col
+    mfu: float | None = None  # JSON only (embedded-mode MFU gauge)
     power: float | None = None
     temp: float | None = None
     ici_bps: float = 0.0  # summed over links

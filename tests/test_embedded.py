@@ -124,6 +124,57 @@ def test_burn_step_hook_feeds_counter():
     steps = run_burn(seconds=0.2, size=128, report_every=1e9,
                      step_hook=col.record_step)
     assert steps > 0 and col._steps == steps
+    # The burn reports its matmul FLOPs (4 chained matmuls of size^3).
+    assert col._flops == steps * 2 * 4 * 128**3
+
+
+def test_flops_counter_divides_over_local_devices():
+    col = JaxIntrospectCollector()
+    devices = col.discover()
+    col.record_step(2, seconds=0.1, flops=16e9)
+    s = col.sample(devices[0])
+    assert s.values[schema.WORKLOAD_FLOPS.name] == 16e9 / len(devices)
+    # CPU devices: no peak table entry -> no peak gauge, no MFU, never a
+    # guess.
+    assert schema.PEAK_FLOPS.name not in s.values
+    assert schema.WORKLOAD_MFU.name not in s.values
+
+
+def test_no_flops_reported_no_flops_series():
+    col = JaxIntrospectCollector()
+    col.record_step(3, seconds=0.1)
+    s = col.sample(col.discover()[0])
+    assert schema.WORKLOAD_FLOPS.name not in s.values
+
+
+def test_mfu_gauge_from_tick_window(monkeypatch):
+    import time as _time
+
+    from kube_gpu_stats_tpu import embedded as embedded_mod
+
+    # CPU device kinds have no table entry; pin a peak so the math is
+    # checkable: 1 GFLOP/s peak per device.
+    monkeypatch.setattr(embedded_mod, "_kind_peak_flops", lambda kind: 1e9)
+    col = JaxIntrospectCollector()
+    devices = col.discover()
+    n = len(devices)
+    col.record_step(1, flops=n * 1e9)
+    col.begin_tick()  # first window point: no MFU yet
+    assert col.sample(devices[0]).values.get(schema.WORKLOAD_MFU.name) is None
+    _time.sleep(0.05)
+    col.record_step(1, flops=n * 1e9)
+    col.begin_tick()
+    s = col.sample(devices[0])
+    assert s.values[schema.PEAK_FLOPS.name] == 1e9
+    mfu = s.values[schema.WORKLOAD_MFU.name]
+    # ~1e9 FLOPs/device over a ~0.05-0.3 s window at 1e9 peak:
+    # far above 100% — proves the window math, and that over-reported
+    # FLOPs surface as >100 instead of being clamped into plausibility.
+    assert mfu > 100.0
+    # A window with no new FLOPs drives MFU to ~0 (goodput gap visible).
+    _time.sleep(0.01)
+    col.begin_tick()
+    assert col.sample(devices[0]).values[schema.WORKLOAD_MFU.name] < mfu
 
 
 def test_real_probe_explains_fallback():
