@@ -63,8 +63,9 @@ def main() -> int:
     if "device_kind" in result:
         line["device_kind"] = result["device_kind"]
     for key in ("workload_steps_per_s_during_bench",
-                "workload_busy_fraction_during_bench"):
-        if key in result:
+                "workload_busy_fraction_during_bench",
+                "workload_mfu_pct_during_bench"):
+        if key in result and result[key] is not None:
             line[key] = result[key]
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or

@@ -460,6 +460,7 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
             return None
         steps_before = collector._steps
         busy_before = collector._busy_seconds
+        flops_before = collector._flops
         window_start = time.monotonic()
         result = measure_collector(
             collector, ticks=ticks, warmup=warmup,
@@ -480,6 +481,18 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
         result["workload_busy_fraction_during_bench"] = round(
             (collector._busy_seconds - busy_before) / elapsed, 3
         ) if elapsed else 0.0
+        # Measured MFU over the same window (burn reports its matmul
+        # FLOPs; peak from the device-kind table — None for unknown
+        # kinds rather than a guess). run_burn executes on the default
+        # device only, so this is the busy chip's MFU — no division over
+        # local devices (the collector's SPMD split would under-report
+        # N-fold on a multi-chip host).
+        from .embedded import _kind_peak_flops
+
+        peak = _kind_peak_flops(record.get("device_kind") or "")
+        result["workload_mfu_pct_during_bench"] = round(
+            100.0 * (collector._flops - flops_before) / elapsed / peak,
+            2) if (peak and elapsed) else None
         stop.wait(burn_seconds + 60.0)
         burner.join(timeout=5.0)
         return result

@@ -69,14 +69,6 @@ _HBM_BY_KIND: tuple[tuple[str, int], ...] = (
 )
 
 
-def _kind_capacity(device_kind: str) -> int | None:
-    lowered = device_kind.lower()
-    for needle, capacity in _HBM_BY_KIND:
-        if needle in lowered:
-            return capacity
-    return None
-
-
 # Peak dense bf16 FLOP/s per chip by PJRT device_kind substring (public
 # per-chip specs; same match discipline as _HBM_BY_KIND: specific
 # spellings first, unknown kinds omit the gauge — never a guess). The
@@ -93,12 +85,21 @@ _PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
 )
 
 
-def _kind_peak_flops(device_kind: str) -> float | None:
+def _kind_lookup(table, device_kind: str):
+    """First-match substring lookup over a per-device-kind table."""
     lowered = device_kind.lower()
-    for needle, peak in _PEAK_FLOPS_BY_KIND:
+    for needle, value in table:
         if needle in lowered:
-            return peak
+            return value
     return None
+
+
+def _kind_capacity(device_kind: str) -> int | None:
+    return _kind_lookup(_HBM_BY_KIND, device_kind)
+
+
+def _kind_peak_flops(device_kind: str) -> float | None:
+    return _kind_lookup(_PEAK_FLOPS_BY_KIND, device_kind)
 
 
 class JaxIntrospectCollector(Collector):

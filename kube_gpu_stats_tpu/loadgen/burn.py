@@ -110,9 +110,15 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
              step_hook=None) -> int:
     """Drive the local chip(s) for `seconds`; returns steps executed.
     kernel: "xla" (jnp matmul chain) or "pallas" (hand-tiled MXU kernel).
-    step_hook(n, seconds=dt): called at each materialization point with the
-    steps since the last call and their combined wall time — the embedded
-    exporter's step hook (embedded.EmbeddedExporter.record_step)."""
+    step_hook(n, seconds=dt, flops=f): called at each materialization point
+    with the steps since the last call, their combined wall time, and
+    their matmul FLOPs — the embedded exporter's step hook
+    (embedded.EmbeddedExporter.record_step). Caveat: this burn executes on
+    the default device only, while record_step's flops contract is
+    workload-global (split over local devices) — on a multi-chip host the
+    exported per-chip FLOPs/MFU spread the one busy chip's work over all
+    chips. Single-device hosts (and the bench harness, which corrects for
+    this) are exact."""
     import jax
 
     import jax.numpy as jnp

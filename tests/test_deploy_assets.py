@@ -220,3 +220,35 @@ def test_podmonitor_matches_daemonset():
         assert endpoint["port"] in port_names
         assert endpoint.get("path", "/metrics") == "/metrics"
     assert pm["metadata"]["namespace"] == ds["metadata"]["namespace"]
+
+
+def test_hub_manifest_shape():
+    """deploy/hub.yaml: the optional slice aggregation Deployment must run
+    the hub subcommand against the mounted targets file, wire probes to
+    the hub's stale-aware endpoints, and keep names consistent across the
+    ConfigMap, volume, and Service selector."""
+    docs = load_yaml_docs("hub.yaml")
+    by_kind = {d["kind"]: d for d in docs}
+    assert set(by_kind) == {"ConfigMap", "Deployment", "Service"}
+    dep = by_kind["Deployment"]
+    pod = dep["spec"]["template"]
+    container = pod["spec"]["containers"][0]
+    assert container["args"][0] == "hub"
+    targets_idx = container["args"].index("--targets-file")
+    targets_path = container["args"][targets_idx + 1]
+    mount = container["volumeMounts"][0]
+    assert targets_path.startswith(mount["mountPath"])
+    (volume,) = pod["spec"]["volumes"]
+    assert volume["configMap"]["name"] == by_kind["ConfigMap"]["metadata"]["name"]
+    filename = targets_path[len(mount["mountPath"]):].lstrip("/")
+    assert filename in by_kind["ConfigMap"]["data"]
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    port_names = {p["name"] for p in container["ports"]}
+    assert container["livenessProbe"]["httpGet"]["port"] in port_names
+    svc = by_kind["Service"]
+    pod_labels = pod["metadata"]["labels"]
+    for key, value in svc["spec"]["selector"].items():
+        assert pod_labels.get(key) == value
+    assert {d["metadata"]["namespace"] for d in docs} == {
+        dep["metadata"]["namespace"]}
