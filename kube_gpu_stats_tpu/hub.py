@@ -41,7 +41,8 @@ import time
 from typing import Mapping, Sequence
 
 from . import schema
-from .registry import HistogramState, Registry, SnapshotBuilder
+from .registry import (HistogramState, Registry, SnapshotBuilder,
+                       contribute_push_stats)
 from .top import Frame, build_frame
 from .validate import fetch_exposition, parse_exposition
 from .workers import DaemonSamplerPool
@@ -73,7 +74,7 @@ class Hub:
                  expect_workers: int = 0, rollups_only: bool = False,
                  fetch_timeout: float = 5.0,
                  registry: Registry | None = None,
-                 render_stats=None) -> None:
+                 render_stats=None, push_stats=None) -> None:
         if not targets:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -88,6 +89,9 @@ class Hub:
         self._rollups_only = rollups_only
         self._fetch_timeout = fetch_timeout
         self._render_stats = render_stats
+        # Shipping-health counters from attached push senders (same shape
+        # as daemon._push_stats: mode -> {pushes, failures, dropped}).
+        self._push_stats = push_stats
         self.registry = registry if registry is not None else Registry()
         self._previous: Frame | None = None
         self._refresh_hist = HistogramState.empty(
@@ -174,6 +178,8 @@ class Hub:
         builder.add_histogram(self._refresh_hist)
         if self._render_stats is not None:
             self._render_stats.contribute(builder)
+        if self._push_stats is not None:
+            contribute_push_stats(builder, self._push_stats())
         self.registry.publish(builder.build())
         for err in errors:
             log.warning("hub refresh: %s", err)
@@ -346,6 +352,26 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--tls-key-file", default="")
     parser.add_argument("--auth-username", default="")
     parser.add_argument("--auth-password-sha256", default="")
+    parser.add_argument("--pushgateway-url", default="",
+                        help="push each merged snapshot to a Prometheus "
+                             "Pushgateway (slice-level egress for "
+                             "unscrapeable clusters); empty disables")
+    parser.add_argument("--pushgateway-job", default="kube-tpu-stats-hub")
+    parser.add_argument("--pushgateway-instance", default="",
+                        help="Pushgateway grouping-key instance; defaults "
+                             "to the job name, NOT the hostname — a hub "
+                             "Deployment's pod name changes every restart "
+                             "and would strand a stale group per "
+                             "reschedule")
+    parser.add_argument("--remote-write-url", default="",
+                        help="ship each merged snapshot via Prometheus "
+                             "remote_write (Mimir/Thanos/GMP receivers); "
+                             "empty disables")
+    parser.add_argument("--remote-write-job", default="kube-tpu-stats-hub")
+    parser.add_argument("--remote-write-interval", type=float, default=15.0)
+    parser.add_argument("--remote-write-protocol",
+                        choices=("1.0", "2.0"), default="1.0")
+    parser.add_argument("--remote-write-bearer-token-file", default="")
     args = parser.parse_args(argv)
 
     targets = list(args.targets)
@@ -363,15 +389,57 @@ def main(argv: Sequence[str] | None = None) -> int:
         parser.error("no targets (positional or --targets-file)")
 
     render_stats = RenderStats()
+    senders: list = []
+
+    def push_stats() -> dict:
+        # Same shape as daemon._push_stats; resolved per refresh so the
+        # collector_push_* self metrics ride the hub's own exposition.
+        return {
+            mode: {"pushes": sender.pushes_total,
+                   "failures": sender.failures_total,
+                   "dropped": sender.dropped_total}
+            for mode, sender in senders
+        }
+
     hub = Hub(targets, interval=args.interval,
               expect_workers=args.expect_workers,
               rollups_only=args.rollups_only,
               fetch_timeout=args.fetch_timeout,
-              render_stats=render_stats)
+              render_stats=render_stats,
+              push_stats=push_stats if (args.pushgateway_url
+                                        or args.remote_write_url) else None)
+
+    # Push senders follow registry publishes, so they ship each merged
+    # snapshot unmodified — the hub as a slice-level egress point.
+    # Constructed before the --once branch: a cron-run `--once
+    # --pushgateway-url ...` must push, not silently succeed at nothing.
+    if args.pushgateway_url:
+        from .exposition import PushgatewayPusher
+
+        senders.append(("pushgateway", PushgatewayPusher(
+            hub.registry, args.pushgateway_url, job=args.pushgateway_job,
+            instance=args.pushgateway_instance or args.pushgateway_job,
+            render_stats=render_stats)))
+    if args.remote_write_url:
+        from .remote_write import RemoteWriter
+
+        senders.append(("remote_write", RemoteWriter(
+            hub.registry, args.remote_write_url,
+            job=args.remote_write_job,
+            min_interval=args.remote_write_interval,
+            protocol=args.remote_write_protocol,
+            bearer_token_file=args.remote_write_bearer_token_file,
+            render_stats=render_stats)))
 
     if args.once:
         frame = hub.refresh_once()
+        for mode, sender in senders:
+            sender.push_once()
+            if sender.failures_total or sender.dropped_total:
+                print(f"! {mode} push failed", file=sys.stderr)
         sys.stdout.write(hub.registry.snapshot().render())
+        if any(s.failures_total or s.dropped_total for _, s in senders):
+            return 1
         # All targets down = nothing aggregated: signal it like top --once.
         return 2 if not frame.rows and frame.errors else 0
 
@@ -383,6 +451,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         auth_password_sha256=args.auth_password_sha256,
         render_stats=render_stats)
     server.start()
+    for _, sender in senders:
+        sender.start()
     hub.start()
     log.info("hub serving %d target(s) on %s:%d",
              len(targets), args.listen_host, server.port)
@@ -393,6 +463,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         return 0
     finally:
         hub.stop()
+        for _, sender in senders:
+            sender.stop()
         server.stop()
 
 

@@ -25,7 +25,7 @@ from . import schema
 from .collectors import Collector, CollectorError, Device, Sample
 from .ici import RateTracker
 from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
-                       SnapshotBuilder)
+                       SnapshotBuilder, contribute_push_stats)
 from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
@@ -479,14 +479,7 @@ class PollLoop:
                 [("reason", reason)],
             )
         if self._push_stats is not None:
-            for mode, stats in sorted(self._push_stats().items()):
-                mode_label = [("mode", mode)]
-                builder.add(schema.SELF_PUSH_TOTAL,
-                            float(stats.get("pushes", 0)), mode_label)
-                builder.add(schema.SELF_PUSH_FAILURES,
-                            float(stats.get("failures", 0)), mode_label)
-                builder.add(schema.SELF_PUSH_DROPPED,
-                            float(stats.get("dropped", 0)), mode_label)
+            contribute_push_stats(builder, self._push_stats())
         builder.add(
             schema.SELF_INFO,
             1.0,

@@ -240,6 +240,22 @@ class SnapshotBuilder:
         )
 
 
+def contribute_push_stats(builder: SnapshotBuilder, stats) -> None:
+    """Fold push-sender shipping-health counters (mode ->
+    {pushes, failures, dropped}) into a snapshot as the collector_push_*
+    families. One definition shared by the poll loop and the hub so the
+    two expositions cannot drift."""
+    for mode in sorted(stats):
+        entry = stats[mode]
+        mode_label = (("mode", mode),)
+        builder.add(schema.SELF_PUSH_TOTAL,
+                    float(entry.get("pushes", 0)), mode_label)
+        builder.add(schema.SELF_PUSH_FAILURES,
+                    float(entry.get("failures", 0)), mode_label)
+        builder.add(schema.SELF_PUSH_DROPPED,
+                    float(entry.get("dropped", 0)), mode_label)
+
+
 class FilteredSnapshotBuilder(SnapshotBuilder):
     """SnapshotBuilder that drops families the operator disabled
     (``--metrics-include``/``--metrics-exclude``, schema.FILTERABLE_METRICS).
