@@ -356,7 +356,12 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="push each merged snapshot to a Prometheus "
                              "Pushgateway (slice-level egress for "
                              "unscrapeable clusters); empty disables")
-    parser.add_argument("--pushgateway-job", default="kube-tpu-stats-hub")
+    parser.add_argument("--pushgateway-job", default="kube-tpu-stats-hub",
+                        help="Pushgateway job; Pushgateway replaces a "
+                             "whole job/instance group per PUT, so give "
+                             "EACH hub its own job (e.g. the slice name) "
+                             "when several hubs share one gateway, or "
+                             "they silently overwrite each other")
     parser.add_argument("--pushgateway-instance", default="",
                         help="Pushgateway grouping-key instance; defaults "
                              "to the job name, NOT the hostname — a hub "
@@ -367,7 +372,16 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="ship each merged snapshot via Prometheus "
                              "remote_write (Mimir/Thanos/GMP receivers); "
                              "empty disables")
-    parser.add_argument("--remote-write-job", default="kube-tpu-stats-hub")
+    parser.add_argument("--remote-write-job", default="kube-tpu-stats-hub",
+                        help="job label stamped on every remote-written "
+                             "series; give each hub its own (e.g. the "
+                             "slice name) when several hubs share a "
+                             "receiver")
+    parser.add_argument("--remote-write-instance", default="",
+                        help="instance label for remote-written series; "
+                             "defaults to the job name, NOT the hostname "
+                             "(a Deployment pod name churns identity "
+                             "every reschedule)")
     parser.add_argument("--remote-write-interval", type=float, default=15.0)
     parser.add_argument("--remote-write-protocol",
                         choices=("1.0", "2.0"), default="1.0")
@@ -426,6 +440,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         senders.append(("remote_write", RemoteWriter(
             hub.registry, args.remote_write_url,
             job=args.remote_write_job,
+            instance=args.remote_write_instance or args.remote_write_job,
             min_interval=args.remote_write_interval,
             protocol=args.remote_write_protocol,
             bearer_token_file=args.remote_write_bearer_token_file,
