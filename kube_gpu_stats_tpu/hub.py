@@ -49,13 +49,19 @@ from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
 
-# Per-chip families the hub re-exports verbatim. Histogram families are
-# excluded: they render as _bucket/_sum/_count series that would need
-# state reconstruction, and the rollups carry the aggregate signal.
+# Per-chip families the hub re-exports verbatim. Histogram families go
+# through _merge_histograms instead: their _bucket/_sum/_count series
+# are summed across targets into one slice-level distribution.
 PER_CHIP_SPECS: dict[str, schema.MetricSpec] = {
     m.name: m
     for m in schema.PER_DEVICE_METRICS
     if m.type is not schema.MetricType.HISTOGRAM
+}
+
+# Workload histogram families the hub merges (schema-fixed buckets, so
+# summing per-bucket cumulative counts across targets is exact).
+HIST_SPECS: dict[str, schema.MetricSpec] = {
+    m.name: m for m in schema.WORKLOAD_HISTOGRAMS
 }
 
 DEFAULT_PORT = 9401
@@ -94,6 +100,12 @@ class Hub:
         self._push_stats = push_stats
         self.registry = registry if registry is not None else Registry()
         self._previous: Frame | None = None
+        # Last-known histogram contribution per target: a target that
+        # misses one refresh keeps contributing its last state, so the
+        # merged cumulative counters never dip on a transient fetch
+        # failure (Prometheus would read the dip as a counter reset and
+        # rate() a phantom spike on recovery).
+        self._hist_cache: dict[str, dict] = {}
         self._refresh_hist = HistogramState.empty(
             schema.HUB_REFRESH_DURATION, schema.HUB_REFRESH_BUCKETS)
         # Daemon-thread pool (workers.py), not ThreadPoolExecutor: a fetch
@@ -173,6 +185,8 @@ class Hub:
         self._add_rollups(builder, frame)
         self._merge_chip_series(builder, parsed, names,
                                 emit_series=not self._rollups_only)
+        if not self._rollups_only:
+            self._merge_histograms(builder, parsed, names)
         self._refresh_hist = self._refresh_hist.observe(
             time.monotonic() - start)
         builder.add_histogram(self._refresh_hist)
@@ -290,6 +304,89 @@ class Hub:
                 "hub: dropped %d duplicate per-chip series (two targets "
                 "export the same chip identity — check topology labels)",
                 duplicates)
+
+    def _merge_histograms(self, builder: SnapshotBuilder,
+                          parsed: Sequence[Sequence],
+                          names: Sequence[str]) -> None:
+        """Sum workload histograms (step-duration) across targets into one
+        slice-level distribution. Valid because cumulative bucket counts
+        with identical bounds add; a target whose bounds differ (older
+        schema) poisons only that family, which is skipped with a
+        warning — never merged wrong. Targets that missed this refresh
+        contribute their cached last state (monotonicity guard — see
+        _hist_cache)."""
+        suffixes = {}
+        for fam in HIST_SPECS:
+            suffixes[fam + "_bucket"] = (fam, "bucket")
+            suffixes[fam + "_sum"] = (fam, "sum")
+            suffixes[fam + "_count"] = (fam, "count")
+        for target, series in zip(names, parsed):
+            local: dict[tuple, dict] = {}
+            for name, labels, value in series:
+                hit = suffixes.get(name)
+                if hit is None:
+                    continue
+                fam, part = hit
+                key = (fam, tuple(sorted(
+                    (k, v) for k, v in labels.items() if k != "le")))
+                entry = local.setdefault(
+                    key, {"buckets": {}, "sum": 0.0, "count": 0.0})
+                if part == "bucket":
+                    try:
+                        entry["buckets"][float(labels.get("le", ""))] = value
+                    except ValueError:
+                        continue  # malformed le: drop the line, not the hub
+                elif part == "sum":
+                    entry["sum"] += value
+                else:
+                    entry["count"] += value
+            # An answered target replaces its cached contribution (its
+            # own counter reset is a legitimate reset downstream); a
+            # failed target keeps its previous entry.
+            self._hist_cache[target] = local
+        acc: dict[tuple, dict] = {}
+        mismatched: set[tuple] = set()
+        for target in self._targets:
+            local = self._hist_cache.get(target)
+            if not local:
+                continue
+            for key, entry in local.items():
+                bounds = tuple(sorted(entry["buckets"]))
+                merged = acc.get(key)
+                if merged is None:
+                    acc[key] = {"bounds": bounds,
+                                "buckets": dict(entry["buckets"]),
+                                "sum": entry["sum"],
+                                "count": entry["count"]}
+                elif merged["bounds"] != bounds:
+                    mismatched.add(key)
+                else:
+                    for le, count in entry["buckets"].items():
+                        merged["buckets"][le] += count
+                    merged["sum"] += entry["sum"]
+                    merged["count"] += entry["count"]
+        for key in sorted(acc, key=repr):
+            if key in mismatched:
+                log.warning(
+                    "hub: histogram %s has different bucket bounds across "
+                    "targets (mixed exporter versions?); not merged", key[0])
+                continue
+            fam, labels = key
+            merged = acc[key]
+            finite = [b for b in merged["bounds"]
+                      if not (b == float("inf"))]
+            counts = []
+            cumulative = 0.0
+            for bound in finite:
+                count = merged["buckets"][bound]
+                counts.append(max(0, int(count - cumulative)))
+                cumulative = count
+            total = int(merged["count"]) if merged["count"] else int(
+                merged["buckets"].get(float("inf"), cumulative))
+            counts.append(max(0, total - int(cumulative)))
+            builder.add_histogram(HistogramState(
+                HIST_SPECS[fam], tuple(finite), tuple(counts),
+                total, merged["sum"], labels))
 
     # -- loop ----------------------------------------------------------------
 

@@ -108,3 +108,18 @@ def test_daemonset_probe_scheme_follows_tls():
     assert "tcpSocket:" in text
     # mTLS branch must come first (clientCaFile implies certFile).
     assert text.index(".Values.tls.clientCaFile") < text.index("scheme: HTTPS")
+
+
+def test_hub_template_shape():
+    """The optional hub component must run the hub subcommand against the
+    mounted targets file, carry both probes, and be fully gated on
+    hub.enabled (disabled by default)."""
+    text = template_texts()["hub.yaml"]
+    assert text.startswith("{{- if .Values.hub.enabled }}")
+    assert '- "hub"' in text
+    assert '"--targets-file"' in text
+    assert "/healthz" in text and "/readyz" in text
+    assert "checksum/targets" in text  # pod rolls when targets change
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    assert values["hub"]["enabled"] is False
+    assert values["hub"]["targets"] == []

@@ -399,6 +399,99 @@ def test_hub_push_modes_ship_merged_snapshot(node_stack):
     assert 'collector_push_total{mode="pushgateway"} 1' in text
 
 
+def _step_hist_text(observations):
+    """Exposition text with one step-duration histogram, like an embedded
+    exporter renders it (through the real HistogramState/render path)."""
+    from kube_gpu_stats_tpu.registry import HistogramState, SnapshotBuilder
+
+    hist = HistogramState.empty(schema.WORKLOAD_STEP_DURATION,
+                                schema.STEP_DURATION_BUCKETS)
+    for value in observations:
+        hist = hist.observe(value)
+    builder = SnapshotBuilder()
+    builder.add_histogram(hist)
+    return builder.build().render()
+
+
+def test_hub_merges_step_histograms_across_targets(tmp_path):
+    (tmp_path / "a.prom").write_text(_step_hist_text([0.01, 0.01, 0.2]))
+    (tmp_path / "b.prom").write_text(_step_hist_text([0.01, 3.0]))
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    name = schema.WORKLOAD_STEP_DURATION.name
+    assert values(text, f"{name}_count") == [5.0]
+    assert values(text, f"{name}_sum") == [pytest.approx(0.01 * 3 + 0.2 + 3.0)]
+    buckets = {labels["le"]: value
+               for n, labels, value in parse_exposition(text)
+               if n == f"{name}_bucket"}
+    assert buckets["0.01"] == 3.0  # three 10 ms steps across both targets
+    assert buckets["+Inf"] == 5.0
+    assert validate.check(text) == []
+
+
+def test_hub_histogram_survives_target_outage_monotone(tmp_path):
+    # A transient fetch failure must not dip the merged cumulative
+    # counters (Prometheus would read a counter reset and rate() a
+    # phantom spike on recovery): the failed target's last contribution
+    # is carried until it answers again.
+    name = schema.WORKLOAD_STEP_DURATION.name
+    a, b = tmp_path / "a.prom", tmp_path / "b.prom"
+    a.write_text(_step_hist_text([0.01, 0.01]))
+    b.write_text(_step_hist_text([0.01, 0.2, 3.0]))
+    hub = hub_mod.Hub([str(a), str(b)])
+    try:
+        hub.refresh_once()
+        assert values(hub.registry.snapshot().render(),
+                      f"{name}_count") == [5.0]
+        b.rename(tmp_path / "b.gone")  # target b misses this refresh
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_target_up") == [1.0, 0.0]
+        assert values(text, f"{name}_count") == [5.0]  # no dip
+        (tmp_path / "b.gone").rename(b)
+        b.write_text(_step_hist_text([0.01, 0.2, 3.0, 3.0]))
+        hub.refresh_once()
+        assert values(hub.registry.snapshot().render(),
+                      f"{name}_count") == [6.0]
+    finally:
+        hub.stop()
+
+
+def test_hub_skips_histogram_with_mismatched_bounds(tmp_path):
+    (tmp_path / "a.prom").write_text(_step_hist_text([0.01]))
+    name = schema.WORKLOAD_STEP_DURATION.name
+    (tmp_path / "b.prom").write_text(
+        f'{name}_bucket{{le="0.5"}} 1\n'
+        f'{name}_bucket{{le="+Inf"}} 1\n'
+        f'{name}_sum 0.4\n'
+        f'{name}_count 1\n')
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    # Mixed exporter versions: never merged wrong, just absent.
+    assert values(text, f"{name}_count") == []
+    assert values(text, "slice_target_up") == [1.0, 1.0]
+
+
+def test_hub_rollups_only_drops_histograms(tmp_path):
+    (tmp_path / "a.prom").write_text(_step_hist_text([0.01]))
+    hub = hub_mod.Hub([str(tmp_path / "a.prom")], rollups_only=True)
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert not any(n.startswith("accelerator_")
+                   for n, _, _ in parse_exposition(text))
+
+
 def test_hub_once_pushes_to_gateway(node_stack, capsys):
     # `hub --once --pushgateway-url` from cron must actually push.
     import http.server
