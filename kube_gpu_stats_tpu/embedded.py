@@ -279,7 +279,9 @@ class EmbeddedExporter:
     JaxIntrospectCollector, owned by the workload process."""
 
     def __init__(self, *, port: int = 0, host: str = "127.0.0.1",
-                 textfile: str | None = None, interval: float = 1.0) -> None:
+                 textfile: str | None = None, interval: float = 1.0,
+                 metrics_include: Sequence[str] = (),
+                 metrics_exclude: Sequence[str] = ()) -> None:
         self.registry = Registry()
         self.render_stats = RenderStats()
         self.collector = JaxIntrospectCollector()
@@ -287,6 +289,10 @@ class EmbeddedExporter:
             self.collector,
             self.registry,
             interval=interval,
+            # Same family selection as the daemon's --metrics-include/
+            # --metrics-exclude (validated: a typo raises at start()).
+            disabled_metrics=schema.resolve_metric_filter(
+                metrics_include, metrics_exclude),
             # live_arrays scans scale with workload allocation count; the
             # DaemonSet's 50 ms budget gates an external scrape path, not
             # in-process introspection — keep headroom.
@@ -341,7 +347,11 @@ class EmbeddedExporter:
 
 def start(port: int = 0, *, host: str = "127.0.0.1",
           textfile: str | None = None,
-          interval: float = 1.0) -> EmbeddedExporter:
+          interval: float = 1.0,
+          metrics_include: Sequence[str] = (),
+          metrics_exclude: Sequence[str] = ()) -> EmbeddedExporter:
     """Start an embedded exporter inside this (workload) process."""
     return EmbeddedExporter(port=port, host=host, textfile=textfile,
-                            interval=interval).start()
+                            interval=interval,
+                            metrics_include=metrics_include,
+                            metrics_exclude=metrics_exclude).start()

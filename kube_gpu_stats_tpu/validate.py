@@ -33,6 +33,17 @@ _RANGES = {
     schema.TEMPERATURE.name: (-50.0, 150.0),
 }
 
+# Hub rollup families (slice_*) checked when validating a hub scrape:
+# range sanity only — the label contract for slice_* is the spec's
+# extra_labels, not the per-device base set.
+_HUB_RANGES = {
+    schema.HUB_TARGET_UP.name: (0.0, 1.0),
+    schema.HUB_DUTY_MEAN.name: (0.0, 100.0),
+    schema.HUB_DUTY_MIN.name: (0.0, 100.0),
+    schema.HUB_DUTY_MAX.name: (0.0, 100.0),
+    schema.HUB_STRAGGLER_RATIO.name: (0.0, 1.0),
+}
+
 
 def parse_exposition(text: str) -> list[tuple[str, dict[str, str], float]]:
     """(name, labels, value) triples; raises ValueError on malformed lines."""
@@ -74,6 +85,17 @@ def check(text: str, previous: str | None = None) -> list[str]:
             hist_suffix[f"{m.name}_count"] = (m, False)
     required = set(schema.ALL_BASE_LABELS)
     seen_identities: set[tuple] = set()
+
+    def common_checks(name, labels, value, ranges) -> None:
+        """Range + duplicate-identity checks shared by every branch."""
+        lo_hi = ranges.get(name)
+        if lo_hi and not (lo_hi[0] <= value <= lo_hi[1]):
+            problems.append(f"{name}{labels}: value {value} outside {lo_hi}")
+        identity = (name, tuple(sorted(labels.items())))
+        if identity in seen_identities:
+            problems.append(f"{name}: duplicate series {labels}")
+        seen_identities.add(identity)
+
     for name, labels, value in series:
         if name.startswith("accelerator_"):
             hist = hist_suffix.get(name)
@@ -84,10 +106,7 @@ def check(text: str, previous: str | None = None) -> list[str]:
                 if unexpected:
                     problems.append(
                         f"{name}: unexpected labels {sorted(unexpected)}")
-                identity = (name, tuple(sorted(labels.items())))
-                if identity in seen_identities:
-                    problems.append(f"{name}: duplicate series {labels}")
-                seen_identities.add(identity)
+                common_checks(name, labels, value, {})
                 continue
             spec = specs.get(name)
             if spec is None or spec.type is schema.MetricType.HISTOGRAM:
@@ -106,15 +125,22 @@ def check(text: str, previous: str | None = None) -> list[str]:
                     f"{name}: unexpected labels "
                     f"{sorted(extra_present - extra_expected)}"
                 )
-            lo_hi = _RANGES.get(name)
-            if lo_hi and not (lo_hi[0] <= value <= lo_hi[1]):
-                problems.append(f"{name}{labels}: value {value} outside {lo_hi}")
             if spec.type is schema.MetricType.COUNTER and value < 0:
                 problems.append(f"{name}{labels}: negative counter")
-            identity = (name, tuple(sorted(labels.items())))
-            if identity in seen_identities:
-                problems.append(f"{name}: duplicate series {labels}")
-            seen_identities.add(identity)
+            common_checks(name, labels, value, _RANGES)
+        elif name.startswith("slice_"):
+            # Hub rollups: range sanity + labels from the spec's
+            # extra_labels (no per-device base set on aggregates).
+            spec = specs.get(name)
+            if spec is None:
+                problems.append(
+                    f"{name}: not in the slice_* rollup contract")
+                continue
+            unexpected = set(labels) - set(spec.extra_labels)
+            if unexpected:
+                problems.append(
+                    f"{name}: unexpected labels {sorted(unexpected)}")
+            common_checks(name, labels, value, _HUB_RANGES)
 
     if previous is not None:
         problems.extend(_check_monotone(previous, text, specs))

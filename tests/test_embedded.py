@@ -217,3 +217,21 @@ def test_colaunch_skipped_without_accelerator_platform(monkeypatch):
     assert probe["jax_platform"] == "cpu"
     assert probe["burn_colaunch"]["spawned"] is False
     assert "no accelerator platform" in str(probe["burn_colaunch"]["skipped"])
+
+
+def test_embedded_exporter_metric_filter():
+    import urllib.request
+
+    exporter = EmbeddedExporter(metrics_exclude=("accelerator_uptime_seconds",))
+    exporter.start()
+    try:
+        assert exporter.registry.wait_for_publish(0, timeout=5)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics", timeout=5
+        ).read().decode()
+    finally:
+        exporter.stop()
+    assert "accelerator_memory_used_bytes" in body
+    assert "accelerator_uptime_seconds" not in body
+    with pytest.raises(ValueError, match="unknown metric family"):
+        EmbeddedExporter(metrics_exclude=("not_a_family",))

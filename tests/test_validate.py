@@ -118,3 +118,32 @@ def test_histogram_buckets_checked_for_monotonicity():
     problems = validate.check(after, previous=before)
     assert any("went backwards" in p for p in problems), problems
     assert validate.check(before, previous=before) == []
+
+
+def test_slice_rollups_checked_for_ranges_and_labels():
+    from kube_gpu_stats_tpu.validate import check
+
+    ok = ('slice_target_up{target="http://a:9400/metrics"} 1\n'
+          'slice_duty_cycle_mean{slice="s"} 55.5\n'
+          'slice_straggler_ratio{slice="s"} 0.9\n')
+    assert check(ok) == []
+    bad = ('slice_duty_cycle_mean{slice="s"} 250\n'
+           'slice_straggler_ratio{slice="s"} 1.5\n'
+           'slice_chips{slice="s",bogus="x"} 4\n'
+           'slice_chips{slice="t"} 4\n'
+           'slice_chips{slice="t"} 5\n')
+    problems = check(bad)
+    assert any("outside" in p and "slice_duty_cycle_mean" in p
+               for p in problems)
+    assert any("outside" in p and "slice_straggler_ratio" in p
+               for p in problems)
+    assert any("unexpected labels" in p and "bogus" in str(p)
+               for p in problems)
+    assert any("duplicate series" in p for p in problems)
+
+
+def test_unknown_slice_family_flagged():
+    from kube_gpu_stats_tpu.validate import check
+
+    problems = check('slice_duty_cycle_avg{slice="s"} 50\n')
+    assert problems and "not in the slice_* rollup contract" in problems[0]
