@@ -88,9 +88,16 @@ class ChipRow:
 class Frame:
     """One fetch round over every target."""
 
-    def __init__(self, rows: dict[tuple, ChipRow], errors: list[str]) -> None:
+    def __init__(self, rows: dict[tuple, ChipRow], errors: list[str],
+                 rollups: dict[tuple, float] | None = None) -> None:
         self.rows = rows
         self.errors = errors
+        # Hub slice_* rollups seen in the scraped text, keyed by
+        # (target, family, ((label, value), ...)) — present when a target
+        # is a kube-tpu-stats hub; render_table folds them into a footer.
+        # Target-keyed so two hubs' unlabeled families (expected-worker
+        # count, duplicate count) never overwrite each other.
+        self.rollups = rollups or {}
 
     def rates(self, previous: "Frame | None") -> None:
         if previous is None:
@@ -122,6 +129,7 @@ def build_frame(texts: Sequence[object], errors: list[str],
     ``ats[i]`` is target i's fetch timestamp (defaults to now);
     ``targets[i]`` its stable identity in row keys (defaults to i)."""
     rows: dict[tuple, ChipRow] = {}
+    rollups: dict[tuple, float] = {}
     now = time.monotonic()
 
     by_id = {name: col for col, name in _GAUGES.items()}
@@ -152,6 +160,9 @@ def build_frame(texts: Sequence[object], errors: list[str],
         else:
             series = text
         for name, labels, value in series:
+            if name.startswith("slice_"):
+                rollups[(tkey, name, tuple(sorted(labels.items())))] = value
+                continue
             if not name.startswith("accelerator_"):
                 continue
             col = by_id.get(name)
@@ -169,7 +180,7 @@ def build_frame(texts: Sequence[object], errors: list[str],
             elif name == schema.PROCESS_OPEN.name:
                 if labels.get("comm") != "_overflow":
                     row(labels).holders += 1
-    return Frame(rows, errors)
+    return Frame(rows, errors, rollups)
 
 
 # -- rendering ---------------------------------------------------------------
@@ -217,9 +228,65 @@ def render_table(frame: Frame) -> str:
             f"{mem_pct:>5} {_fmt(r.power):>6} {_fmt(r.temp, '{:.0f}'):>5} "
             f"{_fmt_bytes(r.ici_bps if r.ici_bps else None):>9} "
             f"{_fmt(r.steps_per_s):>7} {r.holders or '-':>4}  {pod}")
+    lines.extend(_rollup_footer(frame))
     for err in frame.errors:
         lines.append(f"! {err}")
     return "\n".join(lines)
+
+
+def _rollup_footer(frame: Frame) -> list[str]:
+    """One line per hub slice (slice_* rollups): worker/target health and
+    the straggler ratio at a glance. Grouped per hub target so two hubs
+    never mix their numbers, and a hub whose targets are ALL down still
+    gets a line — that outage is exactly what the footer must surface."""
+    if not frame.rollups:
+        return []
+    hubs: dict[object, dict] = {}
+    for (tkey, name, labels), value in frame.rollups.items():
+        hub = hubs.setdefault(tkey, {"expected": None, "down": 0,
+                                     "duplicates": 0.0, "slices": {}})
+        label_map = dict(labels)
+        if name == "slice_workers_expected":
+            hub["expected"] = value
+        elif name == "slice_target_up":
+            hub["down"] += value == 0.0
+        elif name == "slice_duplicate_series":
+            hub["duplicates"] += value
+        elif "slice" in label_map and "worker" not in label_map:
+            hub["slices"].setdefault(label_map["slice"], {})[name] = value
+
+    def hub_parts(hub, vals):
+        parts = []
+        workers = vals.get("slice_workers")
+        expected = hub["expected"]
+        if workers is not None or expected:
+            shown = f"{workers:.0f}" if workers is not None else "0"
+            want = f"/{expected:.0f}" if expected else ""
+            parts.append(f"workers {shown}{want}")
+        if hub["down"]:
+            parts.append(f"targets down {hub['down']:.0f}")
+        ratio = vals.get("slice_straggler_ratio")
+        if ratio is not None:
+            parts.append(f"straggler ratio {ratio:.2f}")
+        if hub["duplicates"]:
+            parts.append(f"DUPLICATE CHIP IDS {hub['duplicates']:.0f}")
+        return parts
+
+    lines = []
+    for tkey in sorted(hubs, key=str):
+        hub = hubs[tkey]
+        if hub["slices"]:
+            for slice_name in sorted(hub["slices"]):
+                parts = hub_parts(hub, hub["slices"][slice_name])
+                if parts:
+                    lines.append(
+                        f"hub[{slice_name or '-'}]:  " + "  ".join(parts))
+        else:
+            # No observed chips at all — the full-outage state.
+            parts = hub_parts(hub, {})
+            if parts:
+                lines.append("hub[-]:  " + "  ".join(parts))
+    return lines
 
 
 def _numeric(s: str):

@@ -140,6 +140,12 @@ def check(text: str, previous: str | None = None) -> list[str]:
             if unexpected:
                 problems.append(
                     f"{name}: unexpected labels {sorted(unexpected)}")
+            missing = set(spec.extra_labels) - set(labels)
+            if missing:
+                # The hub always emits its labels; an unlabeled rollup
+                # breaks every `by (slice)` join and the shipped alerts.
+                problems.append(
+                    f"{name}: missing labels {sorted(missing)}")
             common_checks(name, labels, value, _HUB_RANGES)
 
     if previous is not None:

@@ -245,3 +245,60 @@ def test_top_reads_schema_families_it_claims():
     known = {m.name for m in schema.ALL_METRICS}
     for name in list(top._GAUGES.values()) + list(top._COUNTERS.values()):
         assert name in known
+
+
+def test_hub_rollup_footer_in_table():
+    # Pointing top at a kube-tpu-stats hub: slice_* rollups fold into a
+    # footer line (workers, down targets, straggler ratio).
+    text = (
+        'accelerator_up{chip="0",worker="0",slice="v5p-16"} 1\n'
+        'slice_workers{slice="v5p-16"} 3\n'
+        'slice_workers_expected 4\n'
+        'slice_target_up{target="http://a:9400/metrics"} 1\n'
+        'slice_target_up{target="http://b:9400/metrics"} 0\n'
+        'slice_straggler_ratio{slice="v5p-16"} 0.75\n'
+        'slice_duplicate_series 0\n'
+    )
+    frame = top.build_frame([text], [], ats=[0.0])
+    out = top.render_table(frame)
+    assert "hub[v5p-16]:" in out
+    assert "workers 3/4" in out
+    assert "targets down 1" in out
+    assert "straggler ratio 0.75" in out
+    assert "DUPLICATE" not in out  # zero duplicates stays quiet
+
+
+def test_no_rollup_footer_for_plain_exporters():
+    frame = top.build_frame([rendered()], [], ats=[0.0])
+    assert "hub[" not in top.render_table(frame)
+
+
+def test_hub_footer_survives_full_outage():
+    # A hub with every target down exports no slice-labeled rollups, but
+    # the footer must still surface the outage.
+    text = (
+        'slice_workers_expected 4\n'
+        'slice_target_up{target="http://a:9400/metrics"} 0\n'
+        'slice_target_up{target="http://b:9400/metrics"} 0\n'
+    )
+    out = top.render_table(top.build_frame([text], [], ats=[0.0]))
+    assert "workers 0/4" in out
+    assert "targets down 2" in out
+
+
+def test_hub_footer_two_hubs_do_not_mix():
+    hub_a = (
+        'slice_workers{slice="a"} 2\n'
+        'slice_workers_expected 2\n'
+        'slice_duplicate_series 3\n'
+    )
+    hub_b = (
+        'slice_workers{slice="b"} 8\n'
+        'slice_workers_expected 8\n'
+        'slice_duplicate_series 0\n'
+    )
+    out = top.render_table(top.build_frame([hub_a, hub_b], [],
+                                           ats=[0.0, 0.0]))
+    assert "hub[a]:  workers 2/2  DUPLICATE CHIP IDS 3" in out
+    assert "hub[b]:  workers 8/8" in out
+    assert "hub[b]:  workers 8/8  DUPLICATE" not in out

@@ -147,3 +147,10 @@ def test_unknown_slice_family_flagged():
 
     problems = check('slice_duty_cycle_avg{slice="s"} 50\n')
     assert problems and "not in the slice_* rollup contract" in problems[0]
+
+
+def test_slice_rollup_missing_labels_flagged():
+    from kube_gpu_stats_tpu.validate import check
+
+    problems = check('slice_chips 4\n')
+    assert problems and "missing labels" in problems[0]
