@@ -80,7 +80,10 @@ class Hub:
                  expect_workers: int = 0, rollups_only: bool = False,
                  fetch_timeout: float = 5.0,
                  registry: Registry | None = None,
-                 render_stats=None, push_stats=None) -> None:
+                 render_stats=None, push_stats=None,
+                 headers_provider=None,
+                 target_ca_file: str = "",
+                 target_insecure_tls: bool = False) -> None:
         if not targets:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -98,6 +101,12 @@ class Hub:
         # Shipping-health counters from attached push senders (same shape
         # as daemon._push_stats: mode -> {pushes, failures, dropped}).
         self._push_stats = push_stats
+        # Credentials for hardened exporters: called once per refresh
+        # (file-backed tokens rotate without a restart) and sent to every
+        # target. TLS options pass through to fetch_exposition.
+        self._headers_provider = headers_provider
+        self._target_ca_file = target_ca_file
+        self._target_insecure_tls = target_insecure_tls
         self.registry = registry if registry is not None else Registry()
         self._previous: Frame | None = None
         # Last-known histogram contribution per target: a target that
@@ -131,9 +140,15 @@ class Hub:
         names: list[str] = []
         reachable: dict[str, bool] = {}
 
+        headers = (self._headers_provider()
+                   if self._headers_provider is not None else None)
+
         def fetch(target: str):
             series = parse_exposition(
-                fetch_exposition(target, timeout=self._fetch_timeout))
+                fetch_exposition(target, timeout=self._fetch_timeout,
+                                 headers=headers,
+                                 ca_file=self._target_ca_file,
+                                 insecure_tls=self._target_insecure_tls))
             return series, time.monotonic()
 
         # Submit all before collecting any: one slow target must not
@@ -449,6 +464,24 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--tls-key-file", default="")
     parser.add_argument("--auth-username", default="")
     parser.add_argument("--auth-password-sha256", default="")
+    parser.add_argument("--target-auth-username", default="",
+                        help="basic-auth username sent to every target "
+                             "(exporters started with --auth-username)")
+    parser.add_argument("--target-auth-password-file", default="",
+                        help="file holding the basic-auth password "
+                             "(re-read each refresh; rotations apply "
+                             "without a restart)")
+    parser.add_argument("--target-bearer-token-file", default="",
+                        help="file holding a bearer token sent to every "
+                             "target (re-read each refresh)")
+    parser.add_argument("--target-ca-file", default="",
+                        help="CA bundle verifying the targets' TLS certs "
+                             "(exporters started with --tls-cert-file "
+                             "signed by a private CA)")
+    parser.add_argument("--target-insecure-tls", action="store_true",
+                        help="skip TLS verification of targets "
+                             "(self-signed dev certs; prefer "
+                             "--target-ca-file)")
     parser.add_argument("--pushgateway-url", default="",
                         help="push each merged snapshot to a Prometheus "
                              "Pushgateway (slice-level egress for "
@@ -499,6 +532,26 @@ def main(argv: Sequence[str] | None = None) -> int:
     if not targets:
         parser.error("no targets (positional or --targets-file)")
 
+    if bool(args.target_auth_username) != bool(
+            args.target_auth_password_file):
+        parser.error("--target-auth-username and "
+                     "--target-auth-password-file must be set together")
+    if args.target_bearer_token_file and args.target_auth_username:
+        # Silently preferring one mode would send the wrong credential
+        # to every target (and 401 them all) with no hint why.
+        parser.error("--target-bearer-token-file and --target-auth-* are "
+                     "mutually exclusive — targets take one credential")
+
+    headers_provider = None
+    if args.target_auth_username or args.target_bearer_token_file:
+        from .validate import auth_headers
+
+        def headers_provider() -> dict:
+            return auth_headers(
+                bearer_token_file=args.target_bearer_token_file,
+                username=args.target_auth_username,
+                password_file=args.target_auth_password_file)
+
     render_stats = RenderStats()
     senders: list = []
 
@@ -518,7 +571,10 @@ def main(argv: Sequence[str] | None = None) -> int:
               fetch_timeout=args.fetch_timeout,
               render_stats=render_stats,
               push_stats=push_stats if (args.pushgateway_url
-                                        or args.remote_write_url) else None)
+                                        or args.remote_write_url) else None,
+              headers_provider=headers_provider,
+              target_ca_file=args.target_ca_file,
+              target_insecure_tls=args.target_insecure_tls)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.

@@ -255,37 +255,60 @@ def _rollup_footer(frame: Frame) -> list[str]:
         elif "slice" in label_map and "worker" not in label_map:
             hub["slices"].setdefault(label_map["slice"], {})[name] = value
 
-    def hub_parts(hub, vals):
+    def hub_level_parts(hub, workers=None):
+        # Hub-config/health facts printed once per hub: expected workers
+        # is a property of the hub config, not of one slice (schema.py),
+        # so pairing it against a single slice's count only makes sense
+        # when the hub serves exactly one slice.
         parts = []
-        workers = vals.get("slice_workers")
-        expected = hub["expected"]
-        if workers is not None or expected:
+        if workers is not None or hub["expected"]:
             shown = f"{workers:.0f}" if workers is not None else "0"
-            want = f"/{expected:.0f}" if expected else ""
+            want = f"/{hub['expected']:.0f}" if hub["expected"] else ""
             parts.append(f"workers {shown}{want}")
         if hub["down"]:
             parts.append(f"targets down {hub['down']:.0f}")
+        if hub["duplicates"]:
+            parts.append(f"DUPLICATE CHIP IDS {hub['duplicates']:.0f}")
+        return parts
+
+    def slice_parts(vals):
+        parts = []
+        workers = vals.get("slice_workers")
+        if workers is not None:
+            parts.append(f"workers {workers:.0f}")
         ratio = vals.get("slice_straggler_ratio")
         if ratio is not None:
             parts.append(f"straggler ratio {ratio:.2f}")
-        if hub["duplicates"]:
-            parts.append(f"DUPLICATE CHIP IDS {hub['duplicates']:.0f}")
         return parts
 
     lines = []
     for tkey in sorted(hubs, key=str):
         hub = hubs[tkey]
-        if hub["slices"]:
-            for slice_name in sorted(hub["slices"]):
-                parts = hub_parts(hub, hub["slices"][slice_name])
-                if parts:
-                    lines.append(
-                        f"hub[{slice_name or '-'}]:  " + "  ".join(parts))
-        else:
-            # No observed chips at all — the full-outage state.
-            parts = hub_parts(hub, {})
+        slices = hub["slices"]
+        if len(slices) == 1:
+            # Single-slice hub (the common case): one combined line.
+            (slice_name, vals), = slices.items()
+            parts = hub_level_parts(hub, vals.get("slice_workers"))
+            ratio = vals.get("slice_straggler_ratio")
+            if ratio is not None:
+                parts.insert(min(1, len(parts)),
+                             f"straggler ratio {ratio:.2f}")
             if parts:
-                lines.append("hub[-]:  " + "  ".join(parts))
+                lines.append(
+                    f"hub[{slice_name or '-'}]:  " + "  ".join(parts))
+            continue
+        for slice_name in sorted(slices):
+            parts = slice_parts(slices[slice_name])
+            if parts:
+                lines.append(
+                    f"hub[{slice_name or '-'}]:  " + "  ".join(parts))
+        # Hub-level summary (or the full-outage state with no slices):
+        # total workers across the hub's slices vs the hub's expectation.
+        total = (sum(v.get("slice_workers", 0) for v in slices.values())
+                 if slices else None)
+        parts = hub_level_parts(hub, total)
+        if parts:
+            lines.append("hub:  " + "  ".join(parts))
     return lines
 
 

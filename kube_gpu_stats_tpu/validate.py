@@ -184,11 +184,66 @@ def _check_monotone(before: str, after: str, specs) -> Iterable[str]:
     return problems
 
 
-def fetch_exposition(target: str, timeout: float = 10.0) -> str:
+class _NoRedirectHandler(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None
+
+
+def auth_headers(bearer_token_file: str = "", username: str = "",
+                 password_file: str = "") -> dict:
+    """Authorization header from file-backed credentials, re-read per
+    call so rotations apply without a restart. Unreadable files log and
+    return {} — the scrape proceeds unauthenticated and the hardened
+    target's 401 is a visible per-target failure, never a crash."""
+    import base64
+    import logging
+
+    try:
+        if bearer_token_file:
+            with open(bearer_token_file, encoding="utf-8") as handle:
+                return {"Authorization": "Bearer " + handle.read().strip()}
+        if username:
+            with open(password_file, encoding="utf-8") as handle:
+                password = handle.read().strip()
+            token = base64.b64encode(
+                f"{username}:{password}".encode()).decode()
+            return {"Authorization": "Basic " + token}
+    except OSError as exc:
+        logging.getLogger(__name__).warning(
+            "credential file unreadable: %s", exc)
+    return {}
+
+
+def fetch_exposition(target: str, timeout: float = 10.0,
+                     headers: dict | None = None,
+                     ca_file: str = "",
+                     insecure_tls: bool = False) -> str:
     """Read a scrape target: http(s) URL or a saved .prom file path.
-    Shared by this validator and the `top` view."""
+    Shared by this validator, the `top` view, and the hub. ``headers``
+    ride the request (Authorization for hardened exporters — redirects
+    are refused for authed requests so the credential can never be
+    forwarded to a cross-origin Location); ``ca_file`` verifies a
+    private CA; ``insecure_tls`` skips verification (dev slices with
+    self-signed certs — the scraped data is telemetry, but prefer
+    ca_file)."""
     if target.startswith(("http://", "https://")):
-        with urllib.request.urlopen(target, timeout=timeout) as resp:
+        import ssl
+
+        handlers = []
+        if target.startswith("https://"):
+            if insecure_tls:
+                context = ssl.create_default_context()
+                context.check_hostname = False
+                context.verify_mode = ssl.CERT_NONE
+                handlers.append(urllib.request.HTTPSHandler(context=context))
+            elif ca_file:
+                handlers.append(urllib.request.HTTPSHandler(
+                    context=ssl.create_default_context(cafile=ca_file)))
+        if headers and "Authorization" in headers:
+            handlers.append(_NoRedirectHandler())
+        request = urllib.request.Request(target, headers=headers or {})
+        opener = urllib.request.build_opener(*handlers)
+        with opener.open(request, timeout=timeout) as resp:
             return resp.read().decode()
     with open(target) as f:
         return f.read()

@@ -302,3 +302,18 @@ def test_hub_footer_two_hubs_do_not_mix():
     assert "hub[a]:  workers 2/2  DUPLICATE CHIP IDS 3" in out
     assert "hub[b]:  workers 8/8" in out
     assert "hub[b]:  workers 8/8  DUPLICATE" not in out
+
+
+def test_hub_footer_multi_slice_expected_not_paired_per_slice():
+    # slice_workers_expected is hub config, not a per-slice fact: a hub
+    # serving two slices must not claim each slice is short of the total.
+    text = (
+        'slice_workers{slice="a"} 2\n'
+        'slice_workers{slice="b"} 6\n'
+        'slice_workers_expected 8\n'
+    )
+    out = top.render_table(top.build_frame([text], [], ats=[0.0]))
+    assert "hub[a]:  workers 2\n" in out + "\n"
+    assert "hub[b]:  workers 6\n" in out + "\n"
+    assert "hub:  workers 8/8" in out
+    assert "2/8" not in out and "6/8" not in out

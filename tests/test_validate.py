@@ -154,3 +154,52 @@ def test_slice_rollup_missing_labels_flagged():
 
     problems = check('slice_chips 4\n')
     assert problems and "missing labels" in problems[0]
+
+
+def test_authed_fetch_refuses_redirects():
+    import http.server
+    import threading
+    import urllib.error
+
+    import pytest
+
+    from kube_gpu_stats_tpu.validate import fetch_exposition
+
+    class Redirector(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(302)
+            self.send_header("Location", "http://127.0.0.1:1/steal")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Redirector)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+    try:
+        # With a credential the redirect is refused (the Authorization
+        # header must never chase a cross-origin Location).
+        with pytest.raises(urllib.error.HTTPError):
+            fetch_exposition(url, timeout=5,
+                             headers={"Authorization": "Bearer secret"})
+    finally:
+        server.shutdown()
+
+
+def test_auth_headers_helper(tmp_path):
+    from kube_gpu_stats_tpu.validate import auth_headers
+
+    token = tmp_path / "token"
+    token.write_text("tok123\n")
+    assert auth_headers(bearer_token_file=str(token)) == {
+        "Authorization": "Bearer tok123"}
+    pw = tmp_path / "pw"
+    pw.write_text("hubpass\n")
+    header = auth_headers(username="scraper", password_file=str(pw))
+    import base64
+    assert header["Authorization"] == "Basic " + base64.b64encode(
+        b"scraper:hubpass").decode()
+    # Unreadable file: {} and a warning, never a crash.
+    assert auth_headers(bearer_token_file=str(tmp_path / "absent")) == {}
