@@ -541,6 +541,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         # to every target (and 401 them all) with no hint why.
         parser.error("--target-bearer-token-file and --target-auth-* are "
                      "mutually exclusive — targets take one credential")
+    if args.target_ca_file and args.target_insecure_tls:
+        # insecure would silently win and disable the verification the
+        # command line says is configured.
+        parser.error("--target-ca-file and --target-insecure-tls are "
+                     "mutually exclusive")
 
     headers_provider = None
     if args.target_auth_username or args.target_bearer_token_file:

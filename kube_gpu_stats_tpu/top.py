@@ -282,9 +282,13 @@ def _rollup_footer(frame: Frame) -> list[str]:
         return parts
 
     lines = []
+    # With several hubs in one view every line names its hub, or two
+    # hubs' identical-looking lines would be indistinguishable.
+    many_hubs = len(hubs) > 1
     for tkey in sorted(hubs, key=str):
         hub = hubs[tkey]
         slices = hub["slices"]
+        suffix = f"  ({tkey})" if many_hubs else ""
         if len(slices) == 1:
             # Single-slice hub (the common case): one combined line.
             (slice_name, vals), = slices.items()
@@ -294,21 +298,21 @@ def _rollup_footer(frame: Frame) -> list[str]:
                 parts.insert(min(1, len(parts)),
                              f"straggler ratio {ratio:.2f}")
             if parts:
-                lines.append(
-                    f"hub[{slice_name or '-'}]:  " + "  ".join(parts))
+                lines.append(f"hub[{slice_name or '-'}]:  "
+                             + "  ".join(parts) + suffix)
             continue
         for slice_name in sorted(slices):
             parts = slice_parts(slices[slice_name])
             if parts:
-                lines.append(
-                    f"hub[{slice_name or '-'}]:  " + "  ".join(parts))
+                lines.append(f"hub[{slice_name or '-'}]:  "
+                             + "  ".join(parts) + suffix)
         # Hub-level summary (or the full-outage state with no slices):
         # total workers across the hub's slices vs the hub's expectation.
         total = (sum(v.get("slice_workers", 0) for v in slices.values())
                  if slices else None)
         parts = hub_level_parts(hub, total)
         if parts:
-            lines.append("hub:  " + "  ".join(parts))
+            lines.append("hub:  " + "  ".join(parts) + suffix)
     return lines
 
 
@@ -327,7 +331,16 @@ def render_json(frame: Frame) -> str:
         d["target"], d["slice"], d["worker"], d["chip"] = key
         del d["key"], d["at"]
         rows.append(d)
-    return json.dumps({"chips": rows, "errors": frame.errors})
+    out = {"chips": rows, "errors": frame.errors}
+    if frame.rollups:
+        out["rollups"] = [
+            {"target": str(tkey), "family": name, "labels": dict(labels),
+             "value": value}
+            for (tkey, name, labels), value in sorted(
+                frame.rollups.items(), key=lambda kv: (str(kv[0][0]),
+                                                       kv[0][1], kv[0][2]))
+        ]
+    return json.dumps(out)
 
 
 # -- CLI ---------------------------------------------------------------------

@@ -11,6 +11,7 @@ third-party exporters converging on the unified schema.
 
 from __future__ import annotations
 
+import functools
 import re
 import sys
 import urllib.request
@@ -208,7 +209,9 @@ def auth_headers(bearer_token_file: str = "", username: str = "",
             token = base64.b64encode(
                 f"{username}:{password}".encode()).decode()
             return {"Authorization": "Basic " + token}
-    except OSError as exc:
+    except (OSError, UnicodeDecodeError, ValueError) as exc:
+        # ValueError/UnicodeDecodeError: a rotation mid-write can leave
+        # truncated or non-UTF-8 bytes — same contract as a missing file.
         logging.getLogger(__name__).warning(
             "credential file unreadable: %s", exc)
     return {}
@@ -227,18 +230,10 @@ def fetch_exposition(target: str, timeout: float = 10.0,
     self-signed certs — the scraped data is telemetry, but prefer
     ca_file)."""
     if target.startswith(("http://", "https://")):
-        import ssl
-
         handlers = []
-        if target.startswith("https://"):
-            if insecure_tls:
-                context = ssl.create_default_context()
-                context.check_hostname = False
-                context.verify_mode = ssl.CERT_NONE
-                handlers.append(urllib.request.HTTPSHandler(context=context))
-            elif ca_file:
-                handlers.append(urllib.request.HTTPSHandler(
-                    context=ssl.create_default_context(cafile=ca_file)))
+        if target.startswith("https://") and (insecure_tls or ca_file):
+            handlers.append(urllib.request.HTTPSHandler(
+                context=_tls_context(ca_file, insecure_tls)))
         if headers and "Authorization" in headers:
             handlers.append(_NoRedirectHandler())
         request = urllib.request.Request(target, headers=headers or {})
@@ -247,6 +242,22 @@ def fetch_exposition(target: str, timeout: float = 10.0,
             return resp.read().decode()
     with open(target) as f:
         return f.read()
+
+
+@functools.lru_cache(maxsize=8)
+def _tls_context(ca_file: str, insecure_tls: bool):
+    """Client TLS context, cached per (ca_file, insecure) — parsing the
+    CA bundle per fetch would put file IO + X.509 parsing on the hub's
+    per-target refresh path. Cached for the process lifetime: CA bundle
+    rotation needs a restart (unlike the per-refresh credential files)."""
+    import ssl
+
+    if insecure_tls:
+        context = ssl.create_default_context()
+        context.check_hostname = False
+        context.verify_mode = ssl.CERT_NONE
+        return context
+    return ssl.create_default_context(cafile=ca_file)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -715,3 +715,50 @@ def test_hub_targets_file(node_stack, tmp_path, capsys):
     listing.write_text(f"# slice workers\n{node_stack('0')}\n")
     assert hub_mod.main(["--targets-file", str(listing), "--once"]) == 0
     assert "slice_chips" in capsys.readouterr().out
+
+
+def test_hub_soak_flapping_targets(node_stack, tmp_path):
+    """Short soak: many refreshes while one target flaps. Counters must
+    stay monotone across the whole run (validate's two-scrape check), no
+    thread growth, rollups always present."""
+    import threading
+
+    from kube_gpu_stats_tpu.validate import check
+
+    stable = node_stack("0")
+    flappy = tmp_path / "flappy.prom"
+    flappy.write_text(_step_hist_text([0.01, 0.02]))
+
+    hub = hub_mod.Hub([stable, str(flappy)], fetch_timeout=1.0)
+    try:
+        before_threads = threading.active_count()
+        previous_text = None
+        observations = [0.01, 0.02]
+        for i in range(25):
+            if i % 3 == 2:
+                # Flap: the file target vanishes for one refresh.
+                if flappy.exists():
+                    flappy.rename(tmp_path / "gone")
+            else:
+                if not flappy.exists():
+                    (tmp_path / "gone").rename(flappy)
+                    observations.append(0.05)  # its counters advanced
+                    flappy.write_text(_step_hist_text(observations))
+            hub.refresh_once()
+            text = hub.registry.snapshot().render()
+            problems = check(text, previous=previous_text)
+            assert problems == [], f"refresh {i}: {problems}"
+            assert values(text, "slice_chips") == [2.0]  # stable's 2 chips
+            previous_text = text
+        # No per-refresh thread leak (the fetch pool is fixed-size).
+        assert threading.active_count() <= before_threads + 1
+    finally:
+        hub.stop()
+
+
+def test_hub_cli_tls_flags_mutually_exclusive(capsys):
+    with pytest.raises(SystemExit):
+        hub_mod.main(["http://x/metrics", "--once",
+                      "--target-ca-file", "ca.pem",
+                      "--target-insecure-tls"])
+    capsys.readouterr()

@@ -317,3 +317,13 @@ def test_hub_footer_multi_slice_expected_not_paired_per_slice():
     assert "hub[b]:  workers 6\n" in out + "\n"
     assert "hub:  workers 8/8" in out
     assert "2/8" not in out and "6/8" not in out
+
+
+def test_hub_footer_names_hub_when_several_present():
+    hub_a = 'slice_workers{slice="a"} 2\n'
+    hub_b = 'slice_workers{slice="a"} 4\n'
+    out = top.render_table(top.build_frame(
+        [hub_a, hub_b], [], ats=[0.0, 0.0],
+        targets=["http://hub-a:9401/metrics", "http://hub-b:9401/metrics"]))
+    assert "workers 2  (http://hub-a:9401/metrics)" in out
+    assert "workers 4  (http://hub-b:9401/metrics)" in out

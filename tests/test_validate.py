@@ -203,3 +203,11 @@ def test_auth_headers_helper(tmp_path):
         b"scraper:hubpass").decode()
     # Unreadable file: {} and a warning, never a crash.
     assert auth_headers(bearer_token_file=str(tmp_path / "absent")) == {}
+
+
+def test_auth_headers_survives_binary_credential_file(tmp_path):
+    from kube_gpu_stats_tpu.validate import auth_headers
+
+    bad = tmp_path / "token"
+    bad.write_bytes(b"\xff\xfe\x00garbage")
+    assert auth_headers(bearer_token_file=str(bad)) == {}
