@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
+import re
 from typing import Sequence
 
 DEFAULT_KUBELET_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
@@ -36,6 +37,8 @@ class Config:
     remote_write_interval: float = 15.0
     remote_write_bearer_token_file: str = ""
     remote_write_protocol: str = "1.0"  # 1.0 | 2.0 (415 downgrades to 1.0)
+    remote_write_extra_labels: tuple = ()  # ((name, value), ...) stamped on
+    #                                        every remote-written series
     sysfs_root: str = "/sys"
     proc_root: str = "/proc"
     device_processes: str = "on"  # accelerator_process_open scan (on|off)
@@ -87,6 +90,45 @@ def parse_libtpu_ports(raw: str) -> tuple[int, ...]:
     return tuple(ports) or (DEFAULT_LIBTPU_PORT,)
 
 
+def parse_extra_labels(raw: str) -> tuple:
+    """Parse 'name=value,name2=value2' into label pairs, rejecting names
+    that collide with the schema (a duplicate label name makes every
+    remote-written series invalid) — raises ValueError naming the entry."""
+    from . import schema
+
+    reserved = {"job", "instance", "le", "__name__"}
+    reserved.update(schema.ALL_BASE_LABELS)
+    for spec in schema.ALL_METRICS:
+        reserved.update(spec.extra_labels)
+    pairs = []
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        name, sep, value = token.partition("=")
+        name = name.strip()
+        value = value.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"extra label {token!r} must be name=value")
+        if not value:
+            # The wire encoders drop empty-valued labels (spec), so an
+            # empty value would silently no-op — reject it here instead.
+            raise ValueError(
+                f"extra label {name!r} needs a non-empty value")
+        if not re.match(r"^[a-zA-Z_][a-zA-Z0-9_]*$", name):
+            raise ValueError(f"invalid extra label name {name!r}")
+        if name in reserved:
+            raise ValueError(
+                f"extra label {name!r} collides with a schema/identity "
+                f"label")
+        pairs.append((name, value))
+    names = [name for name, _ in pairs]
+    if len(names) != len(set(names)):
+        raise ValueError("duplicate extra label names")
+    return tuple(pairs)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kube-tpu-stats",
@@ -130,6 +172,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("REMOTE_WRITE_BEARER_TOKEN_FILE", ""),
                    help="file with a bearer token for the receiver "
                         "(re-read per push; rotating tokens work)")
+    p.add_argument("--remote-write-extra-labels",
+                   default=_env("REMOTE_WRITE_EXTRA_LABELS", ""),
+                   help="comma-separated name=value labels stamped on "
+                        "every remote-written series (the Prometheus "
+                        "external_labels analog for a push path that "
+                        "has no Prometheus to attach cluster/region "
+                        "identity, e.g. 'cluster=prod,region=us-east1')")
     p.add_argument("--remote-write-protocol", choices=("1.0", "2.0"),
                    default=_env("REMOTE_WRITE_PROTOCOL", "1.0"),
                    help="remote-write wire protocol; 2.0 interns label "
@@ -319,6 +368,11 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
             metrics_include, metrics_exclude)
     except ValueError as exc:
         parser.error(str(exc))
+    try:
+        remote_write_extra_labels = parse_extra_labels(
+            args.remote_write_extra_labels)
+    except ValueError as exc:
+        parser.error(f"--remote-write-extra-labels: {exc}")
     if args.max_process_series < 1:
         parser.error("--max-process-series must be >= 1")
     if args.interval <= 0:
@@ -372,6 +426,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         remote_write_interval=args.remote_write_interval,
         remote_write_bearer_token_file=args.remote_write_bearer_token_file,
         remote_write_protocol=args.remote_write_protocol,
+        remote_write_extra_labels=remote_write_extra_labels,
         sysfs_root=args.sysfs_root,
         proc_root=args.proc_root,
         device_processes=args.device_processes,

@@ -261,6 +261,7 @@ class Daemon:
                 min_interval=cfg.remote_write_interval,
                 bearer_token_file=cfg.remote_write_bearer_token_file,
                 protocol=cfg.remote_write_protocol,
+                extra_labels=cfg.remote_write_extra_labels,
                 render_stats=self.render_stats,
             )
 

@@ -209,6 +209,11 @@ class Hub:
             self._render_stats.contribute(builder)
         if self._push_stats is not None:
             contribute_push_stats(builder, self._push_stats())
+        # The hub's own process health (CPU, RSS, fds) — same process_*
+        # families the daemon exports, so one dashboard covers both.
+        from . import procstats
+
+        procstats.contribute(builder)
         self.registry.publish(builder.build())
         for err in errors:
             log.warning("hub refresh: %s", err)
@@ -513,6 +518,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                              "(a Deployment pod name churns identity "
                              "every reschedule)")
     parser.add_argument("--remote-write-interval", type=float, default=15.0)
+    parser.add_argument("--remote-write-extra-labels", default="",
+                        help="name=value,... stamped on every "
+                             "remote-written series (e.g. the slice "
+                             "name: 'tpu_slice=v5p-a')")
     parser.add_argument("--remote-write-protocol",
                         choices=("1.0", "2.0"), default="1.0")
     parser.add_argument("--remote-write-bearer-token-file", default="")
@@ -593,8 +602,13 @@ def main(argv: Sequence[str] | None = None) -> int:
             instance=args.pushgateway_instance or args.pushgateway_job,
             render_stats=render_stats)))
     if args.remote_write_url:
+        from .config import parse_extra_labels
         from .remote_write import RemoteWriter
 
+        try:
+            extra_labels = parse_extra_labels(args.remote_write_extra_labels)
+        except ValueError as exc:
+            parser.error(f"--remote-write-extra-labels: {exc}")
         senders.append(("remote_write", RemoteWriter(
             hub.registry, args.remote_write_url,
             job=args.remote_write_job,
@@ -602,6 +616,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             min_interval=args.remote_write_interval,
             protocol=args.remote_write_protocol,
             bearer_token_file=args.remote_write_bearer_token_file,
+            extra_labels=extra_labels,
             render_stats=render_stats)))
 
     if args.once:

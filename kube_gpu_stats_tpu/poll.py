@@ -488,9 +488,7 @@ class PollLoop:
         if self._process_metrics:
             from . import procstats
 
-            by_self = {spec.name: spec for spec in schema.SELF_METRICS}
-            for name, value in procstats.read().items():
-                builder.add(by_self[name], value)
+            procstats.contribute(builder)
         builder.add_histogram(self._hist)
         # Collector-owned histograms (embedded mode's step-duration family):
         # published by reference swap on the workload thread, read here.

@@ -65,3 +65,15 @@ def read() -> dict[str, float]:
     except (ImportError, OSError, ValueError):
         pass
     return out
+
+
+def contribute(builder) -> None:
+    """Fold the current process_* readings into a SnapshotBuilder — the
+    one definition shared by the poll loop and the hub, so a new
+    procstats key missing from schema.SELF_METRICS fails both the same
+    way (loudly, in tests) instead of drifting."""
+    from . import schema
+
+    by_self = {spec.name: spec for spec in schema.SELF_METRICS}
+    for name, value in read().items():
+        builder.add(by_self[name], value)

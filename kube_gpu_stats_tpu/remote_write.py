@@ -74,14 +74,17 @@ def build_headers(bearer_token_file: str = "",
     return headers
 
 
-def _snapshot_series(snapshot: Snapshot, job: str, instance: str):
+def _snapshot_series(snapshot: Snapshot, job: str, instance: str,
+                     extra_labels=()):
     """Yield every remote-written sample as (spec, name, labels, value,
     ts_ms) — the one walk both wire protocols consume, so histogram
     expansion can never drift between 1.0 and 2.0. Each sample is stamped
     with the snapshot's publish time and carries the target-identity
-    labels (job/instance) the spec expects the sender to provide."""
+    labels (job/instance, plus any operator extra labels — the
+    external_labels analog for a push path with no Prometheus to attach
+    identity) the spec expects the sender to provide."""
     ts = int(snapshot.timestamp * 1000.0)
-    identity = [("job", job), ("instance", instance)]
+    identity = [("job", job), ("instance", instance), *extra_labels]
     for s in snapshot.series:
         yield s.spec, s.spec.name, identity + list(s.labels), s.value, ts
     for hist in snapshot.histograms:
@@ -102,17 +105,18 @@ def _snapshot_series(snapshot: Snapshot, job: str, instance: str):
         yield spec, spec.name + "_count", labels, float(hist.total), ts
 
 
-def build_write_request(snapshot: Snapshot, job: str, instance: str) -> bytes:
+def build_write_request(snapshot: Snapshot, job: str, instance: str,
+                        extra_labels=()) -> bytes:
     """Uncompressed 1.0 WriteRequest for one snapshot."""
     return prompb.encode_write_request([
         prompb.encode_series(name, labels, value, ts)
         for _, name, labels, value, ts
-        in _snapshot_series(snapshot, job, instance)
+        in _snapshot_series(snapshot, job, instance, extra_labels)
     ])
 
 
 def build_write_request_v2(snapshot: Snapshot, job: str,
-                           instance: str) -> bytes:
+                           instance: str, extra_labels=()) -> bytes:
     """Uncompressed 2.0 Request: same series set as 1.0 plus per-series
     typed metadata, with every string interned once per request. Expanded
     histogram series inherit TYPE_HISTOGRAM from their spec."""
@@ -122,7 +126,7 @@ def build_write_request_v2(snapshot: Snapshot, job: str,
             table, name, labels, value, ts,
             _V2_TYPES.get(spec.type, prompb2.TYPE_UNSPECIFIED), spec.help)
         for spec, name, labels, value, ts
-        in _snapshot_series(snapshot, job, instance)
+        in _snapshot_series(snapshot, job, instance, extra_labels)
     ]
     return prompb2.encode_request(table, series)
 
@@ -139,6 +143,7 @@ class RemoteWriter(PublishFollower):
                  min_interval: float = 15.0,
                  bearer_token_file: str = "",
                  protocol: str = "1.0",
+                 extra_labels=(),
                  render_stats=None) -> None:
         import socket
 
@@ -151,6 +156,7 @@ class RemoteWriter(PublishFollower):
         self._instance = instance or socket.gethostname()
         self._bearer_token_file = bearer_token_file
         self._protocol = protocol
+        self._extra_labels = tuple(extra_labels)
         self._render_stats = render_stats
 
     @property
@@ -177,7 +183,8 @@ class RemoteWriter(PublishFollower):
         serialize_start = time.monotonic()
         build = (build_write_request_v2 if self._protocol == "2.0"
                  else build_write_request)
-        body = snappy.compress(build(snapshot, self._job, self._instance))
+        body = snappy.compress(build(snapshot, self._job, self._instance,
+                                     self._extra_labels))
         if self._render_stats is not None:
             # prompb serialize + snappy: this path's render equivalent.
             self._render_stats.observe(

@@ -233,3 +233,30 @@ def test_json_log_formatter_single_line():
     assert doc["severity"] == "WARNING"
     assert doc["message"] == "tick overran by 7ms"
     assert "\n" not in JsonLogFormatter().format(rec)
+
+
+def test_remote_write_extra_labels_parse_and_validate():
+    from kube_gpu_stats_tpu.config import from_args, parse_extra_labels
+
+    cfg = from_args(["--backend", "mock", "--remote-write-extra-labels",
+                     "cluster=prod, region=us-east1"])
+    assert cfg.remote_write_extra_labels == (
+        ("cluster", "prod"), ("region", "us-east1"))
+    import pytest
+    for bad in ("cluster", "pod=x", "chip=0", "job=a", "1bad=x",
+                "a=1,a=2"):
+        with pytest.raises(SystemExit):
+            from_args(["--backend", "mock",
+                       "--remote-write-extra-labels", bad])
+    assert parse_extra_labels("") == ()
+
+
+def test_extra_labels_empty_value_rejected():
+    import pytest
+
+    from kube_gpu_stats_tpu.config import parse_extra_labels
+
+    # The wire encoders drop empty-valued labels, so 'cluster=' would
+    # silently no-op — it must fail at startup instead.
+    with pytest.raises(ValueError, match="non-empty value"):
+        parse_extra_labels("cluster=")

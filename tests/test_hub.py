@@ -762,3 +762,14 @@ def test_hub_cli_tls_flags_mutually_exclusive(capsys):
                       "--target-ca-file", "ca.pem",
                       "--target-insecure-tls"])
     capsys.readouterr()
+
+
+def test_hub_exports_own_process_metrics(node_stack):
+    hub = hub_mod.Hub([node_stack("0")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(text, "process_cpu_seconds_total")
+    assert values(text, "process_resident_memory_bytes")

@@ -527,3 +527,24 @@ def test_redirect_is_a_failure_not_a_silent_get(registry):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_extra_labels_stamped_on_every_series(registry):
+    snapshot = registry.snapshot()
+    decoded = prompb.decode_write_request(
+        build_write_request(snapshot, "kts", "node-1",
+                            (("cluster", "prod"), ("region", "us"))))
+    assert decoded
+    for labels, _ in decoded:
+        assert labels["cluster"] == "prod"
+        assert labels["region"] == "us"
+        assert list(labels) == sorted(labels)  # spec still holds
+
+    from kube_gpu_stats_tpu.remote_write import build_write_request_v2
+    from kube_gpu_stats_tpu.proto import prompb2
+
+    decoded_v2 = prompb2.decode_request(
+        build_write_request_v2(snapshot, "kts", "node-1",
+                               (("cluster", "prod"),)))
+    for labels, _, _ in decoded_v2:
+        assert labels["cluster"] == "prod"
