@@ -638,15 +638,24 @@ def main(argv: Sequence[str] | None = None) -> int:
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
         render_stats=render_stats)
-    server.start()
-    for _, sender in senders:
-        sender.start()
-    hub.start()
-    log.info("hub serving %d target(s) on %s:%d",
-             len(targets), args.listen_host, server.port)
+    # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
+    # senders flush the final snapshot on stop, so a pod reschedule is
+    # not a data gap upstream.
+    import signal
+    import threading
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
     try:
-        while True:
-            time.sleep(3600)
+        server.start()
+        for _, sender in senders:
+            sender.start()
+        hub.start()
+        log.info("hub serving %d target(s) on %s:%d",
+                 len(targets), args.listen_host, server.port)
+        stop.wait()
+        return 0
     except KeyboardInterrupt:
         return 0
     finally:
