@@ -144,12 +144,14 @@ class Hub:
                    if self._headers_provider is not None else None)
 
         def fetch(target: str):
+            fetch_start = time.monotonic()
             series = parse_exposition(
                 fetch_exposition(target, timeout=self._fetch_timeout,
                                  headers=headers,
                                  ca_file=self._target_ca_file,
                                  insecure_tls=self._target_insecure_tls))
-            return series, time.monotonic()
+            done = time.monotonic()
+            return series, done, done - fetch_start
 
         # Submit all before collecting any: one slow target must not
         # serialize the rest (same shape as top.snapshot_frame). The
@@ -168,14 +170,16 @@ class Hub:
                 del self._outstanding[target]  # finished late; result stale
             futures.append((target, self._pool.submit(fetch, target)))
         deadline = time.monotonic() + 2 * self._fetch_timeout
+        fetch_seconds: dict[str, float] = {}
         for target, future in futures:
             try:
-                series, at = future.result(
+                series, at, took = future.result(
                     timeout=max(0.0, deadline - time.monotonic()))
                 parsed.append(series)
                 ats.append(at)
                 names.append(target)
                 reachable[target] = True
+                fetch_seconds[target] = took
             except concurrent.futures.TimeoutError:
                 if not future.cancel():
                     self._outstanding[target] = future
@@ -196,6 +200,10 @@ class Hub:
             builder.add(schema.HUB_TARGET_UP,
                         1.0 if reachable.get(target) else 0.0,
                         (("target", target),))
+            took = fetch_seconds.get(target)
+            if took is not None:
+                builder.add(schema.HUB_TARGET_FETCH_SECONDS, took,
+                            (("target", target),))
         builder.add(schema.HUB_WORKERS_EXPECTED, float(self._expect_workers))
         self._add_rollups(builder, frame)
         self._merge_chip_series(builder, parsed, names,
@@ -642,7 +650,6 @@ def main(argv: Sequence[str] | None = None) -> int:
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.
     import signal
-    import threading
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -655,8 +662,6 @@ def main(argv: Sequence[str] | None = None) -> int:
         log.info("hub serving %d target(s) on %s:%d",
                  len(targets), args.listen_host, server.port)
         stop.wait()
-        return 0
-    except KeyboardInterrupt:
         return 0
     finally:
         hub.stop()

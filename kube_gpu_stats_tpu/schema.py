@@ -292,6 +292,14 @@ HUB_TARGET_UP = MetricSpec(
     "of the slice view.",
     extra_labels=("target",),
 )
+HUB_TARGET_FETCH_SECONDS = MetricSpec(
+    "slice_target_fetch_seconds",
+    MetricType.GAUGE,
+    "Wall time the hub's last successful fetch+parse of this target "
+    "took. A worker VM whose exporter answers slowly shows up here long "
+    "before it times out into slice_target_up 0.",
+    extra_labels=("target",),
+)
 HUB_WORKERS_EXPECTED = MetricSpec(
     "slice_workers_expected",
     MetricType.GAUGE,
@@ -402,6 +410,7 @@ HUB_REFRESH_DURATION = MetricSpec(
 
 HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_TARGET_UP,
+    HUB_TARGET_FETCH_SECONDS,
     HUB_WORKERS_EXPECTED,
     HUB_DUPLICATE_SERIES,
     HUB_CHIPS,

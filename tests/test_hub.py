@@ -773,3 +773,20 @@ def test_hub_exports_own_process_metrics(node_stack):
         hub.stop()
     assert values(text, "process_cpu_seconds_total")
     assert values(text, "process_resident_memory_bytes")
+
+
+def test_hub_exports_per_target_fetch_seconds(node_stack):
+    live = node_stack("0")
+    hub = hub_mod.Hub([live, DEAD_TARGET])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    fetches = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_fetch_seconds"}
+    # Only successful fetches report a duration; the dead target's
+    # absence (paired with slice_target_up 0) is the signal.
+    assert set(fetches) == {live}
+    assert 0.0 <= fetches[live] < 5.0
