@@ -83,8 +83,9 @@ class Hub:
                  render_stats=None, push_stats=None,
                  headers_provider=None,
                  target_ca_file: str = "",
-                 target_insecure_tls: bool = False) -> None:
-        if not targets:
+                 target_insecure_tls: bool = False,
+                 targets_provider=None) -> None:
+        if not targets and targets_provider is None:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
         # --targets-file overlap) would emit duplicate slice_target_up
@@ -93,6 +94,11 @@ class Hub:
         if len(self._targets) < len(targets):
             log.warning("hub: %d duplicate target(s) dropped",
                         len(targets) - len(self._targets))
+        # Dynamic discovery (DNS over a headless Service): called at the
+        # top of each refresh; returned targets REPLACE the static list.
+        # A provider failure keeps the previous list — a DNS blip must
+        # not blank the slice view.
+        self._targets_provider = targets_provider
         self._interval = interval
         self._expect_workers = expect_workers
         self._rollups_only = rollups_only
@@ -120,7 +126,8 @@ class Hub:
         # Daemon-thread pool (workers.py), not ThreadPoolExecutor: a fetch
         # wedged in a slow-drip target must not make shutdown unkillable.
         self._pool = DaemonSamplerPool(
-            min(32, len(self._targets)), thread_name_prefix="hub-fetch")
+            min(32, len(self._targets) or 32),
+            thread_name_prefix="hub-fetch")
         # Fetches that blew the refresh deadline but are still running:
         # a running future can't be cancelled, so until it finishes we
         # must not submit another fetch for that target or one wedged
@@ -134,6 +141,7 @@ class Hub:
 
     def refresh_once(self) -> Frame:
         start = time.monotonic()
+        self._refresh_targets()
         errors: list[str] = []
         parsed: list[list] = []
         ats: list[float] = []
@@ -226,6 +234,36 @@ class Hub:
         for err in errors:
             log.warning("hub refresh: %s", err)
         return frame
+
+    def _refresh_targets(self) -> None:
+        """Re-resolve dynamic targets and prune per-target state for
+        departed ones (pod churn under DNS discovery must not grow the
+        histogram cache or the outstanding-fetch map forever)."""
+        if self._targets_provider is None:
+            return
+        try:
+            resolved = list(dict.fromkeys(self._targets_provider()))
+        except Exception as exc:  # noqa: BLE001 - keep the previous list
+            log.warning("target discovery failed, keeping %d target(s): %s",
+                        len(self._targets), exc)
+            return
+        if not resolved:
+            log.warning("target discovery returned no targets, keeping %d",
+                        len(self._targets))
+            return
+        if resolved != self._targets:
+            log.info("targets: %d -> %d after discovery",
+                     len(self._targets), len(resolved))
+        self._targets = resolved
+        alive = set(resolved)
+        for target in [t for t in self._hist_cache if t not in alive]:
+            del self._hist_cache[target]
+        # The stuck-fetch map prunes only FINISHED futures: a target
+        # that flaps out of DNS and back must still be guarded against
+        # its wedged fetch, or each flap would pin another pool worker.
+        for target, future in list(self._outstanding.items()):
+            if target not in alive and future.done():
+                del self._outstanding[target]
 
     @staticmethod
     def _worker_id(row) -> str:
@@ -442,6 +480,38 @@ class Hub:
         self._pool.shutdown(wait=False)
 
 
+def parse_dns_endpoint(endpoint: str) -> tuple[str, str]:
+    """Syntax-only split of ``host:port`` (brackets around an IPv6 host
+    accepted and stripped) — no network, so startup validation is
+    instant even when cluster DNS is degraded."""
+    host, _, port = endpoint.rpartition(":")
+    host = host.strip("[]")
+    if not host or not port.isdigit():
+        raise ValueError(f"--targets-dns {endpoint!r} must be host:port")
+    return host, port
+
+
+def resolve_dns_targets(endpoint: str, scheme: str = "http",
+                        path: str = "/metrics") -> list[str]:
+    """Resolve ``host:port`` to one target URL per A/AAAA record —
+    Kubernetes DNS discovery: a headless Service over the DaemonSet
+    returns every pod IP, so the hub follows pod churn with no target
+    file to maintain. Sorted for stable series identity."""
+    import ipaddress
+    import socket
+
+    host, port = parse_dns_endpoint(endpoint)
+    addresses = set()
+    for info in socket.getaddrinfo(host, int(port), proto=socket.IPPROTO_TCP):
+        address = info[4][0]
+        if isinstance(ipaddress.ip_address(address),
+                      ipaddress.IPv6Address):
+            address = f"[{address}]"
+        addresses.add(address)
+    return [f"{scheme}://{address}:{port}{path}"
+            for address in sorted(addresses)]
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def main(argv: Sequence[str] | None = None) -> int:
@@ -459,6 +529,14 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--targets-file", default="",
                         help="file with one target per line (# comments ok); "
                              "appended to positional targets")
+    parser.add_argument("--targets-dns", default="",
+                        help="host:port resolved to one target per A/AAAA "
+                             "record at every refresh (point it at a "
+                             "headless Service over the DaemonSet and the "
+                             "hub follows pod churn); scheme http, path "
+                             "/metrics (--targets-dns-scheme for https)")
+    parser.add_argument("--targets-dns-scheme", choices=("http", "https"),
+                        default="http")
     parser.add_argument("--interval", type=float, default=10.0,
                         help="refresh cadence in seconds (default 10)")
     parser.add_argument("--fetch-timeout", type=float, default=5.0)
@@ -533,7 +611,15 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--remote-write-protocol",
                         choices=("1.0", "2.0"), default="1.0")
     parser.add_argument("--remote-write-bearer-token-file", default="")
+    parser.add_argument("--log-level", default="info",
+                        choices=("debug", "info", "warning", "error"))
     args = parser.parse_args(argv)
+
+    # A long-running service needs visible logs (refresh failures, dropped
+    # duplicates, credential problems); mirrors the daemon's text format.
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
     targets = list(args.targets)
     if args.targets_file:
@@ -546,8 +632,26 @@ def main(argv: Sequence[str] | None = None) -> int:
         except OSError as exc:
             print(f"--targets-file: {exc}", file=sys.stderr)
             return 2
-    if not targets:
-        parser.error("no targets (positional or --targets-file)")
+    targets_provider = None
+    if args.targets_dns:
+        if targets:
+            parser.error("--targets-dns replaces the target list; combine "
+                         "with positional targets/--targets-file is "
+                         "ambiguous")
+        try:
+            # Syntax-only check: no resolution at startup (degraded
+            # cluster DNS must not stall the container; the provider
+            # resolves — and retries — every refresh).
+            parse_dns_endpoint(args.targets_dns)
+        except ValueError as exc:
+            parser.error(str(exc))
+
+        def targets_provider() -> list[str]:
+            return resolve_dns_targets(args.targets_dns,
+                                       scheme=args.targets_dns_scheme)
+    elif not targets:
+        parser.error("no targets (positional, --targets-file, or "
+                     "--targets-dns)")
 
     if bool(args.target_auth_username) != bool(
             args.target_auth_password_file):
@@ -596,7 +700,8 @@ def main(argv: Sequence[str] | None = None) -> int:
                                         or args.remote_write_url) else None,
               headers_provider=headers_provider,
               target_ca_file=args.target_ca_file,
-              target_insecure_tls=args.target_insecure_tls)
+              target_insecure_tls=args.target_insecure_tls,
+              targets_provider=targets_provider)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -659,8 +764,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         for _, sender in senders:
             sender.start()
         hub.start()
-        log.info("hub serving %d target(s) on %s:%d",
-                 len(targets), args.listen_host, server.port)
+        if targets_provider is not None:
+            log.info("hub serving DNS-discovered targets (%s) on %s:%d",
+                     args.targets_dns, args.listen_host, server.port)
+        else:
+            log.info("hub serving %d target(s) on %s:%d",
+                     len(targets), args.listen_host, server.port)
         stop.wait()
         return 0
     finally:

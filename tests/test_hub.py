@@ -790,3 +790,88 @@ def test_hub_exports_per_target_fetch_seconds(node_stack):
     # absence (paired with slice_target_up 0) is the signal.
     assert set(fetches) == {live}
     assert 0.0 <= fetches[live] < 5.0
+
+
+def test_resolve_dns_targets_localhost():
+    urls = hub_mod.resolve_dns_targets("localhost:19490")
+    assert "http://127.0.0.1:19490/metrics" in urls \
+        or "http://[::1]:19490/metrics" in urls
+    assert urls == sorted(urls)
+    with pytest.raises(ValueError, match="host:port"):
+        hub_mod.resolve_dns_targets("no-port-here")
+    https = hub_mod.resolve_dns_targets("localhost:443", scheme="https")
+    assert all(u.startswith("https://") for u in https)
+
+
+def test_hub_dynamic_targets_follow_provider(node_stack, tmp_path):
+    a, b = node_stack("0"), node_stack("1")
+    current = [a]
+    hub = hub_mod.Hub([], targets_provider=lambda: list(current))
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_target_up") == [1.0]
+        assert values(text, "slice_workers") == [1.0]
+
+        current.append(b)  # pod appears
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_target_up") == [1.0, 1.0]
+        assert values(text, "slice_workers") == [2.0]
+
+        # Histogram cache prunes departed targets.
+        hist = tmp_path / "h.prom"
+        hist.write_text(_step_hist_text([0.01]))
+        current.append(str(hist))
+        hub.refresh_once()
+        assert str(hist) in hub._hist_cache
+        current.remove(str(hist))  # pod gone
+        hub.refresh_once()
+        assert str(hist) not in hub._hist_cache
+
+        def boom():
+            raise OSError("dns down")
+
+        hub._targets_provider = boom  # discovery blip
+        frame = hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        # Previous list kept; refresh proceeded.
+        assert values(text, "slice_target_up") == [1.0, 1.0]
+        assert not frame.errors
+    finally:
+        hub.stop()
+
+
+def test_hub_cli_dns_flag_validation(capsys):
+    with pytest.raises(SystemExit):
+        hub_mod.main(["http://x/metrics", "--targets-dns", "svc:9400",
+                      "--once"])
+    with pytest.raises(SystemExit):
+        hub_mod.main(["--targets-dns", "not-a-host-port", "--once"])
+    capsys.readouterr()
+
+
+def test_parse_dns_endpoint_ipv6_brackets():
+    assert hub_mod.parse_dns_endpoint("[fd00::5]:9400") == ("fd00::5", "9400")
+    assert hub_mod.parse_dns_endpoint("svc.ns.svc:9400") == (
+        "svc.ns.svc", "9400")
+    with pytest.raises(ValueError):
+        hub_mod.parse_dns_endpoint("svc-only")
+
+
+def test_refresh_targets_keeps_running_stuck_future():
+    # A wedged fetch for a target that flaps out of DNS must stay
+    # guarded, or every flap pins another pool worker.
+    import concurrent.futures
+
+    hub = hub_mod.Hub(["a"], targets_provider=lambda: ["b"])
+    try:
+        running = concurrent.futures.Future()  # PENDING: not done
+        finished = concurrent.futures.Future()
+        finished.set_result(None)
+        hub._outstanding = {"a": running, "gone": finished}
+        hub._refresh_targets()
+        assert "a" in hub._outstanding  # still guarded
+        assert "gone" not in hub._outstanding  # finished + departed: pruned
+    finally:
+        hub.stop()
