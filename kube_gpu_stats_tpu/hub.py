@@ -653,30 +653,20 @@ def main(argv: Sequence[str] | None = None) -> int:
         parser.error("no targets (positional, --targets-file, or "
                      "--targets-dns)")
 
-    if bool(args.target_auth_username) != bool(
-            args.target_auth_password_file):
-        parser.error("--target-auth-username and "
-                     "--target-auth-password-file must be set together")
-    if args.target_bearer_token_file and args.target_auth_username:
-        # Silently preferring one mode would send the wrong credential
-        # to every target (and 401 them all) with no hint why.
-        parser.error("--target-bearer-token-file and --target-auth-* are "
-                     "mutually exclusive — targets take one credential")
-    if args.target_ca_file and args.target_insecure_tls:
-        # insecure would silently win and disable the verification the
-        # command line says is configured.
-        parser.error("--target-ca-file and --target-insecure-tls are "
-                     "mutually exclusive")
+    from .validate import fetch_options
+
+    try:
+        # One definition of the credential/TLS flag rules (validate.
+        # fetch_options), applied to the hub's target_ spellings.
+        fetch_options(args, prefix="target_")
+    except ValueError as exc:
+        parser.error(str(exc))
 
     headers_provider = None
     if args.target_auth_username or args.target_bearer_token_file:
-        from .validate import auth_headers
 
         def headers_provider() -> dict:
-            return auth_headers(
-                bearer_token_file=args.target_bearer_token_file,
-                username=args.target_auth_username,
-                password_file=args.target_auth_password_file)
+            return fetch_options(args, prefix="target_")["headers"] or {}
 
     render_stats = RenderStats()
     senders: list = []

@@ -27,7 +27,8 @@ import time
 from typing import Mapping, Sequence
 
 from . import schema
-from .validate import fetch_exposition, parse_exposition
+from .validate import (add_fetch_arguments, fetch_exposition, fetch_options,
+                       parse_exposition)
 
 DEFAULT_TARGET = "http://127.0.0.1:9400/metrics"
 
@@ -346,19 +347,20 @@ def render_json(frame: Frame) -> str:
 # -- CLI ---------------------------------------------------------------------
 
 def snapshot_frame(targets: Sequence[str], previous: Frame | None,
-                   pool: concurrent.futures.ThreadPoolExecutor | None = None
-                   ) -> Frame:
+                   pool: concurrent.futures.ThreadPoolExecutor | None = None,
+                   fetch_kwargs: Mapping | None = None) -> Frame:
     """Fetch every target concurrently (one slow target must not stall
     the others or skew their rate windows) and fold into a Frame. Any
     fetch/decode failure becomes an error line, never a crash — this is
-    a long-running terminal view."""
+    a long-running terminal view. ``fetch_kwargs`` (auth headers, TLS
+    options — validate.fetch_options) ride every fetch."""
     errors: list[str] = []
     texts: list[str] = []
     ats: list[float] = []
     names: list[str] = []
 
     def fetch(target: str) -> tuple[str, float]:
-        text = fetch_exposition(target, timeout=5.0)
+        text = fetch_exposition(target, timeout=5.0, **(fetch_kwargs or {}))
         return text, time.monotonic()
 
     own_pool = pool is None
@@ -398,8 +400,13 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="one JSON frame per line instead of the table")
     parser.add_argument("--no-clear", action="store_true",
                         help="append frames instead of clearing the screen")
+    add_fetch_arguments(parser)
     args = parser.parse_args(argv)
     targets = args.targets or [DEFAULT_TARGET]
+    try:
+        fetch_options(args)  # flag conflicts fail before the loop
+    except ValueError as exc:
+        parser.error(str(exc))
 
     previous: Frame | None = None
     # One executor for the watch loop's lifetime — not 16 threads built
@@ -408,7 +415,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         max_workers=min(16, len(targets)))
     try:
         while True:
-            frame = snapshot_frame(targets, previous, pool)
+            # Re-resolved per frame: credential files rotate under a
+            # long-running watch.
+            frame = snapshot_frame(targets, previous, pool,
+                                   fetch_kwargs=fetch_options(args))
             if not frame.rows and frame.errors and previous is None:
                 for err in frame.errors:
                     print(f"! {err}", file=sys.stderr)

@@ -244,6 +244,56 @@ def fetch_exposition(target: str, timeout: float = 10.0,
         return f.read()
 
 
+def add_fetch_arguments(parser) -> None:
+    """Scrape-client options shared by the `top` and `validate` CLIs so
+    they can talk to hardened exporters (the hub has its own --target-*
+    spellings of the same options)."""
+    parser.add_argument("--auth-username", default="",
+                        help="basic-auth username for the target(s)")
+    parser.add_argument("--auth-password-file", default="",
+                        help="file holding the basic-auth password "
+                             "(re-read per fetch)")
+    parser.add_argument("--bearer-token-file", default="",
+                        help="file holding a bearer token (re-read per "
+                             "fetch)")
+    parser.add_argument("--ca-file", default="",
+                        help="CA bundle verifying the targets' TLS certs")
+    parser.add_argument("--insecure-tls", action="store_true",
+                        help="skip TLS verification (prefer --ca-file)")
+
+
+def fetch_options(args, prefix: str = "") -> dict:
+    """fetch_exposition kwargs from add_fetch_arguments flags; raises
+    ValueError on conflicting flags. ``prefix`` maps differently-spelled
+    argparse attributes onto the same semantics (the hub's ``target_``
+    flags) so the conflict rules exist once. Call per fetch round —
+    credential files are re-read so rotations apply to long-running
+    views."""
+    def get(name: str):
+        return getattr(args, prefix + name)
+
+    def flag(name: str) -> str:
+        return "--" + (prefix + name).replace("_", "-")
+
+    if bool(get("auth_username")) != bool(get("auth_password_file")):
+        raise ValueError(f"{flag('auth_username')} and "
+                         f"{flag('auth_password_file')} must be set "
+                         f"together")
+    if get("bearer_token_file") and get("auth_username"):
+        raise ValueError(f"{flag('bearer_token_file')} and "
+                         f"{flag('auth_username')} are mutually exclusive")
+    if get("ca_file") and get("insecure_tls"):
+        raise ValueError(f"{flag('ca_file')} and {flag('insecure_tls')} "
+                         f"are mutually exclusive")
+    headers = None
+    if get("auth_username") or get("bearer_token_file"):
+        headers = auth_headers(bearer_token_file=get("bearer_token_file"),
+                               username=get("auth_username"),
+                               password_file=get("auth_password_file"))
+    return {"headers": headers, "ca_file": get("ca_file"),
+            "insecure_tls": get("insecure_tls")}
+
+
 @functools.lru_cache(maxsize=8)
 def _tls_context(ca_file: str, insecure_tls: bool):
     """Client TLS context, cached per (ca_file, insecure) — parsing the
@@ -261,24 +311,37 @@ def _tls_context(ca_file: str, insecure_tls: bool):
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = list(sys.argv[1:] if argv is None else argv)
-    two_scrapes = "--two-scrapes" in args
-    if two_scrapes:
-        args.remove("--two-scrapes")
-    if len(args) != 1:
-        print("usage: python -m kube_gpu_stats_tpu.validate [--two-scrapes] "
-              "<http://host:9400/metrics | file.prom>", file=sys.stderr)
-        return 2
-    target = args[0]
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="kube-tpu-stats validate",
+        description="check a scrape against the accelerator_* contract")
+    parser.add_argument("target",
+                        help="http(s)://host:9400/metrics or file.prom")
+    parser.add_argument("--two-scrapes", action="store_true",
+                        help="scrape twice and check counter monotonicity")
+    add_fetch_arguments(parser)
     try:
-        first = fetch_exposition(target)
+        args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    except SystemExit as exc:
+        # Preserve the documented contract: usage errors exit 2
+        # (argparse already uses 2; --help uses 0).
+        return int(exc.code or 0)
+    target = args.target
+    try:
+        options = fetch_options(args)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 2
+    try:
+        first = fetch_exposition(target, **options)
         previous = None
-        if two_scrapes:
+        if args.two_scrapes:
             import time
 
             previous = first
             time.sleep(1.5)
-            first = fetch_exposition(target)
+            first = fetch_exposition(target, **fetch_options(args))
     except OSError as exc:
         print(f"fetch failed: {exc}", file=sys.stderr)
         return 2

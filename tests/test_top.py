@@ -327,3 +327,36 @@ def test_hub_footer_names_hub_when_several_present():
         targets=["http://hub-a:9401/metrics", "http://hub-b:9401/metrics"]))
     assert "workers 2  (http://hub-a:9401/metrics)" in out
     assert "workers 4  (http://hub-b:9401/metrics)" in out
+
+
+def test_top_authenticates_against_hardened_exporter(tmp_path, capsys):
+    import hashlib
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.poll import PollLoop
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    server = MetricsServer(
+        reg, host="127.0.0.1", port=0, auth_username="viewer",
+        auth_password_sha256=hashlib.sha256(b"watchpass").hexdigest())
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    pw = tmp_path / "pw"
+    pw.write_text("watchpass\n")
+    try:
+        rc = top.main([url, "--once", "--json", "--auth-username", "viewer",
+                       "--auth-password-file", str(pw)])
+        assert rc == 0
+        frame = json.loads(capsys.readouterr().out)
+        assert len(frame["chips"]) == 1
+        # Without credentials the same target is a 401 error, exit 2.
+        rc = top.main([url, "--once", "--json"])
+        captured = capsys.readouterr()
+        assert rc == 2 and "401" in captured.err
+    finally:
+        loop.stop()
+        server.stop()

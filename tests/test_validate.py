@@ -211,3 +211,35 @@ def test_auth_headers_survives_binary_credential_file(tmp_path):
     bad = tmp_path / "token"
     bad.write_bytes(b"\xff\xfe\x00garbage")
     assert auth_headers(bearer_token_file=str(bad)) == {}
+
+
+def test_validate_cli_authenticates(tmp_path, capsys):
+    import hashlib
+
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.poll import PollLoop
+    from kube_gpu_stats_tpu.registry import Registry
+    from kube_gpu_stats_tpu.validate import main
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    server = MetricsServer(
+        reg, host="127.0.0.1", port=0, auth_username="ci",
+        auth_password_sha256=hashlib.sha256(b"checkpass").hexdigest())
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    pw = tmp_path / "pw"
+    pw.write_text("checkpass")
+    try:
+        assert main([url, "--auth-username", "ci",
+                     "--auth-password-file", str(pw)]) == 0
+        capsys.readouterr()
+        assert main([url]) == 2  # 401 without credentials
+        capsys.readouterr()
+        assert main([url, "--auth-username", "ci"]) == 2  # missing file
+        capsys.readouterr()
+    finally:
+        loop.stop()
+        server.stop()
