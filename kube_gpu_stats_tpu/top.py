@@ -366,7 +366,7 @@ def snapshot_frame(targets: Sequence[str], previous: Frame | None,
     own_pool = pool is None
     if own_pool:
         pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(16, len(targets)))
+            max_workers=min(16, len(targets) or 16))
     try:
         for target, future in [(t, pool.submit(fetch, t)) for t in targets]:
             try:
@@ -400,9 +400,39 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="one JSON frame per line instead of the table")
     parser.add_argument("--no-clear", action="store_true",
                         help="append frames instead of clearing the screen")
+    parser.add_argument("--targets-dns", default="",
+                        help="host:port resolved to one target per pod IP "
+                             "each frame (watch a whole slice via its "
+                             "headless Service; follows pod churn)")
+    parser.add_argument("--targets-dns-scheme", choices=("http", "https"),
+                        default="http")
     add_fetch_arguments(parser)
     args = parser.parse_args(argv)
-    targets = args.targets or [DEFAULT_TARGET]
+    resolve = None
+    if args.targets_dns:
+        if args.targets:
+            parser.error("--targets-dns replaces positional targets")
+        from .hub import parse_dns_endpoint, resolve_dns_targets
+
+        try:
+            parse_dns_endpoint(args.targets_dns)
+        except ValueError as exc:
+            parser.error(str(exc))
+
+        def resolve(previous_targets):
+            try:
+                return resolve_dns_targets(
+                    args.targets_dns, scheme=args.targets_dns_scheme)
+            except OSError as exc:
+                # DNS blip: keep watching the last-known pods.
+                print(f"! dns: {exc}", file=sys.stderr)
+                return previous_targets
+
+        # Resolved per frame in the loop (one resolution, not two, before
+        # the first frame — degraded DNS must not double startup latency).
+        targets = []
+    else:
+        targets = args.targets or [DEFAULT_TARGET]
     try:
         fetch_options(args)  # flag conflicts fail before the loop
     except ValueError as exc:
@@ -410,13 +440,23 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     previous: Frame | None = None
     # One executor for the watch loop's lifetime — not 16 threads built
-    # and torn down per refresh.
+    # and torn down per refresh. DNS mode sizes for churn (the slice can
+    # scale past the startup pod count), static mode for the given list.
     pool = concurrent.futures.ThreadPoolExecutor(
-        max_workers=min(16, len(targets)))
+        max_workers=16 if resolve is not None
+        else min(16, len(targets) or 16))
     try:
         while True:
-            # Re-resolved per frame: credential files rotate under a
-            # long-running watch.
+            # Re-resolved per frame: credential files rotate and DNS
+            # targets churn under a long-running watch.
+            if resolve is not None:
+                targets = resolve(targets)
+                if not targets:
+                    print("! dns: no targets resolved", file=sys.stderr)
+                    if args.once:
+                        return 2
+                    time.sleep(max(0.2, args.interval))
+                    continue
             frame = snapshot_frame(targets, previous, pool,
                                    fetch_kwargs=fetch_options(args))
             if not frame.rows and frame.errors and previous is None:

@@ -360,3 +360,41 @@ def test_top_authenticates_against_hardened_exporter(tmp_path, capsys):
     finally:
         loop.stop()
         server.stop()
+
+
+def test_top_targets_dns(tmp_path, capsys):
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.poll import PollLoop
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        rc = top.main(["--targets-dns", f"localhost:{server.port}",
+                       "--once", "--json"])
+        assert rc == 0
+        frame = json.loads(capsys.readouterr().out)
+        assert len(frame["chips"]) == 2
+        import pytest
+        with pytest.raises(SystemExit):  # positional + dns is ambiguous
+            top.main(["http://x/metrics", "--targets-dns", "h:1", "--once"])
+        capsys.readouterr()
+    finally:
+        loop.stop()
+        server.stop()
+
+
+def test_top_dns_unresolvable_once_exits_2(capsys, monkeypatch):
+    from kube_gpu_stats_tpu import hub as hub_mod
+
+    def boom(endpoint, scheme="http", path="/metrics"):
+        raise OSError("dns down")
+
+    monkeypatch.setattr(hub_mod, "resolve_dns_targets", boom)
+    rc = top.main(["--targets-dns", "svc:9400", "--once"])
+    assert rc == 2
+    assert "dns" in capsys.readouterr().err
