@@ -206,6 +206,18 @@ def main(argv=None) -> int:
     parser.add_argument("--embedded-textfile", default="",
                         help="embedded exporter textfile output dir")
     args = parser.parse_args(argv)
+    # Honor JAX_PLATFORMS even where a sitecustomize force-registers a
+    # TPU plugin and overrides the env (observed in sandboxes with
+    # tunneled chips): the explicit config update wins because backends
+    # initialize lazily. Without this, JAX_PLATFORMS=cpu loadgen runs
+    # would still try — and possibly hang on — a wedged TPU tunnel.
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
     exporter = None
     step_hook = None
     if args.embedded_port is not None:
