@@ -133,6 +133,15 @@ class JaxIntrospectCollector(Collector):
         # (memory_stats-capable plugins report the runtime's own peak).
         self._peak_live: dict[int, int] = {}
         self._devices = list(jax.local_devices())
+        # FLOPs are reported workload-global; the per-chip share divides
+        # by the GLOBAL device count (multi-host SPMD: every process's
+        # chips worked the same job), not the local one — dividing by
+        # local count would over-report per-chip FLOPs/MFU by the host
+        # count on a multi-host slice.
+        try:
+            self._global_devices = max(1, jax.device_count())
+        except Exception:
+            self._global_devices = max(1, len(self._devices))
         # memory_stats capability probed once: the axon/tunneled plugin
         # returns None, real Cloud TPU PJRT returns a dict.
         try:
@@ -188,7 +197,7 @@ class JaxIntrospectCollector(Collector):
         dt = now - prev[1]
         if peak is None or dt <= 0:
             return
-        per_device = (flops - prev[0]) / max(1, len(self._devices))
+        per_device = (flops - prev[0]) / self._global_devices
         self._mfu = 100.0 * per_device / dt / peak
 
     def extra_histograms(self) -> tuple[HistogramState, ...]:
@@ -265,7 +274,7 @@ class JaxIntrospectCollector(Collector):
             values[schema.PEAK_FLOPS.name] = peak
         if self._flops > 0:
             values[schema.WORKLOAD_FLOPS.name] = (
-                self._flops / max(1, len(self._devices)))
+                self._flops / self._global_devices)
             if self._mfu is not None:
                 values[schema.WORKLOAD_MFU.name] = self._mfu
         return Sample(device=device, values=values)

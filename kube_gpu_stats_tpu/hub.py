@@ -125,9 +125,9 @@ class Hub:
             schema.HUB_REFRESH_DURATION, schema.HUB_REFRESH_BUCKETS)
         # Daemon-thread pool (workers.py), not ThreadPoolExecutor: a fetch
         # wedged in a slow-drip target must not make shutdown unkillable.
+        self._pool_size = min(32, len(self._targets) or 32)
         self._pool = DaemonSamplerPool(
-            min(32, len(self._targets) or 32),
-            thread_name_prefix="hub-fetch")
+            self._pool_size, thread_name_prefix="hub-fetch")
         # Fetches that blew the refresh deadline but are still running:
         # a running future can't be cancelled, so until it finishes we
         # must not submit another fetch for that target or one wedged
@@ -142,6 +142,16 @@ class Hub:
     def refresh_once(self) -> Frame:
         start = time.monotonic()
         self._refresh_targets()
+        if not self._targets:
+            # DNS discovery has never succeeded: publish NOTHING so
+            # /healthz goes stale (a hub watching zero targets must not
+            # claim health) and report the state as a frame error so
+            # --once exits nonzero instead of printing an empty success.
+            frame = Frame({}, ["target discovery has not resolved any "
+                               "targets yet"])
+            self._previous = frame
+            log.warning("hub refresh: %s", frame.errors[0])
+            return frame
         errors: list[str] = []
         parsed: list[list] = []
         ats: list[float] = []
@@ -177,7 +187,13 @@ class Hub:
                     continue
                 del self._outstanding[target]  # finished late; result stale
             futures.append((target, self._pool.submit(fetch, target)))
-        deadline = time.monotonic() + 2 * self._fetch_timeout
+        # Deadline scales with pool waves: more targets than workers run
+        # in batches, and wave N's fetches only START after wave N-1 —
+        # a flat 2x budget would mark healthy targets of a >32-worker
+        # slice down every refresh just for queueing.
+        waves = max(1, -(-len(futures) // self._pool_size))
+        budget = (waves + 1) * self._fetch_timeout
+        deadline = time.monotonic() + budget
         fetch_seconds: dict[str, float] = {}
         for target, future in futures:
             try:
@@ -194,7 +210,7 @@ class Hub:
                 reachable[target] = False
                 errors.append(
                     f"{target}: fetch exceeded the refresh deadline "
-                    f"({2 * self._fetch_timeout:g}s)")
+                    f"({budget:g}s)")
             except Exception as exc:  # noqa: BLE001 - per-target degradation
                 reachable[target] = False
                 errors.append(f"{target}: {exc}")
@@ -338,22 +354,26 @@ class Hub:
 
         Two disambiguation rules keep legitimate setups collision-free:
         series whose ``worker`` label is present-but-empty get the target
-        as their worker value when the hub has multiple targets (two
-        dev-VM/embedded exporters both exporting chip 0 are different
-        hardware — same rule _worker_id applies to rollups), and the
+        as their worker value (two dev-VM/embedded exporters both
+        exporting chip 0 are different hardware — same rule _worker_id
+        applies to rollups; unconditional so series identity is stable
+        under target-count churn), and the
         dedup key sorts labels so a third-party exporter rendering the
         same label set in a different order still collides instead of
         slipping through as a Prometheus-identical duplicate."""
         seen: set[tuple] = set()
         duplicates = 0
-        multi = len(self._targets) > 1
         for target, series in zip(names, parsed):
             for name, labels, value in series:
                 spec = PER_CHIP_SPECS.get(name)
                 if spec is None:
                     continue
                 items: Mapping[str, str] = labels
-                if multi and items.get("worker", None) == "":
+                # Unconditional (not gated on target count): under DNS
+                # discovery the count churns, and identity must not flip
+                # between worker="" and worker=<target> as pods come and
+                # go — Prometheus would see new series + phantom resets.
+                if items.get("worker", None) == "":
                     items = dict(items)
                     items["worker"] = str(target)
                 label_tuple = tuple(items.items())

@@ -198,7 +198,8 @@ WORKLOAD_FLOPS = MetricSpec(
     "Cumulative model FLOPs this chip executed, as reported by the "
     "workload via the embedded exporter's step hook (record_step(flops=)/"
     "step_timer(flops=)); the workload-global figure is divided evenly "
-    "over the local devices (SPMD). rate() of this counter divided by "
+    "over ALL participating devices (jax.device_count() — global, so "
+    "multi-host SPMD shares are exact). rate() of this counter divided by "
     "accelerator_peak_flops_per_second, times 100, is MFU in percent "
     "(matching accelerator_workload_model_flops_utilization). Only "
     "present in embedded mode when the workload reports FLOPs.",

@@ -185,11 +185,6 @@ def _check_monotone(before: str, after: str, specs) -> Iterable[str]:
     return problems
 
 
-class _NoRedirectHandler(urllib.request.HTTPRedirectHandler):
-    def redirect_request(self, req, fp, code, msg, headers, newurl):
-        return None
-
-
 def auth_headers(bearer_token_file: str = "", username: str = "",
                  password_file: str = "") -> dict:
     """Authorization header from file-backed credentials, re-read per
@@ -235,7 +230,9 @@ def fetch_exposition(target: str, timeout: float = 10.0,
             handlers.append(urllib.request.HTTPSHandler(
                 context=_tls_context(ca_file, insecure_tls)))
         if headers and "Authorization" in headers:
-            handlers.append(_NoRedirectHandler())
+            from .workers import NoRedirectHandler
+
+            handlers.append(NoRedirectHandler())
         request = urllib.request.Request(target, headers=headers or {})
         opener = urllib.request.build_opener(*handlers)
         with opener.open(request, timeout=timeout) as resp:

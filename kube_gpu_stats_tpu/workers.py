@@ -27,6 +27,7 @@ import queue
 import logging
 import threading
 import time
+import urllib.request
 from typing import Callable
 
 log = logging.getLogger(__name__)
@@ -84,6 +85,17 @@ class PeriodicRefresher:
 _PUSH_OPENER = None
 
 
+class NoRedirectHandler(urllib.request.HTTPRedirectHandler):
+    """The one redirect-refusal policy, shared by the push senders and
+    the authed scrape path (validate.fetch_exposition): a 3xx raises
+    instead of being followed — a redirected POST/PUT would degrade into
+    a body-less GET, and a followed redirect would forward Authorization
+    headers to a cross-origin Location."""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        return None
+
+
 def push_opener():
     """urllib opener for the push senders that REFUSES redirects. The
     default handler converts a redirected POST/PUT into a body-less GET
@@ -95,13 +107,7 @@ def push_opener():
     this concurrent use); both senders push every interval forever."""
     global _PUSH_OPENER
     if _PUSH_OPENER is None:
-        import urllib.request
-
-        class _NoRedirect(urllib.request.HTTPRedirectHandler):
-            def redirect_request(self, req, fp, code, msg, headers, newurl):
-                return None
-
-        _PUSH_OPENER = urllib.request.build_opener(_NoRedirect)
+        _PUSH_OPENER = urllib.request.build_opener(NoRedirectHandler)
     return _PUSH_OPENER
 
 

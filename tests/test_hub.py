@@ -875,3 +875,56 @@ def test_refresh_targets_keeps_running_stuck_future():
         assert "gone" not in hub._outstanding  # finished + departed: pruned
     finally:
         hub.stop()
+
+
+def test_hub_refresh_deadline_scales_with_pool_waves(tmp_path):
+    # More targets than pool workers run in waves; the deadline must
+    # budget for queueing or healthy targets of a wide slice get marked
+    # down every refresh. 40 file targets through a small pool must all
+    # succeed.
+    targets = []
+    for i in range(40):
+        path = tmp_path / f"w{i}.prom"
+        path.write_text(
+            f'accelerator_up{{chip="0",worker="{i}",slice="s"}} 1\n')
+        targets.append(str(path))
+    hub = hub_mod.Hub(targets, fetch_timeout=5.0)
+    hub._pool_size = 4  # simulate heavy oversubscription
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(text, "slice_target_up") == [1.0] * 40
+    assert values(text, "slice_workers") == [40.0]
+
+
+def test_hub_unresolved_discovery_publishes_nothing(capsys):
+    def no_targets():
+        raise OSError("dns down")
+
+    hub = hub_mod.Hub([], targets_provider=no_targets)
+    try:
+        frame = hub.refresh_once()
+        assert frame.errors and "discovery" in frame.errors[0]
+        # Nothing published: /healthz would go stale rather than claim
+        # health over zero targets.
+        assert hub.registry.snapshot().timestamp == 0.0
+    finally:
+        hub.stop()
+
+
+def test_hub_single_target_empty_worker_rewrite_is_stable(tmp_path):
+    # Identity must not depend on the instantaneous target count (DNS
+    # churn): even a single unlabeled target gets worker=<target>.
+    prom = tmp_path / "dev.prom"
+    prom.write_text('accelerator_up{chip="0",worker="",slice=""} 1\n')
+    hub = hub_mod.Hub([str(prom)])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    (labels,) = [labels for name, labels, _ in parse_exposition(text)
+                 if name == "accelerator_up"]
+    assert labels["worker"] == str(prom)
