@@ -252,3 +252,17 @@ def test_hub_manifest_shape():
         assert pod_labels.get(key) == value
     assert {d["metadata"]["namespace"] for d in docs} == {
         dep["metadata"]["namespace"]}
+
+
+def test_kustomization_references_existing_manifests():
+    """Every resource in deploy/kustomization.yaml must exist and parse
+    as a k8s manifest (a rename breaks `kubectl apply -k` at deploy
+    time, not CI, unless pinned here)."""
+    doc = yaml.safe_load((DEPLOY / "kustomization.yaml").read_text())
+    assert doc["kind"] == "Kustomization"
+    assert doc["resources"], "kustomization lists no resources"
+    for resource in doc["resources"]:
+        path = DEPLOY / resource
+        assert path.exists(), f"kustomization references missing {resource}"
+        for manifest in yaml.safe_load_all(path.read_text()):
+            assert "kind" in manifest and "apiVersion" in manifest
