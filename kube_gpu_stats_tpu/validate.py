@@ -212,10 +212,19 @@ def auth_headers(bearer_token_file: str = "", username: str = "",
     return {}
 
 
+# Response-size ceiling for fetched expositions. A real 256-chip node
+# renders ~tens of KB; 64 MB is three orders of magnitude past any
+# legitimate exposition, while an endless/misdirected response (wrong
+# port, a misbehaving proxy streaming forever) must not OOM a hub or a
+# long-running top.
+MAX_EXPOSITION_BYTES = 64 << 20
+
+
 def fetch_exposition(target: str, timeout: float = 10.0,
                      headers: dict | None = None,
                      ca_file: str = "",
-                     insecure_tls: bool = False) -> str:
+                     insecure_tls: bool = False,
+                     max_bytes: int = MAX_EXPOSITION_BYTES) -> str:
     """Read a scrape target: http(s) URL or a saved .prom file path.
     Shared by this validator, the `top` view, and the hub. ``headers``
     ride the request (Authorization for hardened exporters — redirects
@@ -236,7 +245,12 @@ def fetch_exposition(target: str, timeout: float = 10.0,
         request = urllib.request.Request(target, headers=headers or {})
         opener = urllib.request.build_opener(*handlers)
         with opener.open(request, timeout=timeout) as resp:
-            return resp.read().decode()
+            body = resp.read(max_bytes + 1)
+            if len(body) > max_bytes:
+                raise ValueError(
+                    f"response exceeds {max_bytes} bytes — not a metrics "
+                    f"endpoint?")
+            return body.decode()
     with open(target) as f:
         return f.read()
 

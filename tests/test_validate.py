@@ -243,3 +243,35 @@ def test_validate_cli_authenticates(tmp_path, capsys):
     finally:
         loop.stop()
         server.stop()
+
+
+def test_fetch_exposition_caps_response_size():
+    import http.server
+    import threading
+
+    import pytest
+
+    from kube_gpu_stats_tpu.validate import fetch_exposition
+
+    class Firehose(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"x" * 4096
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Firehose)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+    try:
+        # Under the cap: full body.
+        assert len(fetch_exposition(url, timeout=5)) == 4096
+        # Over the cap: a ValueError per target, never an OOM.
+        with pytest.raises(ValueError, match="exceeds"):
+            fetch_exposition(url, timeout=5, max_bytes=1024)
+    finally:
+        server.shutdown()
