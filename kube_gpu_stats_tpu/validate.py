@@ -353,7 +353,9 @@ def main(argv: list[str] | None = None) -> int:
             previous = first
             time.sleep(1.5)
             first = fetch_exposition(target, **fetch_options(args))
-    except OSError as exc:
+    except (OSError, ValueError) as exc:
+        # ValueError: the response-size cap — same "this isn't a usable
+        # metrics endpoint" class as a connection failure.
         print(f"fetch failed: {exc}", file=sys.stderr)
         return 2
     problems = check(first, previous)

@@ -264,5 +264,5 @@ def test_kustomization_references_existing_manifests():
     for resource in doc["resources"]:
         path = DEPLOY / resource
         assert path.exists(), f"kustomization references missing {resource}"
-        for manifest in yaml.safe_load_all(path.read_text()):
+        for manifest in load_yaml_docs(resource):
             assert "kind" in manifest and "apiVersion" in manifest

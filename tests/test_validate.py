@@ -275,3 +275,4 @@ def test_fetch_exposition_caps_response_size():
             fetch_exposition(url, timeout=5, max_bytes=1024)
     finally:
         server.shutdown()
+        server.server_close()
