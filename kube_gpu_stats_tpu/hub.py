@@ -125,7 +125,11 @@ class Hub:
             schema.HUB_REFRESH_DURATION, schema.HUB_REFRESH_BUCKETS)
         # Daemon-thread pool (workers.py), not ThreadPoolExecutor: a fetch
         # wedged in a slow-drip target must not make shutdown unkillable.
-        self._pool_size = min(32, len(self._targets) or 32)
+        # Dynamic modes (DNS/file re-read) size the pool for growth: the
+        # discovered target count can climb far past the startup snapshot,
+        # and a pool sized from it would serialize fetches into waves.
+        self._pool_size = (32 if targets_provider is not None
+                           else min(32, len(self._targets) or 32))
         self._pool = DaemonSamplerPool(
             self._pool_size, thread_name_prefix="hub-fetch")
         # Fetches that blew the refresh deadline but are still running:
@@ -143,12 +147,12 @@ class Hub:
         start = time.monotonic()
         self._refresh_targets()
         if not self._targets:
-            # DNS discovery has never succeeded: publish NOTHING so
-            # /healthz goes stale (a hub watching zero targets must not
-            # claim health) and report the state as a frame error so
-            # --once exits nonzero instead of printing an empty success.
-            frame = Frame({}, ["target discovery has not resolved any "
-                               "targets yet"])
+            # Discovery never succeeded, or the target list was
+            # deliberately emptied: publish NOTHING so /healthz goes
+            # stale (a hub watching zero targets must not claim health)
+            # and report the state as a frame error so --once exits
+            # nonzero instead of printing an empty success.
+            frame = Frame({}, ["target discovery yielded no targets"])
             self._previous = frame
             log.warning("hub refresh: %s", frame.errors[0])
             return frame
@@ -263,10 +267,10 @@ class Hub:
             log.warning("target discovery failed, keeping %d target(s): %s",
                         len(self._targets), exc)
             return
-        if not resolved:
-            log.warning("target discovery returned no targets, keeping %d",
-                        len(self._targets))
-            return
+        # An empty SUCCESS is accepted: an operator emptying the targets
+        # file has decommissioned the slice — the hub must stop scraping
+        # the dead targets (and go health-stale), not hold them forever.
+        # Only a provider *failure* keeps the previous list.
         if resolved != self._targets:
             log.info("targets: %d -> %d after discovery",
                      len(self._targets), len(resolved))
@@ -500,6 +504,21 @@ class Hub:
         self._pool.shutdown(wait=False)
 
 
+def file_targets_provider(path: str, static: Sequence[str] = ()):
+    """Targets provider with Prometheus file_sd semantics: the file is
+    re-read on every call (a mounted-ConfigMap edit applies live), one
+    target per line, # comments and blanks skipped, appended to the
+    static (positional) targets. An unreadable file raises OSError —
+    _refresh_targets keeps the previous list for that refresh."""
+    def provider() -> list[str]:
+        with open(path, encoding="utf-8") as handle:
+            lines = [line.strip() for line in handle
+                     if line.strip() and not line.strip().startswith("#")]
+        return list(static) + lines
+
+    return provider
+
+
 def parse_dns_endpoint(endpoint: str) -> tuple[str, str]:
     """Syntax-only split of ``host:port`` (brackets around an IPv6 host
     accepted and stripped) — no network, so startup validation is
@@ -547,8 +566,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("targets", nargs="*",
                         help="per-node exporter /metrics URLs or .prom files")
     parser.add_argument("--targets-file", default="",
-                        help="file with one target per line (# comments ok); "
-                             "appended to positional targets")
+                        help="file with one target per line (# comments "
+                             "ok); appended to positional targets and "
+                             "RE-READ every refresh (file_sd semantics: "
+                             "a mounted-ConfigMap edit applies live, no "
+                             "pod roll). Unreadable mid-run keeps the "
+                             "previous list")
     parser.add_argument("--targets-dns", default="",
                         help="host:port resolved to one target per A/AAAA "
                              "record at every refresh (point it at a "
@@ -642,17 +665,19 @@ def main(argv: Sequence[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
     targets = list(args.targets)
+    targets_provider = None
     if args.targets_file:
+        if args.targets_dns:
+            parser.error("--targets-file and --targets-dns are mutually "
+                         "exclusive")
+        targets_provider = file_targets_provider(args.targets_file,
+                                                 args.targets)
         try:
-            with open(args.targets_file, encoding="utf-8") as handle:
-                for line in handle:
-                    line = line.strip()
-                    if line and not line.startswith("#"):
-                        targets.append(line)
+            targets = targets_provider()  # fail fast on an unreadable file
         except OSError as exc:
             print(f"--targets-file: {exc}", file=sys.stderr)
             return 2
-    targets_provider = None
+
     if args.targets_dns:
         if targets:
             parser.error("--targets-dns replaces the target list; combine "
@@ -669,7 +694,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         def targets_provider() -> list[str]:
             return resolve_dns_targets(args.targets_dns,
                                        scheme=args.targets_dns_scheme)
-    elif not targets:
+    elif not targets and targets_provider is None:
+        # A file provider with an empty-for-now file is allowed: the
+        # shipped ConfigMap starts with only comments, and the hub must
+        # serve (health-stale) until targets are added, not CrashLoop.
         parser.error("no targets (positional, --targets-file, or "
                      "--targets-dns)")
 
@@ -774,12 +802,15 @@ def main(argv: Sequence[str] | None = None) -> int:
         for _, sender in senders:
             sender.start()
         hub.start()
-        if targets_provider is not None:
+        if args.targets_dns:
             log.info("hub serving DNS-discovered targets (%s) on %s:%d",
                      args.targets_dns, args.listen_host, server.port)
         else:
-            log.info("hub serving %d target(s) on %s:%d",
-                     len(targets), args.listen_host, server.port)
+            log.info("hub serving %d target(s)%s on %s:%d",
+                     len(targets),
+                     " (targets file re-read per refresh)"
+                     if args.targets_file else "",
+                     args.listen_host, server.port)
         stop.wait()
         return 0
     finally:

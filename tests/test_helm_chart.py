@@ -119,7 +119,9 @@ def test_hub_template_shape():
     assert '- "hub"' in text
     assert '"--targets-file"' in text
     assert "/healthz" in text and "/readyz" in text
-    assert "checksum/targets" in text  # pod rolls when targets change
+    # No checksum-roll annotation: the hub re-reads the mounted targets
+    # file every refresh, so ConfigMap edits apply without a restart.
+    assert "checksum/targets" not in text
     values = yaml.safe_load((CHART / "values.yaml").read_text())
     assert values["hub"]["enabled"] is False
     assert values["hub"]["targets"] == []

@@ -928,3 +928,47 @@ def test_hub_single_target_empty_worker_rewrite_is_stable(tmp_path):
     (labels,) = [labels for name, labels, _ in parse_exposition(text)
                  if name == "accelerator_up"]
     assert labels["worker"] == str(prom)
+
+
+def test_hub_targets_file_reread_follows_edits(node_stack, tmp_path):
+    # file_sd semantics (what `hub --targets-file` wires): edits to the
+    # file apply at the next refresh, no restart.
+    a, b = node_stack("0"), node_stack("1")
+    listing = tmp_path / "targets.txt"
+    listing.write_text(f"{a}\n")
+
+    # The provider main() actually wires — the shipped closure is what
+    # this test pins.
+    provider = hub_mod.file_targets_provider(str(listing))
+
+    hub = hub_mod.Hub([], targets_provider=provider)
+    try:
+        hub.refresh_once()
+        assert values(hub.registry.snapshot().render(),
+                      "slice_workers") == [1.0]
+        listing.write_text(f"{a}\n# comment\n{b}\n")
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_workers") == [2.0]
+        listing.unlink()  # unreadable: previous list kept
+        hub.refresh_once()
+        assert values(hub.registry.snapshot().render(),
+                      "slice_workers") == [2.0]
+        # Deliberately EMPTY is a decommission, not a failure: the hub
+        # stops scraping and publishes nothing (health goes stale).
+        listing.write_text("# decommissioned\n")
+        generation = hub.registry.generation
+        frame = hub.refresh_once()
+        assert frame.errors and "no targets" in frame.errors[0]
+        assert hub.registry.generation == generation  # nothing published
+    finally:
+        hub.stop()
+
+
+def test_hub_cli_file_and_dns_mutually_exclusive(tmp_path, capsys):
+    listing = tmp_path / "t.txt"
+    listing.write_text("http://x/metrics\n")
+    with pytest.raises(SystemExit):
+        hub_mod.main(["--targets-file", str(listing),
+                      "--targets-dns", "svc:9400", "--once"])
+    capsys.readouterr()
