@@ -24,7 +24,8 @@ BUDGET_MS = 50.0
 
 
 def main() -> int:
-    from kube_gpu_stats_tpu.bench import (run_latency_harness,
+    from kube_gpu_stats_tpu.bench import (measure_hub_merge,
+                                          run_latency_harness,
                                           try_embedded_harness,
                                           try_real_harness)
 
@@ -67,6 +68,12 @@ def main() -> int:
                 "workload_mfu_pct_during_bench"):
         if key in result and result[key] is not None:
             line[key] = result[key]
+    # Slice-aggregation cost at the v5p-256 shape (64 workers x 4 chips,
+    # full labels + ICI links): median hub refresh wall time. An extra
+    # datum — None/omitted on failure, never a bench failure.
+    hub_ms = measure_hub_merge()
+    if hub_ms is not None:
+        line["hub_merge_64w_p50_ms"] = hub_ms
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or
     # PJRT atexit hook) blocked in native code; the JSON line is already

@@ -499,3 +499,65 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
     except Exception as exc:
         record["error"] = f"{type(exc).__name__}: {exc}"
         return None
+
+
+def build_slice_fixture(directory, workers: int = 64, chips: int = 4,
+                        links: int = 6) -> list[str]:
+    """Write `workers` realistic worker expositions (full label sets,
+    per-link ICI rates) into `directory` and return the file-target
+    paths — the v5p-256-shaped fixture shared by the hub slice-width
+    test and the bench's hub-merge measurement, so the published number
+    and the CI pin describe the same workload."""
+    from . import schema
+    from .registry import SnapshotBuilder
+
+    targets = []
+    for worker in range(workers):
+        builder = SnapshotBuilder()
+        for chip in range(chips):
+            labels = (
+                ("accel_type", "tpu-v5p"), ("chip", str(chip)),
+                ("device_path", f"/dev/accel{chip}"), ("uuid", ""),
+                ("pod", "trainer-0"), ("namespace", "ml"),
+                ("container", "main"), ("slice", "v5p-256"),
+                ("worker", str(worker)), ("topology", "8x8x4"))
+            builder.add(schema.DEVICE_UP, 1.0, labels)
+            builder.add(schema.DUTY_CYCLE, 50.0 + chip, labels)
+            builder.add(schema.MEMORY_USED, 1.0e9, labels)
+            builder.add(schema.MEMORY_TOTAL, 95.0e9, labels)
+            builder.add(schema.POWER, 300.0, labels)
+            for link in range(links):
+                builder.add(schema.ICI_BANDWIDTH, 1e9,
+                            labels + (("link", str(link)),))
+        path = Path(directory) / f"w{worker}.prom"
+        path.write_text(builder.build().render())
+        targets.append(str(path))
+    return targets
+
+
+def measure_hub_merge(workers: int = 64, chips: int = 4,
+                      refreshes: int = 5) -> float | None:
+    """Median wall time (ms) of one hub refresh over a v5p-256-shaped
+    slice (build_slice_fixture), merged + rolled up by the real Hub.
+    Bounded and failure-proof — returns None rather than ever failing
+    the bench (imports included: a hub.py regression must not cost the
+    already-measured north-star line)."""
+    try:
+        import tempfile
+
+        from .hub import Hub
+
+        with tempfile.TemporaryDirectory() as tmp:
+            targets = build_slice_fixture(tmp, workers, chips)
+            hub = Hub(targets)
+            try:
+                walls = []
+                for _ in range(refreshes):
+                    start = time.monotonic()
+                    hub.refresh_once()
+                    walls.append((time.monotonic() - start) * 1000.0)
+            finally:
+                hub.stop()
+        return round(statistics.median(walls), 1)
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
