@@ -16,9 +16,6 @@ from __future__ import annotations
 import sys
 from typing import Sequence
 
-from .config import from_args
-from .daemon import run
-
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
@@ -38,6 +35,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         from .hub import main as hub_main
 
         return hub_main(args[1:])
+    # Deferred like the subcommands: the daemon path drags in grpc and
+    # the full collector stack, which hub/top/validate/doctor never use.
+    from .config import from_args
+    from .daemon import run
+
     return run(from_args(args))
 
 
