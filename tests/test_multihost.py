@@ -224,3 +224,57 @@ def test_v5p_256_slice_real_stack_concurrent(tmp_path):
             loop.stop()
             http.stop()
             libtpu.stop()
+
+
+def test_hub_aggregates_64_real_http_exporters():
+    """The hub at slice width over REAL HTTP (the deterministic
+    file-target variant lives in test_hub): 64 in-process exporter
+    stacks, one hub refresh through the real concurrent fetch path,
+    256-chip union exactly once with full rollups."""
+    import time
+
+    from kube_gpu_stats_tpu.hub import Hub
+
+    hosts, chips_per_host = 64, 4
+    stacks = []
+    try:
+        for worker in range(hosts):
+            reg = Registry()
+            loop = PollLoop(
+                MockCollector(num_devices=chips_per_host,
+                              accel_type="tpu-v5p"),
+                reg, deadline=5.0,
+                topology_labels={"slice": "v5p-256-slice",
+                                 "worker": str(worker),
+                                 "topology": "8x8x4"},
+            )
+            loop.tick()
+            http = MetricsServer(reg, host="127.0.0.1", port=0)
+            http.start()
+            stacks.append((loop, http))
+        targets = [f"http://127.0.0.1:{http.port}/metrics"
+                   for _, http in stacks]
+        hub = Hub(targets, fetch_timeout=10.0)
+        try:
+            start = time.monotonic()
+            hub.refresh_once()
+            wall = time.monotonic() - start
+            text = hub.registry.snapshot().render()
+        finally:
+            hub.stop()
+        pairs = worker_chip_pairs(text)
+        assert len(pairs) == 256 and len(set(pairs)) == 256
+        assert 'slice_chips{slice="v5p-256-slice"} 256' in text
+        assert 'slice_workers{slice="v5p-256-slice"} 64' in text
+        up_lines = [line for line in text.splitlines()
+                    if line.startswith("slice_target_up")]
+        assert len(up_lines) == 64
+        assert all(line.endswith(" 1") for line in up_lines)
+        # Generous wall bound: one refresh of a whole slice's HTTP
+        # fetches must not approach the default 10 s cadence even on an
+        # oversubscribed CI box.
+        assert wall < 30, f"64-target HTTP refresh took {wall:.1f}s"
+    finally:
+        for loop, http in stacks:
+            loop.stop()
+            http.stop()
