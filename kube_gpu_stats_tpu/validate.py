@@ -234,16 +234,15 @@ def fetch_exposition(target: str, timeout: float = 10.0,
     self-signed certs — the scraped data is telemetry, but prefer
     ca_file)."""
     if target.startswith(("http://", "https://")):
-        handlers = []
-        if target.startswith("https://") and (insecure_tls or ca_file):
-            handlers.append(urllib.request.HTTPSHandler(
-                context=_tls_context(ca_file, insecure_tls)))
-        if headers and "Authorization" in headers:
-            from .workers import NoRedirectHandler
-
-            handlers.append(NoRedirectHandler())
         request = urllib.request.Request(target, headers=headers or {})
-        opener = urllib.request.build_opener(*handlers)
+        opener = _opener(
+            target.startswith("https://"), ca_file, insecure_tls,
+            # Case-insensitive: urllib capitalizes header keys when
+            # SENDING, so a lowercase "authorization" would ride the
+            # request while a case-sensitive check here skipped the
+            # redirect refusal that protects it.
+            bool(headers) and any(k.lower() == "authorization"
+                                  for k in headers))
         with opener.open(request, timeout=timeout) as resp:
             body = resp.read(max_bytes + 1)
             if len(body) > max_bytes:
@@ -303,6 +302,25 @@ def fetch_options(args, prefix: str = "") -> dict:
                                password_file=get("auth_password_file"))
     return {"headers": headers, "ca_file": get("ca_file"),
             "insecure_tls": get("insecure_tls")}
+
+
+@functools.lru_cache(maxsize=16)
+def _opener(https: bool, ca_file: str, insecure_tls: bool,
+            authed: bool):
+    """Opener cached per (scheme, TLS config, auth) — measured 26 ms to
+    build fresh (the default HTTPSHandler loads the system CA bundle
+    from disk each construction) vs 0.7 ms to reuse, which dominated a
+    64-target hub refresh 40x. OpenerDirector.open is safe for this
+    concurrent reuse (same contract as workers.push_opener)."""
+    handlers = []
+    if https and (insecure_tls or ca_file):
+        handlers.append(urllib.request.HTTPSHandler(
+            context=_tls_context(ca_file, insecure_tls)))
+    if authed:
+        from .workers import NoRedirectHandler
+
+        handlers.append(NoRedirectHandler())
+    return urllib.request.build_opener(*handlers)
 
 
 @functools.lru_cache(maxsize=8)

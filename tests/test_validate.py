@@ -276,3 +276,34 @@ def test_fetch_exposition_caps_response_size():
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_lowercase_authorization_header_still_refuses_redirects():
+    import http.server
+    import threading
+    import urllib.error
+
+    import pytest
+
+    from kube_gpu_stats_tpu.validate import fetch_exposition
+
+    class Redirector(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(302)
+            self.send_header("Location", "http://127.0.0.1:1/steal")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Redirector)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            fetch_exposition(url, timeout=5,
+                             headers={"authorization": "Bearer secret"})
+    finally:
+        server.shutdown()
+        server.server_close()
