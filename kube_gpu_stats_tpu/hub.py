@@ -596,6 +596,9 @@ def main(argv: Sequence[str] | None = None) -> int:
                              "stdout, exit (rates need two refreshes)")
     parser.add_argument("--tls-cert-file", default="")
     parser.add_argument("--tls-key-file", default="")
+    parser.add_argument("--tls-client-ca-file", default="",
+                        help="require + verify client certificates (mTLS) "
+                             "on the hub's own scrape endpoint")
     parser.add_argument("--auth-username", default="")
     parser.add_argument("--auth-password-sha256", default="")
     parser.add_argument("--target-auth-username", default="",
@@ -786,6 +789,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         hub.registry, host=args.listen_host, port=args.listen_port,
         healthz_max_age=max(3 * args.interval, 30.0),
         tls_cert_file=args.tls_cert_file, tls_key_file=args.tls_key_file,
+        tls_client_ca_file=args.tls_client_ca_file,
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
         render_stats=render_stats)
