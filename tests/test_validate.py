@@ -307,3 +307,32 @@ def test_lowercase_authorization_header_still_refuses_redirects():
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_parse_exposition_fuzz_never_crashes():
+    """The hub/top feed REMOTE text into parse_exposition: any input must
+    either parse or raise ValueError — never another exception type and
+    never pathological time (the label regex is backtracking-safe)."""
+    import random
+    import time
+
+    from kube_gpu_stats_tpu.validate import parse_exposition
+
+    rng = random.Random(0xC0FFEE)
+    start = time.monotonic()
+    for _ in range(300):
+        kind = rng.randrange(3)
+        if kind == 0:  # raw bytes
+            text = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 300))
+                         ).decode("latin-1")
+        elif kind == 1:  # structured-ish series lines with junk labels
+            labels = "".join(rng.choice('a="b",\\"x{}=') for _ in range(40))
+            text = f"metric_{rng.randrange(9)}{{{labels}}} {rng.random()}\n"
+        else:  # pathological backslash runs (regex backtracking bait)
+            text = 'm{a="' + "\\" * rng.randrange(1, 120) + '"} 1\n'
+        try:
+            parse_exposition(text)
+        except ValueError:
+            pass
+    assert time.monotonic() - start < 10.0
