@@ -135,10 +135,16 @@ class MetricsServer:
                  tls_client_ca_file: str = "",
                  auth_username: str = "", auth_password_sha256: str = "",
                  max_concurrent_scrapes: int = 16,
-                 render_stats: RenderStats | None = None):
+                 render_stats: RenderStats | None = None,
+                 ready_check=None):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
+        # Optional () -> (ok, reason) overriding /readyz's default
+        # "a snapshot exists" test — the hub gates readiness on having
+        # targets so a decommissioned/blind hub drains scrapers without
+        # tripping the (separate) liveness probe.
+        self._ready_check = ready_check
         self._auth = (
             (auth_username, auth_password_sha256.lower())
             if auth_username else None
@@ -284,12 +290,21 @@ class MetricsServer:
                     self.send_header("Content-Type", "text/plain")
                 elif path == "/readyz":
                     # Readiness = at least one snapshot has been published
-                    # (liveness/staleness is /healthz's job).
-                    if outer._registry.snapshot().timestamp > 0:
+                    # (liveness/staleness is /healthz's job), unless the
+                    # owner installed a stricter ready_check.
+                    if outer._ready_check is not None:
+                        try:
+                            ok, reason = outer._ready_check()
+                        except Exception as exc:  # noqa: BLE001 - probe-safe
+                            ok, reason = False, f"ready_check: {exc}"
+                    else:
+                        ok = outer._registry.snapshot().timestamp > 0
+                        reason = "ready" if ok else "no snapshot published yet"
+                    if ok:
                         body = b"ready\n"
                         self.send_response(200)
                     else:
-                        body = b"no snapshot published yet\n"
+                        body = f"{reason}\n".encode()
                         self.send_response(503)
                     self.send_header("Content-Type", "text/plain")
                 elif path == "/debug/profile":

@@ -148,12 +148,21 @@ class Hub:
         self._refresh_targets()
         if not self._targets:
             # Discovery never succeeded, or the target list was
-            # deliberately emptied: publish NOTHING so /healthz goes
-            # stale (a hub watching zero targets must not claim health)
-            # and report the state as a frame error so --once exits
-            # nonzero instead of printing an empty success.
+            # deliberately emptied. Publish a MINIMAL snapshot (config
+            # gauges only, no slice data): the shipped Deployment's
+            # liveness probe hits /healthz, and publishing nothing would
+            # go health-stale and restart-loop the pod — turning an
+            # empty ConfigMap (a configuration state the hub is meant to
+            # survive) into a crash loop. Zero targets stays alertable
+            # as `slice_targets == 0`; --once still exits nonzero via
+            # the frame error.
             frame = Frame({}, ["target discovery yielded no targets"])
             self._previous = frame
+            builder = SnapshotBuilder()
+            builder.add(schema.HUB_TARGETS, 0.0)
+            builder.add(schema.HUB_WORKERS_EXPECTED,
+                        float(self._expect_workers))
+            self._publish(builder, start)
             log.warning("hub refresh: %s", frame.errors[0])
             return frame
         errors: list[str] = []
@@ -232,12 +241,23 @@ class Hub:
             if took is not None:
                 builder.add(schema.HUB_TARGET_FETCH_SECONDS, took,
                             (("target", target),))
+        builder.add(schema.HUB_TARGETS, float(len(self._targets)))
         builder.add(schema.HUB_WORKERS_EXPECTED, float(self._expect_workers))
         self._add_rollups(builder, frame)
         self._merge_chip_series(builder, parsed, names,
                                 emit_series=not self._rollups_only)
         if not self._rollups_only:
             self._merge_histograms(builder, parsed, names)
+        self._publish(builder, start)
+        for err in errors:
+            log.warning("hub refresh: %s", err)
+        return frame
+
+    def _publish(self, builder: SnapshotBuilder, start: float) -> None:
+        """Shared publish tail for every refresh outcome (normal and
+        zero-targets): self-metrics must never vanish from one branch —
+        push senders keep shipping while decommissioned, so their
+        collector_push_* health counters must keep rendering too."""
         self._refresh_hist = self._refresh_hist.observe(
             time.monotonic() - start)
         builder.add_histogram(self._refresh_hist)
@@ -251,9 +271,19 @@ class Hub:
 
         procstats.contribute(builder)
         self.registry.publish(builder.build())
-        for err in errors:
-            log.warning("hub refresh: %s", err)
-        return frame
+
+    def ready(self) -> tuple[bool, str]:
+        """Readiness for /readyz: a hub is ready to serve traffic only
+        when it has targets AND has published. Deliberate decommission
+        (empty targets file) goes NotReady — scrapers drain — while
+        /healthz stays 200 so the liveness probe never restart-loops;
+        a discovery endpoint broken from boot never goes Ready, so a
+        rollout cannot replace a working hub with a blind one."""
+        if self.registry.snapshot().timestamp <= 0:
+            return False, "no snapshot published yet"
+        if not self._targets:
+            return False, "no targets (discovery empty or decommissioned)"
+        return True, "ready"
 
     def _refresh_targets(self) -> None:
         """Re-resolve dynamic targets and prune per-target state for
@@ -269,8 +299,9 @@ class Hub:
             return
         # An empty SUCCESS is accepted: an operator emptying the targets
         # file has decommissioned the slice — the hub must stop scraping
-        # the dead targets (and go health-stale), not hold them forever.
-        # Only a provider *failure* keeps the previous list.
+        # the dead targets (publishing the minimal snapshot: /readyz
+        # 503 drains scrapers, /healthz stays 200), not hold them
+        # forever. Only a provider *failure* keeps the previous list.
         if resolved != self._targets:
             log.info("targets: %d -> %d after discovery",
                      len(self._targets), len(resolved))
@@ -284,6 +315,22 @@ class Hub:
         for target, future in list(self._outstanding.items()):
             if target not in alive and future.done():
                 del self._outstanding[target]
+
+    @staticmethod
+    def _disambiguate_worker(labels: Mapping[str, str],
+                             target: str) -> Mapping[str, str]:
+        """Present-but-empty worker labels get the target as their
+        worker value: two dev-VM/embedded exporters both exporting chip
+        0 are different hardware. Unconditional (not gated on target
+        count): under DNS discovery the count churns, and series
+        identity must not flip between worker="" and worker=<target> as
+        pods come and go — Prometheus would see new series + phantom
+        resets. One rule for gauges AND histograms, so the merged
+        exposition stays internally consistent."""
+        if labels.get("worker", None) == "":
+            labels = dict(labels)
+            labels["worker"] = str(target)
+        return labels
 
     @staticmethod
     def _worker_id(row) -> str:
@@ -372,15 +419,8 @@ class Hub:
                 spec = PER_CHIP_SPECS.get(name)
                 if spec is None:
                     continue
-                items: Mapping[str, str] = labels
-                # Unconditional (not gated on target count): under DNS
-                # discovery the count churns, and identity must not flip
-                # between worker="" and worker=<target> as pods come and
-                # go — Prometheus would see new series + phantom resets.
-                if items.get("worker", None) == "":
-                    items = dict(items)
-                    items["worker"] = str(target)
-                label_tuple = tuple(items.items())
+                label_tuple = tuple(
+                    self._disambiguate_worker(labels, target).items())
                 key = (name, tuple(sorted(label_tuple)))
                 if key in seen:
                     duplicates += 1
@@ -417,8 +457,9 @@ class Hub:
                 if hit is None:
                     continue
                 fam, part = hit
+                items = self._disambiguate_worker(labels, target)
                 key = (fam, tuple(sorted(
-                    (k, v) for k, v in labels.items() if k != "le")))
+                    (k, v) for k, v in items.items() if k != "le")))
                 entry = local.setdefault(
                     key, {"buckets": {}, "sum": 0.0, "count": 0.0})
                 if part == "bucket":
@@ -527,6 +568,15 @@ def parse_dns_endpoint(endpoint: str) -> tuple[str, str]:
     host = host.strip("[]")
     if not host or not port.isdigit():
         raise ValueError(f"--targets-dns {endpoint!r} must be host:port")
+    if "/" in host:
+        # A pasted URL ('http://svc:9400', 'svc:9400/metrics') would pass
+        # the split above and then fail DNS resolution on every refresh
+        # with only log-line evidence; fail at startup like other flag
+        # errors instead.
+        raise ValueError(
+            f"--targets-dns {endpoint!r} must be bare host:port, not a "
+            f"URL (scheme is fixed by --targets-dns-scheme, path is "
+            f"/metrics)")
     return host, port
 
 
@@ -700,7 +750,8 @@ def main(argv: Sequence[str] | None = None) -> int:
     elif not targets and targets_provider is None:
         # A file provider with an empty-for-now file is allowed: the
         # shipped ConfigMap starts with only comments, and the hub must
-        # serve (health-stale) until targets are added, not CrashLoop.
+        # serve (live but NotReady, slice_targets 0) until targets are
+        # added, not CrashLoop.
         parser.error("no targets (positional, --targets-file, or "
                      "--targets-dns)")
 
@@ -792,7 +843,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         tls_client_ca_file=args.tls_client_ca_file,
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
-        render_stats=render_stats)
+        render_stats=render_stats,
+        ready_check=hub.ready)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

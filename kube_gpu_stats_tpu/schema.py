@@ -301,6 +301,16 @@ HUB_TARGET_FETCH_SECONDS = MetricSpec(
     "before it times out into slice_target_up 0.",
     extra_labels=("target",),
 )
+HUB_TARGETS = MetricSpec(
+    "slice_targets",
+    MetricType.GAUGE,
+    "Targets the hub is currently configured/discovered to scrape "
+    "(before reachability). 0 means the target list is empty — a "
+    "configuration/discovery state, not a process failure: the hub "
+    "stays live and publishes this gauge so liveness probes pass; "
+    "alert on `slice_targets == 0` to catch a decommission or a "
+    "discovery outage.",
+)
 HUB_WORKERS_EXPECTED = MetricSpec(
     "slice_workers_expected",
     MetricType.GAUGE,
@@ -412,6 +422,7 @@ HUB_REFRESH_DURATION = MetricSpec(
 HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_TARGET_UP,
     HUB_TARGET_FETCH_SECONDS,
+    HUB_TARGETS,
     HUB_WORKERS_EXPECTED,
     HUB_DUPLICATE_SERIES,
     HUB_CHIPS,

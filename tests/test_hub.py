@@ -433,6 +433,44 @@ def test_hub_merges_step_histograms_across_targets(tmp_path):
     assert validate.check(text) == []
 
 
+def test_hub_histogram_empty_worker_disambiguated_by_target(tmp_path):
+    # Same rule as _merge_chip_series: two embedded/dev targets whose
+    # step histograms carry identical labels with a present-but-empty
+    # worker are different hardware — their distributions must split
+    # into worker=<target> series like their gauges do, not silently
+    # sum into one worker="" series.
+    from kube_gpu_stats_tpu.registry import HistogramState, SnapshotBuilder
+
+    def hist_text(observations):
+        hist = HistogramState.empty(
+            schema.WORKLOAD_STEP_DURATION, schema.STEP_DURATION_BUCKETS,
+            labels=(("chip", "0"), ("worker", ""), ("slice", "")))
+        for value in observations:
+            hist = hist.observe(value)
+        builder = SnapshotBuilder()
+        builder.add_histogram(hist)
+        return builder.build().render()
+
+    a, b = tmp_path / "a.prom", tmp_path / "b.prom"
+    a.write_text(hist_text([0.01, 0.01]))
+    b.write_text(hist_text([3.0]))
+    hub = hub_mod.Hub([str(a), str(b)])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    name = schema.WORKLOAD_STEP_DURATION.name
+    counts = {labels["worker"]: value
+              for n, labels, value in parse_exposition(text)
+              if n == f"{name}_count"}
+    assert counts == {str(a): 2.0, str(b): 1.0}
+    # (No validate.check here: worker-labeled step histograms are
+    # out-of-contract input the hub accepts leniently; in-contract
+    # label-free histograms keep summing into the slice distribution —
+    # pinned by test_hub_merges_step_histograms_across_targets.)
+
+
 def test_hub_histogram_survives_target_outage_monotone(tmp_path):
     # A transient fetch failure must not dip the merged cumulative
     # counters (Prometheus would read a counter reset and rate() a
@@ -837,6 +875,17 @@ def test_hub_cli_dns_flag_validation(capsys):
     capsys.readouterr()
 
 
+def test_parse_dns_endpoint_rejects_urls():
+    # A pasted URL parses into host 'http://svc' and would fail DNS on
+    # every refresh with only log evidence; it must fail at startup.
+    for endpoint in ("http://svc:9400", "https://svc.ns:9400"):
+        with pytest.raises(ValueError, match="bare host:port"):
+            hub_mod.parse_dns_endpoint(endpoint)
+    # A path suffix lands in the port half and fails the digit check.
+    with pytest.raises(ValueError):
+        hub_mod.parse_dns_endpoint("svc:9400/metrics")
+
+
 def test_parse_dns_endpoint_ipv6_brackets():
     assert hub_mod.parse_dns_endpoint("[fd00::5]:9400") == ("fd00::5", "9400")
     assert hub_mod.parse_dns_endpoint("svc.ns.svc:9400") == (
@@ -885,17 +934,76 @@ def test_hub_refresh_deadline_scales_with_pool_waves(tmp_path):
     assert values(text, "slice_workers") == [40.0]
 
 
-def test_hub_unresolved_discovery_publishes_nothing(capsys):
+def test_hub_unresolved_discovery_publishes_minimal_snapshot(capsys):
     def no_targets():
         raise OSError("dns down")
 
-    hub = hub_mod.Hub([], targets_provider=no_targets)
+    hub = hub_mod.Hub([], targets_provider=no_targets, expect_workers=4)
     try:
         frame = hub.refresh_once()
         assert frame.errors and "discovery" in frame.errors[0]
-        # Nothing published: /healthz would go stale rather than claim
-        # health over zero targets.
-        assert hub.registry.snapshot().timestamp == 0.0
+        # A minimal snapshot IS published (slice_targets 0, config
+        # gauges, refresh histogram): the shipped liveness probe hits
+        # /healthz, and publishing nothing would restart-loop the pod
+        # over a DNS outage a restart cannot fix. Zero targets stays
+        # alertable as slice_targets == 0.
+        text = hub.registry.snapshot().render()
+        assert hub.registry.snapshot().timestamp > 0.0
+        assert values(text, "slice_targets") == [0.0]
+        assert values(text, "slice_workers_expected") == [4.0]
+        # No slice data is fabricated.
+        assert values(text, "slice_workers") == []
+        assert not any(n.startswith("accelerator_")
+                       for n, _, _ in parse_exposition(text))
+        # Readiness still gates: a hub that has never seen a target must
+        # not go Ready (a rollout with broken discovery would otherwise
+        # replace a working hub with a blind one).
+        ok, reason = hub.ready()
+        assert not ok and "no targets" in reason
+    finally:
+        hub.stop()
+
+
+def test_hub_minimal_snapshot_keeps_push_health_series():
+    # Push senders keep shipping while the hub is decommissioned, so
+    # their collector_push_* health counters must keep rendering in the
+    # zero-targets snapshot (same publish tail as the normal path).
+    def no_targets():
+        return []
+
+    hub = hub_mod.Hub([], targets_provider=no_targets,
+                      push_stats=lambda: {"remote_write": {
+                          "pushes": 3, "failures": 1, "dropped": 0}})
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_targets") == [0.0]
+        pushes = {labels.get("mode"): value
+                  for name, labels, value in parse_exposition(text)
+                  if name == "collector_push_failures_total"}
+        assert pushes == {"remote_write": 1.0}
+        # process_* self-health renders too.
+        assert any(n.startswith("process_")
+                   for n, _, _ in parse_exposition(text))
+    finally:
+        hub.stop()
+
+
+def test_hub_ready_transitions_with_target_list(tmp_path):
+    prom = tmp_path / "a.prom"
+    prom.write_text('accelerator_up{chip="0",worker="0",slice="s"} 1\n')
+    listing = tmp_path / "targets.txt"
+    listing.write_text(f"{prom}\n")
+    hub = hub_mod.Hub([], targets_provider=hub_mod.file_targets_provider(
+        str(listing)))
+    try:
+        assert hub.ready() == (False, "no snapshot published yet")
+        hub.refresh_once()
+        assert hub.ready() == (True, "ready")
+        listing.write_text("# decommissioned\n")
+        hub.refresh_once()
+        ok, reason = hub.ready()
+        assert not ok and "decommissioned" in reason
     finally:
         hub.stop()
 
@@ -941,12 +1049,17 @@ def test_hub_targets_file_reread_follows_edits(node_stack, tmp_path):
         assert values(hub.registry.snapshot().render(),
                       "slice_workers") == [2.0]
         # Deliberately EMPTY is a decommission, not a failure: the hub
-        # stops scraping and publishes nothing (health goes stale).
+        # stops scraping and publishes a minimal snapshot (slice_targets
+        # 0, no slice data) so the liveness probe keeps passing while
+        # the state stays alertable.
         listing.write_text("# decommissioned\n")
         generation = hub.registry.generation
         frame = hub.refresh_once()
         assert frame.errors and "no targets" in frame.errors[0]
-        assert hub.registry.generation == generation  # nothing published
+        assert hub.registry.generation > generation  # minimal publish
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_targets") == [0.0]
+        assert values(text, "slice_workers") == []
     finally:
         hub.stop()
 
