@@ -1,4 +1,30 @@
-.PHONY: test bench native dashboard golden clean run-mock
+.PHONY: test bench native dashboard golden clean run-mock ci
+
+# The full gate .github/workflows/ci.yaml encodes, runnable offline:
+# native build, suite (goldens diffed), zero-NVML grep, chart checks
+# (helm render when the binary exists, the static chart tests always),
+# wheel + console-script smoke in a scratch venv (no index needed).
+ci: native
+	python -m pytest tests/ -q
+	python tools/check_no_nvml.py
+	@if command -v helm >/dev/null 2>&1; then \
+	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
+	    echo 'helm render: ok'; \
+	else \
+	    echo 'helm binary absent: chart pinned by tests/test_helm_chart.py'; \
+	fi
+	python bench.py | python -c "import json,sys; \
+	    line = json.loads(sys.stdin.readline()); \
+	    assert line['metric'] and line['value'] > 0, line"
+	rm -rf build/ci-venv dist && \
+	    python -m venv --system-site-packages build/ci-venv
+	pip wheel --no-deps --no-build-isolation -w dist . >/dev/null
+	build/ci-venv/bin/pip install --no-index --no-deps dist/*.whl >/dev/null
+	build/ci-venv/bin/python -I -c "import kube_gpu_stats_tpu as m; \
+	    assert 'ci-venv' in m.__file__, \
+	    'wheel smoke resolved another copy, not the wheel: ' + m.__file__"
+	build/ci-venv/bin/kube-tpu-stats --help >/dev/null
+	@echo "make ci: all gates green"
 
 test:
 	python -m pytest tests/ -q

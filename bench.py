@@ -34,12 +34,28 @@ def main() -> int:
         # No external metric surface (the probe says exactly why): the
         # embedded in-process collector is the remaining real-chip path.
         result = try_embedded_harness(probe, ticks=50, warmup=5)
+    simulated = None
     if result is None:
         with tempfile.TemporaryDirectory() as tmp:
-            result = run_latency_harness(
+            simulated = run_latency_harness(
                 tmp, num_chips=8, ticks=50, rpc_delay=0.010, warmup=5,
                 subprocess_server=True,
             )
+        # Round-end real-mode retry: one probe per run lost BENCH_r04's
+        # real numbers when the chip tunnel recovered between bench
+        # start and round end (round-4 verdict, weak 1). The simulated
+        # run above takes minutes — long enough for a tunnel to come
+        # back — so re-attempt; a still-down tunnel costs one more
+        # bounded probe. On success the real measurement becomes the
+        # headline and the simulated section ships alongside it.
+        retry_probe: dict = {}
+        result, retry_probe = try_real_harness(ticks=50, warmup=5)
+        if result is None:
+            result = try_embedded_harness(retry_probe, ticks=50, warmup=5)
+        probe["round_end_retry"] = retry_probe
+        if result is None:
+            result = simulated
+            simulated = None
     p50 = result["p50_ms"]
     line = {
         "metric": f"poll_tick_p50_ms_{result['chips']}chip_{result['mode']}",
@@ -65,12 +81,26 @@ def main() -> int:
         line["device_kind"] = result["device_kind"]
     for key in ("workload_steps_per_s_during_bench",
                 "workload_busy_fraction_during_bench",
-                "workload_mfu_pct_during_bench"):
+                "workload_mfu_pct_during_bench",
+                "mfu_sweep"):
         if key in result and result[key] is not None:
             line[key] = result[key]
     # Slice-aggregation cost at the v5p-256 shape (64 workers x 4 chips,
     # full labels + ICI links): median hub refresh wall time. An extra
     # datum — None/omitted on failure, never a bench failure.
+    if simulated is not None:
+        # Both modes in one artifact: the retry found a live chip after
+        # the simulated harness already ran — ship its figures too so
+        # the regression pin (simulated numbers) survives a real round.
+        line["simulated"] = {
+            "p50_ms": round(simulated["p50_ms"], 3),
+            "p90_ms": round(simulated["p90_ms"], 3),
+            "p99_ms": round(simulated["p99_ms"], 3),
+            "scrape_p50_ms": round(simulated.get("scrape_p50_ms", 0.0), 3),
+            "chips": simulated["chips"],
+            "metrics_per_sec_per_chip": round(
+                simulated["metrics_per_chip"], 1),
+        }
     hub_ms = measure_hub_merge()
     if hub_ms is not None:
         line["hub_merge_64w_p50_ms"] = hub_ms

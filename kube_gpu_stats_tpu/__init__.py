@@ -23,4 +23,4 @@ Layer map (SURVEY.md §1):
     L5 cli.py/daemon.py + deploy/   flags, wiring, k8s manifests
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"

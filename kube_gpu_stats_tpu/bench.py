@@ -437,7 +437,12 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
 
         def burn():
             try:
-                run_burn(burn_seconds, size=1024, report_every=1e9,
+                # size/depth: best known roofline point (sweep evidence
+                # in BASELINE.md); drives EVERY local device via the
+                # sharded all-device burn, so the collector's SPMD
+                # per-chip split is exact.
+                run_burn(burn_seconds, size=4096, depth=16,
+                         report_every=1e9,
                          step_hook=collector.record_step)
             except Exception as exc:  # noqa: BLE001 - recorded, not fatal
                 record["error"] = f"burn: {type(exc).__name__}: {exc}"
@@ -481,20 +486,35 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
         result["workload_busy_fraction_during_bench"] = round(
             (collector._busy_seconds - busy_before) / elapsed, 3
         ) if elapsed else 0.0
-        # Measured MFU over the same window (burn reports its matmul
-        # FLOPs; peak from the device-kind table — None for unknown
-        # kinds rather than a guess). run_burn executes on the default
-        # device only, so this is the busy chip's MFU — no division over
-        # local devices (the collector's SPMD split would under-report
-        # N-fold on a multi-chip host).
+        # Measured per-chip MFU over the same window: the burn drives
+        # every local device and reports workload-global FLOPs, so the
+        # per-chip share divides by the device count — the same split
+        # the collector exports (peak from the device-kind table; None
+        # for unknown kinds rather than a guess).
         from .embedded import _kind_peak_flops
 
         peak = _kind_peak_flops(record.get("device_kind") or "")
+        n_dev = max(1, collector._global_devices)
         result["workload_mfu_pct_during_bench"] = round(
-            100.0 * (collector._flops - flops_before) / elapsed / peak,
-            2) if (peak and elapsed) else None
+            100.0 * (collector._flops - flops_before) / n_dev
+            / elapsed / peak, 2) if (peak and elapsed) else None
         stop.wait(burn_seconds + 60.0)
         burner.join(timeout=5.0)
+        # Bounded roofline mini-sweep AFTER the measurement (the burn
+        # thread is done; the chip is free): steady-state TFLOP/s per
+        # matmul size. Rising with size = dispatch-bound at small sizes;
+        # flat = the transport caps throughput and that ceiling is the
+        # MFU story (round-4 verdict item 1 — the sweep is the
+        # deliverable either way). Failure-proof: an extra datum, never
+        # a bench failure.
+        try:
+            from .loadgen.burn import sweep_burn
+
+            result["mfu_sweep"] = sweep_burn(
+                (2048, 4096, 8192), seconds_per_size=4.0,
+                deadline_seconds=150.0)
+        except Exception as exc:  # noqa: BLE001
+            result["mfu_sweep"] = [{"error": f"{type(exc).__name__}: {exc}"}]
         return result
     except Exception as exc:
         record["error"] = f"{type(exc).__name__}: {exc}"

@@ -38,10 +38,15 @@ class CheckResult:
     name: str
     status: str  # ok | warn | fail | skip
     detail: str
+    # Structured payload for --json consumers (e.g. the name-surface
+    # capture runbook harvests unknown_families from the libtpu check
+    # without parsing prose). Absent keys mean "nothing to report".
+    data: dict = dataclasses.field(default_factory=dict)
 
 
-def _result(name: str, status: str, detail: str) -> CheckResult:
-    return CheckResult(name, status, detail)
+def _result(name: str, status: str, detail: str,
+            data: dict | None = None) -> CheckResult:
+    return CheckResult(name, status, detail, data or {})
 
 
 # -- individual probes (each bounded, each returns exactly one result) -------
@@ -149,6 +154,9 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                 f"{len(cache)} chip(s), {len(families)} famil"
                 f"{'y' if len(families) == 1 else 'ies'} via batched fetch, "
                 f"{dialect} dialect{alien_note}",
+                data={"dialect": dialect,
+                      "served_families": sorted(families),
+                      "unknown_families": sorted(alien_names)},
             )
         if alien_names:
             # The port answers, but EVERY family it serves is outside our
@@ -164,6 +172,7 @@ def check_libtpu_port(cfg: Config, port: int) -> CheckResult:
                   "the exporter will be empty until proto/tpumetrics.py "
                   "is re-pinned, or run with --passthrough-unknown on to "
                   "export these as tpu_runtime_passthrough gauges now",
+                data={"unknown_families": sorted(alien_names)},
             )
         if decode_failures:
             return _result(

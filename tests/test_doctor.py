@@ -108,7 +108,7 @@ def test_json_output_shape(tmp_path, capsys):
     assert doc["ready"] is True
     statuses = {c["name"]: c["status"] for c in doc["checks"]}
     assert statuses["poll"] == "ok"
-    assert all(set(c.keys()) == {"name", "status", "detail"}
+    assert all(set(c.keys()) == {"name", "status", "detail", "data"}
                for c in doc["checks"])
 
 
@@ -311,6 +311,11 @@ def test_doctor_names_alien_families():
     assert res.status == "ok"
     assert "ignoring 1 unrecognized family" in res.detail
     assert "tpu.runtime.novel.metric" in res.detail
+    # Structured payload for the capture runbook (--json harvest):
+    # no prose parsing needed.
+    assert res.data["unknown_families"] == ["tpu.runtime.novel.metric"]
+    assert "accelerator_duty_cycle" in res.data["served_families"]
+    assert res.data["dialect"]
 
     with FakeLibtpuServer(num_chips=2) as alien:
         alien.drop_metrics.update(tpumetrics.ALL_METRICS)
@@ -321,6 +326,8 @@ def test_doctor_names_alien_families():
     assert res.status == "fail"
     assert "tpu.v7.dutycycle" in res.detail and "tpu.v7.hbm.used" in res.detail
     assert "different metric-name surface" in res.detail
+    assert res.data["unknown_families"] == [
+        "tpu.v7.dutycycle", "tpu.v7.hbm.used"]
 
 
 def test_embedded_viability_hint(tmp_path, monkeypatch):

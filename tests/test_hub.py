@@ -433,6 +433,53 @@ def test_hub_merges_step_histograms_across_targets(tmp_path):
     assert validate.check(text) == []
 
 
+def test_hub_rollup_dip_policy_reflects_answered_targets(tmp_path):
+    """The documented dip policy: summed gauges drop by a missing
+    worker's share for exactly the refreshes it misses (truthful
+    current view, slice_target_up names the cause), then recover; the
+    cumulative step HISTOGRAM holds its cached contribution instead
+    (a dipping counter would read as a reset). See _add_rollups."""
+    line = ('accelerator_up{{chip="0",worker="{w}",slice="s"}} 1\n'
+            'accelerator_power_watts{{chip="0",worker="{w}",slice="s"}} 100\n'
+            'accelerator_memory_used_bytes'
+            '{{chip="0",worker="{w}",slice="s"}} 1e9\n')
+    paths = []
+    for worker in range(3):
+        path = tmp_path / f"w{worker}.prom"
+        path.write_text(line.format(w=worker))
+        paths.append(path)
+    hub = hub_mod.Hub([str(p) for p in paths])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_power_watts") == [300.0]
+        assert values(text, "slice_memory_used_bytes") == [3e9]
+        assert values(text, "slice_chips") == [3.0]
+        assert values(text, "slice_workers") == [3.0]
+        # Worker 1 misses one refresh: sums dip by its share, the
+        # flag names it, nothing is fabricated.
+        paths[1].rename(tmp_path / "w1.gone")
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_power_watts") == [200.0]
+        assert values(text, "slice_memory_used_bytes") == [2e9]
+        assert values(text, "slice_chips") == [2.0]
+        assert values(text, "slice_workers") == [2.0]
+        ups = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_up"}
+        assert ups[str(paths[1])] == 0.0
+        assert sum(ups.values()) == 2.0
+        # Recovery restores the full sums next refresh.
+        (tmp_path / "w1.gone").rename(paths[1])
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_power_watts") == [300.0]
+        assert values(text, "slice_chips") == [3.0]
+    finally:
+        hub.stop()
+
+
 def test_hub_histogram_empty_worker_disambiguated_by_target(tmp_path):
     # Same rule as _merge_chip_series: two embedded/dev targets whose
     # step histograms carry identical labels with a present-but-empty

@@ -10,6 +10,8 @@ exporters model N hosts faithfully.
 import re
 import urllib.request
 
+import pytest
+
 from kube_gpu_stats_tpu.collectors.composite import TpuCollector
 from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
 from kube_gpu_stats_tpu.collectors.mock import MockCollector
@@ -278,3 +280,117 @@ def test_hub_aggregates_64_real_http_exporters():
         for loop, http in stacks:
             loop.stop()
             http.stop()
+
+
+def test_embedded_to_hub_chain_on_virtual_mesh(tmp_path):
+    """Round-4 verdict item 4: the FULL embedded->hub chain on >=8
+    virtual devices — two child processes each run the sharded train
+    step (data x model parallel over a forced-8-device CPU mesh) under
+    an embedded exporter; a hub merges both into one slice view.
+    Asserts: 8 per-device series sets per worker, the SPMD FLOPs split
+    (global counter / device count) exact per chip, step histograms
+    populated and summed across workers, 16 chips exactly once."""
+    import os
+    import select
+    import subprocess
+    import sys
+    import time
+
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.validate import parse_exposition
+
+    child_src = (tmp_path / "embedded_worker.py")
+    child_src.write_text(
+        "import sys, time\n"
+        "import jax\n"
+        # sitecustomize force-registers the TPU plugin and ignores env;
+        # the config update is what actually pins CPU (conftest rule).
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from kube_gpu_stats_tpu import embedded\n"
+        "from kube_gpu_stats_tpu.loadgen.burn import make_sharded_train_step\n"
+        "exporter = embedded.start(port=0, interval=0.1)\n"
+        "print(exporter.port, flush=True)\n"
+        "mesh, step, params, x = make_sharded_train_step(8)\n"
+        "for _ in range(40):\n"
+        "    t0 = time.perf_counter()\n"
+        "    params, loss = step(params, x)\n"
+        "    jax.block_until_ready(loss)\n"
+        "    exporter.record_step(1, seconds=time.perf_counter() - t0,\n"
+        "                         flops=8e9)\n"
+        "print('DONE', flush=True)\n"
+        "time.sleep(600)\n"
+    )
+    procs = []
+    ports = []
+    try:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for worker in range(2):
+            env = dict(os.environ, KTS_SLICE="v5p-16", KTS_WORKER=str(worker),
+                       KTS_TOPOLOGY="2x2x4",
+                       # Pin the child mesh explicitly: other tests
+                       # (dryrun_multichip(16)) mutate the inherited
+                       # XLA_FLAGS device count in-process.
+                       XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                       # A plain `python file.py` child doesn't get
+                       # pytest's rootdir on sys.path.
+                       PYTHONPATH=repo_root + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(child_src)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True))
+
+        def read_line(proc, timeout):
+            ready, _, _ = select.select([proc.stdout], [], [], timeout)
+            assert ready, "embedded worker never answered (jax init hang?)"
+            return proc.stdout.readline().strip()
+
+        for proc in procs:
+            ports.append(int(read_line(proc, 120.0)))
+        for proc in procs:
+            assert read_line(proc, 180.0) == "DONE"
+        time.sleep(0.4)  # one more poll tick folds the final counters
+
+        targets = [f"http://127.0.0.1:{p}/metrics" for p in ports]
+        per_worker = []
+        import urllib.request
+
+        for url in targets:
+            text = urllib.request.urlopen(url, timeout=10).read().decode()
+            per_worker.append(text)
+        for text in per_worker:
+            series = parse_exposition(text)
+            ups = [(l["chip"], l["worker"]) for n, l, v in series
+                   if n == "accelerator_up"]
+            assert len(ups) == 8  # 8 per-device series sets
+            flops = [v for n, l, v in series
+                     if n == "accelerator_workload_flops_total"]
+            # SPMD split: 40 steps x 8e9 FLOPs / 8 devices, per chip.
+            assert flops == [pytest.approx(40 * 8e9 / 8)] * 8
+            (count,) = [v for n, l, v in series
+                        if n ==
+                        "accelerator_workload_step_duration_seconds_count"]
+            assert count == 40.0
+
+        hub = Hub(targets, fetch_timeout=10.0)
+        try:
+            hub.refresh_once()
+            merged = hub.registry.snapshot().render()
+        finally:
+            hub.stop()
+        pairs = worker_chip_pairs(merged)
+        assert len(pairs) == 16 and len(set(pairs)) == 16
+        assert 'slice_chips{slice="v5p-16"} 16' in merged
+        assert 'slice_workers{slice="v5p-16"} 2' in merged
+        assert "slice_duplicate_series 0" in merged
+        (total,) = [v for n, l, v in parse_exposition(merged)
+                    if n == "accelerator_workload_step_duration_seconds_count"]
+        assert total == 80.0  # both workers' histograms summed
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
