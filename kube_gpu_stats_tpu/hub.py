@@ -44,7 +44,7 @@ from . import schema
 from .registry import (HistogramState, Registry, SnapshotBuilder,
                        contribute_push_stats)
 from .top import Frame, build_frame
-from .validate import fetch_exposition, parse_exposition
+from .validate import bounded_memo, fetch_exposition, parse_exposition
 from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
@@ -138,6 +138,11 @@ class Hub:
         # target would leak a pool worker per refresh (poll.py's
         # stuck-sampler guard, applied to scraping).
         self._outstanding: dict[str, concurrent.futures.Future] = {}
+        # Dedup-key memo: a series' label tuple is identical from
+        # refresh to refresh (only values change), so the per-series
+        # sorted() in _merge_chip_series re-sorts the same few thousand
+        # tuples every cycle. Bounded like validate's label cache.
+        self._key_cache: dict[tuple, tuple] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -190,7 +195,33 @@ class Hub:
         # individual socket operations, so a slow-drip target (headers,
         # then a byte every few seconds) would otherwise wedge the loop
         # forever while each recv stays under the per-op timeout.
+        def fetch_chunk(chunk: list[str], progress: list) -> list[tuple]:
+            # Per-target outcomes appended to a SHARED list as they
+            # complete (GIL-atomic appends): if one member's read hangs,
+            # the deadline handler salvages every outcome produced
+            # before the hang and can identify the hung member (the
+            # first one with no outcome) instead of guarding the whole
+            # chunk. Exceptions caught per member so one bad file
+            # degrades one target, not the chunk.
+            for member in chunk:
+                try:
+                    progress.append((member, *fetch(member), None))
+                except Exception as exc:  # noqa: BLE001 - per-target
+                    progress.append((member, None, None, None, exc))
+            return progress
+
+        # Network targets submit FIRST (they block on sockets; get them
+        # in flight), then local .prom targets in CHUNKS: one pool
+        # wakeup per ~16 files instead of per file (orchestration was
+        # ~half the 64-target refresh wall, measured), while still
+        # running under the pool + deadline so a target on a hung
+        # NFS/FUSE mount wedges one chunk's worth of targets — never
+        # the refresh loop itself.
         futures: list[tuple[str, concurrent.futures.Future]] = []
+        chunk_futures: list[tuple[list[str], list,
+                                  concurrent.futures.Future]] = []
+        fetch_seconds: dict[str, float] = {}
+        local_targets: list[str] = []
         for target in self._targets:
             stuck = self._outstanding.get(target)
             if stuck is not None:
@@ -199,24 +230,47 @@ class Hub:
                     errors.append(f"{target}: previous fetch still running")
                     continue
                 del self._outstanding[target]  # finished late; result stale
-            futures.append((target, self._pool.submit(fetch, target)))
+            if "://" not in target:
+                local_targets.append(target)
+            else:
+                futures.append((target, self._pool.submit(fetch, target)))
+        CHUNK = 16
+        for i in range(0, len(local_targets), CHUNK):
+            chunk = local_targets[i:i + CHUNK]
+            progress: list = []
+            chunk_futures.append(
+                (chunk, progress,
+                 self._pool.submit(fetch_chunk, chunk, progress)))
         # Deadline scales with pool waves: more targets than workers run
         # in batches, and wave N's fetches only START after wave N-1 —
         # a flat 2x budget would mark healthy targets of a >32-worker
         # slice down every refresh just for queueing.
+        # Deadline scales with the pool's critical path: network
+        # fetches run pool-wide (waves of pool_size), while a chunk
+        # SERIALIZES its members on one worker — so the budget must
+        # grant a slow-but-alive filesystem (degraded NFS at ~1 s/read)
+        # one fetch_timeout per chunk member, or healthy targets would
+        # be marked down for queueing behind their chunk-mates. The
+        # budget is a cap, not a wait: healthy refreshes return as the
+        # futures complete.
         waves = max(1, -(-len(futures) // self._pool_size))
-        budget = (waves + 1) * self._fetch_timeout
+        chunk_depth = max((len(c) for c, _, _ in chunk_futures), default=0)
+        budget = (waves + chunk_depth + 1) * self._fetch_timeout
         deadline = time.monotonic() + budget
-        fetch_seconds: dict[str, float] = {}
+
+        def record_success(target: str, series, at: float,
+                           took: float) -> None:
+            parsed.append(series)
+            ats.append(at)
+            names.append(target)
+            reachable[target] = True
+            fetch_seconds[target] = took
+
         for target, future in futures:
             try:
                 series, at, took = future.result(
                     timeout=max(0.0, deadline - time.monotonic()))
-                parsed.append(series)
-                ats.append(at)
-                names.append(target)
-                reachable[target] = True
-                fetch_seconds[target] = took
+                record_success(target, series, at, took)
             except concurrent.futures.TimeoutError:
                 if not future.cancel():
                     self._outstanding[target] = future
@@ -227,6 +281,40 @@ class Hub:
             except Exception as exc:  # noqa: BLE001 - per-target degradation
                 reachable[target] = False
                 errors.append(f"{target}: {exc}")
+        def record_outcomes(outcomes) -> set:
+            seen = set()
+            for member, series, at, took, exc in outcomes:
+                seen.add(member)
+                if exc is not None:
+                    reachable[member] = False
+                    errors.append(f"{member}: {exc}")
+                else:
+                    record_success(member, series, at, took)
+            return seen
+
+        for chunk, progress, future in chunk_futures:
+            try:
+                outcomes = future.result(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except concurrent.futures.TimeoutError:
+                # A hung filesystem read (NFS/FUSE stall, FIFO):
+                # salvage the outcomes produced before the hang, guard
+                # ONLY the hung member (first with no outcome — it owns
+                # the blocked pool thread), and just mark the unstarted
+                # rest down for this refresh: they re-chunk cleanly next
+                # time without the guarded one.
+                seen = record_outcomes(list(progress))
+                hung = next((m for m in chunk if m not in seen), None)
+                if hung is not None and not future.cancel():
+                    self._outstanding[hung] = future
+                for member in chunk:
+                    if member not in seen:
+                        reachable[member] = False
+                        errors.append(
+                            f"{member}: file read stalled past the refresh "
+                            f"deadline ({budget:g}s)")
+                continue
+            record_outcomes(outcomes)
 
         frame = build_frame(parsed, errors, ats, targets=names)
         frame.rates(self._previous)
@@ -437,7 +525,9 @@ class Hub:
                     continue
                 label_tuple = tuple(
                     self._disambiguate_worker(labels, target).items())
-                key = (name, tuple(sorted(label_tuple)))
+                key = (name, bounded_memo(
+                    self._key_cache, label_tuple,
+                    lambda: tuple(sorted(label_tuple))))
                 if key in seen:
                     duplicates += 1
                     continue

@@ -26,6 +26,38 @@ _SERIES_RE = re.compile(
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
+BOUNDED_MEMO_MAX = 65536
+
+
+def bounded_memo(cache: dict, key, compute):
+    """Shared bounded-memo idiom (this parser's label cache, the hub's
+    dedup-key cache): look up, else compute and store; cleared WHOLESALE
+    at the cap — churn that large means the memo isn't helping anyway.
+    GIL-atomic operations only, so concurrent pool threads are safe
+    (worst case both compute, one wins the store)."""
+    value = cache.get(key)
+    if value is None:
+        if len(cache) >= BOUNDED_MEMO_MAX:
+            cache.clear()
+        value = compute()
+        cache[key] = value
+    return value
+
+
+# Label-substring memo: a scrape's label sets are identical from
+# refresh to refresh (only values change), so the hub re-parses the
+# same few thousand strings every cycle — the regex walk was the
+# hottest line of a 64-worker refresh (profiled). The cache stores
+# immutable pairs and hands each caller a FRESH dict (a 10-item dict
+# build is ~10x cheaper than the findall), so downstream mutation
+# can't poison the cache.
+_LABEL_CACHE: dict[str, tuple] = {}
+
+
+def _parse_labels(raw: str) -> dict[str, str]:
+    return dict(bounded_memo(_LABEL_CACHE, raw,
+                             lambda: tuple(_LABEL_RE.findall(raw))))
+
 _RANGES = {
     schema.DUTY_CYCLE.name: (0.0, 100.0),
     schema.TENSORCORE_UTIL.name: (0.0, 100.0),
@@ -56,7 +88,7 @@ def parse_exposition(text: str) -> list[tuple[str, dict[str, str], float]]:
         match = _SERIES_RE.match(line)
         if not match:
             raise ValueError(f"line {lineno}: unparseable series: {line!r}")
-        labels = dict(_LABEL_RE.findall(match.group("labels") or ""))
+        labels = _parse_labels(match.group("labels") or "")
         raw = match.group("value")
         value = {"NaN": float("nan"), "+Inf": float("inf"),
                  "-Inf": float("-inf")}.get(raw)

@@ -433,6 +433,48 @@ def test_hub_merges_step_histograms_across_targets(tmp_path):
     assert validate.check(text) == []
 
 
+def test_hub_hung_file_target_cannot_wedge_refresh(tmp_path):
+    """A .prom target whose read blocks forever (FIFO with no writer —
+    the NFS/FUSE-stall stand-in) must cost only itself: the chunk's
+    earlier members' results are salvaged, refresh_once returns within
+    the deadline, the hung member is guarded (its blocked pool thread
+    is never doubled), and the healthy target stays up on the NEXT
+    refresh too (it re-chunks without the guarded one)."""
+    import os
+
+    good = tmp_path / "a_good.prom"
+    good.write_text('accelerator_up{chip="0",worker="0",slice="s"} 1\n')
+    fifo = tmp_path / "z_hung.prom"
+    os.mkfifo(fifo)
+    hub = hub_mod.Hub([str(good), str(fifo)], fetch_timeout=0.3)
+    try:
+        start = time.monotonic()
+        hub.refresh_once()
+        assert time.monotonic() - start < 5  # budget ~0.6s, not forever
+        text = hub.registry.snapshot().render()
+        ups = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_up"}
+        # good sorts before the fifo, so its outcome is salvaged from
+        # the hung chunk's progress list.
+        assert ups[str(good)] == 1.0
+        assert ups[str(fifo)] == 0.0
+        # Next refresh: the hung member is guarded ("still running"),
+        # the healthy one re-chunks cleanly and stays up.
+        start = time.monotonic()
+        frame = hub.refresh_once()
+        assert time.monotonic() - start < 5
+        text = hub.registry.snapshot().render()
+        ups = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_up"}
+        assert ups[str(good)] == 1.0
+        assert ups[str(fifo)] == 0.0
+        assert any("still running" in e for e in frame.errors)
+    finally:
+        hub.stop()
+
+
 def test_hub_rollup_dip_policy_reflects_answered_targets(tmp_path):
     """The documented dip policy: summed gauges drop by a missing
     worker's share for exactly the refreshes it misses (truthful
