@@ -45,7 +45,8 @@ def chip_overrides():
 
 
 def timeseries(title, targets, unit, grid, *, per_chip=True, max_val=None,
-               thresholds=None, description=""):
+               thresholds=None, description="", palette=False,
+               right_axis_regex=None):
     field_defaults = {
         "custom": {
             "lineWidth": 2,
@@ -56,7 +57,10 @@ def timeseries(title, targets, unit, grid, *, per_chip=True, max_val=None,
         },
         "unit": unit,
         "min": 0,
-        "color": {"mode": "fixed", "fixedColor": SEQUENTIAL_HUE},
+        # palette: multi-entity panels (workers, targets) cycle the
+        # classic palette; single-quantity panels keep the fixed hue.
+        "color": ({"mode": "palette-classic"} if palette
+                  else {"mode": "fixed", "fixedColor": SEQUENTIAL_HUE}),
     }
     if max_val is not None:
         field_defaults["max"] = max_val
@@ -75,7 +79,16 @@ def timeseries(title, targets, unit, grid, *, per_chip=True, max_val=None,
         "gridPos": grid,
         "fieldConfig": {
             "defaults": field_defaults,
-            "overrides": chip_overrides() if per_chip else [],
+            "overrides": (chip_overrides() if per_chip else [])
+            + ([{
+                # Series matching the regex ride a right-hand 0-1 axis
+                # so a ratio isn't flattened under a large left axis.
+                "matcher": {"id": "byRegexp", "options": right_axis_regex},
+                "properties": [
+                    {"id": "custom.axisPlacement", "value": "right"},
+                    {"id": "max", "value": 1},
+                ],
+            }] if right_axis_regex else []),
         },
         "options": {
             "tooltip": {"mode": "multi", "sort": "desc"},
@@ -340,6 +353,63 @@ panels = [
         description="Peak HBM allocated since runtime init — the OOM-"
                     "debugging companion to HBM used; a drop marks a "
                     "runtime restart."),
+    timeseries(
+        "Workload MFU (% of peak bf16)",
+        [(f'accelerator_workload_model_flops_utilization{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}} (live)'),
+         (f'100 * rate(accelerator_workload_flops_total{{{FILTERS}}}'
+          f'[$__rate_interval]) / '
+          f'accelerator_peak_flops_per_second{{{FILTERS}}}',
+          'w{{worker}} chip {{chip}} (rate)')],
+        "percent", {"x": 12, "y": 76, "w": 12, "h": 8}, per_chip=False,
+        palette=True,
+        description="Model FLOPs utilization from the embedded step hook: "
+                    "the live in-process gauge, and the same ratio "
+                    "recomputed Prometheus-side from the FLOPs counter "
+                    "(the two should agree; divergence means scrape gaps "
+                    "or a device-kind with no peak table entry)."),
+
+    # Row 11 — slice hub rollups (absent unless a hub is deployed).
+    timeseries(
+        "Slice workers: observed vs expected (hub)",
+        # slice_workers carries a slice label (filter it); expected and
+        # target_up are deliberately unlabeled/target-only — unfiltered.
+        [('slice_workers{slice=~"$slice"}', '{{slice}} observed'),
+         ('slice_workers_expected', 'expected'),
+         ('sum(1 - slice_target_up)', 'targets down')],
+        "short", {"x": 0, "y": 84, "w": 12, "h": 8}, per_chip=False,
+        palette=True,
+        description="From the kube-tpu-stats hub aggregation service. "
+                    "Observed workers per slice against --expect-workers; "
+                    "a persistent gap is a missing DaemonSet pod or dead "
+                    "worker VM (see slice_target_up for which)."),
+    timeseries(
+        "Per-worker step rate + straggler ratio (hub)",
+        [('slice_worker_steps_per_second{slice=~"$slice"}',
+          '{{slice}} w{{worker}}'),
+         ('slice_straggler_ratio{slice=~"$slice"}',
+          '{{slice}} straggler ratio')],
+        "short", {"x": 12, "y": 84, "w": 12, "h": 8}, per_chip=False,
+        palette=True, right_axis_regex=".*straggler.*",
+        description="slice_worker_steps_per_second per worker — in an "
+                    "SPMD job the slowest worker gates the slice. "
+                    "slice_straggler_ratio (min/max, right-friendly 0-1) "
+                    "near 1.0 = balanced; a sagging worker drags it "
+                    "down."),
+    timeseries(
+        "Hub health: per-target fetch time + refresh p99",
+        [('slice_target_fetch_seconds', 'fetch {{target}}'),
+         ('histogram_quantile(0.99, sum(rate('
+          'hub_refresh_duration_seconds_bucket[5m])) by (le))',
+          'refresh p99')],
+        "s", {"x": 0, "y": 92, "w": 12, "h": 8}, per_chip=False,
+        palette=True,
+        description="From the kube-tpu-stats hub. slice_target_fetch_"
+                    "seconds shows a worker VM answering slowly long "
+                    "before it times out into slice_target_up 0; "
+                    "hub_refresh_duration_seconds p99 is the whole "
+                    "refresh (concurrent scrape of every target + merge "
+                    "+ rollups)."),
 ]
 
 dashboard = {

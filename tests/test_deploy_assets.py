@@ -91,6 +91,28 @@ def known_exposition_names():
     return names
 
 
+def test_dashboard_json_matches_builder():
+    """dashboard.json must be exactly what build_dashboard.py generates
+    — hand-edits to the JSON get destroyed by the next `make dashboard`
+    (round-5 finding: four round-4 panels lived only in the JSON and a
+    rebuild silently deleted them). Edit the builder, regenerate,
+    commit both."""
+    import runpy
+    import shutil
+    import tempfile
+
+    src = DEPLOY / "grafana" / "build_dashboard.py"
+    committed = (DEPLOY / "grafana" / "dashboard.json").read_text()
+    with tempfile.TemporaryDirectory() as tmp:
+        build = pathlib.Path(tmp) / "build_dashboard.py"
+        shutil.copy(src, build)
+        runpy.run_path(str(build), run_name="__main__")
+        rebuilt = (pathlib.Path(tmp) / "dashboard.json").read_text()
+    assert rebuilt == committed, (
+        "dashboard.json drifted from build_dashboard.py output; run "
+        "`make dashboard` and commit, or port hand-edits into the builder")
+
+
 def test_dashboard_references_only_real_metrics():
     board = json.loads((DEPLOY / "grafana" / "dashboard.json").read_text())
     known = known_exposition_names()
