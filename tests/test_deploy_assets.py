@@ -129,22 +129,23 @@ def test_dashboard_references_only_real_metrics():
 
 def test_dashboard_chip_colors_fixed_order_not_cycled():
     board = json.loads((DEPLOY / "grafana" / "dashboard.json").read_text())
-    per_chip_panels = [
-        p for p in board["panels"]
-        if p.get("fieldConfig", {}).get("overrides")
-    ]
+
+    def color_overrides(panel):
+        # Overrides also carry non-color properties now (right-hand
+        # axis placement); only chip-color overrides are compared.
+        return [
+            o["properties"][0]["value"]["fixedColor"]
+            for o in panel.get("fieldConfig", {}).get("overrides", [])
+            if o["properties"][0]["id"] == "color"
+        ]
+
+    per_chip_panels = [p for p in board["panels"] if color_overrides(p)]
     assert per_chip_panels
-    first = [
-        o["properties"][0]["value"]["fixedColor"]
-        for o in per_chip_panels[0]["fieldConfig"]["overrides"]
-    ]
+    first = color_overrides(per_chip_panels[0])
     assert len(first) == len(set(first)) == 8
     for panel in per_chip_panels[1:]:
-        colors = [
-            o["properties"][0]["value"]["fixedColor"]
-            for o in panel["fieldConfig"]["overrides"]
-        ]
-        assert colors == first  # same chip -> same color on every panel
+        # same chip -> same color on every panel
+        assert color_overrides(panel) == first
 
 
 def test_dashboard_template_vars():
