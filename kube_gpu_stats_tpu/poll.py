@@ -120,6 +120,13 @@ class PollLoop:
         # Retained last-known MEMORY_TOTAL per device so a stale tick keeps
         # capacity gauges stable instead of dropping series.
         self._last_totals: dict[str, float] = {}
+        # Runtime-restart detection: uptime going backwards between
+        # ticks means the runtime reinitialized the chip (the genre's
+        # XID-ish "device bounced" event). The derived counter makes it
+        # alertable with increase() — the uptime gauge alone needs a
+        # magic `< X` threshold that misses restarts between scrapes.
+        self._last_uptime: dict[str, float] = {}
+        self._restarts: dict[str, int] = {}
         # Label-list cache: attribution changes on the C3 refresh cadence
         # (~10 s), not per tick, so the per-device label list is identical
         # tick over tick. Keyed by the attribution items so a pod churn
@@ -189,6 +196,8 @@ class PollLoop:
             if device_id not in alive:
                 del self._last_totals[device_id]
                 self._rates.forget_device(device_id)
+                self._last_uptime.pop(device_id, None)
+                self._restarts.pop(device_id, None)
         for device_id in [d for d in self._outstanding if d not in alive]:
             self._outstanding.pop(device_id).cancel()
 
@@ -396,6 +405,15 @@ class PollLoop:
                 total = self._last_totals.get(dev.device_id)
                 if total is not None:
                     builder.add(schema.MEMORY_TOTAL, total, base)
+                # The restart counter stays emitted through an outage
+                # (like MEMORY_TOTAL): if the series vanished while
+                # polls failed, every point inside the increase() window
+                # after recovery would already carry the bump and the
+                # AcceleratorRuntimeRestarted alert would miss exactly
+                # the crash-then-restart it exists for.
+                builder.add(schema.RUNTIME_RESTARTS,
+                            float(self._restarts.get(dev.device_id, 0)),
+                            base)
                 continue
             builder.add(schema.DEVICE_UP, 1.0, base)
             if schema.MEMORY_TOTAL.name not in sample.values:
@@ -418,6 +436,19 @@ class PollLoop:
                 builder.add(spec, value, base)
                 if name == schema.MEMORY_TOTAL.name:
                     self._last_totals[dev.device_id] = value
+                elif name == schema.UPTIME.name:
+                    prev = self._last_uptime.get(dev.device_id)
+                    # 1 s tolerance: clock jitter between the runtime's
+                    # uptime source and our tick must not fake a bounce.
+                    if prev is not None and value < prev - 1.0:
+                        self._restarts[dev.device_id] = (
+                            self._restarts.get(dev.device_id, 0) + 1)
+                    self._last_uptime[dev.device_id] = value
+            # Unconditional, born at 0 (increase() discipline): the
+            # series must exist before the first restart or the alert
+            # misses a burst that starts the series at N.
+            builder.add(schema.RUNTIME_RESTARTS,
+                        float(self._restarts.get(dev.device_id, 0)), base)
             ici_items = sorted(sample.ici_counters.items())
             if len(ici_items) > self._MAX_ICI_LINKS:
                 # Same threat class as the passthrough family cap: a

@@ -139,6 +139,16 @@ UPTIME = MetricSpec(
     "Seconds since the accelerator runtime (re)initialized this chip. A "
     "reset to a small value flags a runtime restart between scrapes.",
 )
+RUNTIME_RESTARTS = MetricSpec(
+    "accelerator_runtime_restarts_total",
+    MetricType.COUNTER,
+    "Runtime restarts observed for this chip since the exporter started "
+    "(uptime moved backwards between polls — the exporter-derived "
+    "'device bounced' event). Alert with increase(); the uptime gauge "
+    "alone misses a restart that completes between scrapes. Counts "
+    "observations, so restarts during exporter downtime are invisible; "
+    "0 from first sight so increase() sees the first one.",
+)
 DEVICE_UP = MetricSpec(
     "accelerator_up",
     MetricType.GAUGE,
@@ -246,6 +256,7 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     COLLECTIVE_OPS,
     DCN_LATENCY,
     UPTIME,
+    RUNTIME_RESTARTS,
     DEVICE_UP,
     PROCESS_OPEN,
     WORKLOAD_STEPS,

@@ -58,6 +58,9 @@ class FakeLibtpuServer:
         # per-chip value): models a runtime speaking a different/newer
         # metric-name surface (unknown-family visibility tests).
         self.extra_metrics: dict[str, float] = {}
+        # Served uptime baseline; a "restarted runtime" fake sets a
+        # smaller value so exporters can observe uptime move backwards.
+        self.uptime_base = 7200.0
         self.requests: list[str] = []
         self._ici_fetches = 0
         self._lock = threading.Lock()
@@ -111,7 +114,7 @@ class FakeLibtpuServer:
         if name == tpumetrics.COLLECTIVES:
             return float(100 * (chip + 1))
         if name == tpumetrics.UPTIME:
-            return float(7200 + chip)
+            return float(self.uptime_base + chip)
         if name == tpumetrics.DCN_LATENCY_P50:
             return 0.001 * (chip + 1)
         if name == tpumetrics.DCN_LATENCY_P90:

@@ -49,11 +49,19 @@ def test_libtpu_restart_counters_reset_then_recover(tmp_path):
     assert schema.DUTY_CYCLE.name not in names
     assert schema.POWER.name in names
 
+    # Pre-restart: the derived restart counter exists, born at 0.
+    restart_values = [
+        s.value for s in reg.snapshot().series
+        if s.spec.name == schema.RUNTIME_RESTARTS.name
+    ]
+    assert restart_values == [0.0, 0.0]
+
     # Runtime restarts: counters restart near zero (reset semantics). The
     # channel reconnect + reset-interval drop may take a couple of ticks;
     # the invariant is that NO tick ever emits a negative/spiked rate and
     # rates return within a few ticks.
     server2 = FakeLibtpuServer(num_chips=2, port=port).start()
+    server2.uptime_base = 3.0  # fresh runtime: uptime moved backwards
     try:
         bandwidths = []
         for attempt in range(10):
@@ -69,6 +77,14 @@ def test_libtpu_restart_counters_reset_then_recover(tmp_path):
         assert len(bandwidths) == 12, f"rates never recovered: {bandwidths}"
         snap = reg.snapshot()
         assert schema.DUTY_CYCLE.name in {s.spec.name for s in snap.series}
+        # The uptime drop (7200 -> 3) was observed exactly once per chip:
+        # accelerator_runtime_restarts_total makes the bounce alertable
+        # with increase() instead of a magic uptime threshold.
+        restart_values = [
+            s.value for s in snap.series
+            if s.spec.name == schema.RUNTIME_RESTARTS.name
+        ]
+        assert restart_values == [1.0, 1.0]
     finally:
         server2.stop()
         loop.stop()

@@ -113,6 +113,10 @@ def test_memory_total_retained_when_stale():
     snap = reg.snapshot()
     assert get(snap, "accelerator_up")[0][1] == 0.0
     assert get(snap, "accelerator_memory_total_bytes")[0][1] == 1024.0
+    # The restart counter stays emitted through the outage too: a
+    # vanishing counter series would blind increase() exactly across a
+    # crash-then-restart window (see _build_snapshot).
+    assert get(snap, "accelerator_runtime_restarts_total")[0][1] == 0.0
     loop.stop()
 
 
