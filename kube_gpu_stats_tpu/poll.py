@@ -127,6 +127,13 @@ class PollLoop:
         # magic `< X` threshold that misses restarts between scrapes.
         self._last_uptime: dict[str, float] = {}
         self._restarts: dict[str, int] = {}
+        # Energy integration (DCGM total_energy_consumption analog):
+        # joules += watts * tick-gap, rectangle rule at the poll
+        # cadence. Per-device last-seen timestamp, not the loop
+        # interval: a stale tick must not integrate power it didn't
+        # observe.
+        self._energy: dict[str, float] = {}
+        self._last_power_at: dict[str, float] = {}
         # Label-list cache: attribution changes on the C3 refresh cadence
         # (~10 s), not per tick, so the per-device label list is identical
         # tick over tick. Keyed by the attribution items so a pod churn
@@ -192,12 +199,17 @@ class PollLoop:
         # lists rather than reason about which survived (off hot path).
         self._label_cache.clear()
         alive = {dev.device_id for dev in self._devices}
-        for device_id in list(self._last_totals):
-            if device_id not in alive:
-                del self._last_totals[device_id]
-                self._rates.forget_device(device_id)
-                self._last_uptime.pop(device_id, None)
-                self._restarts.pop(device_id, None)
+        # Purge over the UNION of per-device state: a device may exist
+        # in one dict and not another (a degraded-for-life chip carries
+        # power/energy but never MEMORY_TOTAL), and a renumbered chip
+        # must never inherit another chip's counter baseline.
+        state_dicts = (self._last_totals, self._last_uptime,
+                       self._restarts, self._energy, self._last_power_at)
+        known = set().union(*(d.keys() for d in state_dicts))
+        for device_id in known - alive:
+            self._rates.forget_device(device_id)
+            for state in state_dicts:
+                state.pop(device_id, None)
         for device_id in [d for d in self._outstanding if d not in alive]:
             self._outstanding.pop(device_id).cancel()
 
@@ -414,6 +426,11 @@ class PollLoop:
                 builder.add(schema.RUNTIME_RESTARTS,
                             float(self._restarts.get(dev.device_id, 0)),
                             base)
+                # Same outage-persistence as the restart counter: a
+                # counter series must not vanish and blind increase().
+                if dev.device_id in self._last_power_at:
+                    builder.add(schema.ENERGY,
+                                self._energy.get(dev.device_id, 0.0), base)
                 continue
             builder.add(schema.DEVICE_UP, 1.0, base)
             if schema.MEMORY_TOTAL.name not in sample.values:
@@ -444,11 +461,36 @@ class PollLoop:
                         self._restarts[dev.device_id] = (
                             self._restarts.get(dev.device_id, 0) + 1)
                     self._last_uptime[dev.device_id] = value
+                elif name == schema.POWER.name:
+                    # Guard the integrand like the ICI/passthrough caps
+                    # guard series counts: one negative sample must not
+                    # un-monotone the counter (Prometheus reads a dip
+                    # as a reset -> phantom spike) and one NaN must not
+                    # poison every subsequent += forever.
+                    if not (value >= 0.0 and value != float("inf")):
+                        continue
+                    prev_at = self._last_power_at.get(dev.device_id)
+                    if prev_at is not None and now > prev_at:
+                        # Cap the gap at 10 ticks: after a long outage,
+                        # integrating the whole gap at the just-observed
+                        # power would fabricate energy the chip may not
+                        # have drawn.
+                        gap = min(now - prev_at, 10 * self._interval)
+                        self._energy[dev.device_id] = (
+                            self._energy.get(dev.device_id, 0.0)
+                            + value * gap)
+                    self._last_power_at[dev.device_id] = now
             # Unconditional, born at 0 (increase() discipline): the
             # series must exist before the first restart or the alert
             # misses a burst that starts the series at N.
             builder.add(schema.RUNTIME_RESTARTS,
                         float(self._restarts.get(dev.device_id, 0)), base)
+            # Energy appears once power has (born at 0 on the first
+            # power observation — never for collectors with no power
+            # source, e.g. a runtime-only backend without sysfs hwmon).
+            if dev.device_id in self._last_power_at:
+                builder.add(schema.ENERGY,
+                            self._energy.get(dev.device_id, 0.0), base)
             ici_items = sorted(sample.ici_counters.items())
             if len(ici_items) > self._MAX_ICI_LINKS:
                 # Same threat class as the passthrough family cap: a

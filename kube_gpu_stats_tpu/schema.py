@@ -100,6 +100,16 @@ POWER = MetricSpec(
     MetricType.GAUGE,
     "Instantaneous chip power draw, in watts.",
 )
+ENERGY = MetricSpec(
+    "accelerator_energy_joules_total",
+    MetricType.COUNTER,
+    "Energy consumed by this chip since the exporter started, "
+    "integrated from the power gauge at the poll cadence (rectangle "
+    "rule over ~1 s ticks — an approximation; the DCGM "
+    "total_energy_consumption analog). Joined with pod attribution "
+    "labels this is per-workload energy accounting. Resets when the "
+    "exporter restarts; use increase()/rate() across restarts.",
+)
 TEMPERATURE = MetricSpec(
     "accelerator_temperature_celsius",
     MetricType.GAUGE,
@@ -250,6 +260,7 @@ PER_DEVICE_METRICS: tuple[MetricSpec, ...] = (
     MEMORY_PEAK,
     MEMORY_BANDWIDTH_UTIL,
     POWER,
+    ENERGY,
     TEMPERATURE,
     ICI_BANDWIDTH,
     ICI_TRAFFIC_TOTAL,

@@ -120,6 +120,64 @@ def test_memory_total_retained_when_stale():
     loop.stop()
 
 
+def test_energy_integrates_power_over_ticks():
+    import time
+
+    class PowerCollector(Collector):
+        name = "p"
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):
+            return Sample(device, {schema.POWER.name: 100.0})
+
+    reg = Registry()
+    loop = PollLoop(PowerCollector(), reg, deadline=5.0)
+    loop.tick()
+    # Born at 0 on the first power observation (no fabricated back-fill).
+    assert get(reg.snapshot(), "accelerator_energy_joules_total")[0][1] == 0.0
+    time.sleep(0.05)
+    loop.tick()
+    time.sleep(0.05)
+    loop.tick()
+    [(labels, joules)] = get(reg.snapshot(),
+                             "accelerator_energy_joules_total")
+    # 100 W over two observed gaps of >= 0.05 s each: energy is the
+    # rectangle-rule integral, monotone and in a sane band.
+    assert 100 * 0.08 <= joules <= 100 * 5.0
+    loop.stop()
+
+
+def test_energy_survives_garbage_power_samples():
+    import time
+
+    readings = iter([100.0, float("nan"), -5.0, float("inf"), 100.0])
+
+    class GarbageCollector(Collector):
+        name = "g"
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):
+            return Sample(device, {schema.POWER.name: next(readings)})
+
+    reg = Registry()
+    loop = PollLoop(GarbageCollector(), reg, deadline=5.0)
+    for _ in range(5):
+        loop.tick()
+        time.sleep(0.02)
+    [(labels, joules)] = get(reg.snapshot(),
+                             "accelerator_energy_joules_total")
+    # NaN must not poison the sum forever, a negative sample must not
+    # un-monotone the counter, inf must not make it inf: only the two
+    # valid 100 W observations integrate.
+    assert joules == joules  # not NaN
+    assert 0.0 <= joules < 100 * 5.0
+    loop.stop()
+
+
 class StaticAttribution:
     def __init__(self, mapping):
         self.mapping = mapping
@@ -220,17 +278,25 @@ def test_rediscover_purges_vanished_device_state():
             ]
 
         def sample(self, device):
-            return Sample(device, {schema.MEMORY_TOTAL.name: 7.0},
-                          ici_counters={"x0": 100})
+            # Power WITHOUT MEMORY_TOTAL for device 1: a degraded-for-
+            # life chip carries energy state but no retained total —
+            # the purge must key on the union of state dicts, or a
+            # renumbered chip inherits the dead one's energy baseline.
+            values = {schema.POWER.name: 100.0}
+            if device.device_id == "0":
+                values[schema.MEMORY_TOTAL.name] = 7.0
+            return Sample(device, values, ici_counters={"x0": 100})
 
     col = ShrinkingCollector()
     reg = Registry()
     loop = PollLoop(col, reg, deadline=5.0)
     loop.tick()
-    assert "1" in loop._last_totals
+    assert "0" in loop._last_totals and "1" not in loop._last_totals
+    assert "1" in loop._last_power_at
     col.n = 1
     loop.rediscover()
-    assert "1" not in loop._last_totals
+    assert "1" not in loop._last_power_at
+    assert "1" not in loop._energy
     assert ("1", "x0") not in loop._rates._last
     assert ("0", "x0") in loop._rates._last
     loop.stop()
