@@ -46,7 +46,7 @@ def chip_overrides():
 
 def timeseries(title, targets, unit, grid, *, per_chip=True, max_val=None,
                thresholds=None, description="", palette=False,
-               right_axis_regex=None):
+               right_axis_regex=None, right_axis_max=None):
     field_defaults = {
         "custom": {
             "lineWidth": 2,
@@ -81,13 +81,14 @@ def timeseries(title, targets, unit, grid, *, per_chip=True, max_val=None,
             "defaults": field_defaults,
             "overrides": (chip_overrides() if per_chip else [])
             + ([{
-                # Series matching the regex ride a right-hand 0-1 axis
-                # so a ratio isn't flattened under a large left axis.
+                # Series matching the regex ride a right-hand axis so a
+                # small-magnitude series isn't flattened under a large
+                # left axis (ratio under steps/s, watts under counts).
                 "matcher": {"id": "byRegexp", "options": right_axis_regex},
                 "properties": [
                     {"id": "custom.axisPlacement", "value": "right"},
-                    {"id": "max", "value": 1},
-                ],
+                ] + ([{"id": "max", "value": right_axis_max}]
+                     if right_axis_max is not None else []),
             }] if right_axis_regex else []),
         },
         "options": {
@@ -390,12 +391,28 @@ panels = [
          ('slice_straggler_ratio{slice=~"$slice"}',
           '{{slice}} straggler ratio')],
         "short", {"x": 12, "y": 84, "w": 12, "h": 8}, per_chip=False,
-        palette=True, right_axis_regex=".*straggler.*",
+        palette=True, right_axis_regex=".*straggler.*", right_axis_max=1,
         description="slice_worker_steps_per_second per worker — in an "
                     "SPMD job the slowest worker gates the slice. "
                     "slice_straggler_ratio (min/max, right-friendly 0-1) "
                     "near 1.0 = balanced; a sagging worker drags it "
                     "down."),
+    timeseries(
+        "Runtime restarts + energy draw",
+        [(f'increase(accelerator_runtime_restarts_total{{{FILTERS}}}[10m])',
+          'w{{worker}} chip {{chip}} restarts (10m)'),
+         (f'sum(rate(accelerator_energy_joules_total{{{FILTERS}}}[5m]))',
+          'avg power from energy (W)')],
+        "short", {"x": 12, "y": 92, "w": 12, "h": 8}, per_chip=False,
+        palette=True, right_axis_regex=".*power from energy.*",
+        description="accelerator_runtime_restarts_total increase = the "
+                    "runtime bounced under a chip (uptime moved "
+                    "backwards between exporter polls; the "
+                    "AcceleratorRuntimeRestarted alert). rate() of the "
+                    "integrated energy counter recomputes average watts "
+                    "— should track the power panel; divergence means "
+                    "scrape gaps. Joined with pod labels the energy "
+                    "counter is per-workload accounting."),
     timeseries(
         "Hub health: per-target fetch time + refresh p99",
         [('slice_target_fetch_seconds', 'fetch {{target}}'),

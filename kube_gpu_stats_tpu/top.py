@@ -46,6 +46,10 @@ _GAUGES = {
 _COUNTERS = {
     "steps": schema.WORKLOAD_STEPS.name,
     "busy": schema.WORKLOAD_BUSY_SECONDS.name,
+    # JSON-only raw totals (the 80-col table stays as is): energy for
+    # per-chip/per-pod accounting, restarts for bounce triage.
+    "energy": schema.ENERGY.name,
+    "restarts": schema.RUNTIME_RESTARTS.name,
 }
 
 
@@ -81,6 +85,8 @@ class ChipRow:
     # Raw counter values; rates derive from frame-over-frame deltas.
     steps_total: float | None = None
     busy_total: float | None = None
+    energy_total: float | None = None  # JSON only (joules since start)
+    restarts_total: float | None = None  # JSON only (runtime bounces)
     # Filled by Frame.rates():
     steps_per_s: float | None = None
     busy_pct: float | None = None

@@ -88,6 +88,11 @@ def test_render_json_frame():
     assert chip["chip"] == "0" and chip["slice"] == "v5p-16"
     assert chip["up"] == 1.0 and "steps_per_s" in chip
     assert "mem_peak" in chip
+    # Round-5 counters ride the JSON view: energy for accounting,
+    # restarts for bounce triage (mock exports power, so energy exists;
+    # one tick in, its integral is still 0).
+    assert chip["energy_total"] is not None
+    assert chip["restarts_total"] == 0.0
 
 
 def test_process_open_counts_holders_excluding_overflow_fold():
