@@ -149,6 +149,39 @@ def test_energy_integrates_power_over_ticks():
     loop.stop()
 
 
+def test_energy_gap_capped_after_outage():
+    import time
+
+    class OutageCollector(Collector):
+        name = "o"
+        fail = False
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):
+            if self.fail:
+                raise CollectorError("down")
+            return Sample(device, {schema.POWER.name: 100.0})
+
+    col = OutageCollector()
+    reg = Registry()
+    loop = PollLoop(col, reg, interval=0.01, deadline=5.0)
+    loop.tick()  # baseline timestamp
+    col.fail = True
+    loop.tick()
+    time.sleep(0.3)  # outage much longer than 10 intervals (0.1 s cap)
+    col.fail = False
+    loop.tick()
+    [(labels, joules)] = get(reg.snapshot(),
+                             "accelerator_energy_joules_total")
+    # Integrating the whole 0.3 s gap at 100 W would be 30 J of energy
+    # the chip may never have drawn; the 10-interval cap bounds it.
+    assert joules <= 100 * (10 * 0.01) * 1.5  # cap + generous slack
+    assert joules > 0.0
+    loop.stop()
+
+
 def test_energy_survives_garbage_power_samples():
     import time
 
