@@ -62,6 +62,30 @@ def _matmul_chain(depth: int):
     return burn
 
 
+def all_device_burn_inputs(size: int):
+    """Shared input construction for the all-device burns (XLA chain
+    and pallas shard_map — burn parity means they must differ ONLY in
+    who schedules the tiles): 1-D mesh over the local devices, x of
+    shape (n*size, size) bf16 sharded along dim 0, w replicated.
+    Returns (mesh, x_sharding, x, w, n)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.local_devices()
+    n = max(1, len(devices))
+    mesh = Mesh(np.asarray(devices), ("d",))
+    x_sharding = NamedSharding(mesh, P("d", None))
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (n * size, size),
+                          dtype=jax.numpy.bfloat16), x_sharding)
+    w = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (size, size),
+                          dtype=jax.numpy.bfloat16),
+        NamedSharding(mesh, P(None, None)))
+    return mesh, x_sharding, x, w, n
+
+
 def make_all_device_burn(size: int, depth: int):
     """Burn step that drives EVERY local device: x is (n*size, size)
     sharded along dim 0 over a 1-D mesh, w replicated — each device runs
@@ -76,25 +100,9 @@ def make_all_device_burn(size: int, depth: int):
     is how the old caveat "burn drives only the default device" died).
     """
     import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    devices = jax.local_devices()
-    n = max(1, len(devices))
-    mesh = Mesh(np.asarray(devices), ("d",))
-    x_sharding = NamedSharding(mesh, P("d", None))
-    w_sharding = NamedSharding(mesh, P(None, None))
-    burn = _matmul_chain(depth)
-    key = jax.random.PRNGKey(0)
-    x = jax.device_put(
-        jax.random.normal(key, (n * size, size), dtype=jnp.bfloat16),
-        x_sharding)
-    w = jax.device_put(
-        jax.random.normal(jax.random.PRNGKey(1), (size, size),
-                          dtype=jnp.bfloat16),
-        w_sharding)
-    step = jax.jit(burn, donate_argnums=(0,),
+    _, x_sharding, x, w, n = all_device_burn_inputs(size)
+    step = jax.jit(_matmul_chain(depth), donate_argnums=(0,),
                    out_shardings=x_sharding)
     flops_per_step = 2 * depth * n * size**3
     return step, x, w, n, flops_per_step
@@ -167,8 +175,9 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
              result: dict | None = None) -> int:
     """Drive ALL local chips for `seconds`; returns steps executed.
     kernel: "xla" (sharded jnp matmul chain over every local device) or
-    "pallas" (hand-tiled MXU kernel, default device only — a pallas
-    kernel is per-device by construction).
+    "pallas" (the hand-tiled MXU kernel composed with shard_map over
+    the same 1-D mesh — also every local device; ``depth`` applies to
+    the XLA chain only).
     step_hook(n, seconds=dt, flops=f): called at each materialization
     point with the steps since the last call, their combined wall time,
     and their matmul FLOPs scaled to record_step's WORKLOAD-GLOBAL
@@ -190,12 +199,9 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
     import jax.numpy as jnp
 
     if kernel == "pallas":
-        from .pallas_burn import pallas_entry_fn
+        from .pallas_burn import pallas_all_device_burn
 
-        fn, (x, w) = pallas_entry_fn(size)
-        step = jax.jit(fn)
-        n_devices = 1
-        flops_per_step = 2 * size**3
+        step, x, w, n_devices, flops_per_step = pallas_all_device_burn(size)
     elif kernel == "xla":
         step, x, w, n_devices, flops_per_step = \
             make_all_device_burn(size, depth)
@@ -287,7 +293,9 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
             "tflops_per_s": flops_per_step * rate / 1e12,
             "devices": n_devices,
             "size": size,
-            "depth": depth,
+            # depth shapes the XLA chain only; a pallas row carrying it
+            # would fake comparability between the two kernels' rows.
+            "depth": depth if kernel == "xla" else None,
         })
     return steps
 

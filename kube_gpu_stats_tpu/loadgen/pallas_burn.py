@@ -84,19 +84,46 @@ def pallas_matmul(a, b, *, tile_m: int = 256, tile_n: int = 256,
     return _build(m, n, k, tile_m, tile_n, tile_k, interpret)(a, b)
 
 
-def pallas_entry_fn(size: int = 1024):
-    """(fn, example_args) for a Pallas-kernel burn step, mirroring
-    burn.entry_fn's contract."""
+def pallas_all_device_burn(size: int = 1024):
+    """Pallas burn over EVERY local device: the per-device tiled kernel
+    composed with shard_map over a 1-D mesh — x is (n*size, size)
+    sharded along dim 0, w replicated, each device runs the hand-tiled
+    MXU kernel on its own block with no collectives. One jit dispatch
+    drives the whole host, mirroring burn.make_all_device_burn so the
+    two kernels differ only in who schedules the tiles (XLA vs Pallas).
+
+    Returns (jitted_step, x, w, n_devices, flops_per_step); the step
+    donates x.
+    """
     import jax
     import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .burn import all_device_burn_inputs
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.8 jax: experimental spelling
+        from jax.experimental.shard_map import shard_map
 
     interpret = not _is_tpu()
+    mesh, x_sharding, x, w, n = all_device_burn_inputs(size)
 
-    def burn(x, w):
+    def local_step(x, w):
         acc = pallas_matmul(x, w, interpret=interpret)
         return jnp.tanh(acc).astype(jnp.bfloat16)
 
-    key = jax.random.PRNGKey(0)
-    x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
-    w = jax.random.normal(jax.random.PRNGKey(1), (size, size), dtype=jnp.bfloat16)
-    return burn, (x, w)
+    # check_vma/check_rep off: pallas_call's out_shape carries no
+    # varying-across-mesh annotation, and this map is embarrassingly
+    # parallel (no collectives to get replication wrong about).
+    try:
+        sharded = shard_map(local_step, mesh=mesh,
+                            in_specs=(P("d", None), P(None, None)),
+                            out_specs=P("d", None), check_vma=False)
+    except TypeError:  # older jax spells the flag check_rep
+        sharded = shard_map(local_step, mesh=mesh,
+                            in_specs=(P("d", None), P(None, None)),
+                            out_specs=P("d", None), check_rep=False)
+    step = jax.jit(sharded, donate_argnums=(0,), out_shardings=x_sharding)
+    return step, x, w, n, 2 * n * size**3
+
+

@@ -6,7 +6,8 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
-from kube_gpu_stats_tpu.loadgen.pallas_burn import pallas_entry_fn, pallas_matmul
+from kube_gpu_stats_tpu.loadgen.pallas_burn import (pallas_all_device_burn,
+                                                    pallas_matmul)
 
 
 def test_matches_reference_matmul():
@@ -46,11 +47,11 @@ def test_shape_validation():
         )
 
 
-def test_entry_fn_contract():
-    fn, (x, w) = pallas_entry_fn(size=256)
-    out = jax.jit(fn)(x, w)
+def test_all_device_burn_step_contract():
+    step, x, w, n, flops = pallas_all_device_burn(size=256)
+    out = step(x, w)
     out.block_until_ready()
-    assert out.shape == x.shape
+    assert out.shape == x.shape == (n * 256, 256)
     assert out.dtype == jnp.bfloat16
 
 
@@ -71,3 +72,31 @@ def test_unknown_kernel_rejected():
 
     with pytest.raises(ValueError, match="unknown kernel"):
         run_burn(seconds=0.1, size=128, kernel="Pallas")
+
+
+def test_pallas_all_device_burn_drives_the_mesh():
+    """The pallas kernel composed with shard_map covers every local
+    device (parity with burn.make_all_device_burn): sharded input,
+    donated buffer, per-device blocks, correct FLOPs accounting."""
+    import jax
+    import jax.numpy as jnp
+
+    step, x, w, n, flops = pallas_all_device_burn(size=128)
+    assert n == len(jax.local_devices()) == 8
+    assert x.shape == (8 * 128, 128)
+    assert flops == 2 * 8 * 128**3
+    assert not x.sharding.is_fully_replicated
+    out = step(x, w)
+    assert out.shape == (8 * 128, 128)
+    assert out.sharding.device_set == set(jax.local_devices())
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_run_burn_pallas_uses_all_devices():
+    from kube_gpu_stats_tpu.loadgen.burn import run_burn
+
+    result = {}
+    steps = run_burn(seconds=0.2, size=128, report_every=1e9,
+                     kernel="pallas", result=result)
+    assert steps > 0
+    assert result["devices"] == 8
