@@ -462,6 +462,11 @@ class Hub:
                             sum(duties) / len(duties), labels)
                 builder.add(schema.HUB_DUTY_MIN, min(duties), labels)
                 builder.add(schema.HUB_DUTY_MAX, max(duties), labels)
+            mfus = [r.mfu for r in rows if r.mfu is not None]
+            if mfus:
+                builder.add(schema.HUB_MFU_MEAN,
+                            sum(mfus) / len(mfus), labels)
+                builder.add(schema.HUB_MFU_MIN, min(mfus), labels)
             used = [r.mem_used for r in rows if r.mem_used is not None]
             if used:
                 builder.add(schema.HUB_MEMORY_USED, sum(used), labels)

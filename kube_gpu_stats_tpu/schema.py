@@ -390,6 +390,23 @@ HUB_DUTY_MAX = MetricSpec(
     "Maximum per-chip duty cycle across the slice.",
     extra_labels=("slice",),
 )
+HUB_MFU_MEAN = MetricSpec(
+    "slice_workload_mfu_mean",
+    MetricType.GAUGE,
+    "Mean accelerator_workload_model_flops_utilization over every "
+    "observed chip of the slice reporting it (embedded-mode workloads) "
+    "— is the whole slice doing useful FLOPs, not just drawing power. "
+    "Absent until some chip reports MFU.",
+    extra_labels=("slice",),
+)
+HUB_MFU_MIN = MetricSpec(
+    "slice_workload_mfu_min",
+    MetricType.GAUGE,
+    "Minimum per-chip MFU across the slice — in SPMD every chip should "
+    "do the same useful work, so a low outlier is the goodput analog "
+    "of the duty-cycle straggler.",
+    extra_labels=("slice",),
+)
 HUB_MEMORY_USED = MetricSpec(
     "slice_memory_used_bytes",
     MetricType.GAUGE,
@@ -452,6 +469,8 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_WORKERS,
     HUB_DUTY_MEAN,
     HUB_DUTY_MIN,
+    HUB_MFU_MEAN,
+    HUB_MFU_MIN,
     HUB_DUTY_MAX,
     HUB_MEMORY_USED,
     HUB_MEMORY_TOTAL,

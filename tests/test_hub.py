@@ -433,6 +433,32 @@ def test_hub_merges_step_histograms_across_targets(tmp_path):
     assert validate.check(text) == []
 
 
+def test_hub_mfu_rollup_mean_and_min(tmp_path):
+    # Slice-level MFU: mean + min over the chips reporting the gauge
+    # (embedded workloads) — the goodput analog of the duty rollups.
+    line = ('accelerator_workload_model_flops_utilization'
+            '{{chip="0",worker="{w}",slice="s"}} {v}\n')
+    (tmp_path / "a.prom").write_text(
+        line.format(w="0", v="40"))
+    (tmp_path / "b.prom").write_text(
+        line.format(w="1", v="20"))
+    # A worker with no MFU (no embedded hook) must not poison the mean.
+    (tmp_path / "c.prom").write_text(
+        'accelerator_up{chip="0",worker="2",slice="s"} 1\n')
+    hub = hub_mod.Hub([str(tmp_path / n) for n in
+                       ("a.prom", "b.prom", "c.prom")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(text, "slice_workload_mfu_mean") == [30.0]
+    assert values(text, "slice_workload_mfu_min") == [20.0]
+    # (Fixture lines are minimal, not full-label contract expositions;
+    # the new slice_* families themselves are contract-checked by the
+    # validate slice branch in other hub tests.)
+
+
 def test_hub_hung_file_target_cannot_wedge_refresh(tmp_path):
     """A .prom target whose read blocks forever (FIFO with no writer —
     the NFS/FUSE-stall stand-in) must cost only itself: the chunk's
