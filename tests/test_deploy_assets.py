@@ -229,10 +229,13 @@ def test_systemd_installer_references_shipped_files():
 
 
 def test_podmonitor_matches_daemonset():
-    """The optional prometheus-operator PodMonitor must select the
-    DaemonSet's pods and scrape the port the container actually names."""
-    (pm,) = load_yaml_docs("podmonitor.yaml")
-    assert pm["kind"] == "PodMonitor"
+    """The optional prometheus-operator PodMonitors must select the
+    pods they claim (DaemonSet and hub) and scrape the port the
+    container actually names."""
+    docs = load_yaml_docs("podmonitor.yaml")
+    assert [d["kind"] for d in docs] == ["PodMonitor", "PodMonitor"]
+    by_name = {d["metadata"]["name"]: d for d in docs}
+    pm = by_name["kube-tpu-stats"]
     (ds,) = [d for d in load_yaml_docs("daemonset.yaml") if d["kind"] == "DaemonSet"]
     pod_labels = ds["spec"]["template"]["metadata"]["labels"]
     for key, value in pm["spec"]["selector"]["matchLabels"].items():
@@ -243,6 +246,21 @@ def test_podmonitor_matches_daemonset():
         assert endpoint["port"] in port_names
         assert endpoint.get("path", "/metrics") == "/metrics"
     assert pm["metadata"]["namespace"] == ds["metadata"]["namespace"]
+
+    # Hub PodMonitor: pod-direct scraping so the zero-target NotReady
+    # hub stays visible to SliceHubNoTargets.
+    hub_pm = by_name["kube-tpu-stats-hub"]
+    (dep,) = [d for d in load_yaml_docs("hub.yaml")
+              if d["kind"] == "Deployment"]
+    hub_labels = dep["spec"]["template"]["metadata"]["labels"]
+    for key, value in hub_pm["spec"]["selector"]["matchLabels"].items():
+        assert hub_labels.get(key) == value
+    hub_ports = {p["name"] for c in
+                 dep["spec"]["template"]["spec"]["containers"]
+                 for p in c["ports"]}
+    for endpoint in hub_pm["spec"]["podMetricsEndpoints"]:
+        assert endpoint["port"] in hub_ports
+    assert hub_pm["metadata"]["namespace"] == dep["metadata"]["namespace"]
 
 
 def test_hub_manifest_shape():

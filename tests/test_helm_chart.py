@@ -87,6 +87,33 @@ def test_conditional_templates_are_gated():
     assert texts["service.yaml"].startswith("{{- if .Values.service.enabled }}")
 
 
+def test_hub_servicemonitor_gated_and_selector_matches_service():
+    """The hub ServiceMonitor block in templates/hub.yaml must be gated
+    on BOTH hub.enabled and serviceMonitor.enabled, and its selector
+    must match the hub Service's labels — with no helm binary in CI, a
+    renamed -hub label suffix would otherwise ship a ServiceMonitor
+    that selects nothing and silently kills hub scraping."""
+    text = template_texts()["hub.yaml"]
+    assert ("{{- if and .Values.hub.enabled .Values.serviceMonitor.enabled }}"
+            in text)
+    sm_block = text.split("kind: ServiceMonitor", 1)[1]
+    svc_block = text.split("kind: Service\n", 1)[1].split("---", 1)[0]
+    lines = sm_block.splitlines()
+    start = next(i for i, l in enumerate(lines)
+                 if l.strip() == "matchLabels:")
+    indent = len(lines[start]) - len(lines[start].lstrip())
+    selector = []
+    for line in lines[start + 1:]:
+        if not line.strip() or len(line) - len(line.lstrip()) <= indent:
+            break
+        selector.append(line.strip())
+    assert selector, "ServiceMonitor has no matchLabels entries"
+    for entry in selector:
+        # Every matchLabels line must appear verbatim in the Service's
+        # label set (same templated name/instance expressions).
+        assert entry in svc_block, entry
+
+
 def test_template_control_structures_balance():
     """No helm binary in CI: at least pin that every {{ if }}/{{ range }}
     has a matching {{ end }} per template (the typo class that makes
