@@ -16,7 +16,8 @@ import time
 
 def make_ici_burn(n_devices: int, *, shard_mb: float = 4.0, steps: int = 8):
     """Returns (jitted_fn, x) where fn rotates x's shards `steps` times
-    around an n_devices ring, adding 1 each hop."""
+    around an n_devices ring, adding 1 each hop. fn DONATES x (the ring
+    rotates in place): rebind x = fn(x); the passed-in buffer dies."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -45,7 +46,10 @@ def make_ici_burn(n_devices: int, *, shard_mb: float = 4.0, steps: int = 8):
     sharded = shard_map(
         ring, mesh=mesh, in_specs=P("ring"), out_specs=P("ring")
     )
-    fn = jax.jit(sharded)
+    # Donation: the ring rotates in place (same shape/sharding out), so
+    # the burn loop never allocates per round — the same discipline as
+    # the MXU burn (callers must rebind x = fn(x); the old buffer dies).
+    fn = jax.jit(sharded, donate_argnums=(0,))
     x = jax.device_put(
         jnp.arange(total, dtype=jnp.float32).reshape(n_devices, -1).reshape(total),
         NamedSharding(mesh, P("ring")),
@@ -61,7 +65,8 @@ def run_ici_burn(seconds: float = 10.0, *, n_devices: int | None = None,
 
     n = n_devices or len(jax.devices())
     fn, x = make_ici_burn(n, shard_mb=shard_mb, steps=steps)
-    float(jnp.sum(fn(x)))  # compile + one real execution
+    x = fn(x)  # compile + one real execution (x is donated: rebind)
+    float(jnp.sum(x))
     rounds = 0
     start = time.monotonic()
     last_report = start

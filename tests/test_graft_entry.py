@@ -52,8 +52,8 @@ def test_ici_ring_burn_numerics():
 
     n, steps = 8, 3
     fn, x = make_ici_burn(n, shard_mb=0.001, steps=steps)
+    original = np.asarray(x).reshape(n, -1)  # before fn donates x
     out = np.asarray(fn(x))
-    original = np.asarray(x).reshape(n, -1)
     rotated = np.roll(original, steps, axis=0) + steps
     np.testing.assert_allclose(out.reshape(n, -1), rotated)
 
