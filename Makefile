@@ -1,11 +1,11 @@
-.PHONY: test bench native dashboard golden clean run-mock ci
+.PHONY: test bench native dashboard golden clean run-mock ci chaos
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
 # (helm render when the binary exists, the static chart tests always),
 # wheel + console-script smoke in a scratch venv (no index needed).
 ci: native
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not chaos'
 	python tools/check_no_nvml.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
@@ -28,6 +28,12 @@ ci: native
 
 test:
 	python -m pytest tests/ -q
+
+# Fault-injection / soak suite (the `chaos` pytest marker): libtpu
+# restarts, kubelet socket loss, hung collectors, supervisor respawns.
+# Runs everything `make ci` deliberately skips for speed.
+chaos: native
+	python -m pytest tests/ -q -m chaos
 
 bench: native
 	python bench.py

@@ -25,6 +25,7 @@ import logging
 from typing import Mapping, Protocol
 
 from ..collectors import Device
+from ..resilience import CLOSED
 from ..workers import PeriodicRefresher
 
 log = logging.getLogger(__name__)
@@ -91,7 +92,14 @@ class CachedAttribution(PeriodicRefresher):
     """Background-refreshed map; RPC-free lookups (E4 off the hot path).
 
     On refresh failure the previous map is retained and a warning logged —
-    stale attribution beats a crash-looping DaemonSet (SURVEY.md §5)."""
+    stale attribution beats a crash-looping DaemonSet (SURVEY.md §5).
+    Once the failure is persistent — the source's kubelet circuit
+    breaker is open, or ``_STALE_AFTER`` consecutive refreshes failed —
+    :attr:`stale` turns True and the poll loop labels the served
+    (last-good) mapping ``stale="true"`` so dashboards can tell cached
+    truth from live truth."""
+
+    _STALE_AFTER = 3
 
     def __init__(self, source: AllocationSource,
                  refresh_interval: float = 10.0) -> None:
@@ -99,6 +107,28 @@ class CachedAttribution(PeriodicRefresher):
         self._source = source
         self._map: dict[str, Labels] = {}
         self._allocatable: dict[str, int] = {}
+
+    @property
+    def breaker(self):
+        """The source's kubelet circuit breaker, when it has one (None
+        for checkpoint-only sources, or auto mode before the
+        PodResources client exists)."""
+        return getattr(self._source, "breaker", None)
+
+    @property
+    def stale(self) -> bool:
+        """True when lookups serve a retained last-good mapping under a
+        persistent source outage (never True before any map exists —
+        empty lookups aren't stale, they're empty). A succeeding
+        refresh is never stale, whatever the kubelet breaker says:
+        auto mode's checkpoint fallback serves FRESH (UID-labeled) data
+        while the PodResources socket is still down."""
+        if not self._map or self.consecutive_failures == 0:
+            return False
+        breaker = self.breaker
+        if breaker is not None and breaker.state != CLOSED:
+            return True
+        return self.consecutive_failures >= self._STALE_AFTER
 
     def refresh_once(self) -> None:
         try:
@@ -162,6 +192,13 @@ class AutoSource:
         from .checkpoint import CheckpointSource
 
         self._checkpoint = CheckpointSource(checkpoint_path)
+
+    @property
+    def breaker(self):
+        """The PodResources client's kubelet breaker once that client
+        exists (lazy — auto mode may never create it)."""
+        return (self._podresources.breaker
+                if self._podresources is not None else None)
 
     def _active(self) -> AllocationSource:
         import os

@@ -1,6 +1,15 @@
 """PodResources v1 allocation source — kubelet unix-socket gRPC client
 (SURVEY.md §3 E4: List() on its own cadence, crossing the node<->kubelet
-boundary)."""
+boundary).
+
+The socket is guarded by a shared circuit breaker (resilience.py,
+component name "kubelet"): a kubelet that is persistently gone is
+refused fast — no 5 s RPC deadline paid per refresh cycle — while
+:class:`~..attribution.CachedAttribution` keeps serving the last-good
+pod↔device mapping (labeled stale once the breaker is open). The
+recovery probe IS the next fetch, so attribution re-labels fresh within
+one refresh cycle of the socket returning.
+"""
 
 from __future__ import annotations
 
@@ -8,10 +17,12 @@ import grpc
 
 from . import RESOURCE_NAMES, Labels, index_allocations
 from ..proto import podresources as pb
+from ..resilience import CLOSED, BreakerOpenError, CircuitBreaker
 
 
 class PodResourcesSource:
-    def __init__(self, socket_path: str, rpc_timeout: float = 5.0) -> None:
+    def __init__(self, socket_path: str, rpc_timeout: float = 5.0,
+                 breaker: CircuitBreaker | None = None) -> None:
         self._channel = grpc.insecure_channel(
             f"unix://{socket_path}",
             options=[("grpc.enable_http_proxy", 0)],
@@ -27,10 +38,22 @@ class PodResourcesSource:
             response_deserializer=lambda b: b,
         )
         self._timeout = rpc_timeout
+        # Recovery on the attribution cadence: the open breaker admits a
+        # probe after ~one refresh interval's worth of seconds, so a
+        # returned kubelet is picked up on the next cycle, not minutes
+        # later.
+        self.breaker = breaker or CircuitBreaker(
+            "kubelet", failure_threshold=3, recovery_time=10.0)
 
     def fetch(self) -> dict[str, Labels]:
-        raw = self._list(pb.encode_list_request(), timeout=self._timeout)
-        pods = pb.decode_list_response(raw)
+        self.breaker.guard()
+        try:
+            raw = self._list(pb.encode_list_request(), timeout=self._timeout)
+            pods = pb.decode_list_response(raw)
+        except Exception as exc:
+            self.breaker.record_failure(exc)
+            raise
+        self.breaker.record_success()
         allocations: list[tuple[str, Labels]] = []
         for pod in pods:
             for container in pod.containers:
@@ -49,7 +72,15 @@ class PodResourcesSource:
     def fetch_allocatable(self) -> dict[str, int]:
         """Per-resource allocatable device counts (GetAllocatableResources;
         kubelet >= 1.23). Used as a self-metric cross-check against local
-        discovery — not on the poll hot path."""
+        discovery — not on the poll hot path. A non-closed breaker
+        refuses it fast WITHOUT consuming the recovery probe (the probe
+        slot belongs to List(), which records its outcome); its own
+        outcome does NOT feed the breaker either — older kubelets lack
+        the method, a capability gap, not a socket outage."""
+        if self.breaker.state != CLOSED:
+            raise BreakerOpenError(
+                f"kubelet breaker {self.breaker.state}; skipping "
+                f"GetAllocatableResources")
         raw = self._allocatable(b"", timeout=self._timeout)
         counts: dict[str, int] = {}
         for devices in pb.decode_allocatable_response(raw):

@@ -58,6 +58,12 @@ class Sample:
     collective_ops: int | None = None
     raw_values: Mapping[tuple[str, str], float] = dataclasses.field(
         default_factory=dict)
+    # Persistent-degradation marker (resilience.py): the runtime side of
+    # this sample is known-down (its circuit breaker is open), so what's
+    # here is environment-only. The poll loop flips accelerator_up to 0
+    # and labels the surviving gauges stale="true" instead of letting
+    # the chip look merely "runtime-metrics-free".
+    stale: bool = False
 
 
 class CollectorError(RuntimeError):

@@ -16,6 +16,7 @@ from typing import Mapping, Sequence
 from . import Collector, CollectorError, Device, Sample
 from .libtpu import LibtpuClient, LibtpuCollector
 from .sysfs import SysfsCollector
+from ..resilience import BreakerOpenError
 
 log = logging.getLogger(__name__)
 
@@ -122,7 +123,21 @@ class TpuCollector(Collector):
             ici_counters=ici,
             collective_ops=collectives,
             raw_values=raw,
+            # Escalated staleness (resilience.py): the runtime's circuit
+            # breaker is OPEN — persistently down, not a blink. The env
+            # values are real, but the chip is no longer "up" and its
+            # gauges ride a stale="true" label downstream. A not-ready
+            # tick consults the breaker too: during an outage the
+            # half-open recovery probe overruns the tick budget, and
+            # that tick must stay stale, not flap the chip back to up.
+            stale=(isinstance(runtime_err, BreakerOpenError)
+                   or (not runtime_ready
+                       and self._libtpu.device_persistently_down(device))),
         )
+
+    def breakers(self):
+        """Per-port runtime breakers (supervisor/doctor resilience)."""
+        return self._libtpu.breakers()
 
     def close(self) -> None:
         self._libtpu.close()

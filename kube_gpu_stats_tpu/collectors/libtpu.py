@@ -28,8 +28,16 @@ import grpc
 from . import Collector, CollectorError, Device, Sample
 from .. import schema, topology
 from ..proto import tpumetrics
+from ..resilience import BreakerOpenError, CircuitBreaker, HALF_OPEN, OPEN
 
 log = logging.getLogger(__name__)
+
+
+class RuntimeBreakerOpen(CollectorError, BreakerOpenError):
+    """Every libtpu port's circuit breaker is open: the runtime is
+    persistently down, not blinking. The composite collector maps this
+    to a STALE sample (accelerator_up 0, env gauges labeled
+    stale="true") instead of the transient env-only degradation."""
 
 # gRPC statuses that are a capability answer ("this runtime doesn't have
 # that") rather than an outage. Load-bearing in two places: the collector's
@@ -193,11 +201,37 @@ class LibtpuClient:
     are queried in parallel (multi-process runtimes serve disjoint chip
     sets per port; one wedged process must cost one rpc_timeout, not N)."""
 
+    # Deadline for a breaker's half-open recovery probe: must cover a
+    # full TCP+HTTP/2 (re)connect, not just an answer on a warm channel.
+    PROBE_RPC_TIMEOUT = 0.5
+
     def __init__(self, addr: str = "127.0.0.1",
                  ports: Sequence[int] = (8431,),
-                 rpc_timeout: float = 0.040) -> None:
+                 rpc_timeout: float = 0.040,
+                 breaker_recovery_time: float = 1.0,
+                 breaker_failure_threshold: int = 3,
+                 breaker_min_span: float = 2.0) -> None:
         self._rpc_timeout = rpc_timeout
         self.ports = tuple(ports)
+        # Per-port circuit breakers at the transport layer: a port that
+        # keeps failing is refused fast (no RPC, no rpc_timeout spent on
+        # it) until the recovery probe; capability answers
+        # (UNIMPLEMENTED/NOT_FOUND/INVALID_ARGUMENT) count as SUCCESS —
+        # the port is answering, it just lacks the family. Recovery is
+        # ~one tick so a restarted runtime is repolled within two ticks
+        # (SURVEY.md §5 elastic recovery at 1 Hz). The failure streak
+        # must also SPAN ~two ticks (min span): doctor's back-to-back
+        # diagnostic ticks, or a per-metric fan-out racking up one
+        # failure per family in one tick, must not read as a persistent
+        # outage.
+        self.breakers: dict[int, CircuitBreaker] = {
+            port: CircuitBreaker(
+                f"libtpu:{port}",
+                failure_threshold=breaker_failure_threshold,
+                recovery_time=breaker_recovery_time,
+                min_failure_span=breaker_min_span)
+            for port in ports
+        }
         # port -> tpumetrics.FLAT/NESTED, latched on the first successfully
         # scanned response from that port (a runtime never switches
         # dialects mid-life; doctor and logs report this for diagnosis).
@@ -259,17 +293,69 @@ class LibtpuClient:
         must cost one rpc_timeout, not N); per-port (response, error).
         Results are in ``self.ports`` order. Dialect latching happens in
         the decode/ingest paths via :meth:`note_dialect` — they run the
-        structural scan anyway, so no second pre-pass here."""
+        structural scan anyway, so no second pre-pass here.
 
-        def call(method):
+        Each port's circuit breaker gates its RPC: an open breaker
+        refuses fast with :class:`~..resilience.BreakerOpenError` (no
+        rpc_timeout spent on a known-dead port; the per-metric fan-out
+        used to pay ~N timeouts per tick against a dead process).
+        Transport outcomes feed the breaker; capability-rejection
+        statuses count as success — the port IS answering."""
+
+        def call(pair):
+            port, method = pair
+            breaker = self.breakers[port]
+            if not breaker.allow():
+                return None, BreakerOpenError(
+                    f"libtpu port {port} circuit open "
+                    f"({breaker.describe()})")
+            timeout = self._rpc_timeout
+            wait_for_ready = False
+            if breaker.state == HALF_OPEN:
+                # Recovery probe: the channel's connection is torn down
+                # after an outage, and re-establishing it takes longer
+                # than the 40 ms hot-path deadline — a probe failing on
+                # its own deadline would re-open the breaker forever.
+                # Probes run off the tick's critical path (the batched
+                # fetch is async; the tick degrades either way), so give
+                # the probe a connection-sized deadline and let gRPC
+                # wait for the channel instead of failing fast.
+                timeout = max(timeout, self.PROBE_RPC_TIMEOUT)
+                wait_for_ready = True
             try:
-                return method(request, timeout=self._rpc_timeout), None
+                response = method(request, timeout=timeout,
+                                  wait_for_ready=wait_for_ready)
             except grpc.RpcError as exc:
+                if exc.code() in REJECTED_STATUS:
+                    breaker.record_success()
+                else:
+                    breaker.record_failure(exc)
                 return None, exc
+            except Exception as exc:  # noqa: BLE001 - an admitted call
+                # MUST record an outcome, whatever raised — an
+                # unrecorded half-open probe would otherwise hold the
+                # probe slot until the breaker's reclaim window.
+                breaker.record_failure(exc)
+                return None, exc
+            breaker.record_success()
+            return response, None
 
+        pairs = list(zip(self.ports, self._methods))
         if self._port_pool is not None:
-            return list(self._port_pool.map(call, self._methods))
-        return [call(m) for m in self._methods]
+            return list(self._port_pool.map(call, pairs))
+        return [call(pair) for pair in pairs]
+
+    def breakers_by_name(self) -> dict[str, CircuitBreaker]:
+        """``{"libtpu:<port>": breaker}`` for the supervisor/doctor
+        resilience surfaces."""
+        return {f"libtpu:{port}": breaker
+                for port, breaker in self.breakers.items()}
+
+    def all_breakers_open(self) -> bool:
+        """True when every port's breaker is OPEN — the runtime is
+        persistently down, not blinking (staleness escalation)."""
+        return bool(self.breakers) and all(
+            breaker.state == OPEN for breaker in self.breakers.values())
 
     def note_dialect(self, port: int, dialect: str, raw: bytes) -> None:
         """Record the dialect a port's response decoded under (callers:
@@ -405,6 +491,11 @@ class LibtpuCollector(Collector):
             self._ingest_response = _load_wirefast() or ingest_response_py
         self._lock = threading.Lock()
         self._cache: dict[int, dict] = {}
+        # Last-known port -> device-id set from the batched fetch (empty
+        # for per-metric-only runtimes, which carry no port attribution):
+        # lets staleness escalate per DEVICE — "the port serving this
+        # chip is open" — instead of only when every port is down.
+        self._port_devices: dict[int, set[int]] = {}
         self._cache_error: CollectorError | None = CollectorError(
             "no libtpu fetch has completed yet"
         )
@@ -544,17 +635,27 @@ class LibtpuCollector(Collector):
             )
             return all(code in _REJECTED for code in codes)
 
+        port_devices_seen: dict[int, set[int]] = {}
         if self._batched is not False:
             raws, port_errors = self._client.get_raw_with_errors("")
             decode_error: Exception | None = None
             for port, raw in raws:
                 try:
+                    # Per-port scratch, then merge: same all-or-nothing
+                    # semantics, plus it records WHICH port serves which
+                    # device ids — the per-device staleness escalation
+                    # needs that to tell "this chip's port is open" from
+                    # "some other port is open" on multi-port runtimes.
+                    port_cache: dict[int, dict] = {}
                     report = self._ingest_response(
-                        raw, cache, self._client.port_dialects.get(port)
+                        raw, port_cache, self._client.port_dialects.get(port)
                     )
                     self._client.note_dialect(port, report.dialect, raw)
                     if report.unknown:
                         self._note_unknown(port, report)
+                    _merge_cache(port_cache, cache)
+                    if port_cache:
+                        port_devices_seen[port] = set(port_cache)
                 except (ValueError, OverflowError) as exc:
                     # ValueError: different schema / garbled port;
                     # OverflowError: int(inf) on a counter metric.
@@ -654,6 +755,11 @@ class LibtpuCollector(Collector):
             else:
                 first_error = first_error or batch_rejected
         with self._lock:
+            # Last-KNOWN port->devices map: entries for ports that failed
+            # this tick are retained — remembering which chips a
+            # now-dead port used to serve is exactly what the staleness
+            # escalation needs.
+            self._port_devices.update(port_devices_seen)
             if cache:
                 self._cache = cache
                 self._cache_error = None
@@ -680,9 +786,27 @@ class LibtpuCollector(Collector):
         with self._lock:
             error = self._cache_error
             entry = self._cache.get(device.index)
+            device_ports = [
+                port for port, devices in self._port_devices.items()
+                if device.index in devices
+            ]
         if error is not None:
+            if self._ports_open(device_ports):
+                # Persistent outage of this device's port(s), not a
+                # blink: escalate so the composite marks the chip STALE
+                # (up 0, env gauges labeled) instead of quietly serving
+                # env-only forever.
+                raise RuntimeBreakerOpen(str(error))
             raise error
         if entry is None:
+            if device_ports and self._ports_open(device_ports):
+                # Multi-port runtime, partial outage: OTHER ports filled
+                # the cache, but every port known to serve THIS chip has
+                # an open breaker — per-device staleness, same contract
+                # as the all-ports-down case.
+                raise RuntimeBreakerOpen(
+                    f"chip {device.index}: its libtpu port's circuit is "
+                    f"open ({', '.join(map(str, device_ports))})")
             raise CollectorError(
                 f"libtpu reported no metrics for chip {device.index}"
             )
@@ -697,6 +821,41 @@ class LibtpuCollector(Collector):
             collective_ops=entry["collectives"],
             raw_values=entry.get("raw") or {},
         )
+
+    def _ports_open(self, device_ports: Sequence[int]) -> bool:
+        """Is the runtime persistently down FOR THESE PORTS? Every named
+        port's breaker OPEN; with no port attribution (per-metric-only
+        runtimes never fill the map), fall back to all-ports-open."""
+        breakers = self._client.breakers
+        if not device_ports:
+            return self._client.all_breakers_open()
+        return all(breakers[port].state == OPEN
+                   for port in device_ports if port in breakers)
+
+    def device_persistently_down(self, device: Device) -> bool:
+        """Is this device inside a persistent runtime outage right now —
+        its port's breaker OPEN, or HALF_OPEN with the recovery probe
+        still unresolved? The composite consults this for ticks whose
+        degradation reason is 'fetch not ready': during an outage the
+        half-open probe blocks up to PROBE_RPC_TIMEOUT, overrunning the
+        50 ms tick budget — without this check those probe ticks would
+        flap accelerator_up back to 1 (and drop the stale labels) once
+        per recovery window for the whole outage."""
+        with self._lock:
+            device_ports = [
+                port for port, devices in self._port_devices.items()
+                if device.index in devices
+            ]
+        breakers = self._client.breakers
+        candidates = ([breakers[port] for port in device_ports
+                       if port in breakers]
+                      or list(breakers.values()))
+        return bool(candidates) and all(
+            breaker.state in (OPEN, HALF_OPEN) for breaker in candidates)
+
+    def breakers(self) -> Mapping[str, "CircuitBreaker"]:
+        """Per-port circuit breakers (supervisor/doctor resilience)."""
+        return self._client.breakers_by_name()
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

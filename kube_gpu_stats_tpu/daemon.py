@@ -21,6 +21,7 @@ from .exposition import (MetricsServer, PushgatewayPusher, RenderStats,
 from .poll import AttributionProvider, NullAttribution, PollLoop
 from .procopen import DeviceProcessWatcher
 from .registry import Registry
+from .supervisor import Supervisor
 from .workers import PeriodicRefresher
 
 log = logging.getLogger(__name__)
@@ -166,8 +167,8 @@ class BackendUpgradeWatcher(PeriodicRefresher):
             if new is not None:
                 new.close()
             # Modest backoff cap: a workload can start any time, so keep
-            # probing at most ~3x the base cadence (PeriodicRefresher
-            # scales the wait by 1 + consecutive_failures).
+            # probing at most ~4x the base cadence (PeriodicRefresher's
+            # shared BackoffPolicy doubles the wait per failure).
             self.consecutive_failures = min(self.consecutive_failures + 1, 2)
             return
         log.info("auto backend: %s now present; upgrading from %s",
@@ -190,6 +191,17 @@ class Daemon:
         self.render_stats = RenderStats()
         self.collector = build_collector(cfg)
         self.attribution = build_attribution(cfg)
+        # Crash-only supervisor (supervisor.py): owns liveness/hang
+        # detection and restart-with-backoff for every worker thread,
+        # and aggregates circuit-breaker state from the I/O edges into
+        # the kts_* self-metrics and /healthz reasons. Breakers are
+        # late-bound providers: the collector's swap on a backend
+        # upgrade, and the attribution source's lazy PodResources
+        # client, both resolve at read time.
+        self.supervisor = Supervisor(
+            check_interval=max(0.1, min(1.0, cfg.interval)))
+        self.supervisor.register_breaker_provider(self._collector_breakers)
+        self.supervisor.register_breaker_provider(self._attribution_breakers)
         # Per-process device holders (accelerator_process_open): the lazy
         # paths_fn closes over self.poll, which exists before the watcher's
         # first refresh (start()).
@@ -217,7 +229,18 @@ class Daemon:
             process_openers=self.procwatch.lookup if self.procwatch else None,
             push_stats=self._push_stats,
             render_stats=self.render_stats.contribute,
+            health_stats=self.supervisor.contribute,
+            heartbeat=self.supervisor.beater("poll"),
         )
+        # Hung-tick watchdog threshold: same formula as healthz_max_age
+        # (a few missed intervals; floor for tiny test intervals), so the
+        # supervisor respawns the loop BEFORE the liveness probe would
+        # kill the whole pod for the same hang.
+        self.supervisor.register(
+            "poll", is_alive=self.poll.thread_alive,
+            restart=self.poll.respawn,
+            heartbeat_timeout=max(5.0, cfg.interval * 5),
+            breaker_prefixes=("libtpu",))
         self.server = MetricsServer(
             self.registry, cfg.listen_host, cfg.listen_port,
             # A few missed intervals = unhealthy (floor for tiny test
@@ -230,6 +253,7 @@ class Daemon:
             auth_username=cfg.auth_username,
             auth_password_sha256=cfg.auth_password_sha256,
             render_stats=self.render_stats,
+            health_provider=self.supervisor.health_report,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir,
@@ -265,6 +289,18 @@ class Daemon:
                 render_stats=self.render_stats,
             )
 
+    def _collector_breakers(self):
+        """Current collector's circuit breakers (late-bound: survives
+        the auto-mode backend-upgrade swap)."""
+        fn = getattr(self.collector, "breakers", None)
+        return fn() if callable(fn) else {}
+
+    def _attribution_breakers(self):
+        """The attribution source's kubelet breaker, once it exists
+        (auto mode creates the PodResources client lazily)."""
+        breaker = getattr(self.attribution, "breaker", None)
+        return {"kubelet": breaker} if breaker is not None else {}
+
     def _push_stats(self) -> dict[str, dict[str, int]]:
         """Shipping-health counters for the collector_push_* self metrics.
         Wired into the poll loop at construction; the senders are created
@@ -297,6 +333,29 @@ class Daemon:
         if self.upgrade_watcher:
             self.upgrade_watcher.start()
         self.poll.start()
+        # Liveness-only supervision for the auxiliary worker threads
+        # (their loops already contain exceptions, so death is a bug —
+        # the crash-only answer is a fresh thread over retained state).
+        # The upgrade watcher is deliberately NOT supervised: it retires
+        # itself by design once the TPU backend latches, and a restart
+        # would resurrect it. Registered here, started components only;
+        # the supervisor starts last so no watchdog pass can see a
+        # component before its thread exists.
+        for name, component in (
+            ("attribution", self.attribution),
+            ("pushgateway", self.pusher),
+            ("remote_write", self.remote_writer),
+            ("textfile", self.textfile),
+            ("procwatch", self.procwatch),
+        ):
+            alive = getattr(component, "thread_alive", None)
+            starter = getattr(component, "start", None)
+            if component is not None and callable(alive) and callable(starter):
+                self.supervisor.register(
+                    name, is_alive=alive, restart=starter,
+                    breaker_prefixes=(("kubelet",)
+                                      if name == "attribution" else ()))
+        self.supervisor.start()
         log.info(
             "kube-tpu-stats %s: backend=%s devices=%d listening on %s:%d",
             __version__, self.collector.name, len(self.poll.devices),
@@ -304,6 +363,9 @@ class Daemon:
         )
 
     def stop(self) -> None:
+        # Supervisor first: a watchdog firing mid-teardown would respawn
+        # the very threads stop() is joining.
+        self.supervisor.stop()
         if self.upgrade_watcher:
             self.upgrade_watcher.stop()
         self.poll.stop()

@@ -315,9 +315,46 @@ def check_topology(cfg: Config) -> CheckResult:
     )
 
 
-def check_poll(cfg: Config, ticks: int = 5) -> CheckResult:
+def resilience_result(collector) -> CheckResult:
+    """Breaker report for a collector after a short measured run: state,
+    trip count, and last error per breaker (resilience.py). FAIL —
+    doctor exits non-zero — when any breaker is OPEN: collection through
+    that edge is down right now, not blinking."""
+    from . import resilience
+
+    fn = getattr(collector, "breakers", None)
+    breakers = fn() if callable(fn) else {}
+    if not breakers:
+        return _result("resilience", SKIP,
+                       "no circuit breakers on this backend")
+    parts: list[str] = []
+    data: dict[str, dict] = {}
+    worst = OK
+    for name in sorted(breakers):
+        breaker = breakers[name]
+        last = (resilience.flatten_error(breaker.last_error)
+                if breaker.last_error else "")
+        parts.append(
+            f"{name}: {breaker.state}, {breaker.trips_total} trip(s)"
+            + (f", last error: {last}" if last else ""))
+        data[name] = {"state": breaker.state,
+                      "trips": breaker.trips_total,
+                      "last_error": last}
+        if breaker.state == resilience.OPEN:
+            worst = FAIL
+        elif breaker.state != resilience.CLOSED and worst is not FAIL:
+            worst = WARN
+        elif breaker.trips_total and worst is OK:
+            worst = WARN
+    return _result("resilience", worst, "; ".join(parts),
+                   data={"breakers": data})
+
+
+def check_poll(cfg: Config, ticks: int = 5) -> list[CheckResult]:
     """A short real collection run (`ticks` ticks) through the production
-    loop; reports the p50 tick duration against the configured deadline."""
+    loop; reports the p50 tick duration against the configured deadline,
+    plus a `resilience` row describing each circuit breaker's state
+    after the run (exit non-zero when one is open)."""
     from .daemon import build_collector
     from .poll import PollLoop
     from .registry import Registry
@@ -325,16 +362,20 @@ def check_poll(cfg: Config, ticks: int = 5) -> CheckResult:
     try:
         collector = build_collector(cfg)
     except Exception as exc:
-        return _result("poll", FAIL, f"collector construction failed: {exc}")
+        return [_result("poll", FAIL,
+                        f"collector construction failed: {exc}")]
     try:
         registry = Registry()
         loop = PollLoop(collector, registry, deadline=cfg.deadline)
         if not loop.devices:
-            return _result(
-                "poll", WARN,
-                f"backend={collector.name}: 0 devices — exporter would serve "
-                f"self-metrics only",
-            )
+            return [
+                _result(
+                    "poll", WARN,
+                    f"backend={collector.name}: 0 devices — exporter "
+                    f"would serve self-metrics only",
+                ),
+                resilience_result(collector),
+            ]
         durations = sorted(loop.tick() for _ in range(ticks))
         loop.stop()
         p50 = durations[len(durations) // 2] * 1000.0
@@ -347,14 +388,17 @@ def check_poll(cfg: Config, ticks: int = 5) -> CheckResult:
             if s.spec.name == "accelerator_up"
         )
         status = OK if p50 <= cfg.deadline * 1000.0 else WARN
-        return _result(
-            "poll", status,
-            f"backend={collector.name}: {len(loop.devices)} device(s), "
-            f"{int(ups)} up, {series} accelerator series, tick p50 "
-            f"{p50:.1f} ms (deadline {cfg.deadline * 1000.0:.0f} ms)",
-        )
+        return [
+            _result(
+                "poll", status,
+                f"backend={collector.name}: {len(loop.devices)} device(s), "
+                f"{int(ups)} up, {series} accelerator series, tick p50 "
+                f"{p50:.1f} ms (deadline {cfg.deadline * 1000.0:.0f} ms)",
+            ),
+            resilience_result(collector),
+        ]
     except Exception as exc:
-        return _result("poll", FAIL, f"tick crashed: {exc}")
+        return [_result("poll", FAIL, f"tick crashed: {exc}")]
     finally:
         try:
             collector.close()
@@ -434,47 +478,73 @@ def check_remote_write(cfg: Config) -> CheckResult:
                        f"{cfg.remote_write_url}: {exc}")
 
 
-def check_scrape(target: str) -> CheckResult:
+def check_live_resilience(target: str,
+                          text: str | None = None) -> CheckResult:
+    """Read the RUNNING daemon's breaker state off its own exposition
+    (kts_breaker_state). The `resilience` row probes a fresh collector,
+    whose breakers start closed and — by the min-span design — cannot
+    trip during doctor's rapid ticks; the daemon that has been failing
+    for hours carries its state here. FAIL (exit non-zero) when any
+    live breaker is open."""
+    from . import validate
+
+    try:
+        if text is None:
+            text = validate.fetch_exposition(target)
+        series = validate.parse_exposition(text)
+    except Exception as exc:  # noqa: BLE001 - scrape row diagnoses this
+        return _result("live-resilience", SKIP,
+                       f"{target}: not scrapeable here ({exc}); see the "
+                       f"scrape row")
+    states = {
+        labels.get("component", ""): value
+        for name, labels, value in series if name == "kts_breaker_state"
+    }
+    if not states:
+        return _result(
+            "live-resilience", SKIP,
+            f"{target}: no kts_breaker_state series (exporter predates "
+            f"the resilience layer, or serves no breakers)")
+    names = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+    detail = "; ".join(
+        f"{component}: {names.get(value, value)}"
+        for component, value in sorted(states.items()))
+    if any(value == 2.0 for value in states.values()):
+        return _result(
+            "live-resilience", FAIL,
+            detail + " — the running exporter's breaker is open: "
+                     "collection through that edge is down right now",
+            data={"breakers": {c: names.get(v, str(v))
+                               for c, v in states.items()}})
+    worst = WARN if any(v == 1.0 for v in states.values()) else OK
+    return _result("live-resilience", worst, detail,
+                   data={"breakers": {c: names.get(v, str(v))
+                                      for c, v in states.items()}})
+
+
+def check_url(target: str) -> list[CheckResult]:
+    """Both --url rows — scrape contract + live breaker state — off ONE
+    fetch: a node being diagnosed precisely because it is degraded must
+    not render its (possibly 256-chip) exposition twice per doctor run."""
+    text, fetch_row = _scrape_fetch(target)
+    if text is None:
+        return [fetch_row,
+                _result("live-resilience", SKIP,
+                        f"{target}: not scrapeable here; see the scrape "
+                        f"row")]
+    return [check_scrape(target, text=text),
+            check_live_resilience(target, text=text)]
+
+
+def check_scrape(target: str, text: str | None = None) -> CheckResult:
     """Validate a live scrape (or saved .prom) against the exposition
     contract — doctor's hook into the validate tool."""
     from . import validate
 
-    import http.client
-    import ssl
-    import urllib.error
-
-    try:
-        text = validate.fetch_exposition(target)
-    except urllib.error.HTTPError as exc:
-        if exc.code in (401, 403):
-            # The exporter's own shipped hardening (--auth-username): the
-            # endpoint is up and enforcing auth. Doctor only has the
-            # password's sha256 (by design), so it cannot authenticate —
-            # that's a hardened-healthy state, not a collection failure.
-            return _result(
-                "scrape", WARN,
-                f"{target}: endpoint is up but requires authentication "
-                f"(HTTP {exc.code}); contract not checked",
-            )
-        return _result("scrape", FAIL, f"{target}: HTTP {exc.code}")
-    except (OSError, ValueError, http.client.HTTPException) as exc:
-        # urlopen wraps certificate failures as URLError(reason=SSLError):
-        # with the exporter's own --tls-cert-file being self-signed that's
-        # a hardened-healthy state, not a dead endpoint.
-        reason = getattr(exc, "reason", None)
-        if isinstance(exc, ssl.SSLError) or isinstance(reason, ssl.SSLError):
-            return _result(
-                "scrape", WARN,
-                f"{target}: TLS handshake failed ({reason or exc}) — "
-                f"self-signed --tls-cert-file? scrape it with the cert's "
-                f"CA trusted; the endpoint itself is answering TLS",
-            )
-        # ValueError covers UnicodeDecodeError (binary body); HTTPException
-        # covers BadStatusLine — both happen when --url points at something
-        # that isn't a metrics endpoint (e.g. the libtpu gRPC port itself).
-        # ascii() keeps raw response bytes in the message terminal-safe.
-        return _result("scrape", FAIL,
-                       f"{target}: fetch failed: {ascii(str(exc))}")
+    if text is None:
+        text, fetch_row = _scrape_fetch(target)
+        if text is None:
+            return fetch_row
     problems = validate.check(text)
     if problems:
         head = "; ".join(problems[:3])
@@ -485,6 +555,49 @@ def check_scrape(target: str) -> CheckResult:
                  if line and not line.startswith("#"))
     return _result("scrape", OK, f"{series} series conform "
                                  f"to the accelerator_* contract")
+
+
+def _scrape_fetch(target: str) -> tuple[str | None, CheckResult | None]:
+    """Fetch the --url target once: (text, None) on success, else
+    (None, scrape row classifying the failure)."""
+    from . import validate
+
+    import http.client
+    import ssl
+    import urllib.error
+
+    try:
+        return validate.fetch_exposition(target), None
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            # The exporter's own shipped hardening (--auth-username): the
+            # endpoint is up and enforcing auth. Doctor only has the
+            # password's sha256 (by design), so it cannot authenticate —
+            # that's a hardened-healthy state, not a collection failure.
+            return None, _result(
+                "scrape", WARN,
+                f"{target}: endpoint is up but requires authentication "
+                f"(HTTP {exc.code}); contract not checked",
+            )
+        return None, _result("scrape", FAIL, f"{target}: HTTP {exc.code}")
+    except (OSError, ValueError, http.client.HTTPException) as exc:
+        # urlopen wraps certificate failures as URLError(reason=SSLError):
+        # with the exporter's own --tls-cert-file being self-signed that's
+        # a hardened-healthy state, not a dead endpoint.
+        reason = getattr(exc, "reason", None)
+        if isinstance(exc, ssl.SSLError) or isinstance(reason, ssl.SSLError):
+            return None, _result(
+                "scrape", WARN,
+                f"{target}: TLS handshake failed ({reason or exc}) — "
+                f"self-signed --tls-cert-file? scrape it with the cert's "
+                f"CA trusted; the endpoint itself is answering TLS",
+            )
+        # ValueError covers UnicodeDecodeError (binary body); HTTPException
+        # covers BadStatusLine — both happen when --url points at something
+        # that isn't a metrics endpoint (e.g. the libtpu gRPC port itself).
+        # ascii() keeps raw response bytes in the message terminal-safe.
+        return None, _result("scrape", FAIL,
+                             f"{target}: fetch failed: {ascii(str(exc))}")
 
 
 # -- orchestration -----------------------------------------------------------
@@ -607,7 +720,8 @@ def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
     if cfg.remote_write_url:
         probes.append(("remote-write", lambda: check_remote_write(cfg)))
     if url:
-        probes.append(("scrape", lambda: check_scrape(url)))
+        # One probe, one fetch, two rows (scrape + live-resilience).
+        probes.append(("scrape", lambda: check_url(url)))
     results: list[CheckResult] = []
     for name, probe in probes:
         results.extend(_bounded(name, probe))

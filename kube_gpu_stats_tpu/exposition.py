@@ -136,10 +136,16 @@ class MetricsServer:
                  auth_username: str = "", auth_password_sha256: str = "",
                  max_concurrent_scrapes: int = 16,
                  render_stats: RenderStats | None = None,
-                 ready_check=None):
+                 ready_check=None, health_provider=None):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
+        # Optional () -> [(component, state, reason)] rows (the
+        # supervisor's health_report): /healthz carries per-component
+        # reasons so "degraded" is diagnosable from a curl, while the
+        # 200/503 verdict stays snapshot-staleness only — a degraded
+        # (but collecting) exporter must NOT be liveness-restarted.
+        self._health_provider = health_provider
         # Optional () -> (ok, reason) overriding /readyz's default
         # "a snapshot exists" test — the hub gates readiness on having
         # targets so a decommissioned/blind hub drains scrapers without
@@ -279,14 +285,29 @@ class MetricsServer:
                     )
                     if stale:
                         if snapshot.timestamp == 0:
-                            body = b"stale: no snapshot published yet\n"
+                            verdict = "stale: no snapshot published yet\n"
                         else:
                             age = time.time() - snapshot.timestamp
-                            body = f"stale: no poll for {age:.1f}s\n".encode()
+                            verdict = f"stale: no poll for {age:.1f}s\n"
                         self.send_response(503)
                     else:
-                        body = b"ok\n"
+                        verdict = "ok\n"
                         self.send_response(200)
+                    if outer._health_provider is not None:
+                        # Per-component reasons (supervisor health): a
+                        # degraded edge names itself right in the probe
+                        # body — without flipping the verdict.
+                        try:
+                            rows = list(outer._health_provider())
+                        except Exception as exc:  # noqa: BLE001 - probe-safe
+                            rows = [("health-provider", "stale",
+                                     f"crashed: {exc}")]
+                        for name, state, reason in rows:
+                            verdict += f"component={name} state={state}"
+                            if reason:
+                                verdict += f" reason={reason}"
+                            verdict += "\n"
+                    body = verdict.encode()
                     self.send_header("Content-Type", "text/plain")
                 elif path == "/readyz":
                     # Readiness = at least one snapshot has been published
@@ -537,6 +558,11 @@ class TextfileWriter:
             target=self.run_forever, name="textfile-writer", daemon=True
         )
         self._thread.start()
+
+    def thread_alive(self) -> bool:
+        """Liveness probe for the supervisor; start() doubles as the
+        crash-only restart."""
+        return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
         self._stop.set()

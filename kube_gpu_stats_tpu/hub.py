@@ -43,6 +43,7 @@ from typing import Mapping, Sequence
 from . import schema
 from .registry import (HistogramState, Registry, SnapshotBuilder,
                        contribute_push_stats)
+from .resilience import CircuitBreaker
 from .top import Frame, build_frame
 from .validate import bounded_memo, fetch_exposition, parse_exposition
 from .workers import DaemonSamplerPool
@@ -138,6 +139,15 @@ class Hub:
         # target would leak a pool worker per refresh (poll.py's
         # stuck-sampler guard, applied to scraping).
         self._outstanding: dict[str, concurrent.futures.Future] = {}
+        # Per-target circuit breakers (the shared resilience policy,
+        # replacing bespoke retry pacing): a target that fails several
+        # refreshes running is skipped — no pool submit, no
+        # fetch_timeout burned on it — until the recovery probe admits
+        # one fetch. The wedged-future guard above stays: a breaker
+        # can't un-wedge a running future. State exports as
+        # kts_breaker_state{component="target:<url>"}.
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breaker_recovery = max(2.0 * interval, 1.0)
         # Dedup-key memo: a series' label tuple is identical from
         # refresh to refresh (only values change), so the per-series
         # sorted() in _merge_chip_series re-sorts the same few thousand
@@ -145,6 +155,20 @@ class Hub:
         self._key_cache: dict[tuple, tuple] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _breaker(self, target: str) -> CircuitBreaker:
+        breaker = self._breakers.get(target)
+        if breaker is None:
+            # Two trip conditions: consecutive failures (target down),
+            # plus a failure-rate window for the flaky target that
+            # answers just often enough to keep resetting the
+            # consecutive count while wasting a fetch most refreshes.
+            breaker = CircuitBreaker(
+                f"target:{target}", failure_threshold=3,
+                recovery_time=self._breaker_recovery,
+                window=10, failure_rate_threshold=0.6)
+            self._breakers[target] = breaker
+        return breaker
 
     # -- one refresh ---------------------------------------------------------
 
@@ -226,10 +250,23 @@ class Hub:
             stuck = self._outstanding.get(target)
             if stuck is not None:
                 if not stuck.done():
+                    # Still wedged: counts against the breaker too, so a
+                    # target that wedges refresh after refresh opens its
+                    # circuit and stops being submitted once it drains.
+                    self._breaker(target).record_failure(
+                        "previous fetch still running")
                     reachable[target] = False
                     errors.append(f"{target}: previous fetch still running")
                     continue
                 del self._outstanding[target]  # finished late; result stale
+            breaker = self._breaker(target)
+            if not breaker.allow():
+                # Circuit open: marked down without burning a pool
+                # worker or fetch_timeout on a known-dead target; the
+                # recovery probe re-admits one fetch per recovery window.
+                reachable[target] = False
+                errors.append(f"{target}: circuit open ({breaker.describe()})")
+                continue
             if "://" not in target:
                 local_targets.append(target)
             else:
@@ -265,6 +302,7 @@ class Hub:
             names.append(target)
             reachable[target] = True
             fetch_seconds[target] = took
+            self._breaker(target).record_success()
 
         for target, future in futures:
             try:
@@ -275,11 +313,14 @@ class Hub:
                 if not future.cancel():
                     self._outstanding[target] = future
                 reachable[target] = False
+                self._breaker(target).record_failure(
+                    f"fetch exceeded the refresh deadline ({budget:g}s)")
                 errors.append(
                     f"{target}: fetch exceeded the refresh deadline "
                     f"({budget:g}s)")
             except Exception as exc:  # noqa: BLE001 - per-target degradation
                 reachable[target] = False
+                self._breaker(target).record_failure(exc)
                 errors.append(f"{target}: {exc}")
         def record_outcomes(outcomes) -> set:
             seen = set()
@@ -287,6 +328,7 @@ class Hub:
                 seen.add(member)
                 if exc is not None:
                     reachable[member] = False
+                    self._breaker(member).record_failure(exc)
                     errors.append(f"{member}: {exc}")
                 else:
                     record_success(member, series, at, took)
@@ -305,8 +347,15 @@ class Hub:
                 # time without the guarded one.
                 seen = record_outcomes(list(progress))
                 hung = next((m for m in chunk if m not in seen), None)
-                if hung is not None and not future.cancel():
-                    self._outstanding[hung] = future
+                if hung is not None:
+                    # Only the hung member feeds its breaker: the
+                    # unstarted chunk-mates were victims of queueing,
+                    # not failures of their own.
+                    self._breaker(hung).record_failure(
+                        f"file read stalled past the refresh deadline "
+                        f"({budget:g}s)")
+                    if not future.cancel():
+                        self._outstanding[hung] = future
                 for member in chunk:
                     if member not in seen:
                         reachable[member] = False
@@ -349,6 +398,14 @@ class Hub:
         self._refresh_hist = self._refresh_hist.observe(
             time.monotonic() - start)
         builder.add_histogram(self._refresh_hist)
+        # Per-target breaker state: the hub's resilience self-metrics,
+        # same families the daemon exports for its edges.
+        for target in sorted(self._breakers):
+            breaker = self._breakers[target]
+            labels = (("component", f"target:{target}"),)
+            builder.add(schema.BREAKER_STATE, breaker.state_value(), labels)
+            builder.add(schema.BREAKER_TRIPS, float(breaker.trips_total),
+                        labels)
         if self._render_stats is not None:
             self._render_stats.contribute(builder)
         if self._push_stats is not None:
@@ -397,6 +454,10 @@ class Hub:
         alive = set(resolved)
         for target in [t for t in self._hist_cache if t not in alive]:
             del self._hist_cache[target]
+        # Breakers for departed targets go with them (pod churn under
+        # DNS discovery must not grow this map forever).
+        for target in [t for t in self._breakers if t not in alive]:
+            del self._breakers[target]
         # The stuck-fetch map prunes only FINISHED futures: a target
         # that flaps out of DNS and back must still be guarded against
         # its wedged fetch, or each flap would pin another pool worker.

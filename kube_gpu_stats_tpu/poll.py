@@ -26,6 +26,7 @@ from .collectors import Collector, CollectorError, Device, Sample
 from .ici import RateTracker
 from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
                        SnapshotBuilder, contribute_push_stats)
+from .resilience import DeadlineBudget
 from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
@@ -65,6 +66,8 @@ class PollLoop:
         process_openers: Callable[[str], Sequence[tuple[str, str, str, float]]] | None = None,
         push_stats: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
         render_stats: Callable[[SnapshotBuilder], None] | None = None,
+        health_stats: Callable[[SnapshotBuilder], None] | None = None,
+        heartbeat: Callable[[], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -94,6 +97,14 @@ class PollLoop:
         # RenderStats.contribute): folds scrape-duration histograms and
         # rendered-bytes counters into each snapshot.
         self._render_stats = render_stats
+        # Resilience self-observability contributor (daemon wires
+        # Supervisor.contribute): kts_breaker_state / kts_component_*
+        # families ride every snapshot.
+        self._health_stats = health_stats
+        # Supervisor heartbeat: called once per run_forever iteration so
+        # a tick wedged inside a blocking call no timeout covers is
+        # detected (and the loop respawned) by the watchdog.
+        self._heartbeat = heartbeat
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -216,12 +227,31 @@ class PollLoop:
     def tick(self) -> float:
         """Run one poll over all devices; publish a snapshot; return tick
         duration in seconds."""
+        return self._tick_as(None)
+
+    def _tick_as(self, owner: threading.Thread | None) -> float:
+        """One tick on behalf of ``owner`` (the loop thread, or None for
+        direct callers). A thread superseded by a respawn mid-tick — it
+        was wedged inside sampling when the watchdog gave up on it —
+        must not touch shared per-device state (energy integration,
+        restart baselines) or publish a stale snapshot over the fresh
+        thread's: it discards its results at the first post-sample
+        check and retires. (A thread that wedges INSIDE sampling can't
+        be excluded — crash-only means abandon, not preempt — so the
+        shared structures it still touches are individually race-safe:
+        see the pop() in _sample_all.)"""
+        if owner is not None and self._thread is not owner:
+            return 0.0  # superseded before starting: don't sample at all
         self._apply_pending_collector()
         start = self._clock()
         results = self._sample_all()
         duration = self._clock() - start
+        if owner is not None and self._thread is not owner:
+            return duration  # superseded while sampling: discard
         self._hist = self._hist.observe(duration)
         snapshot = self._build_snapshot(results, now=start + duration)
+        if owner is not None and self._thread is not owner:
+            return duration  # superseded during the build: don't publish
         self._registry.publish(snapshot)
         return duration
 
@@ -229,14 +259,22 @@ class PollLoop:
         """Drift-free fixed-rate loop until stop(); re-enumerates devices on
         its own (slower) cadence so hotplug/runtime-restart chip renumbering
         heals without a pod restart (SURVEY.md §5 elastic recovery)."""
+        me = threading.current_thread()
         next_fire = self._clock()
         next_rediscovery = next_fire + self._rediscovery_interval
         while not self._stop.is_set():
+            if self._thread is not None and self._thread is not me:
+                # Crash-only supervision: a respawn replaced this thread
+                # while it was wedged. Now that it unwedged, retire
+                # quietly — the fresh thread owns the loop.
+                log.info("poll loop thread %s superseded by respawn; "
+                         "retiring", me.name)
+                return
             if self._rediscovery_interval > 0 and self._clock() >= next_rediscovery:
                 self.rediscover()
                 next_rediscovery = self._clock() + self._rediscovery_interval
             try:
-                self.tick()
+                self._tick_as(me)
             except Exception:
                 # A tick must never kill the loop: an exception escaping a
                 # collector (bug, unexpected proto shape) would otherwise
@@ -244,6 +282,14 @@ class PollLoop:
                 # while /healthz kept passing. Count, log, keep ticking.
                 self._count_error("tick_crash")
                 log.exception("poll tick crashed; continuing")
+            if self._heartbeat is not None:
+                # After the tick, crash or not: a crashing tick is a bug
+                # with the loop alive; only a HUNG tick must starve the
+                # watchdog into a respawn.
+                try:
+                    self._heartbeat()
+                except Exception:  # noqa: BLE001 - observer must not kill us
+                    log.debug("poll heartbeat raised", exc_info=True)
             next_fire += self._interval
             delay = next_fire - self._clock()
             if delay <= 0:
@@ -254,10 +300,24 @@ class PollLoop:
             self._stop.wait(delay)
 
     def start(self) -> None:
-        self._thread = threading.Thread(
+        self.respawn()
+
+    def respawn(self) -> None:
+        """(Re)start the loop thread. Crash-only restart path for the
+        supervisor watchdog: a wedged previous thread is simply
+        abandoned — it retires itself at its next loop check (or dies
+        with the process; it's daemonic). State carried by self (rate
+        baselines, restart counters, energy) survives, so a respawn is
+        not a telemetry reset."""
+        thread = threading.Thread(
             target=self.run_forever, name="poll-loop", daemon=True
         )
-        self._thread.start()
+        self._thread = thread
+        thread.start()
+
+    def thread_alive(self) -> bool:
+        """Liveness probe for the supervisor."""
+        return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
         self._stop.set()
@@ -293,14 +353,21 @@ class PollLoop:
                     self._count_error("stuck")
                     results.append((dev, None))
                     continue
-                del self._outstanding[dev.device_id]  # finally finished
+                # pop, not del: an abandoned (superseded) loop thread
+                # unwedging mid-_sample_all can race this check-then-
+                # remove with the fresh thread — the loser must no-op,
+                # not KeyError into a spurious tick_crash.
+                self._outstanding.pop(dev.device_id, None)
             futures[self._pool.submit(work, dev)] = dev
-        deadline = self._clock() + self._deadline
+        # One shared budget for the whole tick (resilience.DeadlineBudget):
+        # every subordinate wait draws down the same remainder, so one
+        # slow chip or one slow fetch can only consume what's left — the
+        # 50 ms p50 target is a property of the TICK, not of each child.
+        budget = DeadlineBudget(self._deadline, clock=self._clock)
         runtime_ready = False
         if split:
             try:
-                self._collector.wait_ready(
-                    max(0.0, deadline - self._clock()))
+                self._collector.wait_ready(budget.take())
                 runtime_ready = True
             except Exception as exc:
                 # Fetch missed the tick deadline (or died): assemble with
@@ -309,9 +376,8 @@ class PollLoop:
                 log.warning("runtime fetch not ready within %gs: %s",
                             self._deadline, exc)
         for future, dev in futures.items():
-            remaining = max(0.0, deadline - self._clock())
             try:
-                outcome = future.result(timeout=remaining)
+                outcome = future.result(timeout=budget.take())
                 if split:
                     outcome = self._assemble(dev, outcome, None, runtime_ready)
                 results.append((dev, outcome))
@@ -410,13 +476,24 @@ class PollLoop:
         builder = (FilteredSnapshotBuilder(self._disabled_metrics)
                    if self._disabled_metrics else SnapshotBuilder())
         by_name = _METRICS_BY_NAME
+        # Attribution staleness (resilience.py): the kubelet breaker is
+        # open / refreshes persistently failing, so lookups serve the
+        # retained last-good mapping. Evaluated once per snapshot.
+        attr_stale = bool(getattr(self._attribution, "stale", False))
         for dev, sample in results:
             base = self._device_labels(dev)
+            # stale="true" rides GAUGES only (never counters — a label
+            # flip mid-outage would blind increase(); never
+            # accelerator_up — the health contract keeps one identity).
+            # Absent entirely on the healthy path, so steady-state series
+            # identity (and the goldens) are untouched.
+            stale = attr_stale or (sample is not None and sample.stale)
+            gbase = base + [("stale", "true")] if stale else base
             if sample is None:
                 builder.add(schema.DEVICE_UP, 0.0, base)
                 total = self._last_totals.get(dev.device_id)
                 if total is not None:
-                    builder.add(schema.MEMORY_TOTAL, total, base)
+                    builder.add(schema.MEMORY_TOTAL, total, gbase)
                 # The restart counter stays emitted through an outage
                 # (like MEMORY_TOTAL): if the series vanished while
                 # polls failed, every point inside the increase() window
@@ -432,14 +509,18 @@ class PollLoop:
                     builder.add(schema.ENERGY,
                                 self._energy.get(dev.device_id, 0.0), base)
                 continue
-            builder.add(schema.DEVICE_UP, 1.0, base)
+            # A stale sample (runtime breaker open) is NOT up: the env
+            # gauges below are real sysfs reads, but the chip's runtime
+            # is persistently gone — accelerator_up is the contract that
+            # says "this chip is being collected", and it isn't.
+            builder.add(schema.DEVICE_UP, 0.0 if sample.stale else 1.0, base)
             if schema.MEMORY_TOTAL.name not in sample.values:
                 # Degraded (runtime-not-ready) samples lack HBM capacity;
                 # re-emit the retained total so used/total ratios and
                 # capacity recording rules don't flap on slow ticks.
                 total = self._last_totals.get(dev.device_id)
                 if total is not None:
-                    builder.add(schema.MEMORY_TOTAL, total, base)
+                    builder.add(schema.MEMORY_TOTAL, total, gbase)
             for name, value in sample.values.items():
                 spec = by_name.get(name)
                 if spec is None:
@@ -447,10 +528,13 @@ class PollLoop:
                     if expansion is not None:
                         pct_spec, percentile = expansion
                         builder.add(
-                            pct_spec, value, base + [("percentile", percentile)]
+                            pct_spec, value,
+                            gbase + [("percentile", percentile)]
                         )
                     continue
-                builder.add(spec, value, base)
+                builder.add(
+                    spec, value,
+                    gbase if spec.type is schema.MetricType.GAUGE else base)
                 if name == schema.MEMORY_TOTAL.name:
                     self._last_totals[dev.device_id] = value
                 elif name == schema.UPTIME.name:
@@ -501,11 +585,12 @@ class PollLoop:
                 self._count_error("ici_link_cap")
                 ici_items = ici_items[:self._MAX_ICI_LINKS]
             for link, counter in ici_items:
-                link_labels = base + [("link", link)]
-                builder.add(schema.ICI_TRAFFIC_TOTAL, float(counter), link_labels)
+                builder.add(schema.ICI_TRAFFIC_TOTAL, float(counter),
+                            base + [("link", link)])
                 rate = self._rates.rate(dev.device_id, link, counter, now)
                 if rate is not None:
-                    builder.add(schema.ICI_BANDWIDTH, rate, link_labels)
+                    builder.add(schema.ICI_BANDWIDTH, rate,
+                                gbase + [("link", link)])
             if sample.collective_ops is not None:
                 builder.add(schema.COLLECTIVE_OPS, float(sample.collective_ops), base)
             if sample.raw_values:
@@ -520,7 +605,7 @@ class PollLoop:
                         continue
                     builder.add(
                         schema.PASSTHROUGH, sample.raw_values[key],
-                        base + [("family", family), ("link", link)])
+                        gbase + [("family", family), ("link", link)])
         if self._process_openers is not None:
             for dev, _ in results:
                 base = self._device_labels(dev)
@@ -571,4 +656,8 @@ class PollLoop:
                 builder.add_histogram(hist)
         if self._render_stats is not None:
             self._render_stats(builder)
+        if self._health_stats is not None:
+            # Supervisor.contribute: kts_breaker_state / kts_component_*
+            # resilience self-metrics ride every snapshot.
+            self._health_stats(builder)
         return builder.build()

@@ -10,7 +10,9 @@ https://prometheus.io/docs/specs/remote_write_spec/
 
 Semantics per the spec: snappy-compressed protobuf WriteRequest, samples
 in-order per series, retry on 5xx/transport errors (the next publish is
-the retry — self-backoff via the pusher loop), never retry 4xx (drop and
+the retry — the push cadence stretches under consecutive failures via
+the shared resilience.BackoffPolicy in the PublishFollower scaffold, so
+a down receiver is never hammered), never retry 4xx (drop and
 log: the payload is wrong, not the network). The exporter's gauges are
 trivially in-order because each push carries exactly one timestamp per
 series (the tick's publish time).

@@ -569,6 +569,47 @@ SELF_PUSH_DROPPED = MetricSpec(
     extra_labels=("mode",),
 )
 
+# Resilience self-metrics (resilience.py / supervisor.py): the unified
+# failure policy must self-report, or fleet dashboards silently lie
+# about degraded exporters (ISSUE 1). The component label names an I/O
+# edge or worker thread: "poll", "attribution", "remote_write",
+# "libtpu:<port>", "kubelet", "target:<url>" (hub).
+
+BREAKER_STATE = MetricSpec(
+    "kts_breaker_state",
+    MetricType.GAUGE,
+    "Circuit-breaker state per I/O edge: 0 closed (healthy), 1 half-open "
+    "(probing recovery), 2 open (dependency persistently failing; calls "
+    "are refused and the edge serves stale/degraded data). Alert on "
+    "sustained 2.",
+    extra_labels=("component",),
+)
+BREAKER_TRIPS = MetricSpec(
+    "kts_breaker_trips_total",
+    MetricType.COUNTER,
+    "Times this edge's circuit breaker tripped open since the exporter "
+    "started (consecutive-failure or failure-rate condition met, or a "
+    "half-open probe failed).",
+    extra_labels=("component",),
+)
+COMPONENT_RESTARTS = MetricSpec(
+    "kts_component_restarts_total",
+    MetricType.COUNTER,
+    "Times the crash-only supervisor restarted this worker component "
+    "(thread dead, or hung past its heartbeat timeout). 0 from first "
+    "sight so increase() sees the first restart.",
+    extra_labels=("component",),
+)
+COMPONENT_HEALTHY = MetricSpec(
+    "kts_component_healthy",
+    MetricType.GAUGE,
+    "Supervisor health state per worker component: 1 healthy, 0.5 "
+    "degraded (restarted recently or its breaker is not closed), 0 "
+    "stale (hung or dead right now). /healthz carries the matching "
+    "per-component reason text.",
+    extra_labels=("component",),
+)
+
 PROCESS_CPU = MetricSpec(
     "process_cpu_seconds_total",
     MetricType.COUNTER,
@@ -613,6 +654,10 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_PUSH_TOTAL,
     SELF_PUSH_FAILURES,
     SELF_PUSH_DROPPED,
+    BREAKER_STATE,
+    BREAKER_TRIPS,
+    COMPONENT_RESTARTS,
+    COMPONENT_HEALTHY,
     PROCESS_CPU,
     PROCESS_RSS,
     PROCESS_START,

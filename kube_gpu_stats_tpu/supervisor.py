@@ -1,0 +1,350 @@
+"""Crash-only component supervisor (SURVEY.md §5: "never crash the
+DaemonSet pod" — and never let one silently die either).
+
+The daemon is a set of long-lived worker threads: the poll loop, the
+push senders, the attribution refresher, the backend-upgrade watcher.
+Each contains its own exceptions, but a thread can still die to a truly
+unexpected error or wedge inside a blocking call no timeout covers (a
+D-state sysfs read, a half-open TCP connection). Before this module
+nothing watched the watchers: a dead poll thread meant /healthz going
+stale minutes later and a pod restart — losing all warm state — for a
+failure a thread respawn fixes.
+
+The supervisor owns a per-component record: an ``is_alive`` probe, an
+optional heartbeat (components call :meth:`beat`; the poll loop beats
+once per tick), and a ``restart`` callable. A watchdog thread checks
+every component each interval:
+
+- thread dead (``is_alive`` False) or heartbeat stale past the
+  component's ``heartbeat_timeout`` → the component is restarted
+  (crash-only: the old thread, if merely wedged, is abandoned to retire
+  itself; state reconstruction is the component's job), paced by a
+  shared :class:`~.resilience.BackoffPolicy` so a component that dies on
+  arrival isn't respawned in a hot loop.
+
+Health is a three-state machine per component — ``healthy`` →
+``degraded`` (restarted recently, or its circuit breaker is not closed)
+→ ``stale`` (hung/dead right now) — exported as ``kts_component_healthy``
+(1 / 0.5 / 0), with restarts in ``kts_component_restarts_total`` and
+every registered breaker's state in ``kts_breaker_state`` /
+``kts_breaker_trips_total``. The same report feeds /healthz's
+per-component reasons and ``kube-tpu-stats doctor``'s resilience
+section.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+from . import schema
+from .resilience import BackoffPolicy, CLOSED, CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+STALE = "stale"
+
+HEALTH_VALUES = {HEALTHY: 1.0, DEGRADED: 0.5, STALE: 0.0}
+
+
+@dataclasses.dataclass
+class ComponentHealth:
+    """One row of the health report (also the /healthz body shape)."""
+
+    name: str
+    state: str  # healthy | degraded | stale
+    reason: str
+    restarts: int
+
+
+class _Component:
+    def __init__(self, name: str, *, is_alive: Callable[[], bool],
+                 restart: Callable[[], None] | None,
+                 heartbeat_timeout: float, backoff: BackoffPolicy,
+                 breaker_prefixes: tuple[str, ...],
+                 clock: Callable[[], float]) -> None:
+        self.name = name
+        self.is_alive = is_alive
+        self.restart = restart
+        self.heartbeat_timeout = heartbeat_timeout
+        self.backoff = backoff
+        # Breaker names owned by this component (exact, or
+        # "<prefix>:<detail>"): the poll loop owns "libtpu:<port>",
+        # attribution owns "kubelet". The component's own name always
+        # matches too.
+        self.breaker_prefixes = (name,) + tuple(breaker_prefixes)
+        self.last_beat = clock()
+        self.restarts = 0
+        self.last_restart_at: float | None = None
+        self.next_restart_at = 0.0
+        self.last_reason = ""
+
+
+class Supervisor:
+    """Watchdog + health registry. Single writer (the watchdog thread)
+    for restart bookkeeping; ``beat`` writes one float (GIL-atomic) so
+    components never contend on a lock from their hot paths."""
+
+    # A component restarted within this many seconds reads as degraded:
+    # long enough for dashboards/probes to catch the event, short enough
+    # that a genuinely recovered component returns to healthy.
+    DEGRADED_HOLD = 60.0
+
+    def __init__(self, *, check_interval: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._check_interval = check_interval
+        self._clock = clock
+        self._components: dict[str, _Component] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breaker_providers: list[
+            Callable[[], Mapping[str, CircuitBreaker]]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, name: str, *, is_alive: Callable[[], bool],
+                 restart: Callable[[], None] | None = None,
+                 heartbeat_timeout: float = 0.0,
+                 backoff: BackoffPolicy | None = None,
+                 breaker_prefixes: tuple[str, ...] = ()) -> None:
+        """Supervise a component. ``heartbeat_timeout`` 0 means liveness
+        only (no hang detection); components with one must call
+        :meth:`beat` at least that often. ``restart`` None = report-only
+        (the supervisor can't rebuild it, but its health still exports).
+        ``breaker_prefixes`` names the breakers this component owns (a
+        non-closed one reads as degraded): exact name or
+        "<prefix>:<detail>" — e.g. the poll loop owns ("libtpu",) so
+        "libtpu:8431" maps to it.
+        """
+        with self._lock:
+            self._components[name] = _Component(
+                name, is_alive=is_alive, restart=restart,
+                heartbeat_timeout=heartbeat_timeout,
+                # Decorrelated jitter on the default restart pacing: a
+                # fleet of DaemonSets hitting the same node-level fault
+                # must not respawn (and re-hammer the dependency) in
+                # lockstep. Tests that need determinism pass their own
+                # policy.
+                backoff=backoff or BackoffPolicy(
+                    base=self._check_interval, cap=60.0, jitter=True),
+                breaker_prefixes=breaker_prefixes,
+                clock=self._clock)
+
+    def register_breaker(self, name: str, breaker: CircuitBreaker) -> None:
+        """Expose a circuit breaker in the kts_breaker_* self-metrics and
+        the health report. Re-registering a name replaces it (backend
+        upgrade swaps the collector and its breakers)."""
+        with self._lock:
+            self._breakers[name] = breaker
+
+    def register_breakers(self,
+                          breakers: Mapping[str, CircuitBreaker]) -> None:
+        for name, breaker in breakers.items():
+            self.register_breaker(name, breaker)
+
+    def register_breaker_provider(
+            self, provider: Callable[[], Mapping[str, CircuitBreaker]]
+    ) -> None:
+        """Late-bound breaker source, resolved at every read: the
+        collector's breakers survive a backend-upgrade swap, and a
+        lazily-created client (auto-mode PodResources) appears the
+        moment it exists — no re-registration choreography."""
+        with self._lock:
+            self._breaker_providers.append(provider)
+
+    def breakers(self) -> dict[str, CircuitBreaker]:
+        with self._lock:
+            merged = dict(self._breakers)
+            providers = list(self._breaker_providers)
+        for provider in providers:
+            try:
+                merged.update(provider())
+            except Exception:  # noqa: BLE001 - a provider bug must not
+                log.debug("breaker provider failed", exc_info=True)
+        return merged
+
+    # -- heartbeats ----------------------------------------------------------
+
+    def beat(self, name: str) -> None:
+        component = self._components.get(name)
+        if component is not None:
+            component.last_beat = self._clock()
+
+    def beater(self, name: str) -> Callable[[], None]:
+        """A zero-arg heartbeat closure for wiring into component ctors."""
+        return lambda: self.beat(name)
+
+    # -- watchdog ------------------------------------------------------------
+
+    @staticmethod
+    def _probe(component: _Component, now: float) -> tuple[bool, bool, str]:
+        """(hung, dead, reason) — THE definition of hung/dead, shared by
+        the watchdog and the health report so they can never disagree
+        about the same component."""
+        hung = (component.heartbeat_timeout > 0
+                and now - component.last_beat > component.heartbeat_timeout)
+        try:
+            dead = not component.is_alive()
+        except Exception:  # noqa: BLE001 - a probe bug = treat as dead
+            dead = True
+        reason = ""
+        if hung:
+            reason = (f"hung: no heartbeat for "
+                      f"{now - component.last_beat:.1f}s")
+        elif dead:
+            reason = "thread dead"
+        return hung, dead, reason
+
+    def check_once(self) -> list[str]:
+        """One watchdog pass; returns the names restarted (tests)."""
+        restarted: list[str] = []
+        now = self._clock()
+        with self._lock:
+            components = list(self._components.values())
+        for component in components:
+            hung, dead, reason = self._probe(component, now)
+            if not (hung or dead):
+                if (component.last_restart_at is not None
+                        and now - component.last_restart_at
+                        > self.DEGRADED_HOLD):
+                    # Survived the hold window since its last restart:
+                    # restart pacing resets so a failure next month pays
+                    # base backoff, not the accumulated one.
+                    component.backoff.reset()
+                    component.last_restart_at = None
+                continue
+            component.last_reason = reason
+            if component.restart is None:
+                continue
+            if now < component.next_restart_at:
+                continue  # backoff pacing: don't hot-loop a dying component
+            log.warning("supervisor: restarting %s (%s; restart #%d)",
+                        component.name, reason, component.restarts + 1)
+            try:
+                component.restart()
+            except Exception:  # noqa: BLE001 - a restart bug must not
+                # kill the watchdog — and must not COUNT either: nothing
+                # was respawned, so no restart metric, no heartbeat
+                # grace. Only the backoff advances, so a restart that
+                # crashes every pass isn't retried in a hot loop.
+                log.exception("supervisor: restart of %s crashed",
+                              component.name)
+                component.next_restart_at = (
+                    now + component.backoff.next_delay())
+                continue
+            component.restarts += 1
+            component.last_restart_at = now
+            component.last_beat = now  # grace: the fresh thread starts clean
+            component.next_restart_at = now + component.backoff.next_delay()
+            restarted.append(component.name)
+        return restarted
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 - the watchdog must outlive bugs
+                log.exception("supervisor check crashed; continuing")
+            self._stop.wait(self._check_interval)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="supervisor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- health report -------------------------------------------------------
+
+    def health(self, breakers: Mapping[str, CircuitBreaker] | None = None
+               ) -> list[ComponentHealth]:
+        """Per-component health rows, component order stable (dict
+        insertion order = registration order). ``breakers`` lets a
+        caller that also needs the mapping (contribute, health_report)
+        resolve the provider chain once instead of twice."""
+        now = self._clock()
+        rows: list[ComponentHealth] = []
+        with self._lock:
+            components = list(self._components.values())
+        if breakers is None:
+            breakers = self.breakers()
+        open_by_prefix = {
+            name: breaker for name, breaker in breakers.items()
+            if breaker.state != CLOSED
+        }
+        for component in components:
+            hung, dead, reason = self._probe(component, now)
+            if hung or dead:
+                rows.append(ComponentHealth(
+                    component.name, STALE, reason, component.restarts))
+                continue
+            # Degraded: restarted recently, or a breaker this component
+            # owns (its name, or a registered prefix — exact or
+            # "prefix:detail") is not closed.
+            tripped = [
+                f"breaker {name} {breaker.state}"
+                for name, breaker in sorted(open_by_prefix.items())
+                if any(name == prefix or name.startswith(prefix + ":")
+                       for prefix in component.breaker_prefixes)
+            ]
+            if (component.last_restart_at is not None
+                    and now - component.last_restart_at
+                    <= self.DEGRADED_HOLD):
+                rows.append(ComponentHealth(
+                    component.name, DEGRADED,
+                    f"restarted {now - component.last_restart_at:.0f}s ago "
+                    f"({component.last_reason})", component.restarts))
+            elif tripped:
+                rows.append(ComponentHealth(
+                    component.name, DEGRADED, "; ".join(tripped),
+                    component.restarts))
+            else:
+                rows.append(ComponentHealth(
+                    component.name, HEALTHY, "", component.restarts))
+        return rows
+
+    def health_report(self) -> Sequence[tuple[str, str, str]]:
+        """(name, state, reason) rows for MetricsServer's /healthz body;
+        breakers that belong to no registered component get their own
+        rows so an open hub-target or libtpu-port breaker is visible."""
+        breakers = self.breakers()
+        rows = [(h.name, h.state, h.reason) for h in self.health(breakers)]
+        with self._lock:
+            prefixes = [p for c in self._components.values()
+                        for p in c.breaker_prefixes]
+        for name, breaker in sorted(breakers.items()):
+            if any(name == prefix or name.startswith(prefix + ":")
+                   for prefix in prefixes):
+                continue  # owned: surfaces via its component's row
+            state = HEALTHY if breaker.state == CLOSED else DEGRADED
+            rows.append((name, state,
+                         "" if state == HEALTHY else breaker.describe()))
+        return rows
+
+    def contribute(self, builder) -> None:
+        """Fold kts_* self-metrics into a SnapshotBuilder (called from
+        the poll loop's snapshot build, like RenderStats.contribute)."""
+        breakers = self.breakers()
+        for row in self.health(breakers):
+            labels = (("component", row.name),)
+            builder.add(schema.COMPONENT_HEALTHY,
+                        HEALTH_VALUES[row.state], labels)
+            # Unconditional, born at 0: increase()-based alerting misses
+            # a burst if the series first appears already at N.
+            builder.add(schema.COMPONENT_RESTARTS, float(row.restarts),
+                        labels)
+        for name, breaker in sorted(breakers.items()):
+            labels = (("component", name),)
+            builder.add(schema.BREAKER_STATE, breaker.state_value(), labels)
+            builder.add(schema.BREAKER_TRIPS, float(breaker.trips_total),
+                        labels)

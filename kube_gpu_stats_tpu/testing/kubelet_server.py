@@ -3,6 +3,8 @@ backend #3): canned google.com/tpu allocations for attribution tests."""
 
 from __future__ import annotations
 
+import os
+import time
 from concurrent import futures
 
 import grpc
@@ -12,13 +14,31 @@ from kube_gpu_stats_tpu.proto import podresources as pb
 
 class FakeKubeletServer:
     """`pods` is a list of pb.PodResources; mutate between refreshes to
-    simulate (de)allocations. `fail=True` aborts List with UNAVAILABLE."""
+    simulate (de)allocations. Runtime fault knobs (the same surface
+    FakeLibtpuServer has, so attribution faults are injectable without
+    monkeypatching):
+
+        server.fail = True       # abort List with UNAVAILABLE
+        server.delay = 0.2       # seconds added to every RPC
+        server.garble = True     # return undecodable bytes
+        server.drop = True       # kill the RPC mid-flight with no
+                                 # status (client sees UNKNOWN), like a
+                                 # socket cut under the call
+        server.close_socket()    # hard socket loss: stop serving AND
+                                 # unlink the socket file, the way a
+                                 # crashed-and-cleaned-up kubelet looks;
+                                 # bring it back by constructing a new
+                                 # server on the same path
+    """
 
     def __init__(self, socket_path: str, pods: list[pb.PodResources] | None = None,
                  allocatable: list[pb.ContainerDevices] | None = None):
         self.pods: list[pb.PodResources] = pods or []
         self.allocatable: list[pb.ContainerDevices] = allocatable or []
         self.fail = False
+        self.delay = 0.0
+        self.garble = False
+        self.drop = False
         self.list_calls = 0
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
         handler = grpc.method_handlers_generic_handler(
@@ -40,15 +60,35 @@ class FakeKubeletServer:
         self._server.add_insecure_port(f"unix://{socket_path}")
         self.socket_path = socket_path
 
+    def _faults(self, context) -> bytes | None:
+        """Apply the shared fault knobs; returns garbled bytes when that
+        knob is set, else None (proceed to the real response)."""
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "kubelet injected failure")
+        if self.drop:
+            # No abort, no response: raising out of the handler kills
+            # the RPC without a clean status (client sees UNKNOWN) —
+            # the closest unary-call analog of the socket dying under
+            # the request.
+            raise RuntimeError("kubelet injected drop")
+        if self.garble:
+            return b"\xff\xff\xff\xff"
+        return None
+
     def _list(self, request_bytes: bytes, context) -> bytes:
         self.list_calls += 1
-        if self.fail:
-            context.abort(grpc.StatusCode.UNAVAILABLE, "kubelet injected failure")
+        garbled = self._faults(context)
+        if garbled is not None:
+            return garbled
         return pb.encode_list_response(self.pods)
 
     def _get_allocatable(self, request_bytes: bytes, context) -> bytes:
-        if self.fail:
-            context.abort(grpc.StatusCode.UNAVAILABLE, "kubelet injected failure")
+        garbled = self._faults(context)
+        if garbled is not None:
+            return garbled
         return pb.encode_allocatable_response(self.allocatable)
 
     def start(self) -> "FakeKubeletServer":
@@ -57,6 +97,15 @@ class FakeKubeletServer:
 
     def stop(self) -> None:
         self._server.stop(grace=None)
+
+    def close_socket(self) -> None:
+        """Hard socket loss: stop the server and unlink the socket file
+        so existence probes (AutoSource) see it gone too."""
+        self.stop()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
 
     def __enter__(self) -> "FakeKubeletServer":
         return self.start()

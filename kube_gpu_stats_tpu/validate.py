@@ -151,7 +151,17 @@ def check(text: str, previous: str | None = None) -> list[str]:
                     f"{name}: missing labels {sorted(missing)} (empty-string "
                     f"values are required, absent labels are not allowed)"
                 )
+            # stale="true" is the optional degradation marker (poll.py /
+            # resilience.py): per-device GAUGES carry it while an open
+            # breaker keeps the chip/mapping on last-good data, and it
+            # vanishes on recovery. Counters never carry it (a label
+            # flip mid-outage would blind increase()) and neither does
+            # accelerator_up (the health contract keeps one identity) —
+            # the validator enforces that, not just the emitter.
             extra_expected = set(spec.extra_labels)
+            if (spec.type is schema.MetricType.GAUGE
+                    and spec.name != schema.DEVICE_UP.name):
+                extra_expected.add("stale")
             extra_present = set(labels) - required
             if not extra_expected >= extra_present:
                 problems.append(

@@ -30,15 +30,22 @@ import time
 import urllib.request
 from typing import Callable
 
+from .resilience import BackoffPolicy
+
 log = logging.getLogger(__name__)
 
 
 class PeriodicRefresher:
     """Background cache-refresh scaffold shared by the attribution watcher
     and the device-process watcher (E4-cadence jobs, never on the poll
-    path): daemon thread, `refresh_once()` per period, capped backoff on
-    persistent failure so a dead dependency isn't hammered. Subclasses
-    implement refresh_once() and maintain `consecutive_failures`."""
+    path): daemon thread, `refresh_once()` per period, capped exponential
+    backoff (the shared resilience.BackoffPolicy — no more per-loop
+    hand-rolled formulas) on persistent failure so a dead dependency
+    isn't hammered. Subclasses implement refresh_once() and maintain
+    `consecutive_failures` (an exported health counter, which is why the
+    policy is consulted statelessly from it)."""
+
+    BACKOFF_CAP_FACTOR = 6.0  # max wait = interval * this (unchanged cap)
 
     def __init__(self, refresh_interval: float, thread_name: str,
                  first_refresh_immediately: bool = True) -> None:
@@ -48,6 +55,9 @@ class PeriodicRefresher:
         self._stop_event = threading.Event()
         self._thread: threading.Thread | None = None
         self.consecutive_failures = 0
+        self.backoff = BackoffPolicy(
+            base=max(refresh_interval, 1e-6),
+            cap=max(refresh_interval, 1e-6) * self.BACKOFF_CAP_FACTOR)
 
     def refresh_once(self) -> None:
         raise NotImplementedError
@@ -67,7 +77,7 @@ class PeriodicRefresher:
                 log.warning("%s refresh crashed (%d consecutive)",
                             self._thread_name, self.consecutive_failures,
                             exc_info=True)
-            wait = self._interval * min(1 + self.consecutive_failures, 6)
+            wait = self.backoff.interval_for(self.consecutive_failures)
             self._stop_event.wait(wait)
 
     def start(self) -> None:
@@ -75,6 +85,11 @@ class PeriodicRefresher:
             target=self._run, name=self._thread_name, daemon=True
         )
         self._thread.start()
+
+    def thread_alive(self) -> bool:
+        """Liveness probe for the supervisor; start() doubles as the
+        crash-only restart (fresh thread, retained cache/state)."""
+        return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
         self._stop_event.set()
@@ -114,14 +129,19 @@ def push_opener():
 class PublishFollower:
     """Publish-following push scaffold shared by the Pushgateway and
     remote-write senders: wait for a snapshot publish, rate-limit to
-    ``min_interval`` (scaled up under consecutive failures, capped — a
-    down receiver is not hammered), push, and flush the final snapshot on
+    ``min_interval`` (scaled up under consecutive failures via the
+    shared resilience.BackoffPolicy, capped — a down receiver is not
+    hammered), push, and flush the final snapshot on
     shutdown so stopping isn't a data gap. Defer-never-drop: a publish
     landing inside the interval window is pushed when the window elapses.
 
     Subclasses implement ``push_once()`` (which must never raise — but a
-    bug in it is contained anyway) and maintain ``consecutive_failures``.
+    bug in it is contained anyway) and maintain ``consecutive_failures``
+    — kept as a plain exported counter (the collector_push_* health
+    surface reads it) with the interval math delegated to the policy.
     """
+
+    BACKOFF_CAP_FACTOR = 6.0  # max push interval = min_interval * this
 
     def __init__(self, registry, min_interval: float, thread_name: str) -> None:
         self._registry = registry
@@ -130,6 +150,9 @@ class PublishFollower:
         self._stop_event = threading.Event()
         self._thread: threading.Thread | None = None
         self.consecutive_failures = 0
+        self.backoff = BackoffPolicy(
+            base=max(min_interval, 1e-6),
+            cap=max(min_interval, 1e-6) * self.BACKOFF_CAP_FACTOR)
         # Shipping-health counters, exported as collector_push_* self
         # metrics: subclasses bump pushes_total on success and
         # failures_total on retryable failure; dropped_total counts
@@ -162,7 +185,7 @@ class PublishFollower:
             if self._registry.wait_for_publish(generation, timeout=0.2):
                 generation = self._registry.generation
                 dirty = True
-            interval = self._min_interval * min(1 + self.consecutive_failures, 6)
+            interval = self.backoff.interval_for(self.consecutive_failures)
             if dirty and time.monotonic() - last_push >= interval:
                 self._guarded_push()
                 last_push = time.monotonic()
@@ -175,6 +198,11 @@ class PublishFollower:
             target=self.run_forever, name=self._thread_name, daemon=True
         )
         self._thread.start()
+
+    def thread_alive(self) -> bool:
+        """Liveness probe for the supervisor; start() doubles as the
+        crash-only restart (fresh thread, counters retained)."""
+        return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
         self._stop_event.set()

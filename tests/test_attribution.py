@@ -343,3 +343,36 @@ def test_build_checkpoint_mode_needs_no_grpc(tmp_path, monkeypatch):
         checkpoint_path=str(checkpoint), refresh_interval=10.0)
     cached.refresh_once()
     cached.stop()
+
+
+def test_stale_false_while_checkpoint_fallback_serves_fresh():
+    """Auto mode with the kubelet breaker open but the checkpoint
+    fallback succeeding: lookups serve FRESH (checkpoint) data, so the
+    stale marker must stay off — whatever the breaker says."""
+    from kube_gpu_stats_tpu.attribution import CachedAttribution
+    from kube_gpu_stats_tpu.resilience import CircuitBreaker
+
+    breaker = CircuitBreaker("kubelet", failure_threshold=1)
+    breaker.record_failure("socket gone")
+    assert breaker.state == "open"
+
+    class CheckpointFallbackSource:
+        breaker = None
+
+        def fetch(self):
+            return {"0": {"pod": "", "namespace": "", "container": ""}}
+
+        def close(self):
+            pass
+
+    source = CheckpointFallbackSource()
+    source.breaker = breaker  # AutoSource exposes the PodResources breaker
+    cached = CachedAttribution(source, refresh_interval=60.0)
+    cached.refresh_once()
+    assert cached.consecutive_failures == 0
+    assert not cached.stale  # fresh data, just UID/checkpoint-shaped
+
+    # Once refreshes themselves fail, the open breaker marks it stale.
+    source.fetch = lambda: (_ for _ in ()).throw(RuntimeError("gone too"))
+    cached.refresh_once()
+    assert cached.stale

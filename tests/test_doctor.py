@@ -462,3 +462,75 @@ def test_port_scan_skips_cleanly_when_config_covers_neighborhood(
     row = next(r for r in results if r.name == "port-scan")
     assert row.status == doc.SKIP
     assert "crash" not in row.detail
+
+
+def test_resilience_row_on_healthy_node(tpu_node):
+    results = by_name(doctor.run_checks(tpu_node))
+    row = results["resilience"]
+    assert row.status == "ok"
+    assert f"libtpu:{tpu_node.libtpu_ports[0]}" in row.detail
+    assert "closed" in row.detail
+    assert row.data["breakers"]
+
+
+def test_resilience_row_skip_on_breakerless_backend(tmp_path):
+    cfg = Config(backend="mock", attribution="off",
+                 sysfs_root=str(tmp_path), deadline=5.0)
+    results = by_name(doctor.run_checks(cfg))
+    assert results["resilience"].status == "skip"
+
+
+def test_resilience_open_breaker_is_fail_and_exit_nonzero():
+    """An OPEN breaker means collection through that edge is down right
+    now: the resilience row FAILs, which makes doctor exit non-zero."""
+    from kube_gpu_stats_tpu.resilience import CircuitBreaker
+
+    breaker = CircuitBreaker("libtpu:8431", failure_threshold=1)
+    breaker.record_failure(RuntimeError("connection refused"))
+
+    class Stub:
+        def breakers(self):
+            return {"libtpu:8431": breaker}
+
+    row = doctor.resilience_result(Stub())
+    assert row.status == "fail"
+    assert "open" in row.detail
+    assert "connection refused" in row.detail
+    assert row.data["breakers"]["libtpu:8431"]["state"] == "open"
+    # Sorted with fails first + nonzero exit via the normal machinery.
+    assert doctor._ORDER[row.status] == 0
+
+
+def test_resilience_rapid_doctor_ticks_do_not_fake_an_outage(tmp_path):
+    """doctor's 5 back-to-back ticks against a down-but-sysfs-backed
+    node rack up failures in milliseconds; the breaker's min-span
+    condition must keep that from reading as a persistent outage (the
+    node still collects environmental metrics, poll stays ok)."""
+    make_sysfs(tmp_path / "sys", num_chips=2)
+    cfg = Config(backend="tpu", sysfs_root=str(tmp_path / "sys"),
+                 libtpu_ports=(1,), attribution="off", deadline=5.0)
+    results = by_name(doctor.run_checks(cfg))
+    assert results["poll"].status == "ok"
+    assert "2 up" in results["poll"].detail
+    assert results["resilience"].status == "ok"
+    assert "closed" in results["resilience"].detail
+
+
+def test_live_resilience_reads_running_exporters_breakers(tmp_path):
+    """doctor --url reads the RUNNING daemon's kts_breaker_state (a
+    fresh probe's breakers start closed by design — min span): open on
+    the live exposition is FAIL, all-closed OK, absent SKIP."""
+    live = tmp_path / "live.prom"
+    live.write_text('kts_breaker_state{component="libtpu:8431"} 2\n'
+                    'kts_breaker_state{component="kubelet"} 0\n')
+    row = doctor.check_live_resilience(str(live))
+    assert row.status == "fail"
+    assert "libtpu:8431: open" in row.detail
+    assert row.data["breakers"]["libtpu:8431"] == "open"
+
+    live.write_text('kts_breaker_state{component="kubelet"} 0\n')
+    row = doctor.check_live_resilience(str(live))
+    assert row.status == "ok"
+
+    live.write_text('accelerator_up{chip="0"} 1\n')
+    assert doctor.check_live_resilience(str(live)).status == "skip"

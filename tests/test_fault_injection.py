@@ -7,6 +7,8 @@ import threading
 import time
 import urllib.request
 
+import pytest
+
 from kube_gpu_stats_tpu import schema
 from kube_gpu_stats_tpu.collectors.composite import TpuCollector
 from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
@@ -16,6 +18,10 @@ from kube_gpu_stats_tpu.poll import PollLoop
 from kube_gpu_stats_tpu.registry import Registry
 from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
 from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+# Fault-injection suite: `make chaos` territory, excluded from `make ci`
+# (still green — excluded for speed, not flakiness).
+pytestmark = pytest.mark.chaos
 
 
 def up_values(snapshot):
@@ -299,4 +305,308 @@ def test_slow_runtime_degrades_fresh_not_stale(tmp_path):
     finally:
         loop.stop()
         server.stop()
+        col.close()
+
+
+def test_libtpu_breaker_opens_stale_labels_then_recovers(tmp_path):
+    """Persistent runtime outage (not a blink): after the per-port
+    breaker trips, chips flip accelerator_up to 0 and the surviving
+    env-only gauges carry stale="true" — rather than fabricating
+    runtime values or quietly looking merely runtime-metrics-free.
+    When the runtime returns, the recovery probe re-admits the fetch
+    and chips recover within two ticks. Breaker state self-metrics
+    ride the snapshot throughout."""
+    from kube_gpu_stats_tpu.supervisor import Supervisor
+
+    make_sysfs(tmp_path, num_chips=2)
+    server = FakeLibtpuServer(num_chips=2).start()
+    port = server.port
+    col = TpuCollector(
+        sysfs_root=str(tmp_path),
+        libtpu_client=LibtpuClient(
+            ports=(port,), rpc_timeout=0.5,
+            breaker_recovery_time=0.05, breaker_min_span=0.0),
+        use_native=False,
+    )
+    sup = Supervisor()
+    sup.register_breaker_provider(col.breakers)
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=5.0, health_stats=sup.contribute)
+    try:
+        loop.tick()
+        assert up_values(reg.snapshot()) == [1.0, 1.0]
+
+        server.stop()  # runtime persistently down, not a blink
+        for _ in range(3):  # breaker threshold: 3 consecutive failures
+            loop.tick()
+        assert col.breakers()[f"libtpu:{port}"].state == "open"
+        loop.tick()  # first tick under the open breaker
+        snap = reg.snapshot()
+        assert up_values(snap) == [0.0, 0.0]
+        names = {s.spec.name for s in snap.series}
+        assert schema.DUTY_CYCLE.name not in names  # nothing fabricated
+        power = [s for s in snap.series if s.spec.name == schema.POWER.name]
+        assert power and all(
+            ("stale", "true") in s.labels for s in power)
+        # accelerator_up keeps its base identity (the health contract).
+        ups = [s for s in snap.series
+               if s.spec.name == schema.DEVICE_UP.name]
+        assert all("stale" not in dict(s.labels) for s in ups)
+        # Breaker self-metrics ride the snapshot (and thus /metrics).
+        states = [s.value for s in snap.series
+                  if s.spec.name == schema.BREAKER_STATE.name]
+        assert states == [2.0]
+        trips = [s.value for s in snap.series
+                 if s.spec.name == schema.BREAKER_TRIPS.name]
+        assert trips == [1.0]
+
+        # Runtime returns on the same port: the recovery probe re-admits
+        # the fetch; chips must be fresh within two ticks of a
+        # successful reconnect, with no negative ICI rate ever.
+        server2 = FakeLibtpuServer(num_chips=2, port=port).start()
+        try:
+            time.sleep(0.06)  # recovery_time elapses -> probe admitted
+            recovered_at = None
+            for attempt in range(10):
+                loop.tick()
+                snap = reg.snapshot()
+                rates = [s.value for s in snap.series
+                         if s.spec.name == schema.ICI_BANDWIDTH.name]
+                assert all(r >= 0 for r in rates), rates
+                if up_values(snap) == [1.0, 1.0]:
+                    recovered_at = attempt
+                    break
+                time.sleep(0.2)  # gRPC channel reconnect backoff
+            assert recovered_at is not None, "chips never recovered"
+            snap = reg.snapshot()
+            assert schema.DUTY_CYCLE.name in {
+                s.spec.name for s in snap.series}
+            assert all("stale" not in dict(s.labels) for s in snap.series)
+            states = [s.value for s in snap.series
+                      if s.spec.name == schema.BREAKER_STATE.name]
+            assert states == [0.0]  # closed again
+        finally:
+            server2.stop()
+    finally:
+        loop.stop()
+        col.close()
+
+
+def test_hung_tick_respawned_by_supervisor_watchdog():
+    """A collector hang no timeout covers (begin_tick blocks): the
+    supervisor watchdog notices the missing heartbeat, abandons the
+    wedged thread (crash-only), respawns the loop, and
+    kts_component_restarts_total increments — while the metrics
+    endpoint keeps serving the last snapshot throughout."""
+    from kube_gpu_stats_tpu.supervisor import Supervisor
+
+    class HangingCollector(MockCollector):
+        def __init__(self):
+            super().__init__(num_devices=1)
+            self.hang = threading.Event()     # arm: next begin_tick blocks
+            self.hung = threading.Event()     # signal: we are blocked
+            self.release = threading.Event()  # cleanup: unblock
+
+        def begin_tick(self):
+            if self.hang.is_set():
+                self.hang.clear()  # one-shot: the respawned loop proceeds
+                self.hung.set()
+                self.release.wait(30)
+
+    col = HangingCollector()
+    sup = Supervisor(check_interval=0.05)
+    reg = Registry()
+    loop = PollLoop(col, reg, interval=0.05, deadline=5.0,
+                    heartbeat=sup.beater("poll"),
+                    health_stats=sup.contribute)
+    sup.register("poll", is_alive=loop.thread_alive, restart=loop.respawn,
+                 heartbeat_timeout=0.5)
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    loop.start()
+    sup.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and reg.generation < 2:
+            time.sleep(0.01)
+        assert reg.generation >= 2
+
+        col.hang.set()
+        assert col.hung.wait(5)  # loop thread is now wedged in the tick
+        wedged_at = reg.generation
+        # The endpoint keeps serving while the loop is wedged.
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=2).read()
+        assert b"accelerator_up" in body
+
+        # Watchdog detects the missing heartbeat and respawns the loop:
+        # publishes resume without any external kick.
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and reg.generation < wedged_at + 3):
+            time.sleep(0.02)
+        assert reg.generation >= wedged_at + 3, "loop never respawned"
+        restarts = [
+            s.value for s in reg.snapshot().series
+            if s.spec.name == "kts_component_restarts_total"
+            and dict(s.labels).get("component") == "poll"
+        ]
+        assert restarts and restarts[0] >= 1.0
+        healthy = [
+            s.value for s in reg.snapshot().series
+            if s.spec.name == "kts_component_healthy"
+            and dict(s.labels).get("component") == "poll"
+        ]
+        assert healthy  # health state machine exports alongside
+    finally:
+        col.release.set()
+        sup.stop()
+        loop.stop()
+        server.stop()
+
+
+def test_kubelet_socket_loss_last_good_mapping_stale_then_fresh(tmp_path):
+    """Hard kubelet socket loss: attribution keeps serving the last-good
+    pod-device mapping, labeled stale="true" once the kubelet breaker
+    opens, then recovers and re-labels fresh after the socket returns —
+    picking up the new allocation, not the cached one."""
+    from kube_gpu_stats_tpu.attribution import CachedAttribution
+    from kube_gpu_stats_tpu.attribution.podresources import PodResourcesSource
+    from kube_gpu_stats_tpu.resilience import CircuitBreaker
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+
+    socket_path = str(tmp_path / "kubelet.sock")
+    server = FakeKubeletServer(
+        socket_path, [tpu_pod("train", "ml", "worker", ["0", "1"])]).start()
+    source = PodResourcesSource(
+        socket_path, rpc_timeout=2.0,
+        breaker=CircuitBreaker("kubelet", failure_threshold=2,
+                               recovery_time=0.05))
+    cached = CachedAttribution(source, refresh_interval=60.0)
+    col = MockCollector(num_devices=2)
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=5.0, attribution=cached)
+    try:
+        cached.refresh_once()
+        assert not cached.stale
+        loop.tick()
+        snap = reg.snapshot()
+        power = [s for s in snap.series
+                 if s.spec.name == schema.POWER.name]
+        assert [dict(s.labels)["pod"] for s in power] == ["train", "train"]
+        assert all("stale" not in dict(s.labels) for s in snap.series)
+
+        server.close_socket()  # hard socket loss: stopped AND unlinked
+        cached.refresh_once()  # failure 1
+        cached.refresh_once()  # failure 2 -> kubelet breaker opens
+        assert cached.breaker.state == "open"
+        assert cached.stale
+        loop.tick()
+        snap = reg.snapshot()
+        # Collection itself is healthy: chips stay up...
+        assert up_values(snap) == [1.0, 1.0]
+        power = [s for s in snap.series
+                 if s.spec.name == schema.POWER.name]
+        for s in power:
+            labels = dict(s.labels)
+            # ...serving the LAST-GOOD mapping, labeled stale.
+            assert labels["pod"] == "train"
+            assert labels.get("stale") == "true"
+
+        # Socket returns with a NEW allocation on the same path.
+        server2 = FakeKubeletServer(
+            socket_path,
+            [tpu_pod("serve", "ml", "worker", ["0", "1"])]).start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and cached.stale:
+                time.sleep(0.06)  # let the breaker's recovery window pass
+                cached.refresh_once()
+            assert not cached.stale, "attribution never recovered"
+            loop.tick()
+            snap = reg.snapshot()
+            power = [s for s in snap.series
+                     if s.spec.name == schema.POWER.name]
+            for s in power:
+                labels = dict(s.labels)
+                assert labels["pod"] == "serve"  # fresh, not cached
+                assert "stale" not in labels
+        finally:
+            server2.stop()
+    finally:
+        loop.stop()
+
+
+def test_multiport_partial_outage_stales_only_that_ports_chips(tmp_path):
+    """Multi-process runtime, one process dies permanently: only ITS
+    chips go stale (up 0, stale-labeled env gauges) — the healthy
+    port's chips stay fresh. The per-device escalation must use the
+    port->device mapping, not all-ports-open."""
+    make_sysfs(tmp_path, num_chips=4)
+    server_a = FakeLibtpuServer(num_chips=2).start()
+    server_b = FakeLibtpuServer(num_chips=2, chip_offset=2).start()
+    col = TpuCollector(
+        sysfs_root=str(tmp_path),
+        libtpu_client=LibtpuClient(
+            ports=(server_a.port, server_b.port), rpc_timeout=0.5,
+            breaker_recovery_time=30.0, breaker_min_span=0.0),
+        use_native=False,
+    )
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=5.0)
+    try:
+        loop.tick()
+        assert up_values(reg.snapshot()) == [1.0, 1.0, 1.0, 1.0]
+
+        server_b.stop()  # one process dies; the other keeps serving
+        for _ in range(3):  # trip port B's breaker
+            loop.tick()
+        loop.tick()
+        snap = reg.snapshot()
+        assert up_values(snap) == [1.0, 1.0, 0.0, 0.0]
+        for s in snap.series:
+            labels = dict(s.labels)
+            if s.spec.name == schema.DUTY_CYCLE.name:
+                # Runtime values only from the live port's chips.
+                assert labels["chip"] in ("0", "1")
+            if s.spec.name == schema.POWER.name:
+                stale = labels.get("stale")
+                assert stale == ("true" if labels["chip"] in ("2", "3")
+                                 else None)
+    finally:
+        loop.stop()
+        server_a.stop()
+        col.close()
+
+
+def test_probe_tick_stays_stale_not_flapping(tmp_path):
+    """During a persistent outage, the half-open recovery probe blocks
+    ~0.5s — far past the 50 ms tick budget — so the overlapping tick
+    degrades with 'fetch not ready' rather than a breaker error. That
+    tick must STILL be stale: flapping accelerator_up back to 1 once
+    per recovery window would defeat the contract and churn series
+    identity at the probe cadence for the whole outage."""
+    make_sysfs(tmp_path, num_chips=1)
+    col = TpuCollector(
+        sysfs_root=str(tmp_path),
+        libtpu_client=LibtpuClient(
+            ports=(1,), rpc_timeout=0.1,  # nothing listens on port 1
+            breaker_min_span=0.0, breaker_recovery_time=30.0),
+        use_native=False,
+    )
+    try:
+        (dev,) = col.discover()
+        for _ in range(3):  # trip the breaker
+            col.begin_tick()
+            col.wait_ready(5.0)
+        assert col.breakers()["libtpu:1"].state == "open"
+        env = col.read_environment(dev)
+        # The probe-overrun tick: runtime_ready=False, breaker open.
+        sample = col.assemble(dev, env, None, runtime_ready=False)
+        assert sample.stale
+        # And the ordinary open-breaker tick agrees (peek escalation).
+        sample = col.assemble(dev, env, None, runtime_ready=True)
+        assert sample.stale
+    finally:
         col.close()

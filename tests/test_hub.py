@@ -1194,3 +1194,44 @@ def test_measure_hub_merge_returns_bounded_median():
     # Small shape keeps this fast; the bench runs the full 64x4.
     ms = measure_hub_merge(workers=4, chips=2, refreshes=2)
     assert ms is not None and 0.0 < ms < 5000.0
+
+
+def test_hub_target_breaker_opens_then_recovers(tmp_path):
+    """A target failing several refreshes running trips its circuit
+    breaker: the hub stops burning fetch attempts on it (skipped with a
+    'circuit open' reason, still slice_target_up 0, breaker state in
+    the exposition) until the recovery probe re-admits one fetch."""
+    good = tmp_path / "good.prom"
+    good.write_text('accelerator_up{chip="0",worker="w0",slice="s"} 1\n')
+    gone = tmp_path / "gone.prom"  # never exists at first
+    hub = hub_mod.Hub([str(good), str(gone)], fetch_timeout=1.0)
+    hub._breaker_recovery = 0.05  # fast probe for the test
+    try:
+        for _ in range(3):  # threshold: 3 consecutive failures
+            hub.refresh_once()
+        assert hub._breakers[str(gone)].state == "open"
+        frame = hub.refresh_once()  # skipped, not fetched
+        assert any("circuit open" in err for err in frame.errors)
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_target_up") == [0.0, 1.0] or \
+            values(text, "slice_target_up") == [1.0, 0.0]
+        assert any(
+            n == "kts_breaker_state" and v == 2.0
+            for n, _, v in parse_exposition(text))
+        # Target comes back: the recovery probe readmits one fetch and
+        # the breaker closes.
+        gone.write_text(
+            'accelerator_up{chip="0",worker="w1",slice="s"} 1\n')
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and \
+                hub._breakers[str(gone)].state != "closed":
+            _time.sleep(0.06)
+            hub.refresh_once()
+        assert hub._breakers[str(gone)].state == "closed"
+        text = hub.registry.snapshot().render()
+        assert values(text, "slice_target_up") == [1.0, 1.0]
+        assert values(text, "slice_workers") == [2.0]
+    finally:
+        hub.stop()

@@ -1,0 +1,208 @@
+"""Unit tests for the shared resilience primitives (resilience.py):
+backoff math, circuit-breaker state machine under a fake clock, and the
+per-tick deadline budget. No sleeps — every time-dependent behavior is
+driven through the injectable clock."""
+
+import pytest
+
+from kube_gpu_stats_tpu.resilience import (CLOSED, HALF_OPEN, OPEN,
+                                           BackoffPolicy, BreakerOpenError,
+                                           CircuitBreaker, DeadlineBudget)
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+# -- BackoffPolicy ------------------------------------------------------------
+
+def test_backoff_interval_for_is_exponential_and_capped():
+    policy = BackoffPolicy(base=1.0, cap=6.0)
+    assert policy.interval_for(0) == 1.0
+    assert policy.interval_for(1) == 2.0
+    assert policy.interval_for(2) == 4.0
+    assert policy.interval_for(3) == 6.0  # capped
+    assert policy.interval_for(50) == 6.0  # no overflow at silly counts
+
+
+def test_backoff_stateful_next_delay_and_reset():
+    policy = BackoffPolicy(base=0.5, cap=4.0)
+    assert policy.next_delay() == 0.5
+    assert policy.next_delay() == 1.0
+    assert policy.next_delay() == 2.0
+    policy.reset()
+    assert policy.attempts == 0
+    assert policy.next_delay() == 0.5
+
+
+def test_backoff_decorrelated_jitter_bounded():
+    import random
+
+    policy = BackoffPolicy(base=1.0, cap=10.0, jitter=True,
+                           rng=random.Random(7))
+    prev = 1.0
+    for _ in range(50):
+        delay = policy.next_delay()
+        assert 1.0 <= delay <= min(10.0, prev * 3)
+        prev = delay
+
+
+def test_backoff_rejects_bad_config():
+    with pytest.raises(ValueError):
+        BackoffPolicy(base=0.0, cap=1.0)
+    with pytest.raises(ValueError):
+        BackoffPolicy(base=2.0, cap=1.0)
+
+
+# -- CircuitBreaker -----------------------------------------------------------
+
+def test_breaker_trips_on_consecutive_failures_and_recovers():
+    clock = FakeClock()
+    breaker = CircuitBreaker("edge", failure_threshold=3, recovery_time=5.0,
+                             clock=clock)
+    assert breaker.state == CLOSED
+    for _ in range(2):
+        assert breaker.allow()
+        breaker.record_failure(RuntimeError("boom"))
+    assert breaker.state == CLOSED  # below threshold
+    assert breaker.allow()
+    breaker.record_failure(RuntimeError("boom"))
+    assert breaker.state == OPEN
+    assert breaker.trips_total == 1
+    assert not breaker.allow()  # open refuses
+    clock.advance(4.9)
+    assert not breaker.allow()  # recovery not elapsed
+    clock.advance(0.2)
+    assert breaker.allow()  # the single probe
+    assert breaker.state == HALF_OPEN
+    assert not breaker.allow()  # only ONE probe admitted
+    breaker.record_success()
+    assert breaker.state == CLOSED
+    assert breaker.consecutive_failures == 0
+    assert breaker.last_error is None
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_time=2.0,
+                             clock=clock)
+    breaker.record_failure("down")
+    assert breaker.state == OPEN
+    clock.advance(2.0)
+    assert breaker.allow()
+    breaker.record_failure("still down")
+    assert breaker.state == OPEN
+    assert breaker.trips_total == 2
+    assert not breaker.allow()  # recovery clock restarted
+    clock.advance(2.0)
+    assert breaker.allow()
+
+
+def test_breaker_failure_rate_condition():
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=100, window=10,
+                             failure_rate_threshold=0.5, clock=clock)
+    # Alternate: 50% failures, but under `window` outcomes -> no trip.
+    for _ in range(4):
+        breaker.record_failure("x")
+        breaker.record_success()
+    assert breaker.state == CLOSED
+    # Fill the window at >= 50% failures. (Stop at the trip: an
+    # unsolicited success while OPEN is read as recovery evidence and
+    # closes the breaker again.)
+    for _ in range(5):
+        breaker.record_failure("x")
+        if breaker.state == OPEN:
+            break
+        breaker.record_success()
+    assert breaker.state == OPEN
+
+
+def test_breaker_min_failure_span_requires_duration():
+    # N rapid failures (doctor's back-to-back ticks) must NOT read as a
+    # persistent outage; the same count spread over the span must.
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=3, min_failure_span=2.0,
+                             clock=clock)
+    for _ in range(5):
+        breaker.record_failure("burst")
+    assert breaker.state == CLOSED  # burst spanned 0s
+    clock.advance(2.5)
+    breaker.record_failure("still failing")
+    assert breaker.state == OPEN  # streak now spans >= 2s
+
+
+def test_breaker_guard_and_call():
+    clock = FakeClock()
+    breaker = CircuitBreaker("kubelet", failure_threshold=1, clock=clock)
+    assert breaker.call(lambda: 42) == 42
+    with pytest.raises(RuntimeError):
+        breaker.call(lambda: (_ for _ in ()).throw(RuntimeError("die")))
+    assert breaker.state == OPEN
+    with pytest.raises(BreakerOpenError) as err:
+        breaker.guard()
+    assert "kubelet" in str(err.value)
+
+
+def test_breaker_state_values_for_gauge():
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_time=1.0,
+                             clock=clock)
+    assert breaker.state_value() == 0.0
+    breaker.record_failure("x")
+    assert breaker.state_value() == 2.0
+    clock.advance(1.0)
+    assert breaker.allow()
+    assert breaker.state_value() == 1.0
+
+
+def test_breaker_success_resets_streak():
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=3, clock=clock)
+    breaker.record_failure("a")
+    breaker.record_failure("b")
+    breaker.record_success()
+    breaker.record_failure("c")
+    breaker.record_failure("d")
+    assert breaker.state == CLOSED  # streak was broken by the success
+
+
+# -- DeadlineBudget -----------------------------------------------------------
+
+def test_deadline_budget_draws_down():
+    clock = FakeClock()
+    budget = DeadlineBudget(0.050, clock=clock)
+    assert budget.remaining() == pytest.approx(0.050)
+    assert budget.take(0.010) == pytest.approx(0.010)  # capped at want
+    clock.advance(0.030)
+    assert budget.remaining() == pytest.approx(0.020)
+    assert budget.take() == pytest.approx(0.020)
+    clock.advance(0.030)
+    assert budget.remaining() == 0.0
+    assert budget.take(1.0) == 0.0
+    assert budget.expired()
+    assert budget.elapsed() == pytest.approx(0.060)
+
+
+def test_breaker_reclaims_abandoned_half_open_probe():
+    """An admitted probe whose outcome is never recorded (caller dropped
+    the call before it ran) must not wedge the breaker in HALF_OPEN
+    forever: the probe slot is reclaimed after a recovery window."""
+    clock = FakeClock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_time=2.0,
+                             clock=clock)
+    breaker.record_failure("down")
+    clock.advance(2.0)
+    assert breaker.allow()  # probe admitted... and then abandoned
+    assert not breaker.allow()  # slot held
+    clock.advance(2.0)
+    assert breaker.allow()  # reclaimed: a fresh probe is admitted
+    breaker.record_success()
+    assert breaker.state == CLOSED

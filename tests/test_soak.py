@@ -7,10 +7,15 @@ import threading
 import time
 import urllib.request
 
+import pytest
+
 from kube_gpu_stats_tpu.config import Config
 from kube_gpu_stats_tpu.daemon import Daemon
 from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
 from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+# Soak suite: `make chaos` territory, excluded from `make ci` for speed.
+pytestmark = pytest.mark.chaos
 
 
 class FlakyReceiver(http.server.ThreadingHTTPServer):

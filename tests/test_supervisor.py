@@ -1,0 +1,217 @@
+"""Supervisor unit tests (supervisor.py): watchdog detection of dead and
+hung components, restart pacing, the healthy/degraded/stale state
+machine, and the kts_* self-metric contribution. Clock-driven — no
+thread sleeps except where a real thread is the thing under test."""
+
+import threading
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.registry import SnapshotBuilder
+from kube_gpu_stats_tpu.resilience import BackoffPolicy, CircuitBreaker
+from kube_gpu_stats_tpu.supervisor import (DEGRADED, HEALTHY, STALE,
+                                           Supervisor)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def series(builder: SnapshotBuilder) -> dict:
+    snap = builder.build()
+    return {(s.spec.name, tuple(s.labels)): s.value for s in snap.series}
+
+
+def test_dead_component_is_restarted_with_backoff():
+    clock = FakeClock()
+    sup = Supervisor(clock=clock)
+    alive = {"up": False}
+    restarts = []
+
+    def restart():
+        restarts.append(clock.now)
+
+    sup.register("worker", is_alive=lambda: alive["up"], restart=restart,
+                 backoff=BackoffPolicy(base=2.0, cap=8.0))
+    assert sup.check_once() == ["worker"]
+    # Still dead immediately after: backoff pacing refuses a hot loop.
+    assert sup.check_once() == []
+    clock.advance(2.0)
+    assert sup.check_once() == ["worker"]
+    assert len(restarts) == 2
+    # Component comes back: healthy, restart count retained.
+    alive["up"] = True
+    assert sup.check_once() == []
+    (row,) = sup.health()
+    assert row.state == DEGRADED  # restarted recently
+    assert row.restarts == 2
+    clock.advance(Supervisor.DEGRADED_HOLD + 1)
+    (row,) = sup.health()
+    assert row.state == HEALTHY
+
+
+def test_hung_component_detected_via_heartbeat():
+    clock = FakeClock()
+    sup = Supervisor(clock=clock)
+    restarts = []
+    sup.register("poll", is_alive=lambda: True,
+                 restart=lambda: restarts.append(clock.now),
+                 heartbeat_timeout=5.0)
+    sup.beat("poll")
+    clock.advance(4.0)
+    assert sup.check_once() == []  # beating recently enough
+    clock.advance(2.0)  # 6s since last beat > 5s timeout
+    (row,) = sup.health()
+    assert row.state == STALE
+    assert "no heartbeat" in row.reason
+    assert sup.check_once() == ["poll"]
+    assert restarts == [6.0]
+    # The restart granted heartbeat grace: not immediately re-restarted.
+    assert sup.check_once() == []
+
+
+def test_breaker_makes_component_degraded_and_reports():
+    clock = FakeClock()
+    sup = Supervisor(clock=clock)
+    sup.register("attribution", is_alive=lambda: True)
+    breaker = CircuitBreaker("kubelet", failure_threshold=1, clock=clock)
+    sup.register_breaker("attribution:kubelet", breaker)
+    (row,) = sup.health()
+    assert row.state == HEALTHY
+    breaker.record_failure("socket gone")
+    (row,) = sup.health()
+    assert row.state == DEGRADED
+    assert "attribution:kubelet" in row.reason
+    # health_report carries per-component reasons for /healthz.
+    report = dict(
+        (name, (state, reason)) for name, state, reason in sup.health_report())
+    assert report["attribution"][0] == DEGRADED
+
+
+def test_breaker_provider_is_late_bound():
+    sup = Supervisor(clock=FakeClock())
+    holder = {}
+    sup.register_breaker_provider(lambda: holder)
+    assert sup.breakers() == {}
+    breaker = CircuitBreaker("libtpu:8431")
+    holder["libtpu:8431"] = breaker
+    assert sup.breakers() == {"libtpu:8431": breaker}
+
+
+def test_contribute_exports_kts_families():
+    clock = FakeClock()
+    sup = Supervisor(clock=clock)
+    sup.register("poll", is_alive=lambda: True, heartbeat_timeout=5.0)
+    breaker = CircuitBreaker("libtpu:8431", failure_threshold=1, clock=clock)
+    sup.register_breaker("libtpu:8431", breaker)
+    breaker.record_failure("down")
+    builder = SnapshotBuilder()
+    sup.contribute(builder)
+    values = series(builder)
+    poll = (("component", "poll"),)
+    port = (("component", "libtpu:8431"),)
+    assert values[(schema.COMPONENT_HEALTHY.name, poll)] == 1.0
+    assert values[(schema.COMPONENT_RESTARTS.name, poll)] == 0.0
+    assert values[(schema.BREAKER_STATE.name, port)] == 2.0  # open
+    assert values[(schema.BREAKER_TRIPS.name, port)] == 1.0
+
+
+def test_unowned_breaker_gets_its_own_health_row():
+    sup = Supervisor(clock=FakeClock())
+    breaker = CircuitBreaker("target:http://w0:9400/metrics",
+                             failure_threshold=1)
+    sup.register_breaker("target:http://w0:9400/metrics", breaker)
+    breaker.record_failure("conn refused")
+    report = {name: (state, reason)
+              for name, state, reason in sup.health_report()}
+    state, reason = report["target:http://w0:9400/metrics"]
+    assert state == DEGRADED
+    assert "open" in reason
+
+
+def test_watchdog_thread_restarts_real_dead_thread():
+    # End-to-end with a real thread: die once, get respawned, stay up.
+    sup = Supervisor(check_interval=0.02)
+    spawned = []
+
+    def spawn():
+        thread = threading.Thread(target=lambda: None, daemon=True)
+        thread.start()
+        thread.join()  # dies immediately -> watchdog sees a dead thread
+        spawned.append(thread)
+
+    spawn()
+    sup.register("flaky", is_alive=lambda: spawned[-1].is_alive(),
+                 restart=spawn,
+                 backoff=BackoffPolicy(base=0.01, cap=0.05))
+    sup.start()
+    try:
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(spawned) < 3:
+            time.sleep(0.01)
+        assert len(spawned) >= 3
+        (row,) = sup.health()
+        assert row.restarts >= 2
+    finally:
+        sup.stop()
+
+
+def test_crashing_restart_is_not_counted():
+    """restart() raising means nothing was respawned: no restart count,
+    no heartbeat grace — only the backoff advances."""
+    clock = FakeClock()
+    sup = Supervisor(clock=clock)
+    attempts = []
+
+    def bad_restart():
+        attempts.append(clock.now)
+        raise RuntimeError("start() is broken")
+
+    sup.register("worker", is_alive=lambda: False, restart=bad_restart,
+                 backoff=BackoffPolicy(base=2.0, cap=8.0))
+    assert sup.check_once() == []  # attempted, crashed, not counted
+    assert attempts == [0.0]
+    (row,) = sup.health()
+    assert row.restarts == 0
+    assert row.state == STALE  # still dead, no fake grace
+    # Backoff still paces the next attempt.
+    assert sup.check_once() == []
+    assert attempts == [0.0]
+    clock.advance(2.0)
+    sup.check_once()
+    assert attempts == [0.0, 2.0]
+
+
+def test_breaker_prefixes_map_production_names():
+    """The shipped wiring: component 'poll' owns 'libtpu:<port>',
+    'attribution' owns 'kubelet' — an open breaker degrades its owner
+    and does not get a duplicate standalone row."""
+    clock = FakeClock()
+    sup = Supervisor(clock=clock)
+    sup.register("poll", is_alive=lambda: True,
+                 breaker_prefixes=("libtpu",))
+    sup.register("attribution", is_alive=lambda: True,
+                 breaker_prefixes=("kubelet",))
+    libtpu = CircuitBreaker("libtpu:8431", failure_threshold=1, clock=clock)
+    kubelet = CircuitBreaker("kubelet", failure_threshold=1, clock=clock)
+    sup.register_breaker("libtpu:8431", libtpu)
+    sup.register_breaker("kubelet", kubelet)
+    assert all(h.state == HEALTHY for h in sup.health())
+    libtpu.record_failure("runtime gone")
+    kubelet.record_failure("socket gone")
+    states = {h.name: (h.state, h.reason) for h in sup.health()}
+    assert states["poll"][0] == DEGRADED
+    assert "libtpu:8431" in states["poll"][1]
+    assert states["attribution"][0] == DEGRADED
+    assert "kubelet" in states["attribution"][1]
+    # No duplicate standalone rows for owned breakers.
+    assert [name for name, _, _ in sup.health_report()] == [
+        "poll", "attribution"]

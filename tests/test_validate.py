@@ -336,3 +336,21 @@ def test_parse_exposition_fuzz_never_crashes():
         except ValueError:
             pass
     assert time.monotonic() - start < 10.0
+
+
+def test_stale_label_allowed_on_gauges_only():
+    """stale="true" (resilience degradation marker) is legal on
+    per-device gauges, illegal on counters (a label flip mid-outage
+    blinds increase()) and on accelerator_up (the health contract)."""
+    base = ('accel_type="tpu",chip="0",device_path="/dev/accel0",uuid="",'
+            'pod="",namespace="",container="",slice="",worker="",'
+            'topology=""')
+    ok = f'accelerator_power_watts{{{base},stale="true"}} 100\n'
+    assert validate.check(ok) == []
+    bad_counter = (f'accelerator_energy_joules_total{{{base},'
+                   f'stale="true"}} 5\n')
+    problems = validate.check(bad_counter)
+    assert problems and "stale" in problems[0]
+    bad_up = f'accelerator_up{{{base},stale="true"}} 0\n'
+    problems = validate.check(bad_up)
+    assert problems and "stale" in problems[0]
