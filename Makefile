@@ -1,4 +1,4 @@
-.PHONY: test bench native dashboard golden clean run-mock ci chaos
+.PHONY: test bench bench-quick native dashboard golden clean run-mock ci chaos
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -37,6 +37,13 @@ chaos: native
 
 bench: native
 	python bench.py
+
+# Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
+# merge, no real-chip probing. A quick number for iterating on a perf
+# change; NOT part of `make ci` (ci runs the full bench) and never a
+# BENCH artifact (the line carries quick: true).
+bench-quick: native
+	python bench.py --quick
 
 native:
 	$(MAKE) -C kube_gpu_stats_tpu/native

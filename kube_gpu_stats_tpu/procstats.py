@@ -67,13 +67,15 @@ def read() -> dict[str, float]:
     return out
 
 
-def contribute(builder) -> None:
-    """Fold the current process_* readings into a SnapshotBuilder — the
-    one definition shared by the poll loop and the hub, so a new
-    procstats key missing from schema.SELF_METRICS fails both the same
-    way (loudly, in tests) instead of drifting."""
+def contribute(builder, readings: dict[str, float] | None = None) -> None:
+    """Fold process_* readings into a SnapshotBuilder — the one
+    definition shared by the poll loop and the hub, so a new procstats
+    key missing from schema.SELF_METRICS fails both the same way
+    (loudly, in tests) instead of drifting. ``readings`` lets a caller
+    pass a read() it prefetched off the hot path (the hub overlaps the
+    ~20 /proc syscalls with its fetch phase); None reads inline."""
     from . import schema
 
     by_self = {spec.name: spec for spec in schema.SELF_METRICS}
-    for name, value in read().items():
+    for name, value in (read() if readings is None else readings).items():
         builder.add(by_self[name], value)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import gzip
 import math
 import threading
 import time
@@ -184,6 +185,16 @@ class Registry:
         self._snapshot: Snapshot = EMPTY_SNAPSHOT
         self._published = threading.Condition()
         self._generation = 0
+        # One render per generation (ISSUE 2): every reader of a given
+        # (format, compression) shape between two publishes gets the same
+        # memoized bytes — N concurrent scrapers plus the textfile and
+        # pushgateway followers cost ONE render+compress per publish, not
+        # N+2. Keyed (openmetrics, gzip_level); at most ~4 live entries,
+        # each invalidated by the generation bump. Plain dict, GIL-atomic
+        # get/set: a racing pair of readers at worst both render (byte-
+        # identical output either way) and one wins the store.
+        self._render_cache: dict[tuple[bool, int],
+                                 tuple[int, bytes]] = {}
 
     def publish(self, snapshot: Snapshot) -> None:
         with self._published:
@@ -193,6 +204,42 @@ class Registry:
 
     def snapshot(self) -> Snapshot:
         return self._snapshot
+
+    def rendered(self, openmetrics: bool = False,
+                 gzip_level: int = 0) -> tuple[bytes, bool]:
+        """(bytes, cache_hit) for the current snapshot in the requested
+        shape. ``gzip_level`` 0 returns the plain encoded render; nonzero
+        gzips it (mtime pinned to 0 so the compressed bytes are
+        deterministic — the render-cache golden test diffs them against
+        an uncached compress). The text entry is filled on the way to a
+        gzip entry, so the two shapes share one serialization per
+        generation. Byte-identity with ``Snapshot.render()`` is pinned by
+        tests/test_golden.py."""
+        with self._published:
+            # One lock-held read so (generation, snapshot) is a coherent
+            # pair; the render itself runs outside the lock and can never
+            # stall a publish. A publish racing this render only strands
+            # a stale cache entry, which the generation check rejects.
+            # Goes through snapshot(), not _snapshot: subclasses (and
+            # tests) that override the accessor must see their snapshot
+            # rendered, cache or no cache.
+            generation = self._generation
+            snapshot = self.snapshot()
+        key = (openmetrics, gzip_level)
+        entry = self._render_cache.get(key)
+        if entry is not None and entry[0] == generation:
+            return entry[1], True
+        text_key = (openmetrics, 0)
+        entry = self._render_cache.get(text_key)
+        if entry is not None and entry[0] == generation:
+            body = entry[1]
+        else:
+            body = snapshot.render(openmetrics=openmetrics).encode()
+            self._render_cache[text_key] = (generation, body)
+        if gzip_level:
+            body = gzip.compress(body, compresslevel=gzip_level, mtime=0)
+            self._render_cache[key] = (generation, body)
+        return body, False
 
     @property
     def generation(self) -> int:
@@ -228,6 +275,19 @@ class SnapshotBuilder:
         items = getattr(labels, "items", None)
         labels = tuple(items()) if items is not None else tuple(labels)
         self._series.append(Series(spec, labels, float(value)))
+
+    def add_series(self, series: Series) -> None:
+        """Append an already-built (immutable) Series. The hub's
+        incremental merge pre-builds each target's Series objects once
+        per parsed body and replays them on every refresh the body is
+        unchanged — this entry point skips the per-add label
+        normalization that add() pays."""
+        self._series.append(series)
+
+    def extend_series(self, series: Iterable[Series]) -> None:
+        """Bulk add_series — one C-level extend for a replayed merge
+        plan instead of a method call per series."""
+        self._series.extend(series)
 
     def add_histogram(self, state: HistogramState) -> None:
         self._histograms.append(state)
@@ -270,6 +330,14 @@ class FilteredSnapshotBuilder(SnapshotBuilder):
     def add(self, spec, value, labels=()) -> None:
         if spec.name not in self._disabled:
             super().add(spec, value, labels)
+
+    def add_series(self, series: Series) -> None:
+        if series.spec.name not in self._disabled:
+            super().add_series(series)
+
+    def extend_series(self, series: Iterable[Series]) -> None:
+        super().extend_series(
+            s for s in series if s.spec.name not in self._disabled)
 
     def add_histogram(self, state: HistogramState) -> None:
         if state.spec.name not in self._disabled:

@@ -457,6 +457,24 @@ HUB_REFRESH_DURATION = MetricSpec(
     "Wall time of one hub refresh: concurrent scrape of every target plus "
     "merge and rollup computation.",
 )
+HUB_BODY_CACHE_HITS = MetricSpec(
+    "kts_hub_body_cache_hits_total",
+    MetricType.COUNTER,
+    "Target fetches whose response body was byte-identical to the previous "
+    "refresh, so the hub reused the cached parse and merge plan with zero "
+    "re-parse (idle chips make this the common case). Hit rate = this "
+    "counter's rate over refresh_rate * slice_targets; a low rate on an "
+    "idle slice means something (timestamps, jitter) is churning the "
+    "exposition text every cycle.",
+)
+HUB_PARSE_SECONDS = MetricSpec(
+    "kts_hub_parse_seconds",
+    MetricType.HISTOGRAM,
+    "Wall time tokenizing one target's exposition into series (body-cache "
+    "misses only; hits skip the parse entirely). The ingest half of the "
+    "hub's merge budget — hub_refresh_duration_seconds minus fetch and "
+    "parse is rollup+merge cost.",
+)
 
 HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_TARGET_UP,
@@ -479,6 +497,8 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_WORKER_STEPS,
     HUB_STRAGGLER_RATIO,
     HUB_REFRESH_DURATION,
+    HUB_BODY_CACHE_HITS,
+    HUB_PARSE_SECONDS,
 )
 
 # Buckets for hub_refresh_duration_seconds: a refresh crosses the network
@@ -486,6 +506,14 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
 # typical refresh intervals.
 HUB_REFRESH_BUCKETS: tuple[float, ...] = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Buckets for kts_hub_parse_seconds: one target's exposition is tens of
+# KB (a few thousand lines), so a parse sits well under the refresh
+# buckets — resolve from ~0.1 ms (small body, warm caches) to the
+# tens-of-ms pathological case (huge body, cold intern pools).
+HUB_PARSE_BUCKETS: tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 )
 
 
@@ -521,6 +549,22 @@ SELF_SCRAPES_REJECTED = MetricSpec(
     "Scrapes answered 503 by the --max-concurrent-scrapes storm guard. "
     "A nonzero rate means something is scraping far too hard (second "
     "Prometheus, misconfigured SD) and real scrapes are seeing gaps.",
+)
+RENDER_CACHE_HITS = MetricSpec(
+    "kts_render_cache_hits_total",
+    MetricType.COUNTER,
+    "Renders served from the per-generation exposition cache: the snapshot "
+    "generation had already been rendered (and, for compressed scrapes, "
+    "gzipped) in this shape, so the reader got the memoized bytes. N "
+    "concurrent scrapers per publish cost one render instead of N.",
+)
+RENDER_CACHE_MISSES = MetricSpec(
+    "kts_render_cache_misses_total",
+    MetricType.COUNTER,
+    "Renders that actually serialized the snapshot (first read of a "
+    "generation in a given shape). At most a few per publish — one per "
+    "(format, compression) shape in use; a rate far above the publish "
+    "rate means readers are outpacing the cache key space.",
 )
 SELF_POLL_ERRORS = MetricSpec(
     "collector_poll_errors_total",
@@ -647,6 +691,8 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_SCRAPE_DURATION,
     SELF_RENDERED_BYTES,
     SELF_SCRAPES_REJECTED,
+    RENDER_CACHE_HITS,
+    RENDER_CACHE_MISSES,
     SELF_POLL_ERRORS,
     SELF_DEVICES,
     SELF_INFO,

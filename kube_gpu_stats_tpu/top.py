@@ -85,11 +85,23 @@ class ChipRow:
     # Raw counter values; rates derive from frame-over-frame deltas.
     steps_total: float | None = None
     busy_total: float | None = None
+
     energy_total: float | None = None  # JSON only (joules since start)
     restarts_total: float | None = None  # JSON only (runtime bounces)
     # Filled by Frame.rates():
     steps_per_s: float | None = None
     busy_pct: float | None = None
+
+    def clone_at(self, at: float) -> "ChipRow":
+        """Field-identical copy restamped with a fetch timestamp. The
+        hub replays cached per-target folds into every frame; the frame
+        must get fresh rows (rates() mutates them) without copy.copy's
+        __reduce_ex__ detour — this is ~20x cheaper, measured at
+        64-target fan-in."""
+        row = ChipRow.__new__(ChipRow)
+        row.__dict__.update(self.__dict__)
+        row.at = at
+        return row
 
 
 class Frame:
@@ -127,6 +139,57 @@ class Frame:
                     100.0, 100.0 * (row.busy_total - prev.busy_total) / dt)
 
 
+# Rendered-name -> column maps, built once at import (they were rebuilt
+# per frame, visible in the 1 Hz hub profile at 64-target fan-in).
+_GAUGE_BY_NAME = {name: col for col, name in _GAUGES.items()}
+_COUNTER_BY_NAME = {name: col for col, name in _COUNTERS.items()}
+
+
+def fold_target(series: Sequence, tkey: object, at: float,
+                rows: dict[tuple, ChipRow],
+                rollups: dict[tuple, float]) -> None:
+    """Fold ONE target's parsed series into the rows/rollups
+    accumulators. Every key this writes leads with ``tkey``, so two
+    targets' contributions are disjoint — which is what lets
+    build_frame merge per-target folds, and lets the hub cache a
+    target's fold and replay it for every refresh its body is
+    unchanged (zero-reparse ingest)."""
+    def row(labels: Mapping[str, str]) -> ChipRow:
+        key = (tkey, labels.get("slice", ""), labels.get("worker", ""),
+               labels.get("chip", ""))
+        r = rows.get(key)
+        if r is None:
+            r = rows[key] = ChipRow(key, at=at)
+        if labels.get("accel_type"):
+            r.accel_type = labels["accel_type"]
+        if labels.get("pod"):
+            r.pod = labels["pod"]
+            r.namespace = labels.get("namespace", "")
+        return r
+
+    for name, labels, value in series:
+        if name.startswith("slice_"):
+            rollups[(tkey, name, tuple(sorted(labels.items())))] = value
+            continue
+        if not name.startswith("accelerator_"):
+            continue
+        col = _GAUGE_BY_NAME.get(name)
+        if col is not None:
+            setattr(row(labels), col, value)
+            continue
+        col = _COUNTER_BY_NAME.get(name)
+        if col is not None:
+            setattr(row(labels), f"{col}_total", value)
+            continue
+        if name == schema.ICI_BANDWIDTH.name:
+            r = row(labels)
+            r.ici_bps += value
+            r.ici_links += 1
+        elif name == schema.PROCESS_OPEN.name:
+            if labels.get("comm") != "_overflow":
+                row(labels).holders += 1
+
+
 def build_frame(texts: Sequence[object], errors: list[str],
                 ats: Sequence[float] | None = None,
                 targets: Sequence[object] | None = None) -> Frame:
@@ -139,25 +202,9 @@ def build_frame(texts: Sequence[object], errors: list[str],
     rollups: dict[tuple, float] = {}
     now = time.monotonic()
 
-    by_id = {name: col for col, name in _GAUGES.items()}
-    counter_by_id = {name: col for col, name in _COUNTERS.items()}
     for tidx, text in enumerate(texts):
         at = ats[tidx] if ats is not None else now
         tkey = targets[tidx] if targets is not None else tidx
-
-        def row(labels: Mapping[str, str]) -> ChipRow:
-            key = (tkey, labels.get("slice", ""), labels.get("worker", ""),
-                   labels.get("chip", ""))
-            r = rows.get(key)
-            if r is None:
-                r = rows[key] = ChipRow(key, at=at)
-            if labels.get("accel_type"):
-                r.accel_type = labels["accel_type"]
-            if labels.get("pod"):
-                r.pod = labels["pod"]
-                r.namespace = labels.get("namespace", "")
-            return r
-
         if isinstance(text, str):
             try:
                 series = parse_exposition(text)
@@ -166,27 +213,7 @@ def build_frame(texts: Sequence[object], errors: list[str],
                 continue
         else:
             series = text
-        for name, labels, value in series:
-            if name.startswith("slice_"):
-                rollups[(tkey, name, tuple(sorted(labels.items())))] = value
-                continue
-            if not name.startswith("accelerator_"):
-                continue
-            col = by_id.get(name)
-            if col is not None:
-                setattr(row(labels), col, value)
-                continue
-            col = counter_by_id.get(name)
-            if col is not None:
-                setattr(row(labels), f"{col}_total", value)
-                continue
-            if name == schema.ICI_BANDWIDTH.name:
-                r = row(labels)
-                r.ici_bps += value
-                r.ici_links += 1
-            elif name == schema.PROCESS_OPEN.name:
-                if labels.get("comm") != "_overflow":
-                    row(labels).holders += 1
+        fold_target(series, tkey, at, rows, rollups)
     return Frame(rows, errors, rollups)
 
 

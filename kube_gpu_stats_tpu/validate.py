@@ -25,6 +25,8 @@ _SERIES_RE = re.compile(
     r"(?:\s+(?P<timestamp>-?\d+))?$"  # optional trailing ms timestamp (0.0.4)
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
 
 BOUNDED_MEMO_MAX = 65536
 
@@ -44,19 +46,68 @@ def bounded_memo(cache: dict, key, compute):
     return value
 
 
-# Label-substring memo: a scrape's label sets are identical from
-# refresh to refresh (only values change), so the hub re-parses the
-# same few thousand strings every cycle — the regex walk was the
-# hottest line of a 64-worker refresh (profiled). The cache stores
-# immutable pairs and hands each caller a FRESH dict (a 10-item dict
-# build is ~10x cheaper than the findall), so downstream mutation
-# can't poison the cache.
+# Shared intern pools. A scrape's metric names and label sets are
+# identical from refresh to refresh (only values change), so the hub
+# re-tokenizes the same few thousand strings every cycle — the regex
+# walk was the hottest line of a 64-worker refresh (profiled). Both
+# pools store immutable objects and are bounded like every other memo:
+#
+# - _NAME_POOL: raw family-name substring -> validated interned str.
+# - _LABEL_CACHE: raw label substring -> tuple of (name, value) pairs.
+#   The POOLED tuple itself is what parse_exposition_interned hands
+#   out, so merge keys built from it are pointer-equal across targets
+#   and cycles; parse_exposition builds each caller a FRESH dict (a
+#   10-item dict build is ~10x cheaper than the tokenizer walk), so
+#   downstream mutation can't poison the pool.
+_NAME_POOL: dict[str, str] = {}
 _LABEL_CACHE: dict[str, tuple] = {}
 
 
 def _parse_labels(raw: str) -> dict[str, str]:
-    return dict(bounded_memo(_LABEL_CACHE, raw,
-                             lambda: tuple(_LABEL_RE.findall(raw))))
+    """Reference-parser label view: pure regex, no shared caches, so the
+    oracle in the differential test cannot be contaminated by fast-path
+    state."""
+    return dict(_tokenize_labels_reference(raw))
+
+
+def _tokenize_labels(raw: str) -> tuple:
+    """Label pairs from the text inside ``{...}``: a split/scan
+    tokenizer for the clean ``name="value",...`` grammar every real
+    renderer emits, falling back to the reference regex findall the
+    moment the input deviates (escapes, junk separators, malformed
+    names) — so the fast path can only ever agree with the reference.
+    Duplicate label names collapse last-wins (what dict() always did)
+    so the pooled tuple and the dict view share one identity."""
+    if "\\" in raw:
+        return _tokenize_labels_reference(raw)
+    pairs: list[tuple[str, str]] = []
+    i, n = 0, len(raw)
+    while i < n:
+        eq = raw.find('="', i)
+        if eq == -1:
+            if raw[i:] != ",":  # lone trailing comma is harmless
+                return _tokenize_labels_reference(raw)
+            break
+        name = raw[i:eq]
+        if pairs:
+            if not name.startswith(","):
+                return _tokenize_labels_reference(raw)
+            name = name[1:]
+        end = raw.find('"', eq + 2)
+        if end == -1 or not _LABEL_NAME_RE.match(name):
+            return _tokenize_labels_reference(raw)
+        pairs.append((name, raw[eq + 2:end]))
+        i = end + 1
+    if len(pairs) > 1 and len({name for name, _ in pairs}) != len(pairs):
+        return tuple(dict(pairs).items())
+    return tuple(pairs)
+
+
+def _tokenize_labels_reference(raw: str) -> tuple:
+    pairs = _LABEL_RE.findall(raw)
+    if len(pairs) > 1 and len({name for name, _ in pairs}) != len(pairs):
+        return tuple(dict(pairs).items())
+    return tuple(pairs)
 
 _RANGES = {
     schema.DUTY_CYCLE.name: (0.0, 100.0),
@@ -78,8 +129,102 @@ _HUB_RANGES = {
 }
 
 
+_SPECIAL_VALUES = {"NaN": float("nan"), "+Inf": float("inf"),
+                   "-Inf": float("-inf")}
+
+
+def _intern_name(raw: str) -> str:
+    """Validated, interned metric-family name (raises ValueError)."""
+    if not _METRIC_NAME_RE.match(raw):
+        raise ValueError(f"bad metric name {raw!r}")
+    return sys.intern(raw)
+
+
+def _is_timestamp(raw: str) -> bool:
+    # isdecimal, not isdigit: the reference regex `-?\d+` matches exactly
+    # the Unicode Nd category, which is isdecimal's definition; isdigit
+    # additionally accepts superscripts, which the regex rejects.
+    if raw.startswith("-"):
+        raw = raw[1:]
+    return raw.isdecimal()
+
+
+def _parse_series(text: str, interned: bool) -> list:
+    """Shared tokenizer core: slice out name/labels/value by structure
+    (one find + one rfind per line) instead of running the series regex
+    per line — the regex walk dominated hub parse cost at 64-worker
+    fan-in. Semantics are pinned to parse_exposition_reference by the
+    differential test; any label text the fast scan can't prove
+    equivalent falls back to the reference regex inside
+    _tokenize_labels."""
+    out: list = []
+    append = out.append
+    name_pool = _NAME_POOL
+    label_cache = _LABEL_CACHE
+    specials = _SPECIAL_VALUES
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line[0] == "#":
+            continue
+        try:
+            brace = line.find("{")
+            if brace == -1:
+                fields = line.split()
+                name = fields[0]
+                rest = fields[1:]
+                raw_labels = ""
+            else:
+                close = line.rfind("}")
+                if close < brace:
+                    raise ValueError("unbalanced braces")
+                name = line[:brace]
+                raw_labels = line[brace + 1:close]
+                tail = line[close + 1:]
+                if tail and not tail[0].isspace():
+                    raise ValueError("missing space after labels")
+                rest = tail.split()
+            if not rest or len(rest) > 2 or (
+                    len(rest) == 2 and not _is_timestamp(rest[1])):
+                raise ValueError("bad value/timestamp fields")
+            name = name_pool.get(name) or bounded_memo(
+                name_pool, name, lambda: _intern_name(name))
+            labels = label_cache.get(raw_labels)
+            if labels is None:
+                labels = bounded_memo(label_cache, raw_labels,
+                                      lambda: _tokenize_labels(raw_labels))
+            raw = rest[0]
+            value = specials.get(raw)
+            if value is None:
+                value = float(raw)
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"line {lineno}: unparseable series: {line!r}") from None
+        append((name, dict(labels) if not interned else labels, value))
+    return out
+
+
 def parse_exposition(text: str) -> list[tuple[str, dict[str, str], float]]:
-    """(name, labels, value) triples; raises ValueError on malformed lines."""
+    """(name, labels, value) triples; raises ValueError on malformed
+    lines. Differential-tested against parse_exposition_reference (the
+    regex implementation this tokenizer replaced on the hot path)."""
+    return _parse_series(text, interned=False)
+
+
+def parse_exposition_interned(
+        text: str) -> list[tuple[str, tuple[tuple[str, str], ...], float]]:
+    """Like parse_exposition but labels come back as the POOLED label
+    tuple instead of a fresh dict: tuples (and family names) are
+    pointer-equal across targets and cycles, so the hub's merge keys
+    and shape checks are identity comparisons, not re-hashing. Callers
+    must treat the tuples as immutable shared state."""
+    return _parse_series(text, interned=True)
+
+
+def parse_exposition_reference(
+        text: str) -> list[tuple[str, dict[str, str], float]]:
+    """Reference implementation (the original per-line regex pair),
+    kept as the semantic oracle for the fast tokenizer's differential
+    test — not used on any hot path."""
     out = []
     for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
@@ -90,8 +235,7 @@ def parse_exposition(text: str) -> list[tuple[str, dict[str, str], float]]:
             raise ValueError(f"line {lineno}: unparseable series: {line!r}")
         labels = _parse_labels(match.group("labels") or "")
         raw = match.group("value")
-        value = {"NaN": float("nan"), "+Inf": float("inf"),
-                 "-Inf": float("-inf")}.get(raw)
+        value = _SPECIAL_VALUES.get(raw)
         if value is None:
             value = float(raw)
         out.append((match.group("name"), labels, value))

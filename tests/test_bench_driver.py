@@ -62,7 +62,13 @@ def test_round_end_retry_recovers_real_mode(capsys, monkeypatch):
                         lambda probe, **kw: None)
     monkeypatch.setattr(bench_mod, "run_latency_harness",
                         lambda *a, **kw: _measurement("simulated", 11.0))
-    monkeypatch.setattr(bench_mod, "measure_hub_merge", lambda: 22.0)
+    monkeypatch.setattr(
+        bench_mod, "measure_hub_merge",
+        lambda workers=64, **kw: {
+            "p50_ms": 22.0 if workers == 64 else 55.0,
+            "cold_ms": 30.0 if workers == 64 else 80.0,
+            "body_cache_hit_rate": 0.8, "parse_mb_per_s": 40.0,
+            "render_cache_hits": 3})
 
     line = run_main(capsys, monkeypatch)
     assert calls["real"] == 2
@@ -77,7 +83,14 @@ def test_round_end_retry_recovers_real_mode(capsys, monkeypatch):
     assert line["simulated"]["chips"] == 8
     assert line["real_probe"]["first"] is True
     assert line["real_probe"]["round_end_retry"] == {"jax_platform": "tpu"}
+    # Hub ingest/merge figures at both fan-in shapes, with the cache
+    # evidence fields alongside the latency headline.
     assert line["hub_merge_64w_p50_ms"] == 22.0
+    assert line["hub_merge_64w_cold_ms"] == 30.0
+    assert line["hub_merge_256w_p50_ms"] == 55.0
+    assert line["hub_body_cache_hit_rate"] == 0.8
+    assert line["hub_parse_mb_per_s"] == 40.0
+    assert line["hub_render_cache_hits"] == 3
 
 
 def test_retry_failure_stays_simulated_with_probe_evidence(capsys,
@@ -92,7 +105,8 @@ def test_retry_failure_stays_simulated_with_probe_evidence(capsys,
                         lambda probe, **kw: None)
     monkeypatch.setattr(bench_mod, "run_latency_harness",
                         lambda *a, **kw: _measurement("simulated", 11.0))
-    monkeypatch.setattr(bench_mod, "measure_hub_merge", lambda: None)
+    monkeypatch.setattr(bench_mod, "measure_hub_merge",
+                        lambda *a, **kw: None)
 
     line = run_main(capsys, monkeypatch)
     assert line["mode"] == "simulated"

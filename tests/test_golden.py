@@ -51,3 +51,82 @@ def test_matches_golden():
         GOLDEN.write_text(text)
     assert GOLDEN.exists(), "golden missing; run with GOLDEN_UPDATE=1"
     assert text == GOLDEN.read_text()
+
+
+def _populated_registry():
+    reg = Registry()
+    loop = PollLoop(
+        MockCollector(num_devices=2), reg, deadline=5.0,
+        attribution=FakeAttribution(),
+        topology_labels={"slice": "test-slice", "worker": "0",
+                         "topology": "2x2x1"},
+        version="golden", process_metrics=False,
+        clock=itertools.count(100.0, 0.5).__next__,
+    )
+    loop.tick()
+    loop.stop()
+    return reg
+
+
+def test_cached_render_byte_identical_to_uncached():
+    """The one-render-per-generation cache (Registry.rendered) must be
+    invisible in the bytes: text and gzip, classic and OpenMetrics, all
+    byte-identical to an uncached Snapshot.render() of the same
+    snapshot. gzip is compared against mtime=0 compression — the pinned
+    determinism contract of the cached path."""
+    import gzip
+
+    reg = _populated_registry()
+    snapshot = reg.snapshot()
+    for openmetrics in (False, True):
+        uncached = snapshot.render(openmetrics=openmetrics).encode()
+        body, hit = reg.rendered(openmetrics=openmetrics)
+        assert not hit  # first read of this generation renders
+        assert body == uncached
+        body, hit = reg.rendered(openmetrics=openmetrics)
+        assert hit  # second read is the memoized bytes
+        assert body == uncached
+        gz, _ = reg.rendered(openmetrics=openmetrics, gzip_level=3)
+        assert gz == gzip.compress(uncached, compresslevel=3, mtime=0)
+        assert gzip.decompress(gz) == uncached
+        gz2, hit = reg.rendered(openmetrics=openmetrics, gzip_level=3)
+        assert hit and gz2 == gz
+
+
+def test_render_cache_invalidates_on_publish():
+    from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+    reg = _populated_registry()
+    before, _ = reg.rendered()
+    reg.publish(SnapshotBuilder().build())
+    after, hit = reg.rendered()
+    assert not hit  # new generation: the cache must not serve old bytes
+    assert after != before
+    assert after == reg.snapshot().render().encode()
+
+
+def test_http_scrape_serves_cached_bytes_identical(tmp_path):
+    """End to end through the production MetricsServer: a gzip scrape
+    and a plain scrape both match the uncached render, and repeated
+    scrapes (cache hits) keep serving the same bytes."""
+    import gzip
+    import urllib.request
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    reg = _populated_registry()
+    uncached = reg.snapshot().render().encode()
+    server = MetricsServer(reg, host="127.0.0.1", port=0)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    try:
+        for _ in range(2):  # second pass is a guaranteed cache hit
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.read() == uncached
+            request = urllib.request.Request(
+                url, headers={"Accept-Encoding": "gzip"})
+            with urllib.request.urlopen(request, timeout=5) as resp:
+                assert resp.headers.get("Content-Encoding") == "gzip"
+                assert gzip.decompress(resp.read()) == uncached
+    finally:
+        server.stop()

@@ -356,6 +356,107 @@ def test_hub_serves_http_with_healthz_staleness(node_stack):
         server.stop()
 
 
+def test_hub_body_cache_reuses_parse_on_unchanged_body(tmp_path):
+    """Zero-reparse ingest (ISSUE 2): a byte-identical response body
+    reuses the previous cycle's parse + merge plan, counted in
+    kts_hub_body_cache_hits_total — and the merged accelerator_*/slice_*
+    output is identical either way."""
+    body = ('accelerator_power_watts{chip="0",worker="0",slice="s"} 100\n'
+            'accelerator_power_watts{chip="1",worker="0",slice="s"} 120\n')
+    (tmp_path / "a.prom").write_text(body)
+    hub = hub_mod.Hub([str(tmp_path / "a.prom")])
+    try:
+        hub.refresh_once()
+        first = hub.registry.snapshot().render()
+        entry = hub._parse_cache[str(tmp_path / "a.prom")]
+        hub.refresh_once()
+        second = hub.registry.snapshot().render()
+        # Same entry object: nothing was re-parsed, the plan replayed.
+        assert hub._parse_cache[str(tmp_path / "a.prom")] is entry
+        assert values(second, "kts_hub_body_cache_hits_total") == [1.0]
+        assert values(first, "kts_hub_body_cache_hits_total") == [0.0]
+
+        def merged(text):
+            return sorted(
+                (name, tuple(sorted(labels.items())), value)
+                for name, labels, value in parse_exposition(text)
+                if name.startswith(("accelerator_", "slice_"))
+                and name != "slice_target_fetch_seconds")  # timing varies
+
+        assert merged(first) == merged(second)
+        # A changed body drops the cache entry and re-parses.
+        (tmp_path / "a.prom").write_text(body.replace("100", "140"))
+        hub.refresh_once()
+        third = hub.registry.snapshot().render()
+        assert hub._parse_cache[str(tmp_path / "a.prom")] is not entry
+        assert values(third, "kts_hub_body_cache_hits_total") == [1.0]
+        assert 140.0 in values(third, "accelerator_power_watts")
+    finally:
+        hub.stop()
+
+
+def test_hub_stat_sig_distrusts_open_mtime_granule(tmp_path):
+    """Racily-clean rule: a file whose mtime granule is still open never
+    earns a stat short-circuit (a coarse-mtime filesystem could take a
+    same-size in-place rewrite the (mtime, size, inode) signature can't
+    see), so a pinned-mtime rewrite is still picked up via the body-hash
+    path; once the mtime is safely old, the signature is trusted."""
+    import os
+
+    path = tmp_path / "a.prom"
+    target = str(path)
+    path.write_text(
+        'accelerator_power_watts{chip="0",worker="0",slice="s"} 100\n')
+    hub = hub_mod.Hub([target])
+    try:
+        hub.refresh_once()
+        entry = hub._parse_cache[target]
+        assert entry.stat_sig is None  # mtime granule still open
+        # Same-size in-place rewrite with the mtime PINNED to the old
+        # value — what a whole-second-mtime filesystem shows when both
+        # writes land in one granule. The hub must see the new value.
+        st = path.stat()
+        path.write_text(
+            'accelerator_power_watts{chip="0",worker="0",slice="s"} 120\n')
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+        hub.refresh_once()
+        assert 120.0 in values(hub.registry.snapshot().render(),
+                               "accelerator_power_watts")
+        # An old mtime closes the granule: the next refresh's body-hash
+        # hit adopts a trusted signature for the stat fast path.
+        old = time.time_ns() - 10 * hub_mod._STAT_SIG_SETTLE_NS
+        os.utime(path, ns=(old, old))
+        hub.refresh_once()
+        assert hub._parse_cache[target].stat_sig is not None
+    finally:
+        hub.stop()
+
+
+def test_hub_target_churn_evicts_all_per_target_caches(tmp_path):
+    """_refresh_targets drops dead targets from _hist_cache; the
+    body/parse caches must evict on the same path or a churning
+    discovered target list leaks an entry (body + merge plan) per
+    departed pod."""
+    a, b = str(tmp_path / "a.prom"), str(tmp_path / "b.prom")
+    for path in (a, b):
+        (tmp_path / path.rsplit("/", 1)[1]).write_text(
+            'accelerator_workload_steps_total'
+            '{chip="0",worker="0",slice="s"} 5\n')
+    targets = [[a, b]]
+    hub = hub_mod.Hub([], targets_provider=lambda: list(targets[0]))
+    try:
+        hub.refresh_once()
+        assert set(hub._parse_cache) == {a, b}
+        assert set(hub._hist_cache) == {a, b}
+        targets[0] = [a]  # pod b departs discovery
+        hub.refresh_once()
+        assert set(hub._parse_cache) == {a}
+        assert set(hub._hist_cache) == {a}
+        assert b not in hub._breakers
+    finally:
+        hub.stop()
+
+
 def test_hub_push_modes_ship_merged_snapshot(node_stack):
     # The hub as slice-level egress: a PublishFollower sender attached to
     # the hub registry ships the merged exposition (rollups + per-chip).
@@ -498,6 +599,148 @@ def test_hub_hung_file_target_cannot_wedge_refresh(tmp_path):
         assert ups[str(fifo)] == 0.0
         assert any("still running" in e for e in frame.errors)
     finally:
+        hub.stop()
+
+
+def test_hub_hung_stat_sweep_does_not_starve_other_sweeps(
+        tmp_path, monkeypatch):
+    """A stat hung on a dead mount must cost only its own sweep: the
+    other sweeps' misses get their read chunks submitted the moment
+    each sweep resolves — not after the hung sweep's deadline, which
+    would time the reads out and mark healthy targets down (and feed
+    their breakers) for sharing a refresh with the hang."""
+    import os as os_mod
+    import threading
+
+    line = 'accelerator_up{{chip="0",worker="{w}",slice="s"}} 1\n'
+    paths = []
+    old = time.time_ns() - 10 * hub_mod._STAT_SIG_SETTLE_NS
+    for worker in range(8):
+        path = tmp_path / f"w{worker}.prom"
+        path.write_text(line.format(w=worker))
+        os_mod.utime(path, ns=(old, old))
+        paths.append(path)
+    hub = hub_mod.Hub([str(p) for p in paths], fetch_timeout=0.1)
+    release = threading.Event()
+    try:
+        # First refresh caches every target with a trusted stat_sig
+        # (mtimes are backdated past the settle window).
+        hub.refresh_once()
+        assert all(hub._parse_cache[str(p)].stat_sig is not None
+                   for p in paths)
+        # Rewrite one target per non-first sweep (8 targets / 4 ways =
+        # sweeps of 2: w0-w1, w2-w3, ...) so those sweeps report misses
+        # that need read chunks; backdate so the granule stays closed.
+        chip1 = 'accelerator_up{{chip="1",worker="{w}",slice="s"}} 1\n'
+        for worker in (3, 5, 7):
+            paths[worker].write_text(line.format(w=worker)
+                                     + chip1.format(w=worker))
+            os_mod.utime(paths[worker], ns=(old, old))
+        # w0's stat hangs (dead-NFS stand-in) — it leads sweep 0.
+        real_stat = os_mod.stat
+
+        def hanging_stat(path, *args, **kwargs):
+            if str(path) == str(paths[0]):
+                release.wait()
+            return real_stat(path, *args, **kwargs)
+
+        monkeypatch.setattr(hub_mod.os, "stat", hanging_stat)
+        start = time.monotonic()
+        frame = hub.refresh_once()
+        assert time.monotonic() - start < 5
+        monkeypatch.setattr(hub_mod.os, "stat", real_stat)
+        text = hub.registry.snapshot().render()
+        ups = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_up"}
+        # Hung member down (and only it is charged a stat stall); its
+        # sweep-mate w1 was queued behind it and is down for this
+        # refresh only. Every other sweep's targets — including the
+        # rewritten ones whose reads chunked mid-wait — stay up.
+        assert ups[str(paths[0])] == 0.0
+        assert ups[str(paths[1])] == 0.0
+        for worker in range(2, 8):
+            assert ups[str(paths[worker])] == 1.0, f"w{worker} marked down"
+        assert any("stat stalled" in e for e in frame.errors)
+        # The rewritten bodies were actually re-read, not served stale:
+        # reachable targets contribute w2,w4,w6 (1 chip) + w3,w5,w7
+        # (2 chips after the rewrite).
+        assert len(values(text, "accelerator_up")) == 3 * 1 + 3 * 2
+    finally:
+        release.set()
+        hub.stop()
+
+
+def test_hub_mid_sweep_hang_salvages_without_spurious_breaker_charge(
+        tmp_path, monkeypatch):
+    """A stat hung mid-sweep leaves complete outcomes in the progress
+    list: salvaged HITS record as up, salvaged MISSES are marked down
+    WITHOUT a breaker charge (reading them would need budget the
+    expired deadline can't fund — chunking post-deadline used to time
+    the read out and charge 'file read stalled' to a healthy target),
+    and the next refresh re-reads the miss cleanly while only the hung
+    member stays guarded."""
+    import os as os_mod
+    import threading
+
+    line = 'accelerator_up{{chip="0",worker="{w}",slice="s"}} 1\n'
+    chip1 = 'accelerator_up{{chip="1",worker="{w}",slice="s"}} 1\n'
+    paths = []
+    old = time.time_ns() - 10 * hub_mod._STAT_SIG_SETTLE_NS
+    for worker in range(8):
+        path = tmp_path / f"w{worker}.prom"
+        path.write_text(line.format(w=worker))
+        os_mod.utime(path, ns=(old, old))
+        paths.append(path)
+    hub = hub_mod.Hub([str(p) for p in paths], fetch_timeout=0.1)
+    release = threading.Event()
+    try:
+        hub.refresh_once()
+        # Sweep 0 is (w0, w1): w0 is rewritten (a statted miss sitting
+        # in progress when the hang strikes at w1, sweep 0's SECOND
+        # member — so the salvage sees one complete miss outcome).
+        paths[0].write_text(line.format(w=0) + chip1.format(w=0))
+        os_mod.utime(paths[0], ns=(old, old))
+        real_stat = os_mod.stat
+
+        def hanging_stat(path, *args, **kwargs):
+            if str(path) == str(paths[1]):
+                release.wait()
+            return real_stat(path, *args, **kwargs)
+
+        monkeypatch.setattr(hub_mod.os, "stat", hanging_stat)
+        frame = hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        ups = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_up"}
+        # w0's miss was salvaged down without a read attempt; w1 (the
+        # hung member) is the only one charged. Everyone else is up.
+        assert ups[str(paths[0])] == 0.0
+        assert ups[str(paths[1])] == 0.0
+        for worker in range(2, 8):
+            assert ups[str(paths[worker])] == 1.0, f"w{worker} marked down"
+        assert any("read skipped" in e and str(paths[0]) in e
+                   for e in frame.errors)
+        assert not any("file read stalled" in e for e in frame.errors)
+        # No breaker charge for the salvaged miss: w1 still hangs (its
+        # guarded fetch is outstanding), yet w0 re-reads cleanly and
+        # serves its NEW body on the very next refresh — an open or
+        # half-charged breaker would have kept it down.
+        frame = hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        ups = {labels["target"]: value
+               for name, labels, value in parse_exposition(text)
+               if name == "slice_target_up"}
+        assert ups[str(paths[0])] == 1.0
+        assert ups[str(paths[1])] == 0.0
+        assert any("still running" in e for e in frame.errors)
+        workers = {labels["worker"]
+                   for name, labels, value in parse_exposition(text)
+                   if name == "accelerator_up" and labels["chip"] == "1"}
+        assert workers == {"0"}  # the rewritten body's new chip landed
+    finally:
+        release.set()
         hub.stop()
 
 
@@ -1192,8 +1435,16 @@ def test_measure_hub_merge_returns_bounded_median():
     from kube_gpu_stats_tpu.bench import measure_hub_merge
 
     # Small shape keeps this fast; the bench runs the full 64x4.
-    ms = measure_hub_merge(workers=4, chips=2, refreshes=2)
-    assert ms is not None and 0.0 < ms < 5000.0
+    result = measure_hub_merge(workers=4, chips=2, refreshes=2)
+    assert result is not None
+    assert 0.0 < result["p50_ms"] < 5000.0
+    assert 0.0 < result["cold_ms"] < 5000.0
+    # Static fixture bodies: refresh 2 hits the body cache on all 4
+    # targets -> 4 hits over 8 fetches.
+    assert result["body_cache_hit_rate"] == 0.5
+    assert result["parse_mb_per_s"] is None or result["parse_mb_per_s"] > 0
+    # 4 back-to-back renders of one generation: 1 miss + 3 hits.
+    assert result["render_cache_hits"] == 3
 
 
 def test_hub_target_breaker_opens_then_recovers(tmp_path):

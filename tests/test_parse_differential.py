@@ -1,0 +1,170 @@
+"""Differential test: the split-based fast tokenizer (validate._parse_series
+and the label fast path in _tokenize_labels) must agree with the regex
+reference implementation (parse_exposition_reference) on EVERY input —
+same triples or the same ValueError verdict. The fast path is allowed to
+be fast only because any line it cannot prove equivalent falls back to
+the reference regex; this suite is the oracle that pins that claim, over
+a hand-built corpus (escapes, exponent floats, NaN/Inf, comments,
+timestamps) plus a seeded random fuzz sweep."""
+
+import math
+import random
+
+import pytest
+
+from kube_gpu_stats_tpu.validate import (parse_exposition,
+                                         parse_exposition_interned,
+                                         parse_exposition_reference)
+
+
+def agree(text: str):
+    """Assert fast and reference parses agree; returns the parse (or None
+    when both reject)."""
+    try:
+        expected = parse_exposition_reference(text)
+    except ValueError:
+        with pytest.raises(ValueError):
+            parse_exposition(text)
+        return None
+    got = parse_exposition(text)
+    assert _canon(got) == _canon(expected), text
+    return got
+
+
+def _canon(series):
+    # NaN != NaN breaks naive equality; compare values by repr.
+    return [(name, labels, repr(value)) for name, labels, value in series]
+
+
+CORPUS = [
+    # Plain series, empty/no labels, trailing whitespace.
+    "m 1",
+    "m{} 1",
+    "m{a=\"b\"} 2.5",
+    "  m{a=\"b\",c=\"d\"} 2.5  ",
+    "m_total{a=\"b\"} 0",
+    # Escaped label values: \" \\ \n stay RAW (neither parser unescapes —
+    # the shared contract both sides must honor).
+    'm{a="x\\"y"} 1',
+    'm{a="back\\\\slash"} 1',
+    'm{a="line\\nbreak"} 1',
+    'm{a="\\\\",b="\\""} 1',
+    # Exponent floats, signs, specials, underscores-in-floats.
+    "m 1e3",
+    "m -2.5e-7",
+    "m +Inf",
+    "m -Inf",
+    "m NaN",
+    "m inf",
+    "m 1_0",
+    # Timestamps (optional trailing ms integer).
+    "m 1 1722249600000",
+    "m{a=\"b\"} 1 -5",
+    "m 1 12.5",     # fractional timestamp: both reject
+    "m 1 2 3",      # too many fields: both reject
+    "m 1 x",        # junk timestamp: both reject
+    # Comments and blanks interleaved.
+    "# HELP m help text\n# TYPE m gauge\nm 1\n\n   \nm2 2",
+    "#",
+    "",
+    "\n\n",
+    # Malformed lines: both must reject identically.
+    "m",
+    "m{a=\"b\"}",
+    "m{a=\"b\"}1",          # missing space after labels
+    "m{a=\"b\" 1",          # unclosed brace
+    "m{a=b} 1",             # unquoted value
+    "{a=\"b\"} 1",          # missing name
+    "9metric 1",            # bad name start... reference: no match
+    "m nope",
+    # Label-grammar corners the fast scanner must flee to the regex on.
+    'm{a="b",,c="d"} 1',    # double comma
+    'm{a="b" ,c="d"} 1',    # space before comma
+    'm{a="b", c="d"} 1',    # space after comma
+    'm{a="b"junk,c="d"} 1',  # junk between pairs
+    'm{a="b",} 1',          # trailing comma
+    'm{a="b"="c"} 1',       # = inside value position
+    'm{a="b",a="c"} 1',     # duplicate label name (last wins, both sides)
+    'm{A_1=""} 1',          # empty value
+    'm{le="+Inf"} 1',
+    # Colons are legal in metric names, not label names.
+    "job:rate:5m 1",
+    'm{a:b="c"} 1',
+]
+
+
+def test_corpus_agreement():
+    for text in CORPUS:
+        agree(text)
+
+
+def test_multiline_document_agreement():
+    # A document containing any malformed line errors in both parsers;
+    # build one from only the individually-parseable lines instead.
+    good = []
+    for line in CORPUS:
+        if "\n" in line:
+            continue
+        try:
+            parse_exposition_reference(line)
+            good.append(line)
+        except ValueError:
+            pass
+    doc = "\n".join(good)
+    agree(doc)
+
+
+def test_interned_view_matches_dict_view():
+    """parse_exposition_interned returns the same series with tuple
+    labels, pointer-shared across calls — the identity contract the
+    hub's merge keys rely on."""
+    text = ('m{a="b",c="d"} 1\n'
+            'm{a="b",c="d"} 2\n'
+            'n{a="b",c="d"} 3\n')
+    interned = parse_exposition_interned(text)
+    plain = parse_exposition(text)
+    assert [(n, dict(l), v) for n, l, v in interned] == plain
+    # Same raw label text -> the SAME tuple object, across series and
+    # across calls (the shared pool).
+    assert interned[0][1] is interned[1][1]
+    assert interned[0][1] is interned[2][1]
+    again = parse_exposition_interned('m{a="b",c="d"} 9\n')
+    assert again[0][1] is interned[0][1]
+    assert again[0][0] is interned[0][0]  # family names interned too
+
+
+def test_special_values_parse_exactly():
+    got = parse_exposition("a NaN\nb +Inf\nc -Inf\n")
+    assert math.isnan(got[0][2])
+    assert got[1][2] == math.inf
+    assert got[2][2] == -math.inf
+
+
+def test_fuzz_agreement_seeded():
+    """Random structured-ish and raw-noise inputs: the two parsers must
+    agree (triples or error) on every one. Seeded for reproducibility."""
+    rng = random.Random(0xD1FF)
+    atoms = ['a="b"', 'x="\\""', 'y="\\\\"', 'z="v\\nw"', 'le="0.5"',
+             'a="b"', ',', ',,', ' ', '=', '"', '\\', 'name', '{', '}']
+    for _ in range(400):
+        kind = rng.randrange(4)
+        if kind == 0:  # clean-ish series line
+            labels = ",".join(
+                f'{rng.choice("abcxyz")}{rng.randrange(9)}="v{rng.random()}"'
+                for _ in range(rng.randrange(0, 5)))
+            value = rng.choice(["1", "2.5", "-3e-2", "NaN", "+Inf", "-Inf",
+                                str(rng.random())])
+            ts = rng.choice(["", " 123", " -9", " 1.5", " x"])
+            text = f"m{{{labels}}} {value}{ts}"
+        elif kind == 1:  # label-grammar soup
+            text = "m{" + "".join(rng.choice(atoms)
+                                  for _ in range(rng.randrange(1, 8))) + "} 1"
+        elif kind == 2:  # raw printable noise
+            text = "".join(chr(rng.randrange(32, 127))
+                           for _ in range(rng.randrange(0, 60)))
+        else:  # multi-line mix with comments
+            text = "\n".join(
+                rng.choice(["# c", "", "m 1", 'm{a="b"} 2',
+                            'm{a="\\""} 3 4', "m nope"])
+                for _ in range(rng.randrange(1, 6)))
+        agree(text)
