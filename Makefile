@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick native dashboard golden clean run-mock ci chaos
+.PHONY: test bench bench-quick profile-tick native dashboard golden clean run-mock ci chaos
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -44,6 +44,14 @@ bench: native
 # BENCH artifact (the line carries quick: true).
 bench-quick: native
 	python bench.py --quick
+
+# Localize a tick regression (<30 s): cProfile over a 200-tick
+# simulated run (8 chips, in-process fake runtime, zero scripted RPC
+# delay so exporter CPU dominates the rows), top-20 by cumulative time.
+# bench-quick says THAT the tick moved; this says WHERE. Add --legacy
+# for an A/B against the pre-plan builder path.
+profile-tick: native
+	python tools/profiler.py --ticks 200 --top 20
 
 native:
 	$(MAKE) -C kube_gpu_stats_tpu/native

@@ -67,6 +67,12 @@ def _quick() -> int:
         "scrape_p50_ms": round(result.get("scrape_p50_ms", 0.0), 3),
         "gc_collections": result.get("gc_collections"),
         "gc_max_pause_ms": result.get("gc_max_pause_ms"),
+        # Tick-plan + batched-RPC pins (ISSUE 3): snapshot objects built
+        # per tick (plan slots re-emit unchanged values) and RPCs per
+        # tick (batched mode: one per port).
+        "tick_alloc_objects_per_tick": result.get(
+            "tick_alloc_objects_per_tick"),
+        "rpc_calls_per_tick": result.get("rpc_calls_per_tick"),
         "mode": result["mode"],
         "chips": result["chips"],
         "quick": True,
@@ -137,6 +143,16 @@ def main() -> int:
         # excursion with gc_max_pause_ms ~0 is NOT the collector.
         "gc_collections": result.get("gc_collections"),
         "gc_max_pause_ms": result.get("gc_max_pause_ms"),
+        # Tick-plan + batched-RPC pins (ISSUE 3): snapshot objects built
+        # per tick (plan slots re-emit unchanged values; the rest of the
+        # snapshot is reused) and RPCs the runtime fetch issues per tick
+        # (batched mode: one per port; 0 families batched = per-metric
+        # burst fallback).
+        "tick_alloc_objects_per_tick": result.get(
+            "tick_alloc_objects_per_tick"),
+        "tick_series_per_tick": result.get("tick_series_per_tick"),
+        "rpc_calls_per_tick": result.get("rpc_calls_per_tick"),
+        "rpc_batched_families": result.get("rpc_batched_families"),
         "mode": result["mode"],
         "path": result.get("path", "fake-grpc"),
         "chips": result["chips"],

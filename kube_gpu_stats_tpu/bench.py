@@ -38,6 +38,7 @@ def _percentile(sorted_values: list[float], q: float) -> float:
 
 
 def measure_collector(collector: Collector, *, ticks: int, warmup: int,
+                      pipeline_fetch: bool = True,
                       extra: dict | None = None) -> dict:
     """Run `warmup + ticks` polls of `collector` through the production loop
     and report the tick-duration distribution in milliseconds, plus the
@@ -51,9 +52,19 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
     import gc
 
     registry = Registry()
-    loop = PollLoop(collector, registry, deadline=10.0)
+    loop = PollLoop(collector, registry, deadline=10.0,
+                    pipeline_fetch=pipeline_fetch)
     durations: list[float] = []
     scrape_ms: list[float] = []
+    # Allocation + transport accounting (ISSUE 3 "pinned, not
+    # anecdotal"): series objects actually constructed per tick (tick
+    # plans re-emit cached Series while a slot's value is unchanged —
+    # see PollLoop.last_tick_stats) and RPCs the runtime fetch issued
+    # per tick (batched mode: one per port; per-metric burst: one per
+    # family per port).
+    alloc_per_tick: list[float] = []
+    rpc_stats = getattr(collector, "rpc_stats", None)
+    rpc_calls_before: int | None = None
     server = MetricsServer(registry, host="127.0.0.1", port=0)
     server.start()
 
@@ -97,8 +108,12 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
         gc.collect()
         gc.freeze()
         gc.callbacks.append(_gc_probe)
+        if rpc_stats is not None:
+            rpc_calls_before = rpc_stats().get("rpc_calls_total", 0)
         for _ in range(ticks):
             durations.append(loop.tick() * 1000.0)
+            alloc_per_tick.append(
+                loop.last_tick_stats.get("series_built", 0))
             if len(scrape_ms) < max_scrapes:
                 scrape_start = time.monotonic()
                 scrape()
@@ -138,7 +153,21 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
         "gc_collections": len(gc_pauses_ms),
         "gc_max_pause_ms": round(max(gc_pauses_ms), 3) if gc_pauses_ms
         else 0.0,
+        # Snapshot objects built per tick (vs re-emitted from plan
+        # slots) — the tick-plan allocation pin: series_reused near the
+        # series count means the plan path is warm.
+        "tick_alloc_objects_per_tick": round(
+            statistics.mean(alloc_per_tick), 1) if alloc_per_tick else None,
+        "tick_series_per_tick": loop.last_tick_stats.get("series"),
+        "tick_series_reused_per_tick": loop.last_tick_stats.get(
+            "series_reused"),
     }
+    if rpc_stats is not None and rpc_calls_before is not None and ticks:
+        result["rpc_calls_per_tick"] = round(
+            (rpc_stats().get("rpc_calls_total", 0) - rpc_calls_before)
+            / ticks, 2)
+        result["rpc_batched_families"] = rpc_stats().get(
+            "batched_families", 0)
     result.update(extra or {})
     return result
 
@@ -183,7 +212,8 @@ def _terminate(proc) -> None:
 
 def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
                         ticks: int = 50, rpc_delay: float = 0.010,
-                        warmup: int = 5, subprocess_server: bool = False) -> dict:
+                        warmup: int = 5, subprocess_server: bool = False,
+                        pipeline_fetch: bool = True) -> dict:
     """Simulated-node harness: fake libtpu server (scripted per-RPC delay)
     + sysfs fixture tree, measured through the production stack. With
     subprocess_server the fake runtime runs out-of-process like the real
@@ -213,6 +243,7 @@ def run_latency_harness(workdir: Path | str, *, num_chips: int = 8,
         )
         return measure_collector(
             collector, ticks=ticks, warmup=warmup,
+            pipeline_fetch=pipeline_fetch,
             extra={
                 "mode": "simulated",
                 "rpc_delay_ms": rpc_delay * 1000.0,

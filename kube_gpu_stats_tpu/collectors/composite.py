@@ -23,6 +23,10 @@ log = logging.getLogger(__name__)
 
 class TpuCollector(Collector):
     name = "tpu"
+    # wait_ready accepts max_age: the poll loop may run this backend in
+    # pipelined-tick mode (serve the last completed fetch, let the
+    # in-flight RPC land during the inter-tick idle).
+    pipelined_wait = True
 
     def __init__(
         self,
@@ -58,8 +62,9 @@ class TpuCollector(Collector):
     def begin_tick(self) -> None:
         self._libtpu.begin_tick()
 
-    def wait_ready(self, timeout: float | None = None) -> None:
-        self._libtpu.wait_ready(timeout)
+    def wait_ready(self, timeout: float | None = None,
+                   max_age: float | None = None) -> None:
+        self._libtpu.wait_ready(timeout, max_age)
 
     def sample(self, device: Device) -> Sample:
         # sysfs first: the libtpu sample joins the tick's in-flight batched
@@ -138,6 +143,16 @@ class TpuCollector(Collector):
     def breakers(self):
         """Per-port runtime breakers (supervisor/doctor resilience)."""
         return self._libtpu.breakers()
+
+    @property
+    def runtime_fetch_seq(self) -> int:
+        """Completed-fetch generation (poll loop: rate-feed dedup)."""
+        return self._libtpu.runtime_fetch_seq
+
+    def rpc_stats(self):
+        """Runtime-transport cost figures (poll loop self-metrics +
+        bench's rpc_calls_per_tick) — the libtpu half owns all RPCs."""
+        return self._libtpu.rpc_stats()
 
     def close(self) -> None:
         self._libtpu.close()

@@ -21,6 +21,7 @@ import functools
 import logging
 import math
 import threading
+import time
 from typing import Mapping, NamedTuple, Sequence
 
 import grpc
@@ -213,6 +214,11 @@ class LibtpuClient:
                  breaker_min_span: float = 2.0) -> None:
         self._rpc_timeout = rpc_timeout
         self.ports = tuple(ports)
+        # RPCs actually issued (breaker-refused calls don't count): the
+        # transport-cost figure behind bench's rpc_calls_per_tick. A
+        # plain int — written on the fetch thread, read anywhere
+        # (GIL-atomic), monotone.
+        self.rpc_calls_total = 0
         # Per-port circuit breakers at the transport layer: a port that
         # keeps failing is refused fast (no RPC, no rpc_timeout spent on
         # it) until the recovery probe; capability answers
@@ -271,7 +277,13 @@ class LibtpuClient:
             )
 
     @staticmethod
-    def _raise_all_failed(metric_name: str, errors: list[Exception]) -> None:
+    def all_failed_error(metric_name: str,
+                         errors: list[Exception]) -> CollectorError:
+        """The every-port-failed CollectorError for one family, carrying
+        the per-port gRPC statuses (None for decode failures): capability
+        latching must see EVERY port answer "don't have it" — a transient
+        outage on one port mixed with UNIMPLEMENTED on another is not a
+        capability answer."""
         first = errors[0]
         exc = CollectorError(
             f"libtpu metric {metric_name!r} unavailable: {first}"
@@ -279,14 +291,14 @@ class LibtpuClient:
         exc.status_code = (
             first.code() if isinstance(first, grpc.Call) else None
         )
-        # Per-port statuses (None for decode failures): capability latching
-        # must see EVERY port answer "don't have it" — a transient outage on
-        # one port mixed with UNIMPLEMENTED on another is not a capability
-        # answer.
         exc.status_codes = tuple(
             e.code() if isinstance(e, grpc.Call) else None for e in errors
         )
-        raise exc
+        return exc
+
+    @staticmethod
+    def _raise_all_failed(metric_name: str, errors: list[Exception]) -> None:
+        raise LibtpuClient.all_failed_error(metric_name, errors)
 
     def _fan_out(self, request: bytes) -> list[tuple[bytes | None, Exception | None]]:
         """Issue the request to every port in parallel (one wedged process
@@ -342,8 +354,16 @@ class LibtpuClient:
 
         pairs = list(zip(self.ports, self._methods))
         if self._port_pool is not None:
-            return list(self._port_pool.map(call, pairs))
-        return [call(pair) for pair in pairs]
+            results = list(self._port_pool.map(call, pairs))
+        else:
+            results = [call(pair) for pair in pairs]
+        # Counted AFTER the gather, on the calling thread: `call` runs on
+        # port-pool workers, where an unlocked += would race away
+        # increments. Breaker-refused calls issued no RPC.
+        self.rpc_calls_total += sum(
+            1 for _, error in results
+            if not isinstance(error, BreakerOpenError))
+        return results
 
     def breakers_by_name(self) -> dict[str, CircuitBreaker]:
         """``{"libtpu:<port>": breaker}`` for the supervisor/doctor
@@ -427,6 +447,85 @@ class LibtpuClient:
             self._raise_all_failed(metric_name, errors)
         return samples
 
+    def get_many(
+        self, metric_names: Sequence[str]
+    ) -> dict[str, tuple[list[tpumetrics.MetricSample], list[Exception]]]:
+        """Pipelined per-metric burst — the transport shape for runtimes
+        that reject the batched "" selector: ONE non-blocking async RPC
+        per (port, family), all issued from the calling thread before any
+        is awaited, so the per-tick transport is a single burst per port
+        (wall cost ≈ one RPC round trip) instead of a worker thread per
+        family. Per-family results — merged samples across ports, the
+        per-port error objects, dialect latching — and per-(port, family)
+        breaker accounting are identical to calling :meth:`get_metric`
+        once per family, so breaker semantics (per-port trip, min
+        failure span absorbing a one-tick burst of failures, half-open
+        granting exactly one probe RPC with the connection-sized
+        deadline) are unchanged."""
+        out: dict[str, tuple[list, list]] = {
+            name: ([], []) for name in metric_names
+        }
+        pending: list[tuple[str, int, object]] = []
+        for port, method in zip(self.ports, self._methods):
+            breaker = self.breakers[port]
+            for name in metric_names:
+                if not breaker.allow():
+                    out[name][1].append(BreakerOpenError(
+                        f"libtpu port {port} circuit open "
+                        f"({breaker.describe()})"))
+                    continue
+                timeout = self._rpc_timeout
+                wait_for_ready = False
+                if breaker.state == HALF_OPEN:
+                    # Recovery probe (allow() grants exactly one per
+                    # half-open window; the rest of the burst is refused
+                    # above): connection-sized deadline, same rationale
+                    # as _fan_out's probe branch.
+                    timeout = max(timeout, self.PROBE_RPC_TIMEOUT)
+                    wait_for_ready = True
+                try:
+                    future = method.future(
+                        tpumetrics.encode_request(name),
+                        timeout=timeout, wait_for_ready=wait_for_ready)
+                except Exception as exc:  # noqa: BLE001 - admitted call
+                    # MUST record an outcome (probe-slot reclaim contract)
+                    breaker.record_failure(exc)
+                    out[name][1].append(exc)
+                    continue
+                # Counted only once .future() accepted the call — a raise
+                # above issued no RPC, and the counter's contract is
+                # "RPCs actually issued".
+                self.rpc_calls_total += 1
+                pending.append((name, port, future))
+        for name, port, future in pending:
+            breaker = self.breakers[port]
+            try:
+                raw = future.result()
+            except grpc.RpcError as exc:
+                if exc.code() in REJECTED_STATUS:
+                    breaker.record_success()
+                else:
+                    breaker.record_failure(exc)
+                out[name][1].append(exc)
+                continue
+            except Exception as exc:  # noqa: BLE001 - see above
+                breaker.record_failure(exc)
+                out[name][1].append(exc)
+                continue
+            breaker.record_success()
+            try:
+                decoded, dialect = tpumetrics.decode_response_ex(
+                    raw, self.port_dialects.get(port)
+                )
+            except (ValueError, OverflowError) as exc:
+                # This PORT is undecodable for this family — the others
+                # still count (same contract as get_metric).
+                out[name][1].append(exc)
+                continue
+            self.note_dialect(port, dialect, raw)
+            out[name][0].extend(decoded)
+        return out
+
     def get_raw_with_errors(
         self, metric_name: str
     ) -> tuple[list[tuple[int, bytes]], list[Exception]]:
@@ -465,9 +564,6 @@ class LibtpuCollector(Collector):
                  passthrough_unknown: bool = False) -> None:
         self._client = client or LibtpuClient(addr, ports, rpc_timeout)
         self._accel_type = accel_type if accel_type is not None else topology.accel_type()
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=len(tpumetrics.ALL_METRICS), thread_name_prefix="libtpu-rpc"
-        )
         # Single-worker executor for the per-tick batched fetch: begin_tick
         # dispatches here and returns immediately so the poll loop's sysfs
         # fan-out overlaps the RPC flight time instead of queueing behind it
@@ -477,6 +573,9 @@ class LibtpuCollector(Collector):
             max_workers=1, thread_name_prefix="libtpu-fetch"
         )
         self._inflight: concurrent.futures.Future | None = None
+        # Fallback fan-out pool for duck-typed clients without get_many
+        # (_fetch_per_metric); never created for the real transport.
+        self._per_metric_pool: concurrent.futures.ThreadPoolExecutor | None = None
         # Fused native decode+ingest when built (native/wirefast.cc); the
         # pure-Python path is the pinned-equivalent fallback. Passthrough
         # mode pins the Python path: the C scan drops unknown names by
@@ -499,6 +598,25 @@ class LibtpuCollector(Collector):
         self._cache_error: CollectorError | None = CollectorError(
             "no libtpu fetch has completed yet"
         )
+        # RPC-cost self-observability (rpc_stats): how many families the
+        # last completed batched fetch carried in its one-RPC-per-port
+        # form (0 = per-metric burst fallback), and how many RPCs the
+        # last fetch issued in total.
+        self._last_batched_families = 0
+        self._last_tick_rpcs = 0
+        # Monotonic completion time of the last finished refresh (0 =
+        # never): wait_ready's pipelined path serves any outcome younger
+        # than its max_age without joining the in-flight fetch. A plain
+        # float — written under the lock with the outcome it stamps,
+        # read lock-free (GIL-atomic; a racy read at worst blocks once).
+        self._last_refresh_done = 0.0
+        # Completed-refresh generation (0 = never; failed outcomes count
+        # — they publish a fresh cache_error). The poll loop keys its
+        # ICI rate-baseline feeds on this: a pipelined tick re-serving
+        # the SAME completed fetch must not feed the rate tracker a
+        # duplicate observation (zero-rate sample now, inflated spike
+        # when the genuinely new counters finally land).
+        self._refresh_seq = 0
         # Tri-state: None = unknown, True/False = whether the runtime
         # answers the empty-selector "all metrics" request. One RPC per tick
         # beats a per-metric fan-out by ~5 round trips; older runtimes that
@@ -604,10 +722,28 @@ class LibtpuCollector(Collector):
         if self._inflight is None or self._inflight.done():
             self._inflight = self._fetch_pool.submit(self._refresh)
 
-    def wait_ready(self, timeout: float | None = None) -> None:
+    def wait_ready(self, timeout: float | None = None,
+                   max_age: float | None = None) -> None:
         """Block until the current tick's fetch (if any) has landed in the
         cache. sample() does this implicitly; tests and probes that assert
-        on post-fetch state call it explicitly."""
+        on post-fetch state call it explicitly.
+
+        ``max_age`` enables the pipelined tick (ISSUE 3): when a fetch
+        COMPLETED within the last ``max_age`` seconds, return immediately
+        and let this tick serve that outcome (data or error — a failed
+        refresh still counts as an answer) while the just-dispatched RPC
+        keeps flying for the next tick. The RPC round trip then overlaps
+        the inter-tick idle instead of sitting inside the tick's latency
+        budget. The trade, documented in docs/OPERATIONS.md: runtime
+        counters (and runtime-death detection) lag the tick by up to
+        ``max_age`` (the poll loop's 2x-interval freshness fence); a
+        cache older than ``max_age`` — wedged or
+        slower-than-interval runtime — falls back to the blocking join
+        so staleness handling engages exactly as without pipelining."""
+        if max_age is not None:
+            done_at = self._last_refresh_done
+            if done_at and time.monotonic() - done_at <= max_age:
+                return
         inflight = self._inflight
         if inflight is not None:
             inflight.result(timeout)
@@ -618,6 +754,11 @@ class LibtpuCollector(Collector):
         cache: dict[int, dict] = {}
         first_error: CollectorError | None = None
         try_per_metric = False
+        rpc_calls_before = getattr(self._client, "rpc_calls_total", 0)
+        # Distinct metric families the batched (one-RPC-per-port) path
+        # actually delivered this tick — the kts_rpc_batched_families
+        # gauge; stays empty in per-metric mode.
+        batched_families: set[str] = set()
         # Set when every port rejected the "" selector this tick; _batched
         # only latches False if the per-metric pass then proves the runtime
         # is actually up (yields data) — a half-initialized runtime briefly
@@ -656,6 +797,16 @@ class LibtpuCollector(Collector):
                     _merge_cache(port_cache, cache)
                     if port_cache:
                         port_devices_seen[port] = set(port_cache)
+                        for entry in port_cache.values():
+                            batched_families.update(entry["values"])
+                            if entry["ici"]:
+                                batched_families.add(tpumetrics.ICI_TRAFFIC)
+                            if entry["collectives"] is not None:
+                                batched_families.add(tpumetrics.COLLECTIVES)
+                            raw = entry.get("raw")
+                            if raw:
+                                batched_families.update(
+                                    family for family, _link in raw)
                 except (ValueError, OverflowError) as exc:
                     # ValueError: different schema / garbled port;
                     # OverflowError: int(inf) on a counter metric.
@@ -698,20 +849,26 @@ class LibtpuCollector(Collector):
                     f"libtpu metric '' unavailable: {decode_error}"
                 )
         if (self._batched is False and first_error is None) or try_per_metric:
-            futures = {
-                name: self._pool.submit(self._client.get_metric, name)
-                for name in tpumetrics.ALL_METRICS
-                if name not in self._unsupported
-            }
+            # Per-metric mode: ONE pipelined RPC burst per port (get_many
+            # issues every family's async call before awaiting any), not
+            # a worker thread per family — same per-family data and
+            # error attribution, transport cost of a single round trip.
+            names = [name for name in tpumetrics.ALL_METRICS
+                     if name not in self._unsupported]
+            burst = self._fetch_per_metric(names)
             unsupported_families: list[str] = []
             rejection_error: CollectorError | None = None
-            for name, future in futures.items():
-                try:
-                    staged: dict[int, dict] = {}
-                    for s in future.result():
-                        _ingest_sample(s, staged)
-                    _merge_cache(staged, cache)
-                except CollectorError as exc:
+            for name in names:
+                samples, errors = burst[name]
+                if errors and not samples:
+                    if len(errors) == 1 and isinstance(errors[0],
+                                                       CollectorError):
+                        # Duck-typed client fallback: get_metric already
+                        # built the aggregate error with its per-port
+                        # status attributes.
+                        exc = errors[0]
+                    else:
+                        exc = LibtpuClient.all_failed_error(name, errors)
                     if capability_rejection(exc):
                         # Capability answer from every port, not an outage:
                         # latch candidate, and never the tick's error (the
@@ -723,6 +880,12 @@ class LibtpuCollector(Collector):
                     # counters); a fully-failed fetch poisons the tick below.
                     first_error = first_error or exc
                     log.debug("libtpu fetch of %s failed: %s", name, exc)
+                    continue
+                try:
+                    staged: dict[int, dict] = {}
+                    for s in samples:
+                        _ingest_sample(s, staged)
+                    _merge_cache(staged, cache)
                 except (ValueError, OverflowError) as exc:
                     # Bad value inside one family (int(inf)/int(NaN)):
                     # contain to that family, staged so its leading metrics
@@ -760,6 +923,10 @@ class LibtpuCollector(Collector):
             # now-dead port used to serve is exactly what the staleness
             # escalation needs.
             self._port_devices.update(port_devices_seen)
+            self._last_batched_families = len(batched_families)
+            self._last_tick_rpcs = (
+                getattr(self._client, "rpc_calls_total", 0)
+                - rpc_calls_before)
             if cache:
                 self._cache = cache
                 self._cache_error = None
@@ -768,6 +935,39 @@ class LibtpuCollector(Collector):
                 self._cache_error = first_error or CollectorError(
                     "libtpu returned no samples"
                 )
+            self._last_refresh_done = time.monotonic()
+            self._refresh_seq += 1
+
+    def _fetch_per_metric(
+        self, names: Sequence[str]
+    ) -> Mapping[str, tuple[list, list]]:
+        """Per-metric fetch: the client's pipelined burst when it has
+        one; otherwise (duck-typed clients — tests, alternative
+        transports — that only provide the sync per-family call) one
+        get_metric per family in the same result shape."""
+        get_many = getattr(self._client, "get_many", None)
+        if get_many is not None:
+            return get_many(names)
+
+        def one(name: str) -> tuple[list, list]:
+            try:
+                return (list(self._client.get_metric(name)), [])
+            except CollectorError as exc:
+                return ([], [exc])
+            except (ValueError, OverflowError) as exc:
+                return ([], [exc])
+
+        # Fan the families out on a (lazily created, reused) pool: a
+        # wedged runtime must cost ~one rpc_timeout per refresh, not one
+        # per family serially — in blocking mode the serial form would
+        # blow the tick deadline every tick instead of degrading once.
+        if len(names) > 1:
+            if self._per_metric_pool is None:
+                self._per_metric_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(16, len(names)),
+                    thread_name_prefix="libtpu-burst")
+            return dict(zip(names, self._per_metric_pool.map(one, names)))
+        return {name: one(name) for name in names}
 
     def sample(self, device: Device) -> Sample:
         inflight = self._inflight
@@ -857,7 +1057,26 @@ class LibtpuCollector(Collector):
         """Per-port circuit breakers (supervisor/doctor resilience)."""
         return self._client.breakers_by_name()
 
+    @property
+    def runtime_fetch_seq(self) -> int:
+        """Generation of the last completed refresh (0 = none yet)."""
+        return self._refresh_seq
+
+    def rpc_stats(self) -> Mapping[str, int]:
+        """Transport-cost self-observability: cumulative RPCs issued,
+        RPCs the last fetch cost, and how many families the last batched
+        fetch carried per single RPC (0 = per-metric burst fallback —
+        the kts_rpc_batched_families gauge)."""
+        return {
+            # getattr: duck-typed clients (tests, alternative transports)
+            # may not carry the counter — same guard _refresh uses.
+            "rpc_calls_total": getattr(self._client, "rpc_calls_total", 0),
+            "rpc_calls_last_tick": self._last_tick_rpcs,
+            "batched_families": self._last_batched_families,
+        }
+
     def close(self) -> None:
-        self._pool.shutdown(wait=False, cancel_futures=True)
         self._fetch_pool.shutdown(wait=False, cancel_futures=True)
+        if self._per_metric_pool is not None:
+            self._per_metric_pool.shutdown(wait=False, cancel_futures=True)
         self._client.close()

@@ -53,6 +53,10 @@ class Config:
     checkpoint_path: str = DEFAULT_CHECKPOINT
     attribution_interval: float = 10.0
     rediscovery_interval: float = 60.0  # 0 disables hotplug re-enumeration
+    pipeline_fetch: bool = True  # tick serves the last completed runtime
+    #                              fetch/env round (RPC + file IO overlap
+    #                              the inter-tick idle); False joins this
+    #                              tick's own fetch (pre-ISSUE-3 behavior)
     drop_labels: tuple[str, ...] = ()  # label keys emitted as "" (cardinality)
     metrics_include: tuple[str, ...] = ()  # family allowlist (() = all)
     metrics_exclude: tuple[str, ...] = ()  # family denylist
@@ -224,6 +228,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rediscovery-interval", type=float,
                    default=float(_env("REDISCOVERY_INTERVAL", "60.0")),
                    help="device re-enumeration cadence seconds; 0 disables")
+    p.add_argument("--no-pipeline-fetch", action="store_true",
+                   default=_env_bool("NO_PIPELINE_FETCH"),
+                   help="join each tick's own runtime fetch + sysfs round "
+                        "instead of serving the last completed one "
+                        "(pipelined mode keeps the RPC/file-IO flight out "
+                        "of the tick latency budget; values then lag the "
+                        "tick by up to the freshness fence, 2x the poll "
+                        "interval)")
     p.add_argument("--drop-labels", default=_env("DROP_LABELS", ""),
                    help="comma-separated label keys to blank out (emitted as "
                         "empty strings for cardinality control, e.g. "
@@ -439,6 +451,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         checkpoint_path=args.checkpoint_path,
         attribution_interval=args.attribution_interval,
         rediscovery_interval=args.rediscovery_interval,
+        pipeline_fetch=not args.no_pipeline_fetch,
         drop_labels=drop_labels,
         metrics_include=metrics_include,
         metrics_exclude=metrics_exclude,

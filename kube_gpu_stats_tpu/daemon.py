@@ -224,6 +224,7 @@ class Daemon:
             topology_labels=topology.topology_labels(use_metadata=True),
             version=__version__,
             rediscovery_interval=cfg.rediscovery_interval,
+            pipeline_fetch=cfg.pipeline_fetch,
             drop_labels=cfg.drop_labels,
             disabled_metrics=cfg.disabled_metrics,
             process_openers=self.procwatch.lookup if self.procwatch else None,

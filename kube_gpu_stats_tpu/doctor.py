@@ -366,7 +366,11 @@ def check_poll(cfg: Config, ticks: int = 5) -> list[CheckResult]:
                         f"collector construction failed: {exc}")]
     try:
         registry = Registry()
-        loop = PollLoop(collector, registry, deadline=cfg.deadline)
+        # Blocking ticks for diagnosis: each diagnostic tick must join
+        # ITS OWN fetch so the reported p50 prices the full transport,
+        # not the pipelined fast path serving a previous fetch.
+        loop = PollLoop(collector, registry, deadline=cfg.deadline,
+                        pipeline_fetch=False)
         if not loop.devices:
             return [
                 _result(

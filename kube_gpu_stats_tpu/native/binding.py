@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import glob
+import threading
 from pathlib import Path
 
 from .. import schema
@@ -55,7 +56,8 @@ class _DevicePlan:
     the pure-Python path retries the whole chain per tick, so a plan
     that pinned a dead file would diverge from it permanently."""
 
-    __slots__ = ("metrics", "paths", "scales", "c_scales")
+    __slots__ = ("metrics", "paths", "scales", "c_scales", "values", "ok",
+                 "lock")
 
     def __init__(self, accel_dir: Path) -> None:
         self.metrics: list[str] = []
@@ -84,6 +86,18 @@ class _DevicePlan:
         self.paths = (ctypes.c_char_p * n)(*paths)
         # Constant per plan — built once, not per tick.
         self.c_scales = (ctypes.c_double * n)(*self.scales)
+        # Per-tick output scratch, owned by the plan (tick-plan
+        # allocation discipline): the C call overwrites these every tick
+        # instead of allocating two fresh ctypes arrays per device per
+        # tick. Guarded by `lock`, not by poll.py's _outstanding guard
+        # alone: a loop thread superseded by the watchdog BEFORE its
+        # futures reach _outstanding leaves reads the replacement thread
+        # can't see, so two workers can be inside kts_read_scaled for
+        # the same device (ctypes drops the GIL) — unserialized they
+        # would interleave two ticks' readings into one export.
+        self.values = (ctypes.c_double * n)()
+        self.ok = (ctypes.c_ubyte * n)()
+        self.lock = threading.Lock()
 
 
 class NativeSysfsCollector(SysfsCollector):
@@ -118,10 +132,14 @@ class NativeSysfsCollector(SysfsCollector):
             if not self.accel_dir(device).exists():
                 raise CollectorError(f"{self.accel_dir(device)} vanished")
             return {}
-        values = (ctypes.c_double * n)()
-        ok = (ctypes.c_ubyte * n)()
-        successes = self._lib.kts_read_scaled(plan.paths, plan.c_scales, n,
-                                              values, ok)
+        with plan.lock:
+            values = plan.values
+            ok = plan.ok
+            successes = self._lib.kts_read_scaled(plan.paths, plan.c_scales,
+                                                  n, values, ok)
+            result = {
+                plan.metrics[i]: values[i] for i in range(n) if ok[i]
+            }
         if successes < n:
             # Any pinned file failing (hwmon renumbering, -EIO onset):
             # rebuild next tick so the plan re-probes alternates — the
@@ -133,9 +151,7 @@ class NativeSysfsCollector(SysfsCollector):
             # namespace teardown) — surface staleness, then let the caller
             # rediscover.
             raise CollectorError(f"{self.accel_dir(device)} vanished")
-        return {
-            plan.metrics[i]: values[i] for i in range(n) if ok[i]
-        }
+        return result
 
     def sample(self, device: Device) -> Sample:
         return Sample(device=device, values=self.read_environment(device))

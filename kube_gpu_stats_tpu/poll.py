@@ -11,6 +11,31 @@ Budget (BASELINE.md): all per-chip metrics at 1 Hz with p50 tick latency
 - any per-device failure marks that device stale (accelerator_up 0) and the
   loop keeps running: a DaemonSet pod must survive libtpu restarts and
   kubelet socket loss (SURVEY.md §5).
+
+Tick plans (ISSUE 3, the PR-2 "stop redoing work that didn't change"
+playbook applied to the tick itself): per-device *series plans* — label
+tuples pre-joined, series prefixes pre-rendered into the render cache,
+per-slot Series objects reused while their value is unchanged — are
+compiled once and invalidated only on device churn (rediscover), an
+attribution change for that device, or a drop-label/metric-filter
+reconfig. The snapshot build then writes values into plan slots instead of
+rebuilding every label list per tick. The pre-plan builder path is
+retained as ``_emit_device_legacy`` — the differential-test oracle
+(tests/test_tick_plan_differential.py pins the two paths byte-identical),
+mirroring ``parse_exposition_reference``.
+
+Pipelined sampling (ISSUE 3, default on): for split backends
+(TpuCollector) a tick dispatches the next runtime fetch and sysfs read
+round, then assembles from the last COMPLETED ones — the RPC flight and
+the file-IO syscall burst overlap the inter-tick idle instead of
+serializing inside the tick. Freshness fence: completed state older than
+2x the interval re-engages the blocking fan-out (and every deadline/
+staleness mechanism with it), so values lag the tick by at most the
+fence (two intervals) and a wedged runtime degrades exactly as in
+blocking mode, within two ticks of when blocking mode would have
+flagged it. ``pipeline_fetch=False`` restores the
+join-this-tick's-fetch contract (doctor uses it for honest transport
+timing; tests/test_fault_injection.py pins both contracts).
 """
 
 from __future__ import annotations
@@ -19,13 +44,14 @@ import concurrent.futures
 import logging
 import threading
 import time
-from typing import Callable, Mapping, Protocol, Sequence
+from typing import Callable, Mapping, NamedTuple, Protocol, Sequence
 
-from . import schema
+from . import procstats, schema
 from .collectors import Collector, CollectorError, Device, Sample
 from .ici import RateTracker
 from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
-                       SnapshotBuilder, contribute_push_stats)
+                       Series, SnapshotBuilder, _series_prefix,
+                       contribute_push_stats)
 from .resilience import DeadlineBudget
 from .workers import DaemonSamplerPool
 
@@ -45,6 +71,193 @@ class AttributionProvider(Protocol):
 class NullAttribution:
     def lookup(self, device: Device) -> Mapping[str, str]:
         return {}
+
+
+class _SeriesSlot:
+    """One compiled emit slot: the label tuples for a (device, family)
+    pair in both healthy and stale shapes, plus the last Series emitted
+    per shape. While the value is unchanged tick over tick the cached
+    (immutable) Series object is re-emitted — zero allocation; on change
+    one Series is built and the shared alloc cell counts it."""
+
+    __slots__ = ("spec", "labels", "labels_stale", "_last", "_last_stale",
+                 "_cell")
+
+    def __init__(self, spec: schema.MetricSpec,
+                 labels: tuple[tuple[str, str], ...],
+                 labels_stale: tuple[tuple[str, str], ...],
+                 cell: list[int]) -> None:
+        self.spec = spec
+        self.labels = labels
+        self.labels_stale = labels_stale
+        self._last: Series | None = None
+        self._last_stale: Series | None = None
+        self._cell = cell
+        # Pre-render the series prefixes now (compile time, off the tick
+        # path) so the first scrape of a fresh plan is a render-cache
+        # hit, not a label-escaping pass.
+        _series_prefix(spec.name, labels)
+        if labels_stale is not labels:
+            _series_prefix(spec.name, labels_stale)
+
+    def emit(self, value: float, stale: bool) -> Series:
+        value = float(value)
+        if stale:
+            s = self._last_stale
+            if s is None or s.value != value:
+                s = Series(self.spec, self.labels_stale, value)
+                self._last_stale = s
+                self._cell[0] += 1
+            return s
+        s = self._last
+        if s is None or s.value != value:
+            s = Series(self.spec, self.labels, value)
+            self._last = s
+            self._cell[0] += 1
+        return s
+
+
+class _DevicePlan:
+    """Compiled per-device tick plan: the base/stale label tuples, one
+    slot per known metric family (including percentile expansions), and
+    lazily-grown slot maps for the per-link / passthrough / process-
+    holder families whose label dimensions are only known at runtime.
+    Valid for exactly one attribution key; the loop recompiles on any
+    change (device churn, attribution epoch, reconfig)."""
+
+    # Lazy slot maps are bounded: link/raw dimensions are already capped
+    # upstream (_MAX_ICI_LINKS / _MAX_RAW_FAMILIES), process holders by
+    # procopen's per-device cap — this is a second fence so a churning
+    # dimension can never grow a plan without bound (overflow emits
+    # uncached, still correct).
+    _MAX_LAZY_SLOTS = 512
+
+    __slots__ = ("key", "base", "gbase", "emit", "up", "restarts", "energy",
+                 "collectives", "memory_total", "_ici", "_raw", "_holders",
+                 "_cell", "cfg_gen", "ici_traffic_on", "ici_bw_on",
+                 "raw_on", "holders_on")
+
+    def __init__(self, dev: Device, key: tuple,
+                 attribution: Mapping[str, str],
+                 topology: Mapping[str, str],
+                 drop_labels: frozenset[str],
+                 disabled: frozenset[str],
+                 cell: list[int]) -> None:
+        labels = [
+            ("accel_type", dev.accel_type),
+            ("chip", str(dev.index)),
+            ("device_path", dev.device_path),
+            ("uuid", dev.uuid),
+        ]
+        for k in schema.ATTRIBUTION_LABELS:
+            labels.append((k, attribution.get(k, "")))
+        for k in schema.TOPOLOGY_LABELS:
+            labels.append((k, topology.get(k, "")))
+        if drop_labels:
+            labels = [
+                (k, "" if k in drop_labels else v) for k, v in labels
+            ]
+        self.key = key
+        self.base = tuple(labels)
+        self.gbase = self.base + (("stale", "true"),)
+        self._cell = cell
+        gauge = schema.MetricType.GAUGE
+        # Operator-disabled families are omitted at COMPILE time, not
+        # just dropped by the filtered builder at add time: a slot that
+        # exists would still construct a Series per changing value per
+        # tick only to have it discarded, which both wastes the work the
+        # plan path exists to avoid and corrupts the series_built/
+        # series_reused accounting (built > emitted). reconfigure()
+        # invalidates every plan, so the set is fixed for a plan's life.
+        emit: dict[str, _SeriesSlot] = {}
+        for spec in schema.PER_DEVICE_METRICS:
+            if spec.type is schema.MetricType.HISTOGRAM:
+                continue
+            if spec.name in disabled:
+                continue
+            stale_labels = self.gbase if spec.type is gauge else self.base
+            emit[spec.name] = _SeriesSlot(spec, self.base, stale_labels, cell)
+        for value_key, (pct_spec, pct) in schema.PERCENTILE_VALUE_KEYS.items():
+            if pct_spec.name in disabled:
+                continue
+            pair = (("percentile", pct),)
+            emit[value_key] = _SeriesSlot(
+                pct_spec, self.base + pair, self.gbase + pair, cell)
+        self.emit = emit
+        self.up = emit[schema.DEVICE_UP.name]  # never filterable
+        self.restarts = emit.get(schema.RUNTIME_RESTARTS.name)
+        self.energy = emit.get(schema.ENERGY.name)
+        self.collectives = emit.get(schema.COLLECTIVE_OPS.name)
+        self.memory_total = emit.get(schema.MEMORY_TOTAL.name)
+        self.ici_traffic_on = schema.ICI_TRAFFIC_TOTAL.name not in disabled
+        self.ici_bw_on = schema.ICI_BANDWIDTH.name not in disabled
+        self.raw_on = schema.PASSTHROUGH.name not in disabled
+        self.holders_on = schema.PROCESS_OPEN.name not in disabled
+        self.cfg_gen = 0  # stamped by _plan_for
+        self._ici: dict[str, tuple[_SeriesSlot, _SeriesSlot]] = {}
+        self._raw: dict[tuple[str, str], _SeriesSlot] = {}
+        self._holders: dict[tuple[str, str, str], _SeriesSlot] = {}
+
+    def ici_slots(self, link: str) -> tuple[_SeriesSlot, _SeriesSlot]:
+        slots = self._ici.get(link)
+        if slots is None:
+            pair = (("link", link),)
+            slots = (
+                _SeriesSlot(schema.ICI_TRAFFIC_TOTAL, self.base + pair,
+                            self.base + pair, self._cell),
+                _SeriesSlot(schema.ICI_BANDWIDTH, self.base + pair,
+                            self.gbase + pair, self._cell),
+            )
+            if len(self._ici) < self._MAX_LAZY_SLOTS:
+                self._ici[link] = slots
+        return slots
+
+    def raw_slot(self, family: str, link: str) -> _SeriesSlot:
+        slot = self._raw.get((family, link))
+        if slot is None:
+            pair = (("family", family), ("link", link))
+            slot = _SeriesSlot(schema.PASSTHROUGH, self.base + pair,
+                               self.gbase + pair, self._cell)
+            if len(self._raw) < self._MAX_LAZY_SLOTS:
+                self._raw[(family, link)] = slot
+        return slot
+
+    def holder_slot(self, pid: str, comm: str, pod_uid: str) -> _SeriesSlot:
+        key = (pid, comm, pod_uid)
+        slot = self._holders.get(key)
+        if slot is None:
+            labels = self.base + (("pid", pid), ("comm", comm),
+                                  ("pod_uid", pod_uid))
+            slot = _SeriesSlot(schema.PROCESS_OPEN, labels, labels,
+                               self._cell)
+            if len(self._holders) >= self._MAX_LAZY_SLOTS:
+                # Holder keys churn (pids of dead processes linger for
+                # the plan's life) — unlike the pre-capped link/raw
+                # dimensions. At saturation, dump the map and let the
+                # live holders re-cache over the next ticks: bounded
+                # memory either way, but a saturated map would otherwise
+                # rebuild every NEW holder's labels per tick forever.
+                self._holders.clear()
+            self._holders[key] = slot
+        return slot
+
+
+class _TickDevice(NamedTuple):
+    """One device's derived per-tick data: everything the emitters need,
+    computed (with all state mutation) once in _update_tick_state so the
+    plan and legacy emitters are pure functions of it — the property the
+    differential oracle depends on."""
+
+    dev: Device
+    sample: Sample | None
+    plan: _DevicePlan
+    stale: bool
+    retained_total: float | None  # emit MEMORY_TOTAL from retained state
+    restarts: float
+    energy: float | None          # None = never observed power: no series
+    ici: tuple[tuple[str, int, float | None], ...]  # (link, counter, rate)
+    raw: tuple[tuple[str, str, float], ...]  # admitted (family, link, value)
+    holders: Sequence[tuple[str, str, str, float]] | None
 
 
 class PollLoop:
@@ -68,6 +281,8 @@ class PollLoop:
         render_stats: Callable[[SnapshotBuilder], None] | None = None,
         health_stats: Callable[[SnapshotBuilder], None] | None = None,
         heartbeat: Callable[[], None] | None = None,
+        use_tick_plan: bool = True,
+        pipeline_fetch: bool = True,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -87,6 +302,10 @@ class PollLoop:
         # the builder silently drops. Resolved + validated by
         # schema.resolve_metric_filter at config time.
         self._disabled_metrics = frozenset(disabled_metrics)
+        # Generation of the metric-filter config: per-thread cached
+        # SnapshotBuilders (_emit_snapshot) embed the filter set, so a
+        # reconfigure bumps this and every thread rebuilds its builder.
+        self._filter_gen = 0
         # Cached device→holding-process map (procopen.py); a dict read,
         # same off-hot-path contract as attribution. None = disabled.
         self._process_openers = process_openers
@@ -105,6 +324,21 @@ class PollLoop:
         # a tick wedged inside a blocking call no timeout covers is
         # detected (and the loop respawned) by the watchdog.
         self._heartbeat = heartbeat
+        # Escape hatch + differential oracle: False routes every tick
+        # through the pre-plan builder path (_emit_device_legacy).
+        self._use_tick_plan = use_tick_plan
+        # Pipelined runtime fetch (split backends advertising
+        # pipelined_wait): a tick serves the last COMPLETED fetch while
+        # this tick's RPC lands during the inter-tick idle, so the RPC
+        # round trip stops living inside the tick budget. The freshness
+        # fence: a cache older than 2 intervals re-engages the blocking
+        # join (and with it the deadline/staleness machinery), so a
+        # wedged runtime degrades exactly as in blocking mode, within
+        # two ticks of when blocking mode would have flagged it (the
+        # fence is deliberately 2x, not 1x, so steady-state jitter in
+        # fetch completion does not flap the fast path off). False
+        # restores join-this-tick's-fetch.
+        self._fetch_max_age = 2.0 * interval if pipeline_fetch else None
         self._clock = clock
 
         self._devices: Sequence[Device] = collector.discover()
@@ -114,6 +348,21 @@ class PollLoop:
         # sick backend would make the process unkillable (workers.py).
         self._pool = DaemonSamplerPool(workers, thread_name_prefix="sampler")
         self._rates = RateTracker()
+        # Per-device (fetch_generation, ((link, counter, rate), ...))
+        # from the last tick that fed this device's counters: a
+        # pipelined tick re-serving the same fetch replays the tuple
+        # instead of feeding the rate tracker a duplicate observation
+        # (which would emit a bogus zero rate and reset the baseline
+        # under the genuinely-new counters that follow). Generation-
+        # stamped so a device that missed a generation's first fold
+        # (stuck) is fed, not replayed from an older generation.
+        self._ici_memo: dict[str, tuple] = {}
+        self._runtime_seq_seen: int | None = None
+        # This tick's captured fetch generation lives on _tls (set at
+        # each sampling path's wait_ready join, consumed by
+        # _update_tick_state): per-thread like the sampling scratch, so
+        # a superseded loop thread unwedging mid-tick cannot overwrite
+        # the fresh thread's capture and defeat the rate-feed dedup.
         # Futures for samples that missed their deadline but are still
         # running: future.cancel() cannot stop a running call, so until it
         # finishes we must not submit another sample for that device or a
@@ -145,11 +394,47 @@ class PollLoop:
         # observe.
         self._energy: dict[str, float] = {}
         self._last_power_at: dict[str, float] = {}
-        # Label-list cache: attribution changes on the C3 refresh cadence
-        # (~10 s), not per tick, so the per-device label list is identical
-        # tick over tick. Keyed by the attribution items so a pod churn
-        # invalidates exactly that device's entry.
-        self._label_cache: dict[str, tuple[tuple, list[tuple[str, str]]]] = {}
+        # Compiled tick plans, one per device (replaces the old bare
+        # label-list cache): attribution changes on the C3 refresh
+        # cadence (~10 s), not per tick, so a plan survives thousands of
+        # ticks. Keyed by device_id; the stored attribution key detects
+        # a changed join for the SAME device (empty→populated pod and
+        # back included — tests/test_poll.py pins the transitions).
+        self._plans: dict[str, _DevicePlan] = {}
+        self._plan_compiles: dict[str, int] = {}
+        self._plan_cache_hits = 0
+        # Shared allocation cell: slots bump [0] when they CONSTRUCT a
+        # Series (a changed value); unchanged values re-emit the cached
+        # object. Reset per tick; last_tick_stats reports it.
+        self._built_cell: list[int] = [0]
+        # Process self-metrics, pipelined like the runtime fetch: a pool
+        # task reads /proc while the device fan-out is in flight and the
+        # snapshot folds the last COMPLETED reading — the ~20 /proc
+        # syscalls (the hub prefetches them for the same reason) stop
+        # living inside the serialized build phase. First tick reads
+        # inline so the families exist from the first snapshot.
+        self._procstats: Mapping[str, float] | None = None
+        self._proc_future: concurrent.futures.Future | None = None
+        # Pipelined environment rounds (split backends, pipeline_fetch):
+        # the per-device sysfs reads of round N run on the pool while
+        # tick N assembles from round N-1's completed results — the same
+        # age fence as the runtime fetch, so the file-IO syscall burst
+        # joins the RPC round trip OUTSIDE the tick's latency budget.
+        # device_id -> (env dict, error); at == 0 means never completed.
+        self._env_round: dict[str, concurrent.futures.Future] | None = None
+        self._env_results: dict[str, tuple[dict, Exception | None]] = {}
+        self._env_results_at = 0.0
+        self.last_tick_stats: dict[str, float] = {}
+        # Per-thread sampling scratch (futures dict + index-slotted
+        # results list) reused across ticks. Thread-local, not plain
+        # attributes: a superseded loop thread unwedging mid-tick runs
+        # concurrently with its replacement (crash-only supervision),
+        # and the two must never share mutable tick scratch.
+        self._tls = threading.local()
+        # Emit order: results are assembled by slot (rank of the
+        # device's index) instead of sorted per tick.
+        self._slot_of: dict[str, int] = {}
+        self._rebuild_slots()
         # Passthrough families (Sample.raw_values) admitted so far, capped
         # so a hostile/buggy runtime can't mint unbounded series or grow
         # this set unboundedly via unique-name churn (over-cap names are
@@ -178,6 +463,20 @@ class PollLoop:
         outstanding; the old collector is closed on the loop thread."""
         self._pending_collector = collector
 
+    def reconfigure(self, *, drop_labels: Sequence[str] | None = None,
+                    disabled_metrics: frozenset[str] | None = None) -> None:
+        """Apply a label-drop / metric-filter reconfiguration. Every
+        compiled plan embeds both, so bumping the config generation
+        invalidates all of them: each device recompiles lazily on its
+        next tick, counted under the 'reconfig' reason — the compile
+        burst is attributed to its true cause, not mistaken for device
+        churn."""
+        if drop_labels is not None:
+            self._drop_labels = frozenset(drop_labels)
+        if disabled_metrics is not None:
+            self._disabled_metrics = frozenset(disabled_metrics)
+        self._filter_gen += 1
+
     def _apply_pending_collector(self) -> None:
         pending = self._pending_collector
         if pending is None:
@@ -185,12 +484,29 @@ class PollLoop:
         self._pending_collector = None
         old = self._collector
         self._collector = pending
+        # The new backend's fetch generations are unrelated to the old
+        # one's (a coinciding value must not replay the old collector's
+        # memoized ICI tuples as this backend's rates).
+        self._ici_memo.clear()
+        self._runtime_seq_seen = None
+        self._tls.tick_runtime_seq = None
         try:
             old.close()
         except Exception:  # noqa: BLE001 - old backend teardown is best-effort
             log.warning("old backend close failed during upgrade", exc_info=True)
         log.info("backend upgraded: %s -> %s", old.name, pending.name)
         self.rediscover()
+
+    def _rebuild_slots(self) -> None:
+        """Map device_id -> emit slot (rank by chip index, ties keeping
+        discovery order): _sample_all assembles results straight into
+        their slots, replacing the old per-tick sort."""
+        order = sorted(range(len(self._devices)),
+                       key=lambda i: self._devices[i].index)
+        self._slot_of = {
+            self._devices[i].device_id: slot
+            for slot, i in enumerate(order)
+        }
 
     def rediscover(self) -> None:
         """Re-enumerate devices (startup, periodic, explicit recovery; never
@@ -206,16 +522,30 @@ class PollLoop:
                         len(self._devices), exc)
             return
         # Device identity (path, uuid, index) may have changed for a
-        # surviving device_id after a runtime restart; rebuild all label
-        # lists rather than reason about which survived (off hot path).
-        self._label_cache.clear()
+        # surviving device_id after a runtime restart; recompile all tick
+        # plans rather than reason about which survived (off hot path).
+        self._plans.clear()
+        self._rebuild_slots()
+        # Pipelined-environment state is per-device-identity too: drop
+        # completed results wholesale (a renumbered chip must not serve
+        # another chip's environment) and demote the in-flight round's
+        # unfinished reads to the outstanding guard so a wedged backend
+        # can't be handed a second worker by the next blocking fan-out.
+        self._env_results.clear()
+        self._env_results_at = 0.0
+        if self._env_round is not None:
+            for device_id, future in self._env_round.items():
+                if not future.done():
+                    self._outstanding.setdefault(device_id, future)
+            self._env_round = None
         alive = {dev.device_id for dev in self._devices}
         # Purge over the UNION of per-device state: a device may exist
         # in one dict and not another (a degraded-for-life chip carries
         # power/energy but never MEMORY_TOTAL), and a renumbered chip
         # must never inherit another chip's counter baseline.
         state_dicts = (self._last_totals, self._last_uptime,
-                       self._restarts, self._energy, self._last_power_at)
+                       self._restarts, self._energy, self._last_power_at,
+                       self._ici_memo)
         known = set().union(*(d.keys() for d in state_dicts))
         for device_id in known - alive:
             self._rates.forget_device(device_id)
@@ -327,7 +657,21 @@ class PollLoop:
 
     # -- internals -----------------------------------------------------------
 
+    def _tick_scratch(self) -> tuple[dict, list]:
+        """Per-thread reusable sampling containers (satellite: no fresh
+        futures dict / results list / per-tick sort). Thread-local so a
+        superseded-but-unwedged loop thread can't corrupt the fresh
+        thread's in-progress tick (see _tick_as)."""
+        tls = self._tls
+        futures = getattr(tls, "futures", None)
+        if futures is None:
+            futures = tls.futures = {}
+            tls.results = []
+        return futures, tls.results
+
     def _sample_all(self) -> list[tuple[Device, Sample | None]]:
+        if self._process_metrics and self._proc_future is None:
+            self._proc_future = self._pool.submit(procstats.read)
         if not self._devices:
             return []
         self._collector.begin_tick()
@@ -342,8 +686,19 @@ class PollLoop:
         )
         work = (self._collector.read_environment if split
                 else self._collector.sample)
-        futures: dict[concurrent.futures.Future, Device] = {}
-        results: list[tuple[Device, Sample | None]] = []
+        futures, results = self._tick_scratch()
+        futures.clear()
+        slot_of = self._slot_of
+        if len(results) != len(self._devices):
+            results[:] = [None] * len(self._devices)
+        # Single gate for both the fast-path entry and the blocking
+        # fallback's age-fenced wait below — they must always agree.
+        pipelined = (split and self._fetch_max_age is not None
+                     and getattr(self._collector, "pipelined_wait", False))
+        if pipelined:
+            fast = self._sample_pipelined(results)
+            if fast is not None:
+                return fast
         for dev in self._devices:
             stuck = self._outstanding.get(dev.device_id)
             if stuck is not None:
@@ -351,7 +706,7 @@ class PollLoop:
                     # Previous sample is still wedged inside the backend;
                     # mark stale again rather than stacking another worker.
                     self._count_error("stuck")
-                    results.append((dev, None))
+                    results[slot_of[dev.device_id]] = (dev, None)
                     continue
                 # pop, not del: an abandoned (superseded) loop thread
                 # unwedging mid-_sample_all can race this check-then-
@@ -367,7 +722,11 @@ class PollLoop:
         runtime_ready = False
         if split:
             try:
-                self._collector.wait_ready(budget.take())
+                if pipelined:
+                    self._collector.wait_ready(
+                        budget.take(), max_age=self._fetch_max_age)
+                else:
+                    self._collector.wait_ready(budget.take())
                 runtime_ready = True
             except Exception as exc:
                 # Fetch missed the tick deadline (or died): assemble with
@@ -375,19 +734,41 @@ class PollLoop:
                 self._count_error("fetch_deadline")
                 log.warning("runtime fetch not ready within %gs: %s",
                             self._deadline, exc)
+            # Capture the completed-fetch generation the assembles below
+            # will peek — the fold keys its ICI rate-feed dedup on it.
+            # Captured HERE, right after the join and before any peek:
+            # reading it at fold time instead would race the fetch
+            # thread (a refresh landing between assembly and fold would
+            # claim re-served counters as fresh — the duplicate-feed
+            # bug); a tiny residual race either side of a peek only
+            # delays/smooths one tick's rate, never resets a baseline.
+            self._tls.tick_runtime_seq = getattr(
+                self._collector, "runtime_fetch_seq", None)
+        env_fresh = False
         for future, dev in futures.items():
+            slot = slot_of[dev.device_id]
             try:
                 outcome = future.result(timeout=budget.take())
                 if split:
+                    # Feed the pipelined path's completed-state map so
+                    # the NEXT tick can assemble without waiting.
+                    self._env_results[dev.device_id] = (outcome, None)
+                    env_fresh = True
                     outcome = self._assemble(dev, outcome, None, runtime_ready)
-                results.append((dev, outcome))
+                results[slot] = (dev, outcome)
             except concurrent.futures.TimeoutError:
                 if not future.cancel():
                     self._outstanding[dev.device_id] = future
+                # This device has NO completed read this round: drop any
+                # older entry so the pipelined path degrades it honestly
+                # (env-missing) instead of serving frozen values fenced
+                # only by the round-global freshness stamp.
+                if split:
+                    self._env_results.pop(dev.device_id, None)
                 self._count_error("deadline")
                 log.warning("sample of %s missed the %gs deadline",
                             dev.device_path, self._deadline)
-                results.append((dev, None))
+                results[slot] = (dev, None)
             except Exception as exc:  # CollectorError and anything else
                 if split and not isinstance(exc, concurrent.futures.CancelledError):
                     # Env read failed; runtime counters may still carry
@@ -400,13 +781,143 @@ class PollLoop:
                         self._count_error(type(exc).__name__)
                         log.warning("environment read of %s failed: %s",
                                     dev.device_path, exc)
-                    results.append(
-                        (dev, self._assemble(dev, {}, exc, runtime_ready)))
+                    self._env_results[dev.device_id] = ({}, exc)
+                    env_fresh = True
+                    results[slot] = (
+                        dev, self._assemble(dev, {}, exc, runtime_ready))
                     continue
                 self._count_error(type(exc).__name__)
                 log.warning("sample of %s failed: %s", dev.device_path, exc)
-                results.append((dev, None))
-        results.sort(key=lambda pair: pair[0].index)
+                results[slot] = (dev, None)
+        if split and env_fresh:
+            # Move the pipelined path's freshness fence only when a read
+            # actually completed this tick (success or answered failure):
+            # a tick where EVERY read timed out must leave the fence
+            # expired so the next tick blocks again, rather than re-arm
+            # the fast path around entries that never got refreshed.
+            self._env_results_at = self._clock()
+        if not split:
+            # Generic path: each sample() joined the fetch itself — the
+            # generation is settled once the gather above has drained.
+            self._tls.tick_runtime_seq = getattr(
+                self._collector, "runtime_fetch_seq", None)
+        return results
+
+    def _harvest_env(self, device_id: str,
+                     future: concurrent.futures.Future) -> None:
+        """Fold one COMPLETED environment read into the pipelined state
+        map, with the same accounting as the blocking path's env-failure
+        branch: a CollectorError is expected degradation, but anything
+        else (fast-failing sysfs reads — the round completes, so the
+        blocking fallback never re-engages) must hit
+        collector_poll_errors_total and the log, or the outage is
+        invisible to the counter operators are told to alert on."""
+        try:
+            self._env_results[device_id] = (future.result(), None)
+        except Exception as exc:  # noqa: BLE001 - per-device, surfaced via assemble
+            if not isinstance(exc, CollectorError):
+                self._count_error(type(exc).__name__)
+                log.warning("environment read of device %s failed: %s",
+                            device_id, exc)
+            self._env_results[device_id] = ({}, exc)
+
+    def _sample_pipelined(
+        self, results: list
+    ) -> list[tuple[Device, Sample | None]] | None:
+        """Zero-wait tick for split backends: assemble every device from
+        the last COMPLETED environment round + runtime fetch while the
+        next round cooks on the pool. Returns None when the completed
+        state is cold (startup) or older than the freshness fence — the
+        caller then runs the blocking fan-out, which re-engages every
+        deadline/staleness mechanism exactly as without pipelining."""
+        now = self._clock()
+        round_ = self._env_round
+        if round_ is not None and all(f.done() for f in round_.values()):
+            for device_id, future in round_.items():
+                self._harvest_env(device_id, future)
+            self._env_results_at = now
+            self._env_round = round_ = None
+        if (self._env_results_at == 0.0
+                or now - self._env_results_at > self._fetch_max_age):
+            # Cold or stale (a read is wedged, or the backend is slower
+            # than the fence): surrender to the blocking path. Still-
+            # running reads are demoted to the per-device outstanding
+            # guard so the blocking fan-out cannot stack another worker
+            # onto a wedged backend.
+            if round_ is not None:
+                self._env_round = None
+                for device_id, future in round_.items():
+                    if not future.done():
+                        self._outstanding.setdefault(device_id, future)
+                        # Its completed entry is now older than the fence;
+                        # a later pipelined tick must see "no environment
+                        # read has completed yet", not serve the frozen
+                        # pre-wedge values as fresh forever.
+                        self._env_results.pop(device_id, None)
+                    else:
+                        # A slow sibling pushed the round past the fence,
+                        # but THIS read finished — record it rather than
+                        # discard it (the blocking tick's re-read then
+                        # overwrites it on success). No stamp move: the
+                        # fence stays expired.
+                        self._harvest_env(device_id, future)
+            return None
+        if round_ is None:
+            # Reap outstanding (previously wedged) reads that have since
+            # finished — the blocking path does this per device; without
+            # it here a device demoted once would be excluded from every
+            # pipelined round until the next cold tick.
+            for device_id in [d for d, f in self._outstanding.items()
+                              if f.done()]:
+                self._outstanding.pop(device_id, None)
+            read = self._collector.read_environment
+            self._env_round = {
+                dev.device_id: self._pool.submit(read, dev)
+                for dev in self._devices
+                if dev.device_id not in self._outstanding
+            }
+        runtime_ready = True
+        try:
+            # Age-bounded join: in steady state a fetch completed within
+            # the fence and this returns immediately. A fetch quiet past
+            # the fence gets the SAME tick-deadline wait blocking mode
+            # gives it (a starved-but-alive fetch thread must cost one
+            # slow tick, not silently degrade every chip to env-only);
+            # only a genuine miss of the deadline surfaces as not-ready.
+            self._collector.wait_ready(self._deadline,
+                                       max_age=self._fetch_max_age)
+        except Exception:  # noqa: BLE001 - degraded tick, never a crash
+            self._count_error("fetch_deadline")
+            runtime_ready = False
+        # Same capture point as the blocking path: the generation the
+        # peeks below will serve, fixed before any assemble runs.
+        self._tls.tick_runtime_seq = getattr(
+            self._collector, "runtime_fetch_seq", None)
+        slot_of = self._slot_of
+        empty_env: dict = {}
+        for dev in self._devices:
+            entry = self._env_results.get(dev.device_id)
+            if entry is None:
+                stuck = self._outstanding.get(dev.device_id)
+                if stuck is not None and not stuck.done():
+                    # Same contract as the blocking path's stuck branch:
+                    # a read still wedged inside the backend keeps the
+                    # device visibly down (up 0) and counting every tick
+                    # — a permanently wedged chip must not fade into an
+                    # up=1 runtime-only ghost with a single error count
+                    # at demotion time.
+                    self._count_error("stuck")
+                    results[slot_of[dev.device_id]] = (dev, None)
+                    continue
+                # New device (or one just reaped, awaiting its first
+                # round): no completed environment yet — assemble
+                # runtime-only, the independent-degradation contract.
+                env, env_err = empty_env, CollectorError(
+                    "no environment read has completed yet")
+            else:
+                env, env_err = entry
+            results[slot_of[dev.device_id]] = (
+                dev, self._assemble(dev, env, env_err, runtime_ready))
         return results
 
     def _assemble(self, dev: Device, env, env_err,
@@ -422,6 +933,22 @@ class PollLoop:
 
     def _count_error(self, reason: str) -> None:
         self._errors[reason] = self._errors.get(reason, 0) + 1
+
+    def _harvest_procstats(self) -> Mapping[str, float]:
+        """Last completed /proc reading. Non-blocking on warm ticks; the
+        COLD snapshot joins its own read (never reads inline *after* the
+        pool read was submitted — a fresher first point would make the
+        process_* counters go backwards on the second scrape)."""
+        future = self._proc_future
+        if future is not None and (future.done() or self._procstats is None):
+            self._proc_future = None
+            try:
+                self._procstats = future.result(timeout=5.0)
+            except Exception:  # noqa: BLE001 - self-metrics must not kill a tick
+                log.debug("procstats read failed", exc_info=True)
+        if self._procstats is None:
+            self._procstats = procstats.read()
+        return self._procstats
 
     _MAX_RAW_FAMILIES = 64
     # Real topologies have ~6 ICI links per chip; 64 is far beyond any
@@ -446,105 +973,104 @@ class PollLoop:
         self._raw_families.add(family)
         return True
 
-    def _device_labels(self, dev: Device) -> list[tuple[str, str]]:
+    def _plan_for(self, dev: Device) -> _DevicePlan:
+        """Current compiled plan for this device — compile-on-miss. The
+        attribution key (sorted items) is the validity condition: a value
+        change for the SAME key set (pod rescheduled, empty→populated→
+        empty transitions) recompiles exactly this device's plan."""
+        # Generation read FIRST, before the config the compile embeds:
+        # reconfigure() invalidates purely via the _filter_gen bump (it
+        # does NOT clear the plan map), so a reconfigure racing this
+        # method may land between our gen read and the store below —
+        # the plan then embeds the old config but also carries the old
+        # gen, and the next lookup's stamp check recompiles it. Without
+        # the stamp a stale-config plan would stay cached (its
+        # attribution key still matches) until the next unrelated
+        # invalidation.
+        gen = self._filter_gen
         attribution = self._attribution.lookup(dev)
-        cache_key = tuple(sorted(attribution.items()))
-        cached = self._label_cache.get(dev.device_id)
-        if cached is not None and cached[0] == cache_key:
-            return cached[1]
-        labels = [
-            ("accel_type", dev.accel_type),
-            ("chip", str(dev.index)),
-            ("device_path", dev.device_path),
-            ("uuid", dev.uuid),
-        ]
-        for key in schema.ATTRIBUTION_LABELS:
-            labels.append((key, attribution.get(key, "")))
-        for key in schema.TOPOLOGY_LABELS:
-            labels.append((key, self._topology.get(key, "")))
-        if self._drop_labels:
-            labels = [
-                (key, "" if key in self._drop_labels else value)
-                for key, value in labels
-            ]
-        self._label_cache[dev.device_id] = (cache_key, labels)
-        return labels
+        key = tuple(sorted(attribution.items()))
+        plan = self._plans.get(dev.device_id)
+        if plan is not None and plan.key == key and plan.cfg_gen == gen:
+            self._plan_cache_hits += 1
+            return plan
+        if plan is None:
+            reason = "device"
+        elif plan.cfg_gen != gen:
+            reason = "reconfig"
+        else:
+            reason = "attribution"
+        self._plan_compiles[reason] = self._plan_compiles.get(reason, 0) + 1
+        plan = _DevicePlan(dev, key, attribution, self._topology,
+                           self._drop_labels, self._disabled_metrics,
+                           self._built_cell)
+        plan.cfg_gen = gen
+        self._plans[dev.device_id] = plan
+        return plan
 
-    def _build_snapshot(
+    # -- tick state update (the only mutating phase) -------------------------
+
+    def _update_tick_state(
         self, results: list[tuple[Device, Sample | None]], now: float
-    ):
-        builder = (FilteredSnapshotBuilder(self._disabled_metrics)
-                   if self._disabled_metrics else SnapshotBuilder())
-        by_name = _METRICS_BY_NAME
+    ) -> list[_TickDevice]:
+        """Fold one tick's samples into persistent per-device state
+        (retained totals, restart detection, energy integration, rate
+        baselines, passthrough admission) and return the derived per-
+        device records. All mutation lives here; the plan and legacy
+        emitters below are pure functions of the returned records — the
+        differential test calls both on one update's output."""
         # Attribution staleness (resilience.py): the kubelet breaker is
         # open / refreshes persistently failing, so lookups serve the
         # retained last-good mapping. Evaluated once per snapshot.
         attr_stale = bool(getattr(self._attribution, "stale", False))
+        openers = self._process_openers
+        # Rate-feed dedup for pipelined ticks: when the collector exposes
+        # a completed-fetch generation and it hasn't advanced since the
+        # last fold, this tick is re-serving the SAME runtime counters —
+        # replay the previously computed rates rather than hand the
+        # tracker a duplicate observation. The generation was captured
+        # at the top of _sample_all (pre-begin_tick), NOT here: reading
+        # it at fold time would race the fetch thread. Collectors
+        # without the attribute (mock, sysfs-only) always count as
+        # fresh; direct _build_snapshot callers (tests) see None too.
+        runtime_seq = getattr(self._tls, "tick_runtime_seq", None)
+        runtime_fresh = (runtime_seq is None
+                         or runtime_seq != self._runtime_seq_seen)
+        self._runtime_seq_seen = runtime_seq
+        tick: list[_TickDevice] = []
         for dev, sample in results:
-            base = self._device_labels(dev)
-            # stale="true" rides GAUGES only (never counters — a label
-            # flip mid-outage would blind increase(); never
-            # accelerator_up — the health contract keeps one identity).
-            # Absent entirely on the healthy path, so steady-state series
-            # identity (and the goldens) are untouched.
+            plan = self._plan_for(dev)
+            device_id = dev.device_id
+            holders = (tuple(openers(dev.device_path))
+                       if openers is not None else None)
             stale = attr_stale or (sample is not None and sample.stale)
-            gbase = base + [("stale", "true")] if stale else base
             if sample is None:
-                builder.add(schema.DEVICE_UP, 0.0, base)
-                total = self._last_totals.get(dev.device_id)
-                if total is not None:
-                    builder.add(schema.MEMORY_TOTAL, total, gbase)
-                # The restart counter stays emitted through an outage
-                # (like MEMORY_TOTAL): if the series vanished while
-                # polls failed, every point inside the increase() window
-                # after recovery would already carry the bump and the
-                # AcceleratorRuntimeRestarted alert would miss exactly
-                # the crash-then-restart it exists for.
-                builder.add(schema.RUNTIME_RESTARTS,
-                            float(self._restarts.get(dev.device_id, 0)),
-                            base)
-                # Same outage-persistence as the restart counter: a
-                # counter series must not vanish and blind increase().
-                if dev.device_id in self._last_power_at:
-                    builder.add(schema.ENERGY,
-                                self._energy.get(dev.device_id, 0.0), base)
+                tick.append(_TickDevice(
+                    dev, None, plan, stale,
+                    self._last_totals.get(device_id),
+                    float(self._restarts.get(device_id, 0)),
+                    (self._energy.get(device_id, 0.0)
+                     if device_id in self._last_power_at else None),
+                    (), (), holders,
+                ))
                 continue
-            # A stale sample (runtime breaker open) is NOT up: the env
-            # gauges below are real sysfs reads, but the chip's runtime
-            # is persistently gone — accelerator_up is the contract that
-            # says "this chip is being collected", and it isn't.
-            builder.add(schema.DEVICE_UP, 0.0 if sample.stale else 1.0, base)
+            retained_total = None
             if schema.MEMORY_TOTAL.name not in sample.values:
                 # Degraded (runtime-not-ready) samples lack HBM capacity;
-                # re-emit the retained total so used/total ratios and
-                # capacity recording rules don't flap on slow ticks.
-                total = self._last_totals.get(dev.device_id)
-                if total is not None:
-                    builder.add(schema.MEMORY_TOTAL, total, gbase)
+                # the retained total keeps used/total ratios and capacity
+                # recording rules from flapping on slow ticks.
+                retained_total = self._last_totals.get(device_id)
             for name, value in sample.values.items():
-                spec = by_name.get(name)
-                if spec is None:
-                    expansion = schema.PERCENTILE_VALUE_KEYS.get(name)
-                    if expansion is not None:
-                        pct_spec, percentile = expansion
-                        builder.add(
-                            pct_spec, value,
-                            gbase + [("percentile", percentile)]
-                        )
-                    continue
-                builder.add(
-                    spec, value,
-                    gbase if spec.type is schema.MetricType.GAUGE else base)
                 if name == schema.MEMORY_TOTAL.name:
-                    self._last_totals[dev.device_id] = value
+                    self._last_totals[device_id] = value
                 elif name == schema.UPTIME.name:
-                    prev = self._last_uptime.get(dev.device_id)
+                    prev = self._last_uptime.get(device_id)
                     # 1 s tolerance: clock jitter between the runtime's
                     # uptime source and our tick must not fake a bounce.
                     if prev is not None and value < prev - 1.0:
-                        self._restarts[dev.device_id] = (
-                            self._restarts.get(dev.device_id, 0) + 1)
-                    self._last_uptime[dev.device_id] = value
+                        self._restarts[device_id] = (
+                            self._restarts.get(device_id, 0) + 1)
+                    self._last_uptime[device_id] = value
                 elif name == schema.POWER.name:
                     # Guard the integrand like the ICI/passthrough caps
                     # guard series counts: one negative sample must not
@@ -553,28 +1079,17 @@ class PollLoop:
                     # poison every subsequent += forever.
                     if not (value >= 0.0 and value != float("inf")):
                         continue
-                    prev_at = self._last_power_at.get(dev.device_id)
+                    prev_at = self._last_power_at.get(device_id)
                     if prev_at is not None and now > prev_at:
                         # Cap the gap at 10 ticks: after a long outage,
                         # integrating the whole gap at the just-observed
                         # power would fabricate energy the chip may not
                         # have drawn.
                         gap = min(now - prev_at, 10 * self._interval)
-                        self._energy[dev.device_id] = (
-                            self._energy.get(dev.device_id, 0.0)
+                        self._energy[device_id] = (
+                            self._energy.get(device_id, 0.0)
                             + value * gap)
-                    self._last_power_at[dev.device_id] = now
-            # Unconditional, born at 0 (increase() discipline): the
-            # series must exist before the first restart or the alert
-            # misses a burst that starts the series at N.
-            builder.add(schema.RUNTIME_RESTARTS,
-                        float(self._restarts.get(dev.device_id, 0)), base)
-            # Energy appears once power has (born at 0 on the first
-            # power observation — never for collectors with no power
-            # source, e.g. a runtime-only backend without sysfs hwmon).
-            if dev.device_id in self._last_power_at:
-                builder.add(schema.ENERGY,
-                            self._energy.get(dev.device_id, 0.0), base)
+                    self._last_power_at[device_id] = now
             ici_items = sorted(sample.ici_counters.items())
             if len(ici_items) > self._MAX_ICI_LINKS:
                 # Same threat class as the passthrough family cap: a
@@ -584,44 +1099,170 @@ class PollLoop:
                 # subset for a fixed name population.
                 self._count_error("ici_link_cap")
                 ici_items = ici_items[:self._MAX_ICI_LINKS]
-            for link, counter in ici_items:
-                builder.add(schema.ICI_TRAFFIC_TOTAL, float(counter),
-                            base + [("link", link)])
-                rate = self._rates.rate(dev.device_id, link, counter, now)
-                if rate is not None:
-                    builder.add(schema.ICI_BANDWIDTH, rate,
-                                gbase + [("link", link)])
-            if sample.collective_ops is not None:
-                builder.add(schema.COLLECTIVE_OPS, float(sample.collective_ops), base)
+            if not ici_items:
+                ici: tuple = ()
+            else:
+                memo = self._ici_memo.get(device_id)
+                # Replay only a memo from THIS generation: a device that
+                # was stuck (sample None) on the generation's first fold
+                # has a previous-generation memo, and its now-unstuck
+                # counters must be fed, not shadowed by two-fetch-old
+                # values — feeding is safe, this generation never saw it.
+                if (runtime_fresh or memo is None
+                        or memo[0] != runtime_seq):
+                    ici = tuple(
+                        (link, counter,
+                         self._rates.rate(device_id, link, counter, now))
+                        for link, counter in ici_items
+                    )
+                    self._ici_memo[device_id] = (runtime_seq, ici)
+                else:
+                    # Same fetch generation as the memo: identical
+                    # counters by construction (a refresh publishes a
+                    # brand-new cache wholesale) — the replayed tuple IS
+                    # this tick's truth.
+                    ici = memo[1]
+            raw: tuple[tuple[str, str, float], ...] = ()
             if sample.raw_values:
-                # Keys are (family, link) pairs; all passthrough data
-                # rides ONE static gauge family with the raw runtime name
-                # in the 'family' label — series identity is deterministic
-                # across restarts and collision-free by construction.
+                admitted = []
                 for key in sorted(sample.raw_values):
                     family, link = key
                     if not self._admit_raw_family(family):
                         self._count_error("raw_family_cap")
                         continue
-                    builder.add(
-                        schema.PASSTHROUGH, sample.raw_values[key],
-                        gbase + [("family", family), ("link", link)])
-        if self._process_openers is not None:
-            for dev, _ in results:
-                base = self._device_labels(dev)
-                # Holder entries are (pid, comm, pod_uid, value): 1 per
-                # real holder, the fold count on the capped
-                # {comm="_overflow"} series (procopen.scan bounds
-                # cardinality; pod_uid from the holder's cgroup path).
-                for pid, comm, pod_uid, value in \
-                        self._process_openers(dev.device_path):
-                    builder.add(
-                        schema.PROCESS_OPEN, value,
-                        base + [("pid", pid), ("comm", comm),
-                                ("pod_uid", pod_uid)],
-                    )
+                    admitted.append((family, link, sample.raw_values[key]))
+                raw = tuple(admitted)
+            tick.append(_TickDevice(
+                dev, sample, plan, stale,
+                retained_total,
+                # Unconditional, born at 0 (increase() discipline): the
+                # series must exist before the first restart or the alert
+                # misses a burst that starts the series at N.
+                float(self._restarts.get(device_id, 0)),
+                # Energy appears once power has (born at 0 on the first
+                # power observation — never for collectors with no power
+                # source, e.g. a runtime-only backend without sysfs hwmon).
+                (self._energy.get(device_id, 0.0)
+                 if device_id in self._last_power_at else None),
+                ici, raw, holders,
+            ))
+        return tick
 
-        builder.add(schema.SELF_DEVICES, float(len(results)))
+    # -- emitters (pure; plan path + legacy oracle) --------------------------
+
+    def _emit_device_plan(self, builder: SnapshotBuilder,
+                          rec: _TickDevice) -> None:
+        """Write one device's values into its compiled plan slots."""
+        plan = rec.plan
+        sample = rec.sample
+        stale = rec.stale
+        add = builder.add_series
+        if sample is None:
+            add(plan.up.emit(0.0, False))
+            if rec.retained_total is not None and plan.memory_total is not None:
+                # stale="true" rides GAUGES only (never counters — a label
+                # flip mid-outage would blind increase(); never
+                # accelerator_up — the health contract keeps one identity).
+                add(plan.memory_total.emit(rec.retained_total, stale))
+            # The restart counter stays emitted through an outage
+            # (like MEMORY_TOTAL): if the series vanished while
+            # polls failed, every point inside the increase() window
+            # after recovery would already carry the bump and the
+            # AcceleratorRuntimeRestarted alert would miss exactly
+            # the crash-then-restart it exists for.
+            if plan.restarts is not None:
+                add(plan.restarts.emit(rec.restarts, False))
+            # Same outage-persistence as the restart counter: a
+            # counter series must not vanish and blind increase().
+            if rec.energy is not None and plan.energy is not None:
+                add(plan.energy.emit(rec.energy, False))
+            return
+        # A stale sample (runtime breaker open) is NOT up: the env
+        # gauges are real sysfs reads, but the chip's runtime is
+        # persistently gone — accelerator_up is the contract that
+        # says "this chip is being collected", and it isn't.
+        add(plan.up.emit(0.0 if sample.stale else 1.0, False))
+        if rec.retained_total is not None and plan.memory_total is not None:
+            add(plan.memory_total.emit(rec.retained_total, stale))
+        emit = plan.emit
+        for name, value in sample.values.items():
+            slot = emit.get(name)
+            if slot is not None:
+                add(slot.emit(value, stale))
+        if plan.restarts is not None:
+            add(plan.restarts.emit(rec.restarts, False))
+        if rec.energy is not None and plan.energy is not None:
+            add(plan.energy.emit(rec.energy, False))
+        if rec.ici and (plan.ici_traffic_on or plan.ici_bw_on):
+            for link, counter, rate in rec.ici:
+                total_slot, bw_slot = plan.ici_slots(link)
+                if plan.ici_traffic_on:
+                    add(total_slot.emit(float(counter), False))
+                if rate is not None and plan.ici_bw_on:
+                    add(bw_slot.emit(rate, stale))
+        if sample.collective_ops is not None and plan.collectives is not None:
+            add(plan.collectives.emit(float(sample.collective_ops), False))
+        if rec.raw and plan.raw_on:
+            for family, link, value in rec.raw:
+                add(plan.raw_slot(family, link).emit(value, stale))
+
+    def _emit_device_legacy(self, builder: SnapshotBuilder,
+                            rec: _TickDevice) -> None:
+        """Pre-plan builder path, kept as the differential-test oracle
+        (the parse_exposition_reference of this subsystem): every label
+        list is rebuilt from the base tuple exactly as the original
+        _build_snapshot did. Byte-identity with the plan path is pinned
+        by tests/test_tick_plan_differential.py."""
+        sample = rec.sample
+        base = rec.plan.base
+        gbase = base + (("stale", "true"),) if rec.stale else base
+        if sample is None:
+            builder.add(schema.DEVICE_UP, 0.0, base)
+            if rec.retained_total is not None:
+                builder.add(schema.MEMORY_TOTAL, rec.retained_total, gbase)
+            builder.add(schema.RUNTIME_RESTARTS, rec.restarts, base)
+            if rec.energy is not None:
+                builder.add(schema.ENERGY, rec.energy, base)
+            return
+        builder.add(schema.DEVICE_UP, 0.0 if sample.stale else 1.0, base)
+        if rec.retained_total is not None:
+            builder.add(schema.MEMORY_TOTAL, rec.retained_total, gbase)
+        by_name = _METRICS_BY_NAME
+        for name, value in sample.values.items():
+            spec = by_name.get(name)
+            if spec is None:
+                expansion = schema.PERCENTILE_VALUE_KEYS.get(name)
+                if expansion is not None:
+                    pct_spec, percentile = expansion
+                    builder.add(
+                        pct_spec, value,
+                        gbase + (("percentile", percentile),)
+                    )
+                continue
+            builder.add(
+                spec, value,
+                gbase if spec.type is schema.MetricType.GAUGE else base)
+        builder.add(schema.RUNTIME_RESTARTS, rec.restarts, base)
+        if rec.energy is not None:
+            builder.add(schema.ENERGY, rec.energy, base)
+        for link, counter, rate in rec.ici:
+            builder.add(schema.ICI_TRAFFIC_TOTAL, float(counter),
+                        base + (("link", link),))
+            if rate is not None:
+                builder.add(schema.ICI_BANDWIDTH, rate,
+                            gbase + (("link", link),))
+        if sample.collective_ops is not None:
+            builder.add(schema.COLLECTIVE_OPS,
+                        float(sample.collective_ops), base)
+        for family, link, value in rec.raw:
+            builder.add(schema.PASSTHROUGH, value,
+                        gbase + (("family", family), ("link", link)))
+
+    def _contribute_shared(self, builder: SnapshotBuilder,
+                           device_count: int) -> None:
+        """Self-observability tail of every snapshot — one definition
+        shared by the plan and legacy paths so the two can never drift."""
+        builder.add(schema.SELF_DEVICES, float(device_count))
         allocatable = getattr(self._attribution, "allocatable", None)
         if allocatable is not None:
             for resource, count in sorted(allocatable().items()):
@@ -636,6 +1277,20 @@ class PollLoop:
                 float(self._errors[reason]),
                 [("reason", reason)],
             )
+        for reason in sorted(self._plan_compiles):
+            builder.add(
+                schema.TICK_PLAN_COMPILES,
+                float(self._plan_compiles[reason]),
+                [("reason", reason)],
+            )
+        builder.add(schema.TICK_PLAN_CACHE_HITS,
+                    float(self._plan_cache_hits))
+        rpc_stats = getattr(self._collector, "rpc_stats", None)
+        if rpc_stats is not None:
+            builder.add(
+                schema.RPC_BATCHED_FAMILIES,
+                float(rpc_stats().get("batched_families", 0)),
+            )
         if self._push_stats is not None:
             contribute_push_stats(builder, self._push_stats())
         builder.add(
@@ -644,9 +1299,7 @@ class PollLoop:
             [("version", self._version), ("backend", self._collector.name)],
         )
         if self._process_metrics:
-            from . import procstats
-
-            procstats.contribute(builder)
+            procstats.contribute(builder, self._harvest_procstats())
         builder.add_histogram(self._hist)
         # Collector-owned histograms (embedded mode's step-duration family):
         # published by reference swap on the workload thread, read here.
@@ -660,4 +1313,76 @@ class PollLoop:
             # Supervisor.contribute: kts_breaker_state / kts_component_*
             # resilience self-metrics ride every snapshot.
             self._health_stats(builder)
+
+    def _emit_snapshot(self, tick: list[_TickDevice],
+                       use_plan: bool):
+        # One builder per THREAD, reset per tick (allocation discipline):
+        # build() materializes the snapshot's tuples, so clearing the
+        # backing lists between ticks is safe. Thread-local like the
+        # sampling scratch — a superseded loop thread can wedge INSIDE
+        # the build (procstats' cold join blocks up to 5 s) and resume
+        # after the watchdog's replacement has started its own build; a
+        # shared builder would interleave two ticks' series. (The plan
+        # emitters' shared _built_cell stays racy in that window — it
+        # only skews one tick's series_built/reused self-metric, never
+        # the published series.) Rebuilt when reconfigure bumps
+        # _filter_gen: the filter set is baked into the instance.
+        tls = self._tls
+        builder = getattr(tls, "builder", None)
+        if builder is None or tls.builder_filter_gen != self._filter_gen:
+            builder = (FilteredSnapshotBuilder(self._disabled_metrics)
+                       if self._disabled_metrics else SnapshotBuilder())
+            tls.builder = builder
+            tls.builder_filter_gen = self._filter_gen
+        else:
+            builder.reset()
+        emit_device = (self._emit_device_plan if use_plan
+                       else self._emit_device_legacy)
+        for rec in tick:
+            emit_device(builder, rec)
+        if self._process_openers is not None:
+            for rec in tick:
+                holders = rec.holders or ()
+                # Holder entries are (pid, comm, pod_uid, value): 1 per
+                # real holder, the fold count on the capped
+                # {comm="_overflow"} series (procopen.scan bounds
+                # cardinality; pod_uid from the holder's cgroup path).
+                if use_plan:
+                    if not rec.plan.holders_on:
+                        continue
+                    for pid, comm, pod_uid, value in holders:
+                        builder.add_series(
+                            rec.plan.holder_slot(pid, comm, pod_uid)
+                            .emit(value, False))
+                else:
+                    base = rec.plan.base
+                    for pid, comm, pod_uid, value in holders:
+                        builder.add(
+                            schema.PROCESS_OPEN, value,
+                            base + (("pid", pid), ("comm", comm),
+                                    ("pod_uid", pod_uid)),
+                        )
+        device_series = builder.count
+        self._contribute_shared(builder, len(tick))
+        total = builder.count
+        # Allocation accounting (ISSUE 3 "pinned, not anecdotal"):
+        # series_built counts Series objects actually constructed this
+        # tick — plan slots re-emit their cached object while the value
+        # is unchanged; the legacy path and the self-metrics tail build
+        # every object fresh.
+        built_device = (self._built_cell[0] if use_plan else device_series)
+        self.last_tick_stats = {
+            "series": total,
+            "series_built": built_device + (total - device_series),
+            "series_reused": device_series - built_device,
+            "plan_compiles": sum(self._plan_compiles.values()),
+            "plan_cache_hits": self._plan_cache_hits,
+        }
         return builder.build()
+
+    def _build_snapshot(
+        self, results: list[tuple[Device, Sample | None]], now: float
+    ):
+        self._built_cell[0] = 0
+        tick = self._update_tick_state(results, now)
+        return self._emit_snapshot(tick, self._use_tick_plan)

@@ -67,6 +67,7 @@ phantom devices (see the AMBIGUOUS constant for the trade-off).
 
 from __future__ import annotations
 
+import functools
 import struct
 from typing import NamedTuple
 
@@ -111,7 +112,11 @@ class MetricSample(NamedTuple):
     link: str = ""
 
 
+@functools.lru_cache(maxsize=64)
 def encode_request(metric_name: str = "") -> bytes:
+    """Request body for one family selector (cached: the per-metric burst
+    re-encodes the same ~11 pinned names every tick; the result is an
+    immutable bytes, safe to share)."""
     return codec.field_string(1, metric_name) if metric_name else b""
 
 

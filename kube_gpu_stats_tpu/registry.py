@@ -19,7 +19,7 @@ import gzip
 import math
 import threading
 import time
-from typing import Iterable, Mapping, Sequence
+from typing import Iterable, Mapping, NamedTuple, Sequence
 
 from . import schema
 from .schema import MetricSpec, MetricType
@@ -44,9 +44,13 @@ def format_value(value: float) -> str:
     return repr(value)
 
 
-@dataclasses.dataclass(frozen=True)
-class Series:
-    """One (family, labelset, value) sample."""
+class Series(NamedTuple):
+    """One (family, labelset, value) sample.
+
+    NamedTuple, not frozen dataclass: a poll tick builds (and the hub
+    merge replays) hundreds of these, and frozen-dataclass construction
+    (object.__setattr__ per field) was measurable on the tick hot path —
+    the same trade tpumetrics.MetricSample already makes."""
 
     spec: MetricSpec
     labels: tuple[tuple[str, str], ...]
@@ -263,6 +267,18 @@ class SnapshotBuilder:
     def __init__(self) -> None:
         self._series: list[Series] = []
         self._histograms: list[HistogramState] = []
+
+    def reset(self) -> None:
+        """Drop accumulated state so the instance (and its backing lists)
+        can be reused for another build — per-tick scratch discipline;
+        build() already materialized the previous snapshot's tuples."""
+        self._series.clear()
+        self._histograms.clear()
+
+    @property
+    def count(self) -> int:
+        """Series accumulated so far (tick-plan allocation accounting)."""
+        return len(self._series)
 
     def add(
         self,

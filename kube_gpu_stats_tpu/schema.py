@@ -572,6 +572,39 @@ SELF_POLL_ERRORS = MetricSpec(
     "Device-sample failures observed by the poll loop.",
     extra_labels=("reason",),
 )
+TICK_PLAN_COMPILES = MetricSpec(
+    "kts_tick_plan_compiles_total",
+    MetricType.COUNTER,
+    "Per-device tick-plan compilations (pre-joined label tuples, "
+    "pre-rendered series prefixes, cached series slots) by reason: "
+    "'device' (new/rediscovered device, no plan existed), 'attribution' "
+    "(the device's pod attribution changed, label join recompiled), "
+    "'reconfig' (drop-label/metric-filter reconfiguration invalidated "
+    "every plan; counted per device recompiled). Steady state is a "
+    "one-time burst at startup and a "
+    "blip on pod (re)scheduling; a rate tracking the tick rate is a "
+    "compile storm — every tick is paying full label-build cost (see "
+    "docs/OPERATIONS.md).",
+    extra_labels=("reason",),
+)
+TICK_PLAN_CACHE_HITS = MetricSpec(
+    "kts_tick_plan_cache_hits_total",
+    MetricType.COUNTER,
+    "Device ticks served by an already-compiled tick plan (the snapshot "
+    "build wrote values into cached slots instead of rebuilding label "
+    "lists and series identity). Healthy steady state: rises by "
+    "device-count every tick while kts_tick_plan_compiles_total stays "
+    "flat.",
+)
+RPC_BATCHED_FAMILIES = MetricSpec(
+    "kts_rpc_batched_families",
+    MetricType.GAUGE,
+    "Metric families the runtime served through the single batched "
+    "(empty-selector) RPC per port in the last completed fetch. 0 means "
+    "the runtime rejected the batched form and the collector is on the "
+    "per-metric burst fallback — one pipelined RPC per family per port "
+    "per tick instead of one per port.",
+)
 SELF_DEVICES = MetricSpec(
     "collector_devices",
     MetricType.GAUGE,
@@ -694,6 +727,9 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     RENDER_CACHE_HITS,
     RENDER_CACHE_MISSES,
     SELF_POLL_ERRORS,
+    TICK_PLAN_COMPILES,
+    TICK_PLAN_CACHE_HITS,
+    RPC_BATCHED_FAMILIES,
     SELF_DEVICES,
     SELF_INFO,
     SELF_ALLOCATABLE,

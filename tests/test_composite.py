@@ -72,10 +72,19 @@ def test_both_sources_down_is_stale(tmp_path, server):
 
 
 def test_through_poll_loop_full_families(tmp_path, server):
+    import time
+
     col = make_tpu(tmp_path, server)
     reg = Registry()
     loop = PollLoop(col, reg, deadline=5.0)
     loop.tick()
+    loop.tick()
+    # Pipelined cadence: back-to-back manual ticks re-serve the first
+    # completed fetch, and a rate needs two DISTINCT fetches — wait for
+    # the second tick's fetch to land, then tick again to observe it.
+    deadline = time.monotonic() + 5
+    while col.runtime_fetch_seq < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
     loop.tick()
     snap = reg.snapshot()
     families = {s.spec.name for s in snap.series}

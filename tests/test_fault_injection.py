@@ -41,7 +41,8 @@ def test_libtpu_restart_counters_reset_then_recover(tmp_path):
         use_native=False,
     )
     reg = Registry()
-    loop = PollLoop(col, reg, deadline=5.0)
+    loop = PollLoop(col, reg, deadline=5.0,
+                    pipeline_fetch=False)  # blocking contract: each tick joins its own fetch
     loop.tick()
     loop.tick()
     assert up_values(reg.snapshot()) == [1.0, 1.0]
@@ -275,7 +276,8 @@ def test_slow_runtime_degrades_fresh_not_stale(tmp_path):
         use_native=False,
     )
     reg = Registry()
-    loop = PollLoop(col, reg, deadline=0.4)
+    loop = PollLoop(col, reg, deadline=0.4,
+                    pipeline_fetch=False)  # blocking contract: each tick joins its own fetch
     try:
         loop.tick()  # healthy tick primes the runtime cache
         names = {s.spec.name for s in reg.snapshot().series}
@@ -331,7 +333,8 @@ def test_libtpu_breaker_opens_stale_labels_then_recovers(tmp_path):
     sup = Supervisor()
     sup.register_breaker_provider(col.breakers)
     reg = Registry()
-    loop = PollLoop(col, reg, deadline=5.0, health_stats=sup.contribute)
+    loop = PollLoop(col, reg, deadline=5.0, health_stats=sup.contribute,
+                    pipeline_fetch=False)  # blocking contract: each tick joins its own fetch
     try:
         loop.tick()
         assert up_values(reg.snapshot()) == [1.0, 1.0]
@@ -554,7 +557,8 @@ def test_multiport_partial_outage_stales_only_that_ports_chips(tmp_path):
         use_native=False,
     )
     reg = Registry()
-    loop = PollLoop(col, reg, deadline=5.0)
+    loop = PollLoop(col, reg, deadline=5.0,
+                    pipeline_fetch=False)  # blocking contract: each tick joins its own fetch
     try:
         loop.tick()
         assert up_values(reg.snapshot()) == [1.0, 1.0, 1.0, 1.0]
@@ -609,4 +613,44 @@ def test_probe_tick_stays_stale_not_flapping(tmp_path):
         sample = col.assemble(dev, env, None, runtime_ready=True)
         assert sample.stale
     finally:
+        col.close()
+
+
+def test_pipelined_tick_detects_runtime_death(tmp_path):
+    """The DEFAULT (pipelined) tick serves the last completed fetch, so
+    a runtime death is observed one fetch cadence later, not in the
+    same tick — but it must surface within a couple of ticks (the dead
+    port answers connection-refused fast, and that failed refresh IS a
+    completed outcome), never be masked indefinitely by the cache."""
+    make_sysfs(tmp_path, num_chips=2)
+    server = FakeLibtpuServer(num_chips=2).start()
+    col = TpuCollector(
+        sysfs_root=str(tmp_path),
+        libtpu_client=LibtpuClient(ports=(server.port,), rpc_timeout=0.5),
+        use_native=False,
+    )
+    reg = Registry()
+    loop = PollLoop(col, reg, interval=0.05, deadline=5.0)  # fence 0.1 s
+    try:
+        loop.tick()  # blocking cold tick primes fetch + environment
+        loop.tick()  # first pipelined tick
+        assert schema.DUTY_CYCLE.name in {
+            s.spec.name for s in reg.snapshot().series}
+
+        server.stop()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            loop.tick()
+            names = {s.spec.name for s in reg.snapshot().series}
+            if schema.DUTY_CYCLE.name not in names:
+                break
+            time.sleep(0.05)
+        names = {s.spec.name for s in reg.snapshot().series}
+        # Runtime families are gone; fresh environment still exports
+        # (independent degradation, same contract as blocking mode).
+        assert schema.DUTY_CYCLE.name not in names
+        assert schema.POWER.name in names
+    finally:
+        loop.stop()
+        server.stop()
         col.close()

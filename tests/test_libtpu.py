@@ -564,3 +564,47 @@ def test_unknown_families_counted_and_warned_once(caplog):
         assert len(warns) == 1  # once per port, not per tick
         assert "novel" in warns[0].message or "doctor" in warns[0].message
         col.close()
+
+
+def test_multiport_rpc_call_count_exact():
+    """rpc_calls_total is summed on the calling thread after the port
+    fan-out gathers (the per-port closures run on pool workers, where an
+    unlocked increment can lose counts): two live ports must count
+    exactly 2 per fan-out, and breaker-refused ports must not count."""
+    with FakeLibtpuServer(num_chips=1) as a, \
+            FakeLibtpuServer(num_chips=1, chip_offset=1) as b:
+        client = LibtpuClient(ports=(a.port, b.port), rpc_timeout=0.5)
+        try:
+            assert client.rpc_calls_total == 0
+            client.get_metric(tpumetrics.HBM_TOTAL)
+            assert client.rpc_calls_total == 2
+            client.get_raw_with_errors("")
+            assert client.rpc_calls_total == 4
+            # Force port b's breaker open: refused calls issue no RPC
+            # and must not count.
+            client.breakers[b.port]._trip()
+            client.get_metric(tpumetrics.HBM_TOTAL)
+            assert client.rpc_calls_total == 5
+        finally:
+            client.close()
+
+
+def test_rpc_stats_tolerates_ducktyped_client():
+    """rpc_stats must use the same getattr guard as _refresh for clients
+    without the counter (duck-typed transports are explicitly supported
+    by _fetch_per_metric) — an AttributeError here would crash every
+    tick inside the poll loop's self-metrics contribution."""
+    class MiniClient:
+        def get_metric(self, name):
+            return []
+
+        def close(self):
+            pass
+
+    col = LibtpuCollector(MiniClient(), accel_type="tpu-test")
+    try:
+        stats = col.rpc_stats()
+        assert stats["rpc_calls_total"] == 0
+        assert stats["batched_families"] == 0
+    finally:
+        col.close()

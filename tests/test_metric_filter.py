@@ -158,3 +158,19 @@ def test_config_resolves_and_validates_filter():
 
 def test_config_default_is_everything_enabled():
     assert from_args(["--backend", "mock"]).disabled_metrics == frozenset()
+
+
+def test_disabled_families_not_built_by_plan():
+    """Disabled families are omitted from the compiled tick plan, not
+    just dropped by the filtered builder at add time — otherwise every
+    changing disabled gauge still constructs a Series per tick and the
+    series_built/series_reused accounting goes negative."""
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0,
+                    disabled_metrics=schema.FILTERABLE_METRICS)
+    loop.tick()
+    loop.tick()  # warm tick: unchanged slots replay their cached Series
+    stats = loop.last_tick_stats
+    assert stats["series_reused"] >= 0, stats
+    assert stats["series_built"] <= stats["series"], stats
+    loop.stop()

@@ -8,6 +8,7 @@ exporters model N hosts faithfully.
 """
 
 import re
+import time
 import urllib.request
 
 import pytest
@@ -98,6 +99,13 @@ def test_multihost_real_stack_http(tmp_path):
             server.start()
             daemonish.append((loop, server))
             loop.tick()
+            loop.tick()
+            # Pipelined cadence: a rate needs two DISTINCT completed
+            # fetches; wait for the second tick's fetch, then observe it.
+            deadline = time.monotonic() + 5
+            while (col.runtime_fetch_seq < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
             loop.tick()
         for loop, server in daemonish:
             with urllib.request.urlopen(

@@ -244,6 +244,94 @@ def test_attribution_and_topology_labels_joined():
     loop.stop()
 
 
+def test_attribution_value_change_recompiles_plan():
+    """ISSUE 3 satellite: a changed attribution VALUE for the same key
+    set must recompile the device's tick plan — covering the
+    empty→populated→empty pod transitions a rescheduled workload makes.
+    A plan keyed only on key NAMES would keep exporting the dead pod."""
+    reg = Registry()
+    attr = StaticAttribution({})
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0,
+                    attribution=attr)
+    loop.tick()
+    assert get(reg.snapshot(), "accelerator_duty_cycle")[0][0]["pod"] == ""
+    # empty -> populated
+    attr.mapping = {"0": {"pod": "train-7", "namespace": "ml",
+                          "container": "main"}}
+    loop.tick()
+    labels = get(reg.snapshot(), "accelerator_duty_cycle")[0][0]
+    assert labels["pod"] == "train-7" and labels["namespace"] == "ml"
+    # populated -> populated with a DIFFERENT value for the same keys
+    # (pod rescheduled onto the chip under a new name)
+    attr.mapping = {"0": {"pod": "train-8", "namespace": "ml",
+                          "container": "main"}}
+    loop.tick()
+    assert get(reg.snapshot(), "accelerator_duty_cycle")[0][0]["pod"] == \
+        "train-8"
+    # populated -> empty
+    attr.mapping = {}
+    loop.tick()
+    # Steady tick: the recompiled plan now serves from cache.
+    loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, "accelerator_duty_cycle")[0][0]["pod"] == ""
+    # Three recompiles beyond the initial device compile, each counted
+    # under its reason; the steady tick was a cache hit.
+    assert get(snap, "kts_tick_plan_compiles_total",
+               reason="attribution")[0][1] == 3.0
+    assert get(snap, "kts_tick_plan_compiles_total",
+               reason="device")[0][1] == 1.0
+    assert get(snap, "kts_tick_plan_cache_hits_total")[0][1] >= 1.0
+    loop.stop()
+
+
+def test_reconfigure_drop_labels_invalidates_every_plan():
+    """Drop-label reconfig must invalidate compiled plans (they embed
+    the drop set in their pre-joined tuples) — without it the old labels
+    would keep flowing from the cached slots forever."""
+    reg = Registry()
+    loop = PollLoop(
+        MockCollector(num_devices=2), reg, deadline=5.0,
+        attribution=StaticAttribution(
+            {"0": {"pod": "secret", "namespace": "ml", "container": "c"}}),
+    )
+    loop.tick()
+    assert get(reg.snapshot(), "accelerator_duty_cycle",
+               chip="0")[0][0]["pod"] == "secret"
+    loop.reconfigure(drop_labels=("pod",))
+    loop.tick()
+    snap = reg.snapshot()
+    labels = get(snap, "accelerator_duty_cycle", chip="0")[0][0]
+    assert labels["pod"] == "" and labels["container"] == "c"
+    # Each device recompiled once under the 'reconfig' reason (the
+    # compile burst is attributed to its true cause, not device churn);
+    # 'device' keeps only the initial discovery compiles.
+    assert get(snap, "kts_tick_plan_compiles_total",
+               reason="reconfig")[0][1] == 2.0
+    assert get(snap, "kts_tick_plan_compiles_total",
+               reason="device")[0][1] == 2.0
+    # Un-drop: plans recompile again and the value returns.
+    loop.reconfigure(drop_labels=())
+    loop.tick()
+    assert get(reg.snapshot(), "accelerator_duty_cycle",
+               chip="0")[0][0]["pod"] == "secret"
+    loop.stop()
+
+
+def test_reconfigure_metric_filter_applies_next_tick():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0)
+    loop.tick()
+    assert get(reg.snapshot(), "accelerator_duty_cycle")
+    loop.reconfigure(
+        disabled_metrics=frozenset({"accelerator_duty_cycle"}))
+    loop.tick()
+    snap = reg.snapshot()
+    assert not get(snap, "accelerator_duty_cycle")
+    assert get(snap, "accelerator_up")  # everything else still flows
+    loop.stop()
+
+
 def test_run_forever_ticks_at_interval():
     reg = Registry()
     loop = PollLoop(MockCollector(num_devices=1), reg, interval=0.02, deadline=5.0)
@@ -372,4 +460,193 @@ def test_drop_labels_blank_but_keep_keys():
     assert labels["uuid"] == ""
     assert labels["container"] == "c"  # not dropped
     assert set(labels) >= {"pod", "namespace", "uuid"}  # keys retained
+    loop.stop()
+
+
+def test_wedged_env_read_not_served_frozen_by_pipelined_tick():
+    """A device whose environment read wedges is demoted to the
+    outstanding guard AND loses its completed-round entry: later
+    pipelined ticks must keep it visibly down (up 0, counted stuck every
+    tick — the blocking path's contract) instead of serving the frozen
+    pre-wedge values as fresh forever, while healthy devices keep
+    pipelining; once the read unwedges, the device recovers."""
+    import concurrent.futures
+    import threading
+
+    class WedgeableSplit(Collector):
+        name = "wedge"
+        pipelined_wait = True
+
+        def __init__(self):
+            self.block = {}  # device_id -> Event the read parks on
+
+        def discover(self):
+            return [Device(i, str(i), f"/dev/accel{i}", "stub")
+                    for i in range(2)]
+
+        def begin_tick(self):
+            pass
+
+        def wait_ready(self, timeout=None, max_age=None):
+            pass
+
+        def read_environment(self, device):
+            gate = self.block.get(device.device_id)
+            if gate is not None:
+                gate.wait(timeout=5)
+            return {schema.POWER.name: 50.0}
+
+        def assemble(self, device, env, env_err, runtime_ready=True):
+            values = {schema.DUTY_CYCLE.name: 42.0}
+            values.update(env)
+            return Sample(device, values)
+
+        def sample(self, device):
+            return self.assemble(device, self.read_environment(device), None)
+
+    t = [100.0]
+    col = WedgeableSplit()
+    reg = Registry()
+    loop = PollLoop(col, reg, interval=0.05, deadline=0.3,
+                    clock=lambda: t[0])  # fence = 2 * interval = 0.1
+    gate = threading.Event()
+    try:
+        loop.tick()  # blocking cold tick: env completes for both chips
+        assert get(reg.snapshot(), schema.POWER.name, chip="0") != []
+
+        col.block["0"] = gate  # chip 0's next read wedges
+        t[0] += 0.05
+        loop.tick()  # pipelined: serves last round, kicks one that wedges
+        # Let chip 1's round read land (only chip 0's is wedged) so the
+        # fence-expiry demotion below hits exactly the wedged device.
+        concurrent.futures.wait([loop._env_round["1"]], timeout=2)
+        t[0] += 0.20  # age > fence: the wedged read gets demoted
+        loop.tick()  # blocking fallback: chip 0 is stuck -> stale
+        snap = reg.snapshot()
+        assert get(snap, "accelerator_up", chip="0")[0][1] == 0.0
+        assert get(snap, "accelerator_up", chip="1")[0][1] == 1.0
+
+        t[0] += 0.05
+        loop.tick()  # pipelined again (chip 1's read refreshed the fence)
+        snap = reg.snapshot()
+        # Chip 0's read is still wedged: visibly down and counted, never
+        # the frozen power=50 from before the wedge.
+        assert get(snap, "accelerator_up", chip="0")[0][1] == 0.0
+        assert get(snap, schema.POWER.name, chip="0") == []
+        assert loop._errors.get("stuck", 0) >= 2
+        assert get(snap, schema.POWER.name, chip="1") != []
+
+        gate.set()  # backend unwedges; the parked read completes
+        col.block.clear()
+        for _ in range(3):  # reap -> re-included round -> harvested
+            t[0] += 0.05
+            time.sleep(0.05)
+            loop.tick()
+        assert get(reg.snapshot(), schema.POWER.name, chip="0") != []
+    finally:
+        gate.set()
+        loop.stop()
+
+
+def test_full_env_timeout_does_not_rearm_pipelined_fast_path():
+    """A blocking tick where EVERY environment read missed the deadline
+    must not refresh the pipelined freshness fence: the next tick has to
+    block (and mark the devices stale) again, not assemble 'fresh'
+    runtime-only samples around reads that never completed."""
+    import threading
+
+    class AlwaysWedged(Collector):
+        name = "wedged"
+        pipelined_wait = True
+
+        def __init__(self):
+            self.gate = threading.Event()
+
+        def discover(self):
+            return [Device(i, str(i), f"/dev/accel{i}", "stub")
+                    for i in range(2)]
+
+        def begin_tick(self):
+            pass
+
+        def wait_ready(self, timeout=None, max_age=None):
+            pass
+
+        def read_environment(self, device):
+            self.gate.wait(timeout=5)
+            return {schema.POWER.name: 50.0}
+
+        def assemble(self, device, env, env_err, runtime_ready=True):
+            values = {schema.DUTY_CYCLE.name: 42.0}
+            values.update(env)
+            return Sample(device, values)
+
+        def sample(self, device):
+            return self.assemble(device, self.read_environment(device), None)
+
+    t = [100.0]
+    col = AlwaysWedged()
+    reg = Registry()
+    loop = PollLoop(col, reg, interval=0.05, deadline=0.05,
+                    clock=lambda: t[0])
+    try:
+        loop.tick()  # cold blocking tick: both reads time out
+        assert [v for _, v in get(reg.snapshot(), "accelerator_up")] == \
+            [0.0, 0.0]
+        for _ in range(3):
+            t[0] += 0.05
+            loop.tick()
+            # Every subsequent tick must also be a blocking one that
+            # reports the outage — a re-armed pipelined fast path would
+            # flip the chips to up=1 runtime-only around the dead reads.
+            assert [v for _, v in get(reg.snapshot(), "accelerator_up")] == \
+                [0.0, 0.0]
+    finally:
+        col.gate.set()
+        loop.stop()
+
+
+def test_unchanged_fetch_generation_replays_ici_rates():
+    """Pipelined regression: a tick re-serving the SAME completed fetch
+    (generation unchanged) must replay the previous rates, not feed the
+    tracker a duplicate observation — which would emit a bogus zero rate
+    and reset the baseline under the genuinely-new counters after it."""
+
+    class SeqCollector(Collector):
+        name = "seq"
+
+        def __init__(self):
+            self.counter = 1000
+            self.runtime_fetch_seq = 1
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "stub")]
+
+        def sample(self, device):
+            return Sample(device, {schema.DUTY_CYCLE.name: 1.0},
+                          ici_counters={"x_plus": self.counter})
+
+    t = [50.0]
+    col = SeqCollector()
+    reg = Registry()
+    loop = PollLoop(col, reg, deadline=5.0, clock=lambda: t[0])
+
+    def bandwidths():
+        return [v for _, v in
+                get(reg.snapshot(), schema.ICI_BANDWIDTH.name)]
+
+    loop.tick()
+    assert bandwidths() == []  # first observation: no rate yet
+    t[0] = 51.0
+    col.counter, col.runtime_fetch_seq = 2000, 2
+    loop.tick()
+    assert bandwidths() == [1000.0]
+    t[0] = 52.0  # same generation re-served: replay, not 0
+    loop.tick()
+    assert bandwidths() == [1000.0]
+    t[0] = 53.0
+    col.counter, col.runtime_fetch_seq = 4000, 3
+    loop.tick()
+    # Baseline untouched by the duplicate: (4000-2000)/(53-51), not /1.
+    assert bandwidths() == [1000.0]
     loop.stop()

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Profile the poll-tick hot path (`make profile-tick`).
+
+Runs the production stack — TpuCollector (native sysfs fast path when
+built) against an in-process fake libtpu server over a sysfs fixture
+tree — for N ticks under cProfile and prints the top-K functions by
+cumulative time. One command to localize a tick regression: the
+BENCH trajectory says *that* p50 moved, this says *where*.
+
+Defaults favor localization over realism: zero scripted RPC delay so
+exporter CPU dominates the report instead of time.sleep, and the fake
+server in-process so its decode shows up attributed (the bench keeps it
+out-of-process for honest latency numbers; this tool wants call trees).
+
+cProfile instruments only the calling thread, which is exactly the tick
+hot path: _sample_all orchestration, the wait on the batched fetch,
+sample assembly, tick-state fold, and the plan-slot snapshot build all
+run on it. Pool-worker file IO (workers.py) is invisible here — it
+overlaps the RPC and is priced by the bench, not this profile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import pstats
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from kube_gpu_stats_tpu.collectors.composite import TpuCollector  # noqa: E402
+from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient  # noqa: E402
+from kube_gpu_stats_tpu.poll import PollLoop  # noqa: E402
+from kube_gpu_stats_tpu.registry import Registry  # noqa: E402
+from kube_gpu_stats_tpu.testing import FakeLibtpuServer, make_sysfs  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ticks", type=int, default=200,
+                        help="profiled ticks (default 200)")
+    parser.add_argument("--warmup", type=int, default=10,
+                        help="unprofiled warmup ticks: plans compile, "
+                             "caches fill (default 10)")
+    parser.add_argument("--chips", type=int, default=8)
+    parser.add_argument("--top", type=int, default=20,
+                        help="rows in the cumulative report (default 20)")
+    parser.add_argument("--rpc-delay", type=float, default=0.0,
+                        help="scripted fake-runtime RPC delay in seconds "
+                             "(default 0: pure exporter CPU)")
+    parser.add_argument("--legacy", action="store_true",
+                        help="profile the pre-plan builder path "
+                             "(use_tick_plan=False) for an A/B read")
+    parser.add_argument("--sort", default="cumulative",
+                        choices=("cumulative", "tottime"),
+                        help="pstats sort key (default cumulative)")
+    args = parser.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sysroot = Path(tmp) / "sys"
+        make_sysfs(sysroot, num_chips=args.chips)
+        server = FakeLibtpuServer(num_chips=args.chips)
+        server.delay = args.rpc_delay
+        server.start()
+        loop = None
+        try:
+            collector = TpuCollector(
+                sysfs_root=str(sysroot),
+                libtpu_client=LibtpuClient(ports=(server.port,),
+                                           rpc_timeout=5.0),
+                use_native=True,
+            )
+            loop = PollLoop(collector, Registry(), deadline=10.0,
+                            use_tick_plan=not args.legacy)
+            for _ in range(args.warmup):
+                loop.tick()
+            profile = cProfile.Profile()
+            profile.enable()
+            for _ in range(args.ticks):
+                loop.tick()
+            profile.disable()
+        finally:
+            if loop is not None:
+                loop.stop()
+            server.stop()
+
+    out = io.StringIO()
+    stats = pstats.Stats(profile, stream=out)
+    stats.sort_stats(args.sort).print_stats(args.top)
+    print(f"# profile-tick: {args.ticks} ticks x {args.chips} chips, "
+          f"rpc_delay={args.rpc_delay * 1000:g} ms, "
+          f"path={'legacy' if args.legacy else 'plan'}")
+    print(f"# last_tick_stats: {loop.last_tick_stats}")
+    print(out.getvalue())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
