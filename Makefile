@@ -1,12 +1,11 @@
-.PHONY: test bench bench-quick profile-tick native dashboard golden clean run-mock ci chaos
+.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
 # (helm render when the binary exists, the static chart tests always),
 # wheel + console-script smoke in a scratch venv (no index needed).
-ci: native
+ci: native lint
 	python -m pytest tests/ -q -m 'not chaos'
-	python tools/check_no_nvml.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -44,6 +43,20 @@ bench: native
 # BENCH artifact (the line carries quick: true).
 bench-quick: native
 	python bench.py --quick
+
+# Static gates with no pytest run: the schema/docs sync check (a
+# MetricSpec added without regenerating docs/METRICS.md fails here with
+# the fix in the message) and the zero-NVML grep.
+lint:
+	python tools/check_metrics_docs.py
+	python tools/check_no_nvml.py
+
+# Eyeball where tick time goes: 200 simulated ticks through the
+# production loop with the flight recorder on, dumped as Chrome
+# trace-event JSON (open in chrome://tracing / ui.perfetto.dev).
+# profile-tick says WHICH FUNCTIONS; this shows WHEN, per tick phase.
+trace-tick: native
+	python tools/trace_dump.py --ticks 200 --out /tmp/kts-trace.json
 
 # Localize a tick regression (<30 s): cProfile over a 200-tick
 # simulated run (8 chips, in-process fake runtime, zero scripted RPC

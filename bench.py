@@ -73,6 +73,11 @@ def _quick() -> int:
         "tick_alloc_objects_per_tick": result.get(
             "tick_alloc_objects_per_tick"),
         "rpc_calls_per_tick": result.get("rpc_calls_per_tick"),
+        # Flight-recorder cost pins (ISSUE 4): spans recorded per tick
+        # and the measured per-span overhead budget.
+        "tick_spans_per_tick": result.get("tick_spans_per_tick"),
+        "trace_overhead_ns_per_span": result.get(
+            "trace_overhead_ns_per_span"),
         "mode": result["mode"],
         "chips": result["chips"],
         "quick": True,
@@ -153,6 +158,13 @@ def main() -> int:
         "tick_series_per_tick": result.get("tick_series_per_tick"),
         "rpc_calls_per_tick": result.get("rpc_calls_per_tick"),
         "rpc_batched_families": result.get("rpc_batched_families"),
+        # Flight-recorder cost pins (ISSUE 4): spans recorded per tick
+        # (phases + per-device/per-port aux) and the measured per-span
+        # overhead — tracing ships ON by default, so its price is a
+        # north-star input, budget-pinned in tests/test_latency.py.
+        "tick_spans_per_tick": result.get("tick_spans_per_tick"),
+        "trace_overhead_ns_per_span": result.get(
+            "trace_overhead_ns_per_span"),
         "mode": result["mode"],
         "path": result.get("path", "fake-grpc"),
         "chips": result["chips"],

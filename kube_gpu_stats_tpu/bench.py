@@ -23,6 +23,7 @@ import statistics
 import time
 from pathlib import Path
 
+from . import tracing
 from .collectors import Collector
 from .collectors.composite import TpuCollector
 from .collectors.libtpu import LibtpuClient
@@ -54,6 +55,11 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
     registry = Registry()
     loop = PollLoop(collector, registry, deadline=10.0,
                     pipeline_fetch=pipeline_fetch)
+    # Full production trace wiring (daemon._wire_tracer analog): the
+    # per-port RPC aux spans must be part of the measured cost.
+    setter = getattr(collector, "set_tracer", None)
+    if callable(setter):
+        setter(loop.tracer)
     durations: list[float] = []
     scrape_ms: list[float] = []
     # Allocation + transport accounting (ISSUE 3 "pinned, not
@@ -161,6 +167,14 @@ def measure_collector(collector: Collector, *, ticks: int, warmup: int,
         "tick_series_per_tick": loop.last_tick_stats.get("series"),
         "tick_series_reused_per_tick": loop.last_tick_stats.get(
             "series_reused"),
+        # Flight-recorder cost pins (ISSUE 4): spans each tick actually
+        # recorded (phases + per-device/per-port aux spans; 0 would mean
+        # tracing silently off) and the measured per-span overhead — the
+        # hard budget tests/test_latency.py enforces, shipped here so
+        # BENCH artifacts carry the number, not an anecdote.
+        "tick_spans_per_tick": round(loop.tracer.spans_per_trace(), 1),
+        "trace_overhead_ns_per_span": round(tracing.measure_overhead_ns(),
+                                            1),
     }
     if rpc_stats is not None and rpc_calls_before is not None and ticks:
         result["rpc_calls_per_tick"] = round(

@@ -144,6 +144,12 @@ class TpuCollector(Collector):
         """Per-port runtime breakers (supervisor/doctor resilience)."""
         return self._libtpu.breakers()
 
+    def set_tracer(self, tracer) -> None:
+        """Flight-recorder pass-through: the libtpu half owns the
+        per-port RPC spans (daemon wires this; duck-typed for backends
+        without it)."""
+        self._libtpu.set_tracer(tracer)
+
     @property
     def runtime_fetch_seq(self) -> int:
         """Completed-fetch generation (poll loop: rate-feed dedup)."""

@@ -214,6 +214,11 @@ class LibtpuClient:
                  breaker_min_span: float = 2.0) -> None:
         self._rpc_timeout = rpc_timeout
         self.ports = tuple(ports)
+        # Flight recorder (tracing.Tracer), set via the collectors'
+        # set_tracer chain: each port's RPC wave records an aux span
+        # carrying the port number — the "which port" half of a slow
+        # tick's post-mortem. None = no recording.
+        self.tracer = None
         # RPCs actually issued (breaker-refused calls don't count): the
         # transport-cost figure behind bench's rpc_calls_per_tick. A
         # plain int — written on the fetch thread, read anywhere
@@ -321,6 +326,8 @@ class LibtpuClient:
                 return None, BreakerOpenError(
                     f"libtpu port {port} circuit open "
                     f"({breaker.describe()})")
+            tracer = self.tracer
+            start_ns = tracer.clock_ns() if tracer is not None else 0
             timeout = self._rpc_timeout
             wait_for_ready = False
             if breaker.state == HALF_OPEN:
@@ -335,22 +342,26 @@ class LibtpuClient:
                 timeout = max(timeout, self.PROBE_RPC_TIMEOUT)
                 wait_for_ready = True
             try:
-                response = method(request, timeout=timeout,
-                                  wait_for_ready=wait_for_ready)
-            except grpc.RpcError as exc:
-                if exc.code() in REJECTED_STATUS:
-                    breaker.record_success()
-                else:
+                try:
+                    response = method(request, timeout=timeout,
+                                      wait_for_ready=wait_for_ready)
+                except grpc.RpcError as exc:
+                    if exc.code() in REJECTED_STATUS:
+                        breaker.record_success()
+                    else:
+                        breaker.record_failure(exc)
+                    return None, exc
+                except Exception as exc:  # noqa: BLE001 - an admitted call
+                    # MUST record an outcome, whatever raised — an
+                    # unrecorded half-open probe would otherwise hold the
+                    # probe slot until the breaker's reclaim window.
                     breaker.record_failure(exc)
-                return None, exc
-            except Exception as exc:  # noqa: BLE001 - an admitted call
-                # MUST record an outcome, whatever raised — an
-                # unrecorded half-open probe would otherwise hold the
-                # probe slot until the breaker's reclaim window.
-                breaker.record_failure(exc)
-                return None, exc
-            breaker.record_success()
-            return response, None
+                    return None, exc
+                breaker.record_success()
+                return response, None
+            finally:
+                if tracer is not None:
+                    tracer.aux_span("rpc_port", start_ns, port=port)
 
         pairs = list(zip(self.ports, self._methods))
         if self._port_pool is not None:
@@ -466,8 +477,15 @@ class LibtpuClient:
             name: ([], []) for name in metric_names
         }
         pending: list[tuple[str, int, object]] = []
+        tracer = self.tracer
+        # One aux span per PORT for the whole burst (first issue to last
+        # result), not one per family: the post-mortem question is
+        # "which port was slow", and a span per (port, family) would
+        # just burn the trace's span budget saying it N times.
+        port_spans: dict[int, list] = {}
         for port, method in zip(self.ports, self._methods):
             breaker = self.breakers[port]
+            burst_start = tracer.clock_ns() if tracer is not None else 0
             for name in metric_names:
                 if not breaker.allow():
                     out[name][1].append(BreakerOpenError(
@@ -496,6 +514,8 @@ class LibtpuClient:
                 # above issued no RPC, and the counter's contract is
                 # "RPCs actually issued".
                 self.rpc_calls_total += 1
+                if burst_start and port not in port_spans:
+                    port_spans[port] = [burst_start, 0]
                 pending.append((name, port, future))
         for name, port, future in pending:
             breaker = self.breakers[port]
@@ -512,6 +532,13 @@ class LibtpuClient:
                 breaker.record_failure(exc)
                 out[name][1].append(exc)
                 continue
+            finally:
+                # Advance the port's burst-end stamp on EVERY outcome
+                # (finally runs before each continue too): the span ends
+                # when the port's last pending result resolved.
+                span = port_spans.get(port)
+                if span is not None:
+                    span[1] = tracer.clock_ns()
             breaker.record_success()
             try:
                 decoded, dialect = tpumetrics.decode_response_ex(
@@ -524,6 +551,10 @@ class LibtpuClient:
                 continue
             self.note_dialect(port, dialect, raw)
             out[name][0].extend(decoded)
+        for port, (start_ns, end_ns) in port_spans.items():
+            if end_ns:
+                tracer.aux_span("rpc_port", start_ns,
+                                dur_ns=end_ns - start_ns, port=port)
         return out
 
     def get_raw_with_errors(
@@ -1056,6 +1087,15 @@ class LibtpuCollector(Collector):
     def breakers(self) -> Mapping[str, "CircuitBreaker"]:
         """Per-port circuit breakers (supervisor/doctor resilience)."""
         return self._client.breakers_by_name()
+
+    def set_tracer(self, tracer) -> None:
+        """Wire the flight recorder into the transport: per-port RPC
+        waves record aux spans (the "which port" post-mortem evidence).
+        Duck-typed clients without the attribute just don't record."""
+        try:
+            self._client.tracer = tracer
+        except AttributeError:  # __slots__-style stand-in client
+            pass
 
     @property
     def runtime_fetch_seq(self) -> int:

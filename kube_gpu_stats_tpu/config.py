@@ -57,6 +57,10 @@ class Config:
     #                              fetch/env round (RPC + file IO overlap
     #                              the inter-tick idle); False joins this
     #                              tick's own fetch (pre-ISSUE-3 behavior)
+    trace_enabled: bool = True  # flight recorder: per-tick span traces +
+    #                             event journal behind /debug/ticks|trace|
+    #                             events; --no-trace disables recording
+    #                             (the endpoints stay up and say so)
     drop_labels: tuple[str, ...] = ()  # label keys emitted as "" (cardinality)
     metrics_include: tuple[str, ...] = ()  # family allowlist (() = all)
     metrics_exclude: tuple[str, ...] = ()  # family denylist
@@ -236,6 +240,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "of the tick latency budget; values then lag the "
                         "tick by up to the freshness fence, 2x the poll "
                         "interval)")
+    p.add_argument("--no-trace", action="store_true",
+                   default=_env_bool("NO_TRACE"),
+                   help="disable the flight recorder (per-tick span "
+                        "traces + anomaly event journal served at "
+                        "/debug/ticks, /debug/trace and /debug/events). "
+                        "On by default: the overhead is a handful of "
+                        "clock reads per tick, pinned by the latency "
+                        "harness (trace_overhead_ns_per_span)")
     p.add_argument("--drop-labels", default=_env("DROP_LABELS", ""),
                    help="comma-separated label keys to blank out (emitted as "
                         "empty strings for cardinality control, e.g. "
@@ -452,6 +464,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         attribution_interval=args.attribution_interval,
         rediscovery_interval=args.rediscovery_interval,
         pipeline_fetch=not args.no_pipeline_fetch,
+        trace_enabled=not args.no_trace,
         drop_labels=drop_labels,
         metrics_include=metrics_include,
         metrics_exclude=metrics_exclude,

@@ -22,6 +22,7 @@ from .poll import AttributionProvider, NullAttribution, PollLoop
 from .procopen import DeviceProcessWatcher
 from .registry import Registry
 from .supervisor import Supervisor
+from .tracing import Tracer
 from .workers import PeriodicRefresher
 
 log = logging.getLogger(__name__)
@@ -173,6 +174,7 @@ class BackendUpgradeWatcher(PeriodicRefresher):
             return
         log.info("auto backend: %s now present; upgrading from %s",
                  new.name, self._daemon.collector.name)
+        self._daemon._wire_tracer(new)
         self._daemon.collector = new
         self._daemon.poll.replace_collector(new)
         if _backend_priority(new) >= 2:
@@ -189,7 +191,15 @@ class Daemon:
         self.cfg = cfg
         self.registry = Registry()
         self.render_stats = RenderStats()
+        # Flight recorder (tracing.py): one instance shared by the poll
+        # loop (span recording), the supervisor (breaker/health journal
+        # feed), the collector's transport (per-port RPC spans) and the
+        # HTTP server (/debug/ticks, /debug/trace, /debug/events).
+        # --no-trace keeps the object (endpoints answer "disabled")
+        # but every recording call becomes a cheap no-op.
+        self.tracer = Tracer(enabled=cfg.trace_enabled)
         self.collector = build_collector(cfg)
+        self._wire_tracer(self.collector)
         self.attribution = build_attribution(cfg)
         # Crash-only supervisor (supervisor.py): owns liveness/hang
         # detection and restart-with-backoff for every worker thread,
@@ -199,7 +209,8 @@ class Daemon:
         # upgrade, and the attribution source's lazy PodResources
         # client, both resolve at read time.
         self.supervisor = Supervisor(
-            check_interval=max(0.1, min(1.0, cfg.interval)))
+            check_interval=max(0.1, min(1.0, cfg.interval)),
+            tracer=self.tracer)
         self.supervisor.register_breaker_provider(self._collector_breakers)
         self.supervisor.register_breaker_provider(self._attribution_breakers)
         # Per-process device holders (accelerator_process_open): the lazy
@@ -232,6 +243,7 @@ class Daemon:
             render_stats=self.render_stats.contribute,
             health_stats=self.supervisor.contribute,
             heartbeat=self.supervisor.beater("poll"),
+            tracer=self.tracer,
         )
         # Hung-tick watchdog threshold: same formula as healthz_max_age
         # (a few missed intervals; floor for tiny test intervals), so the
@@ -255,6 +267,7 @@ class Daemon:
             auth_password_sha256=cfg.auth_password_sha256,
             render_stats=self.render_stats,
             health_provider=self.supervisor.health_report,
+            trace_provider=self.tracer,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir,
@@ -289,6 +302,13 @@ class Daemon:
                 extra_labels=cfg.remote_write_extra_labels,
                 render_stats=self.render_stats,
             )
+
+    def _wire_tracer(self, collector) -> None:
+        """Hand the flight recorder to a collector's transport (duck-
+        typed: backends without per-port RPCs just don't record)."""
+        setter = getattr(collector, "set_tracer", None)
+        if callable(setter):
+            setter(self.tracer)
 
     def _collector_breakers(self):
         """Current collector's circuit breakers (late-bound: survives

@@ -14,9 +14,16 @@ Accepts the exporter's own flags (same config surface, C6) plus:
   --json         machine-readable output
   --url TARGET   also scrape TARGET (URL or .prom file) and check it
                  against the accelerator_* exposition contract
+  --trace        pull the RUNNING daemon's flight recorder
+                 (/debug/ticks + /debug/events) and print a
+                 "last slow tick" post-mortem: worst phase, the
+                 responsible device/port, and co-occurring journal
+                 events. Uses the --url target's server (default
+                 http://127.0.0.1:9400/metrics).
 
-Exit code: 0 = no failures (warns allowed), 1 = at least one failure.
-Every probe is time-bounded; doctor never hangs on a wedged runtime.
+Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
+2 = usage error. Every probe is time-bounded; doctor never hangs on a
+wedged runtime.
 """
 
 from __future__ import annotations
@@ -526,6 +533,100 @@ def check_live_resilience(target: str,
                                       for c, v in states.items()}})
 
 
+def trace_base(url: str) -> str:
+    """The server base for /debug/* from a --url scrape target."""
+    base = url.rstrip("/")
+    if base.endswith("/metrics"):
+        base = base[: -len("/metrics")]
+    return base
+
+
+def _fetch_json(url: str, timeout: float = 5.0):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def trace_post_mortem(ticks: dict, events: list) -> tuple[str, dict]:
+    """(detail line, data payload) for the slowest recorded tick: worst
+    phase, the responsible device/port/target (the slowest attributed
+    span the recorder pre-joined as ``blame``), and journal events that
+    fired within ±2 ticks of it. Pure so tests drive it on canned JSON;
+    check_trace wraps it with the fetch/auth/version classification."""
+    slowest = ticks.get("slowest") or []
+    row = slowest[0]
+    seq = row.get("seq")
+    parts = [
+        f"last slow tick: {row.get('kind', 'tick')} seq {seq} took "
+        f"{row.get('dur_ms', 0.0):.1f} ms",
+        f"worst phase {row.get('worst_phase')} "
+        f"({row.get('worst_phase_ms', 0.0):.1f} ms)",
+    ]
+    blame = row.get("blame")
+    if blame:
+        attrs = ",".join(
+            f"{key}={value}"
+            for key, value in sorted((blame.get("attrs") or {}).items()))
+        parts.append(f"responsible: {blame.get('span')}[{attrs}] "
+                     f"{blame.get('dur_ms', 0.0):.1f} ms")
+    nearby = [
+        event for event in events
+        if isinstance(seq, int) and isinstance(event.get("tick_seq"), int)
+        and abs(event["tick_seq"] - seq) <= 2
+    ]
+    if nearby:
+        parts.append("co-occurring events: " + "; ".join(
+            f"[seq {event['tick_seq']}] {event.get('kind')}: "
+            f"{event.get('detail')}" for event in nearby[:3]))
+    dropped = ticks.get("dropped_spans_total", 0)
+    if dropped:
+        parts.append(f"{dropped} span(s) dropped — trace truncated")
+    return "; ".join(parts), {"slowest": row, "events": nearby[:10]}
+
+
+def check_trace(base: str) -> CheckResult:
+    """--trace: read the RUNNING daemon's flight recorder and print the
+    post-mortem. The short measured `poll` row probes a FRESH loop whose
+    recorder starts empty; the daemon that had one slow tick an hour ago
+    carries the evidence here — same live-vs-fresh split as
+    check_live_resilience."""
+    import urllib.error
+
+    try:
+        ticks = _fetch_json(base + "/debug/ticks")
+        events = _fetch_json(base + "/debug/events").get("events", [])
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "trace", WARN,
+                f"{base}/debug/ticks requires authentication "
+                f"(HTTP {exc.code}); the flight recorder sits behind the "
+                f"exporter's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "trace", WARN,
+                f"{base}: no /debug/ticks (exporter predates the flight "
+                f"recorder, or this server has no tracer wired)")
+        return _result("trace", FAIL, f"{base}/debug/ticks: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable daemon, bad JSON
+        return _result("trace", FAIL,
+                       f"{base}: flight recorder unreadable ({exc})")
+    if not ticks.get("enabled", True):
+        return _result(
+            "trace", WARN,
+            "tracing disabled on the daemon (--no-trace); no flight "
+            "record to post-mortem")
+    if not ticks.get("slowest"):
+        return _result(
+            "trace", WARN,
+            f"no ticks recorded yet (current seq "
+            f"{ticks.get('current_seq', 0)}); is the poll loop running?")
+    detail, data = trace_post_mortem(ticks, events)
+    return _result("trace", OK, detail, data=data)
+
+
 def check_url(target: str) -> list[CheckResult]:
     """Both --url rows — scrape contract + live breaker state — off ONE
     fetch: a node being diagnosed precisely because it is degraded must
@@ -704,7 +805,8 @@ def check_embedded_viability(cfg: Config) -> CheckResult:
         f"nothing to export on this node")
 
 
-def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
+def run_checks(cfg: Config, url: str = "",
+               trace: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -726,6 +828,15 @@ def run_checks(cfg: Config, url: str = "") -> list[CheckResult]:
     if url:
         # One probe, one fetch, two rows (scrape + live-resilience).
         probes.append(("scrape", lambda: check_url(url)))
+    if trace:
+        # Only an http(s) --url names a live daemon; a .prom file target
+        # (which --url also accepts) has no flight recorder — fall back
+        # to the local daemon on the CONFIGURED listen port (doctor
+        # accepts all exporter flags, --listen-port included) rather
+        # than urlopen a file path into a spurious [fail].
+        base = (trace_base(url) if url.startswith(("http://", "https://"))
+                else f"http://127.0.0.1:{cfg.listen_port}")
+        probes.append(("trace", lambda: check_trace(base)))
     results: list[CheckResult] = []
     for name, probe in probes:
         results.extend(_bounded(name, probe))
@@ -777,12 +888,15 @@ def render_text(results: Sequence[CheckResult],
 def main(argv: Sequence[str] | None = None) -> int:
     raw = list(sys.argv[1:] if argv is None else argv)
     as_json = False
+    trace = False
     url = ""
     args: list[str] = []
     it = iter(raw)
     for token in it:
         if token == "--json":
             as_json = True
+        elif token == "--trace":
+            trace = True
         elif token == "--url":
             url = next(it, "")
             if not url or url.startswith("--"):
@@ -799,7 +913,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             args.append(token)
     cfg = from_args(args)
     started = time.monotonic()
-    results = run_checks(cfg, url=url)
+    results = run_checks(cfg, url=url, trace=trace)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

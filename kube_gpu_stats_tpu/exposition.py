@@ -153,10 +153,18 @@ class MetricsServer:
                  auth_username: str = "", auth_password_sha256: str = "",
                  max_concurrent_scrapes: int = 16,
                  render_stats: RenderStats | None = None,
-                 ready_check=None, health_provider=None):
+                 ready_check=None, health_provider=None,
+                 trace_provider=None):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
+        # Flight recorder (tracing.Tracer, duck-typed): serves the
+        # /debug/ticks (phase summaries + slowest-tick table),
+        # /debug/trace (Chrome trace-event JSON), and /debug/events
+        # (anomaly journal) endpoints — all behind the same basic-auth
+        # gate as /metrics. None = those paths 404 (hub/daemon wire it;
+        # bare test servers don't).
+        self._trace = trace_provider
         # Optional () -> [(component, state, reason)] rows (the
         # supervisor's health_report): /healthz carries per-component
         # reasons so "degraded" is diagnosable from a curl, while the
@@ -217,6 +225,15 @@ class MetricsServer:
                 ) & hmac.compare_digest(
                     digest.encode(), expected_hash.encode()
                 )
+
+            def _query(self) -> dict:
+                """name -> raw value from the request's query string
+                (shared by the /debug endpoints)."""
+                params: dict = {}
+                for part in self.path.partition("?")[2].split("&"):
+                    key, _, value = part.partition("=")
+                    params[key] = value
+                return params
 
             def _send_plain(self, code: int, body: bytes,
                             headers: dict | None = None) -> None:
@@ -350,15 +367,11 @@ class MetricsServer:
                     # overhead.
                     from . import profiler
 
-                    query = self.path.partition("?")[2]
                     seconds = 5.0
-                    for part in query.split("&"):
-                        key, _, value = part.partition("=")
-                        if key == "seconds":
-                            try:
-                                seconds = float(value)
-                            except ValueError:
-                                pass
+                    try:
+                        seconds = float(self._query().get("seconds", ""))
+                    except ValueError:
+                        pass
                     # Comparison-based clamp: min/max pass NaN through,
                     # and a NaN deadline would return an empty profile.
                     if not seconds >= 0.1:
@@ -375,6 +388,34 @@ class MetricsServer:
                         outer._profile_lock.release()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                elif path in ("/debug/ticks", "/debug/trace",
+                              "/debug/events") and outer._trace is not None:
+                    # Flight recorder (tracing.py): per-phase summaries +
+                    # slowest-tick table, Chrome trace-event JSON for the
+                    # recorded ticks, and the anomaly event journal. Read
+                    # side is lock-cheap snapshots of the ring/journal —
+                    # a curl can never perturb the tick being recorded.
+                    import json
+
+                    params = self._query()
+                    if path == "/debug/ticks":
+                        payload = outer._trace.ticks_summary()
+                    elif path == "/debug/trace":
+                        try:
+                            last = int(params.get("last", "0") or 0)
+                        except ValueError:
+                            last = 0
+                        payload = outer._trace.chrome_trace(last or None)
+                    else:
+                        try:
+                            since = int(params.get("since", "0") or 0)
+                        except ValueError:
+                            since = 0
+                        payload = outer._trace.events(since)
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/threads":
                     # pprof analog (SURVEY.md §5): live stack dump of every
                     # thread — enough to diagnose a wedged sampler or a
@@ -392,14 +433,18 @@ class MetricsServer:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                 elif path == "/":
-                    body = (
-                        b"<html><body>kube-tpu-stats "
-                        b'<a href="/metrics">/metrics</a> '
-                        b'<a href="/healthz">/healthz</a> '
-                        b'<a href="/debug/threads">/debug/threads</a> '
-                        b'<a href="/debug/profile?seconds=5">/debug/profile</a>'
-                        b"</body></html>"
-                    )
+                    # Every endpoint this server actually serves, so the
+                    # landing page IS the endpoint inventory (the trace
+                    # endpoints appear only when a flight recorder is
+                    # wired — a bare registry server doesn't serve them).
+                    links = ["/metrics", "/healthz", "/readyz",
+                             "/debug/threads", "/debug/profile?seconds=5"]
+                    if outer._trace is not None:
+                        links += ["/debug/ticks", "/debug/trace?last=20",
+                                  "/debug/events"]
+                    body = ("<html><body>kube-tpu-stats " + " ".join(
+                        f'<a href="{link}">{link.partition("?")[0]}</a>'
+                        for link in links) + "</body></html>").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/html")
                 else:

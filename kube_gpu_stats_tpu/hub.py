@@ -46,6 +46,7 @@ from .registry import (HistogramState, Registry, Series, SnapshotBuilder,
                        contribute_push_stats)
 from .resilience import CircuitBreaker
 from .top import ChipRow, Frame, fold_target
+from .tracing import Tracer, log_every
 from .validate import (bounded_memo, fetch_exposition,
                        parse_exposition_interned)
 from .workers import DaemonSamplerPool
@@ -179,7 +180,8 @@ class Hub:
                  headers_provider=None,
                  target_ca_file: str = "",
                  target_insecure_tls: bool = False,
-                 targets_provider=None) -> None:
+                 targets_provider=None,
+                 tracer: Tracer | None = None) -> None:
         if not targets and targets_provider is None:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -254,6 +256,14 @@ class Hub:
         # sorted() in _merge_chip_series re-sorts the same few thousand
         # tuples every cycle. Bounded like validate's label cache.
         self._key_cache: dict[tuple, tuple] = {}
+        # Flight recorder (ISSUE 4): each refresh is one "cycle" trace —
+        # fetch / frame_fold / merge / publish phases plus per-target
+        # fetch+parse aux spans from the pool threads — and per-target
+        # breaker transitions land in the event journal. The hub main()
+        # hands the same tracer to its MetricsServer as the /debug
+        # provider.
+        self.tracer = tracer if tracer is not None else Tracer()
+        self._cycle_seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -268,6 +278,9 @@ class Hub:
                 f"target:{target}", failure_threshold=3,
                 recovery_time=self._breaker_recovery,
                 window=10, failure_rate_threshold=0.6)
+            # No supervisor in the hub process: the journal feed is
+            # wired right here in the factory.
+            breaker.on_transition = self.tracer.breaker_listener
             self._breakers[target] = breaker
         return breaker
 
@@ -275,6 +288,9 @@ class Hub:
 
     def refresh_once(self) -> Frame:
         start = time.monotonic()
+        tracer = self.tracer
+        self._cycle_seq += 1
+        tracer.begin("cycle", self._cycle_seq)
         self._refresh_targets()
         if not self._targets:
             # Discovery never succeeded, or the target list was
@@ -293,7 +309,11 @@ class Hub:
             builder.add(schema.HUB_WORKERS_EXPECTED,
                         float(self._expect_workers))
             self._publish(builder, start)
-            log.warning("hub refresh: %s", frame.errors[0])
+            tracer.end(targets=0)
+            if log_every("hub:no-targets", 60.0):
+                log.warning("hub refresh: %s (repeats suppressed for "
+                            "60s; alert on slice_targets == 0)",
+                            frame.errors[0])
             return frame
         errors: list[str] = []
         ats: list[float] = []
@@ -339,9 +359,12 @@ class Hub:
                 done = time.monotonic()
                 return entry, done, done - fetch_start, None
             parse_start = time.monotonic()
+            parse_ns = self.tracer.clock_ns() if self.tracer.enabled else 0
             entry = _TargetCache(body, parse_exposition_interned(body),
                                  stat_sig)
             parse_seconds = time.monotonic() - parse_start
+            if parse_ns:
+                self.tracer.aux_span("parse", parse_ns, target=target)
             self._parse_cache[target] = entry
             done = time.monotonic()
             return entry, done, done - fetch_start, parse_seconds
@@ -403,6 +426,7 @@ class Hub:
         # under the pool + deadline so a target on a hung NFS/FUSE
         # mount wedges one pool worker's worth of targets — never the
         # refresh loop itself.
+        fetch_mark = tracer.mark()
         futures: list[tuple[str, concurrent.futures.Future]] = []
         chunk_futures: list[tuple[list[str], list,
                                   concurrent.futures.Future]] = []
@@ -487,6 +511,15 @@ class Hub:
                 self._body_cache_hits += 1
             else:
                 self._parse_hist = self._parse_hist.observe(parse_seconds)
+            if self.tracer.enabled:
+                # Reconstructed from the measured wall time (the read
+                # ran on a pool thread): the "which target" span of a
+                # slow cycle's post-mortem.
+                dur_ns = int(took * 1e9)
+                self.tracer.aux_span(
+                    "target_fetch", self.tracer.clock_ns() - dur_ns,
+                    dur_ns=dur_ns, target=target,
+                    cached=parse_seconds is None)
             self._breaker(target).record_success()
 
         def salvage_stalled(members: list[str], future, seen: set,
@@ -606,6 +639,8 @@ class Hub:
                                 "file read")
                 continue
             record_outcomes(outcomes)
+        tracer.add_span("fetch", fetch_mark, targets=len(self._targets),
+                        answered=len(entries))
 
         # Deterministic merge order: recording order depends on which
         # targets were cache hits this refresh (sweep hits land before
@@ -627,6 +662,7 @@ class Hub:
         # update). The frame gets per-row COPIES stamped with this
         # refresh's fetch timestamp: Frame.rates mutates rows in place,
         # and the pristine cached originals must replay next refresh.
+        fold_mark = tracer.mark()
         rows: dict[tuple, ChipRow] = {}
         rollups: dict[tuple, float] = {}
         for (target, entry), at in zip(entries, ats):
@@ -643,7 +679,9 @@ class Hub:
         frame = Frame(rows, errors, rollups)
         frame.rates(self._previous)
         self._previous = frame
+        tracer.add_span("frame_fold", fold_mark)
 
+        merge_mark = tracer.mark()
         builder = SnapshotBuilder()
         for target in self._targets:
             builder.add(schema.HUB_TARGET_UP,
@@ -668,14 +706,29 @@ class Hub:
         # byte-compare and the cached plans never touch again.
         for _target, entry in entries:
             entry.series = entry.series_dicts = None
+        tracer.add_span("merge", merge_mark)
         try:
             proc_readings = proc_future.result(
                 timeout=max(0.0, deadline - time.monotonic()))
         except Exception:  # noqa: BLE001 - fall back to an inline read
             proc_readings = None
+        publish_mark = tracer.mark()
         self._publish(builder, start, proc_readings)
+        tracer.add_span("publish", publish_mark)
+        tracer.end(targets=len(self._targets), answered=len(entries),
+                   errors=len(errors))
         for err in errors:
-            log.warning("hub refresh: %s", err)
+            # One line per target per 30 s, not per refresh: a sustained
+            # outage at the 10 s cadence is 360 identical lines/hour per
+            # target otherwise (slice_target_up carries the state).
+            # Split on ": " (the f"{target}: {message}" separator), not
+            # ":" — URL targets contain colons, and splitting on the
+            # bare colon would collapse every http target onto one
+            # "http" key, suppressing all but one target's reason.
+            key = err.split(": ", 1)[0]
+            if log_every(f"hub:refresh:{key}", 30.0):
+                log.warning("hub refresh: %s (repeats suppressed for "
+                            "30s)", err)
         return frame
 
     def _publish(self, builder: SnapshotBuilder, start: float,
@@ -694,6 +747,9 @@ class Hub:
         # short circuit fired; the parse histogram prices the misses.
         builder.add(schema.HUB_BODY_CACHE_HITS, float(self._body_cache_hits))
         builder.add_histogram(self._parse_hist)
+        # Flight-recorder health: nonzero means /debug/trace truncates.
+        builder.add(schema.TRACE_DROPPED_SPANS,
+                    float(self.tracer.dropped_spans_total))
         # Per-target breaker state: the hub's resilience self-metrics,
         # same families the daemon exports for its edges.
         for target in sorted(self._breakers):
@@ -970,10 +1026,12 @@ class Hub:
         if emit:
             builder.extend_series(emit)
         builder.add(schema.HUB_DUPLICATE_SERIES, float(duplicates))
-        if duplicates:
+        if duplicates and log_every("hub:duplicates", 60.0):
             log.warning(
                 "hub: dropped %d duplicate per-chip series (two targets "
-                "export the same chip identity — check topology labels)",
+                "export the same chip identity — check topology labels; "
+                "repeats suppressed for 60s, slice_duplicate_series "
+                "carries the count)",
                 duplicates)
 
     def _build_hist_local(self, target: str, series: Sequence) -> dict:
@@ -1392,7 +1450,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
         render_stats=render_stats,
-        ready_check=hub.ready)
+        ready_check=hub.ready,
+        trace_provider=hub.tracer)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

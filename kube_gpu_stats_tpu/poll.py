@@ -53,6 +53,7 @@ from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
                        Series, SnapshotBuilder, _series_prefix,
                        contribute_push_stats)
 from .resilience import DeadlineBudget
+from .tracing import Tracer, log_every
 from .workers import DaemonSamplerPool
 
 log = logging.getLogger(__name__)
@@ -283,6 +284,7 @@ class PollLoop:
         heartbeat: Callable[[], None] | None = None,
         use_tick_plan: bool = True,
         pipeline_fetch: bool = True,
+        tracer: Tracer | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -340,6 +342,22 @@ class PollLoop:
         # restores join-this-tick's-fetch.
         self._fetch_max_age = 2.0 * interval if pipeline_fetch else None
         self._clock = clock
+        # Flight recorder (ISSUE 4): every tick records phase spans
+        # (fetch_wait, env_round, fold, plan_write, publish) plus
+        # cross-thread aux spans (per-device env reads, per-port RPCs)
+        # into a ring of recent traces, and state transitions (plan
+        # compiles, pipeline fence expiries/demotions) into the event
+        # journal. On by default — the overhead is a few spans' worth of
+        # perf_counter_ns calls per tick, priced by the latency harness
+        # (trace_overhead_ns_per_span) — with --no-trace as the escape
+        # hatch (tracer.enabled False = every call a cheap no-op).
+        self.tracer = tracer if tracer is not None else Tracer()
+        self._tick_seq = 0
+        # Pipeline-fence edge detection: the journal records the fence
+        # EXPIRING and the fast path re-arming, not one event per tick
+        # of a long outage (the journal is a ring; a per-tick repeat
+        # would evict the rare events a post-mortem wants).
+        self._fence_expired = False
 
         self._devices: Sequence[Device] = collector.discover()
         workers = max_workers or max(4, len(self._devices))
@@ -573,6 +591,13 @@ class PollLoop:
         if owner is not None and self._thread is not owner:
             return 0.0  # superseded before starting: don't sample at all
         self._apply_pending_collector()
+        tracer = self.tracer
+        self._tick_seq += 1
+        # Trace abandonment mirrors the crash-only tick contract: a
+        # superseded thread's half-built trace is simply dropped (spans
+        # are thread-local, so it can never interleave with the fresh
+        # thread's); only a tick that publishes reaches end().
+        tracer.begin("tick", self._tick_seq)
         start = self._clock()
         results = self._sample_all()
         duration = self._clock() - start
@@ -582,7 +607,12 @@ class PollLoop:
         snapshot = self._build_snapshot(results, now=start + duration)
         if owner is not None and self._thread is not owner:
             return duration  # superseded during the build: don't publish
+        mark = tracer.mark()
         self._registry.publish(snapshot)
+        tracer.add_span("publish", mark)
+        tracer.end(devices=len(results),
+                   duration_ms=round(duration * 1000.0, 3),
+                   series=self.last_tick_stats.get("series", 0))
         return duration
 
     def run_forever(self) -> None:
@@ -669,6 +699,22 @@ class PollLoop:
             tls.results = []
         return futures, tls.results
 
+    def _traced_read(self, name: str, inner):
+        """Wrap a per-device sampling callable so each pool-thread read
+        records an aux span carrying the device id — the flight
+        recorder's "which device" answer. One closure per tick, never
+        per device; disabled tracing never reaches here."""
+        tracer = self.tracer
+
+        def read(dev):
+            start_ns = tracer.clock_ns()
+            try:
+                return inner(dev)
+            finally:
+                tracer.aux_span(name, start_ns, device=dev.device_id)
+
+        return read
+
     def _sample_all(self) -> list[tuple[Device, Sample | None]]:
         if self._process_metrics and self._proc_future is None:
             self._proc_future = self._pool.submit(procstats.read)
@@ -686,6 +732,10 @@ class PollLoop:
         )
         work = (self._collector.read_environment if split
                 else self._collector.sample)
+        tracer = self.tracer
+        if tracer.enabled:
+            work = self._traced_read("env_read" if split else "sample",
+                                     work)
         futures, results = self._tick_scratch()
         futures.clear()
         slot_of = self._slot_of
@@ -721,6 +771,7 @@ class PollLoop:
         budget = DeadlineBudget(self._deadline, clock=self._clock)
         runtime_ready = False
         if split:
+            mark = tracer.mark()
             try:
                 if pipelined:
                     self._collector.wait_ready(
@@ -732,8 +783,12 @@ class PollLoop:
                 # Fetch missed the tick deadline (or died): assemble with
                 # sysfs only — composite degraded mode, never a crash.
                 self._count_error("fetch_deadline")
-                log.warning("runtime fetch not ready within %gs: %s",
-                            self._deadline, exc)
+                if log_every("poll:fetch_deadline", 30.0):
+                    log.warning("runtime fetch not ready within %gs: %s "
+                                "(repeats suppressed for 30s; rate in "
+                                "collector_poll_errors_total)",
+                                self._deadline, exc)
+            tracer.add_span("fetch_wait", mark, ready=runtime_ready)
             # Capture the completed-fetch generation the assembles below
             # will peek — the fold keys its ICI rate-feed dedup on it.
             # Captured HERE, right after the join and before any peek:
@@ -745,6 +800,7 @@ class PollLoop:
             self._tls.tick_runtime_seq = getattr(
                 self._collector, "runtime_fetch_seq", None)
         env_fresh = False
+        mark = tracer.mark()
         for future, dev in futures.items():
             slot = slot_of[dev.device_id]
             try:
@@ -766,8 +822,10 @@ class PollLoop:
                 if split:
                     self._env_results.pop(dev.device_id, None)
                 self._count_error("deadline")
-                log.warning("sample of %s missed the %gs deadline",
-                            dev.device_path, self._deadline)
+                if log_every(f"poll:deadline:{dev.device_id}", 30.0):
+                    log.warning("sample of %s missed the %gs deadline "
+                                "(repeats suppressed for 30s)",
+                                dev.device_path, self._deadline)
                 results[slot] = (dev, None)
             except Exception as exc:  # CollectorError and anything else
                 if split and not isinstance(exc, concurrent.futures.CancelledError):
@@ -779,16 +837,22 @@ class PollLoop:
                     # alerting even when the runtime keeps the chip up.
                     if not isinstance(exc, CollectorError):
                         self._count_error(type(exc).__name__)
-                        log.warning("environment read of %s failed: %s",
-                                    dev.device_path, exc)
+                        if log_every(f"poll:env:{dev.device_id}", 30.0):
+                            log.warning("environment read of %s failed: %s "
+                                        "(repeats suppressed for 30s)",
+                                        dev.device_path, exc)
                     self._env_results[dev.device_id] = ({}, exc)
                     env_fresh = True
                     results[slot] = (
                         dev, self._assemble(dev, {}, exc, runtime_ready))
                     continue
                 self._count_error(type(exc).__name__)
-                log.warning("sample of %s failed: %s", dev.device_path, exc)
+                if log_every(f"poll:sample:{dev.device_id}", 30.0):
+                    log.warning("sample of %s failed: %s "
+                                "(repeats suppressed for 30s)",
+                                dev.device_path, exc)
                 results[slot] = (dev, None)
+        tracer.add_span("env_round", mark)
         if split and env_fresh:
             # Move the pipelined path's freshness fence only when a read
             # actually completed this tick (success or answered failure):
@@ -817,8 +881,10 @@ class PollLoop:
         except Exception as exc:  # noqa: BLE001 - per-device, surfaced via assemble
             if not isinstance(exc, CollectorError):
                 self._count_error(type(exc).__name__)
-                log.warning("environment read of device %s failed: %s",
-                            device_id, exc)
+                if log_every(f"poll:env:{device_id}", 30.0):
+                    log.warning("environment read of device %s failed: %s "
+                                "(repeats suppressed for 30s)",
+                                device_id, exc)
             self._env_results[device_id] = ({}, exc)
 
     def _sample_pipelined(
@@ -831,6 +897,7 @@ class PollLoop:
         caller then runs the blocking fan-out, which re-engages every
         deadline/staleness mechanism exactly as without pipelining."""
         now = self._clock()
+        tracer = self.tracer
         round_ = self._env_round
         if round_ is not None and all(f.done() for f in round_.values()):
             for device_id, future in round_.items():
@@ -844,11 +911,26 @@ class PollLoop:
             # running reads are demoted to the per-device outstanding
             # guard so the blocking fan-out cannot stack another worker
             # onto a wedged backend.
+            if self._env_results_at > 0.0 and not self._fence_expired:
+                # Journaled on the EDGE (expiry, matched by the re-arm
+                # event below), never per tick of an outage.
+                self._fence_expired = True
+                tracer.event(
+                    "pipeline_fence",
+                    f"completed env state older than "
+                    f"{self._fetch_max_age:g}s; blocking fan-out "
+                    f"re-engaged",
+                    age_s=round(now - self._env_results_at, 3))
             if round_ is not None:
                 self._env_round = None
                 for device_id, future in round_.items():
                     if not future.done():
                         self._outstanding.setdefault(device_id, future)
+                        tracer.event(
+                            "pipeline_demote",
+                            f"device {device_id}: wedged env read demoted "
+                            f"to the outstanding guard",
+                            device=device_id)
                         # Its completed entry is now older than the fence;
                         # a later pipelined tick must see "no environment
                         # read has completed yet", not serve the frozen
@@ -871,12 +953,15 @@ class PollLoop:
                               if f.done()]:
                 self._outstanding.pop(device_id, None)
             read = self._collector.read_environment
+            if tracer.enabled:
+                read = self._traced_read("env_read", read)
             self._env_round = {
                 dev.device_id: self._pool.submit(read, dev)
                 for dev in self._devices
                 if dev.device_id not in self._outstanding
             }
         runtime_ready = True
+        mark = tracer.mark()
         try:
             # Age-bounded join: in steady state a fetch completed within
             # the fence and this returns immediately. A fetch quiet past
@@ -889,10 +974,12 @@ class PollLoop:
         except Exception:  # noqa: BLE001 - degraded tick, never a crash
             self._count_error("fetch_deadline")
             runtime_ready = False
+        tracer.add_span("fetch_wait", mark, ready=runtime_ready)
         # Same capture point as the blocking path: the generation the
         # peeks below will serve, fixed before any assemble runs.
         self._tls.tick_runtime_seq = getattr(
             self._collector, "runtime_fetch_seq", None)
+        mark = tracer.mark()
         slot_of = self._slot_of
         empty_env: dict = {}
         for dev in self._devices:
@@ -918,6 +1005,14 @@ class PollLoop:
                 env, env_err = entry
             results[slot_of[dev.device_id]] = (
                 dev, self._assemble(dev, env, env_err, runtime_ready))
+        tracer.add_span("env_round", mark, pipelined=True)
+        if self._fence_expired:
+            # The fast path served again: close the expiry edge so the
+            # next outage journals a fresh pair.
+            self._fence_expired = False
+            tracer.event("pipeline_resume",
+                         "pipelined fast path re-armed (completed env "
+                         "state fresh again)")
         return results
 
     def _assemble(self, dev: Device, env, env_err,
@@ -928,7 +1023,10 @@ class PollLoop:
                                             runtime_ready=runtime_ready)
         except Exception as exc:
             self._count_error(type(exc).__name__)
-            log.warning("sample of %s failed: %s", dev.device_path, exc)
+            if log_every(f"poll:sample:{dev.device_id}", 30.0):
+                log.warning("sample of %s failed: %s "
+                            "(repeats suppressed for 30s)",
+                            dev.device_path, exc)
             return None
 
     def _count_error(self, reason: str) -> None:
@@ -1001,6 +1099,10 @@ class PollLoop:
         else:
             reason = "attribution"
         self._plan_compiles[reason] = self._plan_compiles.get(reason, 0) + 1
+        self.tracer.event(
+            "plan_compile",
+            f"device {dev.device_id}: tick plan compiled ({reason})",
+            device=dev.device_id, reason=reason)
         plan = _DevicePlan(dev, key, attribution, self._topology,
                            self._drop_labels, self._disabled_metrics,
                            self._built_cell)
@@ -1285,6 +1387,10 @@ class PollLoop:
             )
         builder.add(schema.TICK_PLAN_CACHE_HITS,
                     float(self._plan_cache_hits))
+        # Unconditional, born at 0: a nonzero rate means /debug/trace is
+        # truncating (span cap hit) and the recorded traces are partial.
+        builder.add(schema.TRACE_DROPPED_SPANS,
+                    float(self.tracer.dropped_spans_total))
         rpc_stats = getattr(self._collector, "rpc_stats", None)
         if rpc_stats is not None:
             builder.add(
@@ -1384,5 +1490,11 @@ class PollLoop:
         self, results: list[tuple[Device, Sample | None]], now: float
     ):
         self._built_cell[0] = 0
+        tracer = self.tracer
+        mark = tracer.mark()
         tick = self._update_tick_state(results, now)
-        return self._emit_snapshot(tick, self._use_tick_plan)
+        tracer.add_span("fold", mark)
+        mark = tracer.mark()
+        snapshot = self._emit_snapshot(tick, self._use_tick_plan)
+        tracer.add_span("plan_write", mark)
+        return snapshot

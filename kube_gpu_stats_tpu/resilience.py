@@ -140,6 +140,14 @@ class CircuitBreaker:
     call. ``clock`` is injectable so tests drive recovery without
     sleeping. ``trips_total``, ``last_error`` and ``state`` feed the
     kts_breaker_state / doctor-resilience surfaces.
+
+    ``on_transition`` (optional, assigned post-construction) is called
+    as ``hook(breaker, old_state, new_state)`` on every state change —
+    the flight recorder's journal feed (tracing.Tracer.breaker_listener;
+    the supervisor attaches it to every breaker it can see, the hub to
+    its per-target breakers). Fired AFTER the lock is released so a
+    hook may read breaker state freely; a hook exception is swallowed
+    (observer must never break the guarded edge).
     """
 
     def __init__(self, name: str = "", *, failure_threshold: int = 3,
@@ -173,6 +181,9 @@ class CircuitBreaker:
         self.trips_total = 0
         self.last_error: BaseException | str | None = None
         self.last_failure_at: float | None = None
+        # Transition observer (flight recorder). None = no journaling.
+        self.on_transition: Callable[["CircuitBreaker", str, str],
+                                     None] | None = None
 
     # -- state ---------------------------------------------------------------
 
@@ -203,6 +214,7 @@ class CircuitBreaker:
         """May a call proceed now? OPEN past recovery_time admits exactly
         one probe (HALF_OPEN); further calls are refused until the probe's
         outcome is recorded."""
+        fire: tuple[str, str] | None = None
         with self._lock:
             if self._state == CLOSED:
                 return True
@@ -212,19 +224,25 @@ class CircuitBreaker:
                     self._state = HALF_OPEN
                     self._probe_inflight = True
                     self._probe_started_at = now
-                    return True
-                return False
+                    fire = (OPEN, HALF_OPEN)
+                    allowed = True
+                else:
+                    allowed = False
             # HALF_OPEN: one probe at a time — but a probe whose outcome
             # was never recorded (admitted call abandoned before running,
             # e.g. a queued fetch dropped at a deadline) must not wedge
             # the breaker here forever: reclaim the slot after a
             # recovery window and admit a fresh probe.
-            if (not self._probe_inflight
+            elif (not self._probe_inflight
                     or now - self._probe_started_at >= self._recovery_time):
                 self._probe_inflight = True
                 self._probe_started_at = now
-                return True
-            return False
+                allowed = True
+            else:
+                allowed = False
+        if fire is not None:
+            self._fire(*fire)
+        return allowed
 
     def guard(self) -> None:
         """``allow()`` or raise :class:`BreakerOpenError` naming the
@@ -238,17 +256,22 @@ class CircuitBreaker:
     # -- outcomes ------------------------------------------------------------
 
     def record_success(self) -> None:
+        fire: tuple[str, str] | None = None
         with self._lock:
             self.consecutive_failures = 0
             self._streak_started_at = None
             self._push_outcome(False)
             if self._state != CLOSED:
+                fire = (self._state, CLOSED)
                 self._state = CLOSED
                 self._outcomes.clear()
             self._probe_inflight = False
             self.last_error = None
+        if fire is not None:
+            self._fire(*fire)
 
     def record_failure(self, error: BaseException | str | None = None) -> None:
+        fire: tuple[str, str] | None = None
         with self._lock:
             now = self._clock()
             self.consecutive_failures += 1
@@ -259,20 +282,21 @@ class CircuitBreaker:
             self.last_failure_at = now
             if self._state == HALF_OPEN:
                 # The probe failed: back to OPEN, recovery clock restarts.
-                self._trip()
-                return
-            if self._state == OPEN:
-                return
-            streak_start = (self._streak_started_at
-                            if self._streak_started_at is not None else now)
-            if (self.consecutive_failures >= self._failure_threshold
-                    and now - streak_start >= self._min_failure_span):
-                self._trip()
-            elif (self._rate_threshold is not None
-                  and len(self._outcomes) >= self._window
-                  and (sum(self._outcomes) / len(self._outcomes)
-                       >= self._rate_threshold)):
-                self._trip()
+                fire = self._trip()
+            elif self._state != OPEN:
+                streak_start = (self._streak_started_at
+                                if self._streak_started_at is not None
+                                else now)
+                if (self.consecutive_failures >= self._failure_threshold
+                        and now - streak_start >= self._min_failure_span):
+                    fire = self._trip()
+                elif (self._rate_threshold is not None
+                      and len(self._outcomes) >= self._window
+                      and (sum(self._outcomes) / len(self._outcomes)
+                           >= self._rate_threshold)):
+                    fire = self._trip()
+        if fire is not None:
+            self._fire(*fire)
 
     def call(self, fn: Callable, *args, **kwargs):
         """Run ``fn`` under the breaker: refused fast when open, outcome
@@ -292,11 +316,22 @@ class CircuitBreaker:
         if len(self._outcomes) > self._window:
             del self._outcomes[0]
 
-    def _trip(self) -> None:
+    def _trip(self) -> tuple[str, str]:
+        old = self._state
         self._state = OPEN
         self._opened_at = self._clock()
         self._probe_inflight = False
         self.trips_total += 1
+        return (old, OPEN)
+
+    def _fire(self, old: str, new: str) -> None:
+        hook = self.on_transition
+        if hook is None:
+            return
+        try:
+            hook(self, old, new)
+        except Exception:  # noqa: BLE001 - observer must not break the edge
+            pass
 
 
 class DeadlineBudget:

@@ -596,6 +596,15 @@ TICK_PLAN_CACHE_HITS = MetricSpec(
     "device-count every tick while kts_tick_plan_compiles_total stays "
     "flat.",
 )
+TRACE_DROPPED_SPANS = MetricSpec(
+    "kts_trace_dropped_spans_total",
+    MetricType.COUNTER,
+    "Spans the flight recorder dropped because one tick/cycle trace (or "
+    "the cross-thread side buffer) hit its span cap. Nonzero means "
+    "/debug/trace and the /debug/ticks phase stats are truncating — the "
+    "recorded traces stay valid, just incomplete. Steady state is 0; "
+    "see docs/OPERATIONS.md (flight recorder).",
+)
 RPC_BATCHED_FAMILIES = MetricSpec(
     "kts_rpc_batched_families",
     MetricType.GAUGE,
@@ -729,6 +738,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_ERRORS,
     TICK_PLAN_COMPILES,
     TICK_PLAN_CACHE_HITS,
+    TRACE_DROPPED_SPANS,
     RPC_BATCHED_FAMILIES,
     SELF_DEVICES,
     SELF_INFO,

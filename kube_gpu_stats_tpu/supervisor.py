@@ -96,13 +96,22 @@ class Supervisor:
     DEGRADED_HOLD = 60.0
 
     def __init__(self, *, check_interval: float = 1.0,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer=None) -> None:
         self._check_interval = check_interval
         self._clock = clock
         self._components: dict[str, _Component] = {}
         self._breakers: dict[str, CircuitBreaker] = {}
         self._breaker_providers: list[
             Callable[[], Mapping[str, CircuitBreaker]]] = []
+        # Flight recorder (tracing.Tracer): the watchdog pass journals
+        # component health flips (healthy/degraded/stale) and attaches
+        # the breaker-transition listener to every breaker it can see —
+        # late-bound providers included, so a lazily-created client's
+        # breaker starts journaling within one check interval of
+        # existing. None = no journaling.
+        self._tracer = tracer
+        self._last_health: dict[str, str] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -244,7 +253,31 @@ class Supervisor:
             component.last_beat = now  # grace: the fresh thread starts clean
             component.next_restart_at = now + component.backoff.next_delay()
             restarted.append(component.name)
+        self._observe_transitions()
         return restarted
+
+    def _observe_transitions(self) -> None:
+        """Journal feed (one pass per watchdog check): attach the
+        breaker-transition listener to newly-seen breakers, and emit a
+        `component` event whenever a component's health STATE changed
+        since the last pass — the supervisor degraded/stale flips that
+        previously lived only in log lines."""
+        tracer = self._tracer
+        if tracer is None or not tracer.enabled:
+            return
+        breakers = self.breakers()
+        for breaker in breakers.values():
+            if getattr(breaker, "on_transition", None) is None:
+                breaker.on_transition = tracer.breaker_listener
+        for row in self.health(breakers):
+            previous = self._last_health.get(row.name)
+            if previous is not None and previous != row.state:
+                detail = f"{row.name}: {previous} -> {row.state}"
+                if row.reason:
+                    detail += f" ({row.reason})"
+                tracer.event("component", detail, component=row.name,
+                             state=row.state)
+            self._last_health[row.name] = row.state
 
     def _run(self) -> None:
         while not self._stop.is_set():

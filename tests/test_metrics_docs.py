@@ -1,0 +1,45 @@
+"""Self-metric/docs consistency gate (ISSUE 4 satellite): every family
+the schema emits must appear in docs/METRICS.md and vice versa — the
+pytest face of `make lint`'s tools/check_metrics_docs.py."""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+TOOL = ROOT / "tools" / "check_metrics_docs.py"
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_metrics_docs", TOOL)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_metrics_docs_in_sync():
+    tool = _load_tool()
+    assert tool.check() == [], (
+        "docs/METRICS.md out of sync with the schema; regenerate with "
+        "`python -m kube_gpu_stats_tpu.schema`")
+
+
+def test_tool_exits_nonzero_on_drift(tmp_path, monkeypatch):
+    """The lint must actually catch drift, both directions."""
+    tool = _load_tool()
+    doc = tmp_path / "METRICS.md"
+    text = TOOL.parent.parent.joinpath("docs", "METRICS.md").read_text()
+    doc.write_text(
+        text.replace("| `kts_trace_dropped_spans_total` |", "| `gone` |", 1))
+    monkeypatch.setattr(tool, "DOC", doc)
+    problems = tool.check()
+    assert any("kts_trace_dropped_spans_total" in p and "missing" in p
+               for p in problems), problems
+    assert any("gone" in p and "not emitted" in p for p in problems), problems
+
+
+def test_cli_entrypoint_green():
+    proc = subprocess.run([sys.executable, str(TOOL)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

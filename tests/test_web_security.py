@@ -139,6 +139,43 @@ def test_probes_exempt_from_auth(auth_server):
     assert err.value.code == 401
 
 
+def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
+    """ISSUE 4 satellite: the whole /debug surface — flight recorder
+    included — must refuse unauthenticated requests, and the 401 body
+    must never carry trace payloads (span names, journal details)."""
+    from kube_gpu_stats_tpu.tracing import Tracer
+
+    tracer = Tracer()
+    tracer.begin("tick", 1)
+    with tracer.span("SECRET_PHASE", device="SECRET_DEVICE"):
+        pass
+    tracer.end()
+    tracer.event("breaker", "SECRET_EVENT_DETAIL")
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
+        trace_provider=tracer,
+    )
+    srv.start()
+    try:
+        for path in ("/debug/threads", "/debug/profile?seconds=0.1",
+                     "/debug/ticks", "/debug/trace?last=5",
+                     "/debug/events?since=0"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                fetch(srv.port, path)
+            assert err.value.code == 401, path
+            body = err.value.read()
+            assert body == b"unauthorized\n", (path, body)
+        # With credentials the recorder serves its data — the 401s above
+        # weren't vacuous.
+        ok = fetch(srv.port, "/debug/ticks",
+                   headers=auth_header("prom", "s3cret")).read()
+        assert b"SECRET_PHASE" in ok
+    finally:
+        srv.stop()
+
+
 # -- TLS ---------------------------------------------------------------------
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Dump a Chrome trace of N simulated poll ticks (`make trace-tick`).
+
+Runs the same simulated 8-chip harness as the bench (fake libtpu gRPC
+server + sysfs fixture tree, production PollLoop) with the flight
+recorder's ring sized to hold every tick, then writes the Chrome
+trace-event JSON to --out. Open it in `chrome://tracing` or
+https://ui.perfetto.dev ("Open trace file") to eyeball where tick time
+goes — the visual companion to `make profile-tick`'s cProfile table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="dump a Chrome trace of simulated poll ticks")
+    parser.add_argument("--ticks", type=int, default=200)
+    parser.add_argument("--chips", type=int, default=8)
+    parser.add_argument("--delay", type=float, default=0.0,
+                        help="scripted per-RPC delay seconds (0 = "
+                             "exporter CPU dominates, like profile-tick)")
+    parser.add_argument("--out", default="/tmp/kts-trace.json")
+    parser.add_argument("--blocking", action="store_true",
+                        help="pipeline_fetch=False: every tick joins its "
+                             "own fetch, so the RPC flight shows inside "
+                             "fetch_wait")
+    args = parser.parse_args()
+
+    from kube_gpu_stats_tpu.collectors.composite import TpuCollector
+    from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
+    from kube_gpu_stats_tpu.poll import PollLoop
+    from kube_gpu_stats_tpu.registry import Registry
+    from kube_gpu_stats_tpu.testing import FakeLibtpuServer, make_sysfs
+    from kube_gpu_stats_tpu.tracing import Tracer
+
+    server = FakeLibtpuServer(num_chips=args.chips)
+    server.delay = args.delay
+    server.start()
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            sysroot = pathlib.Path(tmp) / "sys"
+            make_sysfs(sysroot, num_chips=args.chips)
+            collector = TpuCollector(
+                sysfs_root=str(sysroot),
+                libtpu_client=LibtpuClient(ports=(server.port,),
+                                           rpc_timeout=5.0),
+            )
+            tracer = Tracer(capacity=args.ticks + 8)
+            loop = PollLoop(collector, Registry(), deadline=10.0,
+                            pipeline_fetch=not args.blocking,
+                            tracer=tracer)
+            collector.set_tracer(tracer)
+            try:
+                for _ in range(args.ticks):
+                    loop.tick()
+            finally:
+                loop.stop()
+                collector.close()
+    finally:
+        server.stop()
+
+    out = pathlib.Path(args.out)
+    out.write_text(json.dumps(tracer.chrome_trace(), sort_keys=True))
+    summary = tracer.ticks_summary()
+    print(f"wrote {out} ({summary['ticks_recorded']} ticks, "
+          f"{sum(p['count'] for p in summary['phases'].values())} spans; "
+          f"dropped {summary['dropped_spans_total']})")
+    print("open in chrome://tracing or https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
