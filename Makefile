@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint
+.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -6,6 +6,7 @@
 # wheel + console-script smoke in a scratch venv (no index needed).
 ci: native lint
 	python -m pytest tests/ -q -m 'not chaos'
+	python tools/fleet_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -36,6 +37,13 @@ chaos: native
 
 bench: native
 	python bench.py
+
+# Fleet-lens smoke (<30 s): N real daemons (fake libtpu + FakeKubelet
+# attribution) + one hub; injects a straggler via a scripted RPC delay
+# and asserts `doctor --fleet` names the guilty node with its phase and
+# blamed port. Runs inside `make ci` too.
+fleet-sim:
+	python tools/fleet_sim.py --verbose
 
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf

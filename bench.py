@@ -38,6 +38,12 @@ def _merge_hub_fields(line: dict, measure_hub_merge) -> None:
         line["hub_body_cache_hit_rate"] = hub["body_cache_hit_rate"]
         line["hub_parse_mb_per_s"] = hub["parse_mb_per_s"]
         line["hub_render_cache_hits"] = hub["render_cache_hits"]
+        # Fleet-lens scoring cost per refresh at the 64w shape (ISSUE
+        # 5): budget-pinned in tests/test_fleetlens.py — anomaly
+        # baselines + SLO windows must stay a rounding error next to
+        # the merge itself.
+        line["fleet_score_ms_per_refresh"] = hub.get(
+            "fleet_score_ms_per_refresh")
     hub256 = measure_hub_merge(workers=256, refreshes=5)
     if hub256 is not None:
         line["hub_merge_256w_p50_ms"] = hub256["p50_ms"]
@@ -87,6 +93,8 @@ def _quick() -> int:
         line["hub_merge_64w_p50_ms"] = hub["p50_ms"]
         line["hub_merge_64w_cold_ms"] = hub["cold_ms"]
         line["hub_body_cache_hit_rate"] = hub["body_cache_hit_rate"]
+        line["fleet_score_ms_per_refresh"] = hub.get(
+            "fleet_score_ms_per_refresh")
     print(json.dumps(line))
     sys.stdout.flush()
     os._exit(0)

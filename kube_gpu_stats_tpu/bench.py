@@ -710,6 +710,14 @@ def measure_hub_merge(workers: int = 64, chips: int = 4,
                 for _ in range(4):
                     _, hit = hub.registry.rendered()
                     render_hits += int(hit)
+                # Fleet-lens scoring cost per refresh (ISSUE 5): the
+                # exact mean of the fleet_score phase from the hub's
+                # own flight recorder — tracing is on, so this prices
+                # the production configuration.
+                fleet_phase = hub.tracer.ticks_summary()["phases"].get(
+                    "fleet_score")
+                fleet_score_ms = (fleet_phase["mean_ms"]
+                                  if fleet_phase else None)
             finally:
                 hub.stop()
         parse_start = time.monotonic()
@@ -726,6 +734,7 @@ def measure_hub_merge(workers: int = 64, chips: int = 4,
                 total_bytes / parse_seconds / 1e6, 1) if parse_seconds
             else None,
             "render_cache_hits": render_hits,
+            "fleet_score_ms_per_refresh": fleet_score_ms,
         }
     except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
         return None

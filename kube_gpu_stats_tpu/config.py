@@ -15,6 +15,8 @@ import os
 import re
 from typing import Sequence
 
+from . import fleetlens
+
 DEFAULT_KUBELET_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
 DEFAULT_CHECKPOINT = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
 DEFAULT_LIBTPU_PORT = 8431  # TPU_RUNTIME_METRICS_PORTS default (SURVEY.md §2 C11)
@@ -75,6 +77,16 @@ class Config:
     max_concurrent_scrapes: int = 16  # parallel /metrics renders; 0 = off
     auth_username: str = ""  # + password hash = basic auth on /metrics
     auth_password_sha256: str = ""
+    # Fleet-lens / SLO knobs (ISSUE 5). Scored by the HUB's fleet lens
+    # (hub.py shares these flags via add_fleet_lens_flags); carried on
+    # the daemon config surface so doctor and tools accept the same
+    # spellings + KTS_SLO_* env vars everywhere. Defaults come from
+    # fleetlens (the single source both CLIs use) so a programmatic
+    # Config() can never drift from the flag surface.
+    fleet_lens: bool = True
+    slo_freshness_target: float = fleetlens.DEFAULT_FRESHNESS_TARGET
+    slo_straggler_target: float = fleetlens.DEFAULT_STRAGGLER_TARGET
+    slo_straggler_ratio: float = fleetlens.DEFAULT_STRAGGLER_RATIO
 
     @property
     def textfile_enabled(self) -> bool:
@@ -135,6 +147,56 @@ def parse_extra_labels(raw: str) -> tuple:
     if len(names) != len(set(names)):
         raise ValueError("duplicate extra label names")
     return tuple(pairs)
+
+
+def add_fleet_lens_flags(p: argparse.ArgumentParser) -> None:
+    """The fleet-lens / SLO flag surface, shared by the daemon parser
+    (doctor/tools accept them) and `kube-tpu-stats hub` (which actually
+    scores them): one definition so the spellings, KTS_* env vars and
+    defaults can never drift between the two CLIs."""
+    from .fleetlens import (DEFAULT_FRESHNESS_TARGET,
+                            DEFAULT_STRAGGLER_RATIO,
+                            DEFAULT_STRAGGLER_TARGET)
+
+    p.add_argument("--no-fleet-lens", action="store_true",
+                   default=_env_bool("NO_FLEET_LENS"),
+                   help="disable the hub's fleet lens (per-target "
+                        "anomaly baselines, slow-node attribution, SLO "
+                        "burn windows; /debug/fleet and the kts_fleet_* "
+                        "gauges go with it)")
+    p.add_argument("--slo-freshness-target", type=float,
+                   default=float(_env("SLO_FRESHNESS_TARGET",
+                                      str(DEFAULT_FRESHNESS_TARGET))),
+                   help="freshness SLO objective: fraction of observed "
+                        "chip-refreshes that must serve fresh data (a "
+                        "stale chip or an unreachable target's last-known "
+                        "chips count against the error budget)")
+    p.add_argument("--slo-straggler-target", type=float,
+                   default=float(_env("SLO_STRAGGLER_TARGET",
+                                      str(DEFAULT_STRAGGLER_TARGET))),
+                   help="straggler SLO objective: fraction of "
+                        "rate-bearing refreshes whose slice straggler "
+                        "ratio must meet --slo-straggler-ratio")
+    p.add_argument("--slo-straggler-ratio", type=float,
+                   default=float(_env("SLO_STRAGGLER_RATIO",
+                                      str(DEFAULT_STRAGGLER_RATIO))),
+                   help="minimum healthy slice_straggler_ratio (min/max "
+                        "per-worker step rate); refreshes below it burn "
+                        "the straggler error budget")
+
+
+def validate_fleet_lens_args(args) -> str | None:
+    """Range-check the shared SLO flags; returns an error string or
+    None (both CLIs surface it through their own parser.error)."""
+    for name in ("slo_freshness_target", "slo_straggler_target"):
+        value = getattr(args, name)
+        if not 0.0 < value < 1.0:
+            return (f"--{name.replace('_', '-')} must be in (0, 1) "
+                    f"(got {value!r})")
+    if not 0.0 < args.slo_straggler_ratio <= 1.0:
+        return (f"--slo-straggler-ratio must be in (0, 1] "
+                f"(got {args.slo_straggler_ratio!r})")
+    return None
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -294,6 +356,7 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("AUTH_PASSWORD_SHA256", ""),
                    help="hex sha256 of the basic-auth password (never the "
                         "plaintext)")
+    add_fleet_lens_flags(p)
     p.add_argument("--config", default=_env("CONFIG", ""),
                    help="YAML config file (keys = long flag names); "
                         "precedence: flags > KTS_* env > file > defaults")
@@ -421,6 +484,9 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         parser.error(
             f"--remote-write-protocol must be 1.0 or 2.0 "
             f"(got {args.remote_write_protocol!r})")
+    fleet_error = validate_fleet_lens_args(args)
+    if fleet_error:
+        parser.error(fleet_error)
     if bool(args.tls_cert_file) != bool(args.tls_key_file):
         parser.error("--tls-cert-file and --tls-key-file must be set together")
     if args.tls_client_ca_file and not args.tls_cert_file:
@@ -479,4 +545,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         max_concurrent_scrapes=args.max_concurrent_scrapes,
         auth_username=args.auth_username,
         auth_password_sha256=args.auth_password_sha256,
+        fleet_lens=not args.no_fleet_lens,
+        slo_freshness_target=args.slo_freshness_target,
+        slo_straggler_target=args.slo_straggler_target,
+        slo_straggler_ratio=args.slo_straggler_ratio,
     )

@@ -196,7 +196,11 @@ class Daemon:
         # feed), the collector's transport (per-port RPC spans) and the
         # HTTP server (/debug/ticks, /debug/trace, /debug/events).
         # --no-trace keeps the object (endpoints answer "disabled")
-        # but every recording call becomes a cheap no-op.
+        # but every recording call becomes a cheap no-op. The poll loop
+        # also self-exports this recorder's digest every snapshot
+        # (kts_tick_phase_seconds / kts_slowest_tick_seconds,
+        # fleetlens.contribute_trace_digest) — the per-node half of the
+        # hub fleet lens's cross-node slow-node attribution (ISSUE 5).
         self.tracer = Tracer(enabled=cfg.trace_enabled)
         self.collector = build_collector(cfg)
         self._wire_tracer(self.collector)

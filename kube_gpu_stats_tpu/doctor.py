@@ -20,6 +20,12 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  responsible device/port, and co-occurring journal
                  events. Uses the --url target's server (default
                  http://127.0.0.1:9400/metrics).
+  --fleet        pull the RUNNING hub's fleet lens (/debug/fleet) and
+                 print a slice post-mortem: the worst node with its
+                 phase and blame, every anomalous target with its
+                 anomaly kinds, and the SLO burn windows. Uses the
+                 --url target's server when it is http(s), else a
+                 local hub on port 9401.
 
 Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
 2 = usage error. Every probe is time-bounded; doctor never hangs on a
@@ -627,6 +633,106 @@ def check_trace(base: str) -> CheckResult:
     return _result("trace", OK, detail, data=data)
 
 
+def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
+    """(status, detail line, data) for a /debug/fleet rollup: the
+    slice post-mortem — worst node with its phase and blame, every
+    anomalous target with its anomaly kinds (and that target's own
+    worst phase from its digest), and the SLO burn windows. WARN when
+    any anomaly is active or any burn window is over budget (burn >
+    1.0). Pure so tests drive it on canned JSON; check_fleet wraps it
+    with the fetch/auth/version classification."""
+    parts: list[str] = []
+    data: dict = {"attribution": payload.get("attribution"),
+                  "anomalous": {}, "slo": payload.get("slo", {})}
+    status = OK
+    worst = payload.get("attribution")
+    if worst:
+        line = (f"worst node: {worst.get('target')} "
+                f"(phase {worst.get('phase')}, "
+                f"{worst.get('seconds', 0.0):.3f}s")
+        if worst.get("blame"):
+            line += f", blame {worst['blame']}"
+        parts.append(line + ")")
+    for target, entry in sorted((payload.get("targets") or {}).items()):
+        anomalous = entry.get("anomalous") or {}
+        if not anomalous:
+            continue
+        status = WARN
+        data["anomalous"][target] = dict(anomalous)
+        # Freshness reports the CURRENT missed count (entry['missed']),
+        # not the count frozen at the raise edge — a 100-refresh outage
+        # must not read as '3 refreshes missed' forever.
+        kinds = ", ".join(
+            f"{kind} (z={z:g})" if kind != "freshness"
+            else (f"freshness ({int(entry.get('missed', z))} "
+                  f"refreshes missed)")
+            for kind, z in sorted(anomalous.items()))
+        line = f"{target}: {kinds}"
+        digest = entry.get("digest") or {}
+        slow = digest.get("slowest") or {}
+        if slow.get("phase"):
+            line += f" [worst phase {slow['phase']}"
+            if slow.get("blame"):
+                line += f", {slow['blame']}"
+            line += "]"
+        parts.append(line)
+    burns = []
+    for objective, state in sorted((payload.get("slo") or {}).items()):
+        windows = state.get("windows") or {}
+        rendered = []
+        for label in sorted(windows):
+            burn = windows[label].get("burn_rate", 0.0)
+            flag = "!" if burn > 1.0 else ""
+            if burn > 1.0:
+                status = WARN
+            rendered.append(f"{label}={burn:g}x{flag}")
+        if rendered:
+            burns.append(f"{objective} " + "/".join(rendered))
+    if burns:
+        parts.append("burn: " + "; ".join(burns)
+                     + " (>1x = over the error budget)")
+    if not parts:
+        parts.append("no anomalies, burn within budget, no slow-node "
+                     "attribution yet")
+    return status, "; ".join(parts), data
+
+
+def check_fleet(base: str) -> CheckResult:
+    """--fleet: read the RUNNING hub's fleet lens and print the slice
+    post-mortem (which node is dragging the job, which phase, which
+    anomalies co-occur, how fast the SLO budget is burning)."""
+    import urllib.error
+
+    try:
+        payload = _fetch_json(base + "/debug/fleet")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "fleet", WARN,
+                f"{base}/debug/fleet requires authentication "
+                f"(HTTP {exc.code}); the fleet lens sits behind the "
+                f"hub's basic-auth gate by design")
+        if exc.code == 404:
+            from .hub import DEFAULT_PORT
+
+            return _result(
+                "fleet", WARN,
+                f"{base}: no /debug/fleet (hub predates the fleet lens, "
+                f"runs --no-fleet-lens, or this is a daemon — point "
+                f"--url at the hub, default port {DEFAULT_PORT})")
+        return _result("fleet", FAIL, f"{base}/debug/fleet: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable hub, bad JSON
+        return _result("fleet", FAIL,
+                       f"{base}: fleet lens unreadable ({exc})")
+    if not payload.get("targets"):
+        return _result(
+            "fleet", WARN,
+            f"no targets scored yet (refresh seq "
+            f"{payload.get('seq', 0)}); is the hub refreshing?")
+    status, detail, data = fleet_post_mortem(payload)
+    return _result("fleet", status, detail, data=data)
+
+
 def check_url(target: str) -> list[CheckResult]:
     """Both --url rows — scrape contract + live breaker state — off ONE
     fetch: a node being diagnosed precisely because it is degraded must
@@ -806,7 +912,8 @@ def check_embedded_viability(cfg: Config) -> CheckResult:
 
 
 def run_checks(cfg: Config, url: str = "",
-               trace: bool = False) -> list[CheckResult]:
+               trace: bool = False,
+               fleet: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -837,6 +944,17 @@ def run_checks(cfg: Config, url: str = "",
         base = (trace_base(url) if url.startswith(("http://", "https://"))
                 else f"http://127.0.0.1:{cfg.listen_port}")
         probes.append(("trace", lambda: check_trace(base)))
+    if fleet:
+        # The fleet lens lives on the HUB, not the daemon: an http(s)
+        # --url names the hub to read; otherwise fall back to a local
+        # hub on its default port (9401 — hub.DEFAULT_PORT), NOT the
+        # daemon's listen port.
+        from .hub import DEFAULT_PORT as HUB_DEFAULT_PORT
+
+        fleet_base = (trace_base(url)
+                      if url.startswith(("http://", "https://"))
+                      else f"http://127.0.0.1:{HUB_DEFAULT_PORT}")
+        probes.append(("fleet", lambda: check_fleet(fleet_base)))
     results: list[CheckResult] = []
     for name, probe in probes:
         results.extend(_bounded(name, probe))
@@ -889,6 +1007,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     raw = list(sys.argv[1:] if argv is None else argv)
     as_json = False
     trace = False
+    fleet = False
     url = ""
     args: list[str] = []
     it = iter(raw)
@@ -897,6 +1016,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             as_json = True
         elif token == "--trace":
             trace = True
+        elif token == "--fleet":
+            fleet = True
         elif token == "--url":
             url = next(it, "")
             if not url or url.startswith("--"):
@@ -913,7 +1034,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             args.append(token)
     cfg = from_args(args)
     started = time.monotonic()
-    results = run_checks(cfg, url=url, trace=trace)
+    results = run_checks(cfg, url=url, trace=trace, fleet=fleet)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

@@ -154,10 +154,16 @@ class MetricsServer:
                  max_concurrent_scrapes: int = 16,
                  render_stats: RenderStats | None = None,
                  ready_check=None, health_provider=None,
-                 trace_provider=None):
+                 trace_provider=None, fleet_provider=None):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
+        # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
+        # rollup() -> dict): serves /debug/fleet — per-target health,
+        # the anomaly list, SLO burn state, slow-node attribution.
+        # None = 404 (the hub wires it; daemons and --no-fleet-lens
+        # hubs don't serve a fleet view).
+        self._fleet = fleet_provider
         # Flight recorder (tracing.Tracer, duck-typed): serves the
         # /debug/ticks (phase summaries + slowest-tick table),
         # /debug/trace (Chrome trace-event JSON), and /debug/events
@@ -416,6 +422,16 @@ class MetricsServer:
                             + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/fleet" and outer._fleet is not None:
+                    # Fleet lens rollup (fleetlens.py): per-target
+                    # baselines/anomalies, SLO burn windows, slow-node
+                    # attribution — the payload doctor --fleet reads.
+                    import json
+
+                    body = (json.dumps(outer._fleet.rollup(),
+                                       sort_keys=True) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/threads":
                     # pprof analog (SURVEY.md §5): live stack dump of every
                     # thread — enough to diagnose a wedged sampler or a
@@ -442,6 +458,8 @@ class MetricsServer:
                     if outer._trace is not None:
                         links += ["/debug/ticks", "/debug/trace?last=20",
                                   "/debug/events"]
+                    if outer._fleet is not None:
+                        links += ["/debug/fleet"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

@@ -41,7 +41,7 @@ import threading
 import time
 from typing import Mapping, Sequence
 
-from . import procstats, schema
+from . import fleetlens, procstats, schema
 from .registry import (HistogramState, Registry, Series, SnapshotBuilder,
                        contribute_push_stats)
 from .resilience import CircuitBreaker
@@ -145,7 +145,7 @@ class _TargetCache:
 
     __slots__ = ("body", "body_hash", "series", "series_dicts",
                  "chip_plan", "hist_local", "frame_rows", "frame_rollups",
-                 "stat_sig")
+                 "fleet_digest", "stat_sig")
 
     def __init__(self, body: str, series: list,
                  stat_sig: tuple | None = None) -> None:
@@ -160,6 +160,10 @@ class _TargetCache:
         self.hist_local: dict | None = None
         self.frame_rows: dict[tuple, ChipRow] | None = None
         self.frame_rollups: dict[tuple, float] | None = None
+        # Flight-recorder digest harvested from the body (fleetlens):
+        # cached like the other derived artifacts, so an unchanged body
+        # replays it with zero re-extraction.
+        self.fleet_digest: dict | None = None
         self.stat_sig = stat_sig
 
 
@@ -181,7 +185,14 @@ class Hub:
                  target_ca_file: str = "",
                  target_insecure_tls: bool = False,
                  targets_provider=None,
-                 tracer: Tracer | None = None) -> None:
+                 tracer: Tracer | None = None,
+                 fleet_lens: bool = True,
+                 slo_freshness_target: float =
+                 fleetlens.DEFAULT_FRESHNESS_TARGET,
+                 slo_straggler_target: float =
+                 fleetlens.DEFAULT_STRAGGLER_TARGET,
+                 slo_straggler_ratio: float =
+                 fleetlens.DEFAULT_STRAGGLER_RATIO) -> None:
         if not targets and targets_provider is None:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -263,6 +274,18 @@ class Hub:
         # hands the same tracer to its MetricsServer as the /debug
         # provider.
         self.tracer = tracer if tracer is not None else Tracer()
+        # Fleet lens (ISSUE 5): per-target rolling baselines + anomaly
+        # flagging into the event journal, cross-node slow-node
+        # attribution from the daemons' flight-recorder digests, and
+        # multi-window SLO burn rates — scored once per refresh, served
+        # as kts_fleet_* gauges, /debug/fleet, and doctor --fleet.
+        # None when disabled (--no-fleet-lens): /debug/fleet 404s.
+        self.fleet = fleetlens.FleetLens(
+            tracer=self.tracer,
+            freshness_target=slo_freshness_target,
+            straggler_target=slo_straggler_target,
+            straggler_ratio=slo_straggler_ratio,
+        ) if fleet_lens else None
         self._cycle_seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -538,6 +561,7 @@ class Hub:
                     f"({budget:g}s)")
                 if not future.cancel():
                     self._outstanding[hung] = future
+                self._blame_failed_fetch(hung, what, budget)
             for member in members:
                 if member not in seen:
                     reachable[member] = False
@@ -611,6 +635,7 @@ class Hub:
                 errors.append(
                     f"{target}: fetch exceeded the refresh deadline "
                     f"({budget:g}s)")
+                self._blame_failed_fetch(target, "deadline", budget)
             except Exception as exc:  # noqa: BLE001 - per-target degradation
                 reachable[target] = False
                 self._breaker(target).record_failure(exc)
@@ -698,6 +723,22 @@ class Hub:
                                 emit_series=not self._rollups_only)
         if not self._rollups_only:
             self._merge_histograms(builder, entries)
+        # Fleet lens scoring (before the parse views drop below: the
+        # digest harvest is the last consumer of series_dicts, cached on
+        # the entry like every other derived artifact).
+        if self.fleet is not None:
+            fleet_mark = tracer.mark()
+            digests: dict[str, dict] = {}
+            for target, entry in entries:
+                digest = entry.fleet_digest
+                if digest is None:
+                    digest = entry.fleet_digest = \
+                        fleetlens.digest_from_series(entry.series_dicts)
+                digests[target] = digest
+            self.fleet.observe(self._cycle_seq, time.time(),
+                               self._targets, reachable, fetch_seconds,
+                               frame, digests)
+            tracer.add_span("fleet_score", fleet_mark)
         # The parse views are consumed exactly once: every derived
         # artifact this hub's mode replays (frame fold, chip plan,
         # histogram fold) is now cached on the entry, so drop them — at
@@ -731,6 +772,21 @@ class Hub:
                             "30s)", err)
         return frame
 
+    def _blame_failed_fetch(self, target: str, what: str,
+                            budget: float) -> None:
+        """Record a target_fetch aux span for a fetch that blew the
+        refresh deadline (ISSUE 5 satellite): successful fetches already
+        record target-attributed spans, so without this the one fetch
+        that actually MADE the cycle slow was the only one missing from
+        the slowest-cycle table's blame — the hub-side parity of the
+        daemon's device/port blame. The span is stamped with the whole
+        budget: that is what the wedged fetch cost this cycle."""
+        if not self.tracer.enabled:
+            return
+        dur_ns = int(budget * 1e9)
+        self.tracer.aux_span("target_fetch", self.tracer.clock_ns() - dur_ns,
+                             dur_ns=dur_ns, target=target, error=what)
+
     def _publish(self, builder: SnapshotBuilder, start: float,
                  proc_readings: dict | None = None) -> None:
         """Shared publish tail for every refresh outcome (normal and
@@ -750,6 +806,14 @@ class Hub:
         # Flight-recorder health: nonzero means /debug/trace truncates.
         builder.add(schema.TRACE_DROPPED_SPANS,
                     float(self.tracer.dropped_spans_total))
+        # The hub's own cycle digest (same families the daemons export),
+        # so a hub-of-hubs attributes slow hubs exactly like slow nodes.
+        fleetlens.contribute_trace_digest(builder, self.tracer)
+        # Fleet-lens gauges: anomaly counts, SLO burn windows, worst
+        # node. Contributed on EVERY publish branch (zero-targets too):
+        # the burn state must not vanish mid-incident.
+        if self.fleet is not None:
+            self.fleet.contribute(builder)
         # Per-target breaker state: the hub's resilience self-metrics,
         # same families the daemon exports for its edges.
         for target in sorted(self._breakers):
@@ -816,6 +880,9 @@ class Hub:
         # DNS discovery must not grow this map forever).
         for target in [t for t in self._breakers if t not in alive]:
             del self._breakers[target]
+        # Fleet baselines and anomaly counters evict on the same path.
+        if self.fleet is not None:
+            self.fleet.evict(alive)
         # The stuck-fetch map prunes only FINISHED futures: a target
         # that flaps out of DNS and back must still be guarded against
         # its wedged fetch, or each flap would pin another pool worker.
@@ -919,16 +986,14 @@ class Hub:
                             sum(r.ici_bps for r in rows), labels)
             # Per-worker step rate = mean over the worker's chips (SPMD:
             # every chip participates in each step, so chips of one
-            # worker report the same counter — mean, not sum).
-            worker_rates: dict[str, list[float]] = {}
-            for row in rows:
-                if row.steps_per_s is not None:
-                    worker_rates.setdefault(
-                        self._worker_id(row), []).append(row.steps_per_s)
+            # worker report the same counter — mean, not sum). One
+            # definition shared with the fleet lens's straggler SLO
+            # (fleetlens.worker_step_rates), so the SLO can never
+            # desynchronize from the exported rollup.
+            worker_rates = fleetlens.worker_step_rates(rows)
             rates = []
             for worker in sorted(worker_rates):
-                rate = (sum(worker_rates[worker])
-                        / len(worker_rates[worker]))
+                rate = worker_rates[worker]
                 rates.append(rate)
                 builder.add(schema.HUB_WORKER_STEPS, rate,
                             labels + (("worker", worker),))
@@ -1315,7 +1380,16 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--remote-write-bearer-token-file", default="")
     parser.add_argument("--log-level", default="info",
                         choices=("debug", "info", "warning", "error"))
+    # Fleet-lens / SLO knobs: the SAME flag definitions the daemon
+    # parser carries (config.add_fleet_lens_flags), so spellings, env
+    # vars and defaults cannot drift between the two CLIs.
+    from .config import add_fleet_lens_flags, validate_fleet_lens_args
+
+    add_fleet_lens_flags(parser)
     args = parser.parse_args(argv)
+    fleet_error = validate_fleet_lens_args(args)
+    if fleet_error:
+        parser.error(fleet_error)
 
     # A long-running service needs visible logs (refresh failures, dropped
     # duplicates, credential problems); mirrors the daemon's text format.
@@ -1399,7 +1473,11 @@ def main(argv: Sequence[str] | None = None) -> int:
               headers_provider=headers_provider,
               target_ca_file=args.target_ca_file,
               target_insecure_tls=args.target_insecure_tls,
-              targets_provider=targets_provider)
+              targets_provider=targets_provider,
+              fleet_lens=not args.no_fleet_lens,
+              slo_freshness_target=args.slo_freshness_target,
+              slo_straggler_target=args.slo_straggler_target,
+              slo_straggler_ratio=args.slo_straggler_ratio)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -1451,7 +1529,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         auth_password_sha256=args.auth_password_sha256,
         render_stats=render_stats,
         ready_check=hub.ready,
-        trace_provider=hub.tracer)
+        trace_provider=hub.tracer,
+        fleet_provider=hub.fleet)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

@@ -48,6 +48,7 @@ from typing import Callable, Mapping, NamedTuple, Protocol, Sequence
 
 from . import procstats, schema
 from .collectors import Collector, CollectorError, Device, Sample
+from .fleetlens import contribute_trace_digest
 from .ici import RateTracker
 from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
                        Series, SnapshotBuilder, _series_prefix,
@@ -1391,6 +1392,13 @@ class PollLoop:
         # truncating (span cap hit) and the recorded traces are partial.
         builder.add(schema.TRACE_DROPPED_SPANS,
                     float(self.tracer.dropped_spans_total))
+        # Flight-recorder digest (ISSUE 5): kts_tick_phase_seconds +
+        # kts_slowest_tick_seconds ride every snapshot so the hub's
+        # fleet lens can attribute cross-node slowness from the
+        # expositions it already scrapes. Absent under --no-trace and
+        # until a first trace has recorded (this tick's own trace ends
+        # after the build, so tick N exports ticks 1..N-1's fold).
+        contribute_trace_digest(builder, self.tracer)
         rpc_stats = getattr(self._collector, "rpc_stats", None)
         if rpc_stats is not None:
             builder.add(

@@ -476,6 +476,61 @@ HUB_PARSE_SECONDS = MetricSpec(
     "parse is rollup+merge cost.",
 )
 
+# Fleet-lens families (fleetlens.py, driven from the hub refresh):
+# cross-node anomaly detection, slow-node attribution, SLO burn windows.
+
+FLEET_TARGETS_ANOMALOUS = MetricSpec(
+    "kts_fleet_targets_anomalous",
+    MetricType.GAUGE,
+    "Targets the hub's fleet lens currently flags anomalous (z-score "
+    "baseline breach or freshness miss). 0 is the healthy steady state; "
+    "the per-target detail (which signal, how far off baseline) is at "
+    "/debug/fleet and in `doctor --fleet`.",
+)
+FLEET_ANOMALIES = MetricSpec(
+    "kts_fleet_anomalies_total",
+    MetricType.COUNTER,
+    "Anomalies the fleet lens has raised per target and kind since the "
+    "hub started (kind = the breached signal: duty/hbm/power/steps/"
+    "fetch/stale_fraction, or 'freshness' for a target missing several "
+    "refreshes running). Edge-counted — one per transition into "
+    "anomaly, not per anomalous refresh — so increase() counts "
+    "incidents, not their duration.",
+    extra_labels=("target", "kind"),
+)
+FLEET_SLO_BURN = MetricSpec(
+    "kts_fleet_slo_burn_rate",
+    MetricType.GAUGE,
+    "Multi-window SLO burn rate per objective: bad-event fraction over "
+    "the window divided by the objective's error budget (1 - target). "
+    "1.0 = burning exactly the budget; alert on both windows over "
+    "threshold (classic multiwindow burn alerting). Objectives: "
+    "'freshness' (observed chips serving fresh data — a stale chip or "
+    "an unreachable target's last-known chips count as bad) and "
+    "'straggler' (refreshes whose slice straggler ratio met "
+    "--slo-straggler-ratio).",
+    extra_labels=("objective", "window"),
+)
+FLEET_SLO_BAD = MetricSpec(
+    "kts_fleet_slo_bad_ratio",
+    MetricType.GAUGE,
+    "Raw bad-event fraction per SLO objective and window — the burn "
+    "rate's numerator before dividing by the error budget, for "
+    "dashboards that plot budget consumption directly.",
+    extra_labels=("objective", "window"),
+)
+FLEET_WORST_TICK = MetricSpec(
+    "kts_fleet_worst_tick_seconds",
+    MetricType.GAUGE,
+    "Slowest flight-recorder tick across the fleet, harvested from each "
+    "target's kts_slowest_tick_seconds digest: the value is that tick's "
+    "duration, the labels name the worst node and its worst phase — the "
+    "cross-node slow-node attribution a per-process view can't compute. "
+    "Label values follow the current worst node, so treat this as "
+    "forensic state (latest wins), not a long-lived series.",
+    extra_labels=("target", "phase"),
+)
+
 HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_TARGET_UP,
     HUB_TARGET_FETCH_SECONDS,
@@ -499,6 +554,11 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_REFRESH_DURATION,
     HUB_BODY_CACHE_HITS,
     HUB_PARSE_SECONDS,
+    FLEET_TARGETS_ANOMALOUS,
+    FLEET_ANOMALIES,
+    FLEET_SLO_BURN,
+    FLEET_SLO_BAD,
+    FLEET_WORST_TICK,
 )
 
 # Buckets for hub_refresh_duration_seconds: a refresh crosses the network
@@ -595,6 +655,30 @@ TICK_PLAN_CACHE_HITS = MetricSpec(
     "lists and series identity). Healthy steady state: rises by "
     "device-count every tick while kts_tick_plan_compiles_total stays "
     "flat.",
+)
+TICK_PHASE_SECONDS = MetricSpec(
+    "kts_tick_phase_seconds",
+    MetricType.GAUGE,
+    "Flight-recorder phase-duration digest: bucketed p50/p99 (values are "
+    "the recorder's fixed bucket upper bounds) plus the exact observed "
+    "max per recorded phase, cumulative over the process lifetime. The "
+    "compact self-export of /debug/ticks that lets the hub's fleet lens "
+    "do cross-node slow-node attribution without scraping every "
+    "worker's debug endpoint. Absent until a first tick has recorded; "
+    "absent entirely under --no-trace.",
+    extra_labels=("phase", "quantile"),
+)
+SLOWEST_TICK_SECONDS = MetricSpec(
+    "kts_slowest_tick_seconds",
+    MetricType.GAUGE,
+    "Duration of the slowest tick/cycle in the flight recorder's ring, "
+    "labeled with that tick's worst phase and its blame span "
+    "('port=8431' / 'device=3' / 'target=<url>', empty when no span "
+    "carried a responsible party). The one-series slow-tick summary the "
+    "hub folds into kts_fleet_worst_tick_seconds; label values follow "
+    "the ring (forensic state, latest wins). Absent until a tick has "
+    "recorded; absent under --no-trace.",
+    extra_labels=("phase", "blame"),
 )
 TRACE_DROPPED_SPANS = MetricSpec(
     "kts_trace_dropped_spans_total",
@@ -738,6 +822,8 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_POLL_ERRORS,
     TICK_PLAN_COMPILES,
     TICK_PLAN_CACHE_HITS,
+    TICK_PHASE_SECONDS,
+    SLOWEST_TICK_SECONDS,
     TRACE_DROPPED_SPANS,
     RPC_BATCHED_FAMILIES,
     SELF_DEVICES,

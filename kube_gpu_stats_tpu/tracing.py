@@ -430,6 +430,52 @@ class Tracer:
             "slowest": slowest,
         }
 
+    def phase_quantiles(self) -> dict[str, tuple[float, float, float]]:
+        """{phase: (p50_s, p99_s, max_s)} from the cumulative fold — the
+        compact digest poll.py/hub.py export as
+        ``kts_tick_phase_seconds{phase,quantile}`` so the hub's fleet
+        lens can attribute cross-node slowness without hitting every
+        worker's /debug/ticks. p50/p99 are bucket upper bounds (same
+        resolution as /debug/ticks); max is exact."""
+        with self._lock:
+            items = sorted(self._phases.items())
+            return {
+                name: (
+                    self._quantile_ms(state[0], state[1], 0.50,
+                                      state[3]) / 1e3,
+                    self._quantile_ms(state[0], state[1], 0.99,
+                                      state[3]) / 1e3,
+                    state[3] / 1e9,
+                )
+                for name, state in items
+            }
+
+    def slowest_tick(self) -> dict | None:
+        """Summary of the slowest trace in the ring: duration, its worst
+        phase, and the blame span rendered as one ``key=value`` string
+        (the ``kts_slowest_tick_seconds`` digest). None when nothing has
+        recorded yet."""
+        traces = list(self._ring)
+        if not traces:
+            return None
+        trace = max(traces, key=lambda t: t.dur_ns)
+        worst, blame = self._worst_span(trace)
+        blame_text = ""
+        if blame is not None and blame[3]:
+            for key in _BLAME_KEYS:
+                if key in blame[3]:
+                    blame_text = f"{key}={blame[3][key]}"
+                    break
+        return {
+            "kind": trace.kind,
+            "seq": trace.seq,
+            "at": trace.at,
+            "seconds": trace.dur_ns / 1e9,
+            "phase": worst[0] if worst is not None else "",
+            "phase_seconds": worst[2] / 1e9 if worst is not None else 0.0,
+            "blame": blame_text,
+        }
+
     def chrome_trace(self, last: int | None = None) -> dict:
         """Chrome trace-event JSON (`chrome://tracing` / Perfetto "load
         trace"): one complete ("X") event per trace and per span, ts/dur

@@ -1,0 +1,764 @@
+"""Fleet lens (ISSUE 5): per-target anomaly baselines, slow-node
+attribution from the daemons' flight-recorder digests, SLO burn
+windows, /debug/fleet, and doctor --fleet. The acceptance harness
+injects a slow port on one real node and frozen (failing) env reads on
+another and pins that BOTH are flagged with the right phase/kind."""
+
+import json
+import pathlib
+import tempfile
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu import doctor, fleetlens, schema
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.exposition import MetricsServer
+from kube_gpu_stats_tpu.fleetlens import (EwmaBaseline, FleetLens,
+                                          _SloTracker, digest_from_series)
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+from kube_gpu_stats_tpu.top import ChipRow
+from kube_gpu_stats_tpu.tracing import Tracer
+from kube_gpu_stats_tpu.validate import parse_exposition
+
+
+def values(text, family):
+    return [value for name, labels, value in parse_exposition(text)
+            if name == family]
+
+
+def labeled(text, family):
+    return {tuple(sorted(labels.items())): value
+            for name, labels, value in parse_exposition(text)
+            if name == family}
+
+
+# -- baselines ---------------------------------------------------------------
+
+def _step(baseline, value):
+    """score-then-fold, the exact sequence FleetLens._score drives."""
+    z = baseline.score(value)
+    baseline.fold(value)
+    return z
+
+
+def test_ewma_baseline_is_deterministic_and_scores_pre_fold():
+    readings = [50.0, 51.0, 49.5, 50.5, 50.0, 12.0]
+    a, b = EwmaBaseline(), EwmaBaseline()
+    zs_a = [_step(a, x) for x in readings]
+    zs_b = [_step(b, x) for x in readings]
+    assert zs_a == zs_b  # exact arithmetic, no clocks
+    assert (a.mean, a.var, a.count) == (b.mean, b.var, b.count)
+    assert zs_a[0] == 0.0  # first reading seeds, never scores
+    # The collapse to 12 is scored against the ~50 baseline BEFORE it
+    # folds in — large negative z.
+    assert zs_a[-1] < -4.0
+    assert a.count == len(readings)
+
+
+def test_ewma_flat_signal_does_not_zscore_jitter_to_infinity():
+    baseline = EwmaBaseline()
+    for _ in range(20):
+        _step(baseline, 300.0)
+    # 1% jitter on a dead-flat 300 W signal: under the 2%-of-mean
+    # variance floor, well below any sane threshold.
+    z = baseline.score(303.0)
+    assert abs(z) < 1.0
+
+
+# -- SLO burn windows --------------------------------------------------------
+
+def test_slo_tracker_multiwindow_burn_rates():
+    windows = ((300.0, "5m"), (3600.0, "1h"))
+    tracker = _SloTracker(0.99, windows)  # 1% error budget
+    # 50 min of clean refreshes at 10 s cadence, then a 2-minute
+    # incident with 25% of chips stale.
+    at = 0.0
+    for _ in range(300):
+        tracker.update(at, 0.0, 4.0)
+        at += 10.0
+    for _ in range(24):
+        tracker.update(at, 1.0, 4.0)
+        at += 10.0
+    state = tracker.window_state(at, windows)
+    # 5m window: 30 refreshes, 24 bad chips / 120 = 20% >> 1% budget.
+    assert state["5m"]["bad_ratio"] == pytest.approx(0.2)
+    assert state["5m"]["burn_rate"] == pytest.approx(20.0)
+    # 1h window dilutes but still burns over budget.
+    assert 0.0 < state["1h"]["bad_ratio"] < state["5m"]["bad_ratio"]
+    assert state["1h"]["burn_rate"] > 1.0
+    # Events past the horizon are pruned: advance 2h and the windows
+    # drain back to zero.
+    tracker.update(at + 7200.0, 0.0, 4.0)
+    state = tracker.window_state(at + 7200.0, windows)
+    assert state["1h"]["bad_ratio"] == 0.0
+    assert state["1h"]["events"] == 4  # one refresh x 4 chips survives
+
+
+# -- digest harvest ----------------------------------------------------------
+
+def test_digest_from_series_extracts_phases_and_slowest():
+    series = [
+        ("kts_tick_phase_seconds",
+         {"phase": "fetch_wait", "quantile": "p99"}, 0.01),
+        ("kts_tick_phase_seconds",
+         {"phase": "fetch_wait", "quantile": "max"}, 0.5),
+        ("kts_slowest_tick_seconds",
+         {"phase": "fetch_wait", "blame": "port=8431"}, 0.6),
+        ("accelerator_up", {"chip": "0"}, 1.0),
+    ]
+    digest = digest_from_series(series)
+    assert digest["phases"]["fetch_wait"] == {"p99": 0.01, "max": 0.5}
+    assert digest["slowest"] == {"seconds": 0.6, "phase": "fetch_wait",
+                                 "blame": "port=8431"}
+    assert digest_from_series([("accelerator_up", {}, 1.0)]) == {}
+
+
+# -- scripted scoring --------------------------------------------------------
+
+def _row(target, duty=50.0, up=1.0, steps=None, worker="0"):
+    return ChipRow(key=(target, "s", worker, "0"), up=up, duty=duty,
+                   mem_used=1e9, power=300.0, steps_per_s=steps)
+
+
+def _frame(rows):
+    return types.SimpleNamespace(
+        rows={(r.key + (i,)): r for i, r in enumerate(rows)})
+
+
+def _observe(lens, seq, now, targets, rows, reachable=None,
+             fetch=None, digests=None):
+    lens.observe(seq, now, targets,
+                 reachable if reachable is not None
+                 else {t: True for t in targets},
+                 fetch or {}, _frame(rows), digests or {})
+
+
+def test_anomaly_raises_once_journals_and_recovers():
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer, min_samples=3)
+    target = "http://w0/metrics"
+    now = 1000.0
+    for seq in range(1, 7):
+        _observe(lens, seq, now + seq * 10, [target],
+                 [_row(target, duty=50.0)])
+    # Duty collapses: anomaly raises exactly once over 3 bad refreshes.
+    for seq in range(7, 10):
+        _observe(lens, seq, now + seq * 10, [target],
+                 [_row(target, duty=2.0)])
+    events = tracer.events()["events"]
+    raises = [e for e in events if e["kind"] == "fleet_anomaly"]
+    assert len(raises) == 1
+    assert raises[0]["attrs"]["target"] == target
+    assert raises[0]["attrs"]["anomaly"] == "duty"
+    rollup = lens.rollup()
+    assert "duty" in rollup["targets"][target]["anomalous"]
+    assert rollup["anomalies"][0]["kind"] == "duty"
+    # Back to baseline: the EWMA re-centers and the anomaly clears with
+    # a recovery event.
+    for seq in range(10, 40):
+        _observe(lens, seq, now + seq * 10, [target],
+                 [_row(target, duty=2.0)])
+    assert not lens.rollup()["targets"][target]["anomalous"]
+    kinds = [e["kind"] for e in tracer.events()["events"]]
+    assert "fleet_recovered" in kinds
+
+
+def test_freshness_anomaly_for_target_missing_refreshes():
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer, miss_threshold=3)
+    target = "w0.prom"
+    _observe(lens, 1, 0.0, [target], [_row(target)])
+    for seq in range(2, 6):
+        _observe(lens, seq, seq * 10.0, [target], [],
+                 reachable={target: False})
+    rollup = lens.rollup()
+    assert "freshness" in rollup["targets"][target]["anomalous"]
+    raises = [e for e in tracer.events()["events"]
+              if e["kind"] == "fleet_anomaly"]
+    assert len(raises) == 1  # edge-detected, not per refresh
+    assert raises[0]["attrs"]["anomaly"] == "freshness"
+    # The unreachable target's last-known chips burn the freshness
+    # budget: 1 chip bad for 4 of 5 refreshes.
+    fresh = rollup["slo"]["freshness"]["windows"]["5m"]
+    assert fresh["bad_ratio"] == pytest.approx(0.8)
+    assert fresh["burn_rate"] > 1.0
+    # It answers again: freshness clears.
+    _observe(lens, 6, 60.0, [target], [_row(target)])
+    assert "freshness" not in lens.rollup()["targets"][target]["anomalous"]
+
+
+def test_straggler_objective_burns_on_low_ratio():
+    lens = FleetLens(straggler_ratio=0.75)
+    targets = ["a", "b"]
+    for seq in range(1, 5):
+        rows = [_row("a", steps=10.0, worker="0"),
+                _row("b", steps=9.5, worker="1")]
+        _observe(lens, seq, seq * 10.0, targets, rows)
+    state = lens.rollup()["slo"]["straggler"]["windows"]["5m"]
+    assert state["bad_ratio"] == 0.0
+    # Worker b collapses to 20% of a's rate: every refresh burns.
+    for seq in range(5, 9):
+        rows = [_row("a", steps=10.0, worker="0"),
+                _row("b", steps=2.0, worker="1")]
+        _observe(lens, seq, seq * 10.0, targets, rows)
+    state = lens.rollup()["slo"]["straggler"]["windows"]["5m"]
+    assert state["bad_ratio"] == pytest.approx(0.5)  # 4 of 8 refreshes
+    assert state["burn_rate"] == pytest.approx(10.0)  # 5% budget
+
+
+def test_slow_node_attribution_picks_worst_digest():
+    lens = FleetLens()
+    digests = {
+        "a": {"slowest": {"seconds": 0.02, "phase": "env_round",
+                          "blame": "device=1"}},
+        "b": {"slowest": {"seconds": 0.9, "phase": "fetch_wait",
+                          "blame": "port=8431"}},
+    }
+    _observe(lens, 1, 0.0, ["a", "b"], [_row("a"), _row("b")],
+             digests=digests)
+    worst = lens.rollup()["attribution"]
+    assert worst["target"] == "b"
+    assert worst["phase"] == "fetch_wait"
+    assert worst["blame"] == "port=8431"
+    # Contributed as the kts_fleet_worst_tick_seconds gauge.
+    builder = SnapshotBuilder()
+    lens.contribute(builder)
+    text = builder.build().render()
+    gauges = labeled(text, "kts_fleet_worst_tick_seconds")
+    assert gauges == {(("phase", "fetch_wait"), ("target", "b")): 0.9}
+
+
+def test_scoring_is_deterministic_under_seeded_inputs():
+    """Acceptance: identical scripted inputs produce identical baselines,
+    anomalies and burn state — no wall clock, no randomness."""
+    import random
+
+    def run():
+        rng = random.Random(42)
+        lens = FleetLens(min_samples=4)
+        targets = ["a", "b"]
+        for seq in range(1, 30):
+            rows = [_row("a", duty=50 + rng.uniform(-1, 1),
+                         steps=10 + rng.uniform(-0.1, 0.1), worker="0"),
+                    _row("b", duty=(50 if seq < 20 else 5.0),
+                         steps=10.0, worker="1")]
+            _observe(lens, seq, seq * 10.0, targets, rows,
+                     fetch={"a": 0.01 + rng.uniform(0, 0.001),
+                            "b": 0.01})
+        return lens.rollup()
+
+    first, second = run(), run()
+    assert first == second
+    # b's duty collapse was flagged (the live flag adapts and clears
+    # over sustained shifts; the anomaly ring keeps the incident).
+    assert any(r["target"] == "b" and r["kind"] == "duty"
+               for r in first["anomalies"])
+
+
+def test_anomaly_raise_clear_has_hysteresis():
+    """Review fix: clearing requires z to fall below HALF the raise
+    threshold — a signal oscillating just around the threshold latches
+    one incident instead of flapping raise/clear pairs into the journal
+    and inflating the edge-counted incident counter."""
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer, min_samples=3)
+    target = "w0"
+    for seq in range(1, 7):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target, duty=50.0)])
+    state = lens._targets[target]
+    baseline = state.baselines["duty"]
+    # Oscillate the reading so |z| alternates just above and just below
+    # the threshold (4), but never under the clear threshold (2).
+    for seq in range(7, 17):
+        sd = max((baseline.var ** 0.5), 0.02 * abs(baseline.mean), 1.0)
+        offset = (4.5 if seq % 2 else 3.5) * sd
+        _observe(lens, seq, seq * 10.0, [target],
+                 [_row(target, duty=baseline.mean - offset)])
+    events = tracer.events()["events"]
+    assert sum(1 for e in events if e["kind"] == "fleet_anomaly") == 1
+    assert not any(e["kind"] == "fleet_recovered" for e in events)
+    assert "duty" in lens.rollup()["targets"][target]["anomalous"]
+
+
+def test_anomaly_clears_when_its_signal_stops_being_reported():
+    """Review fix: a 'steps' anomaly raised during job teardown must
+    clear once the step-rate series vanish from the exposition — a
+    latched anomaly on data that no longer exists would page forever."""
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer, min_samples=3)
+    target = "w0"
+    for seq in range(1, 7):
+        _observe(lens, seq, seq * 10.0, [target],
+                 [_row(target, steps=100.0)])
+    _observe(lens, 7, 70.0, [target], [_row(target, steps=1.0)])
+    assert "steps" in lens.rollup()["targets"][target]["anomalous"]
+    # The job is gone: no step series at all this refresh.
+    _observe(lens, 8, 80.0, [target], [_row(target, steps=None)])
+    assert "steps" not in lens.rollup()["targets"][target]["anomalous"]
+    kinds = [e["kind"] for e in tracer.events()["events"]]
+    assert kinds.count("fleet_recovered") == 1
+
+
+def test_flat_at_zero_baselines_do_not_flag_job_start():
+    """Review fix: an idle slice (duty/power/HBM flat at exactly zero
+    through warmup) must not flag every target the moment a job starts.
+    Bounded-scale signals get absolute sd floors; unbounded ones
+    re-seed on first activity WITH the warmup gate re-armed, so the
+    production min_samples window covers the post-launch ramp.
+    stale_fraction keeps firing from zero — nonzero-from-zero IS its
+    anomaly."""
+    lens = FleetLens()  # production defaults: the claim under test
+    target = "w0"
+    for seq in range(1, 8):
+        _observe(lens, seq, seq * 10.0, [target],
+                 [ChipRow(key=(target, "s", "0", "0"), up=1.0, duty=0.0,
+                          mem_used=0.0, power=0.0, steps_per_s=0.0)])
+    # Job starts and RAMPS over several refreshes (model loading: HBM
+    # doubling refresh to refresh, duty climbing) — the re-seed resets
+    # the warmup gate, so the ramp must not z-explode against the
+    # re-seeded zero-variance point either.
+    ramp = [(20.0, 1e10, 100.0, 2.0), (45.0, 2e10, 180.0, 5.0),
+            (70.0, 4e10, 250.0, 8.0), (95.0, 8e10, 300.0, 10.0)]
+    for i, (duty, hbm, power, steps) in enumerate(ramp):
+        _observe(lens, 8 + i, 80.0 + i * 10, [target],
+                 [ChipRow(key=(target, "s", "0", "0"), up=1.0, duty=duty,
+                          mem_used=hbm, power=power, steps_per_s=steps)])
+        assert lens.rollup()["targets"][target]["anomalous"] == {}, \
+            f"ramp step {i} falsely flagged"
+    # ...while a chip going stale from the same flat-zero history still
+    # fires (the floored signal's anomaly is exactly zero -> nonzero).
+    _observe(lens, 12, 120.0, [target],
+             [ChipRow(key=(target, "s", "0", "0"), up=0.0, duty=95.0,
+                      mem_used=8e10, power=300.0, steps_per_s=10.0),
+              ChipRow(key=(target, "s", "0", "1"), up=1.0, duty=95.0,
+                      mem_used=8e10, power=300.0, steps_per_s=10.0)])
+    assert "stale_fraction" in lens.rollup()["targets"][target]["anomalous"]
+
+
+def test_attribution_drops_dead_targets_stale_digest():
+    """Review fix: a crashed node's frozen pre-crash digest must not
+    pin worst-node attribution forever while live nodes' rings age
+    their own maxima out."""
+    lens = FleetLens(miss_threshold=3)
+    digests = {
+        "dead": {"slowest": {"seconds": 9.9, "phase": "fetch_wait",
+                             "blame": "port=1"}},
+        "live": {"slowest": {"seconds": 0.1, "phase": "env_round",
+                             "blame": "device=0"}},
+    }
+    _observe(lens, 1, 0.0, ["dead", "live"],
+             [_row("dead"), _row("live")], digests=digests)
+    assert lens.rollup()["attribution"]["target"] == "dead"
+    for seq in range(2, 6):
+        _observe(lens, seq, seq * 10.0, ["dead", "live"],
+                 [_row("live")], reachable={"dead": False, "live": True},
+                 digests={"live": digests["live"]})
+    worst = lens.rollup()["attribution"]
+    assert worst["target"] == "live"
+    # An answered target with NO digest (restarted under --no-trace)
+    # replaces its stale one instead of retaining it.
+    _observe(lens, 6, 60.0, ["dead", "live"],
+             [_row("dead"), _row("live")],
+             digests={"dead": {}, "live": digests["live"]})
+    assert lens.rollup()["attribution"]["target"] == "live"
+
+
+def test_evict_drops_departed_target_state():
+    lens = FleetLens(min_samples=2, miss_threshold=1)
+    _observe(lens, 1, 0.0, ["a", "b"], [_row("a"), _row("b")])
+    _observe(lens, 2, 10.0, ["a", "b"], [_row("a")],
+             reachable={"a": True, "b": False})
+    assert "b" in lens.rollup()["targets"]
+    assert any(k[0] == "b" for k in lens._anomalies_total)
+    lens.evict({"a"})
+    rollup = lens.rollup()
+    assert set(rollup["targets"]) == {"a"}
+    assert not any(k[0] == "b" for k in lens._anomalies_total)
+
+
+# -- daemon-side digest export ----------------------------------------------
+
+def test_poll_exports_flight_recorder_digest():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=2), reg, deadline=5.0)
+    loop.tick()
+    loop.tick()  # tick 2's snapshot carries tick 1's fold
+    loop.stop()
+    text = reg.snapshot().render()
+    phases = labeled(text, schema.TICK_PHASE_SECONDS.name)
+    assert phases, "digest absent with tracing enabled"
+    recorded = {dict(k)["phase"] for k in phases}
+    assert {"fold", "plan_write", "publish"} <= recorded
+    assert {dict(k)["quantile"] for k in phases} == {"p50", "p99", "max"}
+    slowest = labeled(text, schema.SLOWEST_TICK_SECONDS.name)
+    (labels,) = slowest
+    assert dict(labels)["phase"]  # a worst phase is always named
+
+
+def test_poll_digest_absent_under_no_trace():
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0,
+                    tracer=Tracer(enabled=False))
+    loop.tick()
+    loop.tick()
+    loop.stop()
+    text = reg.snapshot().render()
+    assert values(text, schema.TICK_PHASE_SECONDS.name) == []
+    assert values(text, schema.SLOWEST_TICK_SECONDS.name) == []
+
+
+# -- hub integration ---------------------------------------------------------
+
+def _digest_target(tmp_path, name, slowest_phase="fetch_wait",
+                   slowest_s=0.5, blame="port=8431"):
+    builder = SnapshotBuilder()
+    builder.add(schema.DEVICE_UP, 1.0,
+                [("chip", "0"), ("worker", name), ("slice", "s")])
+    builder.add(schema.POWER, 100.0,
+                [("chip", "0"), ("worker", name), ("slice", "s")])
+    builder.add(schema.TICK_PHASE_SECONDS, slowest_s,
+                [("phase", slowest_phase), ("quantile", "p99")])
+    builder.add(schema.SLOWEST_TICK_SECONDS, slowest_s,
+                [("phase", slowest_phase), ("blame", blame)])
+    path = tmp_path / f"{name}.prom"
+    path.write_text(builder.build().render())
+    return str(path)
+
+
+def test_hub_serves_debug_fleet_and_gauges(tmp_path):
+    slow = _digest_target(tmp_path, "slow", slowest_s=0.8)
+    quick = _digest_target(tmp_path, "quick", slowest_phase="env_round",
+                           slowest_s=0.002, blame="device=0")
+    hub = Hub([slow, quick])
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           trace_provider=hub.tracer,
+                           fleet_provider=hub.fleet)
+    server.start()
+    try:
+        hub.refresh_once()
+        hub.refresh_once()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/fleet",
+            timeout=5).read()
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert set(payload["targets"]) == {slow, quick}
+        assert payload["attribution"]["target"] == slow
+        assert payload["attribution"]["blame"] == "port=8431"
+        # The digest cached on the ingest entry survives the body-cache
+        # hit on refresh 2 (series_dicts were dropped after refresh 1).
+        assert payload["targets"][slow]["digest"]["slowest"][
+            "phase"] == "fetch_wait"
+        text = hub.registry.snapshot().render()
+        assert values(text, "kts_fleet_targets_anomalous") == [0.0]
+        burns = labeled(text, "kts_fleet_slo_burn_rate")
+        assert {dict(k)["objective"] for k in burns} == \
+            {"freshness", "straggler"}
+        assert {dict(k)["window"] for k in burns} == {"5m", "1h"}
+        worst = labeled(text, "kts_fleet_worst_tick_seconds")
+        assert worst == {(("phase", "fetch_wait"),
+                          ("target", slow)): 0.8}
+        # The landing page advertises the endpoint.
+        landing = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/", timeout=5).read().decode()
+        assert "/debug/fleet" in landing
+    finally:
+        server.stop()
+        hub.stop()
+
+
+def test_hub_no_fleet_lens_disables_endpoint_and_gauges(tmp_path):
+    target = _digest_target(tmp_path, "w0")
+    hub = Hub([target], fleet_lens=False)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           trace_provider=hub.tracer,
+                           fleet_provider=hub.fleet)
+    server.start()
+    try:
+        hub.refresh_once()
+        assert hub.fleet is None
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/fleet", timeout=5)
+        assert err.value.code == 404
+        text = hub.registry.snapshot().render()
+        assert values(text, "kts_fleet_slo_burn_rate") == []
+        landing = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/", timeout=5).read().decode()
+        assert "/debug/fleet" not in landing
+    finally:
+        server.stop()
+        hub.stop()
+
+
+def test_hub_fleet_state_evicts_with_target_churn(tmp_path):
+    a = _digest_target(tmp_path, "a")
+    b = _digest_target(tmp_path, "b")
+    current = [[a, b]]
+    hub = Hub([], targets_provider=lambda: list(current[0]))
+    try:
+        hub.refresh_once()
+        assert set(hub.fleet.rollup()["targets"]) == {a, b}
+        current[0] = [a]
+        hub.refresh_once()
+        assert set(hub.fleet.rollup()["targets"]) == {a}
+    finally:
+        hub.stop()
+
+
+def test_hub_cli_rejects_bad_slo_flags(capsys):
+    with pytest.raises(SystemExit):
+        from kube_gpu_stats_tpu import hub as hub_mod
+
+        hub_mod.main(["http://x/metrics", "--once",
+                      "--slo-freshness-target", "1.5"])
+    capsys.readouterr()
+
+
+# -- doctor --fleet ----------------------------------------------------------
+
+def _canned_rollup():
+    return {
+        "enabled": True,
+        "seq": 42,
+        "targets": {
+            "http://w0:9400/metrics": {
+                "anomalous": {},
+                "signals": {},
+            },
+            "http://w3:9400/metrics": {
+                "anomalous": {"stale_fraction": 9.5, "freshness": 3.0},
+                "signals": {},
+                "digest": {"slowest": {"seconds": 0.4,
+                                       "phase": "env_round",
+                                       "blame": "device=0"}},
+            },
+        },
+        "anomalies": [],
+        "slo": {
+            "freshness": {"target": 0.99, "windows": {
+                "5m": {"bad_ratio": 0.25, "burn_rate": 25.0,
+                       "events": 30},
+                "1h": {"bad_ratio": 0.02, "burn_rate": 2.0,
+                       "events": 360},
+            }},
+            "straggler": {"target": 0.95, "ratio_min": 0.75, "windows": {
+                "5m": {"bad_ratio": 0.0, "burn_rate": 0.0, "events": 30},
+                "1h": {"bad_ratio": 0.0, "burn_rate": 0.0, "events": 360},
+            }},
+        },
+        "attribution": {"target": "http://w7:9400/metrics",
+                        "seconds": 1.2, "phase": "fetch_wait",
+                        "blame": "port=8431"},
+    }
+
+
+def test_fleet_post_mortem_names_worst_node_anomalies_and_burn():
+    status, detail, data = doctor.fleet_post_mortem(_canned_rollup())
+    assert status == "warn"  # anomalies active + burn over budget
+    assert "worst node: http://w7:9400/metrics" in detail
+    assert "phase fetch_wait" in detail and "port=8431" in detail
+    assert "http://w3:9400/metrics: freshness (3 refreshes missed), " \
+           "stale_fraction (z=9.5) [worst phase env_round, device=0]" \
+           in detail
+    assert "freshness 1h=2x!/5m=25x!" in detail
+    assert data["anomalous"] == {
+        "http://w3:9400/metrics": {"stale_fraction": 9.5,
+                                   "freshness": 3.0}}
+
+
+def test_fleet_post_mortem_clean_fleet_is_ok():
+    payload = _canned_rollup()
+    payload["targets"]["http://w3:9400/metrics"]["anomalous"] = {}
+    for objective in payload["slo"].values():
+        for window in objective["windows"].values():
+            window["burn_rate"] = 0.5
+    status, detail, _ = doctor.fleet_post_mortem(payload)
+    assert status == "ok"
+    assert "worst node" in detail
+
+
+def test_check_fleet_classifies_missing_and_unreachable():
+    # No fleet provider wired: 404 classified, not a crash.
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        result = doctor.check_fleet(f"http://127.0.0.1:{server.port}")
+        assert result.status == "warn"
+        assert "/debug/fleet" in result.detail
+    finally:
+        server.stop()
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    result = doctor.check_fleet(f"http://127.0.0.1:{port}")
+    assert result.status == "fail"
+
+
+def test_doctor_main_accepts_fleet_flag(tmp_path, capsys):
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    rc = doctor.main([
+        "--fleet", "--url", f"http://127.0.0.1:{port}/metrics", "--json",
+        "--backend", "mock", "--attribution", "off",
+        "--sysfs-root", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    rows = {c["name"]: c for c in out["checks"]}
+    assert rows["fleet"]["status"] == "fail"
+    assert rc == 1
+
+
+# -- acceptance: fault injection across a multi-target hub -------------------
+
+def test_fleet_lens_flags_slow_port_and_frozen_env_nodes(tmp_path):
+    """Acceptance (ISSUE 5): one daemon with an injected slow libtpu
+    port and one whose env reads freeze (device sampling fails) are
+    BOTH flagged — doctor --fleet names the slow node with its worst
+    phase (fetch_wait/rpc_port + blamed port) and the frozen node with
+    its anomaly kind (stale_fraction), and the freshness burn gauges
+    trip."""
+    from kube_gpu_stats_tpu.collectors.composite import TpuCollector
+    from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
+    from kube_gpu_stats_tpu.testing import FakeLibtpuServer, make_sysfs
+
+    fake = FakeLibtpuServer(num_chips=2)
+    fake.delay = 0.06  # the injected slow port
+    fake.start()
+    stacks = []
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            sysroot = pathlib.Path(tmp) / "sys"
+            make_sysfs(sysroot, num_chips=2)
+            tracer_a = Tracer()
+            collector_a = TpuCollector(
+                sysfs_root=str(sysroot),
+                libtpu_client=LibtpuClient(ports=(fake.port,),
+                                           rpc_timeout=5.0))
+            collector_a.set_tracer(tracer_a)
+            reg_a = Registry()
+            loop_a = PollLoop(collector_a, reg_a, deadline=2.0,
+                              pipeline_fetch=False, tracer=tracer_a)
+            server_a = MetricsServer(reg_a, host="127.0.0.1", port=0)
+            server_a.start()
+            stacks.append((loop_a, server_a, collector_a))
+
+            mock = MockCollector(num_devices=2)
+            reg_b = Registry()
+            loop_b = PollLoop(mock, reg_b, deadline=2.0)
+            server_b = MetricsServer(reg_b, host="127.0.0.1", port=0)
+            server_b.start()
+            stacks.append((loop_b, server_b, None))
+
+            url_a = f"http://127.0.0.1:{server_a.port}/metrics"
+            url_b = f"http://127.0.0.1:{server_b.port}/metrics"
+            hub = Hub([url_a, url_b], interval=60.0)
+            hub.fleet.min_samples = 3  # short warmup for the test
+            hub_server = MetricsServer(hub.registry, host="127.0.0.1",
+                                       port=0, trace_provider=hub.tracer,
+                                       fleet_provider=hub.fleet)
+            hub_server.start()
+            try:
+                # Healthy baseline: both nodes ticking, six refreshes.
+                for _ in range(6):
+                    loop_a.tick()
+                    loop_b.tick()
+                    hub.refresh_once()
+                assert not hub.fleet.rollup()["targets"][url_b][
+                    "anomalous"]
+
+                # Freeze node B's env reads: device 0's sample fails
+                # from here on — the daemon marks it stale (up 0).
+                real_sample = mock.sample
+
+                def frozen_sample(device):
+                    if device.device_id == "0":
+                        raise RuntimeError("env read frozen")
+                    return real_sample(device)
+
+                mock.sample = frozen_sample
+                for _ in range(3):
+                    loop_a.tick()
+                    loop_b.tick()
+                    hub.refresh_once()
+
+                rollup = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{hub_server.port}/debug/fleet",
+                    timeout=5).read())
+                # The frozen-env node is flagged with the right kind...
+                assert "stale_fraction" in \
+                    rollup["targets"][url_b]["anomalous"]
+                # ...and the slow-port node is the fleet's worst node,
+                # with the runtime-fetch phase and the blamed port.
+                worst = rollup["attribution"]
+                assert worst["target"] == url_a
+                assert worst["phase"] in ("fetch_wait", "rpc_port")
+                assert worst["blame"] == f"port={fake.port}"
+
+                # The corresponding burn gauges trip: stale chips are
+                # burning the freshness error budget.
+                text = hub.registry.snapshot().render()
+                burns = labeled(text, "kts_fleet_slo_burn_rate")
+                fresh_5m = burns[(("objective", "freshness"),
+                                  ("window", "5m"))]
+                assert fresh_5m > 1.0, burns
+                # At least the frozen node is anomalous (real timing
+                # jitter on the slow node's fetch latency may flag it
+                # too — that is working as intended, not noise).
+                assert values(text,
+                              "kts_fleet_targets_anomalous")[0] >= 1.0
+                anomalies = labeled(text, "kts_fleet_anomalies_total")
+                assert anomalies[(("kind", "stale_fraction"),
+                                  ("target", url_b))] == 1.0
+
+                # doctor --fleet names each target with phase/kind.
+                result = doctor.check_fleet(
+                    f"http://127.0.0.1:{hub_server.port}")
+                assert result.status == "warn", result
+                assert f"worst node: {url_a}" in result.detail
+                assert worst["phase"] in result.detail
+                assert f"port={fake.port}" in result.detail
+                assert url_b in result.detail
+                assert "stale_fraction" in result.detail
+                # The anomaly landed in the shared journal with the
+                # causing target and refresh seq.
+                raises = [e for e in hub.tracer.events()["events"]
+                          if e["kind"] == "fleet_anomaly"]
+                assert any(e["attrs"]["target"] == url_b
+                           and e["tick_seq"] > 6 for e in raises)
+            finally:
+                hub_server.stop()
+                hub.stop()
+    finally:
+        for loop, server, collector in stacks:
+            server.stop()
+            loop.stop()
+            if collector is not None:
+                collector.close()
+        fake.stop()
+
+
+# -- refresh-cost budget (bench pin) ----------------------------------------
+
+def test_fleet_score_cost_under_budget():
+    """Acceptance: fleet_score_ms_per_refresh stays under its pinned
+    budget with tracing enabled (the production configuration). The
+    bench publishes the 64-worker figure; this pins an 8-worker shape
+    with a hard ceiling generous enough for CI noise yet far below the
+    refresh budget."""
+    from kube_gpu_stats_tpu.bench import measure_hub_merge
+
+    result = measure_hub_merge(workers=8, chips=2, refreshes=4)
+    assert result is not None
+    score = result["fleet_score_ms_per_refresh"]
+    assert score is not None and score >= 0.0
+    assert score < 25.0, f"fleet scoring {score} ms/refresh blows budget"

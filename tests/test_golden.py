@@ -11,6 +11,7 @@ import pathlib
 from kube_gpu_stats_tpu.collectors.mock import MockCollector
 from kube_gpu_stats_tpu.poll import PollLoop
 from kube_gpu_stats_tpu.registry import Registry
+from kube_gpu_stats_tpu.tracing import Tracer
 
 GOLDEN = pathlib.Path(__file__).parent / "golden" / "mock_2dev.prom"
 
@@ -33,6 +34,9 @@ def render_two_ticks() -> str:
         topology_labels={"slice": "test-slice", "worker": "0", "topology": "2x2x1"},
         version="golden",
         process_metrics=False,  # /proc values are nondeterministic
+        # Disabled recorder: the kts_tick_phase_seconds digest carries
+        # real perf-counter durations, which are nondeterministic.
+        tracer=Tracer(enabled=False),
         clock=clock,
     )
     loop.tick()

@@ -188,6 +188,8 @@ def test_plan_loop_matches_legacy_loop_end_to_end():
     escape hatch) — publish byte-identical expositions tick after tick,
     including the value-unchanged re-emit path (mock gauges hold still
     across some consecutive ticks of the triangle wave)."""
+    from kube_gpu_stats_tpu.tracing import Tracer
+
     frozen = lambda: 0.0  # noqa: E731 - identical tick durations/rates
     loops = []
     for use_plan in (True, False):
@@ -198,6 +200,10 @@ def test_plan_loop_matches_legacy_loop_end_to_end():
             topology_labels={"slice": "s", "worker": "1", "topology": "2x1"},
             process_metrics=False,
             use_tick_plan=use_plan,
+            # Disabled recorders: each loop's kts_tick_phase_seconds
+            # digest would carry its own real span timings, which can
+            # never be byte-identical across two loops.
+            tracer=Tracer(enabled=False),
             clock=frozen,
         )
         loops.append(loop)

@@ -338,6 +338,82 @@ def test_hub_cycle_records_phases_and_target_spans(tmp_path):
     assert traces[0].meta["answered"] == 1
 
 
+def test_hub_debug_trace_and_events_under_rollups_only_with_churn(tmp_path):
+    """ISSUE 5 satellite: the hub's /debug/trace and /debug/events must
+    stay coherent in --rollups-only mode AND across a target churning
+    mid-window (the PR 2 cache-eviction path): cycle traces keep their
+    per-target spans, eviction doesn't wedge the endpoints, and the
+    payloads stay strict JSON."""
+    from kube_gpu_stats_tpu.hub import Hub
+
+    a = tmp_path / "a.prom"
+    b = tmp_path / "b.prom"
+    for path, worker in ((a, "0"), (b, "1")):
+        path.write_text(
+            f'accelerator_up{{chip="0",worker="{worker}",slice="s"}} 1\n')
+    current = [[str(a), str(b)]]
+    hub = Hub([], targets_provider=lambda: list(current[0]),
+              rollups_only=True)
+    srv = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                        trace_provider=hub.tracer,
+                        fleet_provider=hub.fleet)
+    srv.start()
+    try:
+        hub.refresh_once()
+        hub.refresh_once()
+        current[0] = [str(a)]  # target b churns out mid-window
+        hub.refresh_once()
+        assert str(b) not in hub._parse_cache  # eviction path exercised
+        trace = _get_json(srv.port, "/debug/trace?last=10")
+        assert trace["enabled"] is True
+        kinds = [e["name"] for e in trace["traceEvents"]]
+        assert kinds.count("cycle") == 3
+        # Pre-churn cycles carried target-attributed spans for BOTH
+        # targets; rollups-only drops per-chip series, never the trace.
+        targets = {e["args"].get("target")
+                   for e in trace["traceEvents"]
+                   if e["name"] in ("target_fetch", "parse")}
+        assert {str(a), str(b)} <= targets
+        ticks = _get_json(srv.port, "/debug/ticks")
+        assert ticks["ticks_recorded"] == 3
+        events = _get_json(srv.port, "/debug/events")
+        assert events["enabled"] is True
+        json.dumps(events, allow_nan=False)  # strict-parseable
+        # The departed target's cached spans survive in the recorded
+        # window; a refresh AFTER eviction still serves everything.
+        hub.refresh_once()
+        assert _get_json(srv.port, "/debug/trace?last=1")["traceEvents"]
+    finally:
+        srv.stop()
+        hub.stop()
+
+
+def test_hub_slowest_cycle_blames_timed_out_target(tmp_path):
+    """ISSUE 5 satellite: a fetch that blows the refresh deadline is
+    exactly the one that made the cycle slow — the slowest-cycle table
+    must carry its target in the blame span (parity with the daemon's
+    device/port blame), not just the successful fetches'."""
+    import os
+
+    from kube_gpu_stats_tpu.hub import Hub
+
+    good = tmp_path / "a_good.prom"
+    good.write_text('accelerator_up{chip="0",worker="0",slice="s"} 1\n')
+    fifo = tmp_path / "z_hung.prom"
+    os.mkfifo(fifo)  # read blocks forever: the NFS/FUSE-stall stand-in
+    hub = Hub([str(good), str(fifo)], fetch_timeout=0.2)
+    try:
+        hub.refresh_once()
+        summary = hub.tracer.ticks_summary()
+        (slowest,) = [row for row in summary["slowest"]
+                      if row["kind"] == "cycle"][:1]
+        assert slowest["blame"]["attrs"]["target"] == str(fifo)
+        assert slowest["blame"]["attrs"]["error"]
+        assert slowest["blame"]["span"] == "target_fetch"
+    finally:
+        hub.stop()
+
+
 # -- /debug endpoints --------------------------------------------------------
 
 @pytest.fixture

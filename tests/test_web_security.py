@@ -151,17 +151,20 @@ def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
         pass
     tracer.end()
     tracer.event("breaker", "SECRET_EVENT_DETAIL")
+    from kube_gpu_stats_tpu.fleetlens import FleetLens
+
     srv = MetricsServer(
         make_registry(), host="127.0.0.1", port=0,
         auth_username="prom",
         auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
         trace_provider=tracer,
+        fleet_provider=FleetLens(tracer=tracer),
     )
     srv.start()
     try:
         for path in ("/debug/threads", "/debug/profile?seconds=0.1",
                      "/debug/ticks", "/debug/trace?last=5",
-                     "/debug/events?since=0"):
+                     "/debug/events?since=0", "/debug/fleet"):
             with pytest.raises(urllib.error.HTTPError) as err:
                 fetch(srv.port, path)
             assert err.value.code == 401, path

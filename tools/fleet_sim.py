@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Fleet-lens simulation smoke (ISSUE 5 satellite, `make fleet-sim`):
+spin N REAL daemons (full Daemon wiring: TPU backend over make_sysfs +
+FakeLibtpuServer, FakeKubelet-backed PodResources attribution) plus one
+hub scraping all of them, inject a straggler (a scripted RPC delay on
+one node's fake runtime), and assert the fleet lens attributes the
+slowness to that node — end to end through the daemons' self-exported
+flight-recorder digests, the hub's /debug/fleet, and
+`doctor --fleet`'s post-mortem.
+
+Exit 0 with a PASS line when the guilty node is named; exit 1 with the
+evidence otherwise. Wired into `make ci` as a smoke job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    straggler_index = 0
+    daemons: list = []
+    fakes: list = []
+    hub = None
+    hub_server = None
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            targets = []
+            for node in range(nodes):
+                root = pathlib.Path(tmp) / f"node{node}"
+                make_sysfs(root / "sys", num_chips=2)
+                libtpu = FakeLibtpuServer(num_chips=2).start()
+                if node == straggler_index:
+                    libtpu.delay = delay  # the injected straggler
+                socket = str(root / "kubelet.sock")
+                kubelet = FakeKubeletServer(
+                    socket, [tpu_pod(f"train-{node}", "ml", "worker",
+                                     ["0", "1"])]).start()
+                fakes.extend([libtpu, kubelet])
+                cfg = Config(
+                    backend="tpu",
+                    sysfs_root=str(root / "sys"),
+                    libtpu_ports=(libtpu.port,),
+                    interval=0.1,
+                    deadline=2.0,
+                    listen_host="127.0.0.1",
+                    listen_port=0,
+                    attribution="podresources",
+                    kubelet_socket=socket,
+                    attribution_interval=0.5,
+                    pipeline_fetch=False,  # each tick joins its own
+                    #                        (delayed) fetch: the slow
+                    #                        port lands in fetch_wait
+                    use_native=False,
+                )
+                daemon = Daemon(cfg)
+                if node == straggler_index:
+                    # Raise the transport timeout so the injected delay
+                    # SLOWS the straggler's ticks instead of timing its
+                    # RPCs out fast (the 40 ms default would fail the
+                    # fetch in 40 ms and leave nothing slow to blame).
+                    daemon.collector._libtpu._client._rpc_timeout = 5.0
+                daemon.start()
+                daemons.append(daemon)
+                targets.append(
+                    f"http://127.0.0.1:{daemon.server.port}/metrics")
+
+            # Wait for every daemon's first publish: refreshing the hub
+            # against half-started exporters records cold-start noise
+            # (giant first-tick env reads) that isn't the injected
+            # fault.
+            for daemon in daemons:
+                daemon.registry.wait_for_publish(0, timeout=10)
+
+            hub = Hub(targets, interval=0.2, expect_workers=nodes)
+            hub_server = MetricsServer(
+                hub.registry, host="127.0.0.1", port=0,
+                trace_provider=hub.tracer, fleet_provider=hub.fleet)
+            hub_server.start()
+
+            straggler = targets[straggler_index]
+            for _ in range(refreshes):
+                time.sleep(0.3)  # let every daemon tick (and the
+                #                  straggler pay its delay) in between
+                hub.refresh_once()
+
+            result = doctor.check_fleet(
+                f"http://127.0.0.1:{hub_server.port}")
+            if verbose:
+                print(f"[{result.status}] fleet  {result.detail}")
+            attribution = (result.data or {}).get("attribution") or {}
+            worst_target = attribution.get("target", "")
+            phase = attribution.get("phase", "")
+            text = hub.registry.snapshot().render()
+            gauge_names_straggler = any(
+                line.startswith("kts_fleet_worst_tick_seconds")
+                and straggler in line
+                for line in text.splitlines())
+            ok = (worst_target == straggler
+                  and phase in ("fetch_wait", "rpc_port")
+                  and gauge_names_straggler)
+            if ok:
+                print(f"fleet-sim PASS: doctor --fleet named the "
+                      f"straggler ({straggler}, phase {phase}, "
+                      f"{attribution.get('seconds', 0.0):.3f}s, "
+                      f"blame {attribution.get('blame') or '-'}) across "
+                      f"{nodes} nodes")
+                return 0
+            print("fleet-sim FAIL:")
+            print(f"  expected worst node {straggler}")
+            print(f"  attribution: {attribution}")
+            print(f"  gauge named straggler: {gauge_names_straggler}")
+            print(f"  doctor detail: {result.detail}")
+            return 1
+        finally:
+            if hub_server is not None:
+                hub_server.stop()
+            if hub is not None:
+                hub.stop()
+            for daemon in daemons:
+                daemon.stop()
+            for fake in fakes:
+                fake.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--refreshes", type=int, default=8)
+    parser.add_argument("--delay", type=float, default=0.8,
+                        help="scripted RPC delay injected on node 0's "
+                             "fake runtime (the straggler); far above "
+                             "any cold-start read so attribution is "
+                             "unambiguous")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.nodes, args.refreshes, args.delay, args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
