@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim
+.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -7,6 +7,7 @@
 ci: native lint
 	python -m pytest tests/ -q -m 'not chaos'
 	python tools/fleet_sim.py
+	python tools/federation_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -44,6 +45,14 @@ bench: native
 # blamed port. Runs inside `make ci` too.
 fleet-sim:
 	python tools/fleet_sim.py --verbose
+
+# Federation smoke (<30 s): N real daemons pushing deltas into two leaf
+# hubs, leaves pushing rollups into one --federate root; injects a
+# worker restart (generation resync) and a partitioned leaf (pull
+# fallback), asserts the root rollup converges and `doctor --fleet`
+# walks root -> leaf -> node to name the straggler. In `make ci` too.
+federation-sim:
+	python tools/federation_sim.py --verbose
 
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf

@@ -27,6 +27,29 @@ import tempfile
 BUDGET_MS = 50.0
 
 
+def _delta_fields(line: dict) -> None:
+    """Push-delta + federation figures (ISSUE 7): the root-hub warm
+    refresh at 4096 simulated workers over delta ingest, the per-wave
+    ingest cost, and the quiet-tick payload ratio. An extra datum —
+    omitted on failure, never a bench failure."""
+    from kube_gpu_stats_tpu.bench import (measure_delta_federation,
+                                          measure_quiet_tick_delta)
+
+    fed = measure_delta_federation()
+    if fed is not None:
+        line["root_merge_4096w_p50_ms"] = fed["root_merge_p50_ms"]
+        line["root_merge_4096w_cold_ms"] = fed["root_merge_cold_ms"]
+        line["delta_ingest_ms_per_refresh"] = fed[
+            "delta_ingest_ms_per_refresh"]
+        line["delta_bytes_per_tick"] = fed["delta_bytes_per_refresh"]
+        line["federation_root_series"] = fed["root_series"]
+    quiet = measure_quiet_tick_delta()
+    if quiet is not None:
+        line["delta_quiet_tick_bytes"] = quiet["quiet_delta_bytes"]
+        line["delta_full_snapshot_bytes"] = quiet["full_bytes"]
+        line["delta_quiet_tick_ratio"] = quiet["ratio"]
+
+
 def _merge_hub_fields(line: dict, measure_hub_merge) -> None:
     """Hub ingest/merge figures: the 64-worker shape is the BENCH
     trajectory's pinned number; 256 workers is the v5p-256
@@ -95,6 +118,7 @@ def _quick() -> int:
         line["hub_body_cache_hit_rate"] = hub["body_cache_hit_rate"]
         line["fleet_score_ms_per_refresh"] = hub.get(
             "fleet_score_ms_per_refresh")
+    _delta_fields(line)
     print(json.dumps(line))
     sys.stdout.flush()
     os._exit(0)
@@ -207,6 +231,7 @@ def main() -> int:
             "gc_max_pause_ms": simulated.get("gc_max_pause_ms"),
         }
     _merge_hub_fields(line, measure_hub_merge)
+    _delta_fields(line)
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or
     # PJRT atexit hook) blocked in native code; the JSON line is already

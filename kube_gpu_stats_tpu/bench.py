@@ -646,6 +646,174 @@ def build_slice_fixture(directory, workers: int = 64, chips: int = 4,
     return targets
 
 
+def build_leaf_rollup_snapshot(leaf: int, workers: int, duty: float,
+                               step_rate: float):
+    """One leaf hub's rollup exposition (the --rollups-only shape a
+    federation root ingests): slice_* aggregates plus per-worker step
+    rates and per-node target_up — workers-proportional cardinality,
+    exactly what rides the root's delta sessions."""
+    from . import schema
+    from .registry import SnapshotBuilder
+
+    builder = SnapshotBuilder()
+    slice_labels = (("slice", f"slice-{leaf:03d}"),)
+    for worker in range(workers):
+        builder.add(schema.HUB_TARGET_UP, 1.0,
+                    (("target", f"http://node-{leaf:03d}-{worker:03d}"
+                                f":9400/metrics"),))
+    builder.add(schema.HUB_CHIPS, float(workers * 4), slice_labels)
+    builder.add(schema.HUB_CHIPS_UP, float(workers * 4), slice_labels)
+    builder.add(schema.HUB_WORKERS, float(workers), slice_labels)
+    builder.add(schema.HUB_DUTY_MEAN, duty, slice_labels)
+    builder.add(schema.HUB_DUTY_MIN, duty - 2.0, slice_labels)
+    builder.add(schema.HUB_DUTY_MAX, duty + 2.0, slice_labels)
+    builder.add(schema.HUB_MEMORY_USED, 1.0e9 * workers, slice_labels)
+    builder.add(schema.HUB_MEMORY_TOTAL, 9.5e10 * workers, slice_labels)
+    builder.add(schema.HUB_POWER, 300.0 * workers, slice_labels)
+    for worker in range(workers):
+        builder.add(schema.HUB_WORKER_STEPS,
+                    step_rate + (worker % 7) * 0.01,
+                    slice_labels + (("worker", f"w{worker:03d}"),))
+    builder.add(schema.HUB_STRAGGLER_RATIO, 0.97, slice_labels)
+    return builder.build()
+
+
+def measure_delta_federation(leaves: int = 64, workers_per_leaf: int = 64,
+                             refreshes: int = 9) -> dict | None:
+    """Root-hub cost at fleet scale over the push-delta protocol
+    (ISSUE 7): `leaves` leaf hubs, each representing `workers_per_leaf`
+    workers, push rollup expositions into a federation root
+    (``--federate`` shape, push-only — no pull fetches at all):
+
+    - ``root_merge_p50_ms``: warm root refresh wall time (best spaced
+      round's median, timeit.repeat style like measure_hub_merge) while
+      every leaf's gauges churn every cycle — fetch/parse are gone from
+      the refresh; this is pure delta apply + plan replay + rollup.
+    - ``delta_ingest_ms_per_refresh``: mean wall time spent applying
+      one full wave of leaf delta frames (the HTTP-handler work, which
+      in production lands on POST threads between refreshes).
+    - ``delta_bytes_per_refresh``: compressed wire bytes of one wave of
+      churn deltas; ``full_bytes_total`` is what a pull (or resync
+      storm) would move instead.
+    - ``workers``: leaves * workers_per_leaf — the simulated fleet size.
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        from .delta import DeltaEncoder
+        from .hub import Hub
+
+        root = Hub([], targets_provider=lambda: [], interval=10.0,
+                   federate=True)
+        try:
+            encoders = []
+            full_bytes = 0
+            for leaf in range(leaves):
+                source = f"http://leaf-{leaf:03d}:9401/metrics"
+                encoder = DeltaEncoder(source, generation=leaf + 1)
+                body = build_leaf_rollup_snapshot(
+                    leaf, workers_per_leaf, 50.0, 4.0).render()
+                wire, _ = encoder.encode_next(body)
+                code, _resp = root.delta.handle(wire)
+                assert code == 200, code
+                encoder.ack()
+                full_bytes += len(wire)
+                encoders.append(encoder)
+            start = time.monotonic()
+            root.refresh_once()
+            cold_ms = (time.monotonic() - start) * 1000.0
+
+            def churn(round_no: int) -> tuple[float, int]:
+                """Push one wave of changed-gauge deltas; returns
+                (apply seconds, wire bytes)."""
+                apply_seconds = 0.0
+                nbytes = 0
+                for leaf, encoder in enumerate(encoders):
+                    body = build_leaf_rollup_snapshot(
+                        leaf, workers_per_leaf,
+                        50.0 + round_no + leaf * 0.01,
+                        4.0 + round_no * 0.1).render()
+                    wire, _ = encoder.encode_next(body)
+                    apply_start = time.monotonic()
+                    code, _resp = root.delta.handle(wire)
+                    apply_seconds += time.monotonic() - apply_start
+                    assert code == 200, code
+                    encoder.ack()
+                    nbytes += len(wire)
+                return apply_seconds, nbytes
+
+            warm = max(1, refreshes - 1)
+            n_rounds = min(3, warm)
+            medians = []
+            ingest_ms: list[float] = []
+            delta_bytes: list[int] = []
+            round_no = 0
+            for r in range(n_rounds):
+                size = warm // n_rounds + (1 if r < warm % n_rounds else 0)
+                walls = []
+                for _ in range(size):
+                    round_no += 1
+                    apply_seconds, nbytes = churn(round_no)
+                    ingest_ms.append(apply_seconds * 1000.0)
+                    delta_bytes.append(nbytes)
+                    start = time.monotonic()
+                    root.refresh_once()
+                    walls.append((time.monotonic() - start) * 1000.0)
+                if walls:
+                    medians.append(statistics.median(walls))
+                if r + 1 < n_rounds:
+                    time.sleep(0.1)
+            series_count = len(root.registry.snapshot().series)
+        finally:
+            root.stop()
+        return {
+            "workers": leaves * workers_per_leaf,
+            "leaves": leaves,
+            "root_merge_p50_ms": round(min(medians), 2),
+            "root_merge_cold_ms": round(cold_ms, 2),
+            "delta_ingest_ms_per_refresh": round(
+                statistics.median(ingest_ms), 2),
+            "delta_bytes_per_refresh": int(statistics.median(delta_bytes)),
+            "full_bytes_total": full_bytes,
+            "root_series": series_count,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
+def measure_quiet_tick_delta() -> dict | None:
+    """Publisher-side payload pin: one realistic worker exposition, one
+    quiet tick (two gauge twitches), FULL vs DELTA wire bytes — the
+    '>= 10x smaller' acceptance figure, measured not asserted."""
+    try:
+        import tempfile
+
+        from .delta import DeltaEncoder
+
+        with tempfile.TemporaryDirectory() as tmp:
+            target = build_slice_fixture(tmp, workers=1, chips=4)[0]
+            body = Path(target).read_text()
+        encoder = DeltaEncoder("bench-worker", generation=1)
+        wire_full, _ = encoder.encode_next(body)
+        encoder.ack()
+        # A quiet tick: the body is value-identical except one gauge.
+        lines = body.splitlines()
+        for i, line in enumerate(lines):
+            if line.startswith("accelerator_duty_cycle{") and '"0"' in line:
+                lines[i] = line.rsplit(" ", 1)[0] + " 51.5"
+                break
+        quiet = "\n".join(lines) + "\n"
+        wire_delta, _ = encoder.encode_next(quiet)
+        encoder.ack()
+        return {
+            "full_bytes": len(wire_full),
+            "quiet_delta_bytes": len(wire_delta),
+            "ratio": round(len(wire_full) / max(1, len(wire_delta)), 1),
+        }
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def measure_hub_merge(workers: int = 64, chips: int = 4,
                       refreshes: int = 9) -> dict | None:
     """Hub ingest+merge cost over a v5p-256-shaped slice

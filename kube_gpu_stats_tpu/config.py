@@ -87,6 +87,16 @@ class Config:
     slo_freshness_target: float = fleetlens.DEFAULT_FRESHNESS_TARGET
     slo_straggler_target: float = fleetlens.DEFAULT_STRAGGLER_TARGET
     slo_straggler_ratio: float = fleetlens.DEFAULT_STRAGGLER_RATIO
+    # Delta push (ISSUE 7): when hub_url is set, the daemon publishes
+    # seq-numbered changed-series deltas to that hub's /ingest/delta
+    # instead of waiting to be pull-scraped (the hub still pulls as the
+    # automatic fallback). hub_push_source is the identity the hub will
+    # list this node under — by convention the node's own scrape URL,
+    # so the hub's pull fallback lands on the right endpoint; empty
+    # derives it from the hostname and listen port at startup.
+    hub_url: str = ""
+    hub_push_source: str = ""
+    hub_push_interval: float = 1.0
 
     @property
     def textfile_enabled(self) -> bool:
@@ -183,6 +193,34 @@ def add_fleet_lens_flags(p: argparse.ArgumentParser) -> None:
                    help="minimum healthy slice_straggler_ratio (min/max "
                         "per-worker step rate); refreshes below it burn "
                         "the straggler error budget")
+
+
+def add_delta_push_flags(p: argparse.ArgumentParser) -> None:
+    """The delta-push publisher flag surface, shared by the daemon
+    parser (node -> hub) and `kube-tpu-stats hub` (leaf hub -> root hub
+    in a federation tree): one definition so spellings, KTS_* env vars
+    and defaults can never drift between the two CLIs."""
+    p.add_argument("--hub-url", default=_env("HUB_URL", ""),
+                   help="base URL of an upstream hub (e.g. "
+                        "http://hub:9401); when set, each published "
+                        "snapshot ships as a seq-numbered changed-series "
+                        "delta to <url>/ingest/delta — a quiet tick "
+                        "costs bytes proportional to churn, not chip "
+                        "count. Empty disables (the hub can still "
+                        "pull-scrape this exporter)")
+    p.add_argument("--hub-push-source",
+                   default=_env("HUB_PUSH_SOURCE", ""),
+                   help="identity the upstream hub lists this publisher "
+                        "under (its 'target'). Use this node's own "
+                        "scrape URL so the hub's automatic pull "
+                        "fallback hits the right endpoint when the push "
+                        "session goes stale; empty derives "
+                        "http://<hostname>:<listen-port>/metrics")
+    p.add_argument("--hub-push-interval", type=float,
+                   default=float(_env("HUB_PUSH_INTERVAL", "1.0")),
+                   help="minimum seconds between delta pushes (each "
+                        "push follows a snapshot publish; backs off "
+                        "under consecutive failures)")
 
 
 def validate_fleet_lens_args(args) -> str | None:
@@ -357,6 +395,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="hex sha256 of the basic-auth password (never the "
                         "plaintext)")
     add_fleet_lens_flags(p)
+    add_delta_push_flags(p)
     p.add_argument("--config", default=_env("CONFIG", ""),
                    help="YAML config file (keys = long flag names); "
                         "precedence: flags > KTS_* env > file > defaults")
@@ -487,6 +526,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
         parser.error(fleet_error)
+    if args.hub_push_interval <= 0:
+        parser.error("--hub-push-interval must be > 0 seconds")
     if bool(args.tls_cert_file) != bool(args.tls_key_file):
         parser.error("--tls-cert-file and --tls-key-file must be set together")
     if args.tls_client_ca_file and not args.tls_cert_file:
@@ -549,4 +590,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         slo_freshness_target=args.slo_freshness_target,
         slo_straggler_target=args.slo_straggler_target,
         slo_straggler_ratio=args.slo_straggler_ratio,
+        hub_url=args.hub_url,
+        hub_push_source=args.hub_push_source,
+        hub_push_interval=args.hub_push_interval,
     )

@@ -306,6 +306,26 @@ class Daemon:
                 extra_labels=cfg.remote_write_extra_labels,
                 render_stats=self.render_stats,
             )
+        # Delta push to an upstream hub (ISSUE 7): each published
+        # snapshot ships as a changed-series delta; the hub applies it
+        # without fetch or parse and still pull-scrapes us if the
+        # session goes stale. Source defaults to this node's own scrape
+        # URL so the hub's fallback pull lands here.
+        self.delta_pusher = None
+        if cfg.hub_url:
+            import socket
+
+            from .delta import DeltaPublisher
+
+            self.delta_pusher = DeltaPublisher(
+                self.registry, cfg.hub_url,
+                source=cfg.hub_push_source or (
+                    f"http://{socket.gethostname()}:"
+                    f"{cfg.listen_port}/metrics"),
+                min_interval=cfg.hub_push_interval,
+                render_stats=self.render_stats,
+                tracer=self.tracer,
+            )
 
     def _wire_tracer(self, collector) -> None:
         """Hand the flight recorder to a collector's transport (duck-
@@ -333,7 +353,9 @@ class Daemon:
         stats: dict[str, dict[str, int]] = {}
         for mode, sender in (("pushgateway", getattr(self, "pusher", None)),
                              ("remote_write",
-                              getattr(self, "remote_writer", None))):
+                              getattr(self, "remote_writer", None)),
+                             ("delta",
+                              getattr(self, "delta_pusher", None))):
             if sender is not None:
                 stats[mode] = {
                     "pushes": sender.pushes_total,
@@ -355,6 +377,8 @@ class Daemon:
             self.pusher.start()
         if self.remote_writer:
             self.remote_writer.start()
+        if self.delta_pusher:
+            self.delta_pusher.start()
         if self.upgrade_watcher:
             self.upgrade_watcher.start()
         self.poll.start()
@@ -370,6 +394,7 @@ class Daemon:
             ("attribution", self.attribution),
             ("pushgateway", self.pusher),
             ("remote_write", self.remote_writer),
+            ("delta_push", self.delta_pusher),
             ("textfile", self.textfile),
             ("procwatch", self.procwatch),
         ):
@@ -402,6 +427,8 @@ class Daemon:
             self.pusher.stop()
         if self.remote_writer:
             self.remote_writer.stop()
+        if self.delta_pusher:
+            self.delta_pusher.stop()
         self.server.stop()
         stopper = getattr(self.attribution, "stop", None)
         if stopper:

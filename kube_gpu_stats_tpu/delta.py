@@ -1,0 +1,573 @@
+"""Push-delta wire protocol — the hub's inverted hot edge (ISSUE 7).
+
+The pull architecture re-fetches and re-parses every worker's FULL
+exposition each hub refresh, so hub cost scales with chip count even
+when nothing changed. This module flips the edge: each publisher keeps
+the interned parse of its own exposition (the same
+``parse_exposition_interned`` series list the hub would have built from
+a scrape) and ships seq-numbered, generation-stamped **change-sets of
+series slots** — a quiet tick is a handful of (slot, value) pairs, bytes
+proportional to churn, not chip count. Hubs compose hierarchically over
+the same protocol: leaf hubs per slice push their rollup exposition to a
+root hub exactly like daemons push to a leaf.
+
+Protocol (one HTTP POST per frame to ``/ingest/delta``, snappy block
+compression like remote_write):
+
+- **FULL** frame: the complete rendered exposition text. Sent at session
+  start, after any series-shape change (device churn, stale-label flip —
+  values-only deltas keep slot indexing trivially exact), and whenever
+  the receiver demands a resync.
+- **DELTA** frame: (slot, value) pairs against the last acked state,
+  where slot = index into the series list of the last FULL's parse.
+  Labels never travel in a delta — a shape change is a FULL by
+  construction.
+- Receiver rules: a FULL is always accepted and replaces the session; a
+  DELTA must carry the session's generation and exactly seq+1, anything
+  else answers **409 resync** and the publisher responds with a FULL.
+  Any transport failure (timeout, 5xx, lost response) also promotes the
+  next frame to FULL — the publisher never has to reason about whether
+  an unacked delta landed.
+
+The encoder/ingest split keeps the protocol testable without sockets:
+:class:`DeltaEncoder` owns diffing + framing, :class:`DeltaPublisher`
+wraps it in the shared PublishFollower push scaffold (backoff, final
+flush, collector_push_* health counters), and :class:`DeltaIngest` owns
+the hub-side sessions the hub refresh drains into its ``_TargetCache``
+entries.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import time
+from typing import NamedTuple, Sequence
+
+from . import snappy
+from .validate import parse_exposition_interned
+from .workers import PublishFollower, push_opener
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"KTSD"
+VERSION = 1
+KIND_FULL = 0
+KIND_DELTA = 1
+
+INGEST_PATH = "/ingest/delta"
+CONTENT_TYPE = "application/x-kts-delta"
+
+# One frame may not decompress past this (a corrupt or hostile length
+# preamble must not balloon hub memory; a 4096-worker rollup exposition
+# is a few MB at most).
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+_F64 = struct.Struct("<d")
+
+
+class ResyncRequired(ValueError):
+    """The receiver cannot apply this delta frame; the publisher must
+    send a FULL snapshot (answered as HTTP 409)."""
+
+
+class Frame(NamedTuple):
+    kind: int
+    source: str
+    generation: int
+    seq: int
+    body: str | None                 # FULL frames
+    slots: tuple[int, ...]           # DELTA frames: changed slots +
+    values: tuple[float, ...]        # their new values (parallel)
+
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    value = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[pos]
+        pos += 1
+        value |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return value, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _header(kind: int, source: str, generation: int, seq: int) -> bytearray:
+    raw = bytearray(MAGIC)
+    raw.append(VERSION)
+    raw.append(kind)
+    encoded = source.encode()
+    raw += _varint(len(encoded))
+    raw += encoded
+    raw += _varint(generation)
+    raw += _varint(seq)
+    return raw
+
+
+def encode_full(source: str, generation: int, seq: int, body: str) -> bytes:
+    """One snappy-compressed FULL frame carrying the rendered exposition
+    text verbatim — the receiver parses it with the same interned
+    tokenizer the pull path uses, so push state can never diverge from
+    what a scrape of the same bytes would have produced."""
+    raw = _header(KIND_FULL, source, generation, seq)
+    encoded = body.encode()
+    raw += _varint(len(encoded))
+    raw += encoded
+    return snappy.compress(bytes(raw))
+
+
+def encode_delta(source: str, generation: int, seq: int,
+                 changes: Sequence[tuple[int, float]]) -> bytes:
+    """One snappy-compressed DELTA frame: ascending (slot, value) pairs,
+    slots gap-encoded (varint deltas) so a sparse change-set over a
+    large series list stays a couple of bytes per slot."""
+    raw = _header(KIND_DELTA, source, generation, seq)
+    raw += _varint(len(changes))
+    prev = 0
+    for slot, value in changes:
+        if slot < prev:
+            raise ValueError("delta slots must be ascending")
+        raw += _varint(slot - prev)
+        prev = slot
+        raw += _F64.pack(value)
+    return snappy.compress(bytes(raw))
+
+
+def _declared_size(wire: bytes) -> int:
+    """The snappy block preamble (uncompressed-length varint) read
+    straight off the compressed stream — so a hostile frame declaring
+    gigabytes is rejected BEFORE any decompression work happens, not
+    after the bomb has expanded."""
+    value = 0
+    shift = 0
+    for pos in range(min(len(wire), 6)):
+        byte = wire[pos]
+        value |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return value
+        shift += 7
+    raise ValueError("truncated snappy preamble")
+
+
+def decode_frame(wire: bytes) -> Frame:
+    """Strict decode of one wire frame; raises ValueError on anything
+    malformed (the ingest answers 400, never crashes the hub)."""
+    if _declared_size(wire) > MAX_FRAME_BYTES:
+        raise ValueError("frame exceeds the size cap")
+    data = snappy.decompress(wire)
+    if data[:4] != MAGIC:
+        raise ValueError("bad magic")
+    if len(data) < 6:
+        raise ValueError("truncated header")
+    if data[4] != VERSION:
+        raise ValueError(f"unsupported version {data[4]}")
+    kind = data[5]
+    if kind not in (KIND_FULL, KIND_DELTA):
+        raise ValueError(f"unknown frame kind {kind}")
+    pos = 6
+    n, pos = _read_varint(data, pos)
+    if pos + n > len(data):
+        raise ValueError("truncated source")
+    source = data[pos:pos + n].decode()
+    if not source:
+        raise ValueError("empty source")
+    pos += n
+    generation, pos = _read_varint(data, pos)
+    seq, pos = _read_varint(data, pos)
+    if kind == KIND_FULL:
+        n, pos = _read_varint(data, pos)
+        if pos + n != len(data):
+            raise ValueError("full-frame body length mismatch")
+        return Frame(kind, source, generation, seq,
+                     data[pos:pos + n].decode(), (), ())
+    count, pos = _read_varint(data, pos)
+    slots = []
+    values = []
+    slot = 0
+    for i in range(count):
+        gap, pos = _read_varint(data, pos)
+        slot = slot + gap if i else gap
+        if pos + 8 > len(data):
+            raise ValueError("truncated delta value")
+        slots.append(slot)
+        values.append(_F64.unpack_from(data, pos)[0])
+        pos += 8
+    if pos != len(data):
+        raise ValueError("trailing bytes after delta changes")
+    return Frame(kind, source, generation, seq, None,
+                 tuple(slots), tuple(values))
+
+
+def new_generation() -> int:
+    """Process-unique, restart-unique session generation. Collision odds
+    across a restart are what matter (a reused generation could splice a
+    new process's deltas onto old slots) — wall nanoseconds xor pid is
+    plenty for that."""
+    return ((time.time_ns() ^ (os.getpid() << 40)) & ((1 << 62) - 1)) or 1
+
+
+class DeltaEncoder:
+    """Publisher-side session state: diff the current exposition against
+    the last ACKED state and emit the cheapest correct frame. Transport-
+    agnostic (the tests drive it with injected drops/reorders/restarts;
+    DeltaPublisher adds HTTP)."""
+
+    def __init__(self, source: str, generation: int | None = None) -> None:
+        self.source = source
+        self.generation = (generation if generation is not None
+                           else new_generation())
+        self.seq = 0
+        self._keys: list | None = None    # acked (name, labels) per slot
+        self._values: list | None = None  # acked value per slot
+        self._pending: tuple | None = None
+        self._need_full = True
+        self.full_frames = 0
+        self.delta_frames = 0
+
+    def encode_next(self, body: str) -> tuple[bytes, int]:
+        """(wire frame, kind) advancing the session to seq+1. The caller
+        must follow with ack() (receiver applied it) or nack() (anything
+        else) before encoding again."""
+        series = parse_exposition_interned(body)
+        keys = [(name, labels) for name, labels, _ in series]
+        values = [value for _, _, value in series]
+        seq = self.seq + 1
+        if self._need_full or keys != self._keys:
+            # Shape changed (or never synced): values-only deltas can't
+            # express it, and a FULL re-anchors slot indexing exactly.
+            # The key compare is pointer-cheap: names and label tuples
+            # come interned from the shared parse pools.
+            wire = encode_full(self.source, self.generation, seq, body)
+            kind = KIND_FULL
+        else:
+            changes = [(i, v) for i, v in enumerate(values)
+                       if v != self._values[i]]
+            wire = encode_delta(self.source, self.generation, seq, changes)
+            kind = KIND_DELTA
+        self._pending = (keys, values, kind)
+        return wire, kind
+
+    def ack(self) -> None:
+        keys, values, kind = self._pending
+        self.seq += 1
+        self._keys = keys
+        self._values = values
+        self._need_full = False
+        if kind == KIND_FULL:
+            self.full_frames += 1
+        else:
+            self.delta_frames += 1
+
+    def nack(self) -> None:
+        """The frame may or may not have been applied (timeout, lost
+        response, 409): the only safe resumption is a FULL — the
+        receiver accepts one unconditionally."""
+        self._need_full = True
+
+
+class DeltaPublisher(PublishFollower):
+    """Publish-following delta push loop: on each registry publish,
+    render (a per-generation cache hit — the scrape path pre-warms it),
+    parse our own exposition, and POST the diff to the hub's ingest
+    endpoint. Runs on daemons (node -> leaf hub) and on leaf hubs
+    (leaf -> root) unchanged — the registry is the only dependency.
+
+    Shipping health rides the standard collector_push_* counters
+    (mode="delta"); resyncs_total counts 409-forced FULL resends."""
+
+    def __init__(self, registry, url: str, *, source: str,
+                 min_interval: float = 1.0, timeout: float = 5.0,
+                 headers_provider=None, render_stats=None, tracer=None,
+                 generation: int | None = None) -> None:
+        super().__init__(registry, min_interval, thread_name="delta-push")
+        self._url = url.rstrip("/") + INGEST_PATH
+        self._timeout = timeout
+        self._headers_provider = headers_provider
+        self._render_stats = render_stats
+        self._tracer = tracer
+        self._encoder = DeltaEncoder(source, generation)
+        self.resyncs_total = 0
+        self.last_frame_bytes = 0
+        self.last_frame_kind: int | None = None
+
+    @property
+    def source(self) -> str:
+        return self._encoder.source
+
+    def _post(self, wire: bytes) -> str:
+        """'ok' | 'resync' | 'error' for one frame POST."""
+        import urllib.error
+        import urllib.request
+
+        headers = {"Content-Type": CONTENT_TYPE,
+                   "User-Agent": "kube-tpu-stats"}
+        if self._headers_provider is not None:
+            headers.update(self._headers_provider() or {})
+        request = urllib.request.Request(
+            self._url, data=wire, method="POST", headers=headers)
+        try:
+            # No-redirect opener, like every push sender: a 302 must be
+            # a visible failure, not a silently body-less GET.
+            with push_opener().open(request, timeout=self._timeout):
+                return "ok"
+        except urllib.error.HTTPError as exc:
+            if exc.code == 409:
+                return "resync"
+            log.warning("delta push rejected (HTTP %d)", exc.code)
+            return "error"
+        except Exception as exc:  # noqa: BLE001 - transport failure
+            log.warning("delta push failed: %s", exc)
+            return "error"
+
+    def push_once(self) -> None:
+        serialize_start = time.monotonic()
+        body, _ = self._registry.rendered()
+        if not body:
+            return
+        encoder = self._encoder
+        wire, kind = encoder.encode_next(body.decode())
+        # Diff+encode cost only — measured BEFORE the POST like every
+        # other render site (remote_write serializes, then sends); a
+        # slow hub must not masquerade as serialization cost.
+        serialize_seconds = time.monotonic() - serialize_start
+        outcome = self._post(wire)
+        if outcome == "resync":
+            # The hub lost (or never had) our session — restarted hub,
+            # evicted source, seq gap after our own failed send. Recover
+            # inside this push: one FULL, not one more interval of gap.
+            self.resyncs_total += 1
+            encoder.nack()
+            if self._tracer is not None:
+                self._tracer.event(
+                    "delta_resync",
+                    f"{encoder.source}: hub demanded resync; sending full "
+                    f"snapshot", source=encoder.source)
+            wire, kind = encoder.encode_next(body.decode())
+            outcome = self._post(wire)
+        if outcome == "ok":
+            encoder.ack()
+            self.consecutive_failures = 0
+            self.pushes_total += 1
+            self.last_frame_bytes = len(wire)
+            self.last_frame_kind = kind
+            if self._render_stats is not None:
+                # The push path's render-equivalent accounting: bytes on
+                # the wire per frame and the serialize+diff cost, shared
+                # with the scrape/textfile/remote-write surfaces.
+                self._render_stats.observe(
+                    "delta", serialize_seconds, len(wire))
+        else:
+            encoder.nack()
+            self.consecutive_failures += 1
+            self.failures_total += 1
+
+
+class _Session:
+    """One source's receiver-side protocol state (generation + seq chain
+    + freshness). The SERIES state lives on the hub's ingest-cache entry
+    — frames apply straight onto it at POST time, so the refresh thread
+    pays replay, never apply."""
+
+    __slots__ = ("source", "generation", "seq", "last_monotonic", "frames")
+
+    def __init__(self, source: str) -> None:
+        self.source = source
+        self.generation = 0
+        self.seq = 0
+        self.last_monotonic = 0.0
+        self.frames = 0
+
+
+class DeltaIngest:
+    """Hub-side receiver for the push protocol.
+
+    HTTP POST threads call :meth:`handle`/:meth:`apply`, which validate
+    the seq chain and apply the frame DIRECTLY onto the hub's ingest
+    entry (``entry_factory``/``entry_store`` are injected by the hub:
+    a FULL builds a fresh pushed entry from the parsed body, a DELTA
+    calls the entry's ``apply_patch``). That puts the apply cost on the
+    POST threads — spread over the refresh interval, exactly where the
+    pull path's parse cost used to overlap the fetch phase — so the
+    refresh itself only replays ready entries. The refresh thread calls
+    :meth:`fresh_sources` to learn which targets are push-served this
+    cycle, :meth:`sources` to merge live push sources into the target
+    list, and :meth:`evict` on churn.
+
+    Concurrency: the ingest lock serializes frame applies; the refresh
+    thread reads entries without it. A patch landing mid-refresh can
+    hand that one refresh a mix of two adjacent frames' values for ONE
+    target (each slot individually consistent) — the next refresh sees
+    the settled state, the same freshness contract a pull of a
+    mid-write textfile target has always had."""
+
+    def __init__(self, tracer=None, expiry: float = 60.0,
+                 entry_factory=None, entry_store=None) -> None:
+        self._lock = threading.Lock()
+        self._sessions: dict[str, _Session] = {}
+        self._tracer = tracer
+        self._expiry = expiry
+        # Injected by the hub (delta.py must not import hub.py):
+        # entry_factory(series_list) -> pushed ingest entry;
+        # entry_store is the hub's target -> entry mapping.
+        self._entry_factory = entry_factory
+        self._entry_store = entry_store if entry_store is not None else {}
+        self.full_frames_total = 0
+        self.delta_frames_total = 0
+        self.bytes_total = 0
+        self.resyncs_total = 0
+
+    # -- write side (HTTP POST threads) --------------------------------------
+
+    def handle(self, wire: bytes) -> tuple[int, bytes]:
+        """HTTP-facing apply: (status code, response body). 200 applied,
+        409 resync required, 400 malformed — the three-way contract the
+        publisher keys on."""
+        try:
+            frame = decode_frame(wire)
+        except ValueError as exc:
+            return 400, f"bad delta frame: {exc}\n".encode()
+        try:
+            self.apply(frame, len(wire))
+        except ResyncRequired as exc:
+            return 409, f"resync required: {exc}\n".encode()
+        except ValueError as exc:  # unparseable FULL body
+            return 400, f"bad delta frame: {exc}\n".encode()
+        return 200, b"ok\n"
+
+    def _resync(self, source: str, reason: str) -> ResyncRequired:
+        self.resyncs_total += 1
+        if self._tracer is not None:
+            self._tracer.event("delta_resync", f"{source}: {reason}",
+                               source=source)
+        return ResyncRequired(reason)
+
+    def apply(self, frame: Frame, nbytes: int) -> None:
+        # The expensive halves of a FULL — tokenizing the body and
+        # building the entry's derived views — run BEFORE the lock: a
+        # resync storm (every publisher re-POSTing a FULL after a hub
+        # restart) must not convoy N handler threads behind one
+        # multi-millisecond parse.
+        entry = None
+        if frame.kind == KIND_FULL:
+            series = parse_exposition_interned(frame.body)
+            if self._entry_factory is not None:
+                entry = self._entry_factory(series)
+        with self._lock:
+            self.bytes_total += nbytes
+            session = self._sessions.get(frame.source)
+            if frame.kind == KIND_FULL:
+                if session is None:
+                    session = _Session(frame.source)
+                    self._sessions[frame.source] = session
+                elif session.generation not in (0, frame.generation):
+                    # A worker restarted with a new generation: the FULL
+                    # replaces everything, but journal the restart — the
+                    # stale seq chain dies HERE, visibly.
+                    if self._tracer is not None:
+                        self._tracer.event(
+                            "delta_restart",
+                            f"{frame.source}: new generation "
+                            f"{frame.generation} (was {session.generation})",
+                            source=frame.source)
+                session.generation = frame.generation
+                session.seq = frame.seq
+                session.last_monotonic = time.monotonic()
+                session.frames += 1
+                self.full_frames_total += 1
+                if entry is not None:
+                    self._entry_store[frame.source] = entry
+                return
+            if session is None:
+                raise self._resync(
+                    frame.source,
+                    "no session state (hub restarted or source evicted)")
+            entry = self._entry_store.get(frame.source)
+            if (entry is None or not getattr(entry, "pushed", False)
+                    or entry.series is None):
+                # The entry fell out from under the session (evicted on
+                # churn, or a pull fallback replaced it): only a FULL
+                # can re-anchor slot indexing.
+                raise self._resync(
+                    frame.source,
+                    "no ingest entry for this session (evicted or "
+                    "replaced by a pull)")
+            if frame.generation != session.generation:
+                raise self._resync(
+                    frame.source,
+                    f"generation mismatch (session {session.generation}, "
+                    f"frame {frame.generation})")
+            if frame.seq != session.seq + 1:
+                raise self._resync(
+                    frame.source,
+                    f"seq gap (session at {session.seq}, frame {frame.seq})")
+            n = len(entry.series)
+            for slot in frame.slots:
+                if slot >= n:
+                    raise self._resync(
+                        frame.source, f"slot {slot} out of range ({n})")
+            entry.apply_patch(frame.slots, frame.values, frame.source)
+            session.seq = frame.seq
+            session.last_monotonic = time.monotonic()
+            session.frames += 1
+            self.delta_frames_total += 1
+
+    # -- read side (hub refresh thread) --------------------------------------
+
+    def sources(self) -> list[str]:
+        """Live push sources (insertion order — stable for the target
+        merge), dropping sessions silent past the expiry window so a
+        decommissioned worker eventually leaves the target list."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [s for s, session in self._sessions.items()
+                    if now - session.last_monotonic > self._expiry]
+            for source in dead:
+                del self._sessions[source]
+            return list(self._sessions)
+
+    def fresh_sources(self, fence: float) -> list[str]:
+        """Sources whose session produced a frame within ``fence``
+        seconds — the targets this refresh serves from push state.
+        Everything else falls through to the pull path."""
+        now = time.monotonic()
+        with self._lock:
+            return [source for source, session in self._sessions.items()
+                    if now - session.last_monotonic <= fence]
+
+    def evict(self, alive: set) -> None:
+        """Drop sessions for departed targets on the same refresh path
+        that evicts their _TargetCache entries — a worker restarting
+        behind a churned target list must start from a FULL resync, not
+        a stale seq chain (ISSUE 7 satellite)."""
+        with self._lock:
+            for source in [s for s in self._sessions if s not in alive]:
+                del self._sessions[source]
+
+    def stats(self) -> dict[str, float]:
+        with self._lock:
+            return {
+                "full_frames": self.full_frames_total,
+                "delta_frames": self.delta_frames_total,
+                "bytes": self.bytes_total,
+                "resyncs": self.resyncs_total,
+                "sessions": len(self._sessions),
+            }

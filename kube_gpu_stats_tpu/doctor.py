@@ -25,7 +25,12 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  phase and blame, every anomalous target with its
                  anomaly kinds, and the SLO burn windows. Uses the
                  --url target's server when it is http(s), else a
-                 local hub on port 9401.
+                 local hub on port 9401. Against a FEDERATION ROOT
+                 (--federate hub over leaf hubs), the check walks the
+                 tree: every target that itself answers /debug/fleet
+                 is a leaf hub, and its own post-mortem (guilty node,
+                 worst phase, blamed port) is folded into the verdict
+                 — root -> leaf -> node in one command.
 
 Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
 2 = usage error. Every probe is time-bounded; doctor never hangs on a
@@ -730,6 +735,29 @@ def check_fleet(base: str) -> CheckResult:
             f"no targets scored yet (refresh seq "
             f"{payload.get('seq', 0)}); is the hub refreshing?")
     status, detail, data = fleet_post_mortem(payload)
+    # Federation walk (ISSUE 7): any target that itself serves
+    # /debug/fleet is a leaf HUB — descend one level and fold its slice
+    # post-mortem in, so a root-hub doctor names the guilty NODE, not
+    # just the guilty leaf. Bounded: at most 8 probes, each with the
+    # same short fetch timeout; daemons (no /debug/fleet) just 404 out
+    # of the walk.
+    leaves: dict[str, str] = {}
+    for target in sorted(payload.get("targets") or {})[:8]:
+        if "://" not in target:
+            continue  # .prom file targets can't be hubs
+        try:
+            sub = _fetch_json(trace_base(target) + "/debug/fleet")
+        except Exception:  # noqa: BLE001 - a daemon or a dead leaf
+            continue
+        if not isinstance(sub, dict) or not sub.get("targets"):
+            continue
+        sub_status, sub_detail, sub_data = fleet_post_mortem(sub)
+        if _ORDER[sub_status] < _ORDER[status]:
+            status = sub_status
+        leaves[target] = sub_detail
+        data.setdefault("leaves", {})[target] = sub_data
+    for target, sub_detail in leaves.items():
+        detail += f" | leaf {target}: {sub_detail}"
     return _result("fleet", status, detail, data=data)
 
 

@@ -154,10 +154,30 @@ class MetricsServer:
                  max_concurrent_scrapes: int = 16,
                  render_stats: RenderStats | None = None,
                  ready_check=None, health_provider=None,
-                 trace_provider=None, fleet_provider=None):
+                 trace_provider=None, fleet_provider=None,
+                 ingest_provider=None, prewarm_renders: bool = True):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
+        # Delta-push ingest (delta.DeltaIngest.handle, duck-typed:
+        # bytes -> (status, body)): serves POST /ingest/delta behind the
+        # same auth gate as /metrics. None = POSTs answer 404 (daemons
+        # and bare test servers don't ingest).
+        self._ingest = ingest_provider
+        # Render pre-warmer (scrape-regression fix, ISSUE 7 satellite):
+        # a publish-following thread fills the per-generation render
+        # cache (text + gzip) the moment a snapshot lands, so a scrape
+        # serves pre-rendered, pre-gzipped bytes instead of paying the
+        # render inline — which, with pipelined ticks, contended with
+        # the background fetch wave and regressed scrape_p50 from
+        # ~1.5 ms to ~24 ms (BENCH_r06). Off the scrape path, on for
+        # every server unless the registry can't signal publishes.
+        self._prewarm = (prewarm_renders
+                         and callable(getattr(registry,
+                                              "wait_for_publish", None))
+                         and hasattr(registry, "generation"))
+        self._warm_stop = threading.Event()
+        self._warm_thread: threading.Thread | None = None
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -250,6 +270,38 @@ class MetricsServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if outer._auth is not None and not self._authorized():
+                    self._send_plain(
+                        401, b"unauthorized\n",
+                        {"WWW-Authenticate":
+                         'Basic realm="kube-tpu-stats"'})
+                    return
+                if path != "/ingest/delta" or outer._ingest is None:
+                    self._send_plain(404, b"not found\n")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    length = -1
+                # Cap the COMPRESSED read; the decoder separately bounds
+                # the decompressed size (delta.MAX_FRAME_BYTES).
+                if length <= 0 or length > 64 * 1024 * 1024:
+                    self._send_plain(
+                        413, b"delta frame missing or oversized\n")
+                    return
+                wire = self.rfile.read(length)
+                try:
+                    code, body = outer._ingest(wire)
+                except Exception:  # noqa: BLE001 - a frame must not
+                    # kill the connection thread with a stack trace as
+                    # the only evidence; the publisher sees a 500 and
+                    # resyncs.
+                    log.exception("delta ingest crashed")
+                    code, body = 500, b"ingest error\n"
+                self._send_plain(code, body)
 
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0]
@@ -520,17 +572,42 @@ class MetricsServer:
         """Actual bound port (useful when constructed with port 0 in tests)."""
         return self._server.server_address[1]
 
+    def _warm_loop(self) -> None:
+        """Fill the per-generation render cache right behind each
+        publish: one render + one gzip per generation, charged to this
+        thread instead of the first scrape. Failures are contained — a
+        render bug must surface on the scrape path (with a client
+        attached), not kill the warmer silently."""
+        generation = -1
+        while not self._warm_stop.is_set():
+            current = self._registry.generation
+            if current != generation:
+                generation = current
+                try:
+                    self._registry.rendered()
+                    self._registry.rendered(gzip_level=3)
+                except Exception:  # noqa: BLE001
+                    log.debug("render prewarm failed", exc_info=True)
+            self._registry.wait_for_publish(generation, timeout=0.5)
+
     def start(self) -> None:
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="metrics-http", daemon=True
         )
         self._thread.start()
+        if self._prewarm:
+            self._warm_thread = threading.Thread(
+                target=self._warm_loop, name="render-warmer", daemon=True)
+            self._warm_thread.start()
 
     def stop(self) -> None:
+        self._warm_stop.set()
         self._server.shutdown()
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._warm_thread:
+            self._warm_thread.join(timeout=5)
 
 
 class PushgatewayPusher(PublishFollower):

@@ -41,11 +41,13 @@ import threading
 import time
 from typing import Mapping, Sequence
 
+from . import delta as delta_mod
 from . import fleetlens, procstats, schema
 from .registry import (HistogramState, Registry, Series, SnapshotBuilder,
                        contribute_push_stats)
 from .resilience import CircuitBreaker
-from .top import ChipRow, Frame, fold_target
+from .top import (_COUNTER_BY_NAME, _GAUGE_BY_NAME, ChipRow, Frame,
+                  fold_target)
 from .tracing import Tracer, log_every
 from .validate import (bounded_memo, fetch_exposition,
                        parse_exposition_interned)
@@ -66,6 +68,22 @@ PER_CHIP_SPECS: dict[str, schema.MetricSpec] = {
 # summing per-bucket cumulative counts across targets is exact).
 HIST_SPECS: dict[str, schema.MetricSpec] = {
     m.name: m for m in schema.WORKLOAD_HISTOGRAMS
+}
+
+# Slice-rollup families a FEDERATION root re-exports verbatim from its
+# leaf-hub targets (--federate): every family here is dimensioned by a
+# leaf-owned label (slice / target / worker), so series from different
+# leaves are disjoint by construction and compose under the same
+# first-wins dedup as per-chip series. Unlabeled hub families
+# (slice_targets, slice_workers_expected, slice_duplicate_series) and
+# the kts_*/hub_* self families stay leaf-local — they carry no leaf
+# identity and would collide at the root.
+FEDERATED_SPECS: dict[str, schema.MetricSpec] = {
+    m.name: m
+    for m in schema.HUB_METRICS
+    if m.type is not schema.MetricType.HISTOGRAM
+    and ({"slice", "target"} & set(m.extra_labels))
+    and not m.name.startswith("kts_")
 }
 
 DEFAULT_PORT = 9401
@@ -101,6 +119,20 @@ for _fam in HIST_SPECS:
     _HIST_SUFFIXES[_fam + "_sum"] = (_fam, "sum")
     _HIST_SUFFIXES[_fam + "_count"] = (_fam, "count")
 del _fam
+
+# Families feeding the cached fleet digest (fleetlens.digest_from_series):
+# a delta touching one of these invalidates the digest, nothing else does.
+_DIGEST_PHASE = schema.TICK_PHASE_SECONDS.name
+_DIGEST_SLOWEST = schema.SLOWEST_TICK_SECONDS.name
+
+# Compiled patch-action kinds (_TargetCache._compile_patch): what a
+# delta to a given slot must touch beyond the series views and plans.
+_PATCH_PLAIN = 0    # nothing derived consumes this family's value
+_PATCH_ROW = 1      # frame-fold ChipRow gauge/counter column
+_PATCH_ICI = 2      # frame-fold ChipRow summed ICI rate
+_PATCH_ROLLUP = 3   # frame-fold slice_* rollup cell
+_PATCH_HIST = 4     # drop the cached histogram fold
+_PATCH_DIGEST = 5   # drop the cached fleet digest
 
 
 class _TargetCache:
@@ -144,11 +176,14 @@ class _TargetCache:
     which is a GIL-atomic dict store."""
 
     __slots__ = ("body", "body_hash", "series", "series_dicts",
-                 "chip_plan", "hist_local", "frame_rows", "frame_rollups",
-                 "fleet_digest", "stat_sig")
+                 "chip_plan", "rollup_plan", "hist_local", "frame_rows",
+                 "frame_rollups", "fleet_digest", "stat_sig", "pushed",
+                 "wants_rollup", "patch_actions")
 
     def __init__(self, body: str, series: list,
-                 stat_sig: tuple | None = None) -> None:
+                 stat_sig: tuple | None = None,
+                 pushed: bool = False,
+                 wants_rollup: bool = False) -> None:
         self.body = body
         self.body_hash = hash(body)
         self.series = series
@@ -156,7 +191,11 @@ class _TargetCache:
         # and doing it here means a body-cache hit skips even that.
         self.series_dicts = [(name, dict(labels), value)
                              for name, labels, value in series]
-        self.chip_plan: list | None = None
+        self.chip_plan: tuple | None = None
+        # Federation-root re-export plan (slice_* families from a leaf
+        # hub target) — same shape as chip_plan, built only under
+        # --federate.
+        self.rollup_plan: tuple | None = None
         self.hist_local: dict | None = None
         self.frame_rows: dict[tuple, ChipRow] | None = None
         self.frame_rollups: dict[tuple, float] | None = None
@@ -165,6 +204,139 @@ class _TargetCache:
         # replays it with zero re-extraction.
         self.fleet_digest: dict | None = None
         self.stat_sig = stat_sig
+        # Delta-push entries (ISSUE 7): series/series_dicts stay
+        # resident (they ARE the session state deltas patch), body is
+        # synthetic, and refresh_once's parse-view drop skips them.
+        self.pushed = pushed
+        # True on a --federate hub: this entry will also carry a
+        # rollup_plan, so compiled patch actions must not be cached
+        # until BOTH plans exist (a -1 rollup index frozen in while the
+        # refresh thread was still building the rollup plan would
+        # permanently stop patching that slot's re-exported series).
+        self.wants_rollup = wants_rollup
+        # Per-slot compiled patch actions (lazy): a slot's name/labels
+        # are fixed for the entry's life (shape changes arrive as full
+        # replacements), so which fold a value change feeds — and under
+        # which pre-sorted key — is computed once, not per delta.
+        self.patch_actions: dict[int, tuple] = {}
+
+    def apply_patch(self, slots, values, target: str) -> None:
+        """Apply delta (slot, value) changes in place: the series views,
+        any built merge plans, AND the cached frame fold are patched
+        slot-wise (labels never change in a delta — shape changes
+        arrive as full replacements), so the per-refresh cost of an
+        active push target is proportional to its churn, not its series
+        count. Only the folds a change actually feeds are touched: a
+        histogram slot drops the cached histogram fold, a trace-digest
+        slot drops the cached fleet digest, and accelerator_*/slice_*
+        slots update the pristine cached ChipRow/rollup entries
+        directly — the same values a full refold would compute
+        (differential-pinned against the pull-merge oracle)."""
+        series = self.series
+        dicts = self.series_dicts
+        actions = self.patch_actions
+        actions_get = actions.get
+        chip_plan = self.chip_plan
+        rollup_plan = self.rollup_plan
+        chip_pairs = chip_plan[1] if chip_plan is not None else None
+        rollup_pairs = rollup_plan[1] if rollup_plan is not None else None
+        for slot, value in zip(slots, values):
+            action = actions_get(slot)
+            if action is None:
+                action = self._compile_patch(slot, target)
+            entry_tuple = series[slot]
+            series[slot] = (entry_tuple[0], entry_tuple[1], value)
+            dict_entry = dicts[slot]
+            dicts[slot] = (dict_entry[0], dict_entry[1], value)
+            kind, fold_key, column, chip_index, rollup_index = action
+            if chip_index >= 0 and chip_pairs is not None:
+                pair = chip_pairs[chip_index]
+                pair_series = pair[1]
+                chip_pairs[chip_index] = (
+                    pair[0],
+                    Series(pair_series.spec, pair_series.labels, value))
+            if rollup_index >= 0 and rollup_pairs is not None:
+                pair = rollup_pairs[rollup_index]
+                pair_series = pair[1]
+                rollup_pairs[rollup_index] = (
+                    pair[0],
+                    Series(pair_series.spec, pair_series.labels, value))
+            if kind == _PATCH_PLAIN:
+                continue
+            if kind == _PATCH_ROLLUP:
+                if self.frame_rollups is not None:
+                    self.frame_rollups[fold_key] = value
+                continue
+            if kind == _PATCH_HIST:
+                self.hist_local = None
+                continue
+            if kind == _PATCH_DIGEST:
+                self.fleet_digest = None
+                continue
+            rows = self.frame_rows
+            if rows is None:
+                continue
+            row = rows.get(fold_key)
+            if row is None:
+                # A folded family with no row would mean the fold and
+                # the series disagree about shape — refold lazily.
+                self.frame_rows = None
+                self.frame_rollups = None
+            elif kind == _PATCH_ICI:
+                # Per-link rates SUM into the row; patch by the delta
+                # against the old value (exact: the old value is this
+                # slot's prior contribution).
+                row.ici_bps += value - entry_tuple[2]
+            else:
+                setattr(row, column, value)
+
+    def _compile_patch(self, slot: int, target: str) -> tuple:
+        """(kind, fold key, row column, chip-plan pair index,
+        rollup-plan pair index) for one slot — which caches a value
+        change feeds, with lookup keys and plan positions pre-resolved
+        (the per-delta sorted-labels key build was the hot line of the
+        4096-worker root refresh before this memo). Cached on the entry
+        only once both relevant plans exist: pair positions are
+        deterministic for a fixed series shape, so a rebuilt plan lands
+        the same indices."""
+        name = self.series[slot][0]
+        label_dict = self.series_dicts[slot][1]
+        chip_index = (self.chip_plan[3].get(slot, -1)
+                      if self.chip_plan is not None else -1)
+        rollup_index = (self.rollup_plan[3].get(slot, -1)
+                        if self.rollup_plan is not None else -1)
+        if name in _HIST_SUFFIXES:
+            action = (_PATCH_HIST, None, None, chip_index, rollup_index)
+        elif name == _DIGEST_PHASE or name == _DIGEST_SLOWEST:
+            action = (_PATCH_DIGEST, None, None, chip_index, rollup_index)
+        elif name.startswith("slice_"):
+            action = (_PATCH_ROLLUP,
+                      (target, name, tuple(sorted(label_dict.items()))),
+                      None, chip_index, rollup_index)
+        elif name.startswith("accelerator_"):
+            row_key = (target, label_dict.get("slice", ""),
+                       label_dict.get("worker", ""),
+                       label_dict.get("chip", ""))
+            column = _GAUGE_BY_NAME.get(name)
+            counter = _COUNTER_BY_NAME.get(name)
+            if column is not None:
+                action = (_PATCH_ROW, row_key, column,
+                          chip_index, rollup_index)
+            elif counter is not None:
+                action = (_PATCH_ROW, row_key, f"{counter}_total",
+                          chip_index, rollup_index)
+            elif name == schema.ICI_BANDWIDTH.name:
+                action = (_PATCH_ICI, row_key, None,
+                          chip_index, rollup_index)
+            else:
+                action = (_PATCH_PLAIN, None, None,
+                          chip_index, rollup_index)
+        else:
+            action = (_PATCH_PLAIN, None, None, chip_index, rollup_index)
+        if self.chip_plan is not None and (
+                self.rollup_plan is not None or not self.wants_rollup):
+            self.patch_actions[slot] = action
+        return action
 
 
 class Hub:
@@ -192,8 +364,11 @@ class Hub:
                  slo_straggler_target: float =
                  fleetlens.DEFAULT_STRAGGLER_TARGET,
                  slo_straggler_ratio: float =
-                 fleetlens.DEFAULT_STRAGGLER_RATIO) -> None:
-        if not targets and targets_provider is None:
+                 fleetlens.DEFAULT_STRAGGLER_RATIO,
+                 delta_ingest: bool = True,
+                 push_fence: float | None = None,
+                 federate: bool = False) -> None:
+        if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
         # --targets-file overlap) would emit duplicate slice_target_up
@@ -202,6 +377,21 @@ class Hub:
         if len(self._targets) < len(targets):
             log.warning("hub: %d duplicate target(s) dropped",
                         len(targets) - len(self._targets))
+        # The CONFIGURED list (static flags or last provider result):
+        # push sources join the effective target list on top of it each
+        # refresh, so a push-only fleet needs no target config at all.
+        self._configured = list(self._targets)
+        # Federation root (--federate): targets are leaf hubs — their
+        # slice_* rollup series (FEDERATED_SPECS) are re-exported
+        # alongside any per-chip series, so a root hub serves the whole
+        # tree's slices in one exposition.
+        self._federate = federate
+        # A push session older than the fence is not trusted for this
+        # refresh: the target falls back to pull-scrape automatically
+        # (mixed fleets and old daemons keep working), and a session
+        # silent past the ingest expiry leaves the target list.
+        self._push_fence = (push_fence if push_fence is not None
+                            else max(3.0 * interval, 3.0))
         # Dynamic discovery (DNS over a headless Service): called at the
         # top of each refresh; returned targets REPLACE the static list.
         # A provider failure keeps the previous list — a DNS blip must
@@ -286,6 +476,19 @@ class Hub:
             straggler_target=slo_straggler_target,
             straggler_ratio=slo_straggler_ratio,
         ) if fleet_lens else None
+        # Delta-push ingest (ISSUE 7 tentpole): daemons and leaf hubs
+        # POST seq-numbered change-sets to /ingest/delta; the refresh
+        # drains them straight onto the _TargetCache interned state,
+        # bypassing fetch AND parse for push-fresh targets. None
+        # (--no-delta-ingest) keeps the hub pull-only.
+        self.delta = (delta_mod.DeltaIngest(
+            tracer=self.tracer,
+            expiry=max(10.0 * self._push_fence, 60.0),
+            entry_factory=lambda series: _TargetCache(
+                "", series, pushed=True, wants_rollup=federate),
+            entry_store=self._parse_cache)
+            if delta_ingest else None)
+        self._push_served = 0  # targets served by push, last refresh
         self._cycle_seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -345,6 +548,18 @@ class Hub:
 
         headers = (self._headers_provider()
                    if self._headers_provider is not None else None)
+
+        # Delta-push drain (ISSUE 7): sessions fresh within the fence
+        # are applied straight onto their _TargetCache entries — no
+        # fetch submitted, no parse run. Stale sessions are simply
+        # absent here, so those targets fall through to the pull path
+        # below (the automatic per-target fallback).
+        delta_mark = tracer.mark()
+        push_entries = self._sync_push_entries()
+        self._push_served = len(push_entries)
+        if push_entries:
+            tracer.add_span("delta_apply", delta_mark,
+                            targets=len(push_entries))
 
         def fetch(target: str):
             """(cache entry, done-at, fetch+parse seconds, parse seconds
@@ -456,6 +671,14 @@ class Hub:
         fetch_seconds: dict[str, float] = {}
         local_targets: list[str] = []
         for target in self._targets:
+            if target in push_entries:
+                # Served by push this refresh: no pool submit, no
+                # breaker consultation. A finished straggler fetch from
+                # an earlier (pull-era) refresh still gets pruned.
+                stuck = self._outstanding.get(target)
+                if stuck is not None and stuck.done():
+                    del self._outstanding[target]
+                continue
             stuck = self._outstanding.get(target)
             if stuck is not None:
                 if not stuck.done():
@@ -543,6 +766,21 @@ class Hub:
                     "target_fetch", self.tracer.clock_ns() - dur_ns,
                     dur_ns=dur_ns, target=target,
                     cached=parse_seconds is None)
+            self._breaker(target).record_success()
+
+        # Push-served targets are already-collected outcomes: recorded
+        # before the pull futures drain (order is normalized by target-
+        # list position below). fetch_seconds 0.0 — the hub paid no
+        # fetch; the publisher paid the diff on its own node.
+        push_at = time.monotonic()
+        target_set = set(self._targets)
+        for target, entry in push_entries.items():
+            if target not in target_set:
+                continue  # evicted between sync and here (provider churn)
+            ats.append(push_at)
+            entries.append((target, entry))
+            reachable[target] = True
+            fetch_seconds[target] = 0.0
             self._breaker(target).record_success()
 
         def salvage_stalled(members: list[str], future, seen: set,
@@ -718,7 +956,13 @@ class Hub:
                             (("target", target),))
         builder.add(schema.HUB_TARGETS, float(len(self._targets)))
         builder.add(schema.HUB_WORKERS_EXPECTED, float(self._expect_workers))
-        self._add_rollups(builder, frame)
+        if not self._federate:
+            # A federation root re-exports its LEAVES' slice_* rollups
+            # (FEDERATED_SPECS, via the merge below) — the leaf closest
+            # to each slice owns its rollup. Computing them again here
+            # from any per-chip series the leaves forward would emit a
+            # second, conflicting copy of every slice_* series.
+            self._add_rollups(builder, frame)
         self._merge_chip_series(builder, entries,
                                 emit_series=not self._rollups_only)
         if not self._rollups_only:
@@ -744,9 +988,12 @@ class Hub:
         # histogram fold) is now cached on the entry, so drop them — at
         # 256 targets a few thousand series each, the per-series label
         # dicts and tuples are tens of MB of RSS that the body
-        # byte-compare and the cached plans never touch again.
+        # byte-compare and the cached plans never touch again. PUSHED
+        # entries keep theirs: the interned series views ARE the state
+        # the next delta frame patches.
         for _target, entry in entries:
-            entry.series = entry.series_dicts = None
+            if not entry.pushed:
+                entry.series = entry.series_dicts = None
         tracer.add_span("merge", merge_mark)
         try:
             proc_readings = proc_future.result(
@@ -771,6 +1018,24 @@ class Hub:
                 log.warning("hub refresh: %s (repeats suppressed for "
                             "30s)", err)
         return frame
+
+    def _sync_push_entries(self) -> dict[str, "_TargetCache"]:
+        """target -> ready entry for every push-served target this
+        refresh. Frames already applied themselves onto the entries at
+        POST time (DeltaIngest.apply, on the handler threads — spread
+        over the refresh interval); the refresh only asks which
+        sessions are fresh within the fence and picks their entries up.
+        A fresh session whose entry is missing (eviction race, pull
+        fallback replaced it) is skipped: its next delta frame draws a
+        409 -> FULL resync, and this refresh falls back to pull."""
+        if self.delta is None:
+            return {}
+        out: dict[str, _TargetCache] = {}
+        for source in self.delta.fresh_sources(self._push_fence):
+            entry = self._parse_cache.get(source)
+            if entry is not None and entry.pushed:
+                out[source] = entry
+        return out
 
     def _blame_failed_fetch(self, target: str, what: str,
                             budget: float) -> None:
@@ -814,6 +1079,19 @@ class Hub:
         # the burn state must not vanish mid-incident.
         if self.fleet is not None:
             self.fleet.contribute(builder)
+        # Delta-ingest self-metrics (ISSUE 7): frame mix, wire bytes,
+        # resync rate, and how much of the fleet rides push vs pull.
+        if self.delta is not None:
+            builder.add(schema.DELTA_FRAMES,
+                        float(self.delta.full_frames_total),
+                        (("kind", "full"),))
+            builder.add(schema.DELTA_FRAMES,
+                        float(self.delta.delta_frames_total),
+                        (("kind", "delta"),))
+            builder.add(schema.DELTA_BYTES, float(self.delta.bytes_total))
+            builder.add(schema.HUB_RESYNC, float(self.delta.resyncs_total))
+            builder.add(schema.DELTA_PUSH_TARGETS,
+                        float(self._push_served))
         # Per-target breaker state: the hub's resilience self-metrics,
         # same families the daemon exports for its edges.
         for target in sorted(self._breakers):
@@ -845,27 +1123,40 @@ class Hub:
         return True, "ready"
 
     def _refresh_targets(self) -> None:
-        """Re-resolve dynamic targets and prune per-target state for
-        departed ones (pod churn under DNS discovery must not grow the
-        histogram cache or the outstanding-fetch map forever)."""
-        if self._targets_provider is None:
-            return
-        try:
-            resolved = list(dict.fromkeys(self._targets_provider()))
-        except Exception as exc:  # noqa: BLE001 - keep the previous list
-            log.warning("target discovery failed, keeping %d target(s): %s",
-                        len(self._targets), exc)
-            return
-        # An empty SUCCESS is accepted: an operator emptying the targets
-        # file has decommissioned the slice — the hub must stop scraping
-        # the dead targets (publishing the minimal snapshot: /readyz
-        # 503 drains scrapers, /healthz stays 200), not hold them
-        # forever. Only a provider *failure* keeps the previous list.
-        if resolved != self._targets:
-            log.info("targets: %d -> %d after discovery",
-                     len(self._targets), len(resolved))
-        self._targets = resolved
-        alive = set(resolved)
+        """Re-resolve dynamic targets, merge live delta-push sources,
+        and prune per-target state for departed ones (pod churn under
+        DNS discovery must not grow the histogram cache or the
+        outstanding-fetch map forever)."""
+        if self._targets_provider is not None:
+            try:
+                resolved = list(dict.fromkeys(self._targets_provider()))
+                # An empty SUCCESS is accepted: an operator emptying the
+                # targets file has decommissioned the slice — the hub
+                # must stop scraping the dead targets (publishing the
+                # minimal snapshot: /readyz 503 drains scrapers,
+                # /healthz stays 200), not hold them forever. Only a
+                # provider *failure* keeps the previous list.
+                if resolved != self._configured:
+                    log.info("targets: %d -> %d after discovery",
+                             len(self._configured), len(resolved))
+                self._configured = resolved
+            except Exception as exc:  # noqa: BLE001 - keep the previous list
+                log.warning(
+                    "target discovery failed, keeping %d target(s): %s",
+                    len(self._configured), exc)
+        targets = list(self._configured)
+        if self.delta is not None:
+            # Live push sources ARE targets: a worker that announces
+            # itself over the delta protocol needs no entry in any
+            # target list (push-only fleets run a hub with zero
+            # configured targets). sources() drops sessions silent past
+            # the expiry, so a decommissioned worker leaves the slice
+            # view — and its cached state is evicted just below.
+            known = set(targets)
+            targets += [s for s in self.delta.sources() if s not in known]
+        if targets != self._targets:
+            self._targets = targets
+        alive = set(targets)
         for target in [t for t in self._hist_cache if t not in alive]:
             del self._hist_cache[target]
         # The body/parse caches evict on the same path (ISSUE 2 satellite):
@@ -880,9 +1171,14 @@ class Hub:
         # DNS discovery must not grow this map forever).
         for target in [t for t in self._breakers if t not in alive]:
             del self._breakers[target]
-        # Fleet baselines and anomaly counters evict on the same path.
+        # Fleet baselines and anomaly counters evict on the same path —
+        # and so does delta-session state (ISSUE 7 satellite): a target
+        # churned out of the list must not keep a live seq chain that a
+        # restarted worker's frames could splice onto.
         if self.fleet is not None:
             self.fleet.evict(alive)
+        if self.delta is not None:
+            self.delta.evict(alive)
         # The stuck-fetch map prunes only FINISHED futures: a target
         # that flaps out of DNS and back must still be guarded against
         # its wedged fetch, or each flap would pin another pool worker.
@@ -1001,15 +1297,19 @@ class Hub:
                 builder.add(schema.HUB_STRAGGLER_RATIO,
                             min(rates) / max(rates), labels)
 
-    def _build_chip_plan(self, target: str, series: Sequence) -> tuple:
-        """Pre-resolve one target's per-chip merge work — the per-target
-        series index of the incremental merge: (dedup-key frozenset,
-        (dedup key, ready-to-emit Series) pairs, self-collision flag).
-        Built once per PARSE (not per refresh): label tuples arrive
-        interned from validate's pools, so the sorted-key memo and the
-        Series objects are shared across every refresh the body stays
-        unchanged, and a changed body simply rebuilds this target's plan
-        (the full-rebuild fallback for any series-shape change).
+    def _build_merge_plan(self, target: str, series: Sequence,
+                          specs: Mapping[str, schema.MetricSpec]) -> tuple:
+        """Pre-resolve one target's re-export merge work for the given
+        spec set — the per-target series index of the incremental
+        merge: (dedup-key frozenset, (dedup key, ready-to-emit Series)
+        pairs, self-collision flag, series-slot -> pair-index map).
+        Built once per PARSE or push resync (not per refresh): label
+        tuples arrive interned from validate's pools, so the sorted-key
+        memo and the Series objects are shared across every refresh the
+        state stays unchanged, and a changed body simply rebuilds this
+        target's plan (the full-rebuild fallback for any series-shape
+        change). The slot map lets a delta patch rebuild exactly the
+        changed pairs in place (labels can't change in a delta).
 
         The frozenset is the replay fast path: a target whose keys are
         disjoint from every earlier target's merges with two C-level set
@@ -1018,48 +1318,68 @@ class Hub:
         path, because the frozenset would silently swallow the
         duplicate instead of counting and dropping it."""
         pairs: list[tuple[tuple, Series]] = []
-        for name, labels, value in series:
-            spec = PER_CHIP_SPECS.get(name)
+        slot_map: dict[int, int] = {}
+        for slot, (name, labels, value) in enumerate(series):
+            spec = specs.get(name)
             if spec is None:
                 continue
             label_tuple = self._disambiguate_worker_tuple(labels, target)
             key = (name, bounded_memo(
                 self._key_cache, label_tuple,
                 lambda: tuple(sorted(label_tuple))))
+            slot_map[slot] = len(pairs)
             pairs.append((key, Series(spec, label_tuple, float(value))))
         keys = frozenset(key for key, _ in pairs)
-        return keys, pairs, len(keys) != len(pairs)
+        return keys, pairs, len(keys) != len(pairs), slot_map
 
-    def _replay_chip_plans(self, entries, emit: list | None) -> int:
-        """Replay every answered target's chip plan into ``emit``,
+    @staticmethod
+    def _replay_plan(plan: tuple, seen: set, emit: list | None) -> int:
+        """Replay one built plan into ``emit`` against the cross-target
+        ``seen`` set; returns dropped-duplicate count."""
+        keys, pairs, self_dup, _slot_map = plan
+        if not self_dup and seen.isdisjoint(keys):
+            # The common case: this target claims no series identity
+            # any earlier target claimed — merge it wholesale.
+            seen |= keys
+            if emit is not None:
+                emit.extend(series for _, series in pairs)
+            return 0
+        duplicates = 0
+        seen_add = seen.add
+        for key, series in pairs:
+            if key in seen:
+                duplicates += 1
+                continue
+            seen_add(key)
+            if emit is not None:
+                emit.append(series)
+        return duplicates
+
+    def _replay_chip_plans(self, entries, emit: list | None,
+                           rollup_emit: list | None = None) -> int:
+        """Replay every answered target's chip plan into ``emit`` and,
+        under --federate, its slice-rollup re-export plan into
+        ``rollup_emit`` (separate sinks: --rollups-only silences the
+        per-chip series while the federated rollups keep flowing),
         deduplicating across targets (first target wins). Returns the
         duplicate count. The cross-target ``seen`` set is rebuilt every
         refresh on purpose — it is the one piece of state that depends
         on which targets answered, so recomputing it keeps target churn
         trivially correct."""
         seen: set[tuple] = set()
-        seen_add = seen.add
         duplicates = 0
         for target, entry in entries:
             plan = entry.chip_plan
             if plan is None:
-                plan = entry.chip_plan = self._build_chip_plan(
-                    target, entry.series)
-            keys, pairs, self_dup = plan
-            if not self_dup and seen.isdisjoint(keys):
-                # The common case: this target claims no chip identity
-                # any earlier target claimed — merge it wholesale.
-                seen |= keys
-                if emit is not None:
-                    emit.extend(series for _, series in pairs)
-                continue
-            for key, series in pairs:
-                if key in seen:
-                    duplicates += 1
-                    continue
-                seen_add(key)
-                if emit is not None:
-                    emit.append(series)
+                plan = entry.chip_plan = self._build_merge_plan(
+                    target, entry.series, PER_CHIP_SPECS)
+            duplicates += self._replay_plan(plan, seen, emit)
+            if self._federate:
+                rollup = entry.rollup_plan
+                if rollup is None:
+                    rollup = entry.rollup_plan = self._build_merge_plan(
+                        target, entry.series, FEDERATED_SPECS)
+                duplicates += self._replay_plan(rollup, seen, rollup_emit)
         return duplicates
 
     def _merge_chip_series(self, builder: SnapshotBuilder,
@@ -1086,10 +1406,16 @@ class Hub:
         dedup key sorts labels so a third-party exporter rendering the
         same label set in a different order still collides instead of
         slipping through as a Prometheus-identical duplicate."""
-        emit: list[Series] | None = [] if emit_series else None
-        duplicates = self._replay_chip_plans(entries, emit)
-        if emit:
-            builder.extend_series(emit)
+        out: list[Series] = []
+        duplicates = self._replay_chip_plans(
+            entries,
+            out if emit_series else None,
+            # A --federate --rollups-only root serves ONLY the leaves'
+            # slice_* rollups: the re-export must flow even when the
+            # per-chip series are silenced.
+            out if self._federate else None)
+        if out:
+            builder.extend_series(out)
         builder.add(schema.HUB_DUPLICATE_SERIES, float(duplicates))
         if duplicates and log_every("hub:duplicates", 60.0):
             log.warning(
@@ -1310,6 +1636,21 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--rollups-only", action="store_true",
                         help="serve only slice_* rollups, not the merged "
                              "per-chip accelerator_* series")
+    parser.add_argument("--federate", action="store_true",
+                        help="targets are LEAF HUBS, not node exporters: "
+                             "re-export their slice_* rollup series "
+                             "(disjoint per slice/target label) alongside "
+                             "any per-chip series — the root of a "
+                             "leaf/root federation tree. Combine with "
+                             "leaf hubs running --hub-url pointed here")
+    parser.add_argument("--no-delta-ingest", action="store_true",
+                        help="disable the push ingest endpoint "
+                             "(/ingest/delta): every target is served by "
+                             "pull-scrape only")
+    parser.add_argument("--push-fence", type=float, default=0.0,
+                        help="seconds a delta-push session may be silent "
+                             "before the target falls back to pull-scrape "
+                             "for the refresh (default 3x --interval)")
     parser.add_argument("--listen-host", default="0.0.0.0")
     parser.add_argument("--listen-port", type=int, default=DEFAULT_PORT)
     parser.add_argument("--once", action="store_true",
@@ -1380,12 +1721,16 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--remote-write-bearer-token-file", default="")
     parser.add_argument("--log-level", default="info",
                         choices=("debug", "info", "warning", "error"))
-    # Fleet-lens / SLO knobs: the SAME flag definitions the daemon
-    # parser carries (config.add_fleet_lens_flags), so spellings, env
-    # vars and defaults cannot drift between the two CLIs.
-    from .config import add_fleet_lens_flags, validate_fleet_lens_args
+    # Fleet-lens / SLO + delta-push knobs: the SAME flag definitions the
+    # daemon parser carries (config.add_fleet_lens_flags /
+    # add_delta_push_flags), so spellings, env vars and defaults cannot
+    # drift between the two CLIs. On a hub, --hub-url points at the
+    # PARENT (root) hub of a federation tree.
+    from .config import (add_delta_push_flags, add_fleet_lens_flags,
+                         validate_fleet_lens_args)
 
     add_fleet_lens_flags(parser)
+    add_delta_push_flags(parser)
     args = parser.parse_args(argv)
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
@@ -1427,13 +1772,16 @@ def main(argv: Sequence[str] | None = None) -> int:
         def targets_provider() -> list[str]:
             return resolve_dns_targets(args.targets_dns,
                                        scheme=args.targets_dns_scheme)
-    elif not targets and targets_provider is None:
+    elif not targets and targets_provider is None and args.no_delta_ingest:
         # A file provider with an empty-for-now file is allowed: the
         # shipped ConfigMap starts with only comments, and the hub must
         # serve (live but NotReady, slice_targets 0) until targets are
-        # added, not CrashLoop.
+        # added, not CrashLoop. With delta ingest on (the default), an
+        # empty target list is the PUSH-ONLY mode: workers announce
+        # themselves over /ingest/delta and need no target config.
         parser.error("no targets (positional, --targets-file, or "
-                     "--targets-dns)")
+                     "--targets-dns) and --no-delta-ingest leaves no "
+                     "push path either")
 
     from .validate import fetch_options
 
@@ -1477,7 +1825,10 @@ def main(argv: Sequence[str] | None = None) -> int:
               fleet_lens=not args.no_fleet_lens,
               slo_freshness_target=args.slo_freshness_target,
               slo_straggler_target=args.slo_straggler_target,
-              slo_straggler_ratio=args.slo_straggler_ratio)
+              slo_straggler_ratio=args.slo_straggler_ratio,
+              delta_ingest=not args.no_delta_ingest,
+              push_fence=args.push_fence or None,
+              federate=args.federate)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -1507,6 +1858,23 @@ def main(argv: Sequence[str] | None = None) -> int:
             bearer_token_file=args.remote_write_bearer_token_file,
             extra_labels=extra_labels,
             render_stats=render_stats)))
+    if args.hub_url:
+        # Federation leaf: push this hub's merged rollup exposition to
+        # the parent (root) hub over the same delta protocol the
+        # daemons use against us. Source defaults to this hub's own
+        # scrape URL so the root's pull fallback lands here.
+        import socket as socket_mod
+
+        from .delta import DeltaPublisher
+
+        senders.append(("delta", DeltaPublisher(
+            hub.registry, args.hub_url,
+            source=args.hub_push_source or (
+                f"http://{socket_mod.gethostname()}:"
+                f"{args.listen_port}/metrics"),
+            min_interval=args.hub_push_interval,
+            render_stats=render_stats,
+            tracer=hub.tracer)))
 
     if args.once:
         frame = hub.refresh_once()
@@ -1530,7 +1898,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         render_stats=render_stats,
         ready_check=hub.ready,
         trace_provider=hub.tracer,
-        fleet_provider=hub.fleet)
+        fleet_provider=hub.fleet,
+        ingest_provider=hub.delta.handle if hub.delta is not None else None)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

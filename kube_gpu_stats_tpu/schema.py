@@ -476,6 +476,49 @@ HUB_PARSE_SECONDS = MetricSpec(
     "parse is rollup+merge cost.",
 )
 
+# Delta-ingest families (delta.py, ISSUE 7): the hub's push edge —
+# daemons (and leaf hubs, in a federation tree) publish seq-numbered
+# change-sets of interned series slots instead of being pull-scraped
+# whole; these families make the protocol's health observable.
+
+DELTA_FRAMES = MetricSpec(
+    "kts_delta_frames_total",
+    MetricType.COUNTER,
+    "Delta-protocol frames this hub has applied, by kind: 'full' "
+    "(complete exposition snapshot — session start, shape change, or "
+    "resync) and 'delta' (changed series slots only — the steady "
+    "state). A full:delta ratio climbing toward 1 means sessions keep "
+    "resyncing (see kts_hub_resync_total) or series shapes churn every "
+    "tick, and the push path is degenerating into pull-with-extra-steps.",
+    extra_labels=("kind",),
+)
+DELTA_BYTES = MetricSpec(
+    "kts_delta_bytes_total",
+    MetricType.COUNTER,
+    "Compressed wire bytes of delta-protocol frames this hub has "
+    "accepted (full and delta frames both). Against the rendered "
+    "exposition size this prices the push edge: a quiet fleet ships "
+    "bytes proportional to churn, not chip count.",
+)
+HUB_RESYNC = MetricSpec(
+    "kts_hub_resync_total",
+    MetricType.COUNTER,
+    "Delta frames this hub rejected with 'resync required' (seq gap, "
+    "generation mismatch after a worker restart, or no session state "
+    "after a hub restart/eviction). Each rejection makes the publisher "
+    "send one full snapshot and resume deltas. A steady rate here is a "
+    "resync storm — see the federation runbook in docs/OPERATIONS.md.",
+)
+DELTA_PUSH_TARGETS = MetricSpec(
+    "kts_delta_push_targets",
+    MetricType.GAUGE,
+    "Targets whose last refresh was served from a live delta-push "
+    "session (no pull fetch issued). slice_targets minus this is the "
+    "pull-scraped remainder — old daemons, push-disabled nodes, and "
+    "push sessions that went stale past the fence and fell back to "
+    "pull.",
+)
+
 # Fleet-lens families (fleetlens.py, driven from the hub refresh):
 # cross-node anomaly detection, slow-node attribution, SLO burn windows.
 
@@ -554,6 +597,10 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_REFRESH_DURATION,
     HUB_BODY_CACHE_HITS,
     HUB_PARSE_SECONDS,
+    DELTA_FRAMES,
+    DELTA_BYTES,
+    HUB_RESYNC,
+    DELTA_PUSH_TARGETS,
     FLEET_TARGETS_ANOMALOUS,
     FLEET_ANOMALIES,
     FLEET_SLO_BURN,

@@ -1,0 +1,649 @@
+"""Push-delta protocol tests (ISSUE 7): wire codec strictness,
+encoder/ingest session semantics under drops/reorders/duplicates/
+restarts, the hub's push-serve + pull-fallback composition, federation
+re-export, and the byte-identity differential pin — delta-applied hub
+state must render identically to the pull-merge oracle fed the same
+bodies."""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from kube_gpu_stats_tpu import delta, schema
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+
+
+def make_body(worker: int, duty: float, steps: float = 0.0,
+              chips: int = 2, extra_chip: bool = False,
+              phase_p50: float = 0.001) -> str:
+    """One deterministic worker exposition: per-chip gauges + a counter,
+    a workload histogram, and a flight-recorder digest family — every
+    ingest surface the hub derives caches from."""
+    builder = SnapshotBuilder()
+    count = chips + (1 if extra_chip else 0)
+    for chip in range(count):
+        labels = (
+            ("accel_type", "tpu-v5p"), ("chip", str(chip)),
+            ("device_path", f"/dev/accel{chip}"), ("uuid", ""),
+            ("pod", "train-0"), ("namespace", "ml"), ("container", "w"),
+            ("slice", f"s{worker % 2}"), ("worker", str(worker)),
+            ("topology", "2x2"))
+        builder.add(schema.DEVICE_UP, 1.0, labels)
+        builder.add(schema.DUTY_CYCLE, duty + chip, labels)
+        builder.add(schema.MEMORY_USED, 1e9 + worker, labels)
+        builder.add(schema.POWER, 200.0 + duty, labels)
+        builder.add(schema.WORKLOAD_STEPS, steps, labels)
+        builder.add(schema.ICI_BANDWIDTH, 1e8 * (1 + chip),
+                    labels + (("link", "0"),))
+    hist = schema.WORKLOAD_STEP_DURATION
+    from kube_gpu_stats_tpu.registry import HistogramState
+    state = HistogramState.empty(hist, (0.1, 1.0),
+                                 labels=(("worker", str(worker)),))
+    state = state.observe(0.05, max(1, int(steps)))
+    builder.add_histogram(state)
+    builder.add(schema.TICK_PHASE_SECONDS, phase_p50,
+                (("phase", "fold"), ("quantile", "p50")))
+    return builder.build().render()
+
+
+# --- wire codec -------------------------------------------------------------
+
+def test_codec_full_roundtrip():
+    wire = delta.encode_full("node-a", 7, 3, "accelerator_up 1\n")
+    frame = delta.decode_frame(wire)
+    assert frame.kind == delta.KIND_FULL
+    assert (frame.source, frame.generation, frame.seq) == ("node-a", 7, 3)
+    assert frame.body == "accelerator_up 1\n"
+
+
+def test_codec_delta_roundtrip_gap_encoding():
+    changes = [(0, 1.5), (3, -2.0), (4097, 3.25)]
+    wire = delta.encode_delta("node-b", 9, 12, changes)
+    frame = delta.decode_frame(wire)
+    assert frame.kind == delta.KIND_DELTA
+    assert list(zip(frame.slots, frame.values)) == changes
+
+
+def test_codec_rejects_malformed():
+    import kube_gpu_stats_tpu.snappy as snappy
+
+    good = delta.encode_full("s", 1, 1, "x 1\n")
+    with pytest.raises(ValueError):
+        delta.decode_frame(good[:-3])  # truncated snappy stream
+    raw = snappy.decompress(good)
+    for mutant in (
+        snappy.compress(b"NOPE" + raw[4:]),          # bad magic
+        snappy.compress(raw[:4] + b"\x63" + raw[5:]),  # bad version
+        snappy.compress(raw[:5] + b"\x07" + raw[6:]),  # unknown kind
+        snappy.compress(raw[:-2]),                   # body length mismatch
+    ):
+        with pytest.raises(ValueError):
+            delta.decode_frame(mutant)
+    with pytest.raises(ValueError):
+        delta.encode_delta("s", 1, 1, [(5, 1.0), (2, 1.0)])  # not ascending
+
+
+def test_decompression_bomb_rejected_before_expanding():
+    """A frame DECLARING a huge decompressed size is rejected off the
+    preamble, before any decompression work (review finding: the size
+    cap ran after snappy.decompress, i.e. after the bomb went off)."""
+    bomb = delta._varint(delta.MAX_FRAME_BYTES * 4) + b"\x00" * 64
+    with pytest.raises(ValueError, match="size cap"):
+        delta.decode_frame(bomb)
+
+
+def test_empty_source_rejected():
+    with pytest.raises(ValueError, match="empty source"):
+        delta.decode_frame(delta.encode_full("", 1, 1, "x 1\n"))
+
+
+# --- encoder ----------------------------------------------------------------
+
+def test_encoder_full_then_delta_then_shape_change():
+    encoder = delta.DeltaEncoder("w0", generation=1)
+    wire, kind = encoder.encode_next(make_body(0, 10.0))
+    assert kind == delta.KIND_FULL
+    encoder.ack()
+    # Values-only change -> DELTA with exactly the changed slots.
+    wire, kind = encoder.encode_next(make_body(0, 12.0))
+    assert kind == delta.KIND_DELTA
+    frame = delta.decode_frame(wire)
+    assert frame.seq == 2
+    assert len(frame.slots) > 0
+    encoder.ack()
+    # Unchanged body -> empty DELTA heartbeat.
+    wire, kind = encoder.encode_next(make_body(0, 12.0))
+    assert kind == delta.KIND_DELTA
+    assert delta.decode_frame(wire).slots == ()
+    encoder.ack()
+    # Shape change (a chip appears) -> FULL.
+    _, kind = encoder.encode_next(make_body(0, 12.0, extra_chip=True))
+    assert kind == delta.KIND_FULL
+    encoder.ack()
+    # nack (failed/uncertain send) promotes the next frame to FULL.
+    _, kind = encoder.encode_next(make_body(0, 13.0, extra_chip=True))
+    assert kind == delta.KIND_DELTA
+    encoder.nack()
+    _, kind = encoder.encode_next(make_body(0, 13.0, extra_chip=True))
+    assert kind == delta.KIND_FULL
+
+
+def test_quiet_tick_payload_at_least_10x_smaller():
+    """Acceptance pin: a quiet tick's delta payload is >= 10x smaller
+    than the full exposition frame."""
+    encoder = delta.DeltaEncoder("w0", generation=1)
+    full_wire, _ = encoder.encode_next(make_body(0, 10.0, steps=5.0))
+    encoder.ack()
+    # A quiet tick: one gauge twitches, everything else is unchanged.
+    quiet_wire, kind = encoder.encode_next(
+        make_body(0, 10.0, steps=5.0, phase_p50=0.0011))
+    assert kind == delta.KIND_DELTA
+    assert len(quiet_wire) * 10 <= len(full_wire), (
+        len(quiet_wire), len(full_wire))
+
+
+# --- ingest session rules ---------------------------------------------------
+
+def _push_hub(**kwargs) -> Hub:
+    kwargs.setdefault("targets_provider", lambda: [])
+    kwargs.setdefault("interval", 10.0)
+    kwargs.setdefault("push_fence", 1e9)  # tests drive refreshes by hand
+    return Hub([], **kwargs)
+
+
+def _feed(hub: Hub, encoder: delta.DeltaEncoder, body: str,
+          deliver: bool = True) -> tuple[int, bytes]:
+    wire, _kind = encoder.encode_next(body)
+    if not deliver:
+        encoder.nack()
+        return 0, b""
+    code, resp = hub.delta.handle(wire)
+    if code == 200:
+        encoder.ack()
+    else:
+        encoder.nack()
+    return code, resp
+
+
+def test_ingest_seq_gap_duplicate_and_reorder_force_resync():
+    hub = _push_hub()
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=5)
+        code, _ = _feed(hub, encoder, make_body(0, 10.0))
+        assert code == 200
+        hub.refresh_once()
+        wire2, _ = encoder.encode_next(make_body(0, 11.0))
+        assert hub.delta.handle(wire2)[0] == 200
+        encoder.ack()
+        # Duplicate delivery of the same frame: seq already consumed.
+        code, resp = hub.delta.handle(wire2)
+        assert code == 409 and b"seq gap" in resp
+        # A frame from the future (seq gap; simulates a dropped frame).
+        future = delta.encode_delta("w0", 5, 99, [(0, 1.0)])
+        assert hub.delta.handle(future)[0] == 409
+        # Generation mismatch (worker restarted elsewhere).
+        other = delta.encode_delta("w0", 6, 3, [(0, 1.0)])
+        assert hub.delta.handle(other)[0] == 409
+        assert hub.delta.resyncs_total == 3
+        # Unknown source: no session at all.
+        orphan = delta.encode_delta("ghost", 1, 1, [(0, 1.0)])
+        assert hub.delta.handle(orphan)[0] == 409
+        # Out-of-range slot.
+        huge = delta.encode_delta("w0", 5, encoder.seq + 1, [(10_000, 1.0)])
+        assert hub.delta.handle(huge)[0] == 409
+        # Recovery: the nacked encoder promotes to FULL, which is always
+        # accepted and re-anchors the chain.
+        code, _ = _feed(hub, encoder, make_body(0, 12.0))
+        assert code == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert 'accelerator_duty_cycle' in body
+        line = next(l for l in body.splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 12"), line
+    finally:
+        hub.stop()
+
+
+def test_restarted_worker_full_resync_no_stale_chain():
+    """A worker restarting with a new generation replaces its session
+    wholesale — old-generation deltas can never splice onto it."""
+    hub = _push_hub()
+    try:
+        old = delta.DeltaEncoder("w0", generation=100)
+        assert _feed(hub, old, make_body(0, 10.0))[0] == 200
+        hub.refresh_once()
+        fresh = delta.DeltaEncoder("w0", generation=200)
+        assert _feed(hub, fresh, make_body(0, 33.0))[0] == 200
+        # Straggler delta from the DEAD incarnation: rejected.
+        stale = delta.encode_delta("w0", 100, 2, [(1, 99.0)])
+        assert hub.delta.handle(stale)[0] == 409
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        line = next(l for l in body.splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 33"), line
+    finally:
+        hub.stop()
+
+
+def test_session_expiry_evicts_target_state():
+    """A silent session expires: the target leaves the hub's list and
+    its cached entry/breaker/session state is evicted on the same
+    refresh path (ISSUE 7 satellite — no stale seq chains)."""
+    hub = _push_hub(push_fence=0.05)
+    hub.delta._expiry = 0.1
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        hub.refresh_once()
+        assert "w0" in hub._targets
+        assert "w0" in hub._parse_cache
+        time.sleep(0.15)
+        hub.refresh_once()
+        assert "w0" not in hub._targets
+        assert "w0" not in hub._parse_cache
+        assert hub.delta.sources() == []
+        # The worker comes back (restart): next delta draws a resync,
+        # the FULL re-admits it cleanly.
+        late = delta.encode_delta("w0", 1, 2, [(0, 1.0)])
+        assert hub.delta.handle(late)[0] == 409
+        fresh = delta.DeltaEncoder("w0", generation=2)
+        assert _feed(hub, fresh, make_body(0, 20.0))[0] == 200
+        hub.refresh_once()
+        assert "w0" in hub._targets
+    finally:
+        hub.stop()
+
+
+def test_stale_push_session_falls_back_to_pull(tmp_path):
+    """Push-unavailable -> pull fallback: a configured target whose push
+    session goes stale past the fence is pull-scraped that refresh."""
+    target = tmp_path / "w0.prom"
+    target.write_text(make_body(0, 77.0))
+    hub = Hub([str(target)], interval=10.0, push_fence=0.05)
+    try:
+        encoder = delta.DeltaEncoder(str(target), generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        hub.refresh_once()
+        assert hub._push_served == 1
+        line = next(l for l in hub.registry.snapshot().render().splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 10"), line
+        time.sleep(0.1)  # past the fence: session stale, file served
+        hub.refresh_once()
+        assert hub._push_served == 0
+        body = hub.registry.snapshot().render()
+        line = next(l for l in body.splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 77"), line
+        assert f'slice_target_up{{target="{target}"}} 1' in body
+        # The pull replaced the pushed entry: the session's next delta
+        # draws a resync, and a FULL resumes push service.
+        wire, _ = encoder.encode_next(make_body(0, 11.0))
+        assert hub.delta.handle(wire)[0] == 409
+        encoder.nack()
+        assert _feed(hub, encoder, make_body(0, 11.0))[0] == 200
+        hub.refresh_once()
+        assert hub._push_served == 1
+    finally:
+        hub.stop()
+
+
+def test_ingest_metrics_exported():
+    hub = _push_hub()
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        assert _feed(hub, encoder, make_body(0, 11.0))[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert 'kts_delta_frames_total{kind="full"} 1' in body
+        assert 'kts_delta_frames_total{kind="delta"} 1' in body
+        assert "kts_hub_resync_total 0" in body
+        assert "kts_delta_push_targets 1" in body
+        assert "kts_delta_bytes_total" in body
+    finally:
+        hub.stop()
+
+
+# --- federation -------------------------------------------------------------
+
+def leaf_rollup_body() -> str:
+    builder = SnapshotBuilder()
+    builder.add(schema.HUB_CHIPS, 8.0, (("slice", "s-a"),))
+    builder.add(schema.HUB_DUTY_MEAN, 61.5, (("slice", "s-a"),))
+    builder.add(schema.HUB_TARGET_UP, 1.0,
+                (("target", "http://node-1:9400/metrics"),))
+    builder.add(schema.HUB_WORKER_STEPS, 3.5,
+                (("slice", "s-a"), ("worker", "w1")))
+    builder.add(schema.HUB_TARGETS, 4.0)  # unlabeled: NOT re-exported
+    return builder.build().render()
+
+
+def test_federation_root_reexports_slice_rollups():
+    hub = _push_hub(federate=True)
+    try:
+        encoder = delta.DeltaEncoder("leaf-a", generation=1)
+        assert _feed(hub, encoder, leaf_rollup_body())[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert 'slice_chips{slice="s-a"} 8' in body
+        assert 'slice_duty_cycle_mean{slice="s-a"} 61.5' in body
+        assert ('slice_worker_steps_per_second{slice="s-a",worker="w1"} 3.5'
+                in body)
+        assert 'slice_target_up{target="http://node-1:9400/metrics"} 1' \
+            in body
+        # The leaf's unlabeled self-gauge is NOT forwarded; the root
+        # exports its own (1 target: the leaf).
+        assert "slice_targets 1" in body
+        # Delta-patching a re-exported rollup updates it in place.
+        patched = leaf_rollup_body().replace(
+            'slice_chips{slice="s-a"} 8', 'slice_chips{slice="s-a"} 6')
+        assert _feed(hub, encoder, patched)[0] == 200
+        hub.refresh_once()
+        assert 'slice_chips{slice="s-a"} 6' in \
+            hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+
+
+def test_federate_rollups_only_still_serves_leaf_rollups():
+    """--federate --rollups-only: the per-chip series are silenced but
+    the leaves' slice_* re-export must keep flowing (review finding:
+    emit=None silenced both)."""
+    hub = _push_hub(federate=True, rollups_only=True)
+    try:
+        encoder = delta.DeltaEncoder("leaf-a", generation=1)
+        assert _feed(hub, encoder, leaf_rollup_body())[0] == 200
+        chips = delta.DeltaEncoder("worker-x", generation=2)
+        assert _feed(hub, chips, make_body(0, 10.0))[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert 'slice_chips{slice="s-a"} 8' in body
+        assert not any(line.startswith("accelerator_duty_cycle")
+                       for line in body.splitlines())
+    finally:
+        hub.stop()
+
+
+def test_non_federate_hub_drops_leaf_rollups():
+    hub = _push_hub(federate=False)
+    try:
+        encoder = delta.DeltaEncoder("leaf-a", generation=1)
+        assert _feed(hub, encoder, leaf_rollup_body())[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert 'slice_chips{slice="s-a"}' not in body
+    finally:
+        hub.stop()
+
+
+# --- HTTP ingest endpoint ---------------------------------------------------
+
+def test_ingest_endpoint_auth_and_errors():
+    import base64
+    import hashlib
+    import urllib.error
+    import urllib.request
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    hub = _push_hub()
+    password = "hunter2"
+    server = MetricsServer(
+        hub.registry, host="127.0.0.1", port=0,
+        auth_username="admin",
+        auth_password_sha256=hashlib.sha256(password.encode()).hexdigest(),
+        ingest_provider=hub.delta.handle)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}/ingest/delta"
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        wire, _ = encoder.encode_next(make_body(0, 10.0))
+        request = urllib.request.Request(url, data=wire, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=5)
+        assert err.value.code == 401
+        token = base64.b64encode(f"admin:{password}".encode()).decode()
+        request = urllib.request.Request(
+            url, data=wire, method="POST",
+            headers={"Authorization": f"Basic {token}"})
+        with urllib.request.urlopen(request, timeout=5) as resp:
+            assert resp.status == 200
+        # Garbage frame -> 400, authed.
+        request = urllib.request.Request(
+            url, data=b"not a frame", method="POST",
+            headers={"Authorization": f"Basic {token}"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=5)
+        assert err.value.code == 400
+        # Unknown POST path -> 404.
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/ingest/other", data=b"x",
+            method="POST", headers={"Authorization": f"Basic {token}"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=5)
+        assert err.value.code == 404
+    finally:
+        server.stop()
+        hub.stop()
+
+
+def test_daemon_ingest_404():
+    """A server with no ingest provider (daemons) answers POST 404."""
+    import urllib.error
+    import urllib.request
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    registry = Registry()
+    server = MetricsServer(registry, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/ingest/delta",
+            data=b"x", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=5)
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+# --- publisher over HTTP ----------------------------------------------------
+
+def test_publisher_end_to_end_with_resync_recovery():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    worker = Registry()
+
+    def publish(duty: float) -> None:
+        builder = SnapshotBuilder()
+        labels = (("accel_type", "tpu-v5p"), ("chip", "0"),
+                  ("device_path", "/dev/accel0"), ("uuid", ""))
+        builder.add(schema.DEVICE_UP, 1.0, labels)
+        builder.add(schema.DUTY_CYCLE, duty, labels)
+        worker.publish(builder.build())
+
+    publish(10.0)
+    hub = _push_hub()
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-a")
+    try:
+        publisher.push_once()
+        assert publisher.pushes_total == 1
+        publish(20.0)
+        publisher.push_once()
+        assert publisher.pushes_total == 2
+        assert publisher.last_frame_kind == delta.KIND_DELTA
+        # Hub loses the session (restart/eviction): the publisher's
+        # next push recovers inside ONE push_once via 409 -> FULL.
+        hub.delta.evict(set())
+        publish(30.0)
+        publisher.push_once()
+        assert publisher.resyncs_total == 1
+        assert publisher.failures_total == 0
+        assert publisher.last_frame_kind == delta.KIND_FULL
+        hub.refresh_once()
+        line = next(l for l in hub.registry.snapshot().render().splitlines()
+                    if l.startswith("accelerator_duty_cycle"))
+        assert line.endswith(" 30"), line
+        # Hub gone entirely: failures count, telemetry keeps flowing by
+        # pull (not exercised here), and nothing raises.
+        server.stop()
+        publish(40.0)
+        publisher.push_once()
+        assert publisher.failures_total == 1
+    finally:
+        publisher.stop()
+        hub.stop()
+        server.stop()
+
+
+# --- the differential pin ---------------------------------------------------
+
+_EXCLUDED_FAMILIES = (
+    # Wall-clock-derived rates: both hubs compute them from their OWN
+    # refresh timestamps, so they are equal in shape but not in digits.
+    "slice_worker_steps_per_second",
+    "slice_straggler_ratio",
+    # Fetch wall time: the push hub never fetches (reports 0.0).
+    "slice_target_fetch_seconds",
+)
+
+
+def _data_lines(rendered: str) -> list[str]:
+    out = []
+    for line in rendered.splitlines():
+        if line.startswith(("accelerator_", "slice_")) and not \
+                line.startswith(_EXCLUDED_FAMILIES):
+            out.append(line)
+    return out
+
+
+def test_differential_delta_vs_pull_oracle_under_churn(tmp_path):
+    """The acceptance pin: after randomized value churn, shape changes,
+    worker restarts, dropped/duplicated frames and forced resyncs, the
+    push hub's merged data series are byte-identical to a pull hub fed
+    the same bodies."""
+    rng = random.Random(1234)
+    workers = 5
+    paths = [tmp_path / f"w{i}.prom" for i in range(workers)]
+    duties = [10.0 * (i + 1) for i in range(workers)]
+    steps = [float(i) for i in range(workers)]
+    extra = [False] * workers
+    generations = [i + 1 for i in range(workers)]
+
+    def body(i: int) -> str:
+        return make_body(i, duties[i], steps=steps[i], extra_chip=extra[i])
+
+    for i, path in enumerate(paths):
+        path.write_text(body(i))
+
+    oracle = Hub([str(p) for p in paths], interval=10.0,
+                 delta_ingest=False)
+    push = _push_hub()
+    encoders = [delta.DeltaEncoder(str(paths[i]), generation=generations[i])
+                for i in range(workers)]
+    try:
+        for encoder, path in zip(encoders, paths):
+            assert _feed(push, encoder, path.read_text())[0] == 200
+        oracle.refresh_once()
+        push.refresh_once()
+        for round_no in range(8):
+            for i in range(workers):
+                event = rng.random()
+                if event < 0.5:
+                    duties[i] += rng.choice([0.0, 1.0, 2.5])
+                    steps[i] += rng.randint(0, 3)
+                elif event < 0.65:
+                    extra[i] = not extra[i]  # shape change -> FULL
+                elif event < 0.75:
+                    # Worker restart: counters reset, new generation.
+                    generations[i] += 100
+                    encoders[i] = delta.DeltaEncoder(
+                        str(paths[i]), generation=generations[i])
+                    steps[i] = 0.0
+                paths[i].write_text(body(i))
+                fault = rng.random()
+                if fault < 0.15:
+                    # Dropped frame: never delivered; encoder nacks.
+                    # The push hub serves last-known state until the
+                    # settle pass below recovers with a FULL — freshness
+                    # lag by design, never corruption.
+                    _feed(push, encoders[i], body(i), deliver=False)
+                elif fault < 0.25:
+                    # Duplicate delivery: second copy must 409 without
+                    # corrupting state; encoder recovers via FULL.
+                    wire, _ = encoders[i].encode_next(body(i))
+                    code, _resp = push.delta.handle(wire)
+                    if code == 200:
+                        encoders[i].ack()
+                        assert push.delta.handle(wire)[0] == 409
+                    else:
+                        encoders[i].nack()
+                        assert _feed(push, encoders[i], body(i))[0] == 200
+                else:
+                    code, _resp = _feed(push, encoders[i], body(i))
+                    if code == 409:  # e.g. after an earlier fault
+                        assert _feed(push, encoders[i], body(i))[0] == 200
+            # Settle pass: every session converges on the current body
+            # (a dropped frame's nack makes this a FULL resync) — the
+            # differential compares CONVERGED state, the protocol's
+            # post-recovery guarantee.
+            for i in range(workers):
+                code, _resp = _feed(push, encoders[i], body(i))
+                if code != 200:
+                    assert _feed(push, encoders[i], body(i))[0] == 200
+            oracle.refresh_once()
+            push.refresh_once()
+            oracle_lines = _data_lines(oracle.registry.snapshot().render())
+            push_lines = _data_lines(push.registry.snapshot().render())
+            assert oracle_lines == push_lines, (
+                f"round {round_no}: delta-applied state diverged from "
+                f"the pull oracle:\n"
+                + "\n".join(l for l in oracle_lines if l not in push_lines)
+                [:2000])
+    finally:
+        oracle.stop()
+        push.stop()
+
+
+def test_differential_includes_histograms_and_rates_shape(tmp_path):
+    """Histogram merges ride the differential too: the step-duration
+    family folded from pushed state equals the pull oracle's fold."""
+    path = tmp_path / "w0.prom"
+    path.write_text(make_body(0, 10.0, steps=7.0))
+    oracle = Hub([str(path)], interval=10.0, delta_ingest=False)
+    push = _push_hub()
+    encoder = delta.DeltaEncoder(str(path), generation=1)
+    try:
+        assert _feed(push, encoder, path.read_text())[0] == 200
+        oracle.refresh_once()
+        push.refresh_once()
+        path.write_text(make_body(0, 10.0, steps=9.0))
+        assert _feed(push, encoder, path.read_text())[0] == 200
+        oracle.refresh_once()
+        push.refresh_once()
+
+        def hist_lines(hub):
+            return [l for l in hub.registry.snapshot().render().splitlines()
+                    if l.startswith(schema.WORKLOAD_STEP_DURATION.name)]
+
+        assert hist_lines(oracle) == hist_lines(push)
+        assert hist_lines(push)  # the family actually merged
+    finally:
+        oracle.stop()
+        push.stop()

@@ -19,6 +19,13 @@ def test_p50_under_budget_with_scripted_delay(tmp_path):
     assert result["p50_ms"] < 8.0, result
     assert result["rpc_calls_per_tick"] > 0, result
     assert result["metrics_per_chip"] > 10, result
+    # Scrape-path budget (ISSUE 7 satellite, BENCH_r06 regression pin):
+    # with pipelined ticks the background fetch wave contends with an
+    # inline render, which took scrape_p50 from ~1.5 ms to ~24 ms. The
+    # render pre-warmer serves each scrape the per-generation
+    # pre-gzipped bytes, so the measured end-to-end scrape (socket
+    # included, under the live pipelined load) must stay sub-5 ms.
+    assert result["scrape_p50_ms"] < 5.0, result
 
 
 def test_blocking_mode_keeps_rpc_inside_the_tick(tmp_path):
@@ -68,6 +75,70 @@ def test_trace_overhead_within_hard_budget():
 
     ns = measure_overhead_ns()
     assert ns < 25_000, f"span overhead {ns:.0f} ns/span blows the budget"
+
+
+def test_scrape_hot_path_p99_under_5ms():
+    """ISSUE 7 satellite acceptance: scrape_p99 < 5 ms restored. The
+    render pre-warmer fills the per-generation text+gzip cache right
+    behind each publish, so a scrape's cost is semaphore + cache lookup
+    + socket write. Measured end to end over HTTP against a published
+    registry, timeit.repeat style (best round's p99) so a co-tenant
+    noise burst can't fail the pin for the code's cost."""
+    import time
+    import urllib.request
+
+    from kube_gpu_stats_tpu import schema
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+
+    builder = SnapshotBuilder()
+    for chip in range(8):
+        labels = (("accel_type", "tpu-v5p"), ("chip", str(chip)),
+                  ("device_path", f"/dev/accel{chip}"), ("uuid", ""))
+        for spec in schema.PER_DEVICE_METRICS:
+            if spec.type is not schema.MetricType.HISTOGRAM:
+                builder.add(spec, 42.0, labels)
+    registry = Registry()
+    registry.publish(builder.build())
+    server = MetricsServer(registry, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        # Let the warmer fill the text + gzip entries for this
+        # generation (a first-scrape miss would render inline — still
+        # correct, just not the steady state this test prices).
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            _, hit = registry.rendered(gzip_level=3)
+            if hit:
+                break
+            time.sleep(0.01)
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/metrics",
+            headers={"Accept-Encoding": "gzip"})
+        best_p99 = float("inf")
+        for _ in range(3):
+            samples = []
+            for _ in range(40):
+                start = time.monotonic()
+                urllib.request.urlopen(request, timeout=5).read()
+                samples.append((time.monotonic() - start) * 1000.0)
+            samples.sort()
+            best_p99 = min(best_p99, samples[int(len(samples) * 0.99)])
+        assert best_p99 < 5.0, f"warm scrape p99 {best_p99:.2f} ms"
+    finally:
+        server.stop()
+
+
+def test_federation_root_refresh_under_budget():
+    """ISSUE 7 acceptance: 4096 simulated workers behind 64 leaf delta
+    sessions, root-hub WARM refresh p50 under 10 ms (best spaced
+    round's median — the bench's own statistic)."""
+    from kube_gpu_stats_tpu.bench import measure_delta_federation
+
+    result = measure_delta_federation()
+    assert result is not None
+    assert result["workers"] == 4096
+    assert result["root_merge_p50_ms"] < 10.0, result
 
 
 def test_render_cost_bounded_at_32_chip_full_label_scale():

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Federation smoke (ISSUE 7 satellite, `make federation-sim`): a real
+leaf/root hub tree over real daemons, driven end to end through the
+push-delta protocol:
+
+- N daemons (full Daemon wiring: TPU backend over make_sysfs +
+  FakeLibtpuServer, FakeKubelet attribution) split across two LEAF
+  hubs; every daemon PUSHES deltas to its leaf (--hub-url wiring), and
+  each leaf pushes its merged rollup to one federation ROOT
+  (--federate) the same way. One daemon gets a scripted RPC delay —
+  the straggler.
+- Injected worker restart: one daemon's publisher is torn down and
+  replaced (new generation, seq chain reset) — the leaf must resync
+  via a FULL frame, not serve a stale seq chain.
+- Partitioned leaf: leaf B's publisher stops mid-run — the root's pull
+  fallback takes over for that target (the leaf's own scrape endpoint
+  keeps serving), so the rollup must still converge.
+
+Asserts: the root's merged exposition carries every slice's chips
+(converged after the restart and the partition), at least one resync
+was handled, the pull fallback actually served the partitioned leaf,
+and `doctor --fleet` at the ROOT still names the straggler node via
+the root -> leaf walk. Exit 0 with a PASS line, else 1 with evidence.
+Wired into `make ci` as a smoke job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.delta import DeltaPublisher
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    straggler_index = 0
+    daemons: list = []
+    fakes: list = []
+    hubs: list = []
+    servers: list = []
+    publishers: list = []
+
+    def start_hub(hub, **kwargs):
+        server = MetricsServer(
+            hub.registry, host="127.0.0.1", port=0,
+            trace_provider=hub.tracer, fleet_provider=hub.fleet,
+            ingest_provider=hub.delta.handle, **kwargs)
+        server.start()
+        hubs.append(hub)
+        servers.append(server)
+        return server
+
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            # --- daemons ------------------------------------------------
+            import os
+
+            node_urls = []
+            split = max(1, nodes // 2)
+            for node in range(nodes):
+                root = pathlib.Path(tmp) / f"node{node}"
+                make_sysfs(root / "sys", num_chips=2)
+                libtpu = FakeLibtpuServer(num_chips=2).start()
+                if node == straggler_index:
+                    libtpu.delay = delay
+                socket = str(root / "kubelet.sock")
+                kubelet = FakeKubeletServer(
+                    socket, [tpu_pod(f"train-{node}", "ml", "worker",
+                                     ["0", "1"])]).start()
+                fakes.extend([libtpu, kubelet])
+                cfg = Config(
+                    backend="tpu",
+                    sysfs_root=str(root / "sys"),
+                    libtpu_ports=(libtpu.port,),
+                    interval=0.1,
+                    deadline=2.0,
+                    listen_host="127.0.0.1",
+                    listen_port=0,
+                    attribution="podresources",
+                    kubelet_socket=socket,
+                    attribution_interval=0.5,
+                    pipeline_fetch=False,  # slow port lands in fetch_wait
+                    use_native=False,
+                )
+                # Distinct slice identity per leaf (TPU_NAME feeds the
+                # slice topology label; worker id disambiguates nodes):
+                # two slices pushing into one root must not collide on
+                # an empty slice label.
+                os.environ["TPU_NAME"] = f"sim-slice-{0 if node < split else 1}"
+                os.environ["TPU_WORKER_ID"] = str(node)
+                try:
+                    daemon = Daemon(cfg)
+                finally:
+                    os.environ.pop("TPU_NAME", None)
+                    os.environ.pop("TPU_WORKER_ID", None)
+                if node == straggler_index:
+                    daemon.collector._libtpu._client._rpc_timeout = 5.0
+                daemon.start()
+                daemons.append(daemon)
+                node_urls.append(
+                    f"http://127.0.0.1:{daemon.server.port}/metrics")
+            for daemon in daemons:
+                daemon.registry.wait_for_publish(0, timeout=10)
+
+            # --- two leaf hubs, push-only over the daemons ---------------
+            leaf_members = [node_urls[:split], node_urls[split:]]
+            leaf_urls = []
+            for members in leaf_members:
+                leaf = Hub([], targets_provider=lambda: [], interval=0.2,
+                           push_fence=2.0)
+                server = start_hub(leaf)
+                leaf_urls.append(f"http://127.0.0.1:{server.port}/metrics")
+            for members, leaf, leaf_url in zip(leaf_members, hubs[:2],
+                                               leaf_urls):
+                for url in members:
+                    daemon = daemons[node_urls.index(url)]
+                    pub = DeltaPublisher(
+                        daemon.registry,
+                        leaf_url.removesuffix("/metrics"),
+                        source=url, min_interval=0.05)
+                    pub.start()
+                    publishers.append(pub)
+
+            # --- the federation root over the two leaves -----------------
+            root_hub = Hub([], targets_provider=lambda: [], interval=0.2,
+                           federate=True, push_fence=1.0)
+            root_server = start_hub(root_hub)
+            leaf_pubs = []
+            for leaf, leaf_url in zip(hubs[:2], leaf_urls):
+                pub = DeltaPublisher(
+                    leaf.registry,
+                    f"http://127.0.0.1:{root_server.port}",
+                    source=leaf_url, min_interval=0.05)
+                pub.start()
+                leaf_pubs.append(pub)
+            publishers.extend(leaf_pubs)
+
+            def pump(n: int) -> None:
+                for _ in range(n):
+                    time.sleep(0.25)
+                    for leaf in hubs[:2]:
+                        leaf.refresh_once()
+                    root_hub.refresh_once()
+
+            pump(refreshes)
+
+            # --- injected worker restart (new generation -> resync) ------
+            victim = daemons[-1]
+            victim_url = node_urls[-1]
+            old_pub = next(p for p in publishers if p.source == victim_url)
+            old_pub.stop()
+            leaf_url = (leaf_urls[0] if victim_url in leaf_members[0]
+                        else leaf_urls[1])
+            leaf_of_victim = hubs[0 if victim_url in leaf_members[0] else 1]
+            full_before = leaf_of_victim.delta.full_frames_total
+            restarted = DeltaPublisher(
+                victim.registry, leaf_url.removesuffix("/metrics"),
+                source=victim_url, min_interval=0.05)
+            restarted.start()
+            publishers.append(restarted)
+
+            # --- partitioned leaf: its push to the root stops ------------
+            leaf_pubs[1].stop()
+            pump(refreshes)
+
+            # --- assertions ----------------------------------------------
+            problems = []
+            text = root_hub.registry.snapshot().render()
+            total_chips = sum(
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("slice_chips{"))
+            if total_chips != nodes * 2:
+                problems.append(
+                    f"root rollup has {total_chips} chips, want {nodes * 2}")
+            # The restarted worker re-anchored with a FULL frame (new
+            # generation, seq chain reset) — never a stale seq splice.
+            if leaf_of_victim.delta.full_frames_total <= full_before:
+                problems.append(
+                    f"leaf saw no full resync after the worker restart "
+                    f"(full frames {leaf_of_victim.delta.full_frames_total},"
+                    f" was {full_before})")
+            # The partitioned leaf is served by the root's PULL fallback.
+            if f'slice_target_up{{target="{leaf_urls[1]}"}} 1' not in text:
+                problems.append(
+                    f"partitioned leaf {leaf_urls[1]} not served by pull "
+                    f"fallback")
+            if root_hub._push_served < 1:
+                problems.append("root served no targets by push")
+
+            result = doctor.check_fleet(
+                f"http://127.0.0.1:{root_server.port}")
+            if verbose:
+                print(f"[{result.status}] fleet  {result.detail}")
+            straggler = node_urls[straggler_index]
+            if straggler not in result.detail:
+                problems.append(
+                    f"doctor --fleet walk did not name the straggler "
+                    f"{straggler}: {result.detail}")
+
+            if not problems:
+                print(f"federation-sim PASS: {nodes} daemons -> 2 leaves "
+                      f"-> 1 root converged ({int(total_chips)} chips), "
+                      f"worker restart resynced, partitioned leaf fell "
+                      f"back to pull, doctor named {straggler}")
+                return 0
+            print("federation-sim FAIL:")
+            for problem in problems:
+                print(f"  {problem}")
+            print(f"  doctor: [{result.status}] {result.detail}")
+            return 1
+        finally:
+            for pub in publishers:
+                pub.stop()
+            for server in servers:
+                server.stop()
+            for hub in hubs:
+                hub.stop()
+            for daemon in daemons:
+                daemon.stop()
+            for fake in fakes:
+                fake.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--refreshes", type=int, default=8)
+    parser.add_argument("--delay", type=float, default=0.8,
+                        help="scripted RPC delay injected on node 0's "
+                             "fake runtime (the straggler)")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.nodes, args.refreshes, args.delay, args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
