@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim
+.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -8,6 +8,7 @@ ci: native lint
 	python -m pytest tests/ -q -m 'not chaos'
 	python tools/fleet_sim.py
 	python tools/federation_sim.py
+	python tools/energy_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -53,6 +54,16 @@ fleet-sim:
 # walks root -> leaf -> node to name the straggler. In `make ci` too.
 federation-sim:
 	python tools/federation_sim.py --verbose
+
+# Energy/burst smoke (<30 s): a real daemon (TPU backend over the sysfs
+# fixture + fake libtpu, FakeKubelet attribution) with the burst
+# sampler continuous; injects a 50 ms power spike between ticks and
+# asserts the burst histogram catches it while the 1 Hz gauge provably
+# misses it, that per-pod joules survive a daemon restart (checkpoint
+# replay), and that `doctor --energy` verifies the signed digest and
+# refuses a wrong key. In `make ci` too.
+energy-sim:
+	python tools/energy_sim.py --verbose
 
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf

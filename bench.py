@@ -50,6 +50,21 @@ def _delta_fields(line: dict) -> None:
         line["delta_quiet_tick_ratio"] = quiet["ratio"]
 
 
+def _burst_fields(line: dict) -> None:
+    """Burst-sampler cost figures (ISSUE 8): tick-path fold overhead as
+    a percent of the 50 ms budget (the <2% CI pin, tests/test_latency),
+    the achieved sampling rate, and the sampling thread's own CPU share
+    (beside the loop, never inside it)."""
+    from kube_gpu_stats_tpu.bench import measure_burst_overhead
+
+    burst = measure_burst_overhead()
+    if burst is not None:
+        line["burst_overhead_pct"] = burst["burst_overhead_pct"]
+        line["burst_fold_ms_per_tick"] = burst["burst_fold_ms_per_tick"]
+        line["burst_samples_per_sec"] = burst["burst_samples_per_sec"]
+        line["burst_thread_cpu_pct"] = burst["burst_thread_cpu_pct"]
+
+
 def _merge_hub_fields(line: dict, measure_hub_merge) -> None:
     """Hub ingest/merge figures: the 64-worker shape is the BENCH
     trajectory's pinned number; 256 workers is the v5p-256
@@ -119,6 +134,7 @@ def _quick() -> int:
         line["fleet_score_ms_per_refresh"] = hub.get(
             "fleet_score_ms_per_refresh")
     _delta_fields(line)
+    _burst_fields(line)
     print(json.dumps(line))
     sys.stdout.flush()
     os._exit(0)
@@ -232,6 +248,7 @@ def main() -> int:
         }
     _merge_hub_fields(line, measure_hub_merge)
     _delta_fields(line)
+    _burst_fields(line)
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or
     # PJRT atexit hook) blocked in native code; the JSON line is already

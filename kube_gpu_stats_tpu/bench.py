@@ -781,6 +781,78 @@ def measure_delta_federation(leaves: int = 64, workers_per_leaf: int = 64,
         return None
 
 
+def measure_burst_overhead(ticks: int = 200, chips: int = 8,
+                           hz: float = 100.0, budget_ms: float = 50.0,
+                           thread_seconds: float = 1.0) -> dict | None:
+    """Burst-sampler cost (ISSUE 8), two components measured separately
+    because they live on different budgets:
+
+    - ``burst_overhead_pct``: the sampler's cost ON THE TICK PATH — the
+      drain + stats/histogram fold of one production interval's worth
+      of samples (hz per device), as a percent of the 50 ms tick
+      budget. Measured as the p50 tick wall delta between two identical
+      mock loops, one folding a full ring per tick, one with no
+      sampler. This is the number the <2% CI pin guards: the sampling
+      THREAD runs beside the loop and never inside it.
+    - ``burst_samples_per_sec``: achieved sampling rate of the real
+      thread at the configured hz over ``chips`` devices (expected
+      ~hz * chips; a shortfall means the read path can't keep rate).
+    - ``burst_thread_cpu_pct``: the sampling thread's CPU share while
+      armed (read_seconds_total / wall) — the beside-the-loop cost, for
+      the record.
+    """
+    try:
+        from .burstsampler import BurstSampler
+        from .collectors.mock import MockCollector
+
+        def tick_p50(with_burst: bool) -> float:
+            collector = MockCollector(chips)
+            devices = collector.discover()
+            sampler = (BurstSampler(lambda: collector, lambda: devices,
+                                    hz=hz, mode="continuous")
+                       if with_burst else None)
+            loop = PollLoop(collector, Registry(), deadline=budget_ms / 1e3,
+                            burst_sampler=sampler)
+            walls = []
+            per_device = max(1, int(hz))  # one 1 Hz interval's worth
+            for tick in range(ticks):
+                if sampler is not None:
+                    t = float(tick)
+                    for dev in devices:
+                        for i in range(per_device):
+                            sampler.inject(dev.device_id,
+                                           t + i / per_device, 100.0 + i)
+                start = time.perf_counter_ns()
+                loop.tick()
+                walls.append(time.perf_counter_ns() - start)
+            loop.stop()
+            walls.sort()
+            return _percentile(walls, 0.50) / 1e6  # ms
+
+        base_ms = tick_p50(False)
+        burst_ms = tick_p50(True)
+        overhead_ms = max(0.0, burst_ms - base_ms)
+
+        # Achieved rate of the real thread (mock read path).
+        collector = MockCollector(chips)
+        devices = collector.discover()
+        sampler = BurstSampler(lambda: collector, lambda: devices,
+                               hz=hz, mode="continuous")
+        sampler.start()
+        time.sleep(thread_seconds)
+        sampler.stop()
+        drained = sum(len(sampler.drain(d.device_id)) for d in devices)
+        return {
+            "burst_overhead_pct": round(100.0 * overhead_ms / budget_ms, 3),
+            "burst_fold_ms_per_tick": round(overhead_ms, 4),
+            "burst_samples_per_sec": round(drained / thread_seconds, 1),
+            "burst_thread_cpu_pct": round(
+                100.0 * sampler.read_seconds_total / thread_seconds, 2),
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
 def measure_quiet_tick_delta() -> dict | None:
     """Publisher-side payload pin: one realistic worker exposition, one
     quiet tick (two gauge twitches), FULL vs DELTA wire bytes — the

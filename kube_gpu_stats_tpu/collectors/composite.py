@@ -140,6 +140,12 @@ class TpuCollector(Collector):
                        and self._libtpu.device_persistently_down(device))),
         )
 
+    def read_burst(self, device: Device) -> float | None:
+        """Burst-sampler power read: power is an environment attribute,
+        so the sysfs half owns it (the runtime side has no sub-tick
+        surface to offer)."""
+        return self._sysfs.read_burst(device)
+
     def breakers(self):
         """Per-port runtime breakers (supervisor/doctor resilience)."""
         return self._libtpu.breakers()

@@ -63,6 +63,11 @@ class GpuSysfsCollector(Collector):
 
     def __init__(self, sysfs_root: str = "/sys") -> None:
         self._root = Path(sysfs_root)
+        # Burst-path cached power attribute per card (same contract as
+        # SysfsCollector.read_burst — the vestigial GPU backend grows
+        # the identical hooks so the multi-backend refactor lands the
+        # burst sampler once for every accelerator).
+        self._burst_paths: dict[str, str] = {}
 
     def _card_dir(self, device: Device) -> Path:
         return self._root / "class" / "drm" / f"card{device.index}"
@@ -118,6 +123,29 @@ class GpuSysfsCollector(Collector):
                             continue
                         return True
         return False
+
+    def read_burst(self, device: Device) -> float | None:
+        """Burst-sampler power read (watts), path cached per card —
+        hwmon power1_average in microwatts, the same attribute
+        sample() exports as accelerator_power_watts. None when the
+        card exposes no power attribute."""
+        path = self._burst_paths.get(device.device_id)
+        if path is not None:
+            try:
+                return float(Path(path).read_text().strip()) * 1e-6
+            except (OSError, ValueError):
+                del self._burst_paths[device.device_id]
+        card = self._card_dir(device)
+        for hit in sorted(glob.glob(
+                str(card / "device" / "hwmon" / "hwmon*"
+                    / "power1_average"))):
+            try:
+                value = float(Path(hit).read_text().strip()) * 1e-6
+            except (OSError, ValueError):
+                continue
+            self._burst_paths[device.device_id] = hit
+            return value
+        return None
 
     def sample(self, device: Device) -> Sample:
         card = self._card_dir(device)

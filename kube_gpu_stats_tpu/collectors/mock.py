@@ -20,6 +20,7 @@ from .. import schema
 
 _HBM_TOTAL = 95 * 1024**3  # v5p-class HBM capacity, bytes
 _LINKS = ("x0", "x1", "y0", "y1", "z0", "z1")  # v5p 3D-torus link names
+_BURST_BASE_WATTS = 90.0  # matches sample()'s idle power floor
 
 
 class MockCollector(Collector):
@@ -38,6 +39,20 @@ class MockCollector(Collector):
         # Per-device tick counters so each sample advances deterministically
         # regardless of call interleaving.
         self._ticks = [itertools.count(start_tick) for _ in range(num_devices)]
+        # Burst-path fake knob (ISSUE 8): the burst sampler reads this
+        # instead of sysfs on mock nodes. Default is the steady base
+        # draw; tests/sims install their own (device, t) -> watts to
+        # script sub-tick transients the 1 Hz sample() path never sees.
+        self.burst_power_fn = None  # None = flat _BURST_BASE_WATTS
+
+    def read_burst(self, device: Device, t: float | None = None) -> float:
+        """Burst-sampler power read (watts). ``t`` lets scripted
+        burst_power_fn knobs key the transient off the sampler's own
+        clock; the production sampler passes nothing and the default
+        returns the flat base draw."""
+        if self.burst_power_fn is not None:
+            return float(self.burst_power_fn(device, t))
+        return _BURST_BASE_WATTS
 
     def discover(self) -> Sequence[Device]:
         return [

@@ -67,6 +67,11 @@ class SysfsCollector(Collector):
                  accel_type: str | None = None) -> None:
         self._root = Path(sysfs_root)
         self._accel_type = accel_type if accel_type is not None else topology.accel_type()
+        # Resolved power-attribute path per device for the burst path:
+        # read_burst runs at 100 Hz+, where re-running the candidate
+        # glob per read would dominate the sample cost. Invalidated on
+        # read failure (hwmon renumbering after a driver reload).
+        self._burst_paths: dict[str, tuple[str, float]] = {}
 
     def accel_dir(self, device: Device) -> Path:
         return self._root / "class" / "accel" / f"accel{device.index}"
@@ -104,6 +109,31 @@ class SysfsCollector(Collector):
         if temp is not None:
             values[schema.TEMPERATURE.name] = temp
         return values
+
+    def read_burst(self, device: Device) -> float | None:
+        """One power reading in watts for the burst sampler
+        (burstsampler.py): the single hottest read in the process, so
+        the candidate glob resolves once per device and the steady
+        state is open/read/close on a cached path. None = no power
+        attribute (the sampler just skips the device)."""
+        cached = self._burst_paths.get(device.device_id)
+        if cached is not None:
+            path, scale = cached
+            try:
+                return float(Path(path).read_text().strip()) * scale
+            except (OSError, ValueError):
+                # hwmon renumbered / attribute vanished: re-resolve.
+                del self._burst_paths[device.device_id]
+        accel = self.accel_dir(device)
+        for pattern, scale in _POWER_CANDIDATES:
+            for path in sorted(glob.glob(str(accel / pattern))):
+                try:
+                    value = float(Path(path).read_text().strip()) * scale
+                except (OSError, ValueError):
+                    continue
+                self._burst_paths[device.device_id] = (path, scale)
+                return value
+        return None
 
     def sample(self, device: Device) -> Sample:
         return Sample(device=device, values=self.read_environment(device))

@@ -97,6 +97,25 @@ class Config:
     hub_url: str = ""
     hub_push_source: str = ""
     hub_push_interval: float = 1.0
+    # Delta-push transport hardening (ISSUE 8 satellite): credentials +
+    # TLS trust for the POSTs to --hub-url (hubs started with
+    # --auth-username / --tls-cert-file). Password rides in a file,
+    # re-read per push, never on the command line.
+    hub_auth_username: str = ""
+    hub_auth_password_file: str = ""
+    hub_ca_file: str = ""
+    hub_insecure_tls: bool = False
+    # Burst sampler + energy accounting (ISSUE 8 tentpole).
+    burst_mode: str = "auto"  # off | auto (demand/anomaly armed) |
+    #                           continuous
+    burst_hz: float = 100.0  # sampling rate while armed
+    burst_hold: float = 30.0  # seconds a demand/anomaly arm stays armed
+    burst_ring: int = 4096  # buffered samples per device
+    energy_checkpoint: str = ""  # path; empty = per-pod joules reset on
+    #                              restart (in-memory only)
+    energy_checkpoint_interval: float = 10.0
+    energy_audit_key: str = ""  # HMAC key signing the /debug/energy
+    #                             digest; empty = unsigned
 
     @property
     def textfile_enabled(self) -> bool:
@@ -221,6 +240,38 @@ def add_delta_push_flags(p: argparse.ArgumentParser) -> None:
                    help="minimum seconds between delta pushes (each "
                         "push follows a snapshot publish; backs off "
                         "under consecutive failures)")
+    p.add_argument("--hub-auth-username",
+                   default=_env("HUB_AUTH_USERNAME", ""),
+                   help="basic-auth username sent with every delta "
+                        "push to --hub-url (hubs behind "
+                        "--auth-username); needs "
+                        "--hub-auth-password-file")
+    p.add_argument("--hub-auth-password-file",
+                   default=_env("HUB_AUTH_PASSWORD_FILE", ""),
+                   help="file holding the delta-push basic-auth "
+                        "password (re-read per push; rotations apply "
+                        "without a restart)")
+    p.add_argument("--hub-ca-file", default=_env("HUB_CA_FILE", ""),
+                   help="CA bundle verifying an https --hub-url's TLS "
+                        "cert (hubs behind --tls-cert-file signed by a "
+                        "private CA)")
+    p.add_argument("--hub-insecure-tls", action="store_true",
+                   default=_env_bool("HUB_INSECURE_TLS"),
+                   help="skip TLS verification of an https --hub-url "
+                        "(self-signed dev certs; prefer --hub-ca-file)")
+
+
+def validate_delta_push_args(args) -> str | None:
+    """Conflict rules for the shared delta-push transport flags; both
+    CLIs surface the string through their own parser.error."""
+    if bool(args.hub_auth_username) != bool(args.hub_auth_password_file):
+        return ("--hub-auth-username and --hub-auth-password-file must "
+                "be set together")
+    if args.hub_ca_file and args.hub_insecure_tls:
+        return "--hub-ca-file and --hub-insecure-tls are mutually exclusive"
+    if args.hub_push_interval <= 0:
+        return "--hub-push-interval must be > 0 seconds"
+    return None
 
 
 def validate_fleet_lens_args(args) -> str | None:
@@ -396,6 +447,45 @@ def build_parser() -> argparse.ArgumentParser:
                         "plaintext)")
     add_fleet_lens_flags(p)
     add_delta_push_flags(p)
+    p.add_argument("--burst-mode", choices=("off", "auto", "continuous"),
+                   default=_env("BURST_MODE", "auto"),
+                   help="sub-tick power burst sampler (burstsampler.py): "
+                        "'auto' arms on demand (/debug/burst?arm=N) or "
+                        "on power/duty anomaly events and disarms after "
+                        "--burst-hold; 'continuous' samples always; "
+                        "'off' disables the thread and the "
+                        "kts_power_burst_* families")
+    p.add_argument("--burst-hz", type=float,
+                   default=float(_env("BURST_HZ", "100.0")),
+                   help="burst sampling rate while armed (Hz); the "
+                        "achieved rate exports as "
+                        "rate(kts_power_burst_samples_total)")
+    p.add_argument("--burst-hold", type=float,
+                   default=float(_env("BURST_HOLD", "30.0")),
+                   help="seconds a demand/anomaly arm keeps the burst "
+                        "sampler running")
+    p.add_argument("--burst-ring", type=int,
+                   default=int(_env("BURST_RING", "4096")),
+                   help="burst samples buffered per device between poll "
+                        "ticks (oldest dropped at the cap)")
+    p.add_argument("--energy-checkpoint",
+                   default=_env("ENERGY_CHECKPOINT", ""),
+                   help="path persisting the per-pod joules accumulator "
+                        "(write-ahead + atomic rename) so "
+                        "kts_energy_pod_joules_total is monotone across "
+                        "daemon restarts; empty = in-memory only")
+    p.add_argument("--energy-checkpoint-interval", type=float,
+                   default=float(_env("ENERGY_CHECKPOINT_INTERVAL", "10.0")),
+                   help="minimum seconds between energy checkpoint "
+                        "writes (a final write always lands on clean "
+                        "shutdown)")
+    p.add_argument("--energy-audit-key",
+                   default=_env("ENERGY_AUDIT_KEY", ""),
+                   help="HMAC-SHA256 key signing the /debug/energy "
+                        "governance digest; the same key verifies it "
+                        "via `doctor --energy`. Empty serves the digest "
+                        "unsigned. Prefer the KTS_ENERGY_AUDIT_KEY env "
+                        "var (a flag value is visible in `ps`)")
     p.add_argument("--config", default=_env("CONFIG", ""),
                    help="YAML config file (keys = long flag names); "
                         "precedence: flags > KTS_* env > file > defaults")
@@ -526,8 +616,22 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
         parser.error(fleet_error)
-    if args.hub_push_interval <= 0:
-        parser.error("--hub-push-interval must be > 0 seconds")
+    push_error = validate_delta_push_args(args)
+    if push_error:
+        parser.error(push_error)
+    if args.burst_mode not in ("off", "auto", "continuous"):
+        # Env defaults bypass argparse choices, same class as
+        # --remote-write-protocol below.
+        parser.error(f"--burst-mode must be off, auto or continuous "
+                     f"(got {args.burst_mode!r})")
+    if args.burst_hz <= 0:
+        parser.error("--burst-hz must be > 0")
+    if args.burst_hold <= 0:
+        parser.error("--burst-hold must be > 0 seconds")
+    if args.burst_ring < 16:
+        parser.error("--burst-ring must be >= 16 samples")
+    if args.energy_checkpoint_interval <= 0:
+        parser.error("--energy-checkpoint-interval must be > 0 seconds")
     if bool(args.tls_cert_file) != bool(args.tls_key_file):
         parser.error("--tls-cert-file and --tls-key-file must be set together")
     if args.tls_client_ca_file and not args.tls_cert_file:
@@ -593,4 +697,15 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         hub_url=args.hub_url,
         hub_push_source=args.hub_push_source,
         hub_push_interval=args.hub_push_interval,
+        hub_auth_username=args.hub_auth_username,
+        hub_auth_password_file=args.hub_auth_password_file,
+        hub_ca_file=args.hub_ca_file,
+        hub_insecure_tls=args.hub_insecure_tls,
+        burst_mode=args.burst_mode,
+        burst_hz=args.burst_hz,
+        burst_hold=args.burst_hold,
+        burst_ring=args.burst_ring,
+        energy_checkpoint=args.energy_checkpoint,
+        energy_checkpoint_interval=args.energy_checkpoint_interval,
+        energy_audit_key=args.energy_audit_key,
     )

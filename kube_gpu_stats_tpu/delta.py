@@ -284,6 +284,24 @@ class DeltaEncoder:
         self._need_full = True
 
 
+def push_headers_provider(username: str, password_file: str):
+    """headers_provider for DeltaPublisher from the shared
+    --hub-auth-username/--hub-auth-password-file flags: the password
+    file is re-read per push (rotations apply without a restart, same
+    contract as the hub's pull-side --target-auth-* flags). None when
+    no credentials are configured."""
+    if not username:
+        return None
+
+    def provider() -> dict:
+        from .validate import auth_headers
+
+        return auth_headers(username=username,
+                            password_file=password_file)
+
+    return provider
+
+
 class DeltaPublisher(PublishFollower):
     """Publish-following delta push loop: on each registry publish,
     render (a per-generation cache hit — the scrape path pre-warms it),
@@ -297,15 +315,26 @@ class DeltaPublisher(PublishFollower):
     def __init__(self, registry, url: str, *, source: str,
                  min_interval: float = 1.0, timeout: float = 5.0,
                  headers_provider=None, render_stats=None, tracer=None,
+                 ca_file: str = "", insecure_tls: bool = False,
                  generation: int | None = None) -> None:
         super().__init__(registry, min_interval, thread_name="delta-push")
         self._url = url.rstrip("/") + INGEST_PATH
+        self._https = self._url.startswith("https://")
         self._timeout = timeout
+        # Transport hardening (ISSUE 8 satellite): headers_provider is
+        # called per push (file-backed credentials rotate without a
+        # restart); ca_file/insecure_tls shape the TLS context for an
+        # https hub — the same client options the hub's own pull path
+        # (validate.fetch_exposition) honors, so a hardened hub is
+        # reachable from both directions with one config vocabulary.
         self._headers_provider = headers_provider
+        self._ca_file = ca_file
+        self._insecure_tls = insecure_tls
         self._render_stats = render_stats
         self._tracer = tracer
         self._encoder = DeltaEncoder(source, generation)
         self.resyncs_total = 0
+        self.auth_failures_total = 0
         self.last_frame_bytes = 0
         self.last_frame_kind: int | None = None
 
@@ -324,14 +353,32 @@ class DeltaPublisher(PublishFollower):
             headers.update(self._headers_provider() or {})
         request = urllib.request.Request(
             self._url, data=wire, method="POST", headers=headers)
+        # Shared cached opener (validate._opener): always no-redirect
+        # like every push sender — a 302 must be a visible failure (and
+        # must never forward an Authorization header to a cross-origin
+        # Location) — plus the TLS context for https hubs.
+        authed = any(k.lower() == "authorization" for k in headers)
+        if self._https or authed:
+            from .validate import _opener
+
+            opener = _opener(self._https, self._ca_file,
+                             self._insecure_tls, True)
+        else:
+            opener = push_opener()
         try:
-            # No-redirect opener, like every push sender: a 302 must be
-            # a visible failure, not a silently body-less GET.
-            with push_opener().open(request, timeout=self._timeout):
+            with opener.open(request, timeout=self._timeout):
                 return "ok"
         except urllib.error.HTTPError as exc:
             if exc.code == 409:
                 return "resync"
+            if exc.code == 401:
+                # Credential problem, not a transport blip: count it
+                # separately so "the hub rejects our password" is
+                # distinguishable from "the hub is down" at a glance.
+                self.auth_failures_total += 1
+                log.warning("delta push unauthorized (HTTP 401): check "
+                            "--hub-auth-username/--hub-auth-password-file")
+                return "error"
             log.warning("delta push rejected (HTTP %d)", exc.code)
             return "error"
         except Exception as exc:  # noqa: BLE001 - transport failure
@@ -387,7 +434,8 @@ class _Session:
     — frames apply straight onto it at POST time, so the refresh thread
     pays replay, never apply."""
 
-    __slots__ = ("source", "generation", "seq", "last_monotonic", "frames")
+    __slots__ = ("source", "generation", "seq", "last_monotonic", "frames",
+                 "last_gap")
 
     def __init__(self, source: str) -> None:
         self.source = source
@@ -395,6 +443,16 @@ class _Session:
         self.seq = 0
         self.last_monotonic = 0.0
         self.frames = 0
+        # Seconds between the last two frames: the push path's
+        # freshness signal (the fleet lens scores it where the pull
+        # path scores scrape latency — a publisher falling behind its
+        # cadence shows up here refreshes before it goes fence-stale).
+        self.last_gap = 0.0
+
+    def stamp(self, now: float) -> None:
+        if self.last_monotonic:
+            self.last_gap = now - self.last_monotonic
+        self.last_monotonic = now
 
 
 class DeltaIngest:
@@ -490,7 +548,7 @@ class DeltaIngest:
                             source=frame.source)
                 session.generation = frame.generation
                 session.seq = frame.seq
-                session.last_monotonic = time.monotonic()
+                session.stamp(time.monotonic())
                 session.frames += 1
                 self.full_frames_total += 1
                 if entry is not None:
@@ -526,7 +584,7 @@ class DeltaIngest:
                         frame.source, f"slot {slot} out of range ({n})")
             entry.apply_patch(frame.slots, frame.values, frame.source)
             session.seq = frame.seq
-            session.last_monotonic = time.monotonic()
+            session.stamp(time.monotonic())
             session.frames += 1
             self.delta_frames_total += 1
 
@@ -552,6 +610,17 @@ class DeltaIngest:
         with self._lock:
             return [source for source, session in self._sessions.items()
                     if now - session.last_monotonic <= fence]
+
+    def frame_gaps(self) -> dict[str, float]:
+        """Last inter-arrival gap per live session, seconds — the
+        push-path freshness signal (ISSUE 8 satellite): a pushed target
+        pays no hub-side fetch, so scoring its 0.0 'scrape latency'
+        would blind the fleet lens to a publisher falling behind; the
+        frame gap is the honest equivalent. 0.0 until a session's
+        second frame."""
+        with self._lock:
+            return {source: session.last_gap
+                    for source, session in self._sessions.items()}
 
     def evict(self, alive: set) -> None:
         """Drop sessions for departed targets on the same refresh path

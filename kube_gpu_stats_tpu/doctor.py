@@ -31,6 +31,13 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  is a leaf hub, and its own post-mortem (guilty node,
                  worst phase, blamed port) is folded into the verdict
                  — root -> leaf -> node in one command.
+  --energy       pull the RUNNING daemon's /debug/energy governance
+                 digest (per-pod joules + burst coverage) and verify
+                 its HMAC with the locally configured
+                 --energy-audit-key. FAIL on a tampered/mismatched
+                 signature; WARN when either end runs unsigned. Uses
+                 the --url target's server when it is http(s), else
+                 the configured local listen port.
 
 Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
 2 = usage error. Every probe is time-bounded; doctor never hangs on a
@@ -638,6 +645,63 @@ def check_trace(base: str) -> CheckResult:
     return _result("trace", OK, detail, data=data)
 
 
+def check_energy(base: str, audit_key: str) -> CheckResult:
+    """--energy: read the RUNNING daemon's /debug/energy governance
+    digest and verify its HMAC with the locally configured
+    --energy-audit-key (the key never rides the wire — both ends hold
+    it out of band). FAIL on a signature mismatch (tampered payload, or
+    the two ends hold different keys — both are audit-trust failures);
+    WARN when either end runs unsigned."""
+    import urllib.error
+
+    from .energy import verify_payload
+
+    try:
+        digest = _fetch_json(base + "/debug/energy")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "energy", WARN,
+                f"{base}/debug/energy requires authentication "
+                f"(HTTP {exc.code}); the digest sits behind the "
+                f"exporter's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "energy", WARN,
+                f"{base}: no /debug/energy (exporter predates energy "
+                f"accounting, or no accountant is wired)")
+        return _result("energy", FAIL,
+                       f"{base}/debug/energy: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable daemon, bad JSON
+        return _result("energy", FAIL,
+                       f"{base}: energy digest unreadable ({exc})")
+    pods = digest.get("per_pod") or []
+    total = sum(float(row[2]) for row in pods if len(row) >= 3)
+    coverage = digest.get("coverage_ratio", 0.0)
+    summary = (f"{len(pods)} pod total(s), {total:.1f} J, "
+               f"burst coverage {coverage:.1%}")
+    data = {"digest": digest}
+    if not audit_key:
+        return _result(
+            "energy", WARN,
+            f"{summary}; digest NOT verified (no --energy-audit-key "
+            f"configured locally)", data=data)
+    if not digest.get("signed") or "hmac" not in digest:
+        return _result(
+            "energy", FAIL,
+            f"{summary}; daemon serves an UNSIGNED digest but a local "
+            f"audit key is configured — energy totals are not "
+            f"attestable", data=data)
+    if not verify_payload(digest, audit_key):
+        return _result(
+            "energy", FAIL,
+            f"{summary}; digest signature DOES NOT VERIFY — payload "
+            f"tampered in flight, or the daemon holds a different "
+            f"audit key", data=data)
+    return _result("energy", OK, f"{summary}; signature verified",
+                   data=data)
+
+
 def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
     """(status, detail line, data) for a /debug/fleet rollup: the
     slice post-mortem — worst node with its phase and blame, every
@@ -941,7 +1005,8 @@ def check_embedded_viability(cfg: Config) -> CheckResult:
 
 def run_checks(cfg: Config, url: str = "",
                trace: bool = False,
-               fleet: bool = False) -> list[CheckResult]:
+               fleet: bool = False,
+               energy: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -972,6 +1037,14 @@ def run_checks(cfg: Config, url: str = "",
         base = (trace_base(url) if url.startswith(("http://", "https://"))
                 else f"http://127.0.0.1:{cfg.listen_port}")
         probes.append(("trace", lambda: check_trace(base)))
+    if energy:
+        # Same live-daemon fallback as --trace: /debug/energy lives on
+        # the daemon's own server.
+        energy_base = (trace_base(url)
+                       if url.startswith(("http://", "https://"))
+                       else f"http://127.0.0.1:{cfg.listen_port}")
+        probes.append(("energy", lambda: check_energy(
+            energy_base, cfg.energy_audit_key)))
     if fleet:
         # The fleet lens lives on the HUB, not the daemon: an http(s)
         # --url names the hub to read; otherwise fall back to a local
@@ -1036,6 +1109,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     as_json = False
     trace = False
     fleet = False
+    energy = False
     url = ""
     args: list[str] = []
     it = iter(raw)
@@ -1046,6 +1120,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             trace = True
         elif token == "--fleet":
             fleet = True
+        elif token == "--energy":
+            energy = True
         elif token == "--url":
             url = next(it, "")
             if not url or url.startswith("--"):
@@ -1062,7 +1138,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             args.append(token)
     cfg = from_args(args)
     started = time.monotonic()
-    results = run_checks(cfg, url=url, trace=trace, fleet=fleet)
+    results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
+                         energy=energy)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

@@ -155,7 +155,8 @@ class MetricsServer:
                  render_stats: RenderStats | None = None,
                  ready_check=None, health_provider=None,
                  trace_provider=None, fleet_provider=None,
-                 ingest_provider=None, prewarm_renders: bool = True):
+                 ingest_provider=None, burst_provider=None,
+                 energy_provider=None, prewarm_renders: bool = True):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
@@ -178,6 +179,16 @@ class MetricsServer:
                          and hasattr(registry, "generation"))
         self._warm_stop = threading.Event()
         self._warm_thread: threading.Thread | None = None
+        # Burst sampler (burstsampler.BurstSampler, duck-typed:
+        # status()/arm()/disarm()): serves /debug/burst — read the arm
+        # state, or arm/disarm a sampling window on demand
+        # (?arm=<seconds> / ?disarm=1), behind the same basic-auth gate
+        # as /metrics. None = 404 (burst mode off, bare test servers).
+        self._burst = burst_provider
+        # Energy accountant (energy.EnergyAccountant, duck-typed:
+        # digest() -> dict): serves /debug/energy — the signed
+        # per-pod-joules governance digest `doctor --energy` verifies.
+        self._energy = energy_provider
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -474,6 +485,44 @@ class MetricsServer:
                             + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/burst" and outer._burst is not None:
+                    # Burst-sampler control + status (burstsampler.py):
+                    # ?arm=<seconds> opens a demand window, ?disarm=1
+                    # closes it, bare GET reads state. A GET with side
+                    # effects is deliberate here — doctor and curl are
+                    # the operator surface, and the action is bounded
+                    # (auto-disarms after the hold window) and
+                    # auth-gated like every non-probe path.
+                    import json
+
+                    params = self._query()
+                    verdict = {}
+                    if "arm" in params:
+                        try:
+                            seconds = float(params.get("arm") or 0.0)
+                        except ValueError:
+                            seconds = 0.0
+                        verdict["armed_for_s"] = outer._burst.arm(
+                            seconds if seconds > 0 else None)
+                    elif "disarm" in params:
+                        outer._burst.disarm()
+                        verdict["disarmed"] = True
+                    payload = outer._burst.status()
+                    payload.update(verdict)
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/energy" and outer._energy is not None:
+                    # Governance digest (energy.py): per-pod joules +
+                    # coverage, HMAC-signed when an audit key is
+                    # configured; `doctor --energy` verifies it.
+                    import json
+
+                    body = (json.dumps(outer._energy.digest(),
+                                       sort_keys=True) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -512,6 +561,10 @@ class MetricsServer:
                                   "/debug/events"]
                     if outer._fleet is not None:
                         links += ["/debug/fleet"]
+                    if outer._burst is not None:
+                        links += ["/debug/burst"]
+                    if outer._energy is not None:
+                        links += ["/debug/energy"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

@@ -220,6 +220,7 @@ def digest_from_series(series: Sequence) -> dict:
     digest (older exporter, --no-trace)."""
     phases: dict[str, dict[str, float]] = {}
     slowest: dict | None = None
+    burst_max: float | None = None
     for name, labels, value in series:
         if name == schema.TICK_PHASE_SECONDS.name:
             phase = labels.get("phase", "")
@@ -230,11 +231,21 @@ def digest_from_series(series: Sequence) -> dict:
                 "phase": labels.get("phase", ""),
                 "blame": labels.get("blame", ""),
             }
+        elif (name == schema.BURST_WATTS.name
+              and labels.get("stat") == "max"):
+            # Burst-aware power baseline (ISSUE 8): the node's sub-tick
+            # power peak, max over its chips — the 1 Hz power sum the
+            # lens also scores samples AT tick instants and aliases
+            # exactly the transients this surfaces.
+            if burst_max is None or value > burst_max:
+                burst_max = value
     out: dict = {}
     if phases:
         out["phases"] = phases
     if slowest is not None:
         out["slowest"] = slowest
+    if burst_max is not None:
+        out["burst_max_watts"] = burst_max
     return out
 
 
@@ -307,6 +318,13 @@ class FleetLens:
                  windows: Sequence[tuple[float, str]] = SLO_WINDOWS) -> None:
         # Journal feed (tracing.Tracer, duck-typed; None = no journal).
         self._tracer = tracer
+        # Burst auto-arm hook (ISSUE 8): called as hook(target, kind, z)
+        # on every power/duty-shaped anomaly RAISE (outside the lock,
+        # alongside the journal emit). Colocated/sim topologies wire it
+        # straight at a daemon's BurstSampler.arm; distributed setups
+        # rely on the journal-scan path instead (the daemon's sampler
+        # watches its own journal for fleet_anomaly events).
+        self.arm_hook = None
         self.z_threshold = z_threshold
         self.min_samples = min_samples
         self.miss_threshold = miss_threshold
@@ -363,6 +381,15 @@ class FleetLens:
                         state.digest = digests[target]
                     signals = self._signals(target, rows,
                                             fetch_seconds.get(target))
+                    burst_max = digests.get(target, {}).get(
+                        "burst_max_watts")
+                    if burst_max is not None:
+                        # Burst-aware power baseline: the target's
+                        # sub-tick peak joins its scored signals, so a
+                        # transient regime change (a chip starting to
+                        # spike between ticks) raises an anomaly even
+                        # while the tick-sampled power sum stays flat.
+                        signals["power_burst"] = burst_max
                     state.chips = len(rows) or state.chips
                     stale_chips = sum(1 for r in rows if r.up != 1.0)
                     fresh_bad += stale_chips
@@ -529,10 +556,19 @@ class FleetLens:
         self._worst = worst
 
     def _journal(self, events: list) -> None:
-        if self._tracer is None:
-            return
+        hook = self.arm_hook
         for kind, detail, attrs in events:
-            self._tracer.event(kind, detail, **attrs)
+            if self._tracer is not None:
+                self._tracer.event(kind, detail, **attrs)
+            if (hook is not None and kind == "fleet_anomaly"
+                    and attrs.get("anomaly") in ("power", "duty",
+                                                 "power_burst")):
+                try:
+                    hook(attrs.get("target", ""), attrs["anomaly"],
+                         attrs.get("z"))
+                except Exception:  # noqa: BLE001 - observer must not
+                    # kill the refresh that raised the anomaly.
+                    pass
 
     def evict(self, alive: set) -> None:
         """Drop state for departed targets (the hub's target-churn

@@ -124,6 +124,7 @@ del _fam
 # a delta touching one of these invalidates the digest, nothing else does.
 _DIGEST_PHASE = schema.TICK_PHASE_SECONDS.name
 _DIGEST_SLOWEST = schema.SLOWEST_TICK_SECONDS.name
+_DIGEST_BURST = schema.BURST_WATTS.name  # burst-aware power baseline
 
 # Compiled patch-action kinds (_TargetCache._compile_patch): what a
 # delta to a given slot must touch beyond the series views and plans.
@@ -307,7 +308,7 @@ class _TargetCache:
                         if self.rollup_plan is not None else -1)
         if name in _HIST_SUFFIXES:
             action = (_PATCH_HIST, None, None, chip_index, rollup_index)
-        elif name == _DIGEST_PHASE or name == _DIGEST_SLOWEST:
+        elif name in (_DIGEST_PHASE, _DIGEST_SLOWEST, _DIGEST_BURST):
             action = (_PATCH_DIGEST, None, None, chip_index, rollup_index)
         elif name.startswith("slice_"):
             action = (_PATCH_ROLLUP,
@@ -489,6 +490,9 @@ class Hub:
             entry_store=self._parse_cache)
             if delta_ingest else None)
         self._push_served = 0  # targets served by push, last refresh
+        # Federated slice_* series dropped because two leaves claimed
+        # the same slice identity (kts_hub_dup_slice_total).
+        self._dup_slice_total = 0
         self._cycle_seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -979,8 +983,22 @@ class Hub:
                     digest = entry.fleet_digest = \
                         fleetlens.digest_from_series(entry.series_dicts)
                 digests[target] = digest
+            # Push-aware fetch signal (ISSUE 8 satellite): a push-served
+            # target's 0.0 fetch_seconds says the HUB paid nothing, but
+            # scoring it would blind the lens to a publisher falling
+            # behind — feed the delta-frame inter-arrival gap as that
+            # target's freshness signal instead (same units: seconds of
+            # telemetry latency the fleet actually experienced).
+            fetch_signal = fetch_seconds
+            if self.delta is not None and push_entries:
+                gaps = self.delta.frame_gaps()
+                fetch_signal = dict(fetch_seconds)
+                for target in push_entries:
+                    gap = gaps.get(target)
+                    if gap:
+                        fetch_signal[target] = gap
             self.fleet.observe(self._cycle_seq, time.time(),
-                               self._targets, reachable, fetch_seconds,
+                               self._targets, reachable, fetch_signal,
                                frame, digests)
             tracer.add_span("fleet_score", fleet_mark)
         # The parse views are consumed exactly once: every derived
@@ -1092,6 +1110,13 @@ class Hub:
             builder.add(schema.HUB_RESYNC, float(self.delta.resyncs_total))
             builder.add(schema.DELTA_PUSH_TARGETS,
                         float(self._push_served))
+        if self._federate:
+            # Born at 0 on every federation root (increase() alerting):
+            # non-federate hubs never re-export slice_* series, so the
+            # collision class cannot exist there and the series stays
+            # absent.
+            builder.add(schema.HUB_DUP_SLICE,
+                        float(self._dup_slice_total))
         # Per-target breaker state: the hub's resilience self-metrics,
         # same families the daemon exports for its edges.
         for target in sorted(self._breakers):
@@ -1275,6 +1300,14 @@ class Hub:
             power = [r.power for r in rows if r.power is not None]
             if power:
                 builder.add(schema.HUB_POWER, sum(power), labels)
+            # Per-slice joules (ISSUE 8): sum of the per-chip energy
+            # counters over answered chips — a gauge under the dip
+            # policy (see the docstring); audit-grade per-pod totals
+            # live in each node's signed /debug/energy digest.
+            energies = [r.energy_total for r in rows
+                        if r.energy_total is not None]
+            if energies:
+                builder.add(schema.HUB_ENERGY, sum(energies), labels)
             # Gate on series presence, not value: an idle interconnect is
             # a 0 reading, not a vanished series (absent() alerting).
             if any(r.ici_links for r in rows):
@@ -1333,9 +1366,12 @@ class Hub:
         return keys, pairs, len(keys) != len(pairs), slot_map
 
     @staticmethod
-    def _replay_plan(plan: tuple, seen: set, emit: list | None) -> int:
+    def _replay_plan(plan: tuple, seen: set, emit: list | None,
+                     dup_sink: list | None = None) -> int:
         """Replay one built plan into ``emit`` against the cross-target
-        ``seen`` set; returns dropped-duplicate count."""
+        ``seen`` set; returns dropped-duplicate count. ``dup_sink``
+        collects the dropped keys (the federated-rollup replay wants to
+        name the colliding slice, not just count it)."""
         keys, pairs, self_dup, _slot_map = plan
         if not self_dup and seen.isdisjoint(keys):
             # The common case: this target claims no series identity
@@ -1349,6 +1385,8 @@ class Hub:
         for key, series in pairs:
             if key in seen:
                 duplicates += 1
+                if dup_sink is not None:
+                    dup_sink.append(key)
                 continue
             seen_add(key)
             if emit is not None:
@@ -1368,6 +1406,7 @@ class Hub:
         trivially correct."""
         seen: set[tuple] = set()
         duplicates = 0
+        rollup_dups: list = []
         for target, entry in entries:
             plan = entry.chip_plan
             if plan is None:
@@ -1379,8 +1418,40 @@ class Hub:
                 if rollup is None:
                     rollup = entry.rollup_plan = self._build_merge_plan(
                         target, entry.series, FEDERATED_SPECS)
-                duplicates += self._replay_plan(rollup, seen, rollup_emit)
+                duplicates += self._replay_plan(rollup, seen, rollup_emit,
+                                                rollup_dups)
+        if rollup_dups:
+            self._note_dup_slices(rollup_dups)
         return duplicates
+
+    def _note_dup_slices(self, dup_keys: list) -> None:
+        """Two leaves re-exported the same slice_* series identity
+        (shared slice label — misconfigured TPU_NAME, or a leaf listed
+        twice): first-wins silently drops the second leaf's series, so
+        this is the ONLY evidence (ISSUE 8 satellite). Counted in
+        kts_hub_dup_slice_total and journaled per slice, rate-limited —
+        a persistent misconfig collides every refresh and must not
+        flood the bounded journal out of its rarer events."""
+        self._dup_slice_total += len(dup_keys)
+        per_slice: dict[str, int] = {}
+        for _name, key in dup_keys:
+            labels = dict(key)
+            slice_name = labels.get("slice") or labels.get("target", "")
+            per_slice[slice_name] = per_slice.get(slice_name, 0) + 1
+        for slice_name in sorted(per_slice):
+            if log_every(f"hub:dup_slice:{slice_name}", 60.0):
+                self.tracer.event(
+                    "delta_dup_slice",
+                    f"slice {slice_name!r}: {per_slice[slice_name]} "
+                    f"federated rollup series dropped (two leaves share "
+                    f"the slice label; first leaf wins)",
+                    slice=slice_name, dropped=per_slice[slice_name])
+                log.warning(
+                    "hub: %d federated rollup series for slice %r "
+                    "dropped — two leaves share the slice label "
+                    "(repeats suppressed for 60s, kts_hub_dup_slice_total "
+                    "carries the count)",
+                    per_slice[slice_name], slice_name)
 
     def _merge_chip_series(self, builder: SnapshotBuilder,
                            entries: Sequence[tuple[str, _TargetCache]],
@@ -1727,6 +1798,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     # drift between the two CLIs. On a hub, --hub-url points at the
     # PARENT (root) hub of a federation tree.
     from .config import (add_delta_push_flags, add_fleet_lens_flags,
+                         validate_delta_push_args,
                          validate_fleet_lens_args)
 
     add_fleet_lens_flags(parser)
@@ -1735,6 +1807,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
         parser.error(fleet_error)
+    push_error = validate_delta_push_args(args)
+    if push_error:
+        parser.error(push_error)
 
     # A long-running service needs visible logs (refresh failures, dropped
     # duplicates, credential problems); mirrors the daemon's text format.
@@ -1865,7 +1940,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         # scrape URL so the root's pull fallback lands here.
         import socket as socket_mod
 
-        from .delta import DeltaPublisher
+        from .delta import DeltaPublisher, push_headers_provider
 
         senders.append(("delta", DeltaPublisher(
             hub.registry, args.hub_url,
@@ -1874,6 +1949,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                 f"{args.listen_port}/metrics"),
             min_interval=args.hub_push_interval,
             render_stats=render_stats,
+            headers_provider=push_headers_provider(
+                args.hub_auth_username, args.hub_auth_password_file),
+            ca_file=args.hub_ca_file,
+            insecure_tls=args.hub_insecure_tls,
             tracer=hub.tracer)))
 
     if args.once:

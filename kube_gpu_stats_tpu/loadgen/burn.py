@@ -172,7 +172,8 @@ def make_sharded_train_step(n_devices: int, *, d_model: int = 256,
 def run_burn(seconds: float = 10.0, size: int = 2048,
              report_every: float = 1.0, kernel: str = "xla",
              step_hook=None, depth: int = 16,
-             result: dict | None = None) -> int:
+             result: dict | None = None,
+             pulse_ms: float = 0.0) -> int:
     """Drive ALL local chips for `seconds`; returns steps executed.
     kernel: "xla" (sharded jnp matmul chain over every local device) or
     "pallas" (the hand-tiled MXU kernel composed with shard_map over
@@ -193,7 +194,15 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
     {"steps_per_s", "tflops_per_s", "devices", "size", "depth"} over a
     window that EXCLUDES compile and the first materialization batch
     (warmup) — wall-clock that includes compile understates a short
-    burn's throughput by whatever XLA took to compile."""
+    burn's throughput by whatever XLA took to compile.
+    ``pulse_ms`` > 0 duty-cycles the burn (ISSUE 8): burn hard for
+    ``pulse_ms`` milliseconds, idle for the same, repeating — the MXU
+    power transient this produces on real hardware rises and collapses
+    entirely BETWEEN 1 Hz poll ticks, which is exactly the signal the
+    burst sampler exists to catch and the plain gauge provably misses
+    (a sub-interval pulse has at most one tick instant inside it).
+    Throughput figures then describe the burning half only in spirit —
+    use pulses for transient generation, not roofline numbers."""
     import jax
 
     import jax.numpy as jnp
@@ -246,7 +255,17 @@ def run_burn(seconds: float = 10.0, size: int = 2048,
             steady_from = time.monotonic()
             steady_steps_base = steps
 
+    pulse_edge = start + pulse_ms / 1000.0 if pulse_ms > 0 else None
     while time.monotonic() - start < seconds:
+        if pulse_edge is not None and time.monotonic() >= pulse_edge:
+            # Close the pulse: materialize what's in flight (the chips
+            # actually finish — an async queue would smear the pulse),
+            # idle one pulse width, reopen.
+            float(jnp.sum(x))
+            inflight = 0
+            report_pending()
+            time.sleep(pulse_ms / 1000.0)
+            pulse_edge = time.monotonic() + pulse_ms / 1000.0
         x = step(x, w)
         steps += 1
         inflight += 1
@@ -359,6 +378,13 @@ def main(argv=None) -> int:
                              "8192): run a steady-state size sweep instead "
                              "of one burn and print a JSON row per size")
     parser.add_argument("--kernel", choices=("xla", "pallas"), default="xla")
+    parser.add_argument("--pulse-ms", type=float, default=0.0,
+                        help="duty-cycle the burn: burn PULSE_MS ms, "
+                             "idle PULSE_MS ms, repeat — produces "
+                             "sub-second power transients the 1 Hz "
+                             "gauge aliases and the burst sampler "
+                             "(kts_power_burst_*) catches; 0 = "
+                             "sustained burn")
     parser.add_argument("--mode", choices=("mxu", "ici"), default="mxu",
                         help="mxu: matmul burn; ici: ring-permute burn that "
                              "drives inter-chip traffic (C10 validation)")
@@ -408,7 +434,8 @@ def main(argv=None) -> int:
         else:
             result: dict = {}
             run_burn(args.seconds, args.size, kernel=args.kernel,
-                     step_hook=step_hook, depth=args.depth, result=result)
+                     step_hook=step_hook, depth=args.depth, result=result,
+                     pulse_ms=args.pulse_ms)
             if result:
                 import json
 

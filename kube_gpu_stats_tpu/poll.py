@@ -286,6 +286,8 @@ class PollLoop:
         use_tick_plan: bool = True,
         pipeline_fetch: bool = True,
         tracer: Tracer | None = None,
+        burst_sampler=None,
+        energy=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -353,6 +355,15 @@ class PollLoop:
         # (trace_overhead_ns_per_span) — with --no-trace as the escape
         # hatch (tracer.enabled False = every call a cheap no-op).
         self.tracer = tracer if tracer is not None else Tracer()
+        # Burst sampler + energy accountant (ISSUE 8): the tick drains
+        # each device's sub-tick power ring, hands the samples to the
+        # per-pod joules integrator (trapezoid over burst samples when
+        # armed, tick rectangle otherwise), and folds the ring into the
+        # kts_power_burst_* stats in the snapshot tail. None = the
+        # families stay absent (burst mode off / bare test loops).
+        self._burst = burst_sampler
+        self._energy_acct = energy
+        self._ckpt_future: concurrent.futures.Future | None = None
         self._tick_seq = 0
         # Pipeline-fence edge detection: the journal records the fence
         # EXPIRING and the fast path re-arming, not one event per tick
@@ -570,6 +581,13 @@ class PollLoop:
             self._rates.forget_device(device_id)
             for state in state_dicts:
                 state.pop(device_id, None)
+            # Burst ring/histogram + energy anchor go with the device: a
+            # renumbered chip must not inherit another chip's sub-tick
+            # distribution or integrate against its last power point.
+            if self._burst is not None:
+                self._burst.forget_device(device_id)
+            if self._energy_acct is not None:
+                self._energy_acct.forget_device(device_id)
         for device_id in [d for d in self._outstanding if d not in alive]:
             self._outstanding.pop(device_id).cancel()
 
@@ -1111,6 +1129,17 @@ class PollLoop:
         self._plans[dev.device_id] = plan
         return plan
 
+    def _observe_energy(self, plan: _DevicePlan, device_id: str,
+                        now: float, watts: float | None,
+                        bsamples) -> None:
+        """One device-tick into the energy accountant, attributed to
+        the pod the plan's kubelet join names RIGHT NOW (a rescheduled
+        pod's draw lands on the new owner from this tick on)."""
+        attribution = dict(plan.key)
+        self._energy_acct.observe(
+            device_id, attribution.get("pod", ""),
+            attribution.get("namespace", ""), now, watts, bsamples)
+
     # -- tick state update (the only mutating phase) -------------------------
 
     def _update_tick_state(
@@ -1140,14 +1169,30 @@ class PollLoop:
         runtime_fresh = (runtime_seq is None
                          or runtime_seq != self._runtime_seq_seen)
         self._runtime_seq_seen = runtime_seq
+        burst = self._burst
+        energy_acct = self._energy_acct
+        if burst is not None:
+            # Auto-arm on power/duty-shaped anomaly events that landed
+            # in the shared journal since the last tick (one cheap walk
+            # of the new entries; the arm itself edge-journals back).
+            burst.scan_journal()
         tick: list[_TickDevice] = []
         for dev, sample in results:
             plan = self._plan_for(dev)
             device_id = dev.device_id
+            bsamples = burst.drain(device_id) if burst is not None else ()
             holders = (tuple(openers(dev.device_path))
                        if openers is not None else None)
             stale = attr_stale or (sample is not None and sample.stale)
             if sample is None:
+                if energy_acct is not None and bsamples:
+                    # A stale tick observed no gauge power, but armed
+                    # burst samples ARE observations: integrate them
+                    # (no endpoint at `now` — the gauge saw nothing).
+                    self._observe_energy(plan, device_id, now, None,
+                                         bsamples)
+                if burst is not None:
+                    burst.fold(device_id, bsamples)
                 tick.append(_TickDevice(
                     dev, None, plan, stale,
                     self._last_totals.get(device_id),
@@ -1158,6 +1203,7 @@ class PollLoop:
                 ))
                 continue
             retained_total = None
+            power_value: float | None = None
             if schema.MEMORY_TOTAL.name not in sample.values:
                 # Degraded (runtime-not-ready) samples lack HBM capacity;
                 # the retained total keeps used/total ratios and capacity
@@ -1193,6 +1239,18 @@ class PollLoop:
                             self._energy.get(device_id, 0.0)
                             + value * gap)
                     self._last_power_at[device_id] = now
+                    power_value = value
+            if energy_acct is not None and (power_value is not None
+                                            or bsamples):
+                # Audit-grade per-pod accounting: trapezoid over the
+                # drained burst samples when armed, tick rectangle
+                # otherwise (power_value None = no gauge endpoint: a
+                # runtime-only sample's burst readings integrate alone,
+                # the same no-endpoint rule as stale ticks).
+                self._observe_energy(plan, device_id, now, power_value,
+                                     bsamples)
+            if burst is not None:
+                burst.fold(device_id, bsamples)
             ici_items = sorted(sample.ici_counters.items())
             if len(ici_items) > self._MAX_ICI_LINKS:
                 # Same threat class as the passthrough family cap: a
@@ -1362,10 +1420,26 @@ class PollLoop:
                         gbase + (("family", family), ("link", link)))
 
     def _contribute_shared(self, builder: SnapshotBuilder,
-                           device_count: int) -> None:
+                           tick: list[_TickDevice]) -> None:
         """Self-observability tail of every snapshot — one definition
         shared by the plan and legacy paths so the two can never drift."""
-        builder.add(schema.SELF_DEVICES, float(device_count))
+        builder.add(schema.SELF_DEVICES, float(len(tick)))
+        if self._burst is not None:
+            # kts_power_burst_* per device: the tick fold above already
+            # updated the stats; the chip label comes from the tick's
+            # device records so a renumbered chip re-labels with them.
+            self._burst.contribute(builder, {
+                rec.dev.device_id: (("chip", str(rec.dev.index)),)
+                for rec in tick
+            })
+        if self._energy_acct is not None:
+            self._energy_acct.contribute(builder)
+            # Checkpoint on the pool, never the tick path (the fsync is
+            # worth milliseconds); rate-limited inside the accountant,
+            # at most one write in flight.
+            if self._ckpt_future is None or self._ckpt_future.done():
+                self._ckpt_future = self._pool.submit(
+                    self._energy_acct.checkpoint)
         allocatable = getattr(self._attribution, "allocatable", None)
         if allocatable is not None:
             for resource, count in sorted(allocatable().items()):
@@ -1477,7 +1551,7 @@ class PollLoop:
                                     ("pod_uid", pod_uid)),
                         )
         device_series = builder.count
-        self._contribute_shared(builder, len(tick))
+        self._contribute_shared(builder, tick)
         total = builder.count
         # Allocation accounting (ISSUE 3 "pinned, not anecdotal"):
         # series_built counts Series objects actually constructed this

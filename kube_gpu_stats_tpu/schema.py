@@ -434,6 +434,18 @@ HUB_ICI_BANDWIDTH = MetricSpec(
     "slice.",
     extra_labels=("slice",),
 )
+HUB_ENERGY = MetricSpec(
+    "slice_energy_joules",
+    MetricType.GAUGE,
+    "Sum of per-chip accelerator_energy_joules_total over the chips of "
+    "the slice that answered the last refresh. A gauge, not a counter, "
+    "by the deliberate dip policy: a worker missing a refresh drops its "
+    "share (slice_target_up names it) and a counter dipping would "
+    "rate() as a phantom reset. For audit-grade per-pod totals that "
+    "survive restarts, read each node's /debug/energy digest "
+    "(kts_energy_pod_joules_total).",
+    extra_labels=("slice",),
+)
 HUB_WORKER_STEPS = MetricSpec(
     "slice_worker_steps_per_second",
     MetricType.GAUGE,
@@ -509,6 +521,16 @@ HUB_RESYNC = MetricSpec(
     "send one full snapshot and resume deltas. A steady rate here is a "
     "resync storm — see the federation runbook in docs/OPERATIONS.md.",
 )
+HUB_DUP_SLICE = MetricSpec(
+    "kts_hub_dup_slice_total",
+    MetricType.COUNTER,
+    "Federated slice_* rollup series a root hub dropped because another "
+    "leaf already re-exported the identical name+labels (two leaves "
+    "claiming one slice label — a misconfigured TPU_NAME or a leaf "
+    "listed twice). First leaf wins, the loser's series is silently "
+    "absent from the root, so this counter (and the delta_dup_slice "
+    "journal event naming the slice) is the only evidence.",
+)
 DELTA_PUSH_TARGETS = MetricSpec(
     "kts_delta_push_targets",
     MetricType.GAUGE,
@@ -534,11 +556,13 @@ FLEET_ANOMALIES = MetricSpec(
     "kts_fleet_anomalies_total",
     MetricType.COUNTER,
     "Anomalies the fleet lens has raised per target and kind since the "
-    "hub started (kind = the breached signal: duty/hbm/power/steps/"
-    "fetch/stale_fraction, or 'freshness' for a target missing several "
-    "refreshes running). Edge-counted — one per transition into "
-    "anomaly, not per anomalous refresh — so increase() counts "
-    "incidents, not their duration.",
+    "hub started (kind = the breached signal: duty/hbm/power/"
+    "power_burst/steps/fetch/stale_fraction, or 'freshness' for a "
+    "target missing several refreshes running; power_burst scores the "
+    "target's sub-tick burst peak, and fetch scores the delta-frame "
+    "inter-arrival gap for push-served targets). Edge-counted — one "
+    "per transition into anomaly, not per anomalous refresh — so "
+    "increase() counts incidents, not their duration.",
     extra_labels=("target", "kind"),
 )
 FLEET_SLO_BURN = MetricSpec(
@@ -591,6 +615,7 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_MEMORY_USED,
     HUB_MEMORY_TOTAL,
     HUB_POWER,
+    HUB_ENERGY,
     HUB_ICI_BANDWIDTH,
     HUB_WORKER_STEPS,
     HUB_STRAGGLER_RATIO,
@@ -600,6 +625,7 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     DELTA_FRAMES,
     DELTA_BYTES,
     HUB_RESYNC,
+    HUB_DUP_SLICE,
     DELTA_PUSH_TARGETS,
     FLEET_TARGETS_ANOMALOUS,
     FLEET_ANOMALIES,
@@ -745,6 +771,98 @@ RPC_BATCHED_FAMILIES = MetricSpec(
     "per-metric burst fallback — one pipelined RPC per family per port "
     "per tick instead of one per port.",
 )
+# Burst-sampler families (burstsampler.py, ISSUE 8): sub-tick power
+# shape from the high-rate sampling ring, folded at each poll tick so
+# Prometheus sees transients without sub-tick scrape rates. Per-device
+# (chip label); absent for a device until its first folded sample.
+
+BURST_WATTS = MetricSpec(
+    "kts_power_burst_watts",
+    MetricType.GAUGE,
+    "Per-device power statistics over the last poll tick's burst-sample "
+    "fold (stat = min/mean/max), from the 100 Hz+ sampling ring. The "
+    "max is the headline: a sub-second spike invisible to the 1 Hz "
+    "accelerator_power_watts gauge (it samples at tick instants) shows "
+    "up here at its true height. Holds the last armed window's values "
+    "between windows; kts_power_burst_samples_total says whether new "
+    "data arrived.",
+    extra_labels=("chip", "stat"),
+)
+BURST_HIST = MetricSpec(
+    "kts_power_burst_watts_distribution",
+    MetricType.HISTOGRAM,
+    "Cumulative fixed-bucket distribution of burst power samples per "
+    "device, in watts. The sub-tick shape series: "
+    "histogram_quantile() over it answers 'how often does this chip "
+    "spike past the breaker budget' at scrape-rate cost.",
+    extra_labels=("chip",),
+)
+BURST_SAMPLES = MetricSpec(
+    "kts_power_burst_samples_total",
+    MetricType.COUNTER,
+    "Burst samples folded into the per-device distribution since the "
+    "exporter started. rate() of this is the achieved sampling rate "
+    "while armed (compare --burst-hz); flat means the sampler is "
+    "disarmed.",
+    extra_labels=("chip",),
+)
+BURST_ARMED = MetricSpec(
+    "kts_power_burst_armed",
+    MetricType.GAUGE,
+    "1 while the burst sampler is armed (demand/anomaly window open, or "
+    "--burst-mode continuous), else 0.",
+)
+BURST_ARMS = MetricSpec(
+    "kts_power_burst_arms_total",
+    MetricType.COUNTER,
+    "Burst-sampler arm transitions by reason: 'demand' (/debug/burst or "
+    "doctor), 'anomaly' (auto-armed by a power/duty-shaped "
+    "fleet_anomaly event in the journal), 'continuous' (armed at "
+    "startup by --burst-mode continuous).",
+    extra_labels=("reason",),
+)
+
+# Energy-accounting families (energy.py, ISSUE 8): per-pod joules that
+# survive restarts, with an attestable signed digest at /debug/energy.
+
+ENERGY_POD = MetricSpec(
+    "kts_energy_pod_joules_total",
+    MetricType.COUNTER,
+    "Energy attributed to this pod on this node, in joules: per-device "
+    "power integrated trapezoidally over burst samples when the burst "
+    "sampler is armed (true transient area), rectangle over the tick "
+    "gauge otherwise, attributed through the kubelet device mapping at "
+    "integration time. Empty pod/namespace = unattributed draw. "
+    "MONOTONE ACROSS RESTARTS when --energy-checkpoint is set (the "
+    "write-ahead checkpoint replays on startup) — the audit-grade "
+    "companion to accelerator_energy_joules_total, which resets.",
+    extra_labels=("pod", "namespace"),
+)
+ENERGY_COVERAGE = MetricSpec(
+    "kts_energy_coverage_ratio",
+    MetricType.GAUGE,
+    "Fraction of integrated energy time covered by sub-tick burst "
+    "samples (0-1, cumulative). 1.0 = every joule was integrated over "
+    "100 Hz+ samples; near 0 = tick-rectangle fidelity only. Rides the "
+    "signed /debug/energy digest so an auditor can weight the bill's "
+    "fidelity.",
+)
+ENERGY_CHECKPOINT_WRITES = MetricSpec(
+    "kts_energy_checkpoint_writes_total",
+    MetricType.COUNTER,
+    "Energy checkpoint files written (wal + fsync + atomic rename). "
+    "Flat while --energy-checkpoint is set means persistence is "
+    "failing and a restart will lose the accumulated window — see the "
+    "warning log.",
+)
+ENERGY_CHECKPOINT_AGE = MetricSpec(
+    "kts_energy_checkpoint_age_seconds",
+    MetricType.GAUGE,
+    "Seconds since the last successful energy checkpoint write. Absent "
+    "until the first write; alert when it grows far past "
+    "--energy-checkpoint-interval.",
+)
+
 SELF_DEVICES = MetricSpec(
     "collector_devices",
     MetricType.GAUGE,
@@ -873,6 +991,15 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SLOWEST_TICK_SECONDS,
     TRACE_DROPPED_SPANS,
     RPC_BATCHED_FAMILIES,
+    BURST_WATTS,
+    BURST_HIST,
+    BURST_SAMPLES,
+    BURST_ARMED,
+    BURST_ARMS,
+    ENERGY_POD,
+    ENERGY_COVERAGE,
+    ENERGY_CHECKPOINT_WRITES,
+    ENERGY_CHECKPOINT_AGE,
     SELF_DEVICES,
     SELF_INFO,
     SELF_ALLOCATABLE,
@@ -905,6 +1032,15 @@ POLL_DURATION_BUCKETS: tuple[float, ...] = (
 # than a full poll tick, so the range shifts down one decade.
 SCRAPE_DURATION_BUCKETS: tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+)
+
+# Buckets for kts_power_burst_watts_distribution: watts, spanning an
+# idle mobile-class part (~25 W) through a v5p-class chip's sustained
+# draw (~500 W) up to inrush-transient territory — the top buckets are
+# where the breaker-budget question lives.
+BURST_WATTS_BUCKETS: tuple[float, ...] = (
+    25.0, 50.0, 75.0, 100.0, 150.0, 200.0, 250.0, 300.0, 400.0, 500.0,
+    750.0, 1000.0,
 )
 
 # Buckets for accelerator_workload_step_duration_seconds: training/serving

@@ -1,0 +1,405 @@
+"""Burst sampler (burstsampler.py, ISSUE 8): ring/fold mechanics, arm
+modes + journal events, poll-tick integration, the /debug/burst control
+endpoint, and the headline fault-injection acceptance: a scripted 50 ms
+power spike between ticks is invisible in accelerator_power_watts but
+appears in the kts_power_burst_* max/histogram series."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.burstsampler import BurstSampler
+from kube_gpu_stats_tpu.collectors import Collector, Device, Sample
+from kube_gpu_stats_tpu.collectors.mock import MockCollector
+from kube_gpu_stats_tpu.collectors.sysfs import SysfsCollector
+from kube_gpu_stats_tpu.poll import PollLoop
+from kube_gpu_stats_tpu.registry import Registry
+from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+from kube_gpu_stats_tpu.tracing import Tracer
+
+
+def get(snapshot, name, **want_labels):
+    out = []
+    for s in snapshot.series:
+        if s.spec.name != name:
+            continue
+        labels = dict(s.labels)
+        if all(labels.get(k) == v for k, v in want_labels.items()):
+            out.append((labels, s.value))
+    return out
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_sampler(collector=None, devices=None, clock=None, **kwargs):
+    collector = collector if collector is not None else MockCollector(2)
+    devices = devices if devices is not None else collector.discover()
+    return BurstSampler(lambda: collector, lambda: devices,
+                        clock=clock or FakeClock(), **kwargs)
+
+
+class SteadyPowerCollector(Collector):
+    """120 W at every tick instant — the 1 Hz view of a chip whose
+    spikes land between ticks."""
+
+    name = "steady"
+
+    def discover(self):
+        return [Device(0, "0", "/dev/accel0", "mock")]
+
+    def sample(self, device):
+        return Sample(device, {schema.POWER.name: 120.0})
+
+
+# -- ring + fold mechanics ---------------------------------------------------
+
+def test_drain_returns_and_clears():
+    sampler = make_sampler()
+    sampler.inject("0", 0.1, 100.0)
+    sampler.inject("0", 0.2, 200.0)
+    assert sampler.drain("0") == ((0.1, 100.0), (0.2, 200.0))
+    assert sampler.drain("0") == ()
+    assert sampler.drain("never-seen") == ()
+
+
+def test_ring_caps_buffered_samples():
+    sampler = make_sampler(ring=16)
+    for i in range(64):
+        sampler.inject("0", i * 0.01, float(i))
+    samples = sampler.drain("0")
+    assert len(samples) == 16
+    assert samples[-1][1] == 63.0  # newest kept, oldest dropped
+
+
+def test_fold_stats_and_histogram():
+    sampler = make_sampler()
+    sampler.fold("0", ((0.0, 90.0), (0.01, 900.0), (0.02, 120.0)))
+    stats = sampler.last_fold["0"]
+    assert stats["min"] == 90.0
+    assert stats["max"] == 900.0
+    assert stats["n"] == 3
+    assert sampler.samples_total["0"] == 3
+    # An empty fold must hold, not clear, the last stats.
+    sampler.fold("0", ())
+    assert sampler.last_fold["0"]["max"] == 900.0
+
+
+def test_forget_device_purges_state():
+    sampler = make_sampler()
+    sampler.inject("0", 0.0, 100.0)
+    sampler.fold("0", ((0.0, 100.0),))
+    sampler.forget_device("0")
+    assert sampler.drain("0") == ()
+    assert "0" not in sampler.samples_total
+
+
+def test_read_once_uses_collector_read_burst():
+    mock = MockCollector(2)
+    mock.burst_power_fn = lambda dev, t: 150.0 + dev.index
+    sampler = make_sampler(collector=mock)
+    assert sampler._read_once() == 2
+    assert sampler.drain("0") == ((0.0, 150.0),)
+    assert sampler.drain("1") == ((0.0, 151.0),)
+
+
+def test_read_once_tolerates_backends_without_read_burst():
+    class Bare(Collector):
+        name = "bare"
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):  # pragma: no cover
+            raise NotImplementedError
+
+    bare = Bare()
+    sampler = make_sampler(collector=bare, devices=bare.discover())
+    assert sampler._read_once() == 0
+
+
+def test_sysfs_read_burst_matches_sample_and_caches_path(tmp_path):
+    make_sysfs(tmp_path, num_chips=2, power_uw=120_000_000)
+    collector = SysfsCollector(tmp_path)
+    dev = collector.discover()[0]
+    assert collector.read_burst(dev) == pytest.approx(120.0)
+    # Parity with the 1 Hz environment read.
+    assert collector.read_environment(dev)[schema.POWER.name] == \
+        pytest.approx(120.0)
+    # Cached path serves a changed value without re-globbing.
+    power_file = (tmp_path / "class" / "accel" / "accel0" / "device"
+                  / "hwmon" / "hwmon0" / "power1_average")
+    power_file.write_text("900000000\n")
+    assert collector.read_burst(dev) == pytest.approx(900.0)
+    # A vanished attribute re-resolves (returns None, no crash).
+    power_file.unlink()
+    assert collector.read_burst(dev) is None
+
+
+# -- arming ------------------------------------------------------------------
+
+def test_arm_modes_and_journal_events():
+    clock = FakeClock()
+    tracer = Tracer()
+    sampler = make_sampler(clock=clock, tracer=tracer, hold=30.0)
+    assert not sampler.armed
+    sampler.arm()
+    assert sampler.armed
+    clock.t = 29.0
+    assert sampler.armed
+    clock.t = 31.0
+    assert not sampler.armed
+    sampler.arm(5.0, reason="anomaly")
+    sampler.disarm()
+    assert not sampler.armed
+    kinds = [e["kind"] for e in tracer.events()["events"]]
+    assert kinds == ["burst_arm", "burst_arm", "burst_disarm"]
+    assert sampler.arms_total == {"demand": 1, "anomaly": 1}
+
+
+def test_continuous_mode_always_armed():
+    clock = FakeClock()
+    sampler = make_sampler(clock=clock, mode="continuous")
+    clock.t = 1e9
+    assert sampler.armed
+    sampler.disarm()
+    assert sampler.armed  # continuous has no disarmed state
+
+
+def test_scan_journal_auto_arms_on_power_anomaly():
+    tracer = Tracer()
+    sampler = make_sampler(tracer=tracer)
+    tracer.event("fleet_anomaly", "node-3: duty breached", anomaly="duty",
+                 target="node-3")
+    sampler.scan_journal()
+    assert sampler.armed
+    assert sampler.arms_total == {"anomaly": 1}
+
+
+def test_scan_journal_ignores_unrelated_anomalies():
+    tracer = Tracer()
+    sampler = make_sampler(tracer=tracer)
+    tracer.event("fleet_anomaly", "node-3: hbm breached", anomaly="hbm",
+                 target="node-3")
+    tracer.event("breaker", "libtpu:8431: closed -> open")
+    sampler.scan_journal()
+    assert not sampler.armed
+    # Scans advance past consumed events — a later power anomaly is a
+    # fresh trigger, earlier ones are never re-scanned.
+    tracer.event("fleet_anomaly", "node-4: power breached",
+                 anomaly="power", target="node-4")
+    sampler.scan_journal()
+    assert sampler.armed
+
+
+# -- poll integration + the fault-injection acceptance ------------------------
+
+def test_spike_between_ticks_invisible_at_1hz_visible_in_burst():
+    """The headline: a 50 ms 900 W spike strictly between ticks never
+    moves accelerator_power_watts (which reads 120 W at every tick
+    instant) but lands in the burst max + histogram at full height."""
+    reg = Registry()
+    clock = FakeClock()
+    sampler = make_sampler(collector=SteadyPowerCollector(), clock=clock)
+    loop = PollLoop(SteadyPowerCollector(), reg, deadline=5.0,
+                    burst_sampler=sampler, clock=clock)
+    clock.t = 1.0
+    loop.tick()
+    # The spike: 50 ms at 900 W between the t=1 and t=2 ticks, sampled
+    # at 100 Hz by the (test-driven) sampler thread.
+    for i in range(5):
+        sampler.inject("0", 1.5 + i * 0.01, 900.0)
+    clock.t = 2.0
+    loop.tick()
+    snap = reg.snapshot()
+    # 1 Hz gauge: flat 120 W — the spike is invisible by construction.
+    assert get(snap, schema.POWER.name)[0][1] == 120.0
+    # Burst series: the spike at its true height.
+    assert get(snap, schema.BURST_WATTS.name, stat="max")[0][1] == 900.0
+    assert get(snap, schema.BURST_WATTS.name, stat="mean")[0][1] == 900.0
+    assert get(snap, schema.BURST_SAMPLES.name, chip="0")[0][1] == 5.0
+    hist = [h for h in snap.histograms
+            if h.spec.name == schema.BURST_HIST.name]
+    assert len(hist) == 1
+    # 900 W lands in the (750, 1000] bucket.
+    bucket = schema.BURST_WATTS_BUCKETS.index(1000.0)
+    assert hist[0].counts[bucket] == 5
+    assert hist[0].total == 5
+    loop.stop()
+
+
+def test_burst_families_absent_without_sampler():
+    reg = Registry()
+    loop = PollLoop(MockCollector(1), reg, deadline=5.0)
+    loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, schema.BURST_ARMED.name) == []
+    assert get(snap, schema.BURST_WATTS.name) == []
+    loop.stop()
+
+
+def test_armed_gauge_and_arms_counter_exported():
+    reg = Registry()
+    clock = FakeClock()
+    sampler = make_sampler(collector=SteadyPowerCollector(), clock=clock)
+    loop = PollLoop(SteadyPowerCollector(), reg, deadline=5.0,
+                    burst_sampler=sampler, clock=clock)
+    loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, schema.BURST_ARMED.name)[0][1] == 0.0
+    sampler.arm(10.0)
+    loop.tick()
+    snap = reg.snapshot()
+    assert get(snap, schema.BURST_ARMED.name)[0][1] == 1.0
+    assert get(snap, schema.BURST_ARMS.name, reason="demand")[0][1] == 1.0
+    loop.stop()
+
+
+def test_rediscover_purges_departed_device_burst_state():
+    reg = Registry()
+    clock = FakeClock()
+    mock = MockCollector(2)
+    sampler = make_sampler(collector=mock, clock=clock)
+    loop = PollLoop(mock, reg, deadline=5.0, burst_sampler=sampler,
+                    clock=clock)
+    sampler.inject("1", 0.5, 500.0)
+    clock.t = 1.0
+    loop.tick()
+    assert "1" in sampler.samples_total
+    loop.replace_collector(MockCollector(1))
+    clock.t = 2.0
+    loop.tick()
+    assert "1" not in sampler.samples_total
+    loop.stop()
+
+
+def test_poll_scan_journal_auto_arm_end_to_end():
+    """A fleet_anomaly landing in the daemon's journal arms the sampler
+    on the next tick (the anomaly -> sub-tick-evidence loop)."""
+    reg = Registry()
+    clock = FakeClock()
+    tracer = Tracer()
+    sampler = make_sampler(collector=SteadyPowerCollector(), clock=clock,
+                           tracer=tracer)
+    loop = PollLoop(SteadyPowerCollector(), reg, deadline=5.0,
+                    burst_sampler=sampler, tracer=tracer, clock=clock)
+    loop.tick()
+    assert not sampler.armed
+    tracer.event("fleet_anomaly", "self: power breached", anomaly="power",
+                 target="self")
+    clock.t = 1.0
+    loop.tick()
+    assert sampler.armed
+    loop.stop()
+
+
+# -- /debug/burst ------------------------------------------------------------
+
+@pytest.fixture
+def burst_server():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    clock = FakeClock()
+    sampler = make_sampler(clock=clock)
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           burst_provider=sampler)
+    server.start()
+    yield server, sampler
+    server.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_debug_burst_status_arm_disarm(burst_server):
+    server, sampler = burst_server
+    base = f"http://127.0.0.1:{server.port}"
+    payload = _get_json(base + "/debug/burst")
+    assert payload["enabled"] and not payload["armed"]
+    payload = _get_json(base + "/debug/burst?arm=12.5")
+    assert payload["armed"] and payload["armed_for_s"] == 12.5
+    assert sampler.armed
+    payload = _get_json(base + "/debug/burst?disarm=1")
+    assert payload["disarmed"] and not sampler.armed
+    # Bare arm uses the default hold.
+    payload = _get_json(base + "/debug/burst?arm=")
+    assert payload["armed_for_s"] == sampler.hold
+
+
+def test_debug_burst_404_without_provider():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/burst", timeout=5)
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_debug_burst_behind_auth():
+    import base64
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    sampler = make_sampler(clock=FakeClock())
+    server = MetricsServer(
+        Registry(), host="127.0.0.1", port=0,
+        auth_username="ops",
+        # sha256("secret")
+        auth_password_sha256="2bb80d537b1da3e38bd30361aa855686bde0eacd"
+                             "7162fef6a25fe97bf527a25b",
+        burst_provider=sampler)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/burst?arm=5"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url, timeout=5)
+        assert err.value.code == 401
+        assert not sampler.armed  # the 401 must short-circuit the arm
+        request = urllib.request.Request(url, headers={
+            "Authorization": "Basic "
+            + base64.b64encode(b"ops:secret").decode()})
+        with urllib.request.urlopen(request, timeout=5) as resp:
+            assert resp.status == 200
+        assert sampler.armed
+    finally:
+        server.stop()
+
+
+# -- review-fix regressions --------------------------------------------------
+
+def test_inject_rejects_nonfinite_and_negative_samples():
+    """A garbage hwmon read parsing to inf/NaN/negative must not poison
+    the cumulative histogram sum or the joules integral downstream."""
+    sampler = make_sampler()
+    sampler.inject("0", 0.1, float("inf"))
+    sampler.inject("0", 0.2, float("nan"))
+    sampler.inject("0", 0.3, -5.0)
+    sampler.inject("0", 0.4, 100.0)
+    assert sampler.drain("0") == ((0.4, 100.0),)
+
+
+def test_arms_total_counts_transitions_not_extensions():
+    clock = FakeClock()
+    sampler = make_sampler(clock=clock, hold=30.0)
+    sampler.arm()
+    sampler.arm(60.0)           # extension of an open window: no count
+    sampler.arm(5.0, reason="anomaly")  # still armed: no count
+    assert sampler.arms_total == {"demand": 1}
+    clock.t = 100.0             # window lapsed
+    sampler.arm(reason="anomaly")
+    assert sampler.arms_total == {"demand": 1, "anomaly": 1}

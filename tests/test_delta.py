@@ -647,3 +647,189 @@ def test_differential_includes_histograms_and_rates_shape(tmp_path):
     finally:
         oracle.stop()
         push.stop()
+
+
+# --- transport hardening (ISSUE 8 satellite) --------------------------------
+
+def test_publisher_sends_auth_headers_and_handles_401(tmp_path):
+    """End-to-end authed push: a publisher with the configured
+    credentials lands frames behind the hub's basic-auth gate; bad (or
+    missing) credentials get a clean 401 counted as an auth failure,
+    never a crash or a silent drop."""
+    import base64
+    import hashlib
+
+    from kube_gpu_stats_tpu.delta import push_headers_provider
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    worker = Registry()
+    builder = SnapshotBuilder()
+    builder.add(schema.DEVICE_UP, 1.0, (("chip", "0"),))
+    worker.publish(builder.build())
+
+    password_file = tmp_path / "hub-pass"
+    password_file.write_text("hunter2\n")
+    hub = _push_hub()
+    server = MetricsServer(
+        hub.registry, host="127.0.0.1", port=0,
+        auth_username="pusher",
+        auth_password_sha256=hashlib.sha256(b"hunter2").hexdigest(),
+        ingest_provider=hub.delta.handle)
+    server.start()
+    url = f"http://127.0.0.1:{server.port}"
+    good = delta.DeltaPublisher(
+        worker, url, source="node-good",
+        headers_provider=push_headers_provider("pusher",
+                                               str(password_file)))
+    bad = delta.DeltaPublisher(worker, url, source="node-bad")
+    try:
+        good.push_once()
+        assert good.pushes_total == 1 and good.failures_total == 0
+        bad.push_once()
+        assert bad.pushes_total == 0
+        assert bad.failures_total == 1
+        assert bad.auth_failures_total == 1
+        # Only the authed source holds a session.
+        assert hub.delta.sources() == ["node-good"]
+        # Rotation: the password file is re-read per push.
+        password_file.write_text("rotated\n")
+        good.push_once()
+        assert good.auth_failures_total == 1  # old password now rejected
+    finally:
+        good.stop()
+        bad.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_push_headers_provider_none_without_username():
+    from kube_gpu_stats_tpu.delta import push_headers_provider
+
+    assert push_headers_provider("", "") is None
+    provider = push_headers_provider("u", "/nonexistent-password-file")
+    # Unreadable file degrades to no header (the hub's 401 is the
+    # visible failure), never a crash inside the push thread.
+    assert provider() == {}
+
+
+def test_publisher_https_tls_knobs_shape():
+    """ca_file/insecure_tls reach the shared opener cache; a https URL
+    with insecure_tls builds an opener whose HTTPS handler skips
+    verification (the handshake itself needs a live TLS server, which
+    the federation sim covers with real sockets for the authed hop)."""
+    import ssl
+
+    from kube_gpu_stats_tpu.validate import _opener
+
+    publisher = delta.DeltaPublisher(
+        Registry(), "https://hub.example:9401", source="n",
+        insecure_tls=True)
+    assert publisher._https and publisher._insecure_tls
+    opener = _opener(True, "", True, True)
+    https_handlers = [h for h in opener.handlers
+                      if h.__class__.__name__ == "HTTPSHandler"]
+    context = https_handlers[0]._context
+    assert context.verify_mode == ssl.CERT_NONE
+    publisher.stop()
+
+
+# --- root-side slice dedup (ISSUE 8 satellite) ------------------------------
+
+def test_federation_dup_slice_counted_and_journaled():
+    """Two leaves sharing a slice label: first-wins drops the second
+    leaf's rollups — the drop must be visible as kts_hub_dup_slice_total
+    plus a delta_dup_slice journal event naming the slice."""
+    from kube_gpu_stats_tpu.tracing import reset_log_marks
+
+    reset_log_marks()
+    hub = _push_hub(federate=True)
+    try:
+        leaf_a = delta.DeltaEncoder("leaf-a", generation=1)
+        leaf_b = delta.DeltaEncoder("leaf-b", generation=2)
+        assert _feed(hub, leaf_a, leaf_rollup_body())[0] == 200
+        assert _feed(hub, leaf_b, leaf_rollup_body())[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        # One copy of the colliding rollups survives (first leaf wins),
+        # and the drop is counted.
+        assert body.count('slice_chips{slice="s-a"}') == 1
+        dup_line = next(l for l in body.splitlines()
+                        if l.startswith("kts_hub_dup_slice_total"))
+        assert float(dup_line.rsplit(" ", 1)[1]) == 4.0  # 4 shared series
+        events = hub.tracer.events()["events"]
+        dup_events = [e for e in events if e["kind"] == "delta_dup_slice"]
+        # One event per colliding identity group: the 3 slice="s-a"
+        # rollups, plus the target-labeled slice_target_up both leaves
+        # re-exported.
+        by_slice = {e["attrs"]["slice"]: e["attrs"]["dropped"]
+                    for e in dup_events}
+        assert by_slice["s-a"] == 3
+        assert sum(by_slice.values()) == 4
+    finally:
+        hub.stop()
+
+
+def test_dup_slice_absent_on_healthy_federation():
+    hub = _push_hub(federate=True)
+    try:
+        leaf_a = delta.DeltaEncoder("leaf-a", generation=1)
+        assert _feed(hub, leaf_a, leaf_rollup_body())[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert "kts_hub_dup_slice_total 0" in body
+        assert not [e for e in hub.tracer.events()["events"]
+                    if e["kind"] == "delta_dup_slice"]
+    finally:
+        hub.stop()
+
+
+def test_dup_slice_family_absent_on_non_federate_hub():
+    hub = _push_hub()
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        hub.refresh_once()
+        assert "kts_hub_dup_slice_total" not in \
+            hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+
+
+# --- push-aware fleet fetch signal (ISSUE 8 satellite) ----------------------
+
+def test_frame_gap_tracked_per_session(monkeypatch):
+    clock = {"t": 100.0}
+    monkeypatch.setattr(time, "monotonic", lambda: clock["t"])
+    hub = _push_hub()
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        assert hub.delta.frame_gaps() == {"w0": 0.0}  # first frame: no gap
+        clock["t"] = 101.5
+        assert _feed(hub, encoder, make_body(0, 11.0))[0] == 200
+        assert hub.delta.frame_gaps() == {"w0": 1.5}
+    finally:
+        hub.stop()
+
+
+def test_fleet_lens_scores_frame_gap_for_push_targets(monkeypatch):
+    """A push-served target's fetch signal is the delta-frame
+    inter-arrival gap, not the pull path's 0.0 — a publisher falling
+    behind its cadence moves the scored signal."""
+    clock = {"t": 100.0}
+    monkeypatch.setattr(time, "monotonic", lambda: clock["t"])
+    hub = _push_hub(fleet_lens=True)
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        clock["t"] = 102.0
+        assert _feed(hub, encoder, make_body(0, 11.0))[0] == 200
+        hub.refresh_once()
+        state = hub.fleet.rollup()["targets"]["w0"]
+        assert state["signals"]["fetch"]["value"] == 2.0
+        # The exported slice_target_fetch_seconds stays 0.0: the HUB
+        # paid no fetch — only the lens's freshness signal changes.
+        body = hub.registry.snapshot().render()
+        assert 'slice_target_fetch_seconds{target="w0"} 0' in body
+    finally:
+        hub.stop()

@@ -1,0 +1,398 @@
+"""Energy accountant (energy.py, ISSUE 8): trapezoid-over-burst vs
+tick-rectangle integration, per-pod attribution, checkpoint persistence
+(monotone across restarts, torn-file recovery), the signed governance
+digest + tamper detection, and `doctor --energy` verification."""
+
+import json
+
+import pytest
+
+from kube_gpu_stats_tpu import doctor, schema
+from kube_gpu_stats_tpu.energy import (EnergyAccountant, sign_payload,
+                                       verify_payload)
+from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+
+
+def get(snapshot, name, **want_labels):
+    out = []
+    for s in snapshot.series:
+        if s.spec.name != name:
+            continue
+        labels = dict(s.labels)
+        if all(labels.get(k) == v for k, v in want_labels.items()):
+            out.append((labels, s.value))
+    return out
+
+
+# -- integration math --------------------------------------------------------
+
+def test_rectangle_between_tick_gauges():
+    acct = EnergyAccountant()
+    assert acct.observe("0", "p", "ns", 1.0, 100.0) == 0.0  # anchor only
+    # Trapezoid between two equal 100 W points over 1 s = 100 J.
+    assert acct.observe("0", "p", "ns", 2.0, 100.0) == pytest.approx(100.0)
+    # Ramp 100 -> 200 W over 1 s = 150 J.
+    assert acct.observe("0", "p", "ns", 3.0, 200.0) == pytest.approx(150.0)
+
+
+def test_trapezoid_over_burst_samples_catches_spike_area():
+    """A 50 ms 900 W spike between 120 W ticks: rectangle integration
+    sees ~120 J; the burst-aware integral adds the spike's true area."""
+    flat = EnergyAccountant()
+    flat.observe("0", "p", "ns", 1.0, 120.0)
+    flat_j = flat.observe("0", "p", "ns", 2.0, 120.0)
+    bursty = EnergyAccountant()
+    bursty.observe("0", "p", "ns", 1.0, 120.0)
+    spike = tuple((1.5 + i * 0.01, 900.0) for i in range(6))
+    burst_j = bursty.observe("0", "p", "ns", 2.0, 120.0, spike)
+    assert flat_j == pytest.approx(120.0)
+    # The spike plateau alone carries 900 W * 0.05 s = 45 J where the
+    # flat integral had 120 W * 0.05 = 6 J; edges add transition area.
+    assert burst_j > flat_j + 30.0
+
+
+def test_gap_capped_after_outage():
+    acct = EnergyAccountant(max_gap=10.0)
+    acct.observe("0", "p", "ns", 0.0, 100.0)
+    # A 1000 s outage must integrate at most max_gap's worth.
+    assert acct.observe("0", "p", "ns", 1000.0, 100.0) == \
+        pytest.approx(1000.0)  # 100 W * 10 s cap
+
+
+def test_stale_tick_integrates_burst_only():
+    acct = EnergyAccountant()
+    acct.observe("0", "p", "ns", 1.0, 100.0)
+    # No gauge reading, burst samples only: the samples integrate, no
+    # endpoint is fabricated at `now`.
+    joules = acct.observe("0", "p", "ns", 2.0, None,
+                          ((1.1, 100.0), (1.2, 100.0)))
+    assert joules == pytest.approx(0.2 * 100.0)
+
+
+def test_garbage_samples_ignored():
+    acct = EnergyAccountant()
+    acct.observe("0", "p", "ns", 1.0, 100.0)
+    joules = acct.observe(
+        "0", "p", "ns", 2.0, 100.0,
+        ((1.5, -5.0), (0.5, 100.0), (3.0, 100.0)))  # negative/old/future
+    assert joules == pytest.approx(100.0)
+
+
+def test_per_pod_attribution_follows_reschedule():
+    acct = EnergyAccountant()
+    acct.observe("0", "train-a", "ml", 1.0, 100.0)
+    acct.observe("0", "train-a", "ml", 2.0, 100.0)
+    # Pod rescheduled: the next tick's joules land on the new owner.
+    acct.observe("0", "train-b", "ml", 3.0, 100.0)
+    acct.observe("0", "", "", 4.0, 100.0)  # unattributed
+    builder = SnapshotBuilder()
+    acct.contribute(builder)
+    snap = builder.build()
+    assert get(snap, schema.ENERGY_POD.name, pod="train-a")[0][1] == \
+        pytest.approx(100.0)
+    assert get(snap, schema.ENERGY_POD.name, pod="train-b")[0][1] == \
+        pytest.approx(100.0)
+    assert get(snap, schema.ENERGY_POD.name, pod="")[0][1] == \
+        pytest.approx(100.0)
+
+
+def test_coverage_ratio_tracks_burst_share():
+    acct = EnergyAccountant(cover_gap=0.1)
+    acct.observe("0", "p", "ns", 0.0, 100.0)
+    acct.observe("0", "p", "ns", 1.0, 100.0)  # 1 s uncovered
+    acct.observe("0", "p", "ns", 2.0, 100.0,
+                 tuple((1.0 + i * 0.05, 100.0) for i in range(1, 20)))
+    assert 0.3 < acct.coverage_ratio < 0.6  # ~1 of ~2 s covered
+
+
+# -- checkpoint persistence ---------------------------------------------------
+
+def test_checkpoint_replay_keeps_counters_monotone(tmp_path):
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path)
+    acct.observe("0", "train", "ml", 1.0, 100.0)
+    acct.observe("0", "train", "ml", 2.0, 100.0)
+    assert acct.checkpoint(force=True)
+    # "Restart": a fresh accountant over the same path resumes totals.
+    reborn = EnergyAccountant(checkpoint_path=path)
+    assert reborn.checkpoint_loaded
+    builder = SnapshotBuilder()
+    reborn.contribute(builder)
+    assert get(builder.build(), schema.ENERGY_POD.name,
+               pod="train")[0][1] == pytest.approx(100.0)
+    # And keeps counting up from there — monotone across the restart.
+    reborn.observe("0", "train", "ml", 3.0, 100.0)
+    reborn.observe("0", "train", "ml", 4.0, 100.0)
+    builder = SnapshotBuilder()
+    reborn.contribute(builder)
+    assert get(builder.build(), schema.ENERGY_POD.name,
+               pod="train")[0][1] == pytest.approx(200.0)
+
+
+def test_checkpoint_rate_limited_and_forced(tmp_path):
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path, checkpoint_interval=3600)
+    acct.observe("0", "p", "ns", 1.0, 100.0)
+    acct.observe("0", "p", "ns", 2.0, 100.0)
+    assert acct.checkpoint()          # first write always lands
+    assert not acct.checkpoint()      # within the interval: skipped
+    acct.observe("0", "p", "ns", 3.0, 100.0)
+    assert acct.checkpoint(force=True)
+    assert acct.checkpoint_writes == 2
+
+
+def test_torn_main_file_recovers_from_wal(tmp_path):
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path)
+    acct.observe("0", "train", "ml", 1.0, 100.0)
+    acct.observe("0", "train", "ml", 2.0, 100.0)
+    acct.checkpoint(force=True)
+    # Simulate a crash mid-rename: main torn, wal intact.
+    wal_state = (tmp_path / "energy.json").read_text()
+    (tmp_path / "energy.json.wal").write_text(wal_state)
+    (tmp_path / "energy.json").write_text("{torn")
+    reborn = EnergyAccountant(checkpoint_path=path)
+    assert reborn.checkpoint_loaded
+    assert reborn.status()["pods"] == 1
+
+
+def test_unreadable_checkpoint_starts_at_zero(tmp_path):
+    path = str(tmp_path / "energy.json")
+    (tmp_path / "energy.json").write_text("not json")
+    acct = EnergyAccountant(checkpoint_path=path)
+    assert not acct.checkpoint_loaded
+    assert acct.status()["pods"] == 0
+
+
+# -- governance digest --------------------------------------------------------
+
+def test_signed_digest_verifies_and_tamper_fails():
+    acct = EnergyAccountant(audit_key="attest-key", node="node-1")
+    acct.observe("0", "train", "ml", 1.0, 100.0)
+    acct.observe("0", "train", "ml", 2.0, 100.0)
+    digest = acct.digest()
+    assert digest["signed"] and digest["node"] == "node-1"
+    assert verify_payload(digest, "attest-key")
+    assert not verify_payload(digest, "wrong-key")
+    tampered = dict(digest)
+    tampered["per_pod"] = [["train", "ml", 1.0]]  # bill shaved
+    assert not verify_payload(tampered, "attest-key")
+    # Round-trips through JSON (the wire format) unchanged.
+    wired = json.loads(json.dumps(digest))
+    assert verify_payload(wired, "attest-key")
+
+
+def test_unsigned_digest_never_verifies():
+    acct = EnergyAccountant()
+    digest = acct.digest()
+    assert not digest["signed"] and "hmac" not in digest
+    assert not verify_payload(digest, "any-key")
+    assert not verify_payload({**digest, "hmac": ""}, "any-key")
+
+
+def test_sign_payload_ignores_existing_hmac_field():
+    payload = {"a": 1, "hmac": "junk"}
+    assert sign_payload(payload, "k") == sign_payload({"a": 1}, "k")
+
+
+# -- /debug/energy + doctor --energy ------------------------------------------
+
+@pytest.fixture
+def energy_server():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    acct = EnergyAccountant(audit_key="attest-key", node="node-1")
+    acct.observe("0", "train", "ml", 1.0, 100.0)
+    acct.observe("0", "train", "ml", 2.0, 100.0)
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           energy_provider=acct)
+    server.start()
+    yield server, acct
+    server.stop()
+
+
+def test_doctor_energy_verifies_live_digest(energy_server):
+    server, _ = energy_server
+    result = doctor.check_energy(f"http://127.0.0.1:{server.port}",
+                                 "attest-key")
+    assert result.status == doctor.OK
+    assert "signature verified" in result.detail
+    assert "100.0 J" in result.detail
+
+
+def test_doctor_energy_fails_on_wrong_key(energy_server):
+    server, _ = energy_server
+    result = doctor.check_energy(f"http://127.0.0.1:{server.port}",
+                                 "other-key")
+    assert result.status == doctor.FAIL
+    assert "DOES NOT VERIFY" in result.detail
+
+
+def test_doctor_energy_warns_without_local_key(energy_server):
+    server, _ = energy_server
+    result = doctor.check_energy(f"http://127.0.0.1:{server.port}", "")
+    assert result.status == doctor.WARN
+    assert "NOT verified" in result.detail
+
+
+def test_doctor_energy_fails_on_unsigned_daemon_with_local_key():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    acct = EnergyAccountant()  # daemon side unsigned
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           energy_provider=acct)
+    server.start()
+    try:
+        result = doctor.check_energy(f"http://127.0.0.1:{server.port}",
+                                     "attest-key")
+        assert result.status == doctor.FAIL
+        assert "UNSIGNED" in result.detail
+    finally:
+        server.stop()
+
+
+def test_doctor_energy_warns_on_missing_endpoint():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        result = doctor.check_energy(f"http://127.0.0.1:{server.port}",
+                                     "attest-key")
+        assert result.status == doctor.WARN
+        assert "no /debug/energy" in result.detail
+    finally:
+        server.stop()
+
+
+def test_poll_wires_attribution_into_energy():
+    """End-to-end through the poll loop: per-pod joules ride the
+    kubelet attribution the tick plan already holds."""
+    from kube_gpu_stats_tpu.collectors import Collector, Device, Sample
+    from kube_gpu_stats_tpu.poll import PollLoop
+
+    class PowerCollector(Collector):
+        name = "power"
+
+        def discover(self):
+            return [Device(0, "0", "/dev/accel0", "mock")]
+
+        def sample(self, device):
+            return Sample(device, {schema.POWER.name: 200.0})
+
+    class StaticAttribution:
+        def lookup(self, device):
+            return {"pod": "train-7", "namespace": "ml",
+                    "container": "worker"}
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    acct = EnergyAccountant()
+    reg = Registry()
+    loop = PollLoop(PowerCollector(), reg, deadline=5.0,
+                    attribution=StaticAttribution(), energy=acct,
+                    clock=clock)
+    clock.t = 1.0
+    loop.tick()
+    clock.t = 2.0
+    loop.tick()
+    snap = reg.snapshot()
+    rows = get(snap, schema.ENERGY_POD.name, pod="train-7", namespace="ml")
+    assert rows and rows[0][1] == pytest.approx(200.0)
+    assert get(snap, schema.ENERGY_COVERAGE.name)[0][1] == 0.0
+    loop.stop()
+
+
+def test_inf_gauge_and_samples_rejected():
+    """Review fix: an inf integrand (garbage sysfs text parses to
+    float('inf')) must not make the per-pod counter — and the JSON
+    checkpoint — permanently non-finite."""
+    acct = EnergyAccountant()
+    acct.observe("0", "p", "ns", 1.0, 100.0)
+    joules = acct.observe("0", "p", "ns", 2.0, float("inf"),
+                          ((1.5, float("inf")),))
+    assert joules == 0.0
+    assert acct.observe("0", "p", "ns", 3.0, 100.0) == \
+        pytest.approx(200.0)  # 2 s gap from the t=1 anchor
+
+
+def test_concurrent_checkpoints_serialize(tmp_path):
+    """Review fix: the pool-submitted checkpoint and Daemon.stop's
+    forced one must serialize on the io lock — concurrent writers on
+    one .wal could publish a torn main file."""
+    import threading
+
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path)
+    for i in range(50):
+        acct.observe("0", "p", "ns", float(i), 100.0)
+    threads = [threading.Thread(target=acct.checkpoint, args=(True,))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    reborn = EnergyAccountant(checkpoint_path=path)
+    assert reborn.checkpoint_loaded  # main file parseable, never torn
+
+
+def test_crash_between_fsync_and_rename_recovers_newer_wal(tmp_path):
+    """Review fix: a .wal newer than main (crash after fsync, before
+    rename) must win the load — main alone would restart counters below
+    already-scraped values."""
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path)
+    acct.observe("0", "train", "ml", 1.0, 100.0)
+    acct.observe("0", "train", "ml", 2.0, 100.0)
+    acct.checkpoint(force=True)  # main at seq 2
+    acct.observe("0", "train", "ml", 3.0, 100.0)
+    # Simulate the torn second checkpoint: newer state fsynced to .wal,
+    # crash before the rename.
+    import json as json_mod
+    with acct._lock:
+        newer = acct._state()
+    (tmp_path / "energy.json.wal").write_text(json_mod.dumps(newer))
+    reborn = EnergyAccountant(checkpoint_path=path)
+    assert reborn.checkpoint_loaded
+    # The wal's newer state won (main stopped at seq 1: the first
+    # observe was anchor-only and never counted).
+    assert reborn.status()["seq"] == 2
+    assert reborn.status()["seq"] > 1
+
+
+def test_first_checkpoint_crash_recovers_from_wal_alone(tmp_path):
+    """Review fix: no main file at all (crash during the FIRST
+    checkpoint's rename) must still load the fsynced .wal, not start
+    at zero via the missing-main short-circuit."""
+    import json as json_mod
+
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant()
+    acct.observe("0", "train", "ml", 1.0, 100.0)
+    acct.observe("0", "train", "ml", 2.0, 100.0)
+    with acct._lock:
+        state = acct._state()
+    (tmp_path / "energy.json.wal").write_text(json_mod.dumps(state))
+    reborn = EnergyAccountant(checkpoint_path=path)
+    assert reborn.checkpoint_loaded
+    assert reborn.status()["pods"] == 1
+
+
+def test_daemon_derives_cover_gap_from_burst_hz():
+    """Review fix: coverage must follow --burst-hz — a 5 Hz sampler's
+    honest 0.2 s inter-sample gap counts as covered."""
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    daemon = Daemon(Config(backend="null", listen_port=0, burst_hz=5.0,
+                           attribution="off"))
+    try:
+        assert daemon.energy._cover_gap == pytest.approx(0.8)  # 4/hz
+    finally:
+        daemon.start()  # stop() on a never-started HTTP server hangs
+        daemon.stop()

@@ -762,3 +762,79 @@ def test_fleet_score_cost_under_budget():
     score = result["fleet_score_ms_per_refresh"]
     assert score is not None and score >= 0.0
     assert score < 25.0, f"fleet scoring {score} ms/refresh blows budget"
+
+
+# -- burst-aware power baseline + auto-arm hook (ISSUE 8) --------------------
+
+def test_digest_harvests_burst_max():
+    series = [
+        ("kts_power_burst_watts", {"chip": "0", "stat": "max"}, 450.0),
+        ("kts_power_burst_watts", {"chip": "1", "stat": "max"}, 610.0),
+        ("kts_power_burst_watts", {"chip": "0", "stat": "mean"}, 9999.0),
+        ("accelerator_up", {"chip": "0"}, 1.0),
+    ]
+    digest = digest_from_series(series)
+    # Max over chips, stat="max" rows only.
+    assert digest["burst_max_watts"] == 610.0
+
+
+def test_power_burst_signal_scored_and_raises():
+    """A target whose sub-tick burst peak shifts regime raises a
+    power_burst anomaly even while the tick-sampled power stays flat."""
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer, min_samples=3)
+    target = "http://w0/metrics"
+    for seq in range(1, 8):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target)],
+                 digests={target: {"burst_max_watts": 310.0}})
+    # The 1 Hz power stays 300 W (the _row default) but the sub-tick
+    # peak triples: only the burst signal can see it.
+    for seq in range(8, 11):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target)],
+                 digests={target: {"burst_max_watts": 950.0}})
+    rollup = lens.rollup()
+    assert "power_burst" in rollup["targets"][target]["anomalous"]
+    assert "power" not in rollup["targets"][target]["anomalous"]
+    raises = [e for e in tracer.events()["events"]
+              if e["kind"] == "fleet_anomaly"]
+    assert [e["attrs"]["anomaly"] for e in raises] == ["power_burst"]
+
+
+def test_arm_hook_fires_on_power_shaped_anomalies_only():
+    armed = []
+    lens = FleetLens(min_samples=3)
+    lens.arm_hook = lambda target, kind, z: armed.append((target, kind))
+    target = "w0"
+    for seq in range(1, 8):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target, duty=50.0)])
+    for seq in range(8, 11):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target, duty=2.0)])
+    assert armed == [(target, "duty")]
+    # An hbm-shaped anomaly must NOT arm (burst sampling answers power/
+    # duty questions only).
+    armed.clear()
+    lens2 = FleetLens(min_samples=3)
+    lens2.arm_hook = lambda target, kind, z: armed.append((target, kind))
+    for seq in range(1, 8):
+        _observe(lens2, seq, seq * 10.0, [target], [_row(target)])
+
+    def hbm_row(used):
+        row = _row(target)
+        row.mem_used = used
+        return row
+
+    for seq in range(8, 11):
+        _observe(lens2, seq, seq * 10.0, [target], [hbm_row(9e10)])
+    assert lens2.rollup()["targets"][target]["anomalous"]
+    assert armed == []
+
+
+def test_arm_hook_crash_does_not_kill_observe():
+    lens = FleetLens(min_samples=3)
+    lens.arm_hook = lambda *a: (_ for _ in ()).throw(RuntimeError("boom"))
+    target = "w0"
+    for seq in range(1, 8):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target, duty=50.0)])
+    for seq in range(8, 11):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target, duty=2.0)])
+    assert "duty" in lens.rollup()["targets"][target]["anomalous"]

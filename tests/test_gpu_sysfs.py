@@ -114,3 +114,55 @@ def test_telemetry_capable_requires_readable_values(tmp_path):
     assert col.telemetry_capable() is False
     (card / "gpu_busy_percent").write_text("42\n")
     assert col.telemetry_capable() is True
+
+
+# -- burst-path parity (ISSUE 8 satellite: the GPU backend grows the
+# -- same burst hooks as the TPU sysfs path, prep for ROADMAP item 4) --------
+
+def test_read_burst_matches_sample_power(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=2, power_uw=180_000_000)
+    col = GpuSysfsCollector(sysfs_root=str(tmp_path))
+    for dev in col.discover():
+        burst = col.read_burst(dev)
+        gauge = col.sample(dev).values[schema.POWER.name]
+        assert burst == pytest.approx(gauge)
+    assert col.read_burst(col.discover()[0]) == pytest.approx(180.0)
+
+
+def test_read_burst_caches_path_and_reresolves(tmp_path):
+    make_drm_sysfs(tmp_path, num_cards=1, power_uw=180_000_000)
+    col = GpuSysfsCollector(sysfs_root=str(tmp_path))
+    dev = col.discover()[0]
+    assert col.read_burst(dev) == pytest.approx(180.0)
+    power = (tmp_path / "class" / "drm" / "card0" / "device" / "hwmon"
+             / "hwmon1" / "power1_average")
+    power.write_text("900000000\n")
+    # Cached path serves the new value without a re-glob.
+    assert col.read_burst(dev) == pytest.approx(900.0)
+    power.unlink()
+    assert col.read_burst(dev) is None
+    # Attribute reappears (driver reload): re-resolved, not latched dead.
+    power.write_text("200000000\n")
+    assert col.read_burst(dev) == pytest.approx(200.0)
+
+
+def test_read_burst_none_without_power_attribute(tmp_path):
+    card = tmp_path / "class" / "drm" / "card0" / "device"
+    card.mkdir(parents=True)
+    (card / "gpu_busy_percent").write_text("42\n")
+    col = GpuSysfsCollector(sysfs_root=str(tmp_path))
+    assert col.read_burst(col.discover()[0]) is None
+
+
+def test_burst_sampler_runs_over_gpu_backend(tmp_path):
+    """The sampler composes with the GPU backend exactly as with the
+    TPU one — one read per card per pass into the per-device ring."""
+    from kube_gpu_stats_tpu.burstsampler import BurstSampler
+
+    make_drm_sysfs(tmp_path, num_cards=2, power_uw=180_000_000)
+    col = GpuSysfsCollector(sysfs_root=str(tmp_path))
+    devices = col.discover()
+    sampler = BurstSampler(lambda: col, lambda: devices)
+    assert sampler._read_once() == 2
+    assert sampler.drain("0")[0][1] == pytest.approx(180.0)
+    assert sampler.drain("1")[0][1] == pytest.approx(185.0)

@@ -1486,3 +1486,27 @@ def test_hub_target_breaker_opens_then_recovers(tmp_path):
         assert values(text, "slice_workers") == [2.0]
     finally:
         hub.stop()
+
+
+def test_hub_rolls_up_slice_energy_joules(tmp_path):
+    """Per-slice joules (ISSUE 8): sum of the per-chip energy counters
+    over answered chips; absent when no chip exports energy."""
+    line = ('accelerator_energy_joules_total'
+            '{chip="0",worker="{w}",slice="s"} {v}\n')
+    (tmp_path / "a.prom").write_text(
+        line.replace("{w}", "0").replace("{v}", "1200.5"))
+    (tmp_path / "b.prom").write_text(
+        line.replace("{w}", "1").replace("{v}", "800.0"))
+    (tmp_path / "c.prom").write_text(
+        'accelerator_power_watts{chip="0",worker="2",slice="s2"} 100\n')
+    hub = hub_mod.Hub([str(tmp_path / "a.prom"), str(tmp_path / "b.prom"),
+                       str(tmp_path / "c.prom")])
+    try:
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+    finally:
+        hub.stop()
+    assert values(text, "slice_energy_joules") == [2000.5]
+    rows = [labels for name, labels, _ in parse_exposition(text)
+            if name == "slice_energy_joules"]
+    assert rows == [{"slice": "s"}]

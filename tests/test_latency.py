@@ -77,6 +77,30 @@ def test_trace_overhead_within_hard_budget():
     assert ns < 25_000, f"span overhead {ns:.0f} ns/span blows the budget"
 
 
+def test_burst_fold_overhead_under_2pct_of_tick_budget():
+    """ISSUE 8 acceptance pin: the burst sampler's cost ON THE TICK
+    PATH — draining and folding one full 1 Hz interval's worth of
+    100 Hz samples across 8 chips — stays under 2% of the 50 ms tick
+    budget (measured ~0.3%). The sampling thread itself runs beside the
+    loop (its CPU share ships as burst_thread_cpu_pct), never inside
+    the tick. Best of 3 rounds, timeit.repeat style, so a co-tenant
+    noise burst can't fail the pin for the code's cost."""
+    from kube_gpu_stats_tpu.bench import measure_burst_overhead
+
+    best = None
+    for _ in range(3):
+        result = measure_burst_overhead(ticks=60, thread_seconds=0.3)
+        assert result is not None
+        if best is None or result["burst_overhead_pct"] < \
+                best["burst_overhead_pct"]:
+            best = result
+    assert best["burst_overhead_pct"] < 2.0, best
+    # The thread achieved a usable fraction of the configured rate
+    # (mock read path; a collapse here means the sampling loop itself
+    # regressed, not the box).
+    assert best["burst_samples_per_sec"] > 100.0, best
+
+
 def test_scrape_hot_path_p99_under_5ms():
     """ISSUE 7 satellite acceptance: scrape_p99 < 5 ms restored. The
     render pre-warmer fills the per-generation text+gzip cache right

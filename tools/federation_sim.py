@@ -178,8 +178,58 @@ def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
             leaf_pubs[1].stop()
             pump(refreshes)
 
+            # --- authed leaf -> root hop (ISSUE 8 satellite) -------------
+            # A second root behind basic auth: leaf A pushes with the
+            # configured credentials (password file, re-read per push),
+            # a credential-less publisher is refused with clean 401s.
+            import hashlib
+
+            from kube_gpu_stats_tpu.delta import push_headers_provider
+
+            authed_root = Hub([], targets_provider=lambda: [],
+                              interval=0.2, federate=True, push_fence=2.0)
+            authed_server = start_hub(
+                authed_root, auth_username="fed",
+                auth_password_sha256=hashlib.sha256(
+                    b"fed-secret").hexdigest())
+            pass_file = pathlib.Path(tmp) / "fed-pass"
+            pass_file.write_text("fed-secret\n")
+            authed_pub = DeltaPublisher(
+                hubs[0].registry,
+                f"http://127.0.0.1:{authed_server.port}",
+                source=leaf_urls[0], min_interval=0.05,
+                headers_provider=push_headers_provider(
+                    "fed", str(pass_file)))
+            unauthed_pub = DeltaPublisher(
+                hubs[1].registry,
+                f"http://127.0.0.1:{authed_server.port}",
+                source=leaf_urls[1] + "#unauthed", min_interval=0.05)
+            publishers.extend([authed_pub, unauthed_pub])
+            for _ in range(3):
+                authed_pub.push_once()
+                unauthed_pub.push_once()
+                time.sleep(0.05)
+            authed_root.refresh_once()
+
             # --- assertions ----------------------------------------------
             problems = []
+            if authed_pub.pushes_total < 1 or authed_pub.failures_total:
+                problems.append(
+                    f"authed leaf->root push did not land "
+                    f"(pushes {authed_pub.pushes_total}, failures "
+                    f"{authed_pub.failures_total})")
+            if authed_root.delta.full_frames_total < 1:
+                problems.append("authed root accepted no frames")
+            if "slice_chips{" not in \
+                    authed_root.registry.snapshot().render():
+                problems.append(
+                    "authed root re-exported no slice rollups")
+            if unauthed_pub.pushes_total or \
+                    unauthed_pub.auth_failures_total < 1:
+                problems.append(
+                    f"credential-less push was not refused with 401 "
+                    f"(pushes {unauthed_pub.pushes_total}, 401s "
+                    f"{unauthed_pub.auth_failures_total})")
             text = root_hub.registry.snapshot().render()
             total_chips = sum(
                 float(line.rsplit(" ", 1)[1])
@@ -217,7 +267,8 @@ def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
                 print(f"federation-sim PASS: {nodes} daemons -> 2 leaves "
                       f"-> 1 root converged ({int(total_chips)} chips), "
                       f"worker restart resynced, partitioned leaf fell "
-                      f"back to pull, doctor named {straggler}")
+                      f"back to pull, authed hop pushed + 401 refused, "
+                      f"doctor named {straggler}")
                 return 0
             print("federation-sim FAIL:")
             for problem in problems:
