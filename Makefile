@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim
+.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -9,6 +9,7 @@ ci: native lint
 	python tools/fleet_sim.py
 	python tools/federation_sim.py
 	python tools/energy_sim.py
+	python tools/host_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -64,6 +65,15 @@ federation-sim:
 # refuses a wrong key. In `make ci` too.
 energy-sim:
 	python tools/energy_sim.py --verbose
+
+# Host-correlation smoke (<30 s): N real daemons, each over a faked
+# /proc + /sys + cgroup v2 host fixture, one hub; after the fleet
+# lens's baselines warm, one node gets a simultaneous straggler tick
+# (scripted RPC delay) AND a memory-pressure episode (PSI full avg10
+# 0 -> 18%); asserts `doctor --fleet` names the node, its worst phase,
+# and the PSI co-occurrence in one correlated verdict. In `make ci`.
+host-sim:
+	python tools/host_sim.py --verbose
 
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf

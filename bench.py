@@ -65,6 +65,20 @@ def _burst_fields(line: dict) -> None:
         line["burst_thread_cpu_pct"] = burst["burst_thread_cpu_pct"]
 
 
+def _host_fields(line: dict) -> None:
+    """Host-signals collector cost (ISSUE 10): p50 of one full
+    HostStats.read() over a realistic fixture tree — pool-thread cost
+    per tick (off the tick budget by construction; the CI pin lives in
+    tests/test_latency.py)."""
+    from kube_gpu_stats_tpu.bench import measure_hoststats
+
+    host = measure_hoststats()
+    if host is not None:
+        line["hoststats_read_ms_per_tick"] = host[
+            "hoststats_read_ms_per_tick"]
+        line["hoststats_read_p99_ms"] = host["hoststats_read_p99_ms"]
+
+
 def _merge_hub_fields(line: dict, measure_hub_merge) -> None:
     """Hub ingest/merge figures: the 64-worker shape is the BENCH
     trajectory's pinned number; 256 workers is the v5p-256
@@ -135,6 +149,7 @@ def _quick() -> int:
             "fleet_score_ms_per_refresh")
     _delta_fields(line)
     _burst_fields(line)
+    _host_fields(line)
     print(json.dumps(line))
     sys.stdout.flush()
     os._exit(0)
@@ -249,6 +264,7 @@ def main() -> int:
     _merge_hub_fields(line, measure_hub_merge)
     _delta_fields(line)
     _burst_fields(line)
+    _host_fields(line)
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or
     # PJRT atexit hook) blocked in native code; the JSON line is already

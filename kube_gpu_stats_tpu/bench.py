@@ -853,6 +853,52 @@ def measure_burst_overhead(ticks: int = 200, chips: int = 8,
         return None
 
 
+def measure_hoststats(reads: int = 50, pods: int = 8) -> dict | None:
+    """Host-signals collector cost (ISSUE 10): p50 wall time of one full
+    HostStats.read() over a realistic fixture tree (PSI x3, /proc/stat,
+    /proc/softirqs, one NIC, one thermal zone, a throttle counter, and
+    ``pods`` pod cgroups). The read runs on the sampler pool during the
+    pipelined idle window — never inside the tick — so this prices the
+    pool occupancy per tick, not a tick-budget bite; the CI pin
+    (tests/test_latency.py, hoststats_read_ms_per_tick) keeps it small
+    enough that one pool worker absorbs it at 1 Hz."""
+    try:
+        import tempfile
+        import uuid as uuid_mod
+        from pathlib import Path as _Path
+
+        from .hoststats import HostStats
+        from .testing import host_fixture
+
+        with tempfile.TemporaryDirectory() as tmp:
+            roots = host_fixture.make_host_tree(_Path(tmp))
+            for i in range(1, pods):
+                host_fixture.write_pod_cgroup(
+                    roots["cgroup"],
+                    str(uuid_mod.uuid5(uuid_mod.NAMESPACE_DNS,
+                                       f"bench-pod-{i}")))
+            host = HostStats(proc_root=str(roots["proc"]),
+                             sysfs_root=str(roots["sysfs"]),
+                             cgroup_root=str(roots["cgroup"]))
+            host.read()  # warm caches / rate baselines
+            walls = []
+            for _ in range(reads):
+                start = time.perf_counter_ns()
+                snap = host.read()
+                walls.append(time.perf_counter_ns() - start)
+            walls.sort()
+            return {
+                "hoststats_read_ms_per_tick": round(
+                    _percentile(walls, 0.50) / 1e6, 4),
+                "hoststats_read_p99_ms": round(
+                    _percentile(walls, 0.99) / 1e6, 4),
+                "hoststats_families": len(snap.pressure)
+                + len(snap.pods),
+            }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
 def measure_quiet_tick_delta() -> dict | None:
     """Publisher-side payload pin: one realistic worker exposition, one
     quiet tick (two gauge twitches), FULL vs DELTA wire bytes — the

@@ -6,6 +6,7 @@ operational subcommands ride the same binary so a `kubectl exec` into the
 pod has them at hand:
 
     kube-tpu-stats doctor [exporter flags] [--json] [--url TARGET]
+                          [--trace] [--fleet] [--energy] [--host]
     kube-tpu-stats validate [--two-scrapes] <url-or-file>
     kube-tpu-stats top [targets...] [--interval N] [--once] [--json]
     kube-tpu-stats hub [targets...] [--listen-port N] [--rollups-only]

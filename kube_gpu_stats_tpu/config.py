@@ -116,6 +116,11 @@ class Config:
     energy_checkpoint_interval: float = 10.0
     energy_audit_key: str = ""  # HMAC key signing the /debug/energy
     #                             digest; empty = unsigned
+    # Host-signals collector (ISSUE 10): PSI/IRQ/NIC/thermal/cgroup
+    # stats read once per tick off the hot path, exported as kts_host_*
+    # and correlated by the hub's fleet lens + doctor --fleet.
+    host_stats: bool = True
+    cgroup_root: str = "/sys/fs/cgroup"  # cgroup v2 mount for per-pod stats
 
     @property
     def textfile_enabled(self) -> bool:
@@ -486,6 +491,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "via `doctor --energy`. Empty serves the digest "
                         "unsigned. Prefer the KTS_ENERGY_AUDIT_KEY env "
                         "var (a flag value is visible in `ps`)")
+    p.add_argument("--no-host-stats", action="store_true",
+                   default=_env_bool("NO_HOST_STATS"),
+                   help="disable the host-signals collector (PSI "
+                        "pressure, IRQ/softirq rates, NIC errors, "
+                        "thermal throttle, per-pod cgroup stats — the "
+                        "kts_host_* families and /debug/host; read once "
+                        "per tick off the hot path). The endpoint stays "
+                        "up and reports enabled:false")
+    p.add_argument("--cgroup-root", default=_env("CGROUP_ROOT",
+                                                 "/sys/fs/cgroup"),
+                   help="cgroup v2 mount the host-signals collector "
+                        "scans for kubelet pod cgroups (kts_host_pod_* "
+                        "families); v1-only hosts degrade to no pod "
+                        "families")
     p.add_argument("--config", default=_env("CONFIG", ""),
                    help="YAML config file (keys = long flag names); "
                         "precedence: flags > KTS_* env > file > defaults")
@@ -708,4 +727,6 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         energy_checkpoint=args.energy_checkpoint,
         energy_checkpoint_interval=args.energy_checkpoint_interval,
         energy_audit_key=args.energy_audit_key,
+        host_stats=not args.no_host_stats,
+        cgroup_root=args.cgroup_root,
     )

@@ -264,6 +264,27 @@ class Daemon:
             # understate its own fidelity to the auditor.
             cover_gap=max(DEFAULT_COVER_GAP, 4.0 / cfg.burst_hz),
         )
+        # Host-signals collector (ISSUE 10): PSI/IRQ/NIC/thermal/cgroup
+        # read once per tick on the poll loop's pool (never inside the
+        # tick budget), exported as kts_host_* and served at
+        # /debug/host. ALWAYS constructed — under --no-host-stats the
+        # disabled instance keeps the endpoint up answering
+        # enabled:false (the --no-trace contract). The per-pod cgroup
+        # join resolves pod UIDs to pod/namespace through the existing
+        # kubelet attribution mapping via device-holder processes.
+        from .hoststats import HostStats
+
+        self.hoststats = HostStats(
+            proc_root=cfg.proc_root,
+            sysfs_root=cfg.sysfs_root,
+            cgroup_root=cfg.cgroup_root,
+            pod_map=self._pod_uid_map,
+            enabled=cfg.host_stats,
+            # Capability-probe the optional eBPF runqueue source once
+            # at startup (cheap import check; refuses gracefully —
+            # /debug/host carries the reason).
+            probe_ebpf=cfg.host_stats,
+        )
         self.poll = PollLoop(
             self.collector,
             self.registry,
@@ -284,6 +305,7 @@ class Daemon:
             tracer=self.tracer,
             burst_sampler=self.burst,
             energy=self.energy,
+            host_stats=self.hoststats,
         )
         # Hung-tick watchdog threshold: same formula as healthz_max_age
         # (a few missed intervals; floor for tiny test intervals), so the
@@ -310,6 +332,7 @@ class Daemon:
             trace_provider=self.tracer,
             burst_provider=self.burst,
             energy_provider=self.energy,
+            host_provider=self.hoststats,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir,
@@ -375,6 +398,27 @@ class Daemon:
         setter = getattr(collector, "set_tracer", None)
         if callable(setter):
             setter(self.tracer)
+
+    def _pod_uid_map(self) -> dict[str, tuple[str, str]]:
+        """pod UID -> (pod, namespace) for the host collector's cgroup
+        join: a device whose holder process carries a pod UID (procopen's
+        cgroup parse) ties that UID to the kubelet attribution mapping's
+        pod name for the same device. Best-effort dict walks over cached
+        state — no RPC, safe from the host-read pool thread."""
+        if self.procwatch is None:
+            return {}
+        out: dict[str, tuple[str, str]] = {}
+        for dev in self.poll.devices:
+            attribution = self.attribution.lookup(dev)
+            pod = attribution.get("pod", "")
+            if not pod:
+                continue
+            namespace = attribution.get("namespace", "")
+            for _pid, _comm, pod_uid, _value in \
+                    self.procwatch.lookup(dev.device_path):
+                if pod_uid:
+                    out.setdefault(pod_uid, (pod, namespace))
+        return out
 
     def _collector_breakers(self):
         """Current collector's circuit breakers (late-bound: survives

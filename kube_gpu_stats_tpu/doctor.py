@@ -38,6 +38,13 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  signature; WARN when either end runs unsigned. Uses
                  the --url target's server when it is http(s), else
                  the configured local listen port.
+  --host         pull the RUNNING daemon's /debug/host snapshot
+                 (PSI pressure, IRQ/NIC rates, thermal throttle,
+                 per-pod cgroup stats) and summarize the host-side
+                 health picture — WARN on hot pressure shares,
+                 throttle/drop rates, or parse errors. The per-node
+                 companion of --fleet's correlated verdict; same
+                 server fallback as --trace.
 
 Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
 2 = usage error. Every probe is time-bounded; doctor never hangs on a
@@ -702,17 +709,122 @@ def check_energy(base: str, audit_key: str) -> CheckResult:
                    data=data)
 
 
+# Human rendering of the fleet lens's host_* anomaly kinds (the joined
+# verdict's vocabulary): kind -> (digest["host"] key, format).
+_HOST_KIND_TEXT = {
+    "host_mem_stall": ("mem_full_avg10", "PSI memory full-stall {:.1f}%"),
+    "host_cpu_stall": ("cpu_some_avg10", "PSI cpu some-stall {:.1f}%"),
+    "host_io_stall": ("io_full_avg10", "PSI io full-stall {:.1f}%"),
+    "host_nic_drops": ("nic_drop_rate", "NIC drops {:.1f}/s"),
+    "host_throttle": ("throttle_rate",
+                      "CPU thermal throttle {:.1f} events/s"),
+}
+
+
+def _host_verdict_bits(host_kinds: dict, digest_host: dict) -> str:
+    """Render active host anomalies with their CURRENT values from the
+    target's digest (falling back to the latched z when the digest has
+    no value — an older exporter's rollup)."""
+    bits = []
+    for kind in sorted(host_kinds):
+        key, template = _HOST_KIND_TEXT.get(
+            kind, (None, kind + " {:.1f}"))
+        value = (digest_host or {}).get(key) if key else None
+        if value is None:
+            bits.append(f"{kind} (z={host_kinds[kind]:g})")
+        else:
+            bits.append(template.format(value))
+    return " + ".join(bits)
+
+
+def check_host(base: str) -> CheckResult:
+    """--host: read the RUNNING daemon's /debug/host snapshot and
+    summarize the host-side health picture (PSI pressure, IRQ/NIC
+    rates, thermal throttle, per-pod cgroup stats, eBPF availability).
+    Same live-vs-fresh split as --trace: the daemon that has been
+    pressure-stalled for an hour carries the evidence, not a fresh
+    probe."""
+    import urllib.error
+
+    try:
+        payload = _fetch_json(base + "/debug/host")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "host", WARN,
+                f"{base}/debug/host requires authentication "
+                f"(HTTP {exc.code}); the host snapshot sits behind the "
+                f"exporter's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "host", WARN,
+                f"{base}: no /debug/host (exporter predates the host-"
+                f"signals collector, or this server has none wired)")
+        return _result("host", FAIL, f"{base}/debug/host: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable daemon, bad JSON
+        return _result("host", FAIL,
+                       f"{base}: host snapshot unreadable ({exc})")
+    if not payload.get("enabled", True):
+        return _result(
+            "host", WARN,
+            "host-signals collector disabled on the daemon "
+            "(--no-host-stats); no host snapshot to read")
+    if not payload.get("read_at"):
+        return _result(
+            "host", WARN,
+            "no host snapshot read yet; is the poll loop running?")
+    parts: list[str] = []
+    status = OK
+    pressure = payload.get("pressure") or {}
+    hot = {key: value for key, value in pressure.items()
+           if key.endswith("avg10") and value >= 5.0}
+    if hot:
+        status = WARN
+        parts.append("pressure: " + ", ".join(
+            f"{key}={value:g}%" for key, value in sorted(hot.items())))
+    elif pressure:
+        parts.append("pressure: all avg10 shares < 5%")
+    else:
+        parts.append("pressure: absent (pre-4.20 kernel?)")
+    throttle_rate = payload.get("throttle_rate")
+    if throttle_rate:
+        status = WARN
+        parts.append(f"CPU thermal throttle {throttle_rate:g}/s")
+    drop_rate = payload.get("nic_drop_rate")
+    if drop_rate:
+        status = WARN
+        parts.append(f"NIC drops {drop_rate:g}/s")
+    pods = payload.get("pods") or {}
+    parts.append(f"{len(pods)} pod cgroup(s)")
+    ebpf = payload.get("ebpf") or {}
+    if not ebpf.get("available", False):
+        parts.append(f"eBPF runq source off "
+                     f"({ebpf.get('reason', 'unavailable')})")
+    errors = payload.get("errors") or {}
+    if errors:
+        status = WARN if status is OK else status
+        parts.append("parse errors: " + ", ".join(
+            f"{reason}={count}" for reason, count in sorted(errors.items())))
+    return _result("host", status, "; ".join(parts),
+                   data={"host": payload})
+
+
 def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
     """(status, detail line, data) for a /debug/fleet rollup: the
     slice post-mortem — worst node with its phase and blame, every
     anomalous target with its anomaly kinds (and that target's own
-    worst phase from its digest), and the SLO burn windows. WARN when
+    worst phase from its digest), host correlation (ISSUE 10: a
+    target whose device-side anomaly or worst-phase attribution
+    co-occurs with a host_* anomaly in the same refresh window gets
+    the joined verdict, e.g. "node-7: fetch_wait spike co-occurs with
+    PSI memory full-stall 18%"), and the SLO burn windows. WARN when
     any anomaly is active or any burn window is over budget (burn >
     1.0). Pure so tests drive it on canned JSON; check_fleet wraps it
     with the fetch/auth/version classification."""
     parts: list[str] = []
     data: dict = {"attribution": payload.get("attribution"),
-                  "anomalous": {}, "slo": payload.get("slo", {})}
+                  "anomalous": {}, "correlated": {},
+                  "slo": payload.get("slo", {})}
     status = OK
     worst = payload.get("attribution")
     if worst:
@@ -745,6 +857,27 @@ def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
                 line += f", {slow['blame']}"
             line += "]"
         parts.append(line)
+        # Joined verdict: device-side slowness AND host pressure inside
+        # the same refresh window on the SAME node — the root-cause
+        # sentence the whole host-signals pipeline exists to print.
+        host_kinds = {k: z for k, z in anomalous.items()
+                      if k.startswith("host_")}
+        device_kinds = [k for k in anomalous
+                        if not k.startswith("host_") and k != "freshness"]
+        is_worst = bool(worst and worst.get("target") == target)
+        if host_kinds and (device_kinds or is_worst):
+            phase = (slow.get("phase")
+                     or (worst.get("phase") if is_worst else "")
+                     or (device_kinds[0] if device_kinds else "slow"))
+            host_text = _host_verdict_bits(host_kinds,
+                                           digest.get("host") or {})
+            parts.append(f"{target}: {phase} spike co-occurs with "
+                         f"{host_text}")
+            data["correlated"][target] = {
+                "phase": phase,
+                "host": dict(host_kinds),
+                "host_values": dict(digest.get("host") or {}),
+            }
     burns = []
     for objective, state in sorted((payload.get("slo") or {}).items()):
         windows = state.get("windows") or {}
@@ -1006,7 +1139,8 @@ def check_embedded_viability(cfg: Config) -> CheckResult:
 def run_checks(cfg: Config, url: str = "",
                trace: bool = False,
                fleet: bool = False,
-               energy: bool = False) -> list[CheckResult]:
+               energy: bool = False,
+               host: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -1045,6 +1179,13 @@ def run_checks(cfg: Config, url: str = "",
                        else f"http://127.0.0.1:{cfg.listen_port}")
         probes.append(("energy", lambda: check_energy(
             energy_base, cfg.energy_audit_key)))
+    if host:
+        # Same live-daemon fallback as --trace: /debug/host lives on
+        # the daemon's own server.
+        host_base = (trace_base(url)
+                     if url.startswith(("http://", "https://"))
+                     else f"http://127.0.0.1:{cfg.listen_port}")
+        probes.append(("host", lambda: check_host(host_base)))
     if fleet:
         # The fleet lens lives on the HUB, not the daemon: an http(s)
         # --url names the hub to read; otherwise fall back to a local
@@ -1110,6 +1251,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     trace = False
     fleet = False
     energy = False
+    host = False
     url = ""
     args: list[str] = []
     it = iter(raw)
@@ -1122,6 +1264,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             fleet = True
         elif token == "--energy":
             energy = True
+        elif token == "--host":
+            host = True
         elif token == "--url":
             url = next(it, "")
             if not url or url.startswith("--"):
@@ -1139,7 +1283,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     cfg = from_args(args)
     started = time.monotonic()
     results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
-                         energy=energy)
+                         energy=energy, host=host)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

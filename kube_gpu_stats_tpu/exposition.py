@@ -156,7 +156,8 @@ class MetricsServer:
                  ready_check=None, health_provider=None,
                  trace_provider=None, fleet_provider=None,
                  ingest_provider=None, burst_provider=None,
-                 energy_provider=None, prewarm_renders: bool = True):
+                 energy_provider=None, host_provider=None,
+                 prewarm_renders: bool = True):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
@@ -189,6 +190,13 @@ class MetricsServer:
         # digest() -> dict): serves /debug/energy — the signed
         # per-pod-joules governance digest `doctor --energy` verifies.
         self._energy = energy_provider
+        # Host-signals collector (hoststats.HostStats, duck-typed:
+        # debug_payload() -> dict): serves /debug/host — the last host
+        # snapshot (PSI, IRQ/NIC rates, thermal, per-pod cgroup stats)
+        # plus the eBPF capability verdict. A disabled collector
+        # (--no-host-stats) still answers, with enabled:false; None
+        # (hubs, bare test servers) 404s.
+        self._host = host_provider
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -523,6 +531,18 @@ class MetricsServer:
                                        sort_keys=True) + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/host" and outer._host is not None:
+                    # Host-signals snapshot (hoststats.py): the per-node
+                    # half of straggler root-cause, behind the same auth
+                    # gate as every non-probe path. Mirrors /debug/fleet:
+                    # a disabled collector answers enabled:false rather
+                    # than 404 so curl diagnoses config, not absence.
+                    import json
+
+                    body = (json.dumps(outer._host.debug_payload(),
+                                       sort_keys=True) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -565,6 +585,8 @@ class MetricsServer:
                         links += ["/debug/burst"]
                     if outer._energy is not None:
                         links += ["/debug/energy"]
+                    if outer._host is not None:
+                        links += ["/debug/host"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

@@ -106,6 +106,37 @@ _SD_FLOORS: dict[str, float] = {
     "duty": 1.0,
     "stale_fraction": 0.05,
     "fetch": 0.005,
+    # Host signals (ISSUE 10): PSI shares are 0-100 points, drop/
+    # throttle rates are per-second counts — changes below these are
+    # host noise regardless of how flat the (healthy, usually zero)
+    # history was.
+    "host_mem_stall": 2.0,
+    "host_cpu_stall": 5.0,
+    "host_io_stall": 2.0,
+    "host_nic_drops": 5.0,
+    "host_throttle": 0.5,
+}
+
+# Host signals harvested from a target's kts_host_* exposition into its
+# digest (digest_from_series) and scored as baselines (observe): the
+# digest key doubles as the display source for doctor's joined verdict.
+# signal name -> digest key under digest["host"].
+HOST_SIGNALS: dict[str, str] = {
+    "host_mem_stall": "mem_full_avg10",
+    "host_cpu_stall": "cpu_some_avg10",
+    "host_io_stall": "io_full_avg10",
+    "host_nic_drops": "nic_drop_rate",
+    "host_throttle": "throttle_rate",
+}
+
+# kts_host_pressure_share (resource, kind) pairs harvested into the
+# digest at the avg10 window — the strongest stall evidence per PSI
+# semantics: memory/io 'full' (nothing ran), cpu 'some' (cpu has no
+# full line on most kernels).
+_HOST_PSI_KEYS: dict[tuple[str, str], str] = {
+    ("memory", "full"): "mem_full_avg10",
+    ("cpu", "some"): "cpu_some_avg10",
+    ("io", "full"): "io_full_avg10",
 }
 
 
@@ -221,6 +252,7 @@ def digest_from_series(series: Sequence) -> dict:
     phases: dict[str, dict[str, float]] = {}
     slowest: dict | None = None
     burst_max: float | None = None
+    host: dict[str, float] = {}
     for name, labels, value in series:
         if name == schema.TICK_PHASE_SECONDS.name:
             phase = labels.get("phase", "")
@@ -239,6 +271,19 @@ def digest_from_series(series: Sequence) -> dict:
             # exactly the transients this surfaces.
             if burst_max is None or value > burst_max:
                 burst_max = value
+        elif name == schema.HOST_PRESSURE.name:
+            # Host root-cause signals (ISSUE 10): the strongest PSI
+            # shares join the node's digest so the lens can baseline
+            # them and doctor can print them in the joined verdict.
+            if labels.get("window") == "avg10":
+                key = _HOST_PSI_KEYS.get(
+                    (labels.get("resource", ""), labels.get("kind", "")))
+                if key is not None:
+                    host[key] = value
+        elif name == schema.HOST_NIC_DROP_RATE.name:
+            host["nic_drop_rate"] = value
+        elif name == schema.HOST_THROTTLE_RATE.name:
+            host["throttle_rate"] = value
     out: dict = {}
     if phases:
         out["phases"] = phases
@@ -246,6 +291,8 @@ def digest_from_series(series: Sequence) -> dict:
         out["slowest"] = slowest
     if burst_max is not None:
         out["burst_max_watts"] = burst_max
+    if host:
+        out["host"] = host
     return out
 
 
@@ -390,6 +437,19 @@ class FleetLens:
                         # spike between ticks) raises an anomaly even
                         # while the tick-sampled power sum stays flat.
                         signals["power_burst"] = burst_max
+                    host = digests.get(target, {}).get("host")
+                    if host:
+                        # Host-pressure baselines (ISSUE 10): PSI
+                        # full-stall shares, NIC drop rate, throttle
+                        # edges — the signals production stragglers
+                        # actually root-cause to. Healthy state is flat
+                        # zero, so these are exempt from the first-
+                        # activity re-seed (like stale_fraction):
+                        # nonzero-from-zero IS the anomaly.
+                        for name, key in HOST_SIGNALS.items():
+                            value = host.get(key)
+                            if value is not None:
+                                signals[name] = value
                     state.chips = len(rows) or state.chips
                     stale_chips = sum(1 for r in rows if r.up != 1.0)
                     fresh_bad += stale_chips
@@ -455,14 +515,16 @@ class FleetLens:
                 baseline = state.baselines[name] = EwmaBaseline()
             if (baseline.count and value != 0.0
                     and baseline.mean == 0.0 and baseline.var == 0.0
-                    and name != "stale_fraction"):
+                    and name != "stale_fraction"
+                    and name not in HOST_SIGNALS):
                 # First activity on a signal that idled at exactly zero
                 # through warmup (duty/power/HBM/steps before the job
                 # starts): a state change, not a fault — re-seed rather
                 # than flag every target of the slice the moment a job
-                # launches. stale_fraction is the one inversion: its
-                # healthy state IS flat zero, and nonzero-from-zero is
-                # precisely its anomaly. Count resets to 1: the
+                # launches. stale_fraction and the host_* pressure
+                # signals are the inversions: their healthy state IS
+                # flat zero, and nonzero-from-zero is precisely their
+                # anomaly. Count resets to 1: the
                 # min_samples warmup gate must re-run under the new
                 # regime, or the signal's ramp (model still loading,
                 # duty climbing) would z-explode against the re-seeded

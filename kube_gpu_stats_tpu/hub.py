@@ -125,6 +125,11 @@ del _fam
 _DIGEST_PHASE = schema.TICK_PHASE_SECONDS.name
 _DIGEST_SLOWEST = schema.SLOWEST_TICK_SECONDS.name
 _DIGEST_BURST = schema.BURST_WATTS.name  # burst-aware power baseline
+# Host-pressure signals (ISSUE 10): deltas to these patch the cached
+# digest's host dict, so the invalidation set must cover them too.
+_DIGEST_HOST = frozenset((schema.HOST_PRESSURE.name,
+                          schema.HOST_NIC_DROP_RATE.name,
+                          schema.HOST_THROTTLE_RATE.name))
 
 # Compiled patch-action kinds (_TargetCache._compile_patch): what a
 # delta to a given slot must touch beyond the series views and plans.
@@ -308,7 +313,8 @@ class _TargetCache:
                         if self.rollup_plan is not None else -1)
         if name in _HIST_SUFFIXES:
             action = (_PATCH_HIST, None, None, chip_index, rollup_index)
-        elif name in (_DIGEST_PHASE, _DIGEST_SLOWEST, _DIGEST_BURST):
+        elif (name in (_DIGEST_PHASE, _DIGEST_SLOWEST, _DIGEST_BURST)
+              or name in _DIGEST_HOST):
             action = (_PATCH_DIGEST, None, None, chip_index, rollup_index)
         elif name.startswith("slice_"):
             action = (_PATCH_ROLLUP,

@@ -288,6 +288,7 @@ class PollLoop:
         tracer: Tracer | None = None,
         burst_sampler=None,
         energy=None,
+        host_stats=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -363,6 +364,17 @@ class PollLoop:
         # families stay absent (burst mode off / bare test loops).
         self._burst = burst_sampler
         self._energy_acct = energy
+        # Host-signals collector (ISSUE 10): read once per tick on the
+        # pool — the same pipelined-idle-window discipline as the
+        # procstats prefetch, so PSI/IRQ/NIC/cgroup file IO never lives
+        # inside the tick budget. The snapshot tail folds the last
+        # COMPLETED read; its per-read parse errors count under
+        # collector_poll_errors_total like the env path. None (or a
+        # disabled instance) keeps the kts_host_* families absent.
+        self._host = (host_stats if host_stats is not None
+                      and getattr(host_stats, "enabled", True) else None)
+        self._host_future: concurrent.futures.Future | None = None
+        self._host_snap = None
         self._ckpt_future: concurrent.futures.Future | None = None
         self._tick_seq = 0
         # Pipeline-fence edge detection: the journal records the fence
@@ -629,9 +641,18 @@ class PollLoop:
         mark = tracer.mark()
         self._registry.publish(snapshot)
         tracer.add_span("publish", mark)
-        tracer.end(devices=len(results),
-                   duration_ms=round(duration * 1000.0, 3),
-                   series=self.last_tick_stats.get("series", 0))
+        meta = {"devices": len(results),
+                "duration_ms": round(duration * 1000.0, 3),
+                "series": self.last_tick_stats.get("series", 0)}
+        if self._host is not None and self._host_snap is not None:
+            # Time-align the tick with the host's state: the trace ring
+            # carries the strongest host signals as a 'host' aux
+            # annotation, so a /debug/ticks post-mortem of a slow tick
+            # shows the PSI/NIC/throttle picture it co-occurred with.
+            note = self._host.trace_note(self._host_snap)
+            if note:
+                meta["host"] = note
+        tracer.end(**meta)
         return duration
 
     def run_forever(self) -> None:
@@ -737,6 +758,11 @@ class PollLoop:
     def _sample_all(self) -> list[tuple[Device, Sample | None]]:
         if self._process_metrics and self._proc_future is None:
             self._proc_future = self._pool.submit(procstats.read)
+        if self._host is not None and self._host_future is None:
+            # At most one host read in flight: the ~dozens of /proc +
+            # /sys + cgroup reads overlap the device fan-out exactly
+            # like the procstats prefetch.
+            self._host_future = self._pool.submit(self._host.read)
         if not self._devices:
             return []
         self._collector.begin_tick()
@@ -1066,6 +1092,28 @@ class PollLoop:
         if self._procstats is None:
             self._procstats = procstats.read()
         return self._procstats
+
+    def _harvest_hoststats(self):
+        """Last completed host-signals read (hoststats.py). Strictly
+        non-blocking — unlike procstats there is no cold inline read:
+        the kts_host_* families are simply absent until the first pool
+        read completes (a tick must never wait on a wedged /proc)."""
+        future = self._host_future
+        if future is not None and future.done():
+            self._host_future = None
+            try:
+                snap = future.result()
+            except Exception:  # noqa: BLE001 - host stats must not kill a tick
+                self._count_error("hoststats")
+                log.debug("host-stats read failed", exc_info=True)
+            else:
+                # Per-read parse errors (garbage PSI line, hostile
+                # cgroup file) surface on the counter operators are
+                # told to alert on — same contract as the env path.
+                for reason in snap.errors:
+                    self._count_error(reason)
+                self._host_snap = snap
+        return self._host_snap
 
     _MAX_RAW_FAMILIES = 64
     # Real topologies have ~6 ICI links per chip; 64 is far beyond any
@@ -1440,6 +1488,13 @@ class PollLoop:
             if self._ckpt_future is None or self._ckpt_future.done():
                 self._ckpt_future = self._pool.submit(
                     self._energy_acct.checkpoint)
+        if self._host is not None:
+            # kts_host_* families from the last completed host read
+            # (absent until one exists — the collector's degrade-to-
+            # absent contract applies to the cold window too).
+            snap = self._harvest_hoststats()
+            if snap is not None:
+                self._host.contribute(builder, snap)
         allocatable = getattr(self._attribution, "allocatable", None)
         if allocatable is not None:
             for resource, count in sorted(allocatable().items()):

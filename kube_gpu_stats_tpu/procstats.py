@@ -27,6 +27,18 @@ def _boot_time() -> float | None:
 _BOOT_TIME = _boot_time()
 
 
+def _get_boot_time() -> float | None:
+    """Cached boot time, retried lazily: the import-time read can fail
+    transiently (container startup races a /proc remount), and caching
+    the None would leave process_start_time_seconds permanently absent
+    for the process lifetime. Boot time itself never changes, so a
+    successful read caches forever."""
+    global _BOOT_TIME
+    if _BOOT_TIME is None:
+        _BOOT_TIME = _boot_time()
+    return _BOOT_TIME
+
+
 def read() -> dict[str, float]:
     """Current process CPU seconds, RSS bytes, start time (unix). Empty on
     failure — never raises on the poll path."""
@@ -39,9 +51,10 @@ def read() -> dict[str, float]:
         # (1-indexed in proc(5)) -> rest indices 11, 12, 19.
         utime, stime = int(rest[11]), int(rest[12])
         out["process_cpu_seconds_total"] = (utime + stime) / _CLK_TCK
-        if _BOOT_TIME is not None:
+        boot_time = _get_boot_time()
+        if boot_time is not None:
             out["process_start_time_seconds"] = (
-                _BOOT_TIME + int(rest[19]) / _CLK_TCK
+                boot_time + int(rest[19]) / _CLK_TCK
             )
     except (OSError, IndexError, ValueError):
         pass

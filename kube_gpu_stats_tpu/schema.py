@@ -557,12 +557,15 @@ FLEET_ANOMALIES = MetricSpec(
     MetricType.COUNTER,
     "Anomalies the fleet lens has raised per target and kind since the "
     "hub started (kind = the breached signal: duty/hbm/power/"
-    "power_burst/steps/fetch/stale_fraction, or 'freshness' for a "
-    "target missing several refreshes running; power_burst scores the "
-    "target's sub-tick burst peak, and fetch scores the delta-frame "
-    "inter-arrival gap for push-served targets). Edge-counted — one "
-    "per transition into anomaly, not per anomalous refresh — so "
-    "increase() counts incidents, not their duration.",
+    "power_burst/steps/fetch/stale_fraction, a host_* signal from the "
+    "target's kts_host_* exposition — host_mem_stall/host_cpu_stall/"
+    "host_io_stall for PSI shares, host_nic_drops, host_throttle — or "
+    "'freshness' for a target missing several refreshes running; "
+    "power_burst scores the target's sub-tick burst peak, and fetch "
+    "scores the delta-frame inter-arrival gap for push-served "
+    "targets). Edge-counted — one per transition into anomaly, not "
+    "per anomalous refresh — so increase() counts incidents, not "
+    "their duration.",
     extra_labels=("target", "kind"),
 )
 FLEET_SLO_BURN = MetricSpec(
@@ -863,6 +866,178 @@ ENERGY_CHECKPOINT_AGE = MetricSpec(
     "--energy-checkpoint-interval.",
 )
 
+# Host-signals families (hoststats.py, ISSUE 10): the per-node half of
+# straggler root-cause — PSI pressure, IRQ/softirq rates, NIC errors,
+# thermal throttle, per-pod cgroup v2 stats — sampled once per tick off
+# the hot path and time-aligned with the flight recorder's tick traces.
+# Every family degrades to absent (never an error) on hosts missing the
+# backing /proc//sys file; see docs/OPERATIONS.md "Host triage".
+
+HOST_PRESSURE = MetricSpec(
+    "kts_host_pressure_share",
+    MetricType.GAUGE,
+    "Linux PSI pressure share (0-100) from /proc/pressure/<resource>: "
+    "percent of the window some/all runnable tasks stalled on the "
+    "resource (kind 'some') or every non-idle task stalled at once "
+    "(kind 'full' — the whole host made no progress). The headline "
+    "host root-cause signal: a memory 'full' share in the double "
+    "digits during a slow tick means the node was reclaim-stalled, "
+    "not the accelerator. Absent on pre-4.20 kernels (no "
+    "/proc/pressure).",
+    extra_labels=("resource", "kind", "window"),
+)
+HOST_PRESSURE_STALL = MetricSpec(
+    "kts_host_pressure_stall_seconds_total",
+    MetricType.COUNTER,
+    "Cumulative PSI stall time per resource and kind, in seconds (the "
+    "total= field of /proc/pressure/<resource>, kernel-reported "
+    "microseconds). rate() of this is the exact stall fraction — the "
+    "avg10/avg60 shares are the kernel's own EWMA of the same signal.",
+    extra_labels=("resource", "kind"),
+)
+HOST_INTERRUPTS = MetricSpec(
+    "kts_host_interrupts_total",
+    MetricType.COUNTER,
+    "Cumulative interrupts serviced by this host since boot "
+    "(/proc/stat intr/softirq totals), by kind 'hard' or 'soft'.",
+    extra_labels=("kind",),
+)
+HOST_IRQ_RATE = MetricSpec(
+    "kts_host_irq_rate",
+    MetricType.GAUGE,
+    "Interrupts per second over the last host-stats sampling interval "
+    "(delta of /proc/stat intr/softirq totals), by kind 'hard' or "
+    "'soft'. An IRQ storm steals the CPU the runtime's feeder threads "
+    "need — the classic invisible straggler cause. Absent until two "
+    "samples exist.",
+    extra_labels=("kind",),
+)
+HOST_SOFTIRQ_RATE = MetricSpec(
+    "kts_host_softirq_rate",
+    MetricType.GAUGE,
+    "Per-type softirqs per second over the last host-stats sampling "
+    "interval (/proc/softirqs deltas summed over CPUs; type is the "
+    "kernel's row name, e.g. NET_RX, TIMER). Names WHICH softirq is "
+    "storming when kts_host_irq_rate{kind='soft'} spikes.",
+    extra_labels=("type",),
+)
+HOST_NIC_ERRORS = MetricSpec(
+    "kts_host_nic_errors_total",
+    MetricType.COUNTER,
+    "Cumulative NIC errors per interface and direction "
+    "(/sys/class/net/<dev>/statistics/{rx,tx}_errors; loopback "
+    "excluded). Nonzero rate on the DCN-facing NIC during a slow "
+    "collective is a fabric problem, not a chip problem.",
+    extra_labels=("device", "direction"),
+)
+HOST_NIC_DROPS = MetricSpec(
+    "kts_host_nic_drops_total",
+    MetricType.COUNTER,
+    "Cumulative NIC packet drops per interface and direction "
+    "(/sys/class/net/<dev>/statistics/{rx,tx}_dropped; loopback "
+    "excluded).",
+    extra_labels=("device", "direction"),
+)
+HOST_NIC_DROP_RATE = MetricSpec(
+    "kts_host_nic_drop_rate",
+    MetricType.GAUGE,
+    "Packets per second dropped across every non-loopback NIC over the "
+    "last host-stats sampling interval — the one-series NIC health "
+    "signal the hub's fleet lens baselines per node. Absent until two "
+    "samples exist.",
+)
+HOST_THERMAL_ZONE = MetricSpec(
+    "kts_host_thermal_zone_celsius",
+    MetricType.GAUGE,
+    "Host thermal zone temperature in degrees Celsius "
+    "(/sys/class/thermal/thermal_zone*/temp; zone is the sysfs index, "
+    "type the kernel's zone type string). The HOST-side heat picture "
+    "next to the chip's own accelerator_temperature_celsius.",
+    extra_labels=("zone", "type"),
+)
+HOST_THROTTLE_EVENTS = MetricSpec(
+    "kts_host_cpu_throttle_events_total",
+    MetricType.COUNTER,
+    "Cumulative CPU thermal-throttle events summed over CPUs, by scope "
+    "'core' or 'package' (/sys/devices/system/cpu/cpu*/thermal_throttle/"
+    "*_throttle_count). A throttled host CPU starves the runtime's "
+    "feeder threads while every accelerator gauge reads healthy.",
+    extra_labels=("scope",),
+)
+HOST_THROTTLE_RATE = MetricSpec(
+    "kts_host_cpu_throttle_rate",
+    MetricType.GAUGE,
+    "CPU thermal-throttle events per second over the last host-stats "
+    "sampling interval (all scopes summed) — the throttle-edge signal "
+    "the hub's fleet lens baselines per node. Absent until two samples "
+    "exist.",
+)
+HOST_POD_CPU = MetricSpec(
+    "kts_host_pod_cpu_seconds_total",
+    MetricType.COUNTER,
+    "Cumulative CPU time consumed by this pod's cgroup (cgroup v2 "
+    "cpu.stat usage_usec), joined to pod/namespace through the kubelet "
+    "attribution mapping where a holder process ties the pod UID to an "
+    "attributed device (labels empty when the join has no answer). "
+    "The noisy-co-tenant ledger: a bystander pod burning the host CPU "
+    "shows up here while the accelerator pod's gauges look idle.",
+    extra_labels=("pod", "namespace", "pod_uid"),
+)
+HOST_POD_THROTTLED = MetricSpec(
+    "kts_host_pod_cpu_throttled_seconds_total",
+    MetricType.COUNTER,
+    "Cumulative seconds this pod's cgroup spent CPU-throttled by its "
+    "quota (cgroup v2 cpu.stat throttled_usec). A training pod with a "
+    "rising rate here is starved by its own limits, not the node.",
+    extra_labels=("pod", "namespace", "pod_uid"),
+)
+HOST_POD_MEMORY = MetricSpec(
+    "kts_host_pod_memory_bytes",
+    MetricType.GAUGE,
+    "Current memory charged to this pod's cgroup (cgroup v2 "
+    "memory.current). Against the node's PSI memory pressure this "
+    "names WHICH pod is driving reclaim.",
+    extra_labels=("pod", "namespace", "pod_uid"),
+)
+HOST_POD_IO = MetricSpec(
+    "kts_host_pod_io_bytes_total",
+    MetricType.COUNTER,
+    "Cumulative block-IO bytes per pod cgroup and direction (cgroup v2 "
+    "io.stat rbytes/wbytes summed over devices). The checkpoint-storm "
+    "signal next to PSI io pressure.",
+    extra_labels=("pod", "namespace", "pod_uid", "direction"),
+)
+HOST_RUNQ_LATENCY = MetricSpec(
+    "kts_host_runq_latency_seconds",
+    MetricType.GAUGE,
+    "Scheduler run-queue latency quantiles from the optional "
+    "eBPF-backed source (runqlat-style): how long runnable tasks "
+    "waited for a CPU over the last sampling window. Only present "
+    "when the capability probe finds a working eBPF toolchain (see "
+    "/debug/host 'ebpf'); absent otherwise — the collector never "
+    "fails for lack of it.",
+    extra_labels=("quantile",),
+)
+
+HOST_METRICS: tuple[MetricSpec, ...] = (
+    HOST_PRESSURE,
+    HOST_PRESSURE_STALL,
+    HOST_INTERRUPTS,
+    HOST_IRQ_RATE,
+    HOST_SOFTIRQ_RATE,
+    HOST_NIC_ERRORS,
+    HOST_NIC_DROPS,
+    HOST_NIC_DROP_RATE,
+    HOST_THERMAL_ZONE,
+    HOST_THROTTLE_EVENTS,
+    HOST_THROTTLE_RATE,
+    HOST_POD_CPU,
+    HOST_POD_THROTTLED,
+    HOST_POD_MEMORY,
+    HOST_POD_IO,
+    HOST_RUNQ_LATENCY,
+)
+
 SELF_DEVICES = MetricSpec(
     "collector_devices",
     MetricType.GAUGE,
@@ -1019,7 +1194,8 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
 )
 
 ALL_METRICS: tuple[MetricSpec, ...] = (
-    PER_DEVICE_METRICS + WORKLOAD_HISTOGRAMS + HUB_METRICS + SELF_METRICS
+    PER_DEVICE_METRICS + WORKLOAD_HISTOGRAMS + HUB_METRICS + HOST_METRICS
+    + SELF_METRICS
 )
 
 # Default histogram buckets for collector_poll_duration_seconds. Chosen to

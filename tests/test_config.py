@@ -260,3 +260,17 @@ def test_extra_labels_empty_value_rejected():
     # silently no-op — it must fail at startup instead.
     with pytest.raises(ValueError, match="non-empty value"):
         parse_extra_labels("cluster=")
+
+
+def test_host_stats_flags(monkeypatch):
+    cfg = from_args([])
+    assert cfg.host_stats is True
+    assert cfg.cgroup_root == "/sys/fs/cgroup"
+    cfg = from_args(["--no-host-stats", "--cgroup-root", "/mnt/cg"])
+    assert cfg.host_stats is False
+    assert cfg.cgroup_root == "/mnt/cg"
+    monkeypatch.setenv("KTS_NO_HOST_STATS", "1")
+    monkeypatch.setenv("KTS_CGROUP_ROOT", "/env/cg")
+    cfg = from_args([])
+    assert cfg.host_stats is False
+    assert cfg.cgroup_root == "/env/cg"

@@ -118,6 +118,32 @@ def test_digest_from_series_extracts_phases_and_slowest():
     assert digest_from_series([("accelerator_up", {}, 1.0)]) == {}
 
 
+def test_digest_from_series_extracts_host_signals():
+    """ISSUE 10: the strongest kts_host_* signals ride the digest so
+    the lens can baseline them and doctor can print the joined verdict."""
+    series = [
+        ("kts_host_pressure_share",
+         {"resource": "memory", "kind": "full", "window": "avg10"}, 18.0),
+        ("kts_host_pressure_share",
+         {"resource": "memory", "kind": "full", "window": "avg60"}, 9.0),
+        ("kts_host_pressure_share",
+         {"resource": "cpu", "kind": "some", "window": "avg10"}, 2.0),
+        ("kts_host_pressure_share",
+         {"resource": "io", "kind": "full", "window": "avg10"}, 0.5),
+        ("kts_host_nic_drop_rate", {}, 12.5),
+        ("kts_host_cpu_throttle_rate", {}, 1.5),
+        ("accelerator_up", {"chip": "0"}, 1.0),
+    ]
+    digest = digest_from_series(series)
+    assert digest["host"] == {
+        "mem_full_avg10": 18.0,   # avg60 deliberately not harvested
+        "cpu_some_avg10": 2.0,
+        "io_full_avg10": 0.5,
+        "nic_drop_rate": 12.5,
+        "throttle_rate": 1.5,
+    }
+
+
 # -- scripted scoring --------------------------------------------------------
 
 def _row(target, duty=50.0, up=1.0, steps=None, worker="0"):
@@ -209,6 +235,76 @@ def test_straggler_objective_burns_on_low_ratio():
     state = lens.rollup()["slo"]["straggler"]["windows"]["5m"]
     assert state["bad_ratio"] == pytest.approx(0.5)  # 4 of 8 refreshes
     assert state["burn_rate"] == pytest.approx(10.0)  # 5% budget
+
+
+def test_host_pressure_anomaly_raises_from_flat_zero():
+    """ISSUE 10: host_* signals are exempt from the first-activity
+    re-seed (like stale_fraction) — a memory full-stall share going
+    0 -> 18 IS the anomaly, not a new operating point — and the raise
+    journals a host_pressure-kind fleet_anomaly event."""
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer, min_samples=3)
+    target = "http://w0/metrics"
+    host = {"mem_full_avg10": 0.0, "cpu_some_avg10": 1.0,
+            "io_full_avg10": 0.0, "nic_drop_rate": 0.0,
+            "throttle_rate": 0.0}
+    for seq in range(1, 9):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target)],
+                 digests={target: {"host": dict(host)}})
+    stalled = dict(host, mem_full_avg10=18.0)
+    _observe(lens, 9, 90.0, [target], [_row(target)],
+             digests={target: {"host": stalled}})
+    rollup = lens.rollup()
+    assert "host_mem_stall" in rollup["targets"][target]["anomalous"]
+    raises = [e for e in tracer.events()["events"]
+              if e["kind"] == "fleet_anomaly"]
+    assert len(raises) == 1
+    assert raises[0]["attrs"]["anomaly"] == "host_mem_stall"
+    # The digest (with its host values) rides the rollup for doctor's
+    # joined verdict.
+    assert rollup["targets"][target]["digest"]["host"][
+        "mem_full_avg10"] == 18.0
+    # Counter series carries the host kind.
+    builder = SnapshotBuilder()
+    lens.contribute(builder)
+    text = builder.build().render()
+    anomalies = labeled(text, "kts_fleet_anomalies_total")
+    assert anomalies[(("kind", "host_mem_stall"),
+                      ("target", target))] == 1.0
+
+
+def test_host_anomaly_does_not_trigger_burst_arm_hook():
+    """The burst auto-arm hook is power/duty-shaped only: a host
+    pressure anomaly must not arm the power sampler."""
+    armed = []
+    lens = FleetLens(min_samples=2)
+    lens.arm_hook = lambda target, kind, z: armed.append(kind)
+    target = "t"
+    host = {"mem_full_avg10": 0.0}
+    for seq in range(1, 6):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target)],
+                 digests={target: {"host": dict(host)}})
+    _observe(lens, 6, 60.0, [target], [_row(target)],
+             digests={target: {"host": {"mem_full_avg10": 25.0}}})
+    assert "host_mem_stall" in lens.rollup()["targets"][target]["anomalous"]
+    assert armed == []
+
+
+def test_host_signal_vanishing_clears_latched_anomaly():
+    """A daemon restarted with --no-host-stats stops exporting host
+    signals; its latched host anomaly must clear with the data."""
+    lens = FleetLens(min_samples=2)
+    target = "t"
+    for seq in range(1, 5):
+        _observe(lens, seq, seq * 10.0, [target], [_row(target)],
+                 digests={target: {"host": {"nic_drop_rate": 0.0}}})
+    _observe(lens, 5, 50.0, [target], [_row(target)],
+             digests={target: {"host": {"nic_drop_rate": 500.0}}})
+    assert "host_nic_drops" in lens.rollup()["targets"][target]["anomalous"]
+    # Empty digest replaces (the restart case): signal gone, kind clears.
+    _observe(lens, 6, 60.0, [target], [_row(target)],
+             digests={target: {}})
+    assert not lens.rollup()["targets"][target]["anomalous"]
 
 
 def test_slow_node_attribution_picks_worst_digest():
@@ -570,6 +666,67 @@ def test_fleet_post_mortem_names_worst_node_anomalies_and_burn():
     assert data["anomalous"] == {
         "http://w3:9400/metrics": {"stale_fraction": 9.5,
                                    "freshness": 3.0}}
+
+
+def test_fleet_post_mortem_prints_joined_host_verdict():
+    """ISSUE 10 acceptance shape: a target whose device-side anomaly
+    co-occurs with host_* anomalies in the same refresh window gets
+    the correlated sentence with CURRENT host values from its digest."""
+    payload = _canned_rollup()
+    target = "http://w7:9400/metrics"
+    payload["targets"][target] = {
+        "anomalous": {"fetch": 6.2, "host_mem_stall": 9.0,
+                      "host_throttle": 4.5},
+        "signals": {},
+        "digest": {
+            "slowest": {"seconds": 1.2, "phase": "fetch_wait",
+                        "blame": "port=8431"},
+            "host": {"mem_full_avg10": 18.0, "throttle_rate": 2.0},
+        },
+    }
+    status, detail, data = doctor.fleet_post_mortem(payload)
+    assert status == "warn"
+    assert (f"{target}: fetch_wait spike co-occurs with "
+            f"PSI memory full-stall 18.0% + "
+            f"CPU thermal throttle 2.0 events/s") in detail
+    assert data["correlated"][target]["phase"] == "fetch_wait"
+    assert data["correlated"][target]["host_values"][
+        "mem_full_avg10"] == 18.0
+
+
+def test_fleet_post_mortem_host_only_anomaly_not_correlated():
+    """Host pressure alone (no device-side anomaly, not the worst
+    node) is listed but NOT claimed as the straggler's cause — the
+    joined verdict requires co-occurrence."""
+    payload = _canned_rollup()
+    target = "http://w1:9400/metrics"
+    payload["targets"][target] = {
+        "anomalous": {"host_io_stall": 5.0},
+        "signals": {},
+        "digest": {"host": {"io_full_avg10": 7.0}},
+    }
+    status, detail, data = doctor.fleet_post_mortem(payload)
+    assert status == "warn"
+    assert "host_io_stall" in detail
+    assert target not in data["correlated"]
+    assert "co-occurs" not in [part for part in detail.split("; ")
+                               if part.startswith(f"{target}: host")][0]
+
+
+def test_fleet_post_mortem_worst_node_with_host_anomaly_correlates():
+    """The attribution worst node needs no separate z-anomaly: its
+    slow-phase attribution + a host anomaly is the co-occurrence."""
+    payload = _canned_rollup()
+    target = "http://w7:9400/metrics"
+    payload["targets"][target] = {
+        "anomalous": {"host_mem_stall": 12.0},
+        "signals": {},
+        "digest": {"host": {"mem_full_avg10": 22.5}},
+    }
+    status, detail, data = doctor.fleet_post_mortem(payload)
+    assert f"{target}: fetch_wait spike co-occurs with " \
+           f"PSI memory full-stall 22.5%" in detail
+    assert data["correlated"][target]["phase"] == "fetch_wait"
 
 
 def test_fleet_post_mortem_clean_fleet_is_ok():

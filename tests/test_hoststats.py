@@ -1,0 +1,514 @@
+"""Host-signals collector tests (ISSUE 10): fixture-tree reads, rate
+deltas, graceful degradation (missing PSI, cgroup v1-only, unreadable
+thermal, hostile PSI lines), poll-loop wiring off the hot path, the
+/debug/host payload, and the procstats boot-time retry satellite."""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from kube_gpu_stats_tpu import schema  # noqa: E402
+from kube_gpu_stats_tpu.hoststats import (HostStats,  # noqa: E402
+                                          probe_runq_source)
+from kube_gpu_stats_tpu.registry import SnapshotBuilder  # noqa: E402
+from kube_gpu_stats_tpu.testing import host_fixture  # noqa: E402
+from kube_gpu_stats_tpu.validate import parse_exposition  # noqa: E402
+
+POD_UID = host_fixture.DEFAULT_POD_UID
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def make_host(tmp_path, **kwargs) -> tuple[HostStats, dict]:
+    roots = host_fixture.make_host_tree(tmp_path)
+    host = HostStats(proc_root=str(roots["proc"]),
+                     sysfs_root=str(roots["sysfs"]),
+                     cgroup_root=str(roots["cgroup"]), **kwargs)
+    return host, roots
+
+
+def render_series(host, snap=None):
+    builder = SnapshotBuilder()
+    host.contribute(builder, snap)
+    return list(parse_exposition(builder.build().render()))
+
+
+def series_value(series, family, **want):
+    out = [value for name, labels, value in series
+           if name == family and all(labels.get(k) == v
+                                     for k, v in want.items())]
+    return out[0] if out else None
+
+
+# -- full fixture read -------------------------------------------------------
+
+def test_full_fixture_read(tmp_path):
+    host, _ = make_host(tmp_path, clock=FakeClock())
+    snap = host.read()
+    assert snap.errors == ()
+    # PSI: cpu has no full line; memory/io carry both kinds.
+    assert snap.pressure[("cpu", "some", "avg10")] == 1.0
+    assert ("cpu", "full", "avg10") not in snap.pressure
+    assert snap.pressure[("memory", "full", "avg10")] == 0.0
+    assert snap.pressure[("io", "some", "avg10")] == 0.5
+    # Stall totals convert kernel microseconds to seconds.
+    assert snap.pressure_stall[("memory", "full")] == pytest.approx(0.002)
+    # /proc/stat totals present, rates absent on the first sample.
+    assert snap.interrupts == {"hard": 1000.0, "soft": 500.0}
+    assert snap.irq_rate == {}
+    assert snap.nic_drop_rate is None
+    # NIC counters (loopback excluded by construction of the fixture).
+    assert snap.nic_errors[("eth0", "rx")] == 0.0
+    assert snap.nic_drops[("eth0", "tx")] == 0.0
+    # Thermal + throttle.
+    assert snap.thermal[("0", "x86_pkg_temp")] == 45.0
+    assert snap.throttle == {"core": 0.0, "package": 0.0}
+    # Pod cgroup parsed; no pod_map -> empty pod/namespace labels.
+    assert snap.pods[POD_UID]["cpu_seconds"] == pytest.approx(1.0)
+    assert snap.pods[POD_UID]["memory_bytes"] == float(64 << 20)
+    assert snap.pods[POD_UID]["pod"] == ""
+
+
+def test_rates_appear_on_second_read(tmp_path):
+    clock = FakeClock()
+    host, roots = make_host(tmp_path, clock=clock)
+    host.read()
+    # Advance every counter by a known delta over 10 fake seconds.
+    host_fixture.write_proc_stat(roots["proc"], intr_total=2000,
+                                 softirq_total=1500)
+    host_fixture.write_softirqs(roots["proc"],
+                                {"TIMER": (150, 150), "NET_RX": (100, 75)})
+    host_fixture.write_nic(roots["sysfs"], rx_dropped=50, tx_dropped=10)
+    host_fixture.write_throttle(roots["sysfs"], core=5, package=1)
+    clock.now += 10.0
+    snap = host.read()
+    assert snap.irq_rate["hard"] == pytest.approx(100.0)
+    assert snap.irq_rate["soft"] == pytest.approx(100.0)
+    assert snap.softirq_rate["TIMER"] == pytest.approx(10.0)
+    assert snap.softirq_rate["NET_RX"] == pytest.approx(10.0)
+    assert snap.nic_drop_rate == pytest.approx(6.0)  # 60 drops / 10 s
+    assert snap.throttle_rate == pytest.approx(0.6)
+
+
+def test_counter_reset_yields_no_rate(tmp_path):
+    clock = FakeClock()
+    host, roots = make_host(tmp_path, clock=clock)
+    host.read()
+    host_fixture.write_proc_stat(roots["proc"], intr_total=1)  # reboot
+    clock.now += 10.0
+    snap = host.read()
+    assert "hard" not in snap.irq_rate
+
+
+# -- graceful degradation ----------------------------------------------------
+
+def test_missing_pressure_dir_is_absent_not_an_error(tmp_path):
+    """Pre-4.20 kernels have no /proc/pressure: partial families,
+    zero errors."""
+    import shutil
+
+    host, roots = make_host(tmp_path)
+    shutil.rmtree(roots["proc"] / "pressure")
+    snap = host.read()
+    assert snap.errors == ()
+    assert snap.pressure == {}
+    assert snap.interrupts  # the other sources still served
+
+
+def test_cgroup_v1_only_host_has_no_pod_families(tmp_path):
+    """A v1-only host (no cgroup.controllers marker) degrades to no
+    kts_host_pod_* families, silently."""
+    host, roots = make_host(tmp_path)
+    (roots["cgroup"] / "cgroup.controllers").unlink()
+    snap = host.read()
+    assert snap.errors == ()
+    assert snap.pods == {}
+
+
+def test_unreadable_thermal_zone_is_absent(tmp_path):
+    host, roots = make_host(tmp_path)
+    temp = roots["sysfs"] / "class" / "thermal" / "thermal_zone0" / "temp"
+    temp.unlink()
+    temp.mkdir()  # open() now fails with EISDIR — the unreadable case
+    snap = host.read()
+    assert snap.errors == ()
+    assert snap.thermal == {}
+
+
+def test_hostile_psi_line_is_partial_plus_counted_error(tmp_path):
+    host, roots = make_host(tmp_path)
+    (roots["proc"] / "pressure" / "memory").write_text(
+        "some avg10=GARBAGE avg60=nope total=zzz\n"
+        "full avg10=18.00 avg60=9.00 avg300=4.00 total=180000\n")
+    snap = host.read()
+    assert "hoststats_psi" in snap.errors
+    # The parseable line of the same file still served...
+    assert snap.pressure[("memory", "full", "avg10")] == 18.0
+    # ...and so did every other resource.
+    assert snap.pressure[("io", "some", "avg10")] == 0.5
+    # Cumulative counts ride the debug payload.
+    assert host.debug_payload()["errors"]["hoststats_psi"] == 1
+
+
+def test_garbage_cgroup_and_nic_counted_not_raised(tmp_path):
+    host, roots = make_host(tmp_path)
+    pod_dir = (roots["cgroup"] / "kubepods.slice"
+               / "kubepods-burstable.slice"
+               / f"kubepods-burstable-pod{POD_UID.replace('-', '_')}.slice")
+    (pod_dir / "memory.current").write_text("not-a-number\n")
+    (roots["sysfs"] / "class" / "net" / "eth0" / "statistics"
+     / "rx_dropped").write_text("garbage\n")
+    snap = host.read()
+    assert "hoststats_cgroup" in snap.errors
+    assert "hoststats_nic" in snap.errors
+    # Partial pod entry: cpu/io parsed even though memory didn't.
+    assert snap.pods[POD_UID]["cpu_seconds"] == pytest.approx(1.0)
+    assert "memory_bytes" not in snap.pods[POD_UID]
+
+
+def test_everything_missing_yields_empty_snapshot(tmp_path):
+    host = HostStats(proc_root=str(tmp_path / "nope"),
+                     sysfs_root=str(tmp_path / "nope"),
+                     cgroup_root=str(tmp_path / "nope"))
+    snap = host.read()
+    assert snap.errors == ()
+    assert snap.pressure == {} and snap.pods == {} and snap.thermal == {}
+    # Nothing read => contribute emits nothing (snapshot stamped, but
+    # every family empty).
+    assert [s for s in render_series(host, snap)] == []
+
+
+# -- pod join + layouts ------------------------------------------------------
+
+def test_pod_map_join_labels_pod_and_namespace(tmp_path):
+    host, _ = make_host(
+        tmp_path, pod_map=lambda: {POD_UID: ("train-0", "ml")})
+    snap = host.read()
+    assert snap.pods[POD_UID]["pod"] == "train-0"
+    assert snap.pods[POD_UID]["namespace"] == "ml"
+    series = render_series(host, snap)
+    assert series_value(series, "kts_host_pod_cpu_seconds_total",
+                        pod="train-0", namespace="ml",
+                        pod_uid=POD_UID) == pytest.approx(1.0)
+
+
+def test_pod_map_crash_degrades_to_unlabeled(tmp_path):
+    def boom():
+        raise RuntimeError("kubelet went away")
+
+    host, _ = make_host(tmp_path, pod_map=boom)
+    snap = host.read()
+    assert "hoststats_pod_map" in snap.errors
+    assert snap.pods[POD_UID]["pod"] == ""
+
+
+def test_cgroupfs_layout_also_discovered(tmp_path):
+    host, roots = make_host(tmp_path)
+    other = "11112222-3333-4444-5555-666677778888"
+    host_fixture.write_pod_cgroup(roots["cgroup"], other, layout="cgroupfs",
+                                  cpu_usec=2_000_000)
+    snap = host.read()
+    assert snap.pods[other]["cpu_seconds"] == pytest.approx(2.0)
+    assert POD_UID in snap.pods  # systemd layout still found too
+
+
+# -- exposition / schema -----------------------------------------------------
+
+def test_contribute_renders_schema_valid_families(tmp_path):
+    clock = FakeClock()
+    host, roots = make_host(tmp_path, clock=clock)
+    host.read()
+    host_fixture.write_nic(roots["sysfs"], rx_dropped=30)
+    clock.now += 10.0
+    snap = host.read()
+    series = render_series(host, snap)
+    names = {name for name, _labels, _value in series}
+    assert "kts_host_pressure_share" in names
+    assert "kts_host_pressure_stall_seconds_total" in names
+    assert "kts_host_interrupts_total" in names
+    assert "kts_host_irq_rate" in names
+    assert "kts_host_nic_drops_total" in names
+    assert "kts_host_nic_drop_rate" in names
+    assert "kts_host_thermal_zone_celsius" in names
+    assert "kts_host_cpu_throttle_events_total" in names
+    assert "kts_host_pod_memory_bytes" in names
+    assert series_value(series, "kts_host_pressure_share",
+                        resource="cpu", kind="some",
+                        window="avg10") == 1.0
+    assert series_value(series, "kts_host_nic_drop_rate") == \
+        pytest.approx(3.0)
+    # Every emitted family is a schema family (golden contract).
+    known = {spec.name for spec in schema.ALL_METRICS}
+    assert names <= known
+
+
+def test_disabled_collector_contributes_nothing(tmp_path):
+    host, _ = make_host(tmp_path, enabled=False)
+    snap = host.read()  # read still works (tools); contribute gates
+    assert render_series(host, snap) == []
+    assert host.debug_payload() == {"enabled": False}
+
+
+def test_trace_note_carries_strongest_signals(tmp_path):
+    host, roots = make_host(tmp_path)
+    host_fixture.write_psi(roots["proc"], "memory", some_avg10=35.0,
+                           full_avg10=18.0, some_total_us=5_000,
+                           full_total_us=2_000)
+    snap = host.read()
+    note = host.trace_note(snap)
+    assert note["mem_full_avg10"] == 18.0
+    assert note["cpu_some_avg10"] == 1.0
+    assert host.trace_note(None) is not None  # falls back to last read
+
+
+def test_debug_payload_shape(tmp_path):
+    import json
+
+    host, _ = make_host(tmp_path,
+                        pod_map=lambda: {POD_UID: ("train-0", "ml")})
+    host.read()
+    payload = host.debug_payload()
+    assert payload["enabled"] is True
+    assert payload["pressure"]["memory_full_avg10"] == 0.0
+    assert payload["pods"][POD_UID]["pod"] == "train-0"
+    assert payload["ebpf"] == {"available": False, "reason": "not probed"}
+    json.dumps(payload, sort_keys=True)  # must be JSON-serializable
+
+
+# -- eBPF gating -------------------------------------------------------------
+
+def test_ebpf_probe_refuses_gracefully():
+    source, reason = probe_runq_source()
+    assert source is None
+    assert reason  # names why, never raises
+
+
+def test_injected_runq_source_emits_quantiles(tmp_path):
+    class FakeRunq:
+        def read(self):
+            return {"p50": 0.0001, "p99": 0.004}
+
+    host, _ = make_host(tmp_path, ebpf_source=FakeRunq())
+    snap = host.read()
+    assert snap.runq == {"p50": 0.0001, "p99": 0.004}
+    series = render_series(host, snap)
+    assert series_value(series, "kts_host_runq_latency_seconds",
+                        quantile="p99") == pytest.approx(0.004)
+    assert host.debug_payload()["ebpf"]["available"] is True
+
+
+def test_crashing_runq_source_counts_not_raises(tmp_path):
+    class Boom:
+        def read(self):
+            raise OSError("bpf prog detached")
+
+    host, _ = make_host(tmp_path, ebpf_source=Boom())
+    snap = host.read()
+    assert "hoststats_ebpf" in snap.errors
+    assert snap.runq == {}
+
+
+# -- cardinality fences ------------------------------------------------------
+
+def test_pod_cap_is_stable_deterministic_and_latched(tmp_path):
+    from kube_gpu_stats_tpu import hoststats as hs
+
+    host, roots = make_host(tmp_path)
+    for i in range(hs.MAX_PODS + 5):
+        uid = f"{i:08x}-0000-0000-0000-000000000000"
+        host_fixture.write_pod_cgroup(roots["cgroup"], uid,
+                                      layout="cgroupfs")
+    snap = host.read()
+    assert len(snap.pods) == hs.MAX_PODS
+    assert "hoststats_pod_cap" in snap.errors
+    # Deterministic selection: the sorted-first subset, identical on
+    # the next read (flapping series would break rate() queries), and
+    # the over-cap error is latched, not ramped per read.
+    snap2 = host.read()
+    assert set(snap2.pods) == set(snap.pods)
+    assert "hoststats_pod_cap" not in snap2.errors
+
+
+def test_nic_rate_survives_interface_churn_without_spiking(tmp_path):
+    """A NIC entering the read set (veth churn / cap-window shift) must
+    contribute NOTHING on first sight — its lifetime drop counter
+    landing in one delta would export a bogus drop-rate spike and raise
+    a false host_nic_drops fleet anomaly."""
+    import shutil
+
+    clock = FakeClock()
+    host, roots = make_host(tmp_path, clock=clock)
+    host.read()
+    # A new interface appears carrying a large lifetime counter.
+    host_fixture.write_nic(roots["sysfs"], "veth9", rx_dropped=100_000)
+    clock.now += 10.0
+    snap = host.read()
+    assert snap.nic_drop_rate == pytest.approx(0.0)  # eth0 moved 0
+    # From its second sample the newcomer rates normally...
+    host_fixture.write_nic(roots["sysfs"], "veth9", rx_dropped=100_050)
+    clock.now += 10.0
+    snap = host.read()
+    assert snap.nic_drop_rate == pytest.approx(5.0)
+    # ...and a departed interface's baseline is pruned, not leaked.
+    shutil.rmtree(roots["sysfs"] / "class" / "net" / "veth9")
+    clock.now += 10.0
+    host.read()
+    assert "nic:drops:veth9" not in host._prev
+
+
+def test_error_totals_swap_not_mutate_for_http_readers(tmp_path):
+    """debug_payload() iterates _error_totals on HTTP threads; read()
+    must swap in a new dict, never grow the one being iterated."""
+    host, roots = make_host(tmp_path)
+    before = host._error_totals
+    (roots["proc"] / "pressure" / "memory").write_text("garbage\n")
+    host.read()
+    assert host._error_totals is not before
+    assert host._error_totals["hoststats_psi"] == 1
+    assert before == {}
+
+
+# -- poll-loop wiring --------------------------------------------------------
+
+def test_poll_loop_exports_host_families_off_hot_path(tmp_path):
+    import time
+
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.poll import PollLoop
+    from kube_gpu_stats_tpu.registry import Registry
+
+    host, roots = make_host(tmp_path)
+    (roots["proc"] / "pressure" / "memory").write_text("garbage line\n")
+    registry = Registry()
+    loop = PollLoop(MockCollector(2), registry, host_stats=host)
+    try:
+        # First tick submits the pool read; families land once it
+        # completes (absent-until-first-read contract).
+        loop.tick()
+        deadline = time.monotonic() + 5.0
+        names: set = set()
+        while time.monotonic() < deadline:
+            loop.tick()
+            names = {s.spec.name for s in registry.snapshot().series}
+            if "kts_host_pressure_share" in names:
+                break
+            time.sleep(0.02)
+        assert "kts_host_pressure_share" in names
+        # The hostile PSI line surfaced on the counter operators are
+        # told to alert on (same contract as the env path).
+        errors = {
+            labels[0][1]: value for spec, labels, value
+            in registry.snapshot().series
+            if spec.name == "collector_poll_errors_total"
+        }
+        assert errors.get("hoststats_psi", 0) >= 1
+        # Tick meta carries the time-aligned host note on the ring.
+        traces = [t for t in loop.tracer.traces() if "host" in t.meta]
+        assert traces, "no tick trace carried the host aux annotation"
+        assert "cpu_some_avg10" in traces[-1].meta["host"]
+    finally:
+        loop.stop()
+
+
+def test_poll_loop_without_host_stats_unchanged():
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.poll import PollLoop
+    from kube_gpu_stats_tpu.registry import Registry
+
+    registry = Registry()
+    loop = PollLoop(MockCollector(1), registry)
+    try:
+        loop.tick()
+        names = {s.spec.name for s in registry.snapshot().series}
+        assert not any(name.startswith("kts_host_") for name in names)
+    finally:
+        loop.stop()
+
+
+# -- doctor --host -----------------------------------------------------------
+
+def test_doctor_check_host_summarizes_live_daemon(tmp_path):
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    host, roots = make_host(tmp_path)
+    host_fixture.write_psi(roots["proc"], "memory", some_avg10=35.0,
+                           full_avg10=18.0, some_total_us=5_000,
+                           full_total_us=2_000)
+    host.read()
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           host_provider=host)
+    server.start()
+    try:
+        result = doctor.check_host(f"http://127.0.0.1:{server.port}")
+        assert result.status == doctor.WARN  # hot pressure share
+        assert "memory_full_avg10=18%" in result.detail
+        assert "1 pod cgroup(s)" in result.detail
+        assert "eBPF runq source off" in result.detail
+    finally:
+        server.stop()
+
+
+def test_doctor_check_host_classifies_absent_and_disabled(tmp_path):
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    # No provider wired: classified WARN, not a crash.
+    bare = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    bare.start()
+    try:
+        result = doctor.check_host(f"http://127.0.0.1:{bare.port}")
+        assert result.status == doctor.WARN
+        assert "/debug/host" in result.detail
+    finally:
+        bare.stop()
+    # Disabled collector: names --no-host-stats.
+    disabled = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                             host_provider=HostStats(enabled=False))
+    disabled.start()
+    try:
+        result = doctor.check_host(f"http://127.0.0.1:{disabled.port}")
+        assert result.status == doctor.WARN
+        assert "--no-host-stats" in result.detail
+    finally:
+        disabled.stop()
+
+
+# -- procstats satellite -----------------------------------------------------
+
+def test_procstats_boot_time_retries_after_transient_failure(monkeypatch):
+    """Satellite: a transiently unreadable /proc/stat at import must not
+    blank process_start_time_seconds forever — the next read() retries
+    the boot-time parse and caches the success."""
+    from kube_gpu_stats_tpu import procstats
+
+    monkeypatch.setattr(procstats, "_BOOT_TIME", None)
+    monkeypatch.setattr(procstats, "_boot_time", lambda: 1_700_000_000.0)
+    readings = procstats.read()
+    assert "process_start_time_seconds" in readings
+    assert readings["process_start_time_seconds"] > 1_700_000_000.0
+    # The retry cached: later failures of the source don't regress it.
+    assert procstats._BOOT_TIME == 1_700_000_000.0
+
+
+def test_procstats_boot_time_still_absent_while_unreadable(monkeypatch):
+    from kube_gpu_stats_tpu import procstats
+
+    monkeypatch.setattr(procstats, "_BOOT_TIME", None)
+    monkeypatch.setattr(procstats, "_boot_time", lambda: None)
+    readings = procstats.read()
+    assert "process_start_time_seconds" not in readings
+    assert "process_cpu_seconds_total" in readings

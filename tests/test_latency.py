@@ -101,6 +101,27 @@ def test_burst_fold_overhead_under_2pct_of_tick_budget():
     assert best["burst_samples_per_sec"] > 100.0, best
 
 
+def test_hoststats_read_under_budget():
+    """ISSUE 10 acceptance pin: one full HostStats.read() over a
+    realistic fixture tree (PSI x3, stat, softirqs, NIC, thermal,
+    throttle, 8 pod cgroups) stays cheap enough that a single pool
+    worker absorbs it per tick with the whole idle window to spare —
+    the read lives on the sampler pool (the procstats prefetch
+    discipline), never inside the tick budget, and this pin keeps it
+    from quietly growing into a pool hog. Best of 3 rounds so a
+    co-tenant noise burst can't fail the pin for the code's cost."""
+    from kube_gpu_stats_tpu.bench import measure_hoststats
+
+    best = None
+    for _ in range(3):
+        result = measure_hoststats(reads=30)
+        assert result is not None
+        if best is None or result["hoststats_read_ms_per_tick"] < \
+                best["hoststats_read_ms_per_tick"]:
+            best = result
+    assert best["hoststats_read_ms_per_tick"] < 10.0, best
+
+
 def test_scrape_hot_path_p99_under_5ms():
     """ISSUE 7 satellite acceptance: scrape_p99 < 5 ms restored. The
     render pre-warmer fills the per-generation text+gzip cache right

@@ -153,18 +153,22 @@ def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
     tracer.event("breaker", "SECRET_EVENT_DETAIL")
     from kube_gpu_stats_tpu.fleetlens import FleetLens
 
+    from kube_gpu_stats_tpu.hoststats import HostStats
+
     srv = MetricsServer(
         make_registry(), host="127.0.0.1", port=0,
         auth_username="prom",
         auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
         trace_provider=tracer,
         fleet_provider=FleetLens(tracer=tracer),
+        host_provider=HostStats(),
     )
     srv.start()
     try:
         for path in ("/debug/threads", "/debug/profile?seconds=0.1",
                      "/debug/ticks", "/debug/trace?last=5",
-                     "/debug/events?since=0", "/debug/fleet"):
+                     "/debug/events?since=0", "/debug/fleet",
+                     "/debug/host"):
             with pytest.raises(urllib.error.HTTPError) as err:
                 fetch(srv.port, path)
             assert err.value.code == 401, path
@@ -175,6 +179,62 @@ def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
         ok = fetch(srv.port, "/debug/ticks",
                    headers=auth_header("prom", "s3cret")).read()
         assert b"SECRET_PHASE" in ok
+    finally:
+        srv.stop()
+
+
+def test_debug_host_404_without_provider(server):
+    """Servers with no host collector wired (hubs, bare registries)
+    must 404 /debug/host, mirroring /debug/fleet."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(server.port, "/debug/host")
+    assert err.value.code == 404
+
+
+def test_debug_host_disabled_answers_enabled_false():
+    """--no-host-stats keeps the endpoint up and says so (the --no-trace
+    contract) rather than 404ing into 'exporter predates the feature'."""
+    import json
+
+    from kube_gpu_stats_tpu.hoststats import HostStats
+
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        host_provider=HostStats(enabled=False))
+    srv.start()
+    try:
+        payload = json.loads(fetch(srv.port, "/debug/host").read())
+        assert payload == {"enabled": False}
+    finally:
+        srv.stop()
+
+
+def test_debug_host_served_with_auth(tmp_path):
+    import json
+
+    from kube_gpu_stats_tpu.hoststats import HostStats
+    from kube_gpu_stats_tpu.testing import host_fixture
+
+    roots = host_fixture.make_host_tree(tmp_path)
+    host = HostStats(proc_root=str(roots["proc"]),
+                     sysfs_root=str(roots["sysfs"]),
+                     cgroup_root=str(roots["cgroup"]))
+    host.read()
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
+        host_provider=host)
+    srv.start()
+    try:
+        payload = json.loads(fetch(
+            srv.port, "/debug/host",
+            headers=auth_header("prom", "s3cret")).read())
+        assert payload["enabled"] is True
+        assert "memory_full_avg10" in payload["pressure"]
+        # Landing page lists the endpoint (inventory contract).
+        landing = fetch(srv.port, "/",
+                        headers=auth_header("prom", "s3cret")).read()
+        assert b"/debug/host" in landing
     finally:
         srv.stop()
 

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Host-correlation smoke (ISSUE 10, `make host-sim`): N REAL daemons
+(full Daemon wiring: TPU backend over make_sysfs + FakeLibtpuServer,
+FakeKubelet attribution) each over a faked /proc + /sys + cgroup v2
+host fixture, plus one hub scoring all of them. After the fleet lens's
+baselines warm up, ONE node gets a simultaneous straggler tick (a
+scripted RPC delay on its fake runtime) AND a host memory-pressure
+episode (its /proc/pressure/memory full avg10 jumps 0 -> 18%), end to
+end through:
+
+  daemon hoststats read (pool thread, off the tick path)
+    -> kts_host_* exposition -> hub digest harvest
+    -> fleet lens host_mem_stall baseline breach
+    -> doctor --fleet joined verdict
+
+Asserts `doctor --fleet` names the straggler node, its worst PHASE
+(fetch_wait/rpc_port from the flight-recorder digest), AND the
+co-occurring host signal in one correlated sentence ("... co-occurs
+with PSI memory full-stall 18.0%"). Exit 0 with a PASS line, else 1
+with the evidence. Wired into `make ci`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+STALL_PCT = 18.0
+
+
+def run(nodes: int, warmup: int, delay: float, verbose: bool) -> int:
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.testing import host_fixture
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    straggler_index = 0
+    daemons: list = []
+    fakes: list = []
+    hub = None
+    hub_server = None
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            targets = []
+            libtpus = []
+            proc_roots = []
+            for node in range(nodes):
+                root = pathlib.Path(tmp) / f"node{node}"
+                # Accelerator sysfs + the host fixture share one /sys
+                # (class/accel next to class/net + class/thermal), the
+                # way a real node looks.
+                make_sysfs(root / "sys", num_chips=2)
+                host_fixture.write_psi(root / "proc", "cpu",
+                                       some_avg10=1.0, some_total_us=10_000,
+                                       full_avg10=None)
+                host_fixture.write_psi(root / "proc", "memory",
+                                       some_avg10=0.0, full_avg10=0.0)
+                host_fixture.write_psi(root / "proc", "io",
+                                       some_avg10=0.5, full_avg10=0.0)
+                host_fixture.write_proc_stat(root / "proc")
+                host_fixture.write_softirqs(root / "proc")
+                host_fixture.write_nic(root / "sys")
+                host_fixture.write_thermal(root / "sys")
+                host_fixture.write_pod_cgroup(root / "cgroup")
+                proc_roots.append(root / "proc")
+                libtpu = FakeLibtpuServer(num_chips=2).start()
+                libtpus.append(libtpu)
+                socket = str(root / "kubelet.sock")
+                kubelet = FakeKubeletServer(
+                    socket, [tpu_pod(f"train-{node}", "ml", "worker",
+                                     ["0", "1"])]).start()
+                fakes.extend([libtpu, kubelet])
+                cfg = Config(
+                    backend="tpu",
+                    sysfs_root=str(root / "sys"),
+                    proc_root=str(root / "proc"),
+                    cgroup_root=str(root / "cgroup"),
+                    libtpu_ports=(libtpu.port,),
+                    interval=0.1,
+                    deadline=2.0,
+                    listen_host="127.0.0.1",
+                    listen_port=0,
+                    attribution="podresources",
+                    kubelet_socket=socket,
+                    attribution_interval=0.5,
+                    pipeline_fetch=False,  # the delayed fetch must land
+                    #                        in fetch_wait, not lag a fence
+                    use_native=False,
+                )
+                daemon = Daemon(cfg)
+                if node == straggler_index:
+                    # Raise the transport timeout so the injected delay
+                    # SLOWS the straggler's ticks instead of timing its
+                    # RPCs out fast (fleet_sim's lesson).
+                    daemon.collector._libtpu._client._rpc_timeout = 5.0
+                daemon.start()
+                daemons.append(daemon)
+                targets.append(
+                    f"http://127.0.0.1:{daemon.server.port}/metrics")
+
+            for daemon in daemons:
+                daemon.registry.wait_for_publish(0, timeout=10)
+
+            hub = Hub(targets, interval=0.2, expect_workers=nodes)
+            hub_server = MetricsServer(
+                hub.registry, host="127.0.0.1", port=0,
+                trace_provider=hub.tracer, fleet_provider=hub.fleet)
+            hub_server.start()
+
+            # Warm the host baselines (min_samples refreshes of flat-
+            # zero memory pressure) before the episode.
+            for _ in range(warmup):
+                time.sleep(0.3)
+                hub.refresh_once()
+
+            # The episode: a straggler tick AND host memory pressure on
+            # the same node, inside the same refresh windows.
+            straggler = targets[straggler_index]
+            libtpus[straggler_index].delay = delay
+            host_fixture.write_psi(
+                proc_roots[straggler_index], "memory",
+                some_avg10=35.0, full_avg10=STALL_PCT,
+                some_total_us=5_000_000, full_total_us=1_800_000)
+
+            result = None
+            correlated: dict = {}
+            for _ in range(20):
+                time.sleep(0.3)
+                hub.refresh_once()
+                result = doctor.check_fleet(
+                    f"http://127.0.0.1:{hub_server.port}")
+                correlated = (result.data or {}).get("correlated") or {}
+                if straggler in correlated:
+                    break
+            if verbose:
+                print(f"[{result.status}] fleet  {result.detail}")
+
+            attribution = (result.data or {}).get("attribution") or {}
+            worst_target = attribution.get("target", "")
+            phase = attribution.get("phase", "")
+            verdict = correlated.get(straggler) or {}
+            anomalous = ((result.data or {}).get("anomalous") or {}).get(
+                straggler) or {}
+            ok = (worst_target == straggler
+                  and phase in ("fetch_wait", "rpc_port")
+                  and "host_mem_stall" in anomalous
+                  and verdict.get("phase") in ("fetch_wait", "rpc_port")
+                  and "PSI memory full-stall" in result.detail
+                  and "co-occurs with" in result.detail)
+            if ok:
+                print(f"host-sim PASS: doctor --fleet correlated the "
+                      f"straggler ({straggler}, phase {phase}) with the "
+                      f"host episode (PSI memory full-stall "
+                      f"{verdict.get('host_values', {}).get('mem_full_avg10')}"
+                      f"%) across {nodes} nodes")
+                return 0
+            print("host-sim FAIL:")
+            print(f"  expected straggler {straggler}")
+            print(f"  attribution: {attribution}")
+            print(f"  anomalous[straggler]: {anomalous}")
+            print(f"  correlated: {correlated}")
+            print(f"  doctor detail: {result.detail if result else None}")
+            return 1
+        finally:
+            if hub_server is not None:
+                hub_server.stop()
+            if hub is not None:
+                hub.stop()
+            for daemon in daemons:
+                daemon.stop()
+            for fake in fakes:
+                fake.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--warmup", type=int, default=10,
+                        help="clean refreshes before the episode (must "
+                             "cover the lens's min_samples warmup)")
+    parser.add_argument("--delay", type=float, default=0.8,
+                        help="scripted RPC delay injected on node 0's "
+                             "fake runtime during the episode")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.nodes, args.warmup, args.delay, args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
