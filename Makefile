@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -103,6 +103,15 @@ trace-tick: native
 # for an A/B against the pre-plan builder path.
 profile-tick: native
 	python tools/profiler.py --ticks 200 --top 20
+
+# Localize an INGEST regression (<30 s): cProfile of the hub's
+# handler-thread delta apply path at 1k synthesized push sources
+# (decode, session validation, native slot patch), top-20 by
+# cumulative time. The bench's delta_ingest_* fields say THAT ingest
+# moved; this says WHERE. Add --legacy for an A/B against the
+# pure-Python per-slot oracle (--no-native-ingest).
+profile-ingest: native
+	python tools/profiler.py --ingest --sources 1000 --top 20
 
 native:
 	$(MAKE) -C kube_gpu_stats_tpu/native

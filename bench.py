@@ -27,12 +27,16 @@ import tempfile
 BUDGET_MS = 50.0
 
 
-def _delta_fields(line: dict) -> None:
+def _delta_fields(line: dict, quick: bool = False) -> None:
     """Push-delta + federation figures (ISSUE 7): the root-hub warm
     refresh at 4096 simulated workers over delta ingest, the per-wave
-    ingest cost, and the quiet-tick payload ratio. An extra datum —
-    omitted on failure, never a bench failure."""
+    ingest cost, and the quiet-tick payload ratio — plus the 10k-pusher
+    ingest storm (ISSUE 11: wave apply cost, ingest CPU share, and
+    fleet-wide resync-storm recovery; skipped in --quick to keep the
+    smoke under a minute). An extra datum — omitted on failure, never a
+    bench failure."""
     from kube_gpu_stats_tpu.bench import (measure_delta_federation,
+                                          measure_ingest_storm,
                                           measure_quiet_tick_delta)
 
     fed = measure_delta_federation()
@@ -48,6 +52,17 @@ def _delta_fields(line: dict) -> None:
         line["delta_quiet_tick_bytes"] = quiet["quiet_delta_bytes"]
         line["delta_full_snapshot_bytes"] = quiet["full_bytes"]
         line["delta_quiet_tick_ratio"] = quiet["ratio"]
+    if not quick:
+        storm = measure_ingest_storm()
+        if storm is not None:
+            line["delta_ingest_10k_ms_per_refresh"] = storm[
+                "delta_ingest_10k_ms_per_refresh"]
+            line["ingest_cpu_pct"] = storm["ingest_cpu_pct"]
+            line["resync_storm_recovery_s"] = storm[
+                "resync_storm_recovery_s"]
+            line["resync_storm_dropped"] = storm["resync_storm_dropped"]
+            line["ingest_lanes"] = storm["lanes"]
+            line["ingest_native"] = storm["native"]
 
 
 def _burst_fields(line: dict) -> None:
@@ -147,7 +162,7 @@ def _quick() -> int:
         line["hub_body_cache_hit_rate"] = hub["body_cache_hit_rate"]
         line["fleet_score_ms_per_refresh"] = hub.get(
             "fleet_score_ms_per_refresh")
-    _delta_fields(line)
+    _delta_fields(line, quick=True)
     _burst_fields(line)
     _host_fields(line)
     print(json.dumps(line))

@@ -781,6 +781,157 @@ def measure_delta_federation(leaves: int = 64, workers_per_leaf: int = 64,
         return None
 
 
+def build_pusher_body(worker: int, duty: float = 50.0,
+                      power: float = 300.0) -> str:
+    """One synthetic pusher's exposition for the ingest storm: a single
+    chip's gauge surface (~6 series). Tiny on purpose — the storm
+    prices the hub's per-frame ingest machinery (decode, session
+    validation, slot patch) at 10k-source fan-in, not body size."""
+    from . import schema
+    from .registry import SnapshotBuilder
+
+    builder = SnapshotBuilder()
+    labels = (("accel_type", "tpu-v5p"), ("chip", "0"),
+              ("device_path", "/dev/accel0"), ("uuid", ""),
+              ("slice", f"s{worker % 32:02d}"),
+              ("worker", str(worker)), ("topology", "4x4"))
+    builder.add(schema.DEVICE_UP, 1.0, labels)
+    builder.add(schema.DUTY_CYCLE, duty, labels)
+    builder.add(schema.MEMORY_USED, 1.0e9, labels)
+    builder.add(schema.MEMORY_TOTAL, 9.5e10, labels)
+    builder.add(schema.POWER, power, labels)
+    builder.add(schema.ICI_BANDWIDTH, 1e9, labels + (("link", "0"),))
+    return builder.build().render()
+
+
+def measure_ingest_storm(pushers: int = 10_000, waves: int = 3,
+                         interval: float = 10.0,
+                         storm_threads: int = 8,
+                         lanes: int = 0) -> dict | None:
+    """The 10k-pusher ingest storm (ISSUE 11 acceptance): `pushers`
+    synthesized delta sessions against one hub, frames crafted at the
+    wire level (encode_delta/encode_full — the publisher-side diff cost
+    is the pushers' own CPU, not the hub's), measuring:
+
+    - ``delta_ingest_10k_ms_per_refresh``: wall time applying one full
+      wave of per-pusher delta frames (two changed gauges each) — the
+      handler-thread work one refresh interval absorbs when every
+      pusher reports once per interval. Median over ``waves``.
+    - ``ingest_cpu_pct``: that wave as a percent of the refresh
+      interval — the hub's steady-state ingest CPU share at this
+      fan-in. Refresh-interval-bounded ingest means << 100.
+    - ``resync_storm_recovery_s``: a simulated fleet-wide restart —
+      EVERY session re-POSTs a FULL frame with a new generation, from
+      ``storm_threads`` concurrent threads (the lane-sharding test:
+      parses must not convoy behind one lock) — measured from first
+      frame to all applied plus the refresh that re-serves the fleet.
+    - ``resync_storm_dropped``: sessions lost across the storm (must
+      be 0: a restart is a resync, never an eviction).
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        import concurrent.futures
+
+        from .delta import encode_delta, encode_full
+        from .hub import Hub
+        from .validate import parse_exposition_interned
+
+        hub = Hub([], targets_provider=lambda: [], interval=interval,
+                  ingest_lanes=lanes)
+        try:
+            sources = [f"http://node-{i:05d}:9400/metrics"
+                       for i in range(pushers)]
+            bodies = [build_pusher_body(i) for i in range(pushers)]
+            # Slot indices of the two churning gauges — identical for
+            # every pusher (one builder shape).
+            probe = parse_exposition_interned(bodies[0])
+            slot_by_name = {name: slot for slot, (name, _labels, _v)
+                            in enumerate(probe)}
+            duty_slot = slot_by_name["accelerator_duty_cycle"]
+            power_slot = slot_by_name["accelerator_power_watts"]
+            churn_slots = sorted((duty_slot, power_slot))
+
+            seed_start = time.monotonic()
+            for i, source in enumerate(sources):
+                code, _ = hub.delta.handle(
+                    encode_full(source, i + 1, 1, bodies[i]))
+                assert code == 200, code
+            seed_s = time.monotonic() - seed_start
+            start = time.monotonic()
+            hub.refresh_once()
+            cold_refresh_ms = (time.monotonic() - start) * 1000.0
+
+            wave_ms: list[float] = []
+            seq = 1
+            for wave in range(waves):
+                seq += 1
+                wires = [
+                    encode_delta(
+                        source, i + 1, seq,
+                        [(churn_slots[0], 50.0 + wave + i * 1e-3),
+                         (churn_slots[1], 300.0 + wave)])
+                    for i, source in enumerate(sources)]
+                handle = hub.delta.handle
+                start = time.monotonic()
+                for wire in wires:
+                    code, _ = handle(wire)
+                    assert code == 200, code
+                wave_ms.append((time.monotonic() - start) * 1000.0)
+            start = time.monotonic()
+            hub.refresh_once()
+            warm_refresh_ms = (time.monotonic() - start) * 1000.0
+            assert hub._push_served == pushers, hub._push_served
+
+            # Fleet-wide restart: every pusher comes back with a new
+            # generation and one FULL, all at once, from concurrent
+            # threads (production: one handler thread per POST).
+            sessions_before = len(hub.delta.sources())
+            storm_wires = [
+                encode_full(source, i + 1 + 1_000_000, 1, bodies[i])
+                for i, source in enumerate(sources)]
+
+            def drain(chunk) -> None:
+                handle = hub.delta.handle
+                for wire in chunk:
+                    code, _ = handle(wire)
+                    assert code == 200, code
+
+            ways = max(1, storm_threads)
+            per = -(-len(storm_wires) // ways)
+            start = time.monotonic()
+            with concurrent.futures.ThreadPoolExecutor(ways) as pool:
+                futures = [pool.submit(drain, storm_wires[i:i + per])
+                           for i in range(0, len(storm_wires), per)]
+                for future in futures:
+                    future.result()
+            hub.refresh_once()
+            recovery_s = time.monotonic() - start
+            sessions_after = len(hub.delta.sources())
+            served_after = hub._push_served
+        finally:
+            hub.stop()
+        return {
+            "pushers": pushers,
+            "lanes": hub.delta.lanes,
+            "native": hub.delta.native_active,
+            "seed_s": round(seed_s, 2),
+            "cold_refresh_ms": round(cold_refresh_ms, 1),
+            "warm_refresh_ms": round(warm_refresh_ms, 1),
+            "delta_ingest_10k_ms_per_refresh": round(
+                statistics.median(wave_ms), 1),
+            "ingest_cpu_pct": round(
+                100.0 * statistics.median(wave_ms) / (interval * 1000.0),
+                2),
+            "resync_storm_recovery_s": round(recovery_s, 2),
+            "resync_storm_sessions": sessions_after,
+            "resync_storm_dropped": sessions_before - sessions_after,
+            "resync_storm_served": served_after,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
 def measure_burst_overhead(ticks: int = 200, chips: int = 8,
                            hz: float = 100.0, budget_ms: float = 50.0,
                            thread_seconds: float = 1.0) -> dict | None:

@@ -39,11 +39,13 @@ entries.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import struct
 import threading
 import time
+import zlib
 from typing import NamedTuple, Sequence
 
 from . import snappy
@@ -51,6 +53,22 @@ from .validate import parse_exposition_interned
 from .workers import PublishFollower, push_opener
 
 log = logging.getLogger(__name__)
+
+# Default ingest lane count (ISSUE 11): enough lanes that handler
+# threads of distinct sources rarely share a lock, few enough that the
+# per-lane self-metric series stay a rounding error on the exposition.
+DEFAULT_INGEST_LANES = max(1, min(8, os.cpu_count() or 1))
+
+
+def lane_of(source: str, lanes: int) -> int:
+    """Deterministic source -> lane routing shared by the session lanes
+    and the sharded entry store (the two MUST agree, or a lane would
+    lock itself against a session whose entry lives in another lane's
+    slab). crc32, not hash(): stable under PYTHONHASHSEED so lane
+    assignment is reproducible across runs and debuggable from logs."""
+    if lanes <= 1:
+        return 0
+    return zlib.crc32(source.encode()) % lanes
 
 MAGIC = b"KTSD"
 VERSION = 1
@@ -203,15 +221,42 @@ def decode_frame(wire: bytes) -> Frame:
     slots = []
     values = []
     slot = 0
-    for i in range(count):
-        gap, pos = _read_varint(data, pos)
-        slot = slot + gap if i else gap
-        if pos + 8 > len(data):
-            raise ValueError("truncated delta value")
-        slots.append(slot)
-        values.append(_F64.unpack_from(data, pos)[0])
-        pos += 8
-    if pos != len(data):
+    # Inlined varint walk (single-byte fast path): this loop runs once
+    # per changed slot per pushed frame — at 10k-pusher fan-in the
+    # _read_varint call overhead alone was a visible slice of ingest
+    # CPU. Bounds surface as IndexError -> the same "truncated varint"
+    # verdict the helper raises.
+    n = len(data)
+    append_slot = slots.append
+    append_value = values.append
+    unpack_from = _F64.unpack_from
+    try:
+        for i in range(count):
+            byte = data[pos]
+            pos += 1
+            if byte < 0x80:
+                gap = byte
+            else:
+                gap = byte & 0x7F
+                shift = 7
+                while True:
+                    byte = data[pos]
+                    pos += 1
+                    gap |= (byte & 0x7F) << shift
+                    if not byte & 0x80:
+                        break
+                    shift += 7
+                    if shift > 63:
+                        raise ValueError("varint too long")
+            slot = slot + gap if i else gap
+            if pos + 8 > n:
+                raise ValueError("truncated delta value")
+            append_slot(slot)
+            append_value(unpack_from(data, pos)[0])
+            pos += 8
+    except IndexError:
+        raise ValueError("truncated varint") from None
+    if pos != n:
         raise ValueError("trailing bytes after delta changes")
     return Frame(kind, source, generation, seq, None,
                  tuple(slots), tuple(values))
@@ -435,9 +480,9 @@ class _Session:
     pays replay, never apply."""
 
     __slots__ = ("source", "generation", "seq", "last_monotonic", "frames",
-                 "last_gap")
+                 "last_gap", "order")
 
-    def __init__(self, source: str) -> None:
+    def __init__(self, source: str, order: int = 0) -> None:
         self.source = source
         self.generation = 0
         self.seq = 0
@@ -448,11 +493,86 @@ class _Session:
         # path scores scrape latency — a publisher falling behind its
         # cadence shows up here refreshes before it goes fence-stale).
         self.last_gap = 0.0
+        # Global admission sequence: sources() reports sessions in
+        # fleet-wide arrival order even though they live in per-lane
+        # tables, so the hub's target order (and its first-wins series
+        # dedup) is indistinguishable from the single-table era.
+        self.order = order
 
     def stamp(self, now: float) -> None:
         if self.last_monotonic:
             self.last_gap = now - self.last_monotonic
         self.last_monotonic = now
+
+
+class _Lane:
+    """One ingest lane: a shared-nothing shard of the receiver.
+
+    Sources hash here (lane_of) and everything a frame apply touches —
+    the lock, the session table, and (via LaneStore) the entry slab —
+    is lane-local, so handler threads for sources in different lanes
+    never contend. Counters are lane-local too (summed by the
+    DeltaIngest properties): a shared counter would re-serialize every
+    lane on one cache line's worth of lock."""
+
+    __slots__ = ("lock", "sessions", "full_frames", "delta_frames",
+                 "bytes", "resyncs", "apply_seconds")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.sessions: dict[str, _Session] = {}
+        self.full_frames = 0
+        self.delta_frames = 0
+        self.bytes = 0
+        self.resyncs = 0
+        # Cumulative wall seconds handler threads spent inside apply()
+        # (parse + patch). Exported per lane: ingest CPU is the root
+        # hub's ceiling at fleet fan-in, and this is what prices it.
+        self.apply_seconds = 0.0
+
+
+class LaneStore:
+    """Sharded target -> _TargetCache mapping: one dict slab per ingest
+    lane, routed by the same lane_of() the session lanes use, so a
+    lane's frame applies only ever touch its own slab. Presents the
+    small dict surface the hub's refresh path uses (get/set/del/
+    contains/iter) — the lanes are merged into one coherent view simply
+    by iterating the slabs at render-generation time; individual dict
+    operations stay GIL-atomic exactly like the single-dict era."""
+
+    __slots__ = ("shards",)
+
+    def __init__(self, lanes: int = 1) -> None:
+        self.shards: tuple[dict, ...] = tuple(
+            {} for _ in range(max(1, lanes)))
+
+    def _shard(self, key: str) -> dict:
+        return self.shards[lane_of(key, len(self.shards))]
+
+    def get(self, key: str, default=None):
+        return self._shard(key).get(key, default)
+
+    def __getitem__(self, key: str):
+        return self._shard(key)[key]
+
+    def __setitem__(self, key: str, value) -> None:
+        self._shard(key)[key] = value
+
+    def __delitem__(self, key: str) -> None:
+        del self._shard(key)[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._shard(key)
+
+    def __iter__(self):
+        for shard in self.shards:
+            # list() per shard: a concurrent handler-thread insert must
+            # not blow up a refresh-thread iteration (same contract the
+            # hub's eviction loop already applies to the parse cache).
+            yield from list(shard)
+
+    def __len__(self) -> int:
+        return sum(len(shard) for shard in self.shards)
 
 
 class DeltaIngest:
@@ -470,28 +590,74 @@ class DeltaIngest:
     cycle, :meth:`sources` to merge live push sources into the target
     list, and :meth:`evict` on churn.
 
-    Concurrency: the ingest lock serializes frame applies; the refresh
-    thread reads entries without it. A patch landing mid-refresh can
-    hand that one refresh a mix of two adjacent frames' values for ONE
-    target (each slot individually consistent) — the next refresh sees
-    the settled state, the same freshness contract a pull of a
-    mid-write textfile target has always had."""
+    Concurrency (ISSUE 11): sources hash to shared-nothing LANES
+    (lane_of), each with its own lock, session table and — when the hub
+    wires a LaneStore — entry slab, so frame applies only serialize
+    against the same lane's sources; the refresh thread reads entries
+    without any lock and merges the lane views at render-generation
+    time. A patch landing mid-refresh can hand that one refresh a mix
+    of two adjacent frames' values for ONE target (each slot
+    individually consistent) — the next refresh sees the settled state,
+    the same freshness contract a pull of a mid-write textfile target
+    has always had. The hot per-slot patch loop runs behind the native
+    wirefast extension when built (apply_slots); the Python per-slot
+    path stays as the differential oracle (--no-native-ingest)."""
 
     def __init__(self, tracer=None, expiry: float = 60.0,
-                 entry_factory=None, entry_store=None) -> None:
-        self._lock = threading.Lock()
-        self._sessions: dict[str, _Session] = {}
+                 entry_factory=None, entry_store=None, lanes: int = 1,
+                 native: bool = True) -> None:
         self._tracer = tracer
         self._expiry = expiry
+        # Sharded lanes (ISSUE 11 tentpole): sources hash to a lane;
+        # each lane serializes only its own sources' applies, so at
+        # 10k-pusher fan-in the handler threads stop convoying behind
+        # one global lock. lane 0 alone reproduces the old behavior.
+        self._lanes = tuple(_Lane() for _ in range(max(1, lanes)))
+        self._order = itertools.count(1)
         # Injected by the hub (delta.py must not import hub.py):
         # entry_factory(series_list) -> pushed ingest entry;
-        # entry_store is the hub's target -> entry mapping.
+        # entry_store is the hub's target -> entry mapping (a LaneStore
+        # sharded with the same lane_of routing when the hub runs
+        # sharded ingest; any plain mapping works — dict ops are
+        # GIL-atomic either way).
         self._entry_factory = entry_factory
         self._entry_store = entry_store if entry_store is not None else {}
-        self.full_frames_total = 0
-        self.delta_frames_total = 0
-        self.bytes_total = 0
-        self.resyncs_total = 0
+        # Native slot-batch apply (wirefast.cc apply_slots): loaded once
+        # here, handed to every entry patch. None = the Python per-slot
+        # oracle (--no-native-ingest, or the extension isn't built).
+        self._native_mod = None
+        if native:
+            from . import native as native_pkg
+
+            self._native_mod = native_pkg.load_ingest()
+
+    @property
+    def lanes(self) -> int:
+        return len(self._lanes)
+
+    @property
+    def native_active(self) -> bool:
+        return self._native_mod is not None
+
+    # Fleet-wide counters: summed over lanes on read (the write side is
+    # lane-local so lanes never share a hot line; reads happen once per
+    # refresh/publish, where a few adds are free).
+
+    @property
+    def full_frames_total(self) -> int:
+        return sum(lane.full_frames for lane in self._lanes)
+
+    @property
+    def delta_frames_total(self) -> int:
+        return sum(lane.delta_frames for lane in self._lanes)
+
+    @property
+    def bytes_total(self) -> int:
+        return sum(lane.bytes for lane in self._lanes)
+
+    @property
+    def resyncs_total(self) -> int:
+        return sum(lane.resyncs for lane in self._lanes)
 
     # -- write side (HTTP POST threads) --------------------------------------
 
@@ -511,105 +677,154 @@ class DeltaIngest:
             return 400, f"bad delta frame: {exc}\n".encode()
         return 200, b"ok\n"
 
-    def _resync(self, source: str, reason: str) -> ResyncRequired:
-        self.resyncs_total += 1
+    def _route(self, source: str) -> tuple[_Lane, dict]:
+        """(lane, entry mapping) for a source — the source is hashed
+        ONCE per frame: when the entry store is a LaneStore sharded
+        like the session lanes (the hub wiring), the lane's shard dict
+        is returned directly instead of re-hashing through the store's
+        routing on every get/set."""
+        index = lane_of(source, len(self._lanes))
+        store = self._entry_store
+        if isinstance(store, LaneStore) and \
+                len(store.shards) == len(self._lanes):
+            return self._lanes[index], store.shards[index]
+        return self._lanes[index], store
+
+    def _resync(self, lane: _Lane, source: str,
+                reason: str) -> ResyncRequired:
+        lane.resyncs += 1
         if self._tracer is not None:
             self._tracer.event("delta_resync", f"{source}: {reason}",
                                source=source)
         return ResyncRequired(reason)
 
     def apply(self, frame: Frame, nbytes: int) -> None:
+        start = time.perf_counter()
         # The expensive halves of a FULL — tokenizing the body and
         # building the entry's derived views — run BEFORE the lock: a
         # resync storm (every publisher re-POSTing a FULL after a hub
         # restart) must not convoy N handler threads behind one
-        # multi-millisecond parse.
+        # multi-millisecond parse. With sharded lanes the storm also
+        # spreads the post-parse session work over the lane locks.
         entry = None
         if frame.kind == KIND_FULL:
             series = parse_exposition_interned(frame.body)
             if self._entry_factory is not None:
                 entry = self._entry_factory(series)
-        with self._lock:
-            self.bytes_total += nbytes
-            session = self._sessions.get(frame.source)
-            if frame.kind == KIND_FULL:
-                if session is None:
-                    session = _Session(frame.source)
-                    self._sessions[frame.source] = session
-                elif session.generation not in (0, frame.generation):
-                    # A worker restarted with a new generation: the FULL
-                    # replaces everything, but journal the restart — the
-                    # stale seq chain dies HERE, visibly.
-                    if self._tracer is not None:
-                        self._tracer.event(
-                            "delta_restart",
-                            f"{frame.source}: new generation "
-                            f"{frame.generation} (was {session.generation})",
-                            source=frame.source)
-                session.generation = frame.generation
-                session.seq = frame.seq
-                session.stamp(time.monotonic())
-                session.frames += 1
-                self.full_frames_total += 1
-                if entry is not None:
-                    self._entry_store[frame.source] = entry
-                return
+        lane, store = self._route(frame.source)
+        # The pre-lock span (parse + entry build) is real work; the
+        # LOCK WAIT is not — timing across the acquire would inflate
+        # kts_ingest_lane_apply_seconds_total by the queueing delay
+        # exactly when contention makes the metric matter, and its
+        # documented "summed rate = ingest CPU share" reading would
+        # mis-trigger the scaling runbook.
+        pre_lock_seconds = time.perf_counter() - start
+        with lane.lock:
+            locked_start = time.perf_counter()
+            try:
+                self._apply_locked(lane, store, frame, nbytes, entry)
+            finally:
+                # Accumulated under the lane lock (a plain += would race
+                # another handler thread exiting the same lane): the
+                # kts_ingest_lane_apply_seconds_total source — what the
+                # handler threads actually cost, parse included, lock
+                # wait excluded.
+                lane.apply_seconds += (pre_lock_seconds
+                                      + time.perf_counter() - locked_start)
+
+    def _apply_locked(self, lane: _Lane, store: dict, frame: Frame,
+                      nbytes: int, entry) -> None:
+        lane.bytes += nbytes
+        session = lane.sessions.get(frame.source)
+        if frame.kind == KIND_FULL:
             if session is None:
-                raise self._resync(
-                    frame.source,
-                    "no session state (hub restarted or source evicted)")
-            entry = self._entry_store.get(frame.source)
-            if (entry is None or not getattr(entry, "pushed", False)
-                    or entry.series is None):
-                # The entry fell out from under the session (evicted on
-                # churn, or a pull fallback replaced it): only a FULL
-                # can re-anchor slot indexing.
-                raise self._resync(
-                    frame.source,
-                    "no ingest entry for this session (evicted or "
-                    "replaced by a pull)")
-            if frame.generation != session.generation:
-                raise self._resync(
-                    frame.source,
-                    f"generation mismatch (session {session.generation}, "
-                    f"frame {frame.generation})")
-            if frame.seq != session.seq + 1:
-                raise self._resync(
-                    frame.source,
-                    f"seq gap (session at {session.seq}, frame {frame.seq})")
-            n = len(entry.series)
-            for slot in frame.slots:
-                if slot >= n:
-                    raise self._resync(
-                        frame.source, f"slot {slot} out of range ({n})")
-            entry.apply_patch(frame.slots, frame.values, frame.source)
+                session = _Session(frame.source, next(self._order))
+                lane.sessions[frame.source] = session
+            elif session.generation not in (0, frame.generation):
+                # A worker restarted with a new generation: the FULL
+                # replaces everything, but journal the restart — the
+                # stale seq chain dies HERE, visibly.
+                if self._tracer is not None:
+                    self._tracer.event(
+                        "delta_restart",
+                        f"{frame.source}: new generation "
+                        f"{frame.generation} (was {session.generation})",
+                        source=frame.source)
+            session.generation = frame.generation
             session.seq = frame.seq
             session.stamp(time.monotonic())
             session.frames += 1
-            self.delta_frames_total += 1
+            lane.full_frames += 1
+            if entry is not None:
+                store[frame.source] = entry
+            return
+        if session is None:
+            raise self._resync(
+                lane, frame.source,
+                "no session state (hub restarted or source evicted)")
+        entry = store.get(frame.source)
+        if (entry is None or not getattr(entry, "pushed", False)
+                or entry.series is None):
+            # The entry fell out from under the session (evicted on
+            # churn, or a pull fallback replaced it): only a FULL
+            # can re-anchor slot indexing.
+            raise self._resync(
+                lane, frame.source,
+                "no ingest entry for this session (evicted or "
+                "replaced by a pull)")
+        if frame.generation != session.generation:
+            raise self._resync(
+                lane, frame.source,
+                f"generation mismatch (session {session.generation}, "
+                f"frame {frame.generation})")
+        if frame.seq != session.seq + 1:
+            raise self._resync(
+                lane, frame.source,
+                f"seq gap (session at {session.seq}, frame {frame.seq})")
+        n = len(entry.series)
+        for slot in frame.slots:
+            if slot >= n:
+                raise self._resync(
+                    lane, frame.source, f"slot {slot} out of range ({n})")
+        entry.apply_patch(frame.slots, frame.values, frame.source,
+                          native_mod=self._native_mod)
+        session.seq = frame.seq
+        session.stamp(time.monotonic())
+        session.frames += 1
+        lane.delta_frames += 1
 
     # -- read side (hub refresh thread) --------------------------------------
 
     def sources(self) -> list[str]:
-        """Live push sources (insertion order — stable for the target
-        merge), dropping sessions silent past the expiry window so a
-        decommissioned worker eventually leaves the target list."""
+        """Live push sources (fleet-wide admission order — stable for
+        the target merge, lane-independent), dropping sessions silent
+        past the expiry window so a decommissioned worker eventually
+        leaves the target list."""
         now = time.monotonic()
-        with self._lock:
-            dead = [s for s, session in self._sessions.items()
-                    if now - session.last_monotonic > self._expiry]
-            for source in dead:
-                del self._sessions[source]
-            return list(self._sessions)
+        ordered: list[tuple[int, str]] = []
+        for lane in self._lanes:
+            with lane.lock:
+                dead = [s for s, session in lane.sessions.items()
+                        if now - session.last_monotonic > self._expiry]
+                for source in dead:
+                    del lane.sessions[source]
+                ordered.extend((session.order, source)
+                               for source, session in lane.sessions.items())
+        ordered.sort()
+        return [source for _order, source in ordered]
 
     def fresh_sources(self, fence: float) -> list[str]:
         """Sources whose session produced a frame within ``fence``
         seconds — the targets this refresh serves from push state.
         Everything else falls through to the pull path."""
         now = time.monotonic()
-        with self._lock:
-            return [source for source, session in self._sessions.items()
-                    if now - session.last_monotonic <= fence]
+        out: list[str] = []
+        for lane in self._lanes:
+            with lane.lock:
+                out.extend(source
+                           for source, session in lane.sessions.items()
+                           if now - session.last_monotonic <= fence)
+        return out
 
     def frame_gaps(self) -> dict[str, float]:
         """Last inter-arrival gap per live session, seconds — the
@@ -618,25 +833,46 @@ class DeltaIngest:
         would blind the fleet lens to a publisher falling behind; the
         frame gap is the honest equivalent. 0.0 until a session's
         second frame."""
-        with self._lock:
-            return {source: session.last_gap
-                    for source, session in self._sessions.items()}
+        gaps: dict[str, float] = {}
+        for lane in self._lanes:
+            with lane.lock:
+                for source, session in lane.sessions.items():
+                    gaps[source] = session.last_gap
+        return gaps
 
     def evict(self, alive: set) -> None:
         """Drop sessions for departed targets on the same refresh path
         that evicts their _TargetCache entries — a worker restarting
         behind a churned target list must start from a FULL resync, not
         a stale seq chain (ISSUE 7 satellite)."""
-        with self._lock:
-            for source in [s for s in self._sessions if s not in alive]:
-                del self._sessions[source]
+        for lane in self._lanes:
+            with lane.lock:
+                for source in [s for s in lane.sessions
+                               if s not in alive]:
+                    del lane.sessions[source]
 
     def stats(self) -> dict[str, float]:
-        with self._lock:
-            return {
-                "full_frames": self.full_frames_total,
-                "delta_frames": self.delta_frames_total,
-                "bytes": self.bytes_total,
-                "resyncs": self.resyncs_total,
-                "sessions": len(self._sessions),
-            }
+        return {
+            "full_frames": self.full_frames_total,
+            "delta_frames": self.delta_frames_total,
+            "bytes": self.bytes_total,
+            "resyncs": self.resyncs_total,
+            "sessions": sum(len(lane.sessions) for lane in self._lanes),
+        }
+
+    def lane_stats(self) -> list[dict[str, float]]:
+        """Per-lane health for the kts_ingest_lane_* self-metrics: live
+        sessions, frames applied, and cumulative handler-thread apply
+        seconds. One snapshot per publish — a skewed sessions spread
+        (every pusher in one lane) or one lane's apply_seconds running
+        hot is the sharding-isn't-helping signal the runbook keys on."""
+        out = []
+        for lane in self._lanes:
+            with lane.lock:
+                out.append({
+                    "sessions": float(len(lane.sessions)),
+                    "frames": float(lane.full_frames + lane.delta_frames),
+                    "resyncs": float(lane.resyncs),
+                    "apply_seconds": lane.apply_seconds,
+                })
+        return out

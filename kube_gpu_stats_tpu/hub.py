@@ -184,7 +184,8 @@ class _TargetCache:
     __slots__ = ("body", "body_hash", "series", "series_dicts",
                  "chip_plan", "rollup_plan", "hist_local", "frame_rows",
                  "frame_rollups", "fleet_digest", "stat_sig", "pushed",
-                 "wants_rollup", "patch_actions")
+                 "wants_rollup", "patch_actions", "patch_program",
+                 "value_slab")
 
     def __init__(self, body: str, series: list,
                  stat_sig: tuple | None = None,
@@ -225,8 +226,18 @@ class _TargetCache:
         # replacements), so which fold a value change feeds — and under
         # which pre-sorted key — is computed once, not per delta.
         self.patch_actions: dict[int, tuple] = {}
+        # Native patch program (ISSUE 11): the whole-entry flat
+        # compilation of patch_actions — per-slot kind bytes, plan pair
+        # indices, fold keys/columns — plus the per-entry float slab of
+        # current slot values, consumed by wirefast.apply_slots in one
+        # C call per delta frame. Compiled lazily once both plans exist
+        # (same gate as patch_actions caching); None until then and on
+        # the pure-Python oracle path.
+        self.patch_program: tuple | None = None
+        self.value_slab = None
 
-    def apply_patch(self, slots, values, target: str) -> None:
+    def apply_patch(self, slots, values, target: str,
+                    native_mod=None) -> None:
         """Apply delta (slot, value) changes in place: the series views,
         any built merge plans, AND the cached frame fold are patched
         slot-wise (labels never change in a delta — shape changes
@@ -237,7 +248,48 @@ class _TargetCache:
         slot drops the cached fleet digest, and accelerator_*/slice_*
         slots update the pristine cached ChipRow/rollup entries
         directly — the same values a full refold would compute
-        (differential-pinned against the pull-merge oracle)."""
+        (differential-pinned against the pull-merge oracle).
+
+        With ``native_mod`` (the wirefast extension) the per-slot loop
+        runs as ONE C call over the entry's compiled patch program +
+        value slab (ISSUE 11) — semantics identical to the Python loop
+        below, which stays as the differential oracle and the fallback
+        while the program isn't compiled yet (plans still building) or
+        the extension isn't available."""
+        if native_mod is not None:
+            program = self.patch_program
+            if program is None:
+                program = self._compile_program(target)
+            if program is not None:
+                try:
+                    flags = native_mod.apply_slots(self, tuple(slots),
+                                                   tuple(values))
+                except Exception:
+                    # A native fault must degrade to the oracle, not
+                    # drop the frame: recompile next time (the slab may
+                    # be mid-write), drop every fold cache (a partial C
+                    # apply may have fed some folds and not others; the
+                    # next refresh refolds from the series views the
+                    # Python loop below repairs), and patch this frame
+                    # in Python.
+                    log.warning("native apply_slots failed; falling back "
+                                "to the Python patch loop", exc_info=True)
+                    self.patch_program = None
+                    self.value_slab = None
+                    self.hist_local = None
+                    self.fleet_digest = None
+                    self.frame_rows = None
+                    self.frame_rollups = None
+                else:
+                    if flags:
+                        if flags & 1:
+                            self.hist_local = None
+                        if flags & 2:
+                            self.fleet_digest = None
+                        if flags & 4:
+                            self.frame_rows = None
+                            self.frame_rollups = None
+                    return
         series = self.series
         dicts = self.series_dicts
         actions = self.patch_actions
@@ -345,6 +397,51 @@ class _TargetCache:
             self.patch_actions[slot] = action
         return action
 
+    def _compile_program(self, target: str) -> tuple | None:
+        """Flatten every slot's patch action into the arrays the native
+        apply_slots loop consumes — per-slot kind byte, chip/rollup
+        plan pair index, fold key, ChipRow column — plus the per-entry
+        float slab seeded with the CURRENT slot values (the ICI-delta
+        old-value source, kept in sync by the C store from then on).
+        Compiled once per entry life, under the same both-plans-exist
+        gate as patch_actions caching: pair indices compiled against a
+        half-built plan set would freeze wrong positions in. Returns
+        None while the gate isn't met (the Python oracle carries those
+        frames)."""
+        if self.chip_plan is None or (
+                self.rollup_plan is None and self.wants_rollup):
+            return None
+        import array as array_mod
+        import sys as sys_mod
+
+        n = len(self.series)
+        kinds = bytearray(n)
+        chip_idx = array_mod.array("i")
+        rollup_idx = array_mod.array("i")
+        keys: list = []
+        cols: list = []
+        actions_get = self.patch_actions.get
+        for slot in range(n):
+            action = actions_get(slot)
+            if action is None:
+                action = self._compile_patch(slot, target)
+            kind, fold_key, column, ci, ri = action
+            kinds[slot] = kind
+            chip_idx.append(ci)
+            rollup_idx.append(ri)
+            keys.append(fold_key)
+            cols.append(sys_mod.intern(column)
+                        if isinstance(column, str) else None)
+        self.value_slab = array_mod.array(
+            "d", (entry[2] for entry in self.series))
+        # Index arrays ship as immutable bytes (int32 little-endian via
+        # array('i')): the C side reads them pointer-direct with no
+        # per-call buffer acquisition.
+        self.patch_program = (bytes(kinds), chip_idx.tobytes(),
+                              rollup_idx.tobytes(),
+                              tuple(keys), tuple(cols))
+        return self.patch_program
+
 
 class Hub:
     """Owns the refresh loop and the merged registry.
@@ -374,7 +471,9 @@ class Hub:
                  fleetlens.DEFAULT_STRAGGLER_RATIO,
                  delta_ingest: bool = True,
                  push_fence: float | None = None,
-                 federate: bool = False) -> None:
+                 federate: bool = False,
+                 ingest_lanes: int = 0,
+                 native_ingest: bool = True) -> None:
         if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -428,8 +527,18 @@ class Hub:
         self._hist_cache: dict[str, dict] = {}
         # Zero-reparse ingest state per target (_TargetCache): body hash
         # short-circuit + cached parse/merge-plan. Evicted with the
-        # target (_refresh_targets) so churn can't leak entries.
-        self._parse_cache: dict[str, _TargetCache] = {}
+        # target (_refresh_targets) so churn can't leak entries. With
+        # delta ingest on, this is a LaneStore — one dict slab per
+        # ingest lane, routed by the same source hash the session lanes
+        # use, so a lane's POST-thread applies never touch another
+        # lane's slab; the refresh thread merges the lane views at
+        # render-generation time simply by reading through it. 0 lanes
+        # = auto (a few, bounded by the core count).
+        self._ingest_lanes = (ingest_lanes if ingest_lanes > 0
+                              else delta_mod.DEFAULT_INGEST_LANES)
+        self._parse_cache = (delta_mod.LaneStore(self._ingest_lanes)
+                             if delta_ingest
+                             else {})
         self._body_cache_hits = 0
         self._parse_hist = HistogramState.empty(
             schema.HUB_PARSE_SECONDS, schema.HUB_PARSE_BUCKETS)
@@ -493,7 +602,9 @@ class Hub:
             expiry=max(10.0 * self._push_fence, 60.0),
             entry_factory=lambda series: _TargetCache(
                 "", series, pushed=True, wants_rollup=federate),
-            entry_store=self._parse_cache)
+            entry_store=self._parse_cache,
+            lanes=self._ingest_lanes,
+            native=native_ingest)
             if delta_ingest else None)
         self._push_served = 0  # targets served by push, last refresh
         # Federated slice_* series dropped because two leaves claimed
@@ -1116,6 +1227,23 @@ class Hub:
             builder.add(schema.HUB_RESYNC, float(self.delta.resyncs_total))
             builder.add(schema.DELTA_PUSH_TARGETS,
                         float(self._push_served))
+            # Sharded-ingest health (ISSUE 11): lane count + native
+            # path in effect, and per-lane session spread / frame
+            # volume / handler-thread apply seconds — the evidence the
+            # "Scaling ingest" runbook keys on (one lane hot while the
+            # rest idle = a pathological source hash or one chatty
+            # publisher, not an undersized hub).
+            builder.add(schema.INGEST_LANES, float(self.delta.lanes))
+            builder.add(schema.INGEST_NATIVE,
+                        1.0 if self.delta.native_active else 0.0)
+            for index, lane in enumerate(self.delta.lane_stats()):
+                labels = (("lane", str(index)),)
+                builder.add(schema.INGEST_LANE_SESSIONS,
+                            lane["sessions"], labels)
+                builder.add(schema.INGEST_LANE_FRAMES,
+                            lane["frames"], labels)
+                builder.add(schema.INGEST_LANE_APPLY_SECONDS,
+                            lane["apply_seconds"], labels)
         if self._federate:
             # Born at 0 on every federation root (increase() alerting):
             # non-federate hubs never re-export slice_* series, so the
@@ -1728,6 +1856,20 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="seconds a delta-push session may be silent "
                              "before the target falls back to pull-scrape "
                              "for the refresh (default 3x --interval)")
+    parser.add_argument("--ingest-lanes", type=int, default=0,
+                        help="shared-nothing delta-ingest lanes (sources "
+                             "hash to a lane; each has its own lock, "
+                             "session table and entry slab, so POST "
+                             "handler threads stop convoying behind one "
+                             "lock at high pusher fan-in). 0 = auto "
+                             "(bounded by the core count); 1 restores "
+                             "the single-lock behavior")
+    parser.add_argument("--no-native-ingest", action="store_true",
+                        help="apply delta frames with the pure-Python "
+                             "per-slot loop instead of the native "
+                             "wirefast batch store — the differential "
+                             "oracle; ~an order of magnitude more ingest "
+                             "CPU per frame at 10k-pusher fan-in")
     parser.add_argument("--listen-host", default="0.0.0.0")
     parser.add_argument("--listen-port", type=int, default=DEFAULT_PORT)
     parser.add_argument("--once", action="store_true",
@@ -1816,6 +1958,8 @@ def main(argv: Sequence[str] | None = None) -> int:
     push_error = validate_delta_push_args(args)
     if push_error:
         parser.error(push_error)
+    if args.ingest_lanes < 0 or args.ingest_lanes > 256:
+        parser.error("--ingest-lanes must be 0 (auto) or 1..256")
 
     # A long-running service needs visible logs (refresh failures, dropped
     # duplicates, credential problems); mirrors the daemon's text format.
@@ -1909,7 +2053,9 @@ def main(argv: Sequence[str] | None = None) -> int:
               slo_straggler_ratio=args.slo_straggler_ratio,
               delta_ingest=not args.no_delta_ingest,
               push_fence=args.push_fence or None,
-              federate=args.federate)
+              federate=args.federate,
+              ingest_lanes=args.ingest_lanes,
+              native_ingest=not args.no_native_ingest)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.

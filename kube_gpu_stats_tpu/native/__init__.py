@@ -48,3 +48,23 @@ def load_wirefast():
         tpumetrics.COLLECTIVES.encode(),
     )
     return _wirefast
+
+
+def load_ingest():
+    """The native hub-ingest batch apply (wirefast.cc apply_slots), or
+    None — the hub's DeltaIngest falls back to the Python per-slot
+    oracle. A stale prebuilt .so without apply_slots degrades the same
+    way (hasattr, not version sniffing): the ingest path must never be
+    one ABI drift away from a crash."""
+    mod = load_wirefast()
+    if mod is None or not hasattr(mod, "apply_slots"):
+        return None
+    try:
+        from ..registry import Series
+
+        mod.configure_apply(Series)
+    except Exception:
+        log.warning("native ingest apply failed to configure; "
+                    "using pure Python", exc_info=True)
+        return None
+    return mod

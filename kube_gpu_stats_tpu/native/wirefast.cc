@@ -939,6 +939,441 @@ PyObject* py_configure(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// --- hub delta-ingest batch apply (ISSUE 11) -------------------------------
+//
+// apply_slots(entry, slots, values) runs the hub's _TargetCache
+// per-slot patch loop in one C call: store each value into the entry's
+// float slab, rebuild the series/dict view tuples (names and label
+// objects are reused — only the value leaf changes), rebuild the
+// chip/rollup merge-plan pairs the slot feeds, and patch the cached
+// frame fold (ChipRow column setattr / ICI delta accumulate / rollup
+// cell store). Semantics are pinned byte-identical to
+// _TargetCache.apply_patch (the Python oracle, kept behind
+// --no-native-ingest) by tests/test_ingest_differential.py.
+//
+// The per-slot dispatch comes from the entry's compiled patch program
+// (hub._TargetCache._compile_program): kind byte, chip/rollup pair
+// index, fold key, row column — the kind values below MUST stay in
+// sync with hub._PATCH_* (pinned by the differential suite).
+
+constexpr int kPatchPlain = 0;
+constexpr int kPatchRow = 1;
+constexpr int kPatchIci = 2;
+constexpr int kPatchRollup = 3;
+constexpr int kPatchHist = 4;
+constexpr int kPatchDigest = 5;
+
+// Invalidation flags returned to Python (applied to the entry there so
+// this function never mutates attributes mid-loop).
+constexpr long kFlagHist = 1;
+constexpr long kFlagDigest = 2;
+constexpr long kFlagRowsInvalid = 4;
+
+PyObject* g_series_cls = nullptr;  // registry.Series (owned)
+PyObject* g_s_ici_bps = nullptr;   // "ici_bps"
+// Entry attribute names, interned once.
+PyObject* g_a_series = nullptr;
+PyObject* g_a_series_dicts = nullptr;
+PyObject* g_a_chip_plan = nullptr;
+PyObject* g_a_rollup_plan = nullptr;
+PyObject* g_a_frame_rows = nullptr;
+PyObject* g_a_frame_rollups = nullptr;
+PyObject* g_a_patch_program = nullptr;
+PyObject* g_a_value_slab = nullptr;
+
+PyObject* py_configure_apply(PyObject*, PyObject* args) {
+  PyObject* series_cls;
+  if (!PyArg_ParseTuple(args, "O", &series_cls)) return nullptr;
+  if (!PyType_Check(series_cls))
+    return err("configure_apply expects the Series class");
+  Py_XSETREF(g_series_cls, series_cls);
+  Py_INCREF(series_cls);
+  Py_RETURN_NONE;
+}
+
+// Series(spec, labels, value) without the NamedTuple's Python-level
+// __new__ (which dominates the per-pair cost): registry.Series is a
+// tuple subclass whose generated __new__ is exactly tuple.__new__(cls,
+// (spec, labels, value)), so calling tuple's tp_new on the subtype is
+// semantically identical and stays in C.
+PyObject* make_series(PyObject* spec, PyObject* labels, PyObject* fval) {
+  PyObject* inner = PyTuple_Pack(3, spec, labels, fval);
+  if (!inner) return nullptr;
+  PyObject* args = PyTuple_Pack(1, inner);
+  Py_DECREF(inner);
+  if (!args) return nullptr;
+  PyObject* out =
+      PyTuple_Type.tp_new((PyTypeObject*)g_series_cls, args, nullptr);
+  Py_DECREF(args);
+  return out;
+}
+
+// Replace pairs[index] = (key, Series(spec, labels, value)) keeping the
+// key/spec/labels objects of the old pair. Returns 0/-1.
+int rebuild_pair(PyObject* pairs, int index, PyObject* fval) {
+  if (!PyList_Check(pairs) || index >= PyList_GET_SIZE(pairs)) {
+    PyErr_SetString(PyExc_ValueError, "patch program pair index invalid");
+    return -1;
+  }
+  PyObject* pair = PyList_GET_ITEM(pairs, index);
+  PyObject* key = PyTuple_GET_ITEM(pair, 0);
+  PyObject* old_series = PyTuple_GET_ITEM(pair, 1);
+  PyObject* new_series =
+      make_series(PyTuple_GET_ITEM(old_series, 0),
+                  PyTuple_GET_ITEM(old_series, 1), fval);
+  if (!new_series) return -1;
+  PyObject* new_pair = PyTuple_Pack(2, key, new_series);
+  Py_DECREF(new_series);
+  if (!new_pair) return -1;
+  PyList_SetItem(pairs, index, new_pair);  // steals new_pair
+  return 0;
+}
+
+// Replace views[slot] = (item0, item1, value) keeping items 0/1.
+int rebuild_triple(PyObject* views, Py_ssize_t slot, PyObject* fval) {
+  PyObject* old_t = PyList_GET_ITEM(views, slot);
+  PyObject* new_t = PyTuple_Pack(3, PyTuple_GET_ITEM(old_t, 0),
+                                 PyTuple_GET_ITEM(old_t, 1), fval);
+  if (!new_t) return -1;
+  PyList_SetItem(views, slot, new_t);  // steals
+  return 0;
+}
+
+PyObject* py_apply_slots(PyObject*, PyObject* args) {
+  PyObject* entry;
+  PyObject* slots;
+  PyObject* values;
+  if (!PyArg_ParseTuple(args, "OO!O!", &entry, &PyTuple_Type, &slots,
+                        &PyTuple_Type, &values))
+    return nullptr;
+  if (!g_series_cls)
+    return err("configure_apply() has not been called");
+  Py_ssize_t count = PyTuple_GET_SIZE(slots);
+  if (PyTuple_GET_SIZE(values) != count)
+    return err("slots/values length mismatch");
+
+  PyObject* series = nullptr;
+  PyObject* dicts = nullptr;
+  PyObject* chip_plan = nullptr;
+  PyObject* rollup_plan = nullptr;
+  PyObject* frame_rows = nullptr;
+  PyObject* frame_rollups = nullptr;
+  PyObject* program = nullptr;
+  PyObject* slab_obj = nullptr;
+  Py_buffer slab_buf = {};
+  bool slab_held = false;
+  PyObject* result = nullptr;
+  long flags = 0;
+
+  series = PyObject_GetAttr(entry, g_a_series);
+  dicts = series ? PyObject_GetAttr(entry, g_a_series_dicts) : nullptr;
+  chip_plan = dicts ? PyObject_GetAttr(entry, g_a_chip_plan) : nullptr;
+  rollup_plan =
+      chip_plan ? PyObject_GetAttr(entry, g_a_rollup_plan) : nullptr;
+  frame_rows =
+      rollup_plan ? PyObject_GetAttr(entry, g_a_frame_rows) : nullptr;
+  frame_rollups =
+      frame_rows ? PyObject_GetAttr(entry, g_a_frame_rollups) : nullptr;
+  program =
+      frame_rollups ? PyObject_GetAttr(entry, g_a_patch_program) : nullptr;
+  slab_obj = program ? PyObject_GetAttr(entry, g_a_value_slab) : nullptr;
+  if (!slab_obj) goto done;
+
+  {
+    if (!PyList_Check(series) || !PyList_Check(dicts)) {
+      err("entry series views must be lists");
+      goto done;
+    }
+    if (!PyTuple_Check(program) || PyTuple_GET_SIZE(program) != 5) {
+      err("entry has no compiled patch program");
+      goto done;
+    }
+    PyObject* kinds_obj = PyTuple_GET_ITEM(program, 0);
+    PyObject* chip_idx_obj = PyTuple_GET_ITEM(program, 1);
+    PyObject* rollup_idx_obj = PyTuple_GET_ITEM(program, 2);
+    PyObject* keys = PyTuple_GET_ITEM(program, 3);
+    PyObject* cols = PyTuple_GET_ITEM(program, 4);
+    if (!PyBytes_Check(kinds_obj) || !PyBytes_Check(chip_idx_obj) ||
+        !PyBytes_Check(rollup_idx_obj) || !PyTuple_Check(keys) ||
+        !PyTuple_Check(cols)) {
+      err("malformed patch program");
+      goto done;
+    }
+    if (PyObject_GetBuffer(slab_obj, &slab_buf, PyBUF_WRITABLE) < 0)
+      goto done;
+    slab_held = true;
+
+    Py_ssize_t n_slots = PyList_GET_SIZE(series);
+    const uint8_t* kinds = (const uint8_t*)PyBytes_AS_STRING(kinds_obj);
+    const int32_t* chip_idx =
+        (const int32_t*)PyBytes_AS_STRING(chip_idx_obj);
+    const int32_t* rollup_idx =
+        (const int32_t*)PyBytes_AS_STRING(rollup_idx_obj);
+    double* slab = (double*)slab_buf.buf;
+    if (PyBytes_GET_SIZE(kinds_obj) != n_slots ||
+        PyBytes_GET_SIZE(chip_idx_obj) !=
+            (Py_ssize_t)(n_slots * sizeof(int32_t)) ||
+        PyBytes_GET_SIZE(rollup_idx_obj) !=
+            (Py_ssize_t)(n_slots * sizeof(int32_t)) ||
+        slab_buf.len != (Py_ssize_t)(n_slots * sizeof(double)) ||
+        PyList_GET_SIZE(dicts) != n_slots ||
+        PyTuple_GET_SIZE(keys) != n_slots ||
+        PyTuple_GET_SIZE(cols) != n_slots) {
+      err("patch program does not match the entry shape");
+      goto done;
+    }
+    PyObject* chip_pairs =
+        (chip_plan != Py_None && PyTuple_Check(chip_plan) &&
+         PyTuple_GET_SIZE(chip_plan) >= 2)
+            ? PyTuple_GET_ITEM(chip_plan, 1)
+            : nullptr;
+    PyObject* rollup_pairs =
+        (rollup_plan != Py_None && PyTuple_Check(rollup_plan) &&
+         PyTuple_GET_SIZE(rollup_plan) >= 2)
+            ? PyTuple_GET_ITEM(rollup_plan, 1)
+            : nullptr;
+    // Mirror the Python loop's mid-frame invalidation: once a fold key
+    // misses its row, BOTH fold caches stop taking patches for the
+    // rest of the frame (they are refolded lazily at the next refresh).
+    bool rows_valid = true;
+    bool rollups_valid = true;
+
+    for (Py_ssize_t i = 0; i < count; ++i) {
+      Py_ssize_t slot = PyLong_AsSsize_t(PyTuple_GET_ITEM(slots, i));
+      if (slot == -1 && PyErr_Occurred()) goto done;
+      if (slot < 0 || slot >= n_slots) {
+        err("slot out of range for the compiled program");
+        goto done;
+      }
+      double value = PyFloat_AsDouble(PyTuple_GET_ITEM(values, i));
+      if (value == -1.0 && PyErr_Occurred()) goto done;
+      double old = slab[slot];
+      slab[slot] = value;
+      PyObject* fval = PyFloat_FromDouble(value);
+      if (!fval) goto done;
+      int rc = rebuild_triple(series, slot, fval);
+      if (rc == 0) rc = rebuild_triple(dicts, slot, fval);
+      int ci = chip_idx[slot];
+      if (rc == 0 && ci >= 0 && chip_pairs)
+        rc = rebuild_pair(chip_pairs, ci, fval);
+      int ri = rollup_idx[slot];
+      if (rc == 0 && ri >= 0 && rollup_pairs)
+        rc = rebuild_pair(rollup_pairs, ri, fval);
+      if (rc != 0) {
+        Py_DECREF(fval);
+        goto done;
+      }
+      int kind = kinds[slot];
+      if (kind == kPatchHist) {
+        flags |= kFlagHist;
+      } else if (kind == kPatchDigest) {
+        flags |= kFlagDigest;
+      } else if (kind == kPatchRollup) {
+        if (rollups_valid && frame_rollups != Py_None) {
+          if (PyDict_SetItem(frame_rollups, PyTuple_GET_ITEM(keys, slot),
+                             fval) < 0) {
+            Py_DECREF(fval);
+            goto done;
+          }
+        }
+      } else if (kind == kPatchRow || kind == kPatchIci) {
+        if (rows_valid && frame_rows != Py_None) {
+          PyObject* row =
+              PyDict_GetItem(frame_rows, PyTuple_GET_ITEM(keys, slot));
+          if (!row) {
+            if (PyErr_Occurred()) {
+              Py_DECREF(fval);
+              goto done;
+            }
+            // Fold/series shape disagreement: refold lazily (oracle
+            // sets frame_rows/frame_rollups to None here).
+            rows_valid = false;
+            rollups_valid = false;
+            flags |= kFlagRowsInvalid;
+          } else if (kind == kPatchIci) {
+            PyObject* cur = PyObject_GetAttr(row, g_s_ici_bps);
+            if (!cur) {
+              Py_DECREF(fval);
+              goto done;
+            }
+            double accumulated = PyFloat_AsDouble(cur);
+            Py_DECREF(cur);
+            if (accumulated == -1.0 && PyErr_Occurred()) {
+              Py_DECREF(fval);
+              goto done;
+            }
+            PyObject* next =
+                PyFloat_FromDouble(accumulated + (value - old));
+            if (!next || PyObject_SetAttr(row, g_s_ici_bps, next) < 0) {
+              Py_XDECREF(next);
+              Py_DECREF(fval);
+              goto done;
+            }
+            Py_DECREF(next);
+          } else {
+            if (PyObject_SetAttr(row, PyTuple_GET_ITEM(cols, slot),
+                                 fval) < 0) {
+              Py_DECREF(fval);
+              goto done;
+            }
+          }
+        }
+      }
+      Py_DECREF(fval);
+    }
+    result = PyLong_FromLong(flags);
+  }
+
+done:
+  if (slab_held) PyBuffer_Release(&slab_buf);
+  Py_XDECREF(slab_obj);
+  Py_XDECREF(program);
+  Py_XDECREF(frame_rollups);
+  Py_XDECREF(frame_rows);
+  Py_XDECREF(rollup_plan);
+  Py_XDECREF(chip_plan);
+  Py_XDECREF(dicts);
+  Py_XDECREF(series);
+  return result;
+}
+
+// --- snappy block decompress (ISSUE 11) ------------------------------------
+//
+// Byte-for-byte the semantics (and error messages) of
+// kube_gpu_stats_tpu/snappy.py decompress(), which stays as the
+// fallback and the readable reference. The pure-Python decoder builds
+// its output a byte at a time — at 10k-pusher ingest fan-in that was
+// the hottest line of the whole handle() path.
+
+PyObject* py_snappy_uncompress(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  const uint8_t* data = (const uint8_t*)buf.buf;
+  Py_ssize_t n = buf.len;
+  PyObject* out_obj = nullptr;
+  uint64_t expected = 0;
+  int shift = 0;
+  Py_ssize_t pos = 0;
+  uint64_t out_len = 0;
+  uint8_t* out = nullptr;
+
+  for (;;) {
+    if (pos >= n) {
+      err("truncated snappy preamble");
+      goto fail;
+    }
+    uint8_t byte = data[pos++];
+    expected |= (uint64_t)(byte & 0x7F) << shift;
+    if (!(byte & 0x80)) break;
+    shift += 7;
+    if (shift > 32) {
+      err("snappy length varint too long");
+      goto fail;
+    }
+  }
+  // This decoder allocates the declared size upfront, so bound it
+  // (callers with hostile input — the delta ingest — already reject
+  // large preambles before any decompression; this cap just keeps a
+  // bare decompress() call from attempting a multi-GB allocation).
+  // The Python reference applies the SAME cap with the SAME message,
+  // preserving the byte-for-byte error-verdict equivalence the
+  // differential suite pins.
+  if (expected > ((uint64_t)1 << 31)) {
+    err("snappy declared length too large");
+    goto fail;
+  }
+  out_obj = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)expected);
+  if (!out_obj) goto fail;
+  out = (uint8_t*)PyBytes_AS_STRING(out_obj);
+
+  while (pos < n) {
+    uint8_t tag = data[pos++];
+    int kind = tag & 0b11;
+    if (kind == 0b00) {  // literal
+      uint64_t length = tag >> 2;
+      if (length >= 60) {
+        int extra = (int)length - 59;  // 60..63 -> 1..4 length bytes
+        if (pos + extra > n) {
+          err("truncated literal length");
+          goto fail;
+        }
+        length = 0;
+        for (int i = 0; i < extra; ++i)
+          length |= (uint64_t)data[pos + i] << (8 * i);
+        pos += extra;
+      }
+      length += 1;
+      if ((uint64_t)(n - pos) < length) {
+        err("truncated literal body");
+        goto fail;
+      }
+      if (out_len + length > expected) {
+        err("snappy output exceeds declared length");
+        goto fail;
+      }
+      memcpy(out + out_len, data + pos, length);
+      out_len += length;
+      pos += (Py_ssize_t)length;
+      continue;
+    }
+    uint64_t length;
+    uint32_t offset;
+    if (kind == 0b01) {  // copy, 1-byte offset
+      length = ((tag >> 2) & 0x07) + 4;
+      if (pos >= n) {
+        err("truncated copy-1 offset");
+        goto fail;
+      }
+      offset = ((uint32_t)(tag >> 5) << 8) | data[pos];
+      pos += 1;
+    } else if (kind == 0b10) {  // copy, 2-byte offset
+      length = (tag >> 2) + 1;
+      if (pos + 2 > n) {
+        err("truncated copy-2 offset");
+        goto fail;
+      }
+      offset = (uint32_t)data[pos] | ((uint32_t)data[pos + 1] << 8);
+      pos += 2;
+    } else {  // copy, 4-byte offset
+      length = (tag >> 2) + 1;
+      if (pos + 4 > n) {
+        err("truncated copy-4 offset");
+        goto fail;
+      }
+      offset = (uint32_t)data[pos] | ((uint32_t)data[pos + 1] << 8) |
+               ((uint32_t)data[pos + 2] << 16) |
+               ((uint32_t)data[pos + 3] << 24);
+      pos += 4;
+    }
+    if (offset == 0 || offset > out_len) {
+      err("copy offset out of range");
+      goto fail;
+    }
+    if (out_len + length > expected) {
+      err("snappy output exceeds declared length");
+      goto fail;
+    }
+    // Copies may overlap their own output (RLE-style); byte-by-byte
+    // semantics are the spec'd behavior.
+    uint64_t start = out_len - offset;
+    for (uint64_t i = 0; i < length; ++i) out[out_len + i] = out[start + i];
+    out_len += length;
+  }
+  if (out_len != expected) {
+    PyErr_Format(PyExc_ValueError,
+                 "snappy length mismatch: preamble %llu, got %llu",
+                 (unsigned long long)expected, (unsigned long long)out_len);
+    goto fail;
+  }
+  PyBuffer_Release(&buf);
+  return out_obj;
+
+fail:
+  PyBuffer_Release(&buf);
+  Py_XDECREF(out_obj);
+  return nullptr;
+}
+
 PyMethodDef methods[] = {
     {"configure", py_configure, METH_VARARGS,
      "configure(value_map: dict[bytes, str], ici_name: bytes, "
@@ -948,6 +1383,18 @@ PyMethodDef methods[] = {
      "MetricResponse and fold every metric into cache; returns (entry "
      "count, dialect 0=flat/1=nested/2=ambiguous, unknown-family payload "
      "count)."},
+    {"configure_apply", py_configure_apply, METH_VARARGS,
+     "configure_apply(series_cls) — pin the registry.Series class the "
+     "batch apply constructs merge-plan pairs with."},
+    {"apply_slots", py_apply_slots, METH_VARARGS,
+     "apply_slots(entry, slots: tuple[int], values: tuple[float]) -> "
+     "int — run the hub's per-slot delta patch loop natively over the "
+     "entry's compiled patch program + value slab; returns invalidation "
+     "flags (1 histogram fold, 2 fleet digest, 4 frame fold)."},
+    {"snappy_uncompress", py_snappy_uncompress, METH_VARARGS,
+     "snappy_uncompress(data: bytes) -> bytes — strict snappy "
+     "block-format decode, semantics identical to "
+     "kube_gpu_stats_tpu.snappy.decompress (the pure-Python fallback)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_wirefast",
@@ -965,8 +1412,19 @@ PyMODINIT_FUNC PyInit__wirefast(void) {
   g_s_collectives = PyUnicode_InternFromString("collectives");
   g_s_link0 = PyUnicode_InternFromString("link0");
   g_link_cache = PyDict_New();
+  g_s_ici_bps = PyUnicode_InternFromString("ici_bps");
+  g_a_series = PyUnicode_InternFromString("series");
+  g_a_series_dicts = PyUnicode_InternFromString("series_dicts");
+  g_a_chip_plan = PyUnicode_InternFromString("chip_plan");
+  g_a_rollup_plan = PyUnicode_InternFromString("rollup_plan");
+  g_a_frame_rows = PyUnicode_InternFromString("frame_rows");
+  g_a_frame_rollups = PyUnicode_InternFromString("frame_rollups");
+  g_a_patch_program = PyUnicode_InternFromString("patch_program");
+  g_a_value_slab = PyUnicode_InternFromString("value_slab");
   if (!g_s_values || !g_s_ici || !g_s_collectives || !g_s_link0 ||
-      !g_link_cache) {
+      !g_link_cache || !g_s_ici_bps || !g_a_series || !g_a_series_dicts ||
+      !g_a_chip_plan || !g_a_rollup_plan || !g_a_frame_rows ||
+      !g_a_frame_rollups || !g_a_patch_program || !g_a_value_slab) {
     Py_DECREF(m);
     return nullptr;
   }

@@ -60,3 +60,90 @@ def render_folded(counts: Counter) -> str:
         f"{stack} {count}\n"
         for stack, count in counts.most_common()
     )
+
+
+def profile_ingest(sources: int = 1000, waves: int = 5,
+                   native: bool = True, sort: str = "cumulative",
+                   top: int = 20) -> tuple[str, dict]:
+    """cProfile of the hub's handler-thread delta apply path (`make
+    profile-ingest`, ISSUE 11): seed ``sources`` synthesized push
+    sessions, let the refresh build the merge plans (so the steady
+    state — compiled patch programs, native batch store — is what gets
+    profiled, not the one-off compiles), then profile ``waves`` full
+    waves of per-source delta frames through DeltaIngest.handle.
+
+    ``native=False`` is the --legacy A/B: the Python per-slot oracle
+    (--no-native-ingest) under the same load, so the next
+    delta_ingest_ms_per_refresh drift is diagnosable in one command —
+    bench says THAT ingest moved, this says WHERE (decode? session
+    validation? slot patch? fold updates?).
+
+    Returns (pstats report text, summary dict)."""
+    import cProfile
+    import io
+    import pstats
+    import time as time_mod
+
+    from .bench import build_pusher_body
+    from .delta import encode_delta, encode_full
+    from .hub import Hub
+    from .validate import parse_exposition_interned
+
+    hub = Hub([], targets_provider=lambda: [], interval=10.0,
+              native_ingest=native)
+    try:
+        names = [f"http://node-{i:05d}:9400/metrics"
+                 for i in range(sources)]
+        bodies = [build_pusher_body(i) for i in range(sources)]
+        probe = parse_exposition_interned(bodies[0])
+        churn_slots = sorted(
+            slot for slot, (name, _labels, _value) in enumerate(probe)
+            if name in ("accelerator_duty_cycle",
+                        "accelerator_power_watts"))
+        for i, source in enumerate(names):
+            code, _ = hub.delta.handle(
+                encode_full(source, i + 1, 1, bodies[i]))
+            assert code == 200, code
+        hub.refresh_once()  # merge plans -> patch programs can compile
+
+        def wave_wires(seq: int, offset: float) -> list[bytes]:
+            return [encode_delta(source, i + 1, seq,
+                                 [(churn_slots[0], 50.0 + offset + i * 1e-3),
+                                  (churn_slots[1], 300.0 + offset)])
+                    for i, source in enumerate(names)]
+
+        # One unprofiled warmup wave: patch programs compile on the
+        # first delta per entry — a one-off that would otherwise
+        # dominate the report. (handle() outside the assert: under
+        # python -O a side-effecting assert would skip the warmup and
+        # the profiled waves would measure 409 rejection instead.)
+        for wire in wave_wires(2, 0.0):
+            code, _ = hub.delta.handle(wire)
+            assert code == 200, code
+        # Pre-encode every profiled wave: encode_delta is the
+        # PUBLISHER's cost (paid on the pushing node) and must not
+        # pollute the hub-side report.
+        prepared = [wave_wires(3 + wave, 1.0 + wave)
+                    for wave in range(waves)]
+        handle = hub.delta.handle
+        profile = cProfile.Profile()
+        start = time_mod.monotonic()
+        profile.enable()
+        for wave in prepared:
+            for wire in wave:
+                handle(wire)
+        profile.disable()
+        wall = time_mod.monotonic() - start
+        summary = {
+            "sources": sources,
+            "waves": waves,
+            "path": "native" if hub.delta.native_active else "python",
+            "lanes": hub.delta.lanes,
+            "ms_per_wave": round(wall * 1000.0 / max(1, waves), 2),
+            "ingest": hub.delta.stats(),
+        }
+    finally:
+        hub.stop()
+    out = io.StringIO()
+    pstats.Stats(profile, stream=out).sort_stats(sort).print_stats(top)
+    return out.getvalue(), summary

@@ -541,6 +541,58 @@ DELTA_PUSH_TARGETS = MetricSpec(
     "pull.",
 )
 
+# Sharded-ingest families (ISSUE 11): push sources hash to
+# shared-nothing lanes (own lock, session table, entry slab) so POST
+# handler threads stop convoying behind one lock at 10k-pusher fan-in;
+# the hot per-slot patch loop runs in the native wirefast extension.
+
+INGEST_LANES = MetricSpec(
+    "kts_ingest_lanes",
+    MetricType.GAUGE,
+    "Delta-ingest lanes this hub runs (--ingest-lanes; sources hash to "
+    "a lane, each with its own lock, session table and entry slab). "
+    "1 means every POST handler thread serializes on one lock — fine "
+    "for small fleets, the ceiling at high pusher fan-in.",
+)
+INGEST_LANE_SESSIONS = MetricSpec(
+    "kts_ingest_lane_sessions",
+    MetricType.GAUGE,
+    "Live delta-push sessions homed in this ingest lane. A healthy "
+    "fleet spreads roughly evenly (crc32 of the source URL); one lane "
+    "holding most sessions means pathologically similar source names — "
+    "raise --ingest-lanes or diversify the source spellings.",
+    extra_labels=("lane",),
+)
+INGEST_LANE_FRAMES = MetricSpec(
+    "kts_ingest_lane_frames_total",
+    MetricType.COUNTER,
+    "Delta-protocol frames (full + delta) this ingest lane has applied "
+    "since the hub started. Per-lane rate imbalance with a balanced "
+    "session spread = one chatty publisher, not a bad hash.",
+    extra_labels=("lane",),
+)
+INGEST_LANE_APPLY_SECONDS = MetricSpec(
+    "kts_ingest_lane_apply_seconds_total",
+    MetricType.COUNTER,
+    "Cumulative wall seconds this lane's POST handler threads spent "
+    "inside frame apply (parse + seq validation + slot patch). "
+    "rate() summed over lanes is the hub's ingest CPU share — the "
+    "number the 10k-pusher storm bench budgets (ingest_cpu_pct); one "
+    "lane's rate running hot while the others idle is the "
+    "sharding-isn't-helping signal (see the 'Scaling ingest' runbook).",
+    extra_labels=("lane",),
+)
+INGEST_NATIVE = MetricSpec(
+    "kts_ingest_native",
+    MetricType.GAUGE,
+    "1 when delta frames apply through the native wirefast batch store "
+    "(apply_slots), 0 on the pure-Python per-slot oracle "
+    "(--no-native-ingest, or the extension isn't built). The Python "
+    "path costs ~an order of magnitude more ingest CPU per frame — at "
+    "10k-pusher fan-in, 0 here plus a hot "
+    "kts_ingest_lane_apply_seconds_total is the first thing to check.",
+)
+
 # Fleet-lens families (fleetlens.py, driven from the hub refresh):
 # cross-node anomaly detection, slow-node attribution, SLO burn windows.
 
@@ -630,6 +682,11 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_RESYNC,
     HUB_DUP_SLICE,
     DELTA_PUSH_TARGETS,
+    INGEST_LANES,
+    INGEST_LANE_SESSIONS,
+    INGEST_LANE_FRAMES,
+    INGEST_LANE_APPLY_SECONDS,
+    INGEST_NATIVE,
     FLEET_TARGETS_ANOMALOUS,
     FLEET_ANOMALIES,
     FLEET_SLO_BURN,

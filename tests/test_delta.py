@@ -315,6 +315,132 @@ def test_ingest_metrics_exported():
         hub.stop()
 
 
+# --- sharded lanes (ISSUE 11) -----------------------------------------------
+
+def test_lane_routing_sessions_and_entries_agree():
+    """Sources hash to a lane (crc32, PYTHONHASHSEED-stable); the lane's
+    session table and the LaneStore entry shard MUST agree on routing —
+    a lane locking itself against an entry in another lane's slab would
+    be sharding in name only."""
+    hub = _push_hub(ingest_lanes=4)
+    try:
+        assert hub.delta.lanes == 4
+        locks = {id(lane.lock) for lane in hub.delta._lanes}
+        assert len(locks) == 4  # shared-nothing: one lock per lane
+        sources = [f"http://node-{i}:9400/metrics" for i in range(16)]
+        for i, source in enumerate(sources):
+            encoder = delta.DeltaEncoder(source, generation=i + 1)
+            assert _feed(hub, encoder, make_body(i, 10.0))[0] == 200
+        used = set()
+        for source in sources:
+            lane_index = delta.lane_of(source, 4)
+            used.add(lane_index)
+            assert source in hub.delta._lanes[lane_index].sessions
+            assert source in hub._parse_cache.shards[lane_index]
+            for other in range(4):
+                if other != lane_index:
+                    assert source not in hub.delta._lanes[other].sessions
+                    assert source not in hub._parse_cache.shards[other]
+        assert len(used) > 1  # 16 sources actually spread over lanes
+        # sources() reports fleet-wide ADMISSION order, lane-independent
+        # — the hub's target order (and first-wins dedup) must be
+        # indistinguishable from the single-table era.
+        assert hub.delta.sources() == sources
+    finally:
+        hub.stop()
+
+
+def test_lane_self_metrics_exported():
+    hub = _push_hub(ingest_lanes=2)
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        assert _feed(hub, encoder, make_body(0, 11.0))[0] == 200
+        hub.refresh_once()
+        body = hub.registry.snapshot().render()
+        assert "kts_ingest_lanes 2" in body
+        lane = delta.lane_of("w0", 2)
+        assert (f'kts_ingest_lane_sessions{{lane="{lane}"}} 1'
+                in body), body
+        assert f'kts_ingest_lane_frames_total{{lane="{lane}"}} 2' in body
+        apply_line = next(
+            l for l in body.splitlines()
+            if l.startswith("kts_ingest_lane_apply_seconds_total")
+            and f'lane="{lane}"' in l)
+        assert float(apply_line.rsplit(" ", 1)[1]) > 0.0, apply_line
+        assert "kts_ingest_native" in body
+    finally:
+        hub.stop()
+
+
+def test_resync_storm_concurrent_fulls_no_drops_no_healthy_evictions():
+    """ISSUE 11 satellite: N sessions 409→FULL at once — concurrent
+    handler threads firing FULL resyncs (new generations, the
+    fleet-restart shape) while the OTHER half of the fleet keeps
+    pushing ordinary deltas — must leave every session alive, every
+    restart re-anchored, and every healthy session's chain unbroken
+    (no convoy turning into timeouts, no healthy session evicted)."""
+    import threading
+
+    hub = _push_hub(ingest_lanes=4)
+    try:
+        n = 64
+        sources = [f"http://node-{i:03d}:9400/metrics" for i in range(n)]
+        encoders = []
+        for i, source in enumerate(sources):
+            encoder = delta.DeltaEncoder(source, generation=i + 1)
+            assert _feed(hub, encoder, make_body(i, 10.0))[0] == 200
+            encoders.append(encoder)
+        hub.refresh_once()
+        # Half the fleet "restarts": pre-encode one FULL each under a
+        # new generation. The other half pre-encodes a delta chain.
+        restart_wires = [
+            delta.encode_full(sources[i], 1000 + i, 1, make_body(i, 44.0))
+            for i in range(0, n, 2)]
+        delta_wires = []
+        for i in range(1, n, 2):
+            wire, kind = encoders[i].encode_next(make_body(i, 20.0 + i))
+            assert kind == delta.KIND_DELTA
+            delta_wires.append(wire)
+            encoders[i].ack()
+        failures: list = []
+
+        def fire(wires) -> None:
+            for wire in wires:
+                code, resp = hub.delta.handle(wire)
+                if code != 200:
+                    failures.append((code, resp))
+
+        threads = [threading.Thread(target=fire, args=(restart_wires[k::4],))
+                   for k in range(4)]
+        threads += [threading.Thread(target=fire, args=(delta_wires[k::4],))
+                    for k in range(4)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=30)
+        assert not failures, failures[:5]
+        assert len(hub.delta.sources()) == n  # nobody dropped
+        hub.refresh_once()
+        assert hub._push_served == n
+        body = hub.registry.snapshot().render()
+        # A restarted worker serves its post-restart FULL...
+        line = next(l for l in body.splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'worker="0"' in l and 'chip="0"' in l)
+        assert line.endswith(" 44"), line
+        # ...and a healthy worker's concurrent delta landed.
+        line = next(l for l in body.splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'worker="1"' in l and 'chip="0"' in l)
+        assert line.endswith(" 21"), line
+        # The restarts journaled as generation replacements, not
+        # resyncs: a FULL is always accepted.
+        assert hub.delta.full_frames_total == n + len(restart_wires)
+    finally:
+        hub.stop()
+
+
 # --- federation -------------------------------------------------------------
 
 def leaf_rollup_body() -> str:

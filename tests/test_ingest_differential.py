@@ -1,0 +1,240 @@
+"""Differential pin for the native ingest fast paths (ISSUE 11): the
+wirefast batch apply (``apply_slots``) and the native snappy decoder
+must be indistinguishable from their pure-Python oracles —
+``_TargetCache.apply_patch``'s per-slot loop (kept behind
+``--no-native-ingest``) and ``snappy._decompress_py`` — under
+randomized value churn, shape changes, worker restarts, duplicate
+deliveries and forced resyncs, including the histogram-fold and
+fleet-digest invalidation edges (the two caches a delta drops instead
+of patching). The pattern of tests/test_parse_differential.py: drive
+both implementations with identical inputs, require identical outputs
+or identical error verdicts."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from kube_gpu_stats_tpu import delta, snappy
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.native import load_ingest
+
+from tests.test_delta import _EXCLUDED_FAMILIES, make_body
+
+NATIVE = load_ingest()
+
+needs_native = pytest.mark.skipif(
+    NATIVE is None, reason="wirefast extension not built")
+
+
+def _push_hub(native: bool) -> Hub:
+    return Hub([], targets_provider=lambda: [], interval=10.0,
+               push_fence=1e9, ingest_lanes=2, native_ingest=native)
+
+
+def _data_lines(hub: Hub) -> list[str]:
+    out = []
+    for line in hub.registry.snapshot().render().splitlines():
+        if (line.startswith(("accelerator_", "slice_"))
+                and not line.startswith(_EXCLUDED_FAMILIES)):
+            out.append(line)
+    return out
+
+
+def _feed_both(hubs, encoders, body: str) -> None:
+    """One frame per hub from its own encoder — the encoders march in
+    lockstep (same bodies), so both hubs see the same frame KINDS and
+    the same change-sets."""
+    for hub, encoder in zip(hubs, encoders):
+        wire, _kind = encoder.encode_next(body)
+        code, _resp = hub.delta.handle(wire)
+        if code == 200:
+            encoder.ack()
+        else:
+            encoder.nack()
+            wire, _kind = encoder.encode_next(body)
+            assert hub.delta.handle(wire)[0] == 200
+            encoder.ack()
+
+
+@needs_native
+def test_native_apply_matches_python_oracle_under_randomized_churn():
+    """The acceptance pin: after randomized churn/restart/reorder
+    sequences, a native-ingest hub's rendered data series are
+    byte-identical to the Python-oracle hub fed the exact same frame
+    stream — histograms (WORKLOAD_STEP_DURATION riding make_body) and
+    the digest family (TICK_PHASE_SECONDS) included, so the
+    hist/digest invalidation edges run under both paths."""
+    rng = random.Random(0xA11C)
+    workers = 4
+    hubs = [_push_hub(native=True), _push_hub(native=False)]
+    try:
+        assert hubs[0].delta.native_active
+        assert not hubs[1].delta.native_active
+        duties = [10.0 * (i + 1) for i in range(workers)]
+        steps = [float(i) for i in range(workers)]
+        extra = [False] * workers
+        phase = [0.001] * workers
+        generations = [i + 1 for i in range(workers)]
+        encoders = [
+            [delta.DeltaEncoder(f"w{i}", generation=generations[i])
+             for i in range(workers)] for _hub in hubs]
+
+        def body(i: int) -> str:
+            return make_body(i, duties[i], steps=steps[i],
+                             extra_chip=extra[i], phase_p50=phase[i])
+
+        for i in range(workers):
+            _feed_both(hubs, [enc[i] for enc in encoders], body(i))
+        for hub in hubs:
+            hub.refresh_once()
+        assert _data_lines(hubs[0]) == _data_lines(hubs[1])
+
+        for round_no in range(10):
+            for i in range(workers):
+                event = rng.random()
+                if event < 0.45:
+                    duties[i] += rng.choice([0.0, 1.0, 2.5])
+                    steps[i] += rng.randint(0, 3)  # histogram fold edge
+                elif event < 0.6:
+                    phase[i] += 0.0005  # fleet-digest invalidation edge
+                elif event < 0.75:
+                    extra[i] = not extra[i]  # shape change -> FULL
+                elif event < 0.85:
+                    # Worker restart: new generation, counters reset.
+                    generations[i] += 100
+                    steps[i] = 0.0
+                    for enc in encoders:
+                        enc[i] = delta.DeltaEncoder(
+                            f"w{i}", generation=generations[i])
+                fault = rng.random()
+                if fault < 0.15:
+                    # Duplicate delivery against BOTH hubs: a repeated
+                    # DELTA must 409 on each without corrupting state;
+                    # a repeated FULL is accepted idempotently (a FULL
+                    # always replaces the session wholesale).
+                    for hub, enc in zip(hubs, encoders):
+                        wire, kind = enc[i].encode_next(body(i))
+                        code, _resp = hub.delta.handle(wire)
+                        if code == 200:
+                            enc[i].ack()
+                            dup_code, _resp = hub.delta.handle(wire)
+                            assert dup_code == (
+                                200 if kind == delta.KIND_FULL else 409)
+                        else:
+                            enc[i].nack()
+                            wire, _kind = enc[i].encode_next(body(i))
+                            assert hub.delta.handle(wire)[0] == 200
+                            enc[i].ack()
+                else:
+                    _feed_both(hubs, [enc[i] for enc in encoders],
+                               body(i))
+            for hub in hubs:
+                hub.refresh_once()
+            native_lines = _data_lines(hubs[0])
+            python_lines = _data_lines(hubs[1])
+            assert native_lines == python_lines, (
+                f"round {round_no}: native apply diverged from the "
+                f"Python oracle:\n" + "\n".join(
+                    l for l in python_lines
+                    if l not in native_lines)[:2000])
+            # The per-entry float slab stays byte-exact with the series
+            # views it fronts (the ICI-delta old-value source).
+            for source in hubs[0].delta.sources():
+                entry = hubs[0]._parse_cache.get(source)
+                if entry is not None and entry.value_slab is not None:
+                    for slot, (_n, _l, value) in enumerate(entry.series):
+                        assert entry.value_slab[slot] == value
+    finally:
+        for hub in hubs:
+            hub.stop()
+
+
+@needs_native
+def test_native_apply_exercised_not_silently_oracled():
+    """The differential above is vacuous if the native hub quietly ran
+    the Python loop: force one delta through and require the compiled
+    program + slab to exist on the entry afterwards."""
+    hub = _push_hub(native=True)
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        wire, _ = encoder.encode_next(make_body(0, 10.0))
+        assert hub.delta.handle(wire)[0] == 200
+        encoder.ack()
+        hub.refresh_once()  # builds the merge plans (the compile gate)
+        entry = hub._parse_cache.get("w0")
+        assert entry.patch_program is None  # lazy: no delta yet
+        wire, kind = encoder.encode_next(make_body(0, 12.0))
+        assert kind == delta.KIND_DELTA
+        assert hub.delta.handle(wire)[0] == 200
+        encoder.ack()
+        assert entry.patch_program is not None
+        assert entry.value_slab is not None
+        # Kind constants are mirrored in C (wirefast.cc kPatch*): the
+        # program's kind bytes must stay inside the Python enum range.
+        kinds = entry.patch_program[0]
+        assert set(kinds) <= {0, 1, 2, 3, 4, 5}
+    finally:
+        hub.stop()
+
+
+def test_profile_ingest_reports_both_paths():
+    """`make profile-ingest` must produce a usable report in both the
+    native and --legacy (Python oracle) modes — the one-command
+    diagnosability satellite."""
+    from kube_gpu_stats_tpu.profiler import profile_ingest
+
+    for native in (True, False):
+        report, summary = profile_ingest(sources=16, waves=2,
+                                         native=native, top=5)
+        assert "handle" in report
+        assert summary["sources"] == 16
+        assert summary["ingest"]["delta_frames"] == 3 * 16  # warmup + 2
+        if NATIVE is not None and native:
+            assert summary["path"] == "native"
+        if not native:
+            assert summary["path"] == "python"
+        assert summary["ms_per_wave"] > 0
+
+
+@needs_native
+def test_native_snappy_matches_python_decoder():
+    """snappy.decompress dispatches to the native decoder; both sides
+    must agree on every input — round-trips, hand-built streams, and
+    seeded random mutations (same triples-or-error contract as the
+    parser differential)."""
+    rng = random.Random(0x5A17)
+    native = snappy._native_uncompress
+    assert native is not None
+
+    corpus = [
+        snappy.compress(b""),
+        snappy.compress(b"Hello"),
+        snappy.compress(b"ab" * 500),
+        snappy.compress(bytes(rng.randrange(256) for _ in range(4096))),
+        b"\x05\x10Hello",
+        b"\x0a\x04ab\x1e\x02\x00",
+        b"",                      # truncated preamble
+        b"\xff\xff\xff\xff\xff\xff",  # runaway length varint
+        b"\x05\x10Hel",           # truncated literal body
+        b"\x05\x10Hello\x00",     # trailing garbage tag
+        b"\x02\x00a\x05\x01\x00",  # copy reaching past declared length
+    ]
+    for _ in range(300):
+        base = bytearray(snappy.compress(
+            bytes(rng.randrange(4) for _ in range(rng.randrange(0, 64)))))
+        for _ in range(rng.randrange(0, 3)):
+            if base:
+                base[rng.randrange(len(base))] = rng.randrange(256)
+        corpus.append(bytes(base))
+
+    for wire in corpus:
+        try:
+            expected = snappy._decompress_py(wire)
+        except ValueError as exc:
+            with pytest.raises(ValueError) as err:
+                native(wire)
+            assert str(err.value) == str(exc), wire.hex()
+        else:
+            assert native(wire) == expected, wire.hex()

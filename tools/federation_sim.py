@@ -15,6 +15,11 @@ push-delta protocol:
 - Partitioned leaf: leaf B's publisher stops mid-run — the root's pull
   fallback takes over for that target (the leaf's own scrape endpoint
   keeps serving), so the rollup must still converge.
+- Ingest resync storm (ISSUE 11): a sharded-lane hub (4 lanes) takes a
+  simulated fleet-wide restart — every synthetic pusher re-POSTs a
+  FULL frame at once from concurrent threads over real HTTP — and must
+  come out with zero dropped sessions, every target push-served, and
+  the sessions actually spread across lanes (kts_ingest_lane_*).
 
 Asserts: the root's merged exposition carries every slice's chips
 (converged after the restart and the partition), at least one resync
@@ -211,8 +216,80 @@ def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
                 time.sleep(0.05)
             authed_root.refresh_once()
 
+            # --- ingest resync storm over real HTTP (ISSUE 11) -----------
+            import threading
+            import urllib.request
+
+            from kube_gpu_stats_tpu.bench import build_pusher_body
+            from kube_gpu_stats_tpu.delta import CONTENT_TYPE, encode_full
+
+            storm_hub = Hub([], targets_provider=lambda: [],
+                            interval=0.2, push_fence=1e9, ingest_lanes=4)
+            storm_server = start_hub(storm_hub)
+            storm_url = (f"http://127.0.0.1:{storm_server.port}"
+                         f"/ingest/delta")
+            n_storm = 48
+            storm_names = [f"http://storm-{i:03d}:9400/metrics"
+                           for i in range(n_storm)]
+            storm_bodies = [build_pusher_body(i) for i in range(n_storm)]
+
+            def post_frame(wire: bytes) -> None:
+                request = urllib.request.Request(
+                    storm_url, data=wire, method="POST",
+                    headers={"Content-Type": CONTENT_TYPE})
+                with urllib.request.urlopen(request, timeout=10) as resp:
+                    assert resp.status == 200, resp.status
+
+            for i in range(n_storm):
+                post_frame(encode_full(storm_names[i], i + 1, 1,
+                                       storm_bodies[i]))
+            storm_hub.refresh_once()
+            # Fleet-wide restart: every session re-POSTs one FULL under
+            # a new generation, from concurrent HTTP threads.
+            storm_wires = [encode_full(storm_names[i], 1000 + i, 1,
+                                       storm_bodies[i])
+                           for i in range(n_storm)]
+            storm_errors: list = []
+
+            def storm_drain(chunk) -> None:
+                for wire in chunk:
+                    try:
+                        post_frame(wire)
+                    except Exception as exc:  # noqa: BLE001 - recorded
+                        storm_errors.append(exc)
+
+            storm_threads = [
+                threading.Thread(target=storm_drain,
+                                 args=(storm_wires[k::6],))
+                for k in range(6)]
+            for thread in storm_threads:
+                thread.start()
+            for thread in storm_threads:
+                thread.join(timeout=30)
+            storm_hub.refresh_once()
+            storm_sessions = len(storm_hub.delta.sources())
+            storm_served = storm_hub._push_served
+            storm_lane_spread = sum(
+                1 for lane in storm_hub.delta.lane_stats()
+                if lane["sessions"])
+
             # --- assertions ----------------------------------------------
             problems = []
+            if storm_errors:
+                problems.append(
+                    f"resync storm POSTs failed: {storm_errors[:3]}")
+            if storm_sessions != n_storm:
+                problems.append(
+                    f"resync storm dropped sessions: {storm_sessions} "
+                    f"of {n_storm} alive")
+            if storm_served != n_storm:
+                problems.append(
+                    f"post-storm refresh served {storm_served} of "
+                    f"{n_storm} targets by push")
+            if storm_lane_spread < 2:
+                problems.append(
+                    f"storm sessions all landed in one lane "
+                    f"(spread {storm_lane_spread} of 4)")
             if authed_pub.pushes_total < 1 or authed_pub.failures_total:
                 problems.append(
                     f"authed leaf->root push did not land "
@@ -268,7 +345,9 @@ def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
                       f"-> 1 root converged ({int(total_chips)} chips), "
                       f"worker restart resynced, partitioned leaf fell "
                       f"back to pull, authed hop pushed + 401 refused, "
-                      f"doctor named {straggler}")
+                      f"{n_storm}-session resync storm survived over "
+                      f"{storm_lane_spread} lanes, doctor named "
+                      f"{straggler}")
                 return 0
             print("federation-sim FAIL:")
             for problem in problems:

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Profile the poll-tick hot path (`make profile-tick`).
+"""Profile the poll-tick hot path (`make profile-tick`) or the hub's
+delta-ingest apply path (`make profile-ingest`, via --ingest).
 
 Runs the production stack — TpuCollector (native sysfs fast path when
 built) against an in-process fake libtpu server over a sysfs fixture
@@ -53,11 +54,39 @@ def main() -> int:
                              "(default 0: pure exporter CPU)")
     parser.add_argument("--legacy", action="store_true",
                         help="profile the pre-plan builder path "
-                             "(use_tick_plan=False) for an A/B read")
+                             "(use_tick_plan=False) for an A/B read; "
+                             "with --ingest, the Python per-slot apply "
+                             "oracle (--no-native-ingest) instead of "
+                             "the native batch store")
     parser.add_argument("--sort", default="cumulative",
                         choices=("cumulative", "tottime"),
                         help="pstats sort key (default cumulative)")
+    parser.add_argument("--ingest", action="store_true",
+                        help="profile the hub's delta-ingest handler "
+                             "path instead of the poll tick "
+                             "(`make profile-ingest`): N synthesized "
+                             "push sessions, waves of delta frames "
+                             "through DeltaIngest.handle")
+    parser.add_argument("--sources", type=int, default=1000,
+                        help="push sessions for --ingest (default 1000)")
+    parser.add_argument("--waves", type=int, default=5,
+                        help="profiled delta waves for --ingest "
+                             "(default 5)")
     args = parser.parse_args()
+
+    if args.ingest:
+        from kube_gpu_stats_tpu.profiler import profile_ingest
+
+        report, summary = profile_ingest(
+            sources=args.sources, waves=args.waves,
+            native=not args.legacy, sort=args.sort, top=args.top)
+        print(f"# profile-ingest: {summary['waves']} waves x "
+              f"{summary['sources']} sources, path={summary['path']}, "
+              f"lanes={summary['lanes']}, "
+              f"{summary['ms_per_wave']} ms/wave")
+        print(f"# ingest: {summary['ingest']}")
+        print(report)
+        return 0
 
     with tempfile.TemporaryDirectory() as tmp:
         sysroot = Path(tmp) / "sys"
