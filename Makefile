@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -10,6 +10,7 @@ ci: native lint
 	python tools/federation_sim.py
 	python tools/energy_sim.py
 	python tools/host_sim.py
+	python tools/chaos_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -65,6 +66,20 @@ federation-sim:
 # refuses a wrong key. In `make ci` too.
 energy-sim:
 	python tools/energy_sim.py --verbose
+
+# Fleet chaos smoke (<60 s, ISSUE 12): real daemons + synthesized
+# session fleets over real HTTP against the root hub's survival layer.
+# Injects a hub kill/restart (asserts warm resume off the WAL
+# checkpoint: >= 95% of sessions continue delta chains with no FULL
+# resync, zero drops, /readyz gates on replay), a 2x-budget publisher
+# stampede (asserts shed-not-crash: 429 + Retry-After, recovery FULLs
+# always admitted, no established session dropped), slow-loris sockets
+# (cut at the ingest read deadline while healthy pushers land beside
+# them), and a corrupt-frame flood (per-source quarantine + journal
+# event; same-IP healthy pushers unharmed). In `make ci` too; the
+# recovery-time/shed-fairness numbers are pinned in tests/test_latency.
+chaos-sim:
+	python tools/chaos_sim.py --verbose
 
 # Host-correlation smoke (<30 s): N real daemons, each over a faked
 # /proc + /sys + cgroup v2 host fixture, one hub; after the fleet

@@ -63,6 +63,25 @@ def _delta_fields(line: dict, quick: bool = False) -> None:
             line["resync_storm_dropped"] = storm["resync_storm_dropped"]
             line["ingest_lanes"] = storm["lanes"]
             line["ingest_native"] = storm["native"]
+        # Survival-layer figures (ISSUE 12): warm-restart resume rate +
+        # replay wall at 2k sessions, and the shed-priority outcome of
+        # a 4x-budget stampede (CI pins live in tests/test_latency.py).
+        from kube_gpu_stats_tpu.bench import (measure_overload_shed,
+                                              measure_warm_restart)
+
+        warm = measure_warm_restart()
+        if warm is not None:
+            line["warm_restart_resumed_fraction"] = warm[
+                "resumed_fraction"]
+            line["warm_restart_replay_s_2k"] = warm["replay_s"]
+            line["warm_restart_recovery_s_2k"] = warm["recovery_s"]
+            line["warm_restart_dropped"] = warm["dropped"]
+        shed = measure_overload_shed()
+        if shed is not None:
+            line["shed_delta_429"] = shed["delta_shed"]
+            line["shed_full_refused"] = shed["full_refused"]
+            line["shed_sources_served_fraction"] = shed[
+                "sources_served_fraction"]
 
 
 def _burst_fields(line: dict) -> None:

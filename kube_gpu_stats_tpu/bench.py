@@ -714,7 +714,7 @@ def measure_delta_federation(leaves: int = 64, workers_per_leaf: int = 64,
                 body = build_leaf_rollup_snapshot(
                     leaf, workers_per_leaf, 50.0, 4.0).render()
                 wire, _ = encoder.encode_next(body)
-                code, _resp = root.delta.handle(wire)
+                code, _resp, _hdrs = root.delta.handle(wire)
                 assert code == 200, code
                 encoder.ack()
                 full_bytes += len(wire)
@@ -735,7 +735,7 @@ def measure_delta_federation(leaves: int = 64, workers_per_leaf: int = 64,
                         4.0 + round_no * 0.1).render()
                     wire, _ = encoder.encode_next(body)
                     apply_start = time.monotonic()
-                    code, _resp = root.delta.handle(wire)
+                    code, _resp, _hdrs = root.delta.handle(wire)
                     apply_seconds += time.monotonic() - apply_start
                     assert code == 200, code
                     encoder.ack()
@@ -854,7 +854,7 @@ def measure_ingest_storm(pushers: int = 10_000, waves: int = 3,
 
             seed_start = time.monotonic()
             for i, source in enumerate(sources):
-                code, _ = hub.delta.handle(
+                code, _resp, _hdrs = hub.delta.handle(
                     encode_full(source, i + 1, 1, bodies[i]))
                 assert code == 200, code
             seed_s = time.monotonic() - seed_start
@@ -875,7 +875,7 @@ def measure_ingest_storm(pushers: int = 10_000, waves: int = 3,
                 handle = hub.delta.handle
                 start = time.monotonic()
                 for wire in wires:
-                    code, _ = handle(wire)
+                    code, _resp, _hdrs = handle(wire)
                     assert code == 200, code
                 wave_ms.append((time.monotonic() - start) * 1000.0)
             start = time.monotonic()
@@ -894,7 +894,7 @@ def measure_ingest_storm(pushers: int = 10_000, waves: int = 3,
             def drain(chunk) -> None:
                 handle = hub.delta.handle
                 for wire in chunk:
-                    code, _ = handle(wire)
+                    code, _resp, _hdrs = handle(wire)
                     assert code == 200, code
 
             ways = max(1, storm_threads)
@@ -927,6 +927,213 @@ def measure_ingest_storm(pushers: int = 10_000, waves: int = 3,
             "resync_storm_sessions": sessions_after,
             "resync_storm_dropped": sessions_before - sessions_after,
             "resync_storm_served": served_after,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
+def measure_warm_restart(pushers: int = 2_000, tail_fraction: float = 0.02,
+                         interval: float = 10.0) -> dict | None:
+    """Warm-restart recovery at fleet scale (ISSUE 12 acceptance): seed
+    ``pushers`` delta sessions mid-chain, checkpoint, advance a small
+    ``tail_fraction`` of sessions PAST the checkpoint (the
+    crash-window tail), then kill the hub at exactly the checkpoint
+    state and bring up a fresh one on the same file:
+
+    - ``resumed_fraction``: sessions whose next DELTA landed 200 on the
+      restarted hub (no 409, no FULL) — the >= 95% chaos pin. Only the
+      tail (whose seq advanced after the checkpoint) may pay a resync.
+    - ``replay_s`` / ``recovery_s``: background replay wall time, and
+      construction -> fleet fully re-served by push.
+    - ``dropped``: sessions lost across the restart (must be 0).
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        import pathlib
+        import tempfile
+
+        from .delta import encode_delta, encode_full
+        from .hub import Hub
+        from .validate import parse_exposition_interned
+
+        with tempfile.TemporaryDirectory() as tmp:
+            path = str(pathlib.Path(tmp) / "ingest.ckpt")
+            sources = [f"http://node-{i:05d}:9400/metrics"
+                       for i in range(pushers)]
+            bodies = [build_pusher_body(i) for i in range(pushers)]
+            probe = parse_exposition_interned(bodies[0])
+            slot_by_name = {name: slot for slot, (name, _labels, _v)
+                            in enumerate(probe)}
+            churn_slots = sorted((slot_by_name["accelerator_duty_cycle"],
+                                  slot_by_name["accelerator_power_watts"]))
+
+            hub = Hub([], targets_provider=lambda: [], interval=interval,
+                      ingest_checkpoint=path)
+            try:
+                for i, source in enumerate(sources):
+                    code, _resp, _hdrs = hub.delta.handle(
+                        encode_full(source, i + 1, 1, bodies[i]))
+                    assert code == 200, code
+                for i, source in enumerate(sources):
+                    code, _resp, _hdrs = hub.delta.handle(encode_delta(
+                        source, i + 1, 2,
+                        [(churn_slots[0], 51.0), (churn_slots[1], 301.0)]))
+                    assert code == 200, code
+                hub.refresh_once()
+                assert hub.delta.checkpoint(force=True)
+                # The crash tail: a few sessions advance past the
+                # checkpoint — exactly what a rate-limited WAL loses.
+                tail = max(0, int(pushers * tail_fraction))
+                for i in range(tail):
+                    code, _resp, _hdrs = hub.delta.handle(encode_delta(
+                        sources[i], i + 1, 3,
+                        [(churn_slots[0], 52.0), (churn_slots[1], 302.0)]))
+                    assert code == 200, code
+                # Kill at the checkpoint state: stop() force-writes the
+                # newest state (clean-shutdown semantics), so the crash
+                # point is restored from the bytes captured above.
+                crash_state = pathlib.Path(path).read_bytes()
+            finally:
+                hub.stop()
+            pathlib.Path(path).write_bytes(crash_state)
+
+            recovery_start = time.monotonic()
+            hub2 = Hub([], targets_provider=lambda: [], interval=interval,
+                       ingest_checkpoint=path)
+            try:
+                hub2.delta.start_replay()
+                while hub2.delta.replaying and \
+                        time.monotonic() - recovery_start < 60.0:
+                    time.sleep(0.01)
+                replay_s = time.monotonic() - recovery_start
+                resumed = resynced = 0
+                for i, source in enumerate(sources):
+                    seq = 4 if i < tail else 3
+                    code, _resp, _hdrs = hub2.delta.handle(encode_delta(
+                        source, i + 1, seq,
+                        [(churn_slots[0], 53.0), (churn_slots[1], 303.0)]))
+                    if code == 200:
+                        resumed += 1
+                    else:
+                        resynced += 1
+                        code, _resp, _hdrs = hub2.delta.handle(
+                            encode_full(source, i + 1, 1, bodies[i]))
+                        assert code == 200, code
+                hub2.refresh_once()
+                recovery_s = time.monotonic() - recovery_start
+                served = hub2._push_served
+                warm_sessions = hub2.delta.warm_restart_sessions
+            finally:
+                hub2.stop()
+        return {
+            "pushers": pushers,
+            "warm_restart_sessions": warm_sessions,
+            "resumed_fraction": round(resumed / pushers, 4),
+            "resyncs": resynced,
+            "replay_s": round(replay_s, 2),
+            "recovery_s": round(recovery_s, 2),
+            "dropped": pushers - served,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
+def measure_overload_shed(pushers: int = 256, lanes: int = 4,
+                          delta_rate: float = 50.0,
+                          waves: int = 4) -> dict | None:
+    """Admission-control shed behavior under a publisher stampede
+    (ISSUE 12 acceptance): ``pushers`` established sessions blast delta
+    waves far past the per-lane token budget, with the wave order
+    rotated so sheds land round-robin rather than always on the tail:
+
+    - ``delta_shed``: deltas answered 429 + Retry-After (must be > 0 —
+      the guard actually engaged).
+    - ``full_refused``: recovery FULLs refused mid-storm (must be 0 —
+      the shed-priority contract: deltas always go first).
+    - ``sessions_alive`` / ``sources_served_fraction``: established
+      sessions after the storm (must be all of them — shed is load
+      shaping, never eviction) and the fraction of sources that landed
+      at least one delta (shed fairness).
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        from .delta import encode_delta, encode_full
+        from .hub import Hub
+        from .validate import parse_exposition_interned
+
+        hub = Hub([], targets_provider=lambda: [], interval=10.0,
+                  ingest_lanes=lanes,
+                  ingest_delta_rate=delta_rate,
+                  ingest_max_inflight=64,
+                  ingest_max_sessions=pushers)
+        try:
+            sources = [f"http://node-{i:05d}:9400/metrics"
+                       for i in range(pushers)]
+            bodies = [build_pusher_body(i) for i in range(pushers)]
+            probe = parse_exposition_interned(bodies[0])
+            slot_by_name = {name: slot for slot, (name, _labels, _v)
+                            in enumerate(probe)}
+            churn_slots = sorted((slot_by_name["accelerator_duty_cycle"],
+                                  slot_by_name["accelerator_power_watts"]))
+            for i, source in enumerate(sources):
+                code, _resp, _hdrs = hub.delta.handle(
+                    encode_full(source, i + 1, 1, bodies[i]))
+                assert code == 200, code
+            # The memory fence is at capacity now: a NEW source must be
+            # refused 503 while every established session keeps landing.
+            code, _resp, hdrs = hub.delta.handle(
+                encode_full("http://intruder:9400/metrics", 99, 1,
+                            bodies[0]))
+            fence_held = code == 503 and "Retry-After" in hdrs
+
+            landed = [0] * pushers
+            seqs = [1] * pushers
+            gens = [i + 1 for i in range(pushers)]
+            delta_shed = 0
+            full_refused = 0
+            for wave in range(waves):
+                start = wave * (pushers // waves)  # rotate shed burden
+                order = list(range(start, pushers)) + list(range(start))
+                for i in order:
+                    wire = encode_delta(
+                        sources[i], gens[i], seqs[i] + 1,
+                        [(churn_slots[0], 50.0 + wave),
+                         (churn_slots[1], 300.0 + wave)])
+                    code, _resp, hdrs = hub.delta.handle(wire)
+                    if code == 200:
+                        seqs[i] += 1
+                        landed[i] += 1
+                    elif code == 429 and "Retry-After" in hdrs:
+                        delta_shed += 1
+                    else:
+                        assert False, (code, _resp)
+                # One mid-storm recovery FULL (a "restarted worker"):
+                # must be admitted even while deltas shed.
+                victim = (wave * 37) % pushers
+                code, _resp, _hdrs = hub.delta.handle(encode_full(
+                    sources[victim], 1_000_000 + victim * 10 + wave, 1,
+                    bodies[victim]))
+                if code != 200:
+                    full_refused += 1
+                else:
+                    gens[victim] = 1_000_000 + victim * 10 + wave
+                    seqs[victim] = 1
+            hub.refresh_once()
+            alive = len(hub.delta.sources())
+            served_sources = sum(1 for n in landed if n > 0)
+            shed_counts = hub.delta.shed_total
+        finally:
+            hub.stop()
+        return {
+            "pushers": pushers,
+            "delta_shed": delta_shed,
+            "full_refused": full_refused,
+            "fence_held": fence_held,
+            "sessions_alive": alive,
+            "sources_served_fraction": round(served_sources / pushers, 4),
+            "shed_counts": shed_counts,
         }
     except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
         return None

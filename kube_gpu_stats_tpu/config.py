@@ -266,6 +266,74 @@ def add_delta_push_flags(p: argparse.ArgumentParser) -> None:
                         "(self-signed dev certs; prefer --hub-ca-file)")
 
 
+def add_ingest_guard_flags(p: argparse.ArgumentParser) -> None:
+    """The hub's ingest survival knobs (ISSUE 12): admission control,
+    hostile-pusher quarantine, and the warm-restart checkpoint. Defined
+    here (not inline in hub.main) so spellings/env vars stay in one
+    place alongside the delta-push flag surface they pair with."""
+    p.add_argument("--ingest-delta-rate", type=float,
+                   default=float(_env("INGEST_DELTA_RATE", "0")),
+                   help="max DELTA frames/second PER INGEST LANE before "
+                        "the hub sheds with 429 + Retry-After (chatty "
+                        "sources lose deltas first; 409-recovery FULLs "
+                        "are never rate-shed). 0 = unlimited")
+    p.add_argument("--ingest-max-inflight", type=int,
+                   default=int(_env("INGEST_MAX_INFLIGHT", "256")),
+                   help="max frames in concurrent apply before the hub "
+                        "sheds (deltas at 3/4 of the budget with 429, "
+                        "FULLs only at the hard cap with 503, so "
+                        "session recovery always finds headroom). "
+                        "0 = unlimited")
+    p.add_argument("--ingest-max-sessions", type=int,
+                   default=int(_env("INGEST_MAX_SESSIONS", "0")),
+                   help="memory fence over the session table: a FULL "
+                        "from a NEW source is refused with 503 + "
+                        "Retry-After once this many sessions are live "
+                        "(established sessions keep being served and "
+                        "resynced). 0 = unlimited")
+    p.add_argument("--ingest-quarantine-threshold", type=int,
+                   default=int(_env("INGEST_QUARANTINE_THRESHOLD", "5")),
+                   help="consecutive malformed frames from one "
+                        "peer/source before it is quarantined (frames "
+                        "answered 429 before any decode work)")
+    p.add_argument("--ingest-quarantine-window", type=float,
+                   default=float(_env("INGEST_QUARANTINE_WINDOW", "60")),
+                   help="seconds a quarantined peer/source stays "
+                        "refused before one probe frame is admitted")
+    p.add_argument("--ingest-checkpoint", default=_env(
+                       "INGEST_CHECKPOINT", ""),
+                   help="path for the warm-restart session checkpoint "
+                        "(.wal + fsync + atomic rename, written off "
+                        "the handler path): a restarted hub replays "
+                        "it and resumes delta chains instead of "
+                        "409ing the fleet into a FULL-resync "
+                        "stampede. Empty disables (cold restarts)")
+    p.add_argument("--ingest-checkpoint-interval", type=float,
+                   default=float(_env("INGEST_CHECKPOINT_INTERVAL", "10")),
+                   help="minimum seconds between checkpoint writes "
+                        "(the crash-tail bound: sessions whose deltas "
+                        "landed after the last write pay one FULL "
+                        "resync on restart)")
+
+
+def validate_ingest_guard_args(args) -> str | None:
+    """Range rules for the ingest survival flags; the hub parser
+    surfaces the string through parser.error."""
+    if args.ingest_delta_rate < 0:
+        return "--ingest-delta-rate must be >= 0 (0 disables)"
+    if args.ingest_max_inflight < 0:
+        return "--ingest-max-inflight must be >= 0 (0 disables)"
+    if args.ingest_max_sessions < 0:
+        return "--ingest-max-sessions must be >= 0 (0 disables)"
+    if args.ingest_quarantine_threshold < 1:
+        return "--ingest-quarantine-threshold must be >= 1"
+    if args.ingest_quarantine_window <= 0:
+        return "--ingest-quarantine-window must be > 0 seconds"
+    if args.ingest_checkpoint_interval <= 0:
+        return "--ingest-checkpoint-interval must be > 0 seconds"
+    return None
+
+
 def validate_delta_push_args(args) -> str | None:
     """Conflict rules for the shared delta-push transport flags; both
     CLIs surface the string through their own parser.error."""

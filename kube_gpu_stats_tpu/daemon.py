@@ -448,6 +448,12 @@ class Daemon:
                     "failures": sender.failures_total,
                     "dropped": sender.dropped_total,
                 }
+                if hasattr(sender, "shed_honored_total"):
+                    # Delta publishers only (ISSUE 12): hub-admission
+                    # sheds this publisher honored — their own class,
+                    # deliberately NOT in failures (the hub is shaping
+                    # load, not failing).
+                    stats[mode]["shed_honored"] = sender.shed_honored_total
         return stats
 
     def start(self) -> None:

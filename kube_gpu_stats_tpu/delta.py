@@ -35,11 +35,35 @@ wraps it in the shared PublishFollower push scaffold (backoff, final
 flush, collector_push_* health counters), and :class:`DeltaIngest` owns
 the hub-side sessions the hub refresh drains into its ``_TargetCache``
 entries.
+
+Survival layer (ISSUE 12): the receiver also owns its own overload and
+crash behavior, because at fleet fan-in the root hub is the single
+stateful choke point —
+
+- **Admission control**: a per-lane :class:`resilience.TokenBucket`
+  rates DELTA frames, a bounded in-flight budget caps concurrent
+  applies, and a session-count memory fence refuses NEW sessions before
+  the entry slab blows up RSS. Shed priority is deliberate: chatty
+  healthy sources lose deltas (429 + Retry-After — their state is one
+  re-diff away) before a 409-recovery FULL is ever refused, and
+  established sessions are never evicted by pressure.
+- **Warm restart**: the session table (source/generation/seq/order)
+  plus each pushed entry's current series state checkpoints under the
+  energy.py WAL discipline (.wal + fsync + atomic rename, rate-limited
+  off the handler path); a restarted hub replays it and resumes delta
+  chains at the checkpointed seq instead of 409ing the whole fleet into
+  a FULL-resync stampede.
+- **Hostile-pusher quarantine**: repeated malformed frames from one
+  peer/source trip a per-key circuit breaker — further frames are
+  refused with 429 before any decode work, with a journal event naming
+  the offender — so a corrupt-frame flood costs the hub a dict lookup
+  per frame, not a parse.
 """
 
 from __future__ import annotations
 
 import itertools
+import json
 import logging
 import os
 import struct
@@ -49,7 +73,8 @@ import zlib
 from typing import NamedTuple, Sequence
 
 from . import snappy
-from .validate import parse_exposition_interned
+from .resilience import CLOSED, OPEN, CircuitBreaker, TokenBucket
+from .validate import parse_exposition_interned, retry_after_seconds
 from .workers import PublishFollower, push_opener
 
 log = logging.getLogger(__name__)
@@ -328,6 +353,18 @@ class DeltaEncoder:
         receiver accepts one unconditionally."""
         self._need_full = True
 
+    def defer(self) -> None:
+        """The frame was DEFINITELY not applied (the receiver refused it
+        at admission with 429/503 + Retry-After, before touching session
+        state). Unlike nack(), no FULL is needed: the acked state still
+        matches the receiver's, so the next encode_next() re-diffs
+        against it and ships one delta covering everything that changed
+        in the meantime. This distinction is what keeps an overload shed
+        from AMPLIFYING load — promoting every shed frame to a FULL
+        (the old any-failure behavior) is exactly the resync stampede
+        the receiver was shedding to avoid."""
+        self._pending = None
+
 
 def push_headers_provider(username: str, password_file: str):
     """headers_provider for DeltaPublisher from the shared
@@ -355,13 +392,19 @@ class DeltaPublisher(PublishFollower):
     (leaf -> root) unchanged — the registry is the only dependency.
 
     Shipping health rides the standard collector_push_* counters
-    (mode="delta"); resyncs_total counts 409-forced FULL resends."""
+    (mode="delta"); resyncs_total counts 409-forced FULL resends;
+    shed_honored_total counts frames the hub refused at admission
+    (429/503 + Retry-After) that this publisher deferred — its own
+    retry class (ISSUE 12): a shed frame is known-unapplied, so the next
+    push re-diffs instead of promoting to a FULL, and the retry itself
+    waits out a decorrelated-jitter spread of the hub's Retry-After so
+    10k publishers can't thundering-herd a recovering hub."""
 
     def __init__(self, registry, url: str, *, source: str,
                  min_interval: float = 1.0, timeout: float = 5.0,
                  headers_provider=None, render_stats=None, tracer=None,
                  ca_file: str = "", insecure_tls: bool = False,
-                 generation: int | None = None) -> None:
+                 generation: int | None = None, rng=None) -> None:
         super().__init__(registry, min_interval, thread_name="delta-push")
         self._url = url.rstrip("/") + INGEST_PATH
         self._https = self._url.startswith("https://")
@@ -382,13 +425,43 @@ class DeltaPublisher(PublishFollower):
         self.auth_failures_total = 0
         self.last_frame_bytes = 0
         self.last_frame_kind: int | None = None
+        # Shed-honoring state (ISSUE 12 satellite): when the hub answers
+        # 429/503 + Retry-After, the next push is deferred until a
+        # decorrelated-jitter spread of that hint has passed — delay =
+        # min(cap, uniform(retry_after, prev * 3)), the AWS recipe
+        # BackoffPolicy documents, re-based on each response's hint so a
+        # recovering hub's 10k publishers drift apart instead of
+        # re-arriving in lockstep. rng injectable so tests pin the
+        # spread deterministically.
+        import random as random_mod
+
+        self._rng = rng if rng is not None else random_mod.Random()
+        self._shed_until = 0.0
+        self._shed_prev = 0.0
+        self.shed_honored_total = 0
 
     @property
     def source(self) -> str:
         return self._encoder.source
 
-    def _post(self, wire: bytes) -> str:
-        """'ok' | 'resync' | 'error' for one frame POST."""
+    def _note_shed(self, retry_after: float) -> None:
+        base = max(0.05, retry_after)
+        prev = max(self._shed_prev, base)
+        delay = min(max(60.0, 4.0 * base),
+                    self._rng.uniform(base, prev * 3.0))
+        self._shed_prev = delay
+        self._shed_until = time.monotonic() + delay
+        self.shed_honored_total += 1
+        if self._tracer is not None:
+            self._tracer.event(
+                "delta_shed",
+                f"{self._encoder.source}: hub shed this frame; deferring "
+                f"{delay:.2f}s (Retry-After {retry_after:g}s)",
+                source=self._encoder.source)
+
+    def _post(self, wire: bytes) -> tuple[str, float]:
+        """('ok' | 'resync' | 'shed' | 'error', retry-after seconds —
+        meaningful only for 'shed') for one frame POST."""
         import urllib.error
         import urllib.request
 
@@ -412,10 +485,18 @@ class DeltaPublisher(PublishFollower):
             opener = push_opener()
         try:
             with opener.open(request, timeout=self._timeout):
-                return "ok"
+                return "ok", 0.0
         except urllib.error.HTTPError as exc:
             if exc.code == 409:
-                return "resync"
+                return "resync", 0.0
+            if exc.code in (429, 503) and \
+                    exc.headers.get("Retry-After") is not None:
+                # Admission shed, not a failure: the hub refused the
+                # frame BEFORE touching session state and said when to
+                # come back. Known-unapplied => defer + re-diff, never
+                # a FULL promotion (that would amplify exactly the load
+                # being shed).
+                return "shed", retry_after_seconds(exc.headers)
             if exc.code == 401:
                 # Credential problem, not a transport blip: count it
                 # separately so "the hub rejects our password" is
@@ -423,14 +504,20 @@ class DeltaPublisher(PublishFollower):
                 self.auth_failures_total += 1
                 log.warning("delta push unauthorized (HTTP 401): check "
                             "--hub-auth-username/--hub-auth-password-file")
-                return "error"
+                return "error", 0.0
             log.warning("delta push rejected (HTTP %d)", exc.code)
-            return "error"
+            return "error", 0.0
         except Exception as exc:  # noqa: BLE001 - transport failure
             log.warning("delta push failed: %s", exc)
-            return "error"
+            return "error", 0.0
 
     def push_once(self) -> None:
+        if self._shed_until and time.monotonic() < self._shed_until:
+            # Honoring a Retry-After: skip this push entirely (no
+            # render, no POST). Nothing is lost — the encoder's acked
+            # state is untouched, so the first push after the window
+            # ships one delta covering the whole gap.
+            return
         serialize_start = time.monotonic()
         body, _ = self._registry.rendered()
         if not body:
@@ -441,7 +528,7 @@ class DeltaPublisher(PublishFollower):
         # other render site (remote_write serializes, then sends); a
         # slow hub must not masquerade as serialization cost.
         serialize_seconds = time.monotonic() - serialize_start
-        outcome = self._post(wire)
+        outcome, retry_after = self._post(wire)
         if outcome == "resync":
             # The hub lost (or never had) our session — restarted hub,
             # evicted source, seq gap after our own failed send. Recover
@@ -454,9 +541,10 @@ class DeltaPublisher(PublishFollower):
                     f"{encoder.source}: hub demanded resync; sending full "
                     f"snapshot", source=encoder.source)
             wire, kind = encoder.encode_next(body.decode())
-            outcome = self._post(wire)
+            outcome, retry_after = self._post(wire)
         if outcome == "ok":
             encoder.ack()
+            self._shed_until = self._shed_prev = 0.0
             self.consecutive_failures = 0
             self.pushes_total += 1
             self.last_frame_bytes = len(wire)
@@ -467,6 +555,13 @@ class DeltaPublisher(PublishFollower):
                 # with the scrape/textfile/remote-write surfaces.
                 self._render_stats.observe(
                     "delta", serialize_seconds, len(wire))
+        elif outcome == "shed":
+            # Its own retry class: not a failure (the backoff-scaled
+            # push interval and the supervisor's failure counters stay
+            # untouched), not a resync (the frame never reached session
+            # state, so the acked diff base is still valid).
+            encoder.defer()
+            self._note_shed(retry_after)
         else:
             encoder.nack()
             self.consecutive_failures += 1
@@ -516,7 +611,7 @@ class _Lane:
     lane on one cache line's worth of lock."""
 
     __slots__ = ("lock", "sessions", "full_frames", "delta_frames",
-                 "bytes", "resyncs", "apply_seconds")
+                 "bytes", "resyncs", "apply_seconds", "bucket")
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
@@ -529,6 +624,10 @@ class _Lane:
         # (parse + patch). Exported per lane: ingest CPU is the root
         # hub's ceiling at fleet fan-in, and this is what prices it.
         self.apply_seconds = 0.0
+        # Per-lane DELTA admission bucket (ISSUE 12): None = unlimited.
+        # Lane-local like the lock and counters, so the rate check
+        # never re-serializes the lanes on one shared bucket.
+        self.bucket: TokenBucket | None = None
 
 
 class LaneStore:
@@ -605,7 +704,13 @@ class DeltaIngest:
 
     def __init__(self, tracer=None, expiry: float = 60.0,
                  entry_factory=None, entry_store=None, lanes: int = 1,
-                 native: bool = True) -> None:
+                 native: bool = True,
+                 delta_rate: float = 0.0, delta_burst: float = 0.0,
+                 max_inflight: int = 0, max_sessions: int = 0,
+                 quarantine_threshold: int = 5,
+                 quarantine_window: float = 60.0,
+                 checkpoint_path: str = "",
+                 checkpoint_interval: float = 10.0) -> None:
         self._tracer = tracer
         self._expiry = expiry
         # Sharded lanes (ISSUE 11 tentpole): sources hash to a lane;
@@ -614,6 +719,65 @@ class DeltaIngest:
         # one global lock. lane 0 alone reproduces the old behavior.
         self._lanes = tuple(_Lane() for _ in range(max(1, lanes)))
         self._order = itertools.count(1)
+        # -- admission control (ISSUE 12): all off by default (0), so
+        # in-process users (tests, benches, the differential oracle)
+        # keep the accept-everything contract; the hub CLI turns the
+        # knobs on. delta_rate is PER LANE (the lanes are shared-
+        # nothing; a global bucket would re-serialize them).
+        if delta_rate > 0:
+            burst = delta_burst if delta_burst > 0 else 2.0 * delta_rate
+            for lane in self._lanes:
+                lane.bucket = TokenBucket(delta_rate, burst)
+        self._max_inflight = max(0, max_inflight)
+        # FULLs may use the whole in-flight budget; DELTAs only up to
+        # budget - reserve. Under pressure the deltas shed FIRST, so a
+        # 409-recovery FULL always finds headroom (the issue's shed
+        # priority: refusing the FULL would strand the session and turn
+        # one shed into a retry storm).
+        self._inflight_reserve = max(1, self._max_inflight // 4) \
+            if self._max_inflight else 0
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._max_sessions = max(0, max_sessions)
+        # Shed accounting: reason -> count, under its own small lock
+        # (sheds are the slow path by definition; a per-lane split
+        # would buy nothing but label cardinality).
+        self._shed_lock = threading.Lock()
+        self._shed: dict[str, int] = {}
+        # -- hostile-pusher quarantine: per-peer/per-source breakers
+        # over MALFORMED frames only (a resync is protocol, not
+        # hostility). Bounded so a spoofed-source flood can't grow the
+        # dict without limit.
+        self._quarantine_threshold = max(1, quarantine_threshold)
+        self._quarantine_window = quarantine_window
+        self._quarantine: dict[str, CircuitBreaker] = {}
+        self._quarantine_lock = threading.Lock()
+        # -- warm restart (ISSUE 12): sessions + pushed-entry state
+        # checkpoint under the energy.py WAL discipline; a restarted
+        # hub loads the index synchronously (cheap JSON) and replays
+        # entries in the background / on demand, resuming delta chains
+        # instead of 409ing the fleet.
+        self._ckpt_path = checkpoint_path
+        self._ckpt_interval = checkpoint_interval
+        self._ckpt_io_lock = threading.Lock()
+        self._ckpt_last_write = 0.0
+        self._ckpt_frames_at_write = -1
+        # Monotone write epoch, persisted and re-seeded across
+        # restarts: the WAL-vs-main recovery rule compares it, so it
+        # must never restart from 0 (a fresh process's first .wal,
+        # stranded by a crash between fsync and rename, has to beat a
+        # previous life's main file).
+        self._ckpt_seq = 0
+        self.checkpoint_writes = 0
+        self.checkpoint_loaded = False
+        self._replay_lock = threading.Lock()
+        self._pending_replay: dict[str, tuple[int, int, int, str]] = {}
+        self._replay_thread: threading.Thread | None = None
+        self._replay_loaded_monotonic = 0.0
+        self.warm_restart_sessions = 0
+        self.warm_restart_replay_seconds = 0.0
+        if checkpoint_path:
+            self._load_checkpoint()
         # Injected by the hub (delta.py must not import hub.py):
         # entry_factory(series_list) -> pushed ingest entry;
         # entry_store is the hub's target -> entry mapping (a LaneStore
@@ -659,23 +823,190 @@ class DeltaIngest:
     def resyncs_total(self) -> int:
         return sum(lane.resyncs for lane in self._lanes)
 
+    # -- admission + quarantine (ISSUE 12) ------------------------------------
+
+    # Quarantine keys beyond this are evicted oldest-first: a flood of
+    # spoofed sources must not grow the breaker dict without bound.
+    MAX_QUARANTINE_KEYS = 1024
+
+    def _count_shed(self, reason: str) -> None:
+        with self._shed_lock:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+
+    @property
+    def shed_total(self) -> dict[str, int]:
+        with self._shed_lock:
+            return dict(self._shed)
+
+    @property
+    def quarantined(self) -> int:
+        """Keys currently refused at the door (open breakers) — the
+        kts_ingest_quarantined gauge."""
+        with self._quarantine_lock:
+            breakers = list(self._quarantine.values())
+        return sum(1 for breaker in breakers if breaker.state != CLOSED)
+
+    def _quarantine_blocked(self, key: str) -> bool:
+        """True when ``key`` is quarantined right now. allow() doubles
+        as the recovery probe: after the quarantine window one frame is
+        admitted, and its outcome (malformed again vs clean) decides
+        whether the key stays out. No breaker is CREATED here — healthy
+        traffic must stay a dict miss."""
+        breaker = self._quarantine.get(key)
+        return breaker is not None and not breaker.allow()
+
+    def _record_malformed(self, keys) -> None:
+        for key in keys:
+            with self._quarantine_lock:
+                breaker = self._quarantine.get(key)
+                if breaker is None:
+                    if len(self._quarantine) >= self.MAX_QUARANTINE_KEYS:
+                        # Room is made only from CLOSED (healed or
+                        # never-tripped) breakers, oldest first. A live
+                        # quarantine is never evicted: a flood rotating
+                        # >cap source names would otherwise push real
+                        # offenders back into full parse work — and
+                        # when the table is all live quarantines, the
+                        # new key goes untracked rather than freeing
+                        # one (rotating sources never reach the
+                        # threshold anyway; the OPEN ones are the
+                        # protection worth keeping).
+                        victim = next(
+                            (k for k, b in self._quarantine.items()
+                             if b.state == CLOSED), None)
+                        if victim is None:
+                            continue
+                        del self._quarantine[victim]
+                    breaker = CircuitBreaker(
+                        f"ingest:{key}",
+                        failure_threshold=self._quarantine_threshold,
+                        recovery_time=self._quarantine_window)
+                    if self._tracer is not None:
+                        def _journal(b, old, new, key=key):
+                            if new == OPEN:
+                                self._tracer.event(
+                                    "ingest_quarantine",
+                                    f"{key}: quarantined for "
+                                    f"{self._quarantine_window:g}s after "
+                                    f"repeated malformed frames",
+                                    source=key)
+                        breaker.on_transition = _journal
+                    self._quarantine[key] = breaker
+            breaker.record_failure("malformed frame")
+
+    def _absolve(self, keys) -> None:
+        """A clean frame clears its keys' malformed streaks (and closes
+        a half-open probe). Only touches breakers that already exist —
+        the healthy path stays allocation-free."""
+        for key in keys:
+            breaker = self._quarantine.get(key)
+            if breaker is not None and (breaker.consecutive_failures
+                                        or breaker.state != CLOSED):
+                breaker.record_success()
+
+    def _session_established(self, source: str) -> bool:
+        lane = self._lanes[lane_of(source, len(self._lanes))]
+        return source in lane.sessions or source in self._pending_replay
+
+    def _admit(self, frame: Frame) -> tuple[tuple | None, bool]:
+        """(shed verdict or None, in-flight slot acquired). Shed order
+        is the survival contract: chatty sources' DELTAs go first (429 —
+        one re-diff recovers them for free), concurrency pressure sheds
+        DELTAs before FULLs (the reserve), and only NEW sessions are
+        refused by the memory fence — an established session is never
+        turned away for pressure, because refusing its recovery FULL
+        converts one shed into a repeating 409 storm."""
+        if frame.kind == KIND_DELTA:
+            bucket = self._lanes[lane_of(frame.source,
+                                         len(self._lanes))].bucket
+            if bucket is not None and not bucket.try_take():
+                self._count_shed("delta_rate")
+                retry = max(0.1, bucket.retry_after())
+                return (429, b"shed: delta rate over lane budget\n",
+                        {"Retry-After": f"{retry:.1f}"}), False
+        acquired = False
+        if self._max_inflight:
+            limit = self._max_inflight
+            if frame.kind == KIND_DELTA:
+                limit -= self._inflight_reserve
+            with self._inflight_lock:
+                if self._inflight < limit:
+                    self._inflight += 1
+                    acquired = True
+            if not acquired:
+                self._count_shed("inflight")
+                code = 503 if frame.kind == KIND_FULL else 429
+                return (code, b"shed: ingest at the in-flight budget\n",
+                        {"Retry-After": "1"}), False
+        if (frame.kind == KIND_FULL and self._max_sessions
+                and not self._session_established(frame.source)
+                and sum(len(lane.sessions) for lane in self._lanes)
+                >= self._max_sessions):
+            if acquired:
+                with self._inflight_lock:
+                    self._inflight -= 1
+            self._count_shed("memory")
+            return (503, b"shed: session table at the memory fence\n",
+                    {"Retry-After": "15"}), False
+        return None, acquired
+
     # -- write side (HTTP POST threads) --------------------------------------
 
-    def handle(self, wire: bytes) -> tuple[int, bytes]:
-        """HTTP-facing apply: (status code, response body). 200 applied,
-        409 resync required, 400 malformed — the three-way contract the
-        publisher keys on."""
+    def handle(self, wire: bytes,
+               peer: str = "") -> tuple[int, bytes, dict]:
+        """HTTP-facing apply: (status code, response body, response
+        headers). 200 applied, 409 resync required, 400 malformed, and
+        the ISSUE 12 shed classes — 429/503 with Retry-After (refused at
+        admission, definitely unapplied; the publisher defers and
+        re-diffs) — the contract the publisher keys on. ``peer`` is the
+        client address when the caller knows it: it keys the
+        quarantine check BEFORE any decode work, so a corrupt-frame
+        flood costs a dict lookup per frame, not a parse."""
+        peer_key = f"peer:{peer}" if peer else None
+        if peer_key is not None and self._quarantine_blocked(peer_key):
+            self._count_shed("quarantined")
+            return (429, b"quarantined: repeated malformed frames\n",
+                    {"Retry-After": f"{self._quarantine_window:g}"})
         try:
             frame = decode_frame(wire)
         except ValueError as exc:
-            return 400, f"bad delta frame: {exc}\n".encode()
+            self._record_malformed([peer_key] if peer_key else [])
+            return 400, f"bad delta frame: {exc}\n".encode(), {}
+        source_key = "source:" + frame.source
+        if self._quarantine_blocked(source_key):
+            self._count_shed("quarantined")
+            return (429, b"quarantined: repeated malformed frames\n",
+                    {"Retry-After": f"{self._quarantine_window:g}"})
+        verdict, acquired = self._admit(frame)
+        if verdict is not None:
+            return verdict
         try:
             self.apply(frame, len(wire))
         except ResyncRequired as exc:
-            return 409, f"resync required: {exc}\n".encode()
+            # A 409 is protocol-honest traffic (well-formed frame, seq
+            # chain disagreement) — it clears malformed streaks and
+            # closes a half-open quarantine probe just like a 200, or a
+            # recovering peer whose first frame drew a resync would
+            # stay quarantined one extra window.
+            self._absolve([k for k in (peer_key, source_key) if k])
+            return 409, f"resync required: {exc}\n".encode(), {}
         except ValueError as exc:  # unparseable FULL body
-            return 400, f"bad delta frame: {exc}\n".encode()
-        return 200, b"ok\n"
+            # The frame DECODED, so the source identity is reliable —
+            # quarantine that alone, never the peer: many pushers share
+            # one client IP behind a NAT/service mesh, and keying a
+            # parse failure on the address would collateral-quarantine
+            # every healthy pusher beside the bad one. (The peer key is
+            # reserved for undecodable garbage, where nothing better
+            # exists — and even there a healthy frame from the same
+            # address resets the streak before it trips.)
+            self._record_malformed([source_key])
+            return 400, f"bad delta frame: {exc}\n".encode(), {}
+        finally:
+            if acquired:
+                with self._inflight_lock:
+                    self._inflight -= 1
+        self._absolve([k for k in (peer_key, source_key) if k])
+        return 200, b"ok\n", {}
 
     def _route(self, source: str) -> tuple[_Lane, dict]:
         """(lane, entry mapping) for a source — the source is hashed
@@ -699,6 +1030,18 @@ class DeltaIngest:
         return ResyncRequired(reason)
 
     def apply(self, frame: Frame, nbytes: int) -> None:
+        if self._pending_replay:
+            # Warm restart, on-demand half: the first frame from a
+            # checkpointed source replays that source's session inline
+            # (one parse, spread over the handler threads exactly like
+            # normal FULL traffic) so its DELTA applies instead of
+            # 409ing — the background replay thread sweeps up sources
+            # that haven't pushed yet. A FULL supersedes the
+            # checkpoint: the publisher's live state is fresher.
+            with self._replay_lock:
+                record = self._pending_replay.pop(frame.source, None)
+            if record is not None and frame.kind != KIND_FULL:
+                self._replay_one(frame.source, record)
         start = time.perf_counter()
         # The expensive halves of a FULL — tokenizing the body and
         # building the entry's derived views — run BEFORE the lock: a
@@ -858,7 +1201,241 @@ class DeltaIngest:
             "bytes": self.bytes_total,
             "resyncs": self.resyncs_total,
             "sessions": sum(len(lane.sessions) for lane in self._lanes),
+            "quarantined": self.quarantined,
+            "shed": sum(self.shed_total.values()),
+            "warm_restart_pending": len(self._pending_replay),
         }
+
+    # -- warm restart (ISSUE 12): WAL checkpoint + replay ---------------------
+
+    CHECKPOINT_VERSION = 1
+
+    @staticmethod
+    def _render_series(series) -> str:
+        """Serialize an entry's current series state back to exposition
+        text — the checkpoint's entry encoding, chosen so replay runs
+        through parse_exposition_interned exactly like a FULL frame
+        (one code path, one set of intern pools; the replayed entry can
+        never diverge from what a live FULL of the same values would
+        have built)."""
+        from . import schema
+        from .registry import format_value
+
+        return "\n".join(
+            name + schema.render_labels(labels) + " " + format_value(value)
+            for name, labels, value in series) + "\n"
+
+    @property
+    def replaying(self) -> bool:
+        """True while checkpointed sessions are still waiting for
+        replay — the hub's /readyz holds NotReady on this (scrapers
+        drain to a fully-resumed hub) while /healthz stays live."""
+        return bool(self._pending_replay)
+
+    @property
+    def warm_restart_pending(self) -> int:
+        return len(self._pending_replay)
+
+    def checkpoint_age(self) -> float | None:
+        """Seconds since the last successful checkpoint write; None
+        when checkpointing is off or nothing has been written yet."""
+        if not self._ckpt_path or not self._ckpt_last_write:
+            return None
+        return max(0.0, time.monotonic() - self._ckpt_last_write)
+
+    def _capture_checkpoint(self) -> dict:
+        """Snapshot every lane's sessions + pushed-entry series under
+        the lane locks (one lane at a time — apply() mutates both under
+        the same lock, so each record is internally consistent: a
+        checkpoint taken between a session's FULL and its first DELTA
+        replays to exactly the post-FULL seq). Serialization happens
+        outside the locks; only list() copies happen inside."""
+        raw: list[tuple[str, int, int, int, list]] = []
+        store = self._entry_store
+        sharded = (isinstance(store, LaneStore)
+                   and len(store.shards) == len(self._lanes))
+        for index, lane in enumerate(self._lanes):
+            shard = store.shards[index] if sharded else store
+            with lane.lock:
+                for source, session in lane.sessions.items():
+                    entry = shard.get(source)
+                    if (entry is None or not getattr(entry, "pushed", False)
+                            or entry.series is None):
+                        continue
+                    raw.append((source, session.generation, session.seq,
+                                session.order, list(entry.series)))
+        sessions = [
+            [source, generation, seq, order, self._render_series(series)]
+            for source, generation, seq, order, series in raw
+        ]
+        # Sessions still AWAITING warm replay carry forward verbatim
+        # (their records are already in checkpoint form): a checkpoint
+        # written mid-replay — or a crash-loop of restarts — must never
+        # shrink the fleet to the replayed-so-far fraction, or the
+        # next start cold-409s exactly the sessions this file exists
+        # to protect. A source both replayed and pending cannot exist
+        # (the pending pop is the single hand-off), but the captured
+        # set wins on any race.
+        captured = {record[0] for record in sessions}
+        with self._replay_lock:
+            pending = list(self._pending_replay.items())
+        for source, (generation, seq, order, body) in pending:
+            if source not in captured:
+                sessions.append([source, generation, seq, order, body])
+        self._ckpt_seq += 1
+        return {
+            "version": self.CHECKPOINT_VERSION,
+            "wall": time.time(),
+            "seq": self._ckpt_seq,
+            "frames": self.full_frames_total + self.delta_frames_total,
+            "sessions": sessions,
+        }
+
+    def checkpoint(self, force: bool = False) -> bool:
+        """Write-ahead persist (the energy.py discipline verbatim: full
+        state to ``<path>.wal``, fsync, atomic rename over ``<path>``).
+        Called from the hub's refresh thread — never a handler thread —
+        and rate-limited to the checkpoint interval unless forced
+        (clean shutdown forces a final write so a drain-and-restart
+        loses nothing at all)."""
+        if not self._ckpt_path:
+            return False
+        with self._ckpt_io_lock:
+            now = time.monotonic()
+            frames = self.full_frames_total + self.delta_frames_total
+            if not force and (
+                    frames == self._ckpt_frames_at_write
+                    or now - self._ckpt_last_write < self._ckpt_interval):
+                return False
+            state = self._capture_checkpoint()
+            wal = self._ckpt_path + ".wal"
+            try:
+                os.makedirs(os.path.dirname(self._ckpt_path) or ".",
+                            exist_ok=True)
+                with open(wal, "w", encoding="utf-8") as handle:
+                    json.dump(state, handle, separators=(",", ":"))
+                    handle.flush()
+                    os.fsync(handle.fileno())
+                os.replace(wal, self._ckpt_path)
+            except OSError as exc:
+                log.warning("ingest checkpoint write failed: %s", exc)
+                return False
+            self._ckpt_last_write = now
+            self._ckpt_frames_at_write = frames
+            self.checkpoint_writes += 1
+            return True
+
+    @staticmethod
+    def _read_checkpoint(path: str) -> dict | None:
+        try:
+            with open(path, encoding="utf-8") as handle:
+                state = json.load(handle)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as exc:
+            log.warning("ingest checkpoint %s unreadable (%s)", path, exc)
+            return None
+        if state.get("version") != DeltaIngest.CHECKPOINT_VERSION:
+            log.warning("ingest checkpoint %s version %r unsupported; "
+                        "ignoring", path, state.get("version"))
+            return None
+        return state
+
+    def _load_checkpoint(self) -> None:
+        """Synchronous index load at construction: cheap JSON only, no
+        parses. Both candidates, newest frame count wins — a crash
+        between the wal's fsync and the rename leaves the newer state
+        in the .wal (the energy.py recovery rule)."""
+        main = self._read_checkpoint(self._ckpt_path)
+        wal = self._read_checkpoint(self._ckpt_path + ".wal")
+        state = main
+        if wal is not None and (state is None or wal.get("seq", 0)
+                                > state.get("seq", 0)):
+            state = wal
+            log.info("ingest checkpoint: recovering from the newer .wal "
+                     "(crash between fsync and rename)")
+        if state is None:
+            return
+        # Resume the write epoch past BOTH candidates: this process's
+        # first write must out-rank even the one not loaded, or a
+        # later crash could resurrect it over newer fsynced state.
+        self._ckpt_seq = max(
+            main.get("seq", 0) if main is not None else 0,
+            wal.get("seq", 0) if wal is not None else 0)
+        max_order = 0
+        for source, generation, seq, order, body in \
+                state.get("sessions", ()):
+            self._pending_replay[str(source)] = (
+                int(generation), int(seq), int(order), str(body))
+            max_order = max(max_order, int(order))
+        self._order = itertools.count(max_order + 1)
+        self.checkpoint_loaded = True
+        self._replay_loaded_monotonic = time.monotonic()
+        log.info("ingest checkpoint loaded: %d session(s) pending warm "
+                 "replay", len(self._pending_replay))
+
+    def _replay_one(self, source: str,
+                    record: tuple[int, int, int, str]) -> None:
+        """Rebuild one source's session + entry from its checkpoint
+        record. Parse runs before the lane lock (the FULL-storm
+        discipline); a session that already exists wins — a live FULL
+        is always fresher than the checkpoint."""
+        generation, seq, order, body = record
+        series = parse_exposition_interned(body)
+        entry = (self._entry_factory(series)
+                 if self._entry_factory is not None else None)
+        lane, store = self._route(source)
+        with lane.lock:
+            if source in lane.sessions:
+                return
+            session = _Session(source, order)
+            session.generation = generation
+            session.seq = seq
+            # Stamped now, not at checkpoint time: the session is
+            # fresh-for-one-fence-window so the first refresh after a
+            # restart serves the checkpointed values (that is the warm
+            # part) — the publisher's next delta lands before the
+            # fence expires or the target falls back to pull.
+            session.stamp(time.monotonic())
+            lane.sessions[source] = session
+            if entry is not None:
+                store[source] = entry
+        self.warm_restart_sessions += 1
+
+    def start_replay(self) -> None:
+        """Kick the background replay sweep (idempotent). On-demand
+        replay in apply() races it safely: the pending dict pop is the
+        single hand-off point, so each source replays exactly once."""
+        if not self._pending_replay or (
+                self._replay_thread is not None
+                and self._replay_thread.is_alive()):
+            return
+
+        def sweep() -> None:
+            while True:
+                with self._replay_lock:
+                    if not self._pending_replay:
+                        break
+                    source, record = next(iter(self._pending_replay.items()))
+                    del self._pending_replay[source]
+                try:
+                    self._replay_one(source, record)
+                except Exception:  # noqa: BLE001 - one bad record must
+                    # not strand the rest of the fleet unreplayed.
+                    log.warning("warm replay of %s failed", source,
+                                exc_info=True)
+            self.warm_restart_replay_seconds = (
+                time.monotonic() - self._replay_loaded_monotonic)
+            if self._tracer is not None:
+                self._tracer.event(
+                    "warm_restart",
+                    f"warm restart: {self.warm_restart_sessions} "
+                    f"session(s) replayed in "
+                    f"{self.warm_restart_replay_seconds:.2f}s")
+
+        self._replay_thread = threading.Thread(
+            target=sweep, name="ingest-replay", daemon=True)
+        self._replay_thread.start()
 
     def lane_stats(self) -> list[dict[str, float]]:
         """Per-lane health for the kts_ingest_lane_* self-metrics: live

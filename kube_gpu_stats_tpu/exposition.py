@@ -157,15 +157,22 @@ class MetricsServer:
                  trace_provider=None, fleet_provider=None,
                  ingest_provider=None, burst_provider=None,
                  energy_provider=None, host_provider=None,
-                 prewarm_renders: bool = True):
+                 prewarm_renders: bool = True,
+                 ingest_read_deadline: float = 10.0):
         self._registry = registry
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
         # Delta-push ingest (delta.DeltaIngest.handle, duck-typed:
-        # bytes -> (status, body)): serves POST /ingest/delta behind the
-        # same auth gate as /metrics. None = POSTs answer 404 (daemons
-        # and bare test servers don't ingest).
+        # (bytes, peer) -> (status, body, headers)): serves POST
+        # /ingest/delta behind the same auth gate as /metrics. None =
+        # POSTs answer 404 (daemons and bare test servers don't
+        # ingest). ingest_read_deadline is the slow-loris fence
+        # (ISSUE 12): a POST body that dribbles in slower than this is
+        # cut off with 408 — without it, ThreadingHTTPServer donates
+        # one thread per loris until the default socket timeout (None:
+        # forever).
         self._ingest = ingest_provider
+        self._ingest_read_deadline = ingest_read_deadline
         # Render pre-warmer (scrape-regression fix, ISSUE 7 satellite):
         # a publish-following thread fills the per-generation render
         # cache (text + gzip) the moment a snapshot lands, so a scrape
@@ -240,6 +247,15 @@ class MetricsServer:
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # Header-level slow-loris fence (ISSUE 12): the socket
+            # timeout BaseHTTPRequestHandler applies to every read on
+            # the connection, so a client that opens a connection and
+            # dribbles (or never sends) the request line can hold its
+            # handler thread for at most this long — with the default
+            # (None) it holds the thread forever and a few hundred
+            # sockets exhaust the thread budget.
+            timeout = 30.0
+
             # Scrapes arrive at >= 1/s per Prometheus; default logging to
             # stderr per request would swamp the DaemonSet logs.
             def log_message(self, fmt: str, *args) -> None:
@@ -301,26 +317,50 @@ class MetricsServer:
                 if path != "/ingest/delta" or outer._ingest is None:
                     self._send_plain(404, b"not found\n")
                     return
+                # Content-Length fence BEFORE any body read (ISSUE 12):
+                # cap the COMPRESSED read; the decoder separately
+                # bounds the decompressed size (delta.MAX_FRAME_BYTES).
+                # Absent/garbage/oversized answers without touching the
+                # socket again — the frame is never buffered.
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                 except ValueError:
                     length = -1
-                # Cap the COMPRESSED read; the decoder separately bounds
-                # the decompressed size (delta.MAX_FRAME_BYTES).
                 if length <= 0 or length > 64 * 1024 * 1024:
                     self._send_plain(
                         413, b"delta frame missing or oversized\n")
                     return
-                wire = self.rfile.read(length)
+                # Body-level slow-loris fence: the read deadline bounds
+                # how long a declared-but-dribbled body can hold this
+                # handler thread. 408 + connection close — a loris gets
+                # no second request on the wedged socket.
+                import socket as socket_mod
+
+                previous_timeout = self.connection.gettimeout()
+                self.connection.settimeout(outer._ingest_read_deadline)
                 try:
-                    code, body = outer._ingest(wire)
+                    wire = self.rfile.read(length)
+                except (socket_mod.timeout, TimeoutError):
+                    self.close_connection = True
+                    self._send_plain(
+                        408, b"request body read timed out\n")
+                    return
+                finally:
+                    self.connection.settimeout(previous_timeout)
+                if len(wire) < length:
+                    # Short read (peer closed mid-body): not a frame.
+                    self._send_plain(400, b"truncated request body\n")
+                    return
+                try:
+                    code, body, headers = outer._ingest(
+                        wire, peer=self.client_address[0])
                 except Exception:  # noqa: BLE001 - a frame must not
                     # kill the connection thread with a stack trace as
                     # the only evidence; the publisher sees a 500 and
                     # resyncs.
                     log.exception("delta ingest crashed")
-                    code, body = 500, b"ingest error\n"
-                self._send_plain(code, body)
+                    code, body, headers = 500, b"ingest error\n", {}
+                self._send_plain(code, body, headers or None)
 
             def do_GET(self) -> None:
                 path = self.path.split("?", 1)[0]
@@ -477,6 +517,16 @@ class MetricsServer:
                     params = self._query()
                     if path == "/debug/ticks":
                         payload = outer._trace.ticks_summary()
+                        # Render-path contention meta (ISSUE 12
+                        # satellite): the scrape-p99 watch item's first
+                        # suspect is pre-warmer lock contention, so the
+                        # cumulative wait is surfaced where the slow-
+                        # tick post-mortem already lands — no profiler
+                        # needed to rule it in or out.
+                        payload.setdefault("meta", {})[
+                            "render_prewarm_wait_seconds_total"] = round(
+                            getattr(outer._registry,
+                                    "render_wait_seconds", 0.0), 6)
                     elif path == "/debug/trace":
                         try:
                             last = int(params.get("last", "0") or 0)

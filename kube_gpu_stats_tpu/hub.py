@@ -473,7 +473,14 @@ class Hub:
                  push_fence: float | None = None,
                  federate: bool = False,
                  ingest_lanes: int = 0,
-                 native_ingest: bool = True) -> None:
+                 native_ingest: bool = True,
+                 ingest_delta_rate: float = 0.0,
+                 ingest_max_inflight: int = 0,
+                 ingest_max_sessions: int = 0,
+                 ingest_quarantine_threshold: int = 5,
+                 ingest_quarantine_window: float = 60.0,
+                 ingest_checkpoint: str = "",
+                 ingest_checkpoint_interval: float = 10.0) -> None:
         if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -597,6 +604,10 @@ class Hub:
         # drains them straight onto the _TargetCache interned state,
         # bypassing fetch AND parse for push-fresh targets. None
         # (--no-delta-ingest) keeps the hub pull-only.
+        # Survival knobs (ISSUE 12) ride straight through: admission
+        # control + quarantine + the warm-restart checkpoint live in
+        # DeltaIngest; the hub only owns the cadence (checkpoint per
+        # refresh, replay kicked at start) and the /readyz gate.
         self.delta = (delta_mod.DeltaIngest(
             tracer=self.tracer,
             expiry=max(10.0 * self._push_fence, 60.0),
@@ -604,7 +615,14 @@ class Hub:
                 "", series, pushed=True, wants_rollup=federate),
             entry_store=self._parse_cache,
             lanes=self._ingest_lanes,
-            native=native_ingest)
+            native=native_ingest,
+            delta_rate=ingest_delta_rate,
+            max_inflight=ingest_max_inflight,
+            max_sessions=ingest_max_sessions,
+            quarantine_threshold=ingest_quarantine_threshold,
+            quarantine_window=ingest_quarantine_window,
+            checkpoint_path=ingest_checkpoint,
+            checkpoint_interval=ingest_checkpoint_interval)
             if delta_ingest else None)
         self._push_served = 0  # targets served by push, last refresh
         # Federated slice_* series dropped because two leaves claimed
@@ -638,6 +656,10 @@ class Hub:
         tracer = self.tracer
         self._cycle_seq += 1
         tracer.begin("cycle", self._cycle_seq)
+        if self.delta is not None:
+            # Warm-restart replay (ISSUE 12): idempotent kick, so the
+            # --once/test paths (which never call start()) replay too.
+            self.delta.start_replay()
         self._refresh_targets()
         if not self._targets:
             # Discovery never succeeded, or the target list was
@@ -1236,6 +1258,30 @@ class Hub:
             builder.add(schema.INGEST_LANES, float(self.delta.lanes))
             builder.add(schema.INGEST_NATIVE,
                         1.0 if self.delta.native_active else 0.0)
+            # Overload-survival self-metrics (ISSUE 12). Shed reasons
+            # are born at 0 for every reason the guard can emit, so
+            # increase()-based IngestShedHigh alerting sees the first
+            # shed of each class.
+            shed = self.delta.shed_total
+            for reason in ("delta_rate", "inflight", "memory",
+                           "quarantined"):
+                builder.add(schema.INGEST_SHED,
+                            float(shed.get(reason, 0)),
+                            (("reason", reason),))
+            builder.add(schema.INGEST_QUARANTINED,
+                        float(self.delta.quarantined))
+            builder.add(schema.HUB_WARM_RESTART_SESSIONS,
+                        float(self.delta.warm_restart_sessions))
+            builder.add(schema.HUB_WARM_RESTART_PENDING,
+                        float(self.delta.warm_restart_pending))
+            if self.delta.warm_restart_replay_seconds:
+                builder.add(schema.HUB_WARM_RESTART_REPLAY_SECONDS,
+                            self.delta.warm_restart_replay_seconds)
+            builder.add(schema.HUB_WARM_RESTART_CHECKPOINT_WRITES,
+                        float(self.delta.checkpoint_writes))
+            age = self.delta.checkpoint_age()
+            if age is not None:
+                builder.add(schema.HUB_WARM_RESTART_CHECKPOINT_AGE, age)
             for index, lane in enumerate(self.delta.lane_stats()):
                 labels = (("lane", str(index)),)
                 builder.add(schema.INGEST_LANE_SESSIONS,
@@ -1266,7 +1312,16 @@ class Hub:
         # The hub's own process health (CPU, RSS, fds) — same process_*
         # families the daemon exports, so one dashboard covers both.
         procstats.contribute(builder, proc_readings)
+        # Render-lock contention (ISSUE 12 satellite — the scrape-p99
+        # watch item's first suspect, also in /debug/ticks meta).
+        builder.add(schema.RENDER_PREWARM_WAIT,
+                    self.registry.render_wait_seconds)
         self.registry.publish(builder.build())
+        if self.delta is not None:
+            # Warm-restart checkpoint (ISSUE 12): written HERE, on the
+            # refresh thread, never on a handler thread — rate-limited
+            # inside (one fsync per checkpoint interval at most).
+            self.delta.checkpoint()
 
     def ready(self) -> tuple[bool, str]:
         """Readiness for /readyz: a hub is ready to serve traffic only
@@ -1277,6 +1332,13 @@ class Hub:
         rollout cannot replace a working hub with a blind one."""
         if self.registry.snapshot().timestamp <= 0:
             return False, "no snapshot published yet"
+        if self.delta is not None and self.delta.replaying:
+            # Warm restart in progress: live (refreshing, /healthz 200)
+            # but not ready — scrapers drain to fully-resumed hubs
+            # instead of reading a partially-replayed fleet view.
+            return False, (f"warm restart: "
+                           f"{self.delta.warm_restart_pending} session(s) "
+                           f"awaiting replay")
         if not self._targets:
             return False, "no targets (discovery empty or decommissioned)"
         return True, "ready"
@@ -1736,6 +1798,8 @@ class Hub:
             self._stop.wait(max(0.1, self._interval - elapsed))
 
     def start(self) -> None:
+        if self.delta is not None:
+            self.delta.start_replay()
         self._thread = threading.Thread(
             target=self.run_forever, name="hub-refresh", daemon=True)
         self._thread.start()
@@ -1745,6 +1809,11 @@ class Hub:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._pool.shutdown(wait=False)
+        if self.delta is not None:
+            # Clean shutdown keeps the newest state: a drain-and-
+            # restart (pod reschedule) warm-resumes every session, not
+            # just those up to the last periodic write.
+            self.delta.checkpoint(force=True)
 
 
 def file_targets_provider(path: str, static: Sequence[str] = ()):
@@ -1946,11 +2015,14 @@ def main(argv: Sequence[str] | None = None) -> int:
     # drift between the two CLIs. On a hub, --hub-url points at the
     # PARENT (root) hub of a federation tree.
     from .config import (add_delta_push_flags, add_fleet_lens_flags,
+                         add_ingest_guard_flags,
                          validate_delta_push_args,
-                         validate_fleet_lens_args)
+                         validate_fleet_lens_args,
+                         validate_ingest_guard_args)
 
     add_fleet_lens_flags(parser)
     add_delta_push_flags(parser)
+    add_ingest_guard_flags(parser)
     args = parser.parse_args(argv)
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
@@ -1958,6 +2030,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     push_error = validate_delta_push_args(args)
     if push_error:
         parser.error(push_error)
+    guard_error = validate_ingest_guard_args(args)
+    if guard_error:
+        parser.error(guard_error)
     if args.ingest_lanes < 0 or args.ingest_lanes > 256:
         parser.error("--ingest-lanes must be 0 (auto) or 1..256")
 
@@ -2029,12 +2104,16 @@ def main(argv: Sequence[str] | None = None) -> int:
     def push_stats() -> dict:
         # Same shape as daemon._push_stats; resolved per refresh so the
         # collector_push_* self metrics ride the hub's own exposition.
-        return {
-            mode: {"pushes": sender.pushes_total,
-                   "failures": sender.failures_total,
-                   "dropped": sender.dropped_total}
-            for mode, sender in senders
-        }
+        stats = {}
+        for mode, sender in senders:
+            stats[mode] = {"pushes": sender.pushes_total,
+                           "failures": sender.failures_total,
+                           "dropped": sender.dropped_total}
+            if hasattr(sender, "shed_honored_total"):
+                # A leaf hub pushing into a shedding root honors its
+                # Retry-After exactly like a daemon does (ISSUE 12).
+                stats[mode]["shed_honored"] = sender.shed_honored_total
+        return stats
 
     hub = Hub(targets, interval=args.interval,
               expect_workers=args.expect_workers,
@@ -2042,7 +2121,8 @@ def main(argv: Sequence[str] | None = None) -> int:
               fetch_timeout=args.fetch_timeout,
               render_stats=render_stats,
               push_stats=push_stats if (args.pushgateway_url
-                                        or args.remote_write_url) else None,
+                                        or args.remote_write_url
+                                        or args.hub_url) else None,
               headers_provider=headers_provider,
               target_ca_file=args.target_ca_file,
               target_insecure_tls=args.target_insecure_tls,
@@ -2055,7 +2135,14 @@ def main(argv: Sequence[str] | None = None) -> int:
               push_fence=args.push_fence or None,
               federate=args.federate,
               ingest_lanes=args.ingest_lanes,
-              native_ingest=not args.no_native_ingest)
+              native_ingest=not args.no_native_ingest,
+              ingest_delta_rate=args.ingest_delta_rate,
+              ingest_max_inflight=args.ingest_max_inflight,
+              ingest_max_sessions=args.ingest_max_sessions,
+              ingest_quarantine_threshold=args.ingest_quarantine_threshold,
+              ingest_quarantine_window=args.ingest_quarantine_window,
+              ingest_checkpoint=args.ingest_checkpoint,
+              ingest_checkpoint_interval=args.ingest_checkpoint_interval)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.

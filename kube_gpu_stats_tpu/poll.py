@@ -1536,6 +1536,13 @@ class PollLoop:
             )
         if self._push_stats is not None:
             contribute_push_stats(builder, self._push_stats())
+        # Render-lock contention (ISSUE 12 satellite): cumulative
+        # seconds readers waited to enter Registry.rendered() — the
+        # scrape-p99 watch item's first suspect, kept ~0 by the
+        # pre-warmer and exported so the next creep is diagnosable
+        # without a profiler.
+        builder.add(schema.RENDER_PREWARM_WAIT,
+                    self._registry.render_wait_seconds)
         builder.add(
             schema.SELF_INFO,
             1.0,

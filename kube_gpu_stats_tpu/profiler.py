@@ -101,7 +101,7 @@ def profile_ingest(sources: int = 1000, waves: int = 5,
             if name in ("accelerator_duty_cycle",
                         "accelerator_power_watts"))
         for i, source in enumerate(names):
-            code, _ = hub.delta.handle(
+            code, _resp, _hdrs = hub.delta.handle(
                 encode_full(source, i + 1, 1, bodies[i]))
             assert code == 200, code
         hub.refresh_once()  # merge plans -> patch programs can compile
@@ -118,7 +118,7 @@ def profile_ingest(sources: int = 1000, waves: int = 5,
         # python -O a side-effecting assert would skip the warmup and
         # the profiled waves would measure 409 rejection instead.)
         for wire in wave_wires(2, 0.0):
-            code, _ = hub.delta.handle(wire)
+            code, _resp, _hdrs = hub.delta.handle(wire)
             assert code == 200, code
         # Pre-encode every profiled wave: encode_delta is the
         # PUBLISHER's cost (paid on the pushing node) and must not

@@ -199,6 +199,16 @@ class Registry:
         # identical output either way) and one wins the store.
         self._render_cache: dict[tuple[bool, int],
                                  tuple[int, bytes]] = {}
+        # Cumulative seconds readers spent WAITING to acquire the
+        # publish lock inside rendered() (ISSUE 12 satellite: the
+        # scrape-p99 creep watch item). The lock-held region is a
+        # two-field read, so in a healthy process this stays ~0;
+        # growth means scrapes are queueing behind publishes or the
+        # render pre-warmer — exported as
+        # kts_render_prewarm_wait_seconds_total and surfaced in
+        # /debug/ticks meta, so the next creep is diagnosable without
+        # a profiler. Accumulated while holding the lock (no race).
+        self.render_wait_seconds = 0.0
 
     def publish(self, snapshot: Snapshot) -> None:
         with self._published:
@@ -219,6 +229,7 @@ class Registry:
         gzip entry, so the two shapes share one serialization per
         generation. Byte-identity with ``Snapshot.render()`` is pinned by
         tests/test_golden.py."""
+        wait_start = time.perf_counter()
         with self._published:
             # One lock-held read so (generation, snapshot) is a coherent
             # pair; the render itself runs outside the lock and can never
@@ -227,6 +238,7 @@ class Registry:
             # Goes through snapshot(), not _snapshot: subclasses (and
             # tests) that override the accessor must see their snapshot
             # rendered, cache or no cache.
+            self.render_wait_seconds += time.perf_counter() - wait_start
             generation = self._generation
             snapshot = self.snapshot()
         key = (openmetrics, gzip_level)
@@ -330,6 +342,12 @@ def contribute_push_stats(builder: SnapshotBuilder, stats) -> None:
                     float(entry.get("failures", 0)), mode_label)
         builder.add(schema.SELF_PUSH_DROPPED,
                     float(entry.get("dropped", 0)), mode_label)
+        if "shed_honored" in entry:
+            # Delta publishers only (ISSUE 12 satellite): frames the
+            # hub refused at admission that this publisher deferred
+            # per the Retry-After instead of retrying or FULL-resyncing.
+            builder.add(schema.DELTA_SHED_HONORED,
+                        float(entry.get("shed_honored", 0)), mode_label)
 
 
 class FilteredSnapshotBuilder(SnapshotBuilder):

@@ -21,6 +21,10 @@ inconsistent and invisible. Three primitives unify it:
 - :class:`DeadlineBudget` — a per-tick wall-time budget that child calls
   draw down, so one slow chip (or one slow port) can't blow the whole
   tick's 50 ms p50 target.
+- :class:`TokenBucket` — non-blocking rate admission with a Retry-After
+  hint for refused callers. The hub's delta-ingest shed path (ISSUE 12)
+  rates each lane with one; anything that must refuse load instead of
+  queueing it can reuse it.
 
 Everything here is allocation-light and safe to touch from the poll hot
 path; the breaker takes a small lock only around its counters, never
@@ -332,6 +336,56 @@ class CircuitBreaker:
             hook(self, old, new)
         except Exception:  # noqa: BLE001 - observer must not break the edge
             pass
+
+
+class TokenBucket:
+    """Thread-safe token bucket: ``rate`` tokens/second refill up to a
+    ``burst`` ceiling; :meth:`try_take` either debits and admits or
+    refuses without blocking. The admission primitive for the hub's
+    ingest shed path (ISSUE 12): refusal is cheap and instant — the
+    caller answers 429/503 with :meth:`retry_after` as the Retry-After
+    hint — so an overloaded receiver degrades by shedding load, never
+    by queueing it into RSS.
+
+    Same injectable-clock discipline as CircuitBreaker (tests never
+    sleep); the lock guards only the counter math."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if rate <= 0 or burst <= 0:
+            raise ValueError(f"need rate > 0 and burst > 0 "
+                             f"(got {rate}, {burst})")
+        self.rate = rate
+        self.burst = burst
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = burst
+        self._stamp = clock()
+
+    def _refill(self, now: float) -> None:
+        elapsed = now - self._stamp
+        if elapsed > 0:
+            self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+        self._stamp = now
+
+    def try_take(self, tokens: float = 1.0) -> bool:
+        with self._lock:
+            self._refill(self._clock())
+            if self._tokens >= tokens:
+                self._tokens -= tokens
+                return True
+            return False
+
+    def retry_after(self, tokens: float = 1.0) -> float:
+        """Seconds until ``tokens`` will have refilled — the honest
+        Retry-After value for a refused caller (a floor, not a
+        guarantee: other callers drain the bucket too, which is why the
+        shed path pairs this with decorrelated-jitter backoff on the
+        publisher side)."""
+        with self._lock:
+            self._refill(self._clock())
+            deficit = tokens - self._tokens
+        return max(0.0, deficit / self.rate)
 
 
 class DeadlineBudget:

@@ -593,6 +593,75 @@ INGEST_NATIVE = MetricSpec(
     "kts_ingest_lane_apply_seconds_total is the first thing to check.",
 )
 
+# Overload-survival families (ISSUE 12): ingest admission control,
+# hostile-pusher quarantine, and the warm-restart checkpoint — see the
+# 'Overload & disaster recovery' runbook in docs/OPERATIONS.md.
+
+INGEST_SHED = MetricSpec(
+    "kts_ingest_shed_total",
+    MetricType.COUNTER,
+    "Delta-ingest frames refused at admission, by reason: 'delta_rate' "
+    "(a lane's DELTA token bucket ran dry — chatty sources, 429), "
+    "'inflight' (the concurrent-apply budget is full, 429/503), "
+    "'memory' (a NEW session hit the session-table fence, 503 — "
+    "established sessions are never refused here), and 'quarantined' "
+    "(a peer/source serving repeated malformed frames, 429). Every "
+    "shed carries Retry-After; publishers defer and re-diff (see "
+    "kts_delta_shed_honored_total), so a steady rate here is load "
+    "shaping, not data loss — alert when it stays high "
+    "(IngestShedHigh).",
+    extra_labels=("reason",),
+)
+INGEST_QUARANTINED = MetricSpec(
+    "kts_ingest_quarantined",
+    MetricType.GAUGE,
+    "Peers/sources currently quarantined by the malformed-frame "
+    "breaker: their frames answer 429 before any decode work until the "
+    "quarantine window passes, then one probe frame decides. Nonzero "
+    "means someone is POSTing garbage at /ingest/delta — the "
+    "ingest_quarantine journal event (/debug/events) names the key.",
+)
+HUB_WARM_RESTART_SESSIONS = MetricSpec(
+    "kts_hub_warm_restart_sessions",
+    MetricType.GAUGE,
+    "Push sessions this hub restored from its ingest checkpoint after "
+    "a restart (seq chains resumed without a 409/FULL resync). "
+    "Compare with kts_hub_resync_total right after a restart: warm "
+    "sessions resume for free, only the checkpoint-to-crash tail pays "
+    "a FULL.",
+)
+HUB_WARM_RESTART_PENDING = MetricSpec(
+    "kts_hub_warm_restart_pending",
+    MetricType.GAUGE,
+    "Checkpointed sessions still waiting for warm-restart replay. "
+    "/readyz holds NotReady while this is nonzero (scrapers drain to "
+    "fully-resumed hubs); stuck above 0 means the replay thread died "
+    "or the checkpoint names sources that never pushed again.",
+)
+HUB_WARM_RESTART_REPLAY_SECONDS = MetricSpec(
+    "kts_hub_warm_restart_replay_seconds",
+    MetricType.GAUGE,
+    "Wall time the last warm-restart replay took from checkpoint load "
+    "to the final session restored (background sweep + on-demand "
+    "replays together). The recovery-time half of the chaos-sim pin.",
+)
+HUB_WARM_RESTART_CHECKPOINT_WRITES = MetricSpec(
+    "kts_hub_warm_restart_checkpoint_writes_total",
+    MetricType.COUNTER,
+    "Ingest checkpoint writes (.wal + fsync + atomic rename, the "
+    "energy.py WAL discipline) since the hub started. Flat while "
+    "frames flow means checkpointing is failing — the next restart "
+    "will be a cold 409 stampede, alert on it.",
+)
+HUB_WARM_RESTART_CHECKPOINT_AGE = MetricSpec(
+    "kts_hub_warm_restart_checkpoint_age_seconds",
+    MetricType.GAUGE,
+    "Seconds since the last successful ingest checkpoint write. "
+    "Bounded by the checkpoint interval on a healthy hub; its value "
+    "at crash time is exactly the session tail that will pay a FULL "
+    "resync on the next start.",
+)
+
 # Fleet-lens families (fleetlens.py, driven from the hub refresh):
 # cross-node anomaly detection, slow-node attribution, SLO burn windows.
 
@@ -687,6 +756,13 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     INGEST_LANE_FRAMES,
     INGEST_LANE_APPLY_SECONDS,
     INGEST_NATIVE,
+    INGEST_SHED,
+    INGEST_QUARANTINED,
+    HUB_WARM_RESTART_SESSIONS,
+    HUB_WARM_RESTART_PENDING,
+    HUB_WARM_RESTART_REPLAY_SECONDS,
+    HUB_WARM_RESTART_CHECKPOINT_WRITES,
+    HUB_WARM_RESTART_CHECKPOINT_AGE,
     FLEET_TARGETS_ANOMALOUS,
     FLEET_ANOMALIES,
     FLEET_SLO_BURN,
@@ -1135,6 +1211,29 @@ SELF_PUSH_DROPPED = MetricSpec(
     "spec: 4xx other than 429 means the payload, not the network).",
     extra_labels=("mode",),
 )
+DELTA_SHED_HONORED = MetricSpec(
+    "kts_delta_shed_honored_total",
+    MetricType.COUNTER,
+    "Delta-push frames the hub refused at admission (429/503 + "
+    "Retry-After) that this publisher honored: the push was deferred a "
+    "decorrelated-jitter spread of the hub's hint and the next frame "
+    "re-diffed against the acked state — NOT promoted to a FULL (that "
+    "would amplify the load being shed) and NOT counted as a push "
+    "failure (the hub is healthy, it is shaping load). A sustained "
+    "rate across the fleet means the hub's admission knobs are too "
+    "tight for the fleet's cadence (ISSUE 12).",
+    extra_labels=("mode",),
+)
+RENDER_PREWARM_WAIT = MetricSpec(
+    "kts_render_prewarm_wait_seconds_total",
+    MetricType.COUNTER,
+    "Cumulative seconds readers spent waiting to ACQUIRE the publish "
+    "lock inside Registry.rendered() — scrapes queueing behind "
+    "publishes or the render pre-warmer. ~0 on a healthy process; "
+    "growth is the first suspect for scrape-p99 creep (the r07→r09 "
+    "watch item), also surfaced in /debug/ticks meta so a post-mortem "
+    "needs no profiler.",
+)
 
 # Resilience self-metrics (resilience.py / supervisor.py): the unified
 # failure policy must self-report, or fleet dashboards silently lie
@@ -1238,6 +1337,8 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_PUSH_TOTAL,
     SELF_PUSH_FAILURES,
     SELF_PUSH_DROPPED,
+    DELTA_SHED_HONORED,
+    RENDER_PREWARM_WAIT,
     BREAKER_STATE,
     BREAKER_TRIPS,
     COMPONENT_RESTARTS,

@@ -371,6 +371,24 @@ def _check_monotone(before: str, after: str, specs) -> Iterable[str]:
     return problems
 
 
+def retry_after_seconds(headers, default: float = 1.0,
+                        cap: float = 300.0) -> float:
+    """Seconds from a response's ``Retry-After`` header (delta-ingest
+    shed responses, scrape-storm 503s). Only the delta-seconds form is
+    parsed — an HTTP-date (the other RFC 9110 form) or garbage falls
+    back to ``default`` rather than raising: a hostile or buggy server
+    must not crash the publisher, and ``cap`` bounds how long one bad
+    header can silence a push loop."""
+    raw = headers.get("Retry-After", "") if headers is not None else ""
+    try:
+        seconds = float(raw)
+    except (TypeError, ValueError):
+        return default
+    if not (seconds >= 0.0):  # NaN falls through to the default too
+        return default
+    return min(seconds, cap)
+
+
 def auth_headers(bearer_token_file: str = "", username: str = "",
                  password_file: str = "") -> dict:
     """Authorization header from file-backed credentials, re-read per

@@ -1,0 +1,50 @@
+"""Bounded retry for known box-noise flakes (ISSUE 12 satellite).
+
+A handful of end-to-end tests (soak, multihost) drive real sockets,
+real thread fleets, and wall-clock pacing; on a loaded CI box they fail
+~1/10 runs on scheduling noise, not code. Those failures drown real
+regressions from the chaos/robustness suites in rerun noise, so the
+known-noisy tests get EXACTLY ONE retry — marked loudly in the test
+log, so a test that starts failing twice in a row (a real regression)
+still fails the suite, and a rising retry rate is itself visible
+evidence.
+
+Deliberately not a plugin dependency (the image is frozen) and
+deliberately narrow: apply it only to tests whose flake is understood
+and box-noise-shaped. A retry on a deterministic test is a bug
+sponge — don't."""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def retry_once_on_box_noise(test):
+    """Re-run the test once if its first run raises AssertionError or
+    OSError (the box-noise shapes: timing assertions and transient
+    socket failures). Anything else — and a second failure — propagates
+    unchanged."""
+
+    @functools.wraps(test)
+    def wrapper(*args, **kwargs):
+        try:
+            return test(*args, **kwargs)
+        except (AssertionError, OSError) as exc:
+            log.warning(
+                "box-noise retry: %s failed once (%s: %s); retrying "
+                "exactly once", test.__name__, type(exc).__name__, exc)
+            if "tmp_path" in kwargs:
+                # The retry gets a FRESH directory: the first attempt
+                # already built fixture trees (make_sysfs mkdirs
+                # without exist_ok), and re-running into the same
+                # tmp_path would fail deterministically with
+                # FileExistsError — masking the flake being retried.
+                retry_dir = kwargs["tmp_path"] / "box-noise-retry"
+                retry_dir.mkdir()
+                kwargs = {**kwargs, "tmp_path": retry_dir}
+            return test(*args, **kwargs)
+
+    return wrapper

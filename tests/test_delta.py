@@ -161,7 +161,7 @@ def _feed(hub: Hub, encoder: delta.DeltaEncoder, body: str,
     if not deliver:
         encoder.nack()
         return 0, b""
-    code, resp = hub.delta.handle(wire)
+    code, resp, _hdrs = hub.delta.handle(wire)
     if code == 200:
         encoder.ack()
     else:
@@ -180,7 +180,7 @@ def test_ingest_seq_gap_duplicate_and_reorder_force_resync():
         assert hub.delta.handle(wire2)[0] == 200
         encoder.ack()
         # Duplicate delivery of the same frame: seq already consumed.
-        code, resp = hub.delta.handle(wire2)
+        code, resp, _hdrs = hub.delta.handle(wire2)
         assert code == 409 and b"seq gap" in resp
         # A frame from the future (seq gap; simulates a dropped frame).
         future = delta.encode_delta("w0", 5, 99, [(0, 1.0)])
@@ -407,7 +407,7 @@ def test_resync_storm_concurrent_fulls_no_drops_no_healthy_evictions():
 
         def fire(wires) -> None:
             for wire in wires:
-                code, resp = hub.delta.handle(wire)
+                code, resp, _hdrs = hub.delta.handle(wire)
                 if code != 200:
                     failures.append((code, resp))
 
@@ -638,6 +638,120 @@ def test_publisher_end_to_end_with_resync_recovery():
         server.stop()
 
 
+def _shed_hub_and_server():
+    """Push-only hub whose DELTA bucket is effectively empty (FULLs
+    still sail through — rate shedding never touches them), fronted by
+    a real MetricsServer so the 429 + Retry-After rides real HTTP."""
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    hub = Hub([], targets_provider=lambda: [], interval=10.0,
+              push_fence=1e9, ingest_lanes=1, ingest_delta_rate=1e-6)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    return hub, server
+
+
+def _worker_registry():
+    worker = Registry()
+
+    def publish(duty: float) -> None:
+        builder = SnapshotBuilder()
+        labels = (("accel_type", "tpu-v5p"), ("chip", "0"),
+                  ("device_path", "/dev/accel0"), ("uuid", ""))
+        builder.add(schema.DEVICE_UP, 1.0, labels)
+        builder.add(schema.DUTY_CYCLE, duty, labels)
+        worker.publish(builder.build())
+
+    return worker, publish
+
+
+def test_publisher_honors_shed_as_its_own_retry_class():
+    """ISSUE 12 satellite: a 429/503 + Retry-After is neither a
+    failure (no backoff-interval scaling, no supervisor alarm) nor a
+    resync (no FULL promotion — under shed that would AMPLIFY load).
+    The publisher defers, then the next push re-diffs as a DELTA."""
+    worker, publish = _worker_registry()
+    publish(10.0)
+    hub, server = _shed_hub_and_server()
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-a",
+        rng=random.Random(7))
+    try:
+        publisher.push_once()  # session FULL: never rate-shed
+        assert publisher.pushes_total == 1
+        publish(20.0)
+        publisher.push_once()  # DELTA: the empty bucket sheds it
+        assert publisher.shed_honored_total == 1
+        assert publisher.pushes_total == 1
+        assert publisher.failures_total == 0
+        assert publisher.resyncs_total == 0
+        assert publisher.consecutive_failures == 0
+        assert publisher._shed_until > time.monotonic()
+        # While deferring, push_once is a no-op: no render, no POST.
+        frames_before = hub.delta.stats()["delta_frames"]
+        publish(30.0)
+        publisher.push_once()
+        assert hub.delta.stats()["delta_frames"] == frames_before
+        assert publisher.shed_honored_total == 1
+        # Pressure lifts (bucket removed) + the deferral window passes:
+        # the next frame is a DELTA off the still-valid acked state —
+        # never a FULL — and the seq chain continues unbroken.
+        for lane in hub.delta._lanes:
+            lane.bucket = None
+        publisher._shed_until = 0.0
+        publisher.push_once()
+        assert publisher.pushes_total == 2
+        assert publisher.last_frame_kind == delta.KIND_DELTA
+        assert hub.delta.resyncs_total == 0
+        hub.refresh_once()
+        line = next(l for l in hub.registry.snapshot().render().splitlines()
+                    if l.startswith("accelerator_duty_cycle"))
+        assert line.endswith(" 30"), line
+    finally:
+        publisher.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_publisher_shed_backoff_spreads_with_decorrelated_jitter():
+    """ISSUE 12 satellite pin: 8 publishers shed by one hub must NOT
+    re-arrive in lockstep — each defers a decorrelated-jitter draw
+    from [Retry-After, 3x] (the AWS recipe re-based on the hub's
+    hint), so the spread across seeds is wide and deterministic."""
+    worker, publish = _worker_registry()
+    publish(10.0)
+    hub, server = _shed_hub_and_server()
+    publishers = [
+        delta.DeltaPublisher(
+            worker, f"http://127.0.0.1:{server.port}",
+            source=f"node-{i}", rng=random.Random(i))
+        for i in range(8)
+    ]
+    try:
+        for publisher in publishers:
+            publisher.push_once()
+            assert publisher.pushes_total == 1
+        publish(20.0)
+        now = time.monotonic()
+        delays = []
+        for publisher in publishers:
+            publisher.push_once()
+            assert publisher.shed_honored_total == 1
+            delays.append(publisher._shed_until - now)
+        # The hub's hint is capped at 300s by retry_after_seconds (the
+        # empty bucket quotes an absurd horizon); the first decorrelated
+        # draw is uniform(base, 3*base) = [300, 900).
+        assert all(299.0 < d < 901.0 for d in delays), delays
+        assert max(delays) - min(delays) > 30.0, delays  # no lockstep
+        assert len({round(d, 1) for d in delays}) == len(delays), delays
+    finally:
+        for publisher in publishers:
+            publisher.stop()
+        server.stop()
+        hub.stop()
+
+
 # --- the differential pin ---------------------------------------------------
 
 _EXCLUDED_FAMILIES = (
@@ -714,7 +828,7 @@ def test_differential_delta_vs_pull_oracle_under_churn(tmp_path):
                     # Duplicate delivery: second copy must 409 without
                     # corrupting state; encoder recovers via FULL.
                     wire, _ = encoders[i].encode_next(body(i))
-                    code, _resp = push.delta.handle(wire)
+                    code, _resp, _hdrs = push.delta.handle(wire)
                     if code == 200:
                         encoders[i].ack()
                         assert push.delta.handle(wire)[0] == 409

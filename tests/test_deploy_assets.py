@@ -279,8 +279,18 @@ def test_hub_manifest_shape():
     targets_path = container["args"][targets_idx + 1]
     mount = container["volumeMounts"][0]
     assert targets_path.startswith(mount["mountPath"])
-    (volume,) = pod["spec"]["volumes"]
-    assert volume["configMap"]["name"] == by_kind["ConfigMap"]["metadata"]["name"]
+    volumes = {v["name"]: v for v in pod["spec"]["volumes"]}
+    mounts = {m["name"]: m for m in container["volumeMounts"]}
+    assert set(volumes) == set(mounts) == {"targets", "state"}
+    assert volumes["targets"]["configMap"]["name"] == \
+        by_kind["ConfigMap"]["metadata"]["name"]
+    # Warm-restart state (ISSUE 12): the checkpoint path must land on
+    # the writable emptyDir, which survives container restarts — the
+    # liveness-probe case the checkpoint exists for.
+    assert "emptyDir" in volumes["state"]
+    ckpt_idx = container["args"].index("--ingest-checkpoint")
+    assert container["args"][ckpt_idx + 1].startswith(
+        mounts["state"]["mountPath"])
     filename = targets_path[len(mount["mountPath"]):].lstrip("/")
     assert filename in by_kind["ConfigMap"]["data"]
     assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"

@@ -1,0 +1,59 @@
+"""The box-noise retry helper (tests/flake.py): exactly one retry, on
+the noise-shaped exception classes only, with a fresh tmp_path so
+fixture trees built by the first attempt don't fail the retry."""
+
+import pytest
+from flake import retry_once_on_box_noise
+
+
+def test_retries_exactly_once_and_passes():
+    calls = []
+
+    @retry_once_on_box_noise
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise AssertionError("box noise")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert len(calls) == 2
+
+
+def test_second_failure_propagates():
+    @retry_once_on_box_noise
+    def broken():
+        raise AssertionError("real regression")
+
+    with pytest.raises(AssertionError, match="real regression"):
+        broken()
+    # ...and non-noise exception classes never retry at all.
+    calls = []
+
+    @retry_once_on_box_noise
+    def buggy():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        buggy()
+    assert len(calls) == 1
+
+
+def test_retry_gets_a_fresh_tmp_path(tmp_path):
+    """Review fix: the first attempt builds fixture trees (make_sysfs
+    mkdirs without exist_ok); re-running into the same directory would
+    fail deterministically and mask the flake being retried."""
+    seen = []
+
+    @retry_once_on_box_noise
+    def builds_a_tree(tmp_path):
+        seen.append(tmp_path)
+        (tmp_path / "sys").mkdir()  # FileExistsError on a reused dir
+        if len(seen) == 1:
+            raise AssertionError("box noise")
+
+    builds_a_tree(tmp_path=tmp_path)
+    assert len(seen) == 2
+    assert seen[0] != seen[1]
+    assert seen[1] == tmp_path / "box-noise-retry"

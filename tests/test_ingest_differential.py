@@ -48,7 +48,7 @@ def _feed_both(hubs, encoders, body: str) -> None:
     the same change-sets."""
     for hub, encoder in zip(hubs, encoders):
         wire, _kind = encoder.encode_next(body)
-        code, _resp = hub.delta.handle(wire)
+        code, _resp, _hdrs = hub.delta.handle(wire)
         if code == 200:
             encoder.ack()
         else:
@@ -116,10 +116,10 @@ def test_native_apply_matches_python_oracle_under_randomized_churn():
                     # always replaces the session wholesale).
                     for hub, enc in zip(hubs, encoders):
                         wire, kind = enc[i].encode_next(body(i))
-                        code, _resp = hub.delta.handle(wire)
+                        code, _resp, _hdrs = hub.delta.handle(wire)
                         if code == 200:
                             enc[i].ack()
-                            dup_code, _resp = hub.delta.handle(wire)
+                            dup_code, _resp, _hdrs = hub.delta.handle(wire)
                             assert dup_code == (
                                 200 if kind == delta.KIND_FULL else 409)
                         else:

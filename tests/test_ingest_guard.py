@@ -1,0 +1,549 @@
+"""Ingest survival layer (ISSUE 12): admission control (token bucket,
+in-flight budget, memory fence, shed priority), hostile-pusher
+quarantine, and the warm-restart checkpoint/replay — including the
+churn/restart races the satellites call out."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from kube_gpu_stats_tpu import delta
+from kube_gpu_stats_tpu.bench import build_pusher_body
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.resilience import TokenBucket
+from kube_gpu_stats_tpu.validate import parse_exposition_interned
+
+
+def make_hub(**kwargs):
+    return Hub([], targets_provider=lambda: [], interval=10.0,
+               push_fence=1e9, **kwargs)
+
+
+def churn_slots_of(body: str) -> list[int]:
+    probe = parse_exposition_interned(body)
+    by_name = {name: slot for slot, (name, _l, _v) in enumerate(probe)}
+    return sorted((by_name["accelerator_duty_cycle"],
+                   by_name["accelerator_power_watts"]))
+
+
+def seed(hub, n: int, prefix: str = "node"):
+    sources = [f"http://{prefix}-{i:03d}:9400/metrics" for i in range(n)]
+    bodies = [build_pusher_body(i) for i in range(n)]
+    for i, source in enumerate(sources):
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full(source, i + 1, 1, bodies[i]))
+        assert code == 200, code
+    return sources, bodies
+
+
+# --- TokenBucket (resilience.py) ---------------------------------------------
+
+def test_token_bucket_rate_and_retry_after():
+    clock = [0.0]
+    bucket = TokenBucket(rate=10.0, burst=2.0, clock=lambda: clock[0])
+    assert bucket.try_take()
+    assert bucket.try_take()
+    assert not bucket.try_take()  # burst drained, no time passed
+    # Retry-After names the refill horizon: one token at 10/s = 0.1s.
+    assert 0.0 < bucket.retry_after() <= 0.1
+    clock[0] += 0.1
+    assert bucket.try_take()
+    # Refill never exceeds the burst ceiling.
+    clock[0] += 100.0
+    assert bucket.try_take()
+    assert bucket.try_take()
+    assert not bucket.try_take()
+
+
+# --- admission: rate, in-flight, memory fence --------------------------------
+
+def test_delta_rate_sheds_deltas_never_fulls():
+    hub = make_hub(ingest_lanes=1, ingest_delta_rate=1e-6)
+    try:
+        sources, bodies = seed(hub, 2)
+        slots = churn_slots_of(bodies[0])
+        # The bucket starts at burst 2e-6: effectively zero tokens, so
+        # the very first DELTA sheds with 429 + Retry-After...
+        code, _resp, hdrs = hub.delta.handle(delta.encode_delta(
+            sources[0], 1, 2, [(slots[0], 51.0)]))
+        assert code == 429, code
+        assert "Retry-After" in hdrs
+        assert hub.delta.shed_total.get("delta_rate") == 1
+        # ...while a recovery FULL for an established session sails
+        # through (shed priority), and the shed session is still alive.
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full(sources[0], 1_000_001, 1, bodies[0]))
+        assert code == 200, code
+        assert len(hub.delta.sources()) == 2
+    finally:
+        hub.stop()
+
+
+def test_inflight_budget_reserves_headroom_for_fulls():
+    # max_inflight=1 -> reserve=1 -> the DELTA admission limit is 0
+    # while FULLs may still use the whole budget: the degenerate
+    # configuration that makes the priority observable synchronously.
+    hub = make_hub(ingest_max_inflight=1)
+    try:
+        sources, bodies = seed(hub, 1)
+        slots = churn_slots_of(bodies[0])
+        code, _resp, hdrs = hub.delta.handle(delta.encode_delta(
+            sources[0], 1, 2, [(slots[0], 51.0)]))
+        assert code == 429, code
+        assert "Retry-After" in hdrs
+        assert hub.delta.shed_total.get("inflight") == 1
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full(sources[0], 1, 2, bodies[0]))
+        assert code == 200, code
+    finally:
+        hub.stop()
+
+
+def test_memory_fence_refuses_only_new_sessions():
+    hub = make_hub(ingest_max_sessions=2)
+    try:
+        sources, bodies = seed(hub, 2)
+        slots = churn_slots_of(bodies[0])
+        # A third, NEW source is refused 503 + Retry-After at the fence
+        # — before any session state is allocated for it.
+        code, _resp, hdrs = hub.delta.handle(
+            delta.encode_full("http://new:9400/metrics", 9, 1, bodies[0]))
+        assert code == 503, code
+        assert "Retry-After" in hdrs
+        assert hub.delta.shed_total.get("memory") == 1
+        assert len(hub.delta.sources()) == 2
+        # Established sessions are never turned away: deltas land, and
+        # a restart (new generation FULL) re-anchors fine at capacity.
+        code, _resp, _hdrs = hub.delta.handle(delta.encode_delta(
+            sources[1], 2, 2, [(slots[0], 51.0)]))
+        assert code == 200, code
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full(sources[0], 1_000_001, 1, bodies[0]))
+        assert code == 200, code
+    finally:
+        hub.stop()
+
+
+# --- quarantine --------------------------------------------------------------
+
+def test_undecodable_flood_quarantines_peer_before_decode():
+    hub = make_hub(ingest_quarantine_threshold=3,
+                   ingest_quarantine_window=60.0)
+    try:
+        for _ in range(3):
+            code, _resp, _hdrs = hub.delta.handle(b"garbage", peer="9.9.9.9")
+            assert code == 400, code
+        code, _resp, hdrs = hub.delta.handle(b"garbage", peer="9.9.9.9")
+        assert code == 429, code
+        assert "Retry-After" in hdrs
+        assert hub.delta.quarantined == 1
+        assert hub.delta.shed_total.get("quarantined") == 1
+        # Even a VALID frame from the quarantined peer is refused at
+        # the door — that's the point: no decode work for that address
+        # until the window passes.
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full("http://ok:9400/metrics", 1, 1,
+                              build_pusher_body(0)), peer="9.9.9.9")
+        assert code == 429, code
+        # A different peer is untouched.
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full("http://ok:9400/metrics", 1, 1,
+                              build_pusher_body(0)), peer="8.8.8.8")
+        assert code == 200, code
+    finally:
+        hub.stop()
+
+
+def test_healthy_traffic_on_shared_ip_resets_the_peer_streak():
+    """NAT safety: pushers behind one address must not be collateral —
+    a clean frame between a bad actor's garbage bursts resets the
+    consecutive-malformed streak, so the shared peer never trips."""
+    hub = make_hub(ingest_quarantine_threshold=3)
+    try:
+        good = delta.encode_full("http://ok:9400/metrics", 1, 1,
+                                 build_pusher_body(0))
+        for round_no in range(4):
+            for _ in range(2):  # threshold - 1 garbage frames
+                code, _resp, _hdrs = hub.delta.handle(b"junk", peer="n.a.t")
+                assert code == 400, code
+            code, _resp, _hdrs = hub.delta.handle(
+                delta.encode_full("http://ok:9400/metrics",
+                                  round_no + 2, 1, build_pusher_body(0)),
+                peer="n.a.t")
+            assert code == 200, code
+        assert hub.delta.quarantined == 0
+        assert good  # the wire stayed valid throughout
+    finally:
+        hub.stop()
+
+
+def test_bad_body_quarantines_source_not_peer():
+    """A frame that DECODES carries a reliable source identity: the
+    breaker keys on it, never on the shared client address (the
+    chaos-sim regression: one bad source must not 429 every healthy
+    pusher on the same IP)."""
+    hub = make_hub(ingest_quarantine_threshold=3,
+                   ingest_quarantine_window=0.2)
+    try:
+        sources, bodies = seed(hub, 1)
+        slots = churn_slots_of(bodies[0])
+        for i in range(3):
+            code, _resp, _hdrs = hub.delta.handle(
+                delta.encode_full("http://evil:9400/metrics", i + 2, 1,
+                                  "{ not an exposition\n"),
+                peer="127.0.0.1")
+            assert code == 400, code
+        code, _resp, hdrs = hub.delta.handle(
+            delta.encode_full("http://evil:9400/metrics", 50, 1,
+                              "{ still not\n"), peer="127.0.0.1")
+        assert code == 429, code
+        # The healthy session on the SAME peer address keeps landing.
+        code, _resp, _hdrs = hub.delta.handle(delta.encode_delta(
+            sources[0], 1, 2, [(slots[0], 51.0)]), peer="127.0.0.1")
+        assert code == 200, code
+        # After the window one probe is admitted; a clean FULL from the
+        # once-evil source closes the quarantine.
+        time.sleep(0.25)
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full("http://evil:9400/metrics", 60, 1,
+                              bodies[0]), peer="127.0.0.1")
+        assert code == 200, code
+        assert hub.delta.quarantined == 0
+    finally:
+        hub.stop()
+
+
+# --- warm restart ------------------------------------------------------------
+
+def test_checkpoint_between_full_and_first_delta_replays_consistent_seq(
+        tmp_path):
+    """ISSUE 12 satellite: a checkpoint written between a session's
+    FULL and its first DELTA must replay to the post-FULL seq — the
+    publisher's next DELTA (seq 2) lands, and the values patch onto
+    the replayed entry exactly as they would have on the original."""
+    path = str(tmp_path / "ckpt")
+    hub = make_hub(ingest_checkpoint=path)
+    sources, bodies = seed(hub, 3)
+    slots = churn_slots_of(bodies[0])
+    assert hub.delta.checkpoint(force=True)
+    hub.stop()
+
+    hub2 = make_hub(ingest_checkpoint=path)
+    try:
+        assert hub2.delta.checkpoint_loaded
+        assert hub2.delta.replaying
+        # /readyz holds NotReady on the replay gate (published but
+        # still replaying), while /healthz liveness is untouched.
+        from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+        hub2.registry.publish(SnapshotBuilder().build())
+        ok, reason = hub2.ready()
+        assert not ok and "warm restart" in reason
+        # The publisher's first post-restart DELTA replays the session
+        # on demand and applies — no 409, no FULL.
+        code, _resp, _hdrs = hub2.delta.handle(delta.encode_delta(
+            sources[0], 1, 2, [(slots[0], 77.0), (slots[1], 307.0)]))
+        assert code == 200, code
+        assert hub2.delta.resyncs_total == 0
+        # Background sweep restores the quiet sessions too.
+        hub2.delta.start_replay()
+        deadline = time.monotonic() + 5.0
+        while hub2.delta.replaying and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not hub2.delta.replaying
+        assert hub2.delta.warm_restart_sessions == 3
+        hub2.refresh_once()
+        assert hub2._push_served == 3
+        # The on-demand delta's values are live in the merged view.
+        text = hub2.registry.snapshot().render()
+        assert "accelerator_duty_cycle" in text
+        assert " 77" in text
+    finally:
+        hub2.stop()
+
+
+def test_full_after_restart_supersedes_checkpoint(tmp_path):
+    """A publisher that restarted during the hub's own downtime sends a
+    FULL with a new generation: the checkpoint record must be
+    discarded, not replayed over the fresher state."""
+    path = str(tmp_path / "ckpt")
+    hub = make_hub(ingest_checkpoint=path)
+    sources, bodies = seed(hub, 1)
+    assert hub.delta.checkpoint(force=True)
+    hub.stop()
+
+    hub2 = make_hub(ingest_checkpoint=path)
+    try:
+        code, _resp, _hdrs = hub2.delta.handle(
+            delta.encode_full(sources[0], 999, 1, bodies[0]))
+        assert code == 200, code
+        assert not hub2.delta.replaying  # the pending record is gone
+        # The session runs on the NEW generation, not the checkpointed.
+        code, _resp, _hdrs = hub2.delta.handle(delta.encode_delta(
+            sources[0], 999, 2, [(churn_slots_of(bodies[0])[0], 51.0)]))
+        assert code == 200, code
+    finally:
+        hub2.stop()
+
+
+def test_checkpoint_survives_weird_label_values(tmp_path):
+    """The checkpoint serializes entries back to exposition text; label
+    escaping must round-trip (backslash, quote, newline) or a replay
+    would corrupt — or refuse — the session it claims to restore."""
+    from kube_gpu_stats_tpu import schema
+    from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+    builder = SnapshotBuilder()
+    builder.add(schema.DEVICE_UP, 1.0,
+                (("accel_type", 'we"ird\\val\nue'), ("chip", "0"),
+                 ("device_path", "/dev/accel0"), ("uuid", "")))
+    body = builder.build().render()
+    path = str(tmp_path / "ckpt")
+    hub = make_hub(ingest_checkpoint=path)
+    source = "http://weird:9400/metrics"
+    code, _resp, _hdrs = hub.delta.handle(
+        delta.encode_full(source, 1, 1, body))
+    assert code == 200, code
+    assert hub.delta.checkpoint(force=True)
+    hub.stop()
+
+    hub2 = make_hub(ingest_checkpoint=path)
+    try:
+        hub2.delta.start_replay()
+        deadline = time.monotonic() + 5.0
+        while hub2.delta.replaying and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hub2.delta.warm_restart_sessions == 1
+        code, _resp, _hdrs = hub2.delta.handle(delta.encode_delta(
+            source, 1, 2, [(0, 0.0)]))
+        assert code == 200, code
+    finally:
+        hub2.stop()
+
+
+def test_checkpoint_mid_resync_storm_is_consistent(tmp_path):
+    """Satellite race: a checkpoint capture racing a concurrent FULL
+    resync storm must stay internally consistent (each record is
+    captured under its lane lock) and replayable."""
+    path = str(tmp_path / "ckpt")
+    hub = make_hub(ingest_checkpoint=path, ingest_lanes=4)
+    n = 64
+    sources, bodies = seed(hub, n)
+    stop = threading.Event()
+    errors: list = []
+
+    def storm() -> None:
+        gen = 1_000
+        while not stop.is_set():
+            gen += 1
+            for i in range(0, n, 7):
+                code, _resp, _hdrs = hub.delta.handle(
+                    delta.encode_full(sources[i], gen * n + i, 1,
+                                      bodies[i]))
+                if code != 200:
+                    errors.append(code)
+
+    thread = threading.Thread(target=storm)
+    thread.start()
+    try:
+        for _ in range(10):
+            assert hub.delta.checkpoint(force=True)
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    hub.stop()
+    assert not errors
+    state = json.loads((tmp_path / "ckpt").read_text())
+    assert len(state["sessions"]) == n
+    hub2 = make_hub(ingest_checkpoint=path)
+    try:
+        hub2.delta.start_replay()
+        deadline = time.monotonic() + 5.0
+        while hub2.delta.replaying and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hub2.delta.warm_restart_sessions == n
+        hub2.refresh_once()
+        assert hub2._push_served == n
+    finally:
+        hub2.stop()
+
+
+# --- churn races -------------------------------------------------------------
+
+def test_eviction_and_expiry_racing_concurrent_ingest():
+    """Satellite race: lane eviction (target churn) and expiry sweeps
+    (sources()) racing live frame applies must neither crash nor
+    strand a session — an evicted source's next delta draws a clean
+    409 and its FULL re-admits it."""
+    hub = make_hub(ingest_lanes=4)
+    n = 32
+    sources, bodies = seed(hub, n)
+    slots = churn_slots_of(bodies[0])
+    stop = threading.Event()
+    crashes: list = []
+    seqs = [1] * n
+
+    def pusher() -> None:
+        try:
+            while not stop.is_set():
+                for i in range(n):
+                    code, _resp, _hdrs = hub.delta.handle(
+                        delta.encode_delta(
+                            sources[i], i + 1, seqs[i] + 1,
+                            [(slots[0], 50.0 + i)]))
+                    if code == 200:
+                        seqs[i] += 1
+                    elif code == 409:
+                        # Evicted underneath us: re-anchor like a real
+                        # publisher.
+                        code, _resp, _hdrs = hub.delta.handle(
+                            delta.encode_full(sources[i], i + 1,
+                                              seqs[i] + 1, bodies[i]))
+                        if code == 200:
+                            seqs[i] += 1
+                        else:
+                            crashes.append(("full", code))
+                    else:
+                        crashes.append(("delta", code))
+        except Exception as exc:  # noqa: BLE001 - the test's whole point
+            crashes.append(exc)
+
+    threads = [threading.Thread(target=pusher) for _ in range(2)]
+    for thread in threads:
+        thread.start()
+    try:
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            # Churn: evict half the fleet, then everyone, then let the
+            # expiry sweep (sources()) run against live applies.
+            hub.delta.evict(set(sources[: n // 2]))
+            hub.delta.sources()
+            hub.delta.fresh_sources(1e9)
+            hub.delta.evict(set())
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=10)
+    try:
+        assert not crashes, crashes[:5]
+        # The fleet converges back: every source re-admitted via FULL.
+        for i in range(n):
+            code, _resp, _hdrs = hub.delta.handle(
+                delta.encode_full(sources[i], i + 1, seqs[i] + 1,
+                                  bodies[i]))
+            assert code == 200, code
+        assert len(hub.delta.sources()) == n
+    finally:
+        hub.stop()
+
+
+def test_checkpoint_mid_replay_preserves_pending_sessions(tmp_path):
+    """Review fix: a checkpoint written while warm replay is still
+    pending must carry the unreplayed records forward verbatim — a
+    crash-loop (or clean stop) mid-replay must never shrink the fleet
+    to the replayed-so-far fraction."""
+    path = str(tmp_path / "ckpt")
+    hub = make_hub(ingest_checkpoint=path)
+    sources, bodies = seed(hub, 5)
+    slots = churn_slots_of(bodies[0])
+    assert hub.delta.checkpoint(force=True)
+    hub.stop()
+
+    hub2 = make_hub(ingest_checkpoint=path)
+    # NO background replay: only one source replays (on demand), then
+    # the hub checkpoints and dies — the other four are still pending.
+    code, _resp, _hdrs = hub2.delta.handle(delta.encode_delta(
+        sources[0], 1, 2, [(slots[0], 60.0)]))
+    assert code == 200, code
+    assert hub2.delta.warm_restart_pending == 4
+    assert hub2.delta.checkpoint(force=True)
+    state = json.loads((tmp_path / "ckpt").read_text())
+    assert {record[0] for record in state["sessions"]} == set(sources)
+    hub2.stop()
+
+    hub3 = make_hub(ingest_checkpoint=path)
+    try:
+        hub3.delta.start_replay()
+        deadline = time.monotonic() + 5.0
+        while hub3.delta.replaying and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hub3.delta.warm_restart_sessions == 5
+        # The on-demand-replayed source resumes at its ADVANCED seq
+        # (2, not the original checkpoint's 1)...
+        code, _resp, _hdrs = hub3.delta.handle(delta.encode_delta(
+            sources[0], 1, 3, [(slots[0], 61.0)]))
+        assert code == 200, code
+        # ...and a carried-forward pending source at its original seq.
+        code, _resp, _hdrs = hub3.delta.handle(delta.encode_delta(
+            sources[3], 4, 2, [(slots[0], 62.0)]))
+        assert code == 200, code
+    finally:
+        hub3.stop()
+
+
+def test_checkpoint_epoch_outranks_previous_lives(tmp_path):
+    """Review fix: the WAL-vs-main 'newest wins' rule compares a
+    PERSISTED monotone epoch, re-seeded on load — a fresh process's
+    first write must out-rank a long-lived previous incarnation's
+    main file, or a crash between fsync and rename would resurrect
+    the stale state over the newer fsynced one."""
+    path = str(tmp_path / "ckpt")
+    hub = make_hub(ingest_checkpoint=path)
+    sources, bodies = seed(hub, 2)
+    for _ in range(5):  # a long first life: epoch climbs to 5
+        assert hub.delta.checkpoint(force=True)
+    hub.stop()  # forced final write: epoch 6
+    first_life = json.loads((tmp_path / "ckpt").read_text())
+
+    hub2 = make_hub(ingest_checkpoint=path)
+    assert hub2.delta.checkpoint(force=True)
+    hub2_state = json.loads((tmp_path / "ckpt").read_text())
+    assert hub2_state["seq"] > first_life["seq"]
+    hub2.stop()
+
+    # Simulated crash between fsync and rename: the second life's
+    # newest state stranded in the .wal behind the first life's main.
+    (tmp_path / "ckpt.wal").write_text(json.dumps(hub2_state))
+    (tmp_path / "ckpt").write_text(json.dumps(first_life))
+    hub3 = make_hub(ingest_checkpoint=path)
+    try:
+        # The .wal wins on epoch, not on a per-process frame counter.
+        assert hub3.delta.checkpoint_loaded
+        assert hub3.delta._ckpt_seq == hub2_state["seq"]
+    finally:
+        hub3.stop()
+
+
+def test_quarantine_eviction_never_drops_live_quarantines(monkeypatch):
+    """Review fix: at the quarantine-table cap, room is made only from
+    CLOSED breakers — a flood rotating >cap source names must not push
+    a real (OPEN) offender back into full parse work, and the rotating
+    names themselves never accumulate enough streak to trip."""
+    monkeypatch.setattr(delta.DeltaIngest, "MAX_QUARANTINE_KEYS", 4)
+    hub = make_hub(ingest_quarantine_threshold=3)
+    try:
+        # A real offender trips OPEN.
+        for i in range(3):
+            code, _resp, _hdrs = hub.delta.handle(
+                delta.encode_full("http://evil:9400/metrics", i + 2, 1,
+                                  "{ bad\n"))
+            assert code == 400, code
+        assert hub.delta.quarantined == 1
+        # A rotating flood far past the cap: closed trackers churn,
+        # the OPEN offender survives, the table stays bounded.
+        for i in range(20):
+            code, _resp, _hdrs = hub.delta.handle(
+                delta.encode_full(f"http://rot-{i}:9400/metrics", 9, 1,
+                                  "{ bad\n"))
+            assert code == 400, code
+        assert len(hub.delta._quarantine) <= 4
+        assert hub.delta.quarantined == 1
+        code, _resp, _hdrs = hub.delta.handle(
+            delta.encode_full("http://evil:9400/metrics", 99, 1,
+                              "{ bad\n"))
+        assert code == 429, code  # still quarantined, not evicted
+    finally:
+        hub.stop()

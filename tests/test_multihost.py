@@ -12,6 +12,7 @@ import time
 import urllib.request
 
 import pytest
+from flake import retry_once_on_box_noise
 
 from kube_gpu_stats_tpu.collectors.composite import TpuCollector
 from kube_gpu_stats_tpu.collectors.libtpu import LibtpuClient
@@ -125,6 +126,11 @@ def test_multihost_real_stack_http(tmp_path):
             s.stop()
 
 
+# Known ~1/10 box-noise flake (ISSUE 12 satellite): the concurrent
+# 64-stack budget assertion scales with CPU oversubscription but a
+# co-tenant burst can still blow the scaled bound. One marked retry;
+# a real regression fails twice and still fails the suite.
+@retry_once_on_box_noise
 def test_v5p_256_slice_real_stack_concurrent(tmp_path):
     """Round-1 verdict item 6 (done round 3): the 256-chip union claim at
     REAL stack depth — 64 exporter instances (real gRPC fake-libtpu
@@ -236,6 +242,9 @@ def test_v5p_256_slice_real_stack_concurrent(tmp_path):
             libtpu.stop()
 
 
+# Same box-noise class: 64 real HTTP servers + a deadlined hub fetch
+# wave occasionally trip the refresh deadline on a starved box.
+@retry_once_on_box_noise
 def test_hub_aggregates_64_real_http_exporters():
     """The hub at slice width over REAL HTTP (the deterministic
     file-target variant lives in test_hub): 64 in-process exporter

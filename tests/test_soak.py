@@ -8,6 +8,7 @@ import time
 import urllib.request
 
 import pytest
+from flake import retry_once_on_box_noise
 
 from kube_gpu_stats_tpu.config import Config
 from kube_gpu_stats_tpu.daemon import Daemon
@@ -46,6 +47,12 @@ class FlakyReceiver(http.server.ThreadingHTTPServer):
         threading.Thread(target=self.serve_forever, daemon=True).start()
 
 
+# Known ~1/10 box-noise flake (ISSUE 12 satellite): the soak's pacing
+# assertions ride real wall-clock sleeps under real scrape load, and a
+# loaded CI box occasionally starves a sender past its window. One
+# marked retry bounds the noise so chaos/robustness-suite failures stay
+# visible; two failures in a row still fail the suite.
+@retry_once_on_box_noise
 def test_soak_flapping_backend(tmp_path):
     make_sysfs(tmp_path / "sys", num_chips=4)
     server = FakeLibtpuServer(num_chips=4).start()

@@ -354,3 +354,22 @@ def test_stale_label_allowed_on_gauges_only():
     bad_up = f'accelerator_up{{{base},stale="true"}} 0\n'
     problems = validate.check(bad_up)
     assert problems and "stale" in problems[0]
+
+
+def test_retry_after_seconds_parses_and_bounds():
+    """Shed responses carry Retry-After (ISSUE 12); the parser takes
+    only the delta-seconds form, never raises, and caps how long one
+    bad header can silence a publisher."""
+    from kube_gpu_stats_tpu.validate import retry_after_seconds
+
+    assert retry_after_seconds({"Retry-After": "2.5"}) == 2.5
+    assert retry_after_seconds({"Retry-After": "0"}) == 0.0
+    assert retry_after_seconds({}) == 1.0
+    assert retry_after_seconds(None, default=3.0) == 3.0
+    # HTTP-date form, garbage, negatives, NaN: the default, not a crash.
+    assert retry_after_seconds(
+        {"Retry-After": "Wed, 21 Oct 2015 07:28:00 GMT"}) == 1.0
+    assert retry_after_seconds({"Retry-After": "-5"}) == 1.0
+    assert retry_after_seconds({"Retry-After": "nan"}) == 1.0
+    # One hostile header cannot demand an hour of silence.
+    assert retry_after_seconds({"Retry-After": "99999"}) == 300.0

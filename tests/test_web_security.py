@@ -537,3 +537,109 @@ def test_rejected_scrapes_surface_as_self_metric():
     (series,) = [s for s in builder.build().series
                  if s.spec.name == schema.SELF_SCRAPES_REJECTED.name]
     assert series.value == 2.0
+
+
+# --- ingest hardening (ISSUE 12): slow-loris + Content-Length fences --------
+
+def _ingest_server(read_deadline: float = 0.5):
+    """Server with a live ingest provider and a tight body-read
+    deadline (the slow-loris fence under test)."""
+    from kube_gpu_stats_tpu.hub import Hub
+
+    hub = Hub([], targets_provider=lambda: [], interval=10.0,
+              push_fence=1e9)
+    srv = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                        ingest_provider=hub.delta.handle,
+                        ingest_read_deadline=read_deadline)
+    srv.start()
+    return hub, srv
+
+
+def test_slow_loris_post_body_cut_off_with_408():
+    """A POST that declares a body and dribbles 2 bytes must be cut at
+    the read deadline with 408 + connection close — not hold its
+    handler thread for the default (infinite) socket timeout."""
+    import socket
+    import time
+
+    hub, srv = _ingest_server(read_deadline=0.5)
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10)
+        start = time.monotonic()
+        sock.sendall(b"POST /ingest/delta HTTP/1.1\r\n"
+                     b"Host: t\r\n"
+                     b"Content-Type: application/x-kts-delta\r\n"
+                     b"Content-Length: 5000\r\n\r\nab")
+        sock.settimeout(10)
+        answer = sock.recv(256)
+        took = time.monotonic() - start
+        sock.close()
+        assert b"408" in answer, answer
+        assert took < 5.0, took  # the deadline fired, not TCP teardown
+        # The server is fully live afterwards: a real frame lands.
+        from kube_gpu_stats_tpu import delta as delta_mod
+        from kube_gpu_stats_tpu.bench import build_pusher_body
+
+        wire = delta_mod.encode_full("http://ok:9400/metrics", 1, 1,
+                                     build_pusher_body(0))
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/ingest/delta", data=wire,
+            method="POST",
+            headers={"Content-Type": delta_mod.CONTENT_TYPE})
+        assert urllib.request.urlopen(request, timeout=5).status == 200
+    finally:
+        srv.stop()
+        hub.stop()
+
+
+def test_content_length_fence_refuses_before_reading():
+    """Missing, garbage, zero, and absurd Content-Length all answer
+    413 without the server ever reading a body byte."""
+    import http.client
+
+    hub, srv = _ingest_server()
+    try:
+        for headers in ({},
+                        {"Content-Length": "banana"},
+                        {"Content-Length": "0"},
+                        {"Content-Length": str(65 * 1024 * 1024)}):
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=5)
+            try:
+                conn.putrequest("POST", "/ingest/delta")
+                conn.putheader("Content-Type",
+                               "application/x-kts-delta")
+                for key, value in headers.items():
+                    conn.putheader(key, value)
+                conn.endheaders()
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 413, (headers, resp.status)
+            finally:
+                conn.close()
+    finally:
+        srv.stop()
+        hub.stop()
+
+
+def test_truncated_post_body_is_400_not_a_stuck_thread():
+    """A peer that closes mid-body yields a clean 400 (short read), not
+    an exception-killed connection thread."""
+    import socket
+
+    hub, srv = _ingest_server(read_deadline=0.5)
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port),
+                                        timeout=10)
+        sock.sendall(b"POST /ingest/delta HTTP/1.1\r\n"
+                     b"Host: t\r\n"
+                     b"Content-Length: 500\r\n\r\nshort")
+        sock.shutdown(socket.SHUT_WR)
+        sock.settimeout(10)
+        answer = sock.recv(256)
+        sock.close()
+        assert b"400" in answer, answer
+    finally:
+        srv.stop()
+        hub.stop()

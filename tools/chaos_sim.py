@@ -1,0 +1,541 @@
+#!/usr/bin/env python
+"""Fleet chaos smoke (ISSUE 12, `make chaos-sim`): the root hub's
+survival layer driven end to end over real HTTP — real daemons pushing
+deltas through real DeltaPublishers into real MetricsServer-fronted
+hubs — with the failures injected that production actually serves:
+
+- **Hub kill + warm restart**: a checkpointing root hub with 2 real
+  daemons + N synthesized sessions is killed at its last WAL state and
+  restarted on the same port. The fleet must warm-resume: >= 95% of
+  sessions continue their delta chains with NO FULL resync (only the
+  checkpoint-to-kill tail — here the live daemons that pushed past the
+  last write — pays one), zero sessions dropped, /readyz gating on the
+  replay, recovery inside one refresh interval.
+- **Publisher stampede**: an admission-controlled hub takes a
+  multiples-over-budget delta blast from concurrent threads. It must
+  shed with 429 + Retry-After (never 5xx, never a crash), refuse no
+  recovery FULL mid-storm, keep every established session alive and
+  served, and hold the new-session memory fence closed at capacity.
+- **Slow-loris**: sockets that send POST headers then dribble the body
+  are cut off with 408 at the read deadline while healthy pushers keep
+  landing deltas with bounded latency beside them.
+- **Corrupt-frame flood**: one source POSTing repeated malformed
+  bodies is quarantined (429 before decode work, journal event names
+  it, kts_ingest_quarantined rises) while healthy pushers on the same
+  client IP are untouched (mixed traffic from one NAT must never be
+  collateral).
+
+Exit 0 with a PASS line, else 1 with evidence. Wired into `make ci`;
+the recovery-time and shed-fairness numbers are CI-pinned separately in
+tests/test_latency.py (bench.measure_warm_restart /
+measure_overload_shed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import pathlib
+import socket
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def post_frame(port: int, wire: bytes, timeout: float = 10.0):
+    """(status, retry-after header or None) for one delta-frame POST
+    on a fresh connection."""
+    from kube_gpu_stats_tpu.delta import CONTENT_TYPE, INGEST_PATH
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", INGEST_PATH, body=wire,
+                     headers={"Content-Type": CONTENT_TYPE})
+        resp = conn.getresponse()
+        resp.read()
+        return resp.status, resp.getheader("Retry-After")
+    finally:
+        conn.close()
+
+
+class SessionFleet:
+    """N synthesized delta sessions speaking real HTTP over persistent
+    connections (one conn per drain thread) — the 10k-pusher shape at
+    a CI-sized N."""
+
+    def __init__(self, port: int, count: int, prefix: str = "node"):
+        from kube_gpu_stats_tpu.bench import build_pusher_body
+        from kube_gpu_stats_tpu.validate import parse_exposition_interned
+
+        self.port = port
+        self.sources = [f"http://{prefix}-{i:04d}:9400/metrics"
+                        for i in range(count)]
+        self.bodies = [build_pusher_body(i) for i in range(count)]
+        self.gens = [i + 1 for i in range(count)]
+        self.seqs = [0] * count
+        probe = parse_exposition_interned(self.bodies[0])
+        by_name = {name: slot for slot, (name, _l, _v) in enumerate(probe)}
+        self.churn_slots = sorted((by_name["accelerator_duty_cycle"],
+                                   by_name["accelerator_power_watts"]))
+
+    def _drain(self, wires_with_index, outcomes, threads: int = 6) -> None:
+        import threading
+
+        from kube_gpu_stats_tpu.delta import CONTENT_TYPE, INGEST_PATH
+
+        def worker(chunk) -> None:
+            conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                              timeout=15)
+            try:
+                for index, wire in chunk:
+                    conn.request("POST", INGEST_PATH, body=wire,
+                                 headers={"Content-Type": CONTENT_TYPE})
+                    resp = conn.getresponse()
+                    resp.read()
+                    outcomes.append(
+                        (index, resp.status, resp.getheader("Retry-After")))
+            finally:
+                conn.close()
+
+        pool = [threading.Thread(target=worker,
+                                 args=(wires_with_index[k::threads],))
+                for k in range(threads)]
+        for thread in pool:
+            thread.start()
+        for thread in pool:
+            thread.join(timeout=60)
+
+    def seed(self) -> list:
+        from kube_gpu_stats_tpu.delta import encode_full
+
+        wires = [(i, encode_full(self.sources[i], self.gens[i], 1,
+                                 self.bodies[i]))
+                 for i in range(len(self.sources))]
+        outcomes: list = []
+        self._drain(wires, outcomes)
+        for index, status, _retry in outcomes:
+            if status == 200:
+                self.seqs[index] = 1
+        return outcomes
+
+    def delta_wave(self, offset: float) -> list:
+        from kube_gpu_stats_tpu.delta import encode_delta
+
+        wires = [(i, encode_delta(
+            self.sources[i], self.gens[i], self.seqs[i] + 1,
+            [(self.churn_slots[0], 50.0 + offset),
+             (self.churn_slots[1], 300.0 + offset)]))
+            for i in range(len(self.sources))]
+        outcomes: list = []
+        self._drain(wires, outcomes)
+        for index, status, _retry in outcomes:
+            if status == 200:
+                self.seqs[index] += 1
+        return outcomes
+
+
+def scenario_warm_restart(tmp: str, daemons_n: int,
+                          sessions_n: int, verbose: bool) -> list[str]:
+    """Kill/restart a checkpointing root hub under real daemons + a
+    synthesized session fleet; assert warm resume."""
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.delta import DeltaPublisher
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    problems: list[str] = []
+    ckpt = str(pathlib.Path(tmp) / "root.ckpt")
+    daemons: list = []
+    fakes: list = []
+    publishers: list = []
+
+    def make_hub():
+        return Hub([], targets_provider=lambda: [], interval=0.2,
+                   push_fence=5.0, ingest_checkpoint=ckpt,
+                   ingest_checkpoint_interval=0.1)
+
+    hub = make_hub()
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           trace_provider=hub.tracer,
+                           ready_check=hub.ready,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    port = server.port
+    hub2 = server2 = None
+    try:
+        import os
+
+        for node in range(daemons_n):
+            noderoot = pathlib.Path(tmp) / f"node{node}"
+            make_sysfs(noderoot / "sys", num_chips=2)
+            libtpu = FakeLibtpuServer(num_chips=2).start()
+            sock = str(noderoot / "kubelet.sock")
+            kubelet = FakeKubeletServer(
+                sock, [tpu_pod(f"train-{node}", "ml", "worker",
+                               ["0", "1"])]).start()
+            fakes.extend([libtpu, kubelet])
+            cfg = Config(backend="tpu", sysfs_root=str(noderoot / "sys"),
+                         libtpu_ports=(libtpu.port,), interval=0.1,
+                         deadline=2.0, listen_host="127.0.0.1",
+                         listen_port=0, attribution="podresources",
+                         kubelet_socket=sock, attribution_interval=0.5,
+                         use_native=False)
+            os.environ["TPU_NAME"] = "chaos-slice"
+            os.environ["TPU_WORKER_ID"] = str(node)
+            try:
+                daemon = Daemon(cfg)
+            finally:
+                os.environ.pop("TPU_NAME", None)
+                os.environ.pop("TPU_WORKER_ID", None)
+            daemon.start()
+            daemons.append(daemon)
+            pub = DeltaPublisher(
+                daemon.registry, f"http://127.0.0.1:{port}",
+                source=f"http://127.0.0.1:{daemon.server.port}/metrics",
+                min_interval=0.05)
+            pub.start()
+            publishers.append(pub)
+        for daemon in daemons:
+            daemon.registry.wait_for_publish(0, timeout=10)
+
+        fleet = SessionFleet(port, sessions_n)
+        bad_seed = [o for o in fleet.seed() if o[1] != 200]
+        if bad_seed:
+            problems.append(f"warm: seeding failed: {bad_seed[:3]}")
+        bad_wave = [o for o in fleet.delta_wave(1.0) if o[1] != 200]
+        if bad_wave:
+            problems.append(f"warm: delta wave failed: {bad_wave[:3]}")
+        time.sleep(0.3)  # let the daemons' publishers land a few frames
+        hub.refresh_once()
+        if not hub.delta.checkpoint(force=True):
+            problems.append("warm: forced checkpoint did not write")
+        crash_state = pathlib.Path(ckpt).read_bytes()
+
+        # --- kill: server down, hub down, WAL rolled back to the
+        # crash point (stop() force-writes a newest-state checkpoint —
+        # a clean drain — so the crash is simulated by restoring the
+        # pre-stop bytes, exactly what kill -9 would have left).
+        server.stop()
+        hub.stop()
+        pathlib.Path(ckpt).write_bytes(crash_state)
+
+        resyncs_before_restart = sum(p.resyncs_total for p in publishers)
+        restart_start = time.monotonic()
+        hub2 = make_hub()
+        server2 = MetricsServer(hub2.registry, host="127.0.0.1", port=port,
+                                trace_provider=hub2.tracer,
+                                ready_check=hub2.ready,
+                                ingest_provider=hub2.delta.handle)
+        server2.start()
+        hub2.start()
+
+        # The silent synthesized fleet resumes its chains cold-free:
+        # every next DELTA must land 200 off the replayed sessions.
+        outcomes = fleet.delta_wave(2.0)
+        resumed = sum(1 for _i, status, _r in outcomes if status == 200)
+        full_resyncs = len(outcomes) - resumed
+        deadline = time.monotonic() + 10.0
+        ready = False
+        while time.monotonic() < deadline:
+            ok, _reason = hub2.ready()
+            if ok:
+                ready = True
+                break
+            time.sleep(0.05)
+        recovery_s = time.monotonic() - restart_start
+        # Live daemons may have pushed past the checkpoint (the crash
+        # tail): each pays at most one FULL resync, never a dropped
+        # session.
+        time.sleep(0.5)
+        hub2.refresh_once()
+        sessions_after = len(hub2.delta.sources())
+        total = sessions_n + daemons_n
+        if resumed < 0.95 * sessions_n:
+            problems.append(
+                f"warm: only {resumed}/{sessions_n} sessions resumed "
+                f"their delta chain ({full_resyncs} forced FULL)")
+        if hub2.delta.warm_restart_sessions < 0.95 * sessions_n:
+            problems.append(
+                f"warm: replay restored only "
+                f"{hub2.delta.warm_restart_sessions} of ~{total} sessions")
+        if sessions_after < total:
+            problems.append(
+                f"warm: {total - sessions_after} session(s) dropped "
+                f"across the restart")
+        if not ready:
+            problems.append("warm: hub never went Ready after restart")
+        if recovery_s > 10.0:
+            problems.append(
+                f"warm: recovery took {recovery_s:.1f}s (> 10s)")
+        pushes_before = sum(p.pushes_total for p in publishers)
+        time.sleep(0.5)
+        if sum(p.pushes_total for p in publishers) <= pushes_before:
+            problems.append(
+                "warm: daemon publishers did not resume pushing")
+        if verbose:
+            print(f"  warm restart: {resumed}/{sessions_n} resumed, "
+                  f"{full_resyncs} FULL resyncs, "
+                  f"{sum(p.resyncs_total for p in publishers) - resyncs_before_restart} "
+                  f"daemon resyncs, ready in {recovery_s:.2f}s")
+    finally:
+        for pub in publishers:
+            pub.stop()
+        for daemon in daemons:
+            daemon.stop()
+        for fake in fakes:
+            fake.stop()
+        if server2 is not None:
+            server2.stop()
+        if hub2 is not None:
+            hub2.stop()
+    return problems
+
+
+def scenario_stampede(verbose: bool) -> list[str]:
+    """2x-budget publisher stampede against an admission-controlled
+    hub: shed-not-crash, zero established-session drops."""
+    from kube_gpu_stats_tpu.delta import encode_full
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+    n = 128
+    hub = Hub([], targets_provider=lambda: [], interval=0.2,
+              push_fence=1e9, ingest_lanes=4,
+              ingest_delta_rate=40.0, ingest_max_inflight=32,
+              ingest_max_sessions=n)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           trace_provider=hub.tracer,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    try:
+        fleet = SessionFleet(server.port, n, prefix="stampede")
+        bad_seed = [o for o in fleet.seed() if o[1] != 200]
+        if bad_seed:
+            problems.append(f"stampede: seeding failed: {bad_seed[:3]}")
+        hub.refresh_once()
+
+        # The fence: a new session at capacity is refused 503 +
+        # Retry-After, never accepted into RSS.
+        status, retry = post_frame(
+            server.port, encode_full("http://intruder:9400/metrics",
+                                     7, 1, fleet.bodies[0]))
+        if status != 503 or retry is None:
+            problems.append(
+                f"stampede: memory fence answered {status} "
+                f"(Retry-After {retry!r}), want 503 + Retry-After")
+
+        shed = landed = 0
+        crashed: list = []
+        for wave in range(4):
+            outcomes = fleet.delta_wave(10.0 + wave)
+            for _i, status, retry in outcomes:
+                if status == 200:
+                    landed += 1
+                elif status == 429 and retry is not None:
+                    shed += 1
+                else:
+                    crashed.append(status)
+            # A recovery FULL mid-storm must always be admitted.
+            victim = wave * 31 % n
+            status, _retry = post_frame(
+                server.port, encode_full(fleet.sources[victim],
+                                         5_000_000 + victim * 10 + wave, 1,
+                                         fleet.bodies[victim]))
+            if status != 200:
+                problems.append(
+                    f"stampede: recovery FULL refused with {status} "
+                    f"mid-storm (shed priority violated)")
+            else:
+                fleet.gens[victim] = 5_000_000 + victim * 10 + wave
+                fleet.seqs[victim] = 1
+        hub.refresh_once()
+        alive = len(hub.delta.sources())
+        served = hub._push_served
+        if crashed:
+            problems.append(
+                f"stampede: non-shed failures {crashed[:5]} "
+                f"(want only 200 or 429+Retry-After)")
+        if not shed:
+            problems.append("stampede: the guard never shed "
+                            "(2x-budget blast all landed?)")
+        if not landed:
+            problems.append("stampede: nothing landed (over-shedding)")
+        if alive != n:
+            problems.append(
+                f"stampede: {n - alive} established session(s) dropped")
+        if served != n:
+            problems.append(
+                f"stampede: post-storm refresh push-served {served}/{n}")
+        text = hub.registry.snapshot().render()
+        if 'kts_ingest_shed_total{reason="delta_rate"}' not in text:
+            problems.append(
+                "stampede: kts_ingest_shed_total{reason=delta_rate} "
+                "missing from the exposition")
+        if verbose:
+            print(f"  stampede: {landed} landed, {shed} shed with 429, "
+                  f"{alive}/{n} sessions alive")
+    finally:
+        server.stop()
+        hub.stop()
+    return problems
+
+
+def scenario_hostile(verbose: bool) -> list[str]:
+    """Slow-loris + corrupt-frame flood beside healthy pushers."""
+    import json
+    import urllib.request
+
+    from kube_gpu_stats_tpu.delta import encode_full
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+    hub = Hub([], targets_provider=lambda: [], interval=0.2,
+              push_fence=1e9, ingest_quarantine_threshold=5,
+              ingest_quarantine_window=30.0)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           trace_provider=hub.tracer,
+                           ingest_provider=hub.delta.handle,
+                           ingest_read_deadline=1.0)
+    server.start()
+    try:
+        fleet = SessionFleet(server.port, 16, prefix="healthy")
+        bad_seed = [o for o in fleet.seed() if o[1] != 200]
+        if bad_seed:
+            problems.append(f"hostile: seeding failed: {bad_seed[:3]}")
+
+        # --- slow-loris: headers + a dribble, then silence ------------
+        lorises = []
+        for _ in range(5):
+            sock = socket.create_connection(("127.0.0.1", server.port),
+                                            timeout=10)
+            sock.sendall(b"POST /ingest/delta HTTP/1.1\r\n"
+                         b"Host: chaos\r\n"
+                         b"Content-Type: application/x-kts-delta\r\n"
+                         b"Content-Length: 10000\r\n\r\nab")
+            lorises.append(sock)
+        # Healthy pushers keep landing beside the lorises, fast.
+        latencies = []
+        for offset in (20.0, 21.0, 22.0):
+            start = time.monotonic()
+            bad = [o for o in fleet.delta_wave(offset) if o[1] != 200]
+            latencies.append(time.monotonic() - start)
+            if bad:
+                problems.append(
+                    f"hostile: healthy deltas failed beside lorises: "
+                    f"{bad[:3]}")
+        if max(latencies) > 5.0:
+            problems.append(
+                f"hostile: healthy wave took {max(latencies):.1f}s "
+                f"beside lorises")
+        cut = 0
+        deadline = time.monotonic() + 10.0
+        for sock in lorises:
+            sock.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                answer = sock.recv(256)
+                if b"408" in answer or answer == b"":
+                    cut += 1
+            except OSError:
+                pass
+            finally:
+                sock.close()
+        if cut < len(lorises):
+            problems.append(
+                f"hostile: only {cut}/{len(lorises)} lorises cut off "
+                f"at the read deadline")
+
+        # --- corrupt-frame flood from one source ----------------------
+        evil_source = "http://evil:9400/metrics"
+        evil_gen = 1
+        quarantined_at = None
+        for attempt in range(12):
+            # Valid header, unparseable body: the per-source malformed
+            # breaker's food. A new generation each time so the frame
+            # is never a stale-session shortcut.
+            evil_gen += 1
+            wire = encode_full(evil_source, evil_gen, 1,
+                               "this is { not an exposition !!\n")
+            status, retry = post_frame(server.port, wire)
+            if status == 429 and retry is not None:
+                quarantined_at = attempt
+                break
+            if status != 400:
+                problems.append(
+                    f"hostile: corrupt frame answered {status}, "
+                    f"want 400 then 429")
+                break
+        if quarantined_at is None:
+            problems.append(
+                "hostile: 12 corrupt frames never tripped quarantine")
+        # Healthy pushers (same client IP!) must be untouched.
+        bad = [o for o in fleet.delta_wave(30.0) if o[1] != 200]
+        if bad:
+            problems.append(
+                f"hostile: healthy pushers collateral-damaged by the "
+                f"quarantine: {bad[:3]}")
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        if "kts_ingest_quarantined 0" in text or \
+                "kts_ingest_quarantined" not in text:
+            problems.append(
+                "hostile: kts_ingest_quarantined did not rise")
+        events = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/events",
+            timeout=10).read())
+        if not any(e.get("kind") == "ingest_quarantine"
+                   for e in events.get("events", [])):
+            problems.append(
+                "hostile: no ingest_quarantine journal event")
+        if verbose:
+            print(f"  hostile: {cut}/5 lorises cut, evil source "
+                  f"quarantined after {quarantined_at} bad frames, "
+                  f"healthy pushers unaffected")
+    finally:
+        server.stop()
+        hub.stop()
+    return problems
+
+
+def run(daemons_n: int, sessions_n: int, verbose: bool) -> int:
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        problems += scenario_warm_restart(tmp, daemons_n, sessions_n,
+                                          verbose)
+    problems += scenario_stampede(verbose)
+    problems += scenario_hostile(verbose)
+    if not problems:
+        print(f"chaos-sim PASS: hub kill/restart warm-resumed "
+              f"{sessions_n} sessions + {daemons_n} daemons, stampede "
+              f"shed with 429 and zero session drops, lorises cut at "
+              f"the read deadline, corrupt-frame source quarantined "
+              f"with healthy pushers unharmed")
+        return 0
+    print("chaos-sim FAIL:")
+    for problem in problems:
+        print(f"  {problem}")
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--daemons", type=int, default=2)
+    parser.add_argument("--sessions", type=int, default=256,
+                        help="synthesized delta sessions in the "
+                             "warm-restart fleet")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.daemons, args.sessions, args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
