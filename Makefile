@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -11,6 +11,7 @@ ci: native lint
 	python tools/energy_sim.py
 	python tools/host_sim.py
 	python tools/chaos_sim.py
+	python tools/partition_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -80,6 +81,20 @@ energy-sim:
 # recovery-time/shed-fairness numbers are pinned in tests/test_latency.
 chaos-sim:
 	python tools/chaos_sim.py --verbose
+
+# Partition chaos smoke (<60 s, ISSUE 13): the durable egress layer
+# end to end — real daemons with disk spill queues through a hub
+# blackout (late-but-complete drain: 0 lost, no 409 loop, live deltas
+# resume), a beyond-bounds blackout (oldest-first loss, exactly
+# accounted in kts_spill_dropped_total + journal), a rate-capped drain
+# against an admission-controlled hub (sheds honored, 0 FULL
+# amplification), and the durable sharded RemoteWriter through TSDB
+# blackouts/flaps/slow links into a fake receiver (exactly-once,
+# oldest-first, lag metered, WAL-bound loss accounted). In `make ci`;
+# drain-throughput/catch-up numbers are CI-pinned in tests/test_latency
+# (bench.measure_partition_drain).
+partition-sim:
+	python tools/partition_sim.py --verbose
 
 # Host-correlation smoke (<30 s): N real daemons, each over a faked
 # /proc + /sys + cgroup v2 host fixture, one hub; after the fleet

@@ -84,6 +84,24 @@ def _delta_fields(line: dict, quick: bool = False) -> None:
                 "sources_served_fraction"]
 
 
+def _egress_fields(line: dict) -> None:
+    """Partition-survival egress figures (ISSUE 13): fsynced spool cost
+    per offline tick, on-disk bytes per spooled snapshot (the spool
+    sizing table's input), raw drain throughput over real HTTP, and
+    backlog-to-live catch-up seconds at that ceiling (CI pins in
+    tests/test_latency.py)."""
+    from kube_gpu_stats_tpu.bench import measure_partition_drain
+
+    drain = measure_partition_drain()
+    if drain is not None:
+        line["spill_spool_ms_per_frame"] = drain[
+            "spill_spool_ms_per_frame"]
+        line["spill_bytes_per_tick"] = drain["spill_bytes_per_tick"]
+        line["partition_drain_frames_per_s"] = drain[
+            "partition_drain_frames_per_s"]
+        line["partition_catchup_s_200f"] = drain["partition_catchup_s"]
+
+
 def _burst_fields(line: dict) -> None:
     """Burst-sampler cost figures (ISSUE 8): tick-path fold overhead as
     a percent of the 50 ms budget (the <2% CI pin, tests/test_latency),
@@ -182,6 +200,7 @@ def _quick() -> int:
         line["fleet_score_ms_per_refresh"] = hub.get(
             "fleet_score_ms_per_refresh")
     _delta_fields(line, quick=True)
+    _egress_fields(line)
     _burst_fields(line)
     _host_fields(line)
     print(json.dumps(line))
@@ -297,6 +316,7 @@ def main() -> int:
         }
     _merge_hub_fields(line, measure_hub_merge)
     _delta_fields(line)
+    _egress_fields(line)
     _burst_fields(line)
     _host_fields(line)
     print(json.dumps(line))

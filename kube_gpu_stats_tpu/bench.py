@@ -1139,6 +1139,103 @@ def measure_overload_shed(pushers: int = 256, lanes: int = 4,
         return None
 
 
+def measure_partition_drain(frames: int = 200,
+                            drain_rate: float = 1e9) -> dict | None:
+    """Partition-survival egress figures (ISSUE 13 acceptance): spool
+    ``frames`` realistic snapshots into a disk spill queue (fsynced —
+    the real write path a partitioned node pays per tick), then drain
+    them over real HTTP into a push hub:
+
+    - ``spill_spool_ms_per_frame``: fsynced spool cost per published
+      snapshot while offline (must stay a rounding error next to the
+      poll interval — spooling is the partition-mode hot path).
+    - ``spill_bytes_per_tick``: on-disk bytes per spooled snapshot
+      (snappy-compressed + framing) — the OPERATIONS.md spool-sizing
+      table's input.
+    - ``partition_drain_frames_per_s``: un-rate-limited drain
+      throughput over real HTTP (the ceiling the --hub-drain-rate knob
+      caps).
+    - ``partition_catchup_s``: wall seconds from reconnect to backlog
+      empty for the ``frames``-deep backlog at that ceiling.
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        import pathlib
+        import tempfile
+
+        from . import schema
+        from .delta import DeltaPublisher
+        from .exposition import MetricsServer
+        from .hub import Hub
+        from .registry import Registry, SnapshotBuilder
+        from .spillq import SpillQueue
+
+        with tempfile.TemporaryDirectory() as tmp:
+            worker = Registry()
+
+            def publish(value: float) -> None:
+                builder = SnapshotBuilder()
+                labels = (("accel_type", "tpu-v5p"), ("chip", "0"),
+                          ("device_path", "/dev/accel0"), ("uuid", ""))
+                builder.add(schema.DEVICE_UP, 1.0, labels)
+                builder.add(schema.DUTY_CYCLE, value, labels)
+                builder.add(schema.MEMORY_USED, 1.0e9 + value, labels)
+                builder.add(schema.MEMORY_TOTAL, 9.5e10, labels)
+                builder.add(schema.POWER, 300.0 + value, labels)
+                worker.publish(builder.build())
+
+            spill = SpillQueue(str(pathlib.Path(tmp) / "spill"),
+                               fsync=True)
+            publish(0.0)
+            body = worker.rendered()[0].decode()
+            spool_start = time.perf_counter()
+            for i in range(frames):
+                spill.spool(time.time(), body)
+            spool_ms = ((time.perf_counter() - spool_start)
+                        / frames * 1000.0)
+            bytes_per_tick = spill.bytes_pending() / max(1, spill.depth())
+
+            hub = Hub([], targets_provider=lambda: [], interval=10.0,
+                      push_fence=1e9)
+            server = MetricsServer(hub.registry, host="127.0.0.1",
+                                   port=0,
+                                   ingest_provider=hub.delta.handle)
+            server.start()
+            publisher = DeltaPublisher(
+                worker, f"http://127.0.0.1:{server.port}",
+                source="bench-node", spill=spill,
+                drain_rate=drain_rate)
+            try:
+                drain_start = time.perf_counter()
+                deadline = drain_start + 120.0
+                while spill.depth() and time.perf_counter() < deadline:
+                    publisher.push_once()
+                catchup_s = time.perf_counter() - drain_start
+                drained = spill.drained_total
+            finally:
+                publisher.stop()
+                server.stop()
+                hub.stop()
+            if spill.depth():
+                return None  # drain wedged; not a representative number
+            return {
+                "frames": frames,
+                "spill_spool_ms_per_frame": round(spool_ms, 4),
+                "spill_bytes_per_tick": round(bytes_per_tick, 1),
+                "partition_drain_frames_per_s": round(
+                    drained / max(catchup_s, 1e-9), 1),
+                "partition_catchup_s": round(catchup_s, 3),
+                "spill_dropped": spill.dropped_total,
+            }
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "partition-drain bench failed", exc_info=True)
+        return None
+
+
 def measure_burst_overhead(ticks: int = 200, chips: int = 8,
                            hz: float = 100.0, budget_ms: float = 50.0,
                            thread_seconds: float = 1.0) -> dict | None:

@@ -41,6 +41,15 @@ class Config:
     remote_write_protocol: str = "1.0"  # 1.0 | 2.0 (415 downgrades to 1.0)
     remote_write_extra_labels: tuple = ()  # ((name, value), ...) stamped on
     #                                        every remote-written series
+    # Durable sharded exporter (ISSUE 13): wal_dir set => every
+    # snapshot is journaled to per-shard write-ahead segment rings and
+    # drained with retry classification (5xx/timeout retried off the
+    # WAL, poison 4xx parked, Retry-After honored) — a receiver outage
+    # becomes late delivery, bounded and accounted, instead of a hole.
+    remote_write_shards: int = 1
+    remote_write_wal_dir: str = ""
+    remote_write_wal_max_bytes: int = 64 * 1024 * 1024
+    remote_write_drain_max: int = 64  # requests per shard per push cycle
     sysfs_root: str = "/sys"
     proc_root: str = "/proc"
     device_processes: str = "on"  # accelerator_process_open scan (on|off)
@@ -105,6 +114,15 @@ class Config:
     hub_auth_password_file: str = ""
     hub_ca_file: str = ""
     hub_insecure_tls: bool = False
+    # Partition survival (ISSUE 13): when hub_spill_dir is set, a
+    # publisher whose hub link is down spools every published snapshot
+    # to a bounded on-disk ring and drains it oldest-first (at most
+    # hub_drain_rate frames/s) on reconnect — a partition becomes a
+    # late-but-complete record instead of a hole. Empty = the old
+    # lossy-under-partition behavior.
+    hub_spill_dir: str = ""
+    hub_spill_max_bytes: int = 64 * 1024 * 1024
+    hub_drain_rate: float = 50.0
     # Burst sampler + energy accounting (ISSUE 8 tentpole).
     burst_mode: str = "auto"  # off | auto (demand/anomaly armed) |
     #                           continuous
@@ -264,6 +282,30 @@ def add_delta_push_flags(p: argparse.ArgumentParser) -> None:
                    default=_env_bool("HUB_INSECURE_TLS"),
                    help="skip TLS verification of an https --hub-url "
                         "(self-signed dev certs; prefer --hub-ca-file)")
+    p.add_argument("--hub-spill-dir", default=_env("HUB_SPILL_DIR", ""),
+                   help="directory for the delta-push spill queue: while "
+                        "--hub-url is unreachable every published "
+                        "snapshot spools to a bounded on-disk ring "
+                        "(fsynced, crash-recoverable) and drains "
+                        "oldest-first on reconnect, so a partition "
+                        "yields a late-but-complete record instead of a "
+                        "hole. Empty disables (offline ticks are "
+                        "dropped, the pre-ISSUE-13 behavior)")
+    p.add_argument("--hub-spill-max-bytes", type=int,
+                   default=int(_env("HUB_SPILL_MAX_BYTES",
+                                    str(64 * 1024 * 1024))),
+                   help="spill queue byte bound; past it the OLDEST "
+                        "frames are dropped, counted in "
+                        "kts_spill_dropped_total and journaled (bounded "
+                        "loss is accounted loss). See the spool sizing "
+                        "table in docs/OPERATIONS.md")
+    p.add_argument("--hub-drain-rate", type=float,
+                   default=float(_env("HUB_DRAIN_RATE", "50")),
+                   help="max spooled frames/second sent while draining "
+                        "a backlog (token bucket) — the whole returning "
+                        "fleet must never stampede a recovering hub; "
+                        "429/503 + Retry-After from the hub pauses the "
+                        "drain on top of this")
 
 
 def add_ingest_guard_flags(p: argparse.ArgumentParser) -> None:
@@ -344,6 +386,11 @@ def validate_delta_push_args(args) -> str | None:
         return "--hub-ca-file and --hub-insecure-tls are mutually exclusive"
     if args.hub_push_interval <= 0:
         return "--hub-push-interval must be > 0 seconds"
+    if args.hub_spill_max_bytes < 1 << 16:
+        return ("--hub-spill-max-bytes must be >= 65536 (a bound smaller "
+                "than one frame spools nothing)")
+    if args.hub_drain_rate <= 0:
+        return "--hub-drain-rate must be > 0 frames/second"
     return None
 
 
@@ -416,6 +463,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="remote-write wire protocol; 2.0 interns label "
                         "strings and sends typed metadata, and falls "
                         "back to 1.0 if the receiver answers 415")
+    p.add_argument("--remote-write-wal-dir",
+                   default=_env("REMOTE_WRITE_WAL_DIR", ""),
+                   help="directory for the durable exporter's per-shard "
+                        "write-ahead segment rings: snapshots are "
+                        "journaled to disk BEFORE sending and drained "
+                        "oldest-first with retry classification "
+                        "(5xx/timeout retried, poison 4xx parked, "
+                        "Retry-After honored), so a receiver outage is "
+                        "late delivery, not a hole in the TSDB. Empty = "
+                        "legacy best-effort (failures drop the snapshot)")
+    p.add_argument("--remote-write-shards", type=int,
+                   default=int(_env("REMOTE_WRITE_SHARDS", "1")),
+                   help="send shards for the durable exporter (series "
+                        "hash to a shard by identity; each shard has "
+                        "its own WAL ring, backoff and parked ring). "
+                        "Needs --remote-write-wal-dir when > 1")
+    p.add_argument("--remote-write-wal-max-bytes", type=int,
+                   default=int(_env("REMOTE_WRITE_WAL_MAX_BYTES",
+                                    str(64 * 1024 * 1024))),
+                   help="per-shard WAL byte bound; past it the OLDEST "
+                        "segment is evicted whole, counted in "
+                        "kts_remote_write_dropped_total and journaled")
+    p.add_argument("--remote-write-drain-max", type=int,
+                   default=int(_env("REMOTE_WRITE_DRAIN_MAX", "64")),
+                   help="max backlogged requests one shard sends per "
+                        "push cycle while catching up after an outage "
+                        "(bounds the catch-up burst a recovering "
+                        "receiver absorbs)")
     p.add_argument("--sysfs-root", default=_env("SYSFS_ROOT", "/sys"))
     p.add_argument("--proc-root", default=_env("PROC_ROOT", "/proc"))
     p.add_argument("--device-processes", choices=("on", "off"),
@@ -686,6 +761,16 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         parser.error("--max-concurrent-scrapes must be >= 0 (0 disables)")
     if args.remote_write_interval <= 0:
         parser.error("--remote-write-interval must be > 0 seconds")
+    if not 1 <= args.remote_write_shards <= 64:
+        parser.error("--remote-write-shards must be 1..64")
+    if args.remote_write_shards > 1 and not args.remote_write_wal_dir:
+        parser.error("--remote-write-shards > 1 needs "
+                     "--remote-write-wal-dir (sharding exists for the "
+                     "durable exporter)")
+    if args.remote_write_wal_max_bytes < 1 << 16:
+        parser.error("--remote-write-wal-max-bytes must be >= 65536")
+    if args.remote_write_drain_max < 1:
+        parser.error("--remote-write-drain-max must be >= 1")
     if args.passthrough_unknown not in ("on", "off"):
         # Same env-bypasses-argparse-choices class as the protocol check:
         # KTS_PASSTHROUGH_UNKNOWN=true must fail loudly, not silently
@@ -749,6 +834,10 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         remote_write_bearer_token_file=args.remote_write_bearer_token_file,
         remote_write_protocol=args.remote_write_protocol,
         remote_write_extra_labels=remote_write_extra_labels,
+        remote_write_shards=args.remote_write_shards,
+        remote_write_wal_dir=args.remote_write_wal_dir,
+        remote_write_wal_max_bytes=args.remote_write_wal_max_bytes,
+        remote_write_drain_max=args.remote_write_drain_max,
         sysfs_root=args.sysfs_root,
         proc_root=args.proc_root,
         device_processes=args.device_processes,
@@ -788,6 +877,9 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         hub_auth_password_file=args.hub_auth_password_file,
         hub_ca_file=args.hub_ca_file,
         hub_insecure_tls=args.hub_insecure_tls,
+        hub_spill_dir=args.hub_spill_dir,
+        hub_spill_max_bytes=args.hub_spill_max_bytes,
+        hub_drain_rate=args.hub_drain_rate,
         burst_mode=args.burst_mode,
         burst_hz=args.burst_hz,
         burst_hold=args.burst_hold,

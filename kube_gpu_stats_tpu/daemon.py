@@ -299,6 +299,7 @@ class Daemon:
             disabled_metrics=cfg.disabled_metrics,
             process_openers=self.procwatch.lookup if self.procwatch else None,
             push_stats=self._push_stats,
+            egress_stats=self._egress_stats,
             render_stats=self.render_stats.contribute,
             health_stats=self.supervisor.contribute,
             heartbeat=self.supervisor.beater("poll"),
@@ -333,6 +334,7 @@ class Daemon:
             burst_provider=self.burst,
             energy_provider=self.energy,
             host_provider=self.hoststats,
+            egress_provider=self._egress_payload,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir,
@@ -366,6 +368,11 @@ class Daemon:
                 protocol=cfg.remote_write_protocol,
                 extra_labels=cfg.remote_write_extra_labels,
                 render_stats=self.render_stats,
+                shards=cfg.remote_write_shards,
+                wal_dir=cfg.remote_write_wal_dir,
+                wal_max_bytes=cfg.remote_write_wal_max_bytes,
+                drain_max_per_push=cfg.remote_write_drain_max,
+                tracer=self.tracer,
             )
         # Delta push to an upstream hub (ISSUE 7): each published
         # snapshot ships as a changed-series delta; the hub applies it
@@ -378,6 +385,17 @@ class Daemon:
 
             from .delta import DeltaPublisher, push_headers_provider
 
+            # Partition survival (ISSUE 13): with --hub-spill-dir, a
+            # down hub link spools every published snapshot to a
+            # bounded on-disk ring (drained oldest-first, rate-limited
+            # on reconnect) instead of dropping it to the backoff.
+            spill = None
+            if cfg.hub_spill_dir:
+                from .spillq import SpillQueue
+
+                spill = SpillQueue(cfg.hub_spill_dir,
+                                   max_bytes=cfg.hub_spill_max_bytes,
+                                   tracer=self.tracer)
             self.delta_pusher = DeltaPublisher(
                 self.registry, cfg.hub_url,
                 source=cfg.hub_push_source or (
@@ -390,6 +408,8 @@ class Daemon:
                 ca_file=cfg.hub_ca_file,
                 insecure_tls=cfg.hub_insecure_tls,
                 tracer=self.tracer,
+                spill=spill,
+                drain_rate=cfg.hub_drain_rate,
             )
 
     def _wire_tracer(self, collector) -> None:
@@ -455,6 +475,49 @@ class Daemon:
                     # load, not failing).
                     stats[mode]["shed_honored"] = sender.shed_honored_total
         return stats
+
+    def _egress_stats(self) -> dict:
+        """Spill-queue + durable remote-write status for the
+        kts_spill_*/kts_remote_write_* fold and /debug/egress (ISSUE
+        13). Late-bound like _push_stats — the senders are created
+        after the poll loop."""
+        out: dict = {}
+        pusher = getattr(self, "delta_pusher", None)
+        if pusher is not None:
+            status = pusher.spill_status()
+            if status is not None:
+                out["spill"] = status
+        writer = getattr(self, "remote_writer", None)
+        if writer is not None:
+            status_fn = getattr(writer, "egress_status", None)
+            status = status_fn() if callable(status_fn) else None
+            if status is not None:
+                out["remote_write"] = status
+        return out
+
+    def _egress_payload(self) -> dict:
+        """/debug/egress: the egress-durability picture plus per-sender
+        shipping health — what `doctor --egress` summarizes. enabled
+        says whether ANY durability (spill queue / durable remote
+        write) is configured; sender rows appear for every configured
+        sender either way (their failure counters are the 'is the link
+        down' half of the triage)."""
+        payload: dict = dict(self._egress_stats())
+        payload["enabled"] = bool(payload)
+        senders: dict = {}
+        for mode, sender in (("delta", getattr(self, "delta_pusher", None)),
+                             ("remote_write",
+                              getattr(self, "remote_writer", None)),
+                             ("pushgateway", getattr(self, "pusher", None))):
+            if sender is not None:
+                senders[mode] = {
+                    "pushes_total": sender.pushes_total,
+                    "failures_total": sender.failures_total,
+                    "dropped_total": sender.dropped_total,
+                    "consecutive_failures": sender.consecutive_failures,
+                }
+        payload["senders"] = senders
+        return payload
 
     def start(self) -> None:
         starter = getattr(self.attribution, "start", None)

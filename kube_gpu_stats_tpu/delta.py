@@ -63,7 +63,6 @@ stateful choke point —
 from __future__ import annotations
 
 import itertools
-import json
 import logging
 import os
 import struct
@@ -72,7 +71,7 @@ import time
 import zlib
 from typing import NamedTuple, Sequence
 
-from . import snappy
+from . import snappy, wal
 from .resilience import CLOSED, OPEN, CircuitBreaker, TokenBucket
 from .validate import parse_exposition_interned, retry_after_seconds
 from .workers import PublishFollower, push_opener
@@ -404,7 +403,8 @@ class DeltaPublisher(PublishFollower):
                  min_interval: float = 1.0, timeout: float = 5.0,
                  headers_provider=None, render_stats=None, tracer=None,
                  ca_file: str = "", insecure_tls: bool = False,
-                 generation: int | None = None, rng=None) -> None:
+                 generation: int | None = None, rng=None,
+                 spill=None, drain_rate: float = 50.0) -> None:
         super().__init__(registry, min_interval, thread_name="delta-push")
         self._url = url.rstrip("/") + INGEST_PATH
         self._https = self._url.startswith("https://")
@@ -439,6 +439,34 @@ class DeltaPublisher(PublishFollower):
         self._shed_until = 0.0
         self._shed_prev = 0.0
         self.shed_honored_total = 0
+        # Disk spill queue (ISSUE 13): while the hub link is down every
+        # published snapshot spools to the bounded on-disk ring instead
+        # of being dropped by the backoff; on reconnect the backlog
+        # drains oldest-first through the drain-rate bucket BEFORE live
+        # deltas resume — a partition becomes a late-but-complete
+        # record, and drain can never stampede a recovering hub.
+        self._spill = spill
+        # Bucket burst = one publish interval's worth of frames (>= 1 s
+        # floor): push_once is publish-gated, so a smaller burst would
+        # silently cap the amortized drain below the knob (tokens top
+        # out at burst between calls), while a burst this size bounds
+        # any single call's blast to ~1-2 s of the configured rate.
+        self._drain_bucket = (
+            TokenBucket(drain_rate,
+                        max(1.0, drain_rate * max(1.0, min_interval)))
+            if spill is not None and drain_rate > 0 else None)
+        self.drain_rate = drain_rate
+        # One spool per published snapshot: push_once must stay
+        # idempotent across redundant calls (final flush, tools driving
+        # it in a loop) — re-spooling the same generation would inflate
+        # the record with duplicates.
+        self._last_spooled_gen: int | None = None
+        # Probe backoff while partitioned: spooling happens at publish
+        # cadence (it's a local disk write — the follower's backoff is
+        # for receivers), but the NETWORK probe against the dead link
+        # backs off on the shared policy via these two.
+        self._link_failures = 0
+        self._probe_at = 0.0
 
     @property
     def source(self) -> str:
@@ -511,28 +539,18 @@ class DeltaPublisher(PublishFollower):
             log.warning("delta push failed: %s", exc)
             return "error", 0.0
 
-    def push_once(self) -> None:
-        if self._shed_until and time.monotonic() < self._shed_until:
-            # Honoring a Retry-After: skip this push entirely (no
-            # render, no POST). Nothing is lost — the encoder's acked
-            # state is untouched, so the first push after the window
-            # ships one delta covering the whole gap.
-            return
-        serialize_start = time.monotonic()
-        body, _ = self._registry.rendered()
-        if not body:
-            return
+    def _send_frame(self, body: str) -> tuple[str, float]:
+        """Encode + POST one snapshot with in-push 409 recovery (the
+        hub lost or never had our session — restarted hub, evicted
+        source, seq gap after our own failed send: one FULL inside this
+        push, not one more interval of gap). Owns the encoder's
+        ack/defer/nack transition and the pushes_total/last_frame
+        accounting; the caller classifies the outcome ('ok' | 'shed' |
+        'error') for its own path (live vs backlog drain)."""
         encoder = self._encoder
-        wire, kind = encoder.encode_next(body.decode())
-        # Diff+encode cost only — measured BEFORE the POST like every
-        # other render site (remote_write serializes, then sends); a
-        # slow hub must not masquerade as serialization cost.
-        serialize_seconds = time.monotonic() - serialize_start
+        wire, kind = encoder.encode_next(body)
         outcome, retry_after = self._post(wire)
         if outcome == "resync":
-            # The hub lost (or never had) our session — restarted hub,
-            # evicted source, seq gap after our own failed send. Recover
-            # inside this push: one FULL, not one more interval of gap.
             self.resyncs_total += 1
             encoder.nack()
             if self._tracer is not None:
@@ -540,32 +558,183 @@ class DeltaPublisher(PublishFollower):
                     "delta_resync",
                     f"{encoder.source}: hub demanded resync; sending full "
                     f"snapshot", source=encoder.source)
-            wire, kind = encoder.encode_next(body.decode())
+            wire, kind = encoder.encode_next(body)
             outcome, retry_after = self._post(wire)
         if outcome == "ok":
             encoder.ack()
-            self._shed_until = self._shed_prev = 0.0
-            self.consecutive_failures = 0
             self.pushes_total += 1
             self.last_frame_bytes = len(wire)
             self.last_frame_kind = kind
-            if self._render_stats is not None:
-                # The push path's render-equivalent accounting: bytes on
-                # the wire per frame and the serialize+diff cost, shared
-                # with the scrape/textfile/remote-write surfaces.
-                self._render_stats.observe(
-                    "delta", serialize_seconds, len(wire))
         elif outcome == "shed":
             # Its own retry class: not a failure (the backoff-scaled
             # push interval and the supervisor's failure counters stay
             # untouched), not a resync (the frame never reached session
             # state, so the acked diff base is still valid).
             encoder.defer()
-            self._note_shed(retry_after)
         else:
             encoder.nack()
-            self.consecutive_failures += 1
+        return outcome, retry_after
+
+    @property
+    def backlog_depth(self) -> int:
+        return self._spill.depth() if self._spill is not None else 0
+
+    def spill_status(self) -> dict | None:
+        """Spool health for /debug/egress and the kts_spill_* fold;
+        None when no spill queue is configured."""
+        if self._spill is None:
+            return None
+        status = self._spill.status()
+        status["drain_rate"] = self.drain_rate
+        status["draining"] = bool(status["depth_frames"])
+        status["link_failures"] = self._link_failures
+        return status
+
+    def _enter_spill(self, text: str, generation) -> None:
+        """First failed push of a partition: spool the snapshot, start
+        the probe backoff, journal the edge. ``generation`` is the
+        registry generation of the snapshot being spooled, captured by
+        push_once BEFORE the (possibly seconds-long) failed POST — a
+        publish landing during that POST must not be dedup-skipped as
+        already-spooled."""
+        depth_before = self._spill.depth()
+        self._spill.spool(time.time(), text)
+        self._last_spooled_gen = generation
+        self._link_failures += 1
+        self._probe_at = (time.monotonic()
+                          + self.backoff.interval_for(self._link_failures))
+        if depth_before == 0 and self._tracer is not None:
+            self._tracer.event(
+                "spill_start",
+                f"{self._encoder.source}: hub unreachable; spooling "
+                f"snapshots to disk", source=self._encoder.source)
+
+    def _drain_backlog(self) -> None:
+        """Send spooled frames oldest-first through the drain-rate
+        bucket, honoring shed responses and backing the probe off on
+        transport failure. Bounded per call by the bucket — the next
+        push_once continues — so the publisher thread stays responsive
+        and the amortized drain rate never exceeds the knob."""
+        spill = self._spill
+        if time.monotonic() < self._probe_at:
+            return
+        try:
+            while True:
+                if self._shed_until and time.monotonic() < self._shed_until:
+                    return
+                if self._drain_bucket is not None and \
+                        not self._drain_bucket.try_take():
+                    return
+                record = spill.peek()
+                if record is None:
+                    break
+                _ts, body = record
+                outcome, retry_after = self._send_frame(body)
+                if outcome == "ok":
+                    spill.commit()
+                    self._link_failures = 0
+                    self._shed_until = self._shed_prev = 0.0
+                    continue
+                if outcome == "shed":
+                    # The hub is up but shaping load: honor the
+                    # Retry-After (decorrelated jitter) and leave the
+                    # frame spooled — known-unapplied, it re-sends after
+                    # the window. This is the 0-FULL-amplification half
+                    # of the drain contract.
+                    self._note_shed(retry_after)
+                    return
+                # Still partitioned: the frame stays at the head, the
+                # probe backs off, failures stay visible in the push
+                # health.
+                self.failures_total += 1
+                self._link_failures += 1
+                self._probe_at = (time.monotonic()
+                                  + self.backoff.interval_for(
+                                      self._link_failures))
+                return
+        finally:
+            # Persist the cursor on EVERY exit (dirty-gated: a no-op
+            # when nothing was committed) — a long drain is paced over
+            # many push cycles by the rate bucket, and a crash mid-drain
+            # must re-send at most this cycle's window, not replay the
+            # whole already-drained prefix.
+            spill.save_cursor()
+        # Backlog cleared: journal the recovery edge.
+        if self._tracer is not None:
+            self._tracer.event(
+                "spill_drained",
+                f"{self._encoder.source}: backlog drained "
+                f"({spill.drained_total} total); live deltas resumed",
+                source=self._encoder.source)
+
+    def push_once(self) -> None:
+        if self._shed_until and time.monotonic() < self._shed_until:
+            # Honoring a Retry-After: skip this push entirely (no
+            # render, no POST). Nothing is lost — the encoder's acked
+            # state is untouched, so the first push after the window
+            # ships one delta covering the whole gap (and a spooling
+            # publisher keeps spooling the moment the window ends).
+            return
+        serialize_start = time.monotonic()
+        # Generation captured BEFORE the render (and so before any
+        # failed POST's timeout): a publish racing this push must err
+        # toward re-spooling a duplicate of the same values, never
+        # toward dedup-skipping a genuinely new snapshot into a hole.
+        generation = getattr(self._registry, "generation", None)
+        body, _ = self._registry.rendered()
+        if not body:
+            return
+        text = body.decode()
+        if self._spill is not None and self._spill.depth():
+            # Partitioned or draining: the live snapshot joins the TAIL
+            # of the record (ordering preserved — oldest-first is the
+            # whole point) and the head drains through the rate bucket.
+            # consecutive_failures is pinned to 0 so the follower keeps
+            # PUBLISH cadence: the spool write is local disk, and the
+            # backoff belongs to the network probe (_probe_at), not to
+            # the record-keeping.
+            if generation is None or generation != self._last_spooled_gen:
+                self._spill.spool(time.time(), text)
+                self._last_spooled_gen = generation
+            self.consecutive_failures = 0
+            self._drain_backlog()
+            return
+        # Diff+encode cost only — measured BEFORE the POST like every
+        # other render site (remote_write serializes, then sends); a
+        # slow hub must not masquerade as serialization cost.
+        serialize_seconds = time.monotonic() - serialize_start
+        outcome, retry_after = self._send_frame(text)
+        if outcome == "ok":
+            self._shed_until = self._shed_prev = 0.0
+            self.consecutive_failures = 0
+            self._link_failures = 0
+            # Delivered live = recorded: a redundant push_once for the
+            # same generation must not spool it after the fact.
+            self._last_spooled_gen = generation
+            if self._render_stats is not None:
+                # The push path's render-equivalent accounting: bytes on
+                # the wire per frame and the serialize+diff cost, shared
+                # with the scrape/textfile/remote-write surfaces.
+                self._render_stats.observe(
+                    "delta", serialize_seconds, self.last_frame_bytes)
+        elif outcome == "shed":
+            self._note_shed(retry_after)
+        else:
             self.failures_total += 1
+            if self._spill is not None:
+                # The partition edge: this snapshot (and every one
+                # after it) goes to disk instead of the floor.
+                self._enter_spill(text, generation)
+                self.consecutive_failures = 0
+            else:
+                self.consecutive_failures += 1
+
+    def stop(self) -> None:
+        super().stop()
+        if self._spill is not None:
+            # Final cursor save + segment close: a clean pod reschedule
+            # resumes the drain exactly where it stopped.
+            self._spill.close()
 
 
 class _Session:
@@ -611,13 +780,21 @@ class _Lane:
     lane on one cache line's worth of lock."""
 
     __slots__ = ("lock", "sessions", "full_frames", "delta_frames",
-                 "bytes", "resyncs", "apply_seconds", "bucket")
+                 "dup_frames", "bytes", "resyncs", "apply_seconds",
+                 "bucket")
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.sessions: dict[str, _Session] = {}
         self.full_frames = 0
         self.delta_frames = 0
+        # FULL retransmits (same generation, same seq, session already
+        # counted it): a publisher whose response was lost re-sends the
+        # frame it cannot know landed. Re-applied (idempotent; the body
+        # may be fresher) but counted HERE, not in full_frames — a
+        # spill-queue drain across a flaky link must not double-count
+        # the record (ISSUE 13 satellite).
+        self.dup_frames = 0
         self.bytes = 0
         self.resyncs = 0
         # Cumulative wall seconds handler threads spent inside apply()
@@ -814,6 +991,12 @@ class DeltaIngest:
     @property
     def delta_frames_total(self) -> int:
         return sum(lane.delta_frames for lane in self._lanes)
+
+    @property
+    def duplicate_frames_total(self) -> int:
+        """FULL retransmits absorbed without double-counting (the
+        publisher's response was lost; the frame already landed)."""
+        return sum(lane.dup_frames for lane in self._lanes)
 
     @property
     def bytes_total(self) -> int:
@@ -1083,6 +1266,20 @@ class DeltaIngest:
             if session is None:
                 session = _Session(frame.source, next(self._order))
                 lane.sessions[frame.source] = session
+            elif (session.generation == frame.generation
+                    and frame.seq == session.seq and session.frames):
+                # Retransmit of an already-counted FULL: the publisher's
+                # response was lost (timeout on a flaky link), so it
+                # cannot know the frame landed and must re-send. Apply
+                # it (idempotent replace — the re-encoded body may even
+                # be fresher) but never re-count: a spill drain across
+                # a flap must produce an exactly-once RECORD even when
+                # the wire is at-least-once.
+                session.stamp(time.monotonic())
+                lane.dup_frames += 1
+                if entry is not None:
+                    store[frame.source] = entry
+                return
             elif session.generation not in (0, frame.generation):
                 # A worker restarted with a new generation: the FULL
                 # replaces everything, but journal the restart — the
@@ -1198,6 +1395,7 @@ class DeltaIngest:
         return {
             "full_frames": self.full_frames_total,
             "delta_frames": self.delta_frames_total,
+            "duplicate_frames": self.duplicate_frames_total,
             "bytes": self.bytes_total,
             "resyncs": self.resyncs_total,
             "sessions": sum(len(lane.sessions) for lane in self._lanes),
@@ -1308,60 +1506,31 @@ class DeltaIngest:
                     or now - self._ckpt_last_write < self._ckpt_interval):
                 return False
             state = self._capture_checkpoint()
-            wal = self._ckpt_path + ".wal"
-            try:
-                os.makedirs(os.path.dirname(self._ckpt_path) or ".",
-                            exist_ok=True)
-                with open(wal, "w", encoding="utf-8") as handle:
-                    json.dump(state, handle, separators=(",", ":"))
-                    handle.flush()
-                    os.fsync(handle.fileno())
-                os.replace(wal, self._ckpt_path)
-            except OSError as exc:
-                log.warning("ingest checkpoint write failed: %s", exc)
+            # Shared write-ahead discipline (wal.py): .wal + fsync +
+            # atomic rename — the same implementation the energy
+            # checkpoint and the egress spill/exporter rings use.
+            if not wal.write_state(self._ckpt_path, state, label="ingest"):
                 return False
             self._ckpt_last_write = now
             self._ckpt_frames_at_write = frames
             self.checkpoint_writes += 1
             return True
 
-    @staticmethod
-    def _read_checkpoint(path: str) -> dict | None:
-        try:
-            with open(path, encoding="utf-8") as handle:
-                state = json.load(handle)
-        except FileNotFoundError:
-            return None
-        except (OSError, ValueError) as exc:
-            log.warning("ingest checkpoint %s unreadable (%s)", path, exc)
-            return None
-        if state.get("version") != DeltaIngest.CHECKPOINT_VERSION:
-            log.warning("ingest checkpoint %s version %r unsupported; "
-                        "ignoring", path, state.get("version"))
-            return None
-        return state
-
     def _load_checkpoint(self) -> None:
         """Synchronous index load at construction: cheap JSON only, no
-        parses. Both candidates, newest frame count wins — a crash
+        parses. Both candidates, newest write epoch wins — a crash
         between the wal's fsync and the rename leaves the newer state
-        in the .wal (the energy.py recovery rule)."""
-        main = self._read_checkpoint(self._ckpt_path)
-        wal = self._read_checkpoint(self._ckpt_path + ".wal")
-        state = main
-        if wal is not None and (state is None or wal.get("seq", 0)
-                                > state.get("seq", 0)):
-            state = wal
-            log.info("ingest checkpoint: recovering from the newer .wal "
-                     "(crash between fsync and rename)")
-        if state is None:
-            return
+        in the .wal (the shared wal.py recovery rule)."""
+        state = wal.load_newest(self._ckpt_path, self.CHECKPOINT_VERSION,
+                                label="ingest")
         # Resume the write epoch past BOTH candidates: this process's
         # first write must out-rank even the one not loaded, or a
         # later crash could resurrect it over newer fsynced state.
-        self._ckpt_seq = max(
-            main.get("seq", 0) if main is not None else 0,
-            wal.get("seq", 0) if wal is not None else 0)
+        # load_newest already returned the higher-seq candidate, so the
+        # winner's seq IS the max across both — no second read pass.
+        self._ckpt_seq = int(state.get("seq", 0)) if state is not None else 0
+        if state is None:
+            return
         max_order = 0
         for source, generation, seq, order, body in \
                 state.get("sessions", ()):

@@ -45,6 +45,14 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  throttle/drop rates, or parse errors. The per-node
                  companion of --fleet's correlated verdict; same
                  server fallback as --trace.
+  --egress       pull the RUNNING daemon's (or hub's) /debug/egress
+                 snapshot and summarize the durable-egress picture:
+                 spill-queue depth/age and accounted drops, durable
+                 remote-write shard WAL bytes/lag/parked-poison
+                 counts, and per-sender link health. WARN on data
+                 loss, a near-full spool, parked poison, or a down
+                 link; classified 401/404/disabled like --host. Same
+                 server fallback as --trace.
 
 Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
 2 = usage error. Every probe is time-bounded; doctor never hangs on a
@@ -809,6 +817,95 @@ def check_host(base: str) -> CheckResult:
                    data={"host": payload})
 
 
+def check_egress(base: str) -> CheckResult:
+    """--egress: read /debug/egress and summarize the durable-egress
+    picture — spill depth/age/loss, durable remote-write shard
+    WAL/lag/parked state, per-sender link health. Classified
+    401/404/disabled like --host: a WARN row diagnoses config, only a
+    broken surface FAILs."""
+    import urllib.error
+
+    try:
+        payload = _fetch_json(base + "/debug/egress")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "egress", WARN,
+                f"{base}/debug/egress requires authentication "
+                f"(HTTP {exc.code}); the egress snapshot sits behind "
+                f"the exporter's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "egress", WARN,
+                f"{base}: no /debug/egress (exporter predates the "
+                f"durable-egress layer, or this server has none wired)")
+        return _result("egress", FAIL,
+                       f"{base}/debug/egress: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable, bad JSON
+        return _result("egress", FAIL,
+                       f"{base}: egress snapshot unreadable ({exc})")
+    if not payload.get("enabled", True):
+        return _result(
+            "egress", WARN,
+            "no egress durability configured (--hub-spill-dir for the "
+            "delta publisher, --remote-write-wal-dir for the exporter); "
+            "a partition drops whatever it outlasts")
+    parts: list[str] = []
+    status = OK
+    spill = payload.get("spill")
+    if spill:
+        depth = spill.get("depth_frames", 0)
+        parts.append(
+            f"spill: {depth} frame(s) / {spill.get('bytes', 0)}B "
+            f"spooled, oldest {spill.get('oldest_age_seconds', 0):g}s")
+        if spill.get("dropped_total", 0):
+            status = WARN
+            parts.append(f"spill DROPPED {spill['dropped_total']} "
+                         f"frame(s) at the byte bound (data loss, "
+                         f"accounted — see kts_spill_dropped_total)")
+        max_bytes = spill.get("max_bytes") or 0
+        if max_bytes and spill.get("bytes", 0) > 0.8 * max_bytes:
+            status = WARN
+            parts.append("spill near its byte bound (>80%)")
+    remote = payload.get("remote_write")
+    if remote:
+        shards = remote.get("shards") or []
+        wal_bytes = sum(s.get("wal_bytes", 0) for s in shards)
+        lag = max((s.get("lag_seconds", 0.0) for s in shards),
+                  default=0.0)
+        parked = sum(s.get("parked_total", 0) for s in shards)
+        dropped = sum(s.get("dropped_total", 0) for s in shards)
+        parts.append(f"remote-write: {len(shards)} shard(s), "
+                     f"{wal_bytes}B WAL pending, lag {lag:g}s")
+        if parked:
+            status = WARN
+            parts.append(f"{parked} poison request(s) parked (receiver "
+                         f"rejects the payload — schema mismatch, not "
+                         f"an outage)")
+        if dropped:
+            status = WARN
+            parts.append(f"remote-write DROPPED {dropped} request(s) at "
+                         f"the WAL bound (accounted loss)")
+    down = {mode for mode, s in (payload.get("senders") or {}).items()
+            if s.get("consecutive_failures", 0) > 0}
+    # The durable senders deliberately pin consecutive_failures to 0
+    # (the backoff belongs to the probe / shard loop, not the publish
+    # cadence) — their link state lives in the spill queue's
+    # link_failures and the shards' own failure counts.
+    if spill and spill.get("link_failures", 0) > 0:
+        down.add("delta")
+    if remote and any(s.get("consecutive_failures", 0) > 0
+                      for s in remote.get("shards") or []):
+        down.add("remote_write")
+    if down:
+        status = WARN
+        parts.append("link down: " + ", ".join(sorted(down)))
+    if not parts:
+        parts.append("egress healthy; no backlog")
+    return _result("egress", status, "; ".join(parts),
+                   data={"egress": payload})
+
+
 def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
     """(status, detail line, data) for a /debug/fleet rollup: the
     slice post-mortem — worst node with its phase and blame, every
@@ -1140,7 +1237,8 @@ def run_checks(cfg: Config, url: str = "",
                trace: bool = False,
                fleet: bool = False,
                energy: bool = False,
-               host: bool = False) -> list[CheckResult]:
+               host: bool = False,
+               egress: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -1186,6 +1284,13 @@ def run_checks(cfg: Config, url: str = "",
                      if url.startswith(("http://", "https://"))
                      else f"http://127.0.0.1:{cfg.listen_port}")
         probes.append(("host", lambda: check_host(host_base)))
+    if egress:
+        # Same live-daemon fallback as --trace/--host: /debug/egress
+        # lives on the daemon's (or hub's) own server.
+        egress_base = (trace_base(url)
+                       if url.startswith(("http://", "https://"))
+                       else f"http://127.0.0.1:{cfg.listen_port}")
+        probes.append(("egress", lambda: check_egress(egress_base)))
     if fleet:
         # The fleet lens lives on the HUB, not the daemon: an http(s)
         # --url names the hub to read; otherwise fall back to a local
@@ -1252,6 +1357,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     fleet = False
     energy = False
     host = False
+    egress = False
     url = ""
     args: list[str] = []
     it = iter(raw)
@@ -1266,6 +1372,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             energy = True
         elif token == "--host":
             host = True
+        elif token == "--egress":
+            egress = True
         elif token == "--url":
             url = next(it, "")
             if not url or url.startswith("--"):
@@ -1283,7 +1391,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     cfg = from_args(args)
     started = time.monotonic()
     results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
-                         energy=energy, host=host)
+                         energy=energy, host=host, egress=egress)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

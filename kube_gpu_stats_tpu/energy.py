@@ -39,12 +39,11 @@ import hashlib
 import hmac as hmac_mod
 import json
 import logging
-import os
 import threading
 import time
 from typing import Callable, Sequence
 
-from . import schema
+from . import schema, wal
 
 log = logging.getLogger(__name__)
 
@@ -207,38 +206,15 @@ class EnergyAccountant:
             "ticks_observed": self.ticks_observed,
         }
 
-    @staticmethod
-    def _read_state(path: str) -> dict | None:
-        try:
-            with open(path, encoding="utf-8") as handle:
-                state = json.load(handle)
-        except FileNotFoundError:
-            return None
-        except (OSError, ValueError) as exc:
-            log.warning("energy checkpoint file %s unreadable (%s)",
-                        path, exc)
-            return None
-        if state.get("version") != CHECKPOINT_VERSION:
-            log.warning("energy checkpoint %s version %r unsupported; "
-                        "ignoring", path, state.get("version"))
-            return None
-        return state
-
     def _load(self) -> None:
-        # Both candidates, newest seq wins: a crash between the wal's
-        # fsync and the rename leaves the NEWER state in the .wal
-        # behind an older (or absent) main — loading main alone would
-        # restart counters below values Prometheus already scraped,
-        # exactly the phantom-reset the write-ahead discipline exists
-        # to prevent.
-        main = self._read_state(self._path)
-        wal = self._read_state(self._path + ".wal")
-        state = main
-        if wal is not None and (state is None
-                                or wal.get("seq", 0) > state.get("seq", 0)):
-            state = wal
-            log.info("energy checkpoint: recovering from the newer .wal "
-                     "(crash between fsync and rename)")
+        # Both candidates, newest seq wins (the shared wal.py recovery
+        # rule): a crash between the wal's fsync and the rename leaves
+        # the NEWER state in the .wal behind an older (or absent) main —
+        # loading main alone would restart counters below values
+        # Prometheus already scraped, exactly the phantom-reset the
+        # write-ahead discipline exists to prevent.
+        state = wal.load_newest(self._path, CHECKPOINT_VERSION,
+                                label="energy")
         if state is None:
             return
         for pod, namespace, joules in state.get("per_pod", ()):
@@ -267,17 +243,9 @@ class EnergyAccountant:
             with self._lock:
                 state = self._state()
                 self._dirty = False
-            wal = self._path + ".wal"
-            try:
-                os.makedirs(os.path.dirname(self._path) or ".",
-                            exist_ok=True)
-                with open(wal, "w", encoding="utf-8") as handle:
-                    json.dump(state, handle, separators=(",", ":"))
-                    handle.flush()
-                    os.fsync(handle.fileno())
-                os.replace(wal, self._path)
-            except OSError as exc:
-                log.warning("energy checkpoint write failed: %s", exc)
+            # Shared write-ahead discipline (wal.py): .wal + fsync +
+            # atomic rename, one implementation for every checkpoint.
+            if not wal.write_state(self._path, state, label="energy"):
                 self._dirty = True
                 return False
             self._last_write = now

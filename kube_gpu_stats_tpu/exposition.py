@@ -157,6 +157,7 @@ class MetricsServer:
                  trace_provider=None, fleet_provider=None,
                  ingest_provider=None, burst_provider=None,
                  energy_provider=None, host_provider=None,
+                 egress_provider=None,
                  prewarm_renders: bool = True,
                  ingest_read_deadline: float = 10.0):
         self._registry = registry
@@ -204,6 +205,13 @@ class MetricsServer:
         # (--no-host-stats) still answers, with enabled:false; None
         # (hubs, bare test servers) 404s.
         self._host = host_provider
+        # Egress-durability snapshot (ISSUE 13, duck-typed: () -> dict):
+        # serves /debug/egress — spill-queue depth/age, durable
+        # remote-write shard WAL/lag/parked state, sender health — the
+        # payload `doctor --egress` reads. A wired provider with
+        # nothing configured answers enabled:false (the --no-trace
+        # contract); None (bare test servers) 404s.
+        self._egress = egress_provider
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -593,6 +601,24 @@ class MetricsServer:
                                        sort_keys=True) + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/egress" and outer._egress is not None:
+                    # Egress durability (ISSUE 13): the spill queue's
+                    # and durable remote-write shards' backlog/lag/loss
+                    # accounting — behind the same auth gate as every
+                    # non-probe path. Mirrors /debug/host: a provider
+                    # with nothing configured answers enabled:false so
+                    # curl diagnoses config, not absence.
+                    import json
+
+                    try:
+                        payload = outer._egress()
+                    except Exception as exc:  # noqa: BLE001 - a status
+                        # walk must not 500 the whole debug surface.
+                        payload = {"enabled": False, "error": str(exc)}
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -637,6 +663,8 @@ class MetricsServer:
                         links += ["/debug/energy"]
                     if outer._host is not None:
                         links += ["/debug/host"]
+                    if outer._egress is not None:
+                        links += ["/debug/egress"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

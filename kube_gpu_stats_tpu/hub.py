@@ -44,7 +44,7 @@ from typing import Mapping, Sequence
 from . import delta as delta_mod
 from . import fleetlens, procstats, schema
 from .registry import (HistogramState, Registry, Series, SnapshotBuilder,
-                       contribute_push_stats)
+                       contribute_egress_stats, contribute_push_stats)
 from .resilience import CircuitBreaker
 from .top import (_COUNTER_BY_NAME, _GAUGE_BY_NAME, ChipRow, Frame,
                   fold_target)
@@ -456,7 +456,7 @@ class Hub:
                  expect_workers: int = 0, rollups_only: bool = False,
                  fetch_timeout: float = 5.0,
                  registry: Registry | None = None,
-                 render_stats=None, push_stats=None,
+                 render_stats=None, push_stats=None, egress_stats=None,
                  headers_provider=None,
                  target_ca_file: str = "",
                  target_insecure_tls: bool = False,
@@ -518,6 +518,12 @@ class Hub:
         # Shipping-health counters from attached push senders (same shape
         # as daemon._push_stats: mode -> {pushes, failures, dropped}).
         self._push_stats = push_stats
+        # Egress-durability status provider (ISSUE 13): a callable
+        # returning {"spill": ..., "remote_write": ...} status dicts
+        # from the hub's senders (leaf->root spill queue, durable
+        # remote-write shards) — folded as kts_spill_*/
+        # kts_remote_write_* on every publish.
+        self._egress_stats = egress_stats
         # Credentials for hardened exporters: called once per refresh
         # (file-backed tokens rotate without a restart) and sent to every
         # target. TLS options pass through to fetch_exposition.
@@ -1309,6 +1315,8 @@ class Hub:
             self._render_stats.contribute(builder)
         if self._push_stats is not None:
             contribute_push_stats(builder, self._push_stats())
+        if self._egress_stats is not None:
+            contribute_egress_stats(builder, self._egress_stats())
         # The hub's own process health (CPU, RSS, fds) — same process_*
         # families the daemon exports, so one dashboard covers both.
         procstats.contribute(builder, proc_readings)
@@ -2007,6 +2015,27 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--remote-write-protocol",
                         choices=("1.0", "2.0"), default="1.0")
     parser.add_argument("--remote-write-bearer-token-file", default="")
+    parser.add_argument("--remote-write-wal-dir", default="",
+                        help="durable exporter (ISSUE 13): per-shard "
+                             "write-ahead rings under this directory; "
+                             "snapshots journal to disk before sending "
+                             "and a receiver outage becomes late "
+                             "delivery, bounded and accounted. Empty = "
+                             "legacy best-effort")
+    parser.add_argument("--remote-write-shards", type=int, default=1,
+                        help="send shards for the durable exporter "
+                             "(series hash by identity; per-shard WAL, "
+                             "backoff, parked-poison ring). Needs "
+                             "--remote-write-wal-dir when > 1")
+    parser.add_argument("--remote-write-wal-max-bytes", type=int,
+                        default=64 * 1024 * 1024,
+                        help="per-shard WAL byte bound; past it the "
+                             "oldest segment is evicted, counted in "
+                             "kts_remote_write_dropped_total and "
+                             "journaled")
+    parser.add_argument("--remote-write-drain-max", type=int, default=64,
+                        help="max backlogged requests per shard per "
+                             "push cycle while catching up")
     parser.add_argument("--log-level", default="info",
                         choices=("debug", "info", "warning", "error"))
     # Fleet-lens / SLO + delta-push knobs: the SAME flag definitions the
@@ -2035,6 +2064,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         parser.error(guard_error)
     if args.ingest_lanes < 0 or args.ingest_lanes > 256:
         parser.error("--ingest-lanes must be 0 (auto) or 1..256")
+    if not 1 <= args.remote_write_shards <= 64:
+        parser.error("--remote-write-shards must be 1..64")
+    if args.remote_write_shards > 1 and not args.remote_write_wal_dir:
+        parser.error("--remote-write-shards > 1 needs "
+                     "--remote-write-wal-dir")
 
     # A long-running service needs visible logs (refresh failures, dropped
     # duplicates, credential problems); mirrors the daemon's text format.
@@ -2115,6 +2149,40 @@ def main(argv: Sequence[str] | None = None) -> int:
                 stats[mode]["shed_honored"] = sender.shed_honored_total
         return stats
 
+    def egress_payload() -> dict:
+        # /debug/egress for the hub: same shape as the daemon's (doctor
+        # --egress reads both), senders included.
+        payload = dict(egress_stats())
+        payload["enabled"] = bool(payload)
+        payload["senders"] = {
+            mode: {
+                "pushes_total": sender.pushes_total,
+                "failures_total": sender.failures_total,
+                "dropped_total": sender.dropped_total,
+                "consecutive_failures": sender.consecutive_failures,
+            }
+            for mode, sender in senders
+        }
+        return payload
+
+    def egress_stats() -> dict:
+        # Spill-queue + durable remote-write status (ISSUE 13), same
+        # shape as daemon._egress_stats — folded as kts_spill_*/
+        # kts_remote_write_* on the hub's own exposition.
+        out = {}
+        for _mode, sender in senders:
+            spill_fn = getattr(sender, "spill_status", None)
+            if callable(spill_fn):
+                status = spill_fn()
+                if status is not None:
+                    out["spill"] = status
+            egress_fn = getattr(sender, "egress_status", None)
+            if callable(egress_fn):
+                status = egress_fn()
+                if status is not None:
+                    out["remote_write"] = status
+        return out
+
     hub = Hub(targets, interval=args.interval,
               expect_workers=args.expect_workers,
               rollups_only=args.rollups_only,
@@ -2123,6 +2191,9 @@ def main(argv: Sequence[str] | None = None) -> int:
               push_stats=push_stats if (args.pushgateway_url
                                         or args.remote_write_url
                                         or args.hub_url) else None,
+              egress_stats=egress_stats if (args.remote_write_wal_dir
+                                            or args.hub_spill_dir)
+              else None,
               headers_provider=headers_provider,
               target_ca_file=args.target_ca_file,
               target_insecure_tls=args.target_insecure_tls,
@@ -2171,7 +2242,12 @@ def main(argv: Sequence[str] | None = None) -> int:
             protocol=args.remote_write_protocol,
             bearer_token_file=args.remote_write_bearer_token_file,
             extra_labels=extra_labels,
-            render_stats=render_stats)))
+            render_stats=render_stats,
+            shards=args.remote_write_shards,
+            wal_dir=args.remote_write_wal_dir,
+            wal_max_bytes=args.remote_write_wal_max_bytes,
+            drain_max_per_push=args.remote_write_drain_max,
+            tracer=hub.tracer)))
     if args.hub_url:
         # Federation leaf: push this hub's merged rollup exposition to
         # the parent (root) hub over the same delta protocol the
@@ -2181,6 +2257,16 @@ def main(argv: Sequence[str] | None = None) -> int:
 
         from .delta import DeltaPublisher, push_headers_provider
 
+        # Partition survival (ISSUE 13): a leaf hub spools its rollup
+        # snapshots while the root is unreachable exactly like a daemon
+        # spools for its leaf — the same flags, the same drain contract.
+        spill = None
+        if args.hub_spill_dir:
+            from .spillq import SpillQueue
+
+            spill = SpillQueue(args.hub_spill_dir,
+                               max_bytes=args.hub_spill_max_bytes,
+                               tracer=hub.tracer)
         senders.append(("delta", DeltaPublisher(
             hub.registry, args.hub_url,
             source=args.hub_push_source or (
@@ -2192,7 +2278,9 @@ def main(argv: Sequence[str] | None = None) -> int:
                 args.hub_auth_username, args.hub_auth_password_file),
             ca_file=args.hub_ca_file,
             insecure_tls=args.hub_insecure_tls,
-            tracer=hub.tracer)))
+            tracer=hub.tracer,
+            spill=spill,
+            drain_rate=args.hub_drain_rate)))
 
     if args.once:
         frame = hub.refresh_once()
@@ -2217,7 +2305,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         ready_check=hub.ready,
         trace_provider=hub.tracer,
         fleet_provider=hub.fleet,
-        ingest_provider=hub.delta.handle if hub.delta is not None else None)
+        ingest_provider=hub.delta.handle if hub.delta is not None else None,
+        egress_provider=egress_payload)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

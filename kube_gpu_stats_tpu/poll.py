@@ -52,7 +52,7 @@ from .fleetlens import contribute_trace_digest
 from .ici import RateTracker
 from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
                        Series, SnapshotBuilder, _series_prefix,
-                       contribute_push_stats)
+                       contribute_egress_stats, contribute_push_stats)
 from .resilience import DeadlineBudget
 from .tracing import Tracer, log_every
 from .workers import DaemonSamplerPool
@@ -280,6 +280,7 @@ class PollLoop:
         disabled_metrics: frozenset[str] = frozenset(),
         process_openers: Callable[[str], Sequence[tuple[str, str, str, float]]] | None = None,
         push_stats: Callable[[], Mapping[str, Mapping[str, int]]] | None = None,
+        egress_stats: Callable[[], Mapping] | None = None,
         render_stats: Callable[[SnapshotBuilder], None] | None = None,
         health_stats: Callable[[SnapshotBuilder], None] | None = None,
         heartbeat: Callable[[], None] | None = None,
@@ -318,6 +319,10 @@ class PollLoop:
         # Shipping-health counters from the push senders (daemon-wired
         # callable; reads plain ints, safe from this thread).
         self._push_stats = push_stats
+        # Egress-durability status from the spill queue / durable
+        # remote-write exporter (ISSUE 13; daemon-wired callable
+        # returning {"spill": ..., "remote_write": ...} status dicts).
+        self._egress_stats = egress_stats
         # Scrape/render self-observability contributor (daemon wires
         # RenderStats.contribute): folds scrape-duration histograms and
         # rendered-bytes counters into each snapshot.
@@ -1536,6 +1541,11 @@ class PollLoop:
             )
         if self._push_stats is not None:
             contribute_push_stats(builder, self._push_stats())
+        if self._egress_stats is not None:
+            # Spill / durable remote-write health (ISSUE 13): the
+            # kts_spill_* and kts_remote_write_* families ride every
+            # snapshot where the features are on.
+            contribute_egress_stats(builder, self._egress_stats())
         # Render-lock contention (ISSUE 12 satellite): cumulative
         # seconds readers waited to enter Registry.rendered() — the
         # scrape-p99 watch item's first suspect, kept ~0 by the

@@ -1224,6 +1224,121 @@ DELTA_SHED_HONORED = MetricSpec(
     "tight for the fleet's cadence (ISSUE 12).",
     extra_labels=("mode",),
 )
+# Egress-durability families (ISSUE 13): the node-side spill queue
+# (spillq.py — a partitioned publisher's late-but-complete record) and
+# the WAL-backed sharded remote_write exporter (remote_write.py). Both
+# ends of the data path self-report their backlog, their lag, and —
+# critically — their accounted loss: a bounded queue that drops silently
+# is a hole, one that counts and journals is an audit line.
+
+SPILL_FRAMES = MetricSpec(
+    "kts_spill_frames_total",
+    MetricType.COUNTER,
+    "Delta-push snapshots through the disk spill queue, by state: "
+    "'spooled' (published while the hub link was down — written to the "
+    "bounded on-disk ring instead of dropped) and 'drained' (sent to "
+    "the hub on reconnect, oldest-first, drain-rate limited). spooled "
+    "minus drained minus kts_spill_dropped_total is the live backlog "
+    "(kts_spill_depth_frames).",
+    extra_labels=("state",),
+)
+SPILL_DROPPED = MetricSpec(
+    "kts_spill_dropped_total",
+    MetricType.COUNTER,
+    "Spooled snapshots dropped OLDEST-FIRST because the spill queue hit "
+    "--hub-spill-max-bytes: the partition outlasted the spool bound, "
+    "and this counter (plus the spill_drop journal event) is the "
+    "accounting for exactly how much record was lost. Size the bound "
+    "from the OPERATIONS.md spool table so the partitions you plan for "
+    "fit; alert on any increase (SpillDataLoss).",
+)
+SPILL_DEPTH = MetricSpec(
+    "kts_spill_depth_frames",
+    MetricType.GAUGE,
+    "Snapshots currently spooled on disk awaiting drain. 0 when the "
+    "hub link is healthy; rising during a partition; falling at "
+    "--hub-drain-rate after reconnect. Near the byte bound "
+    "(kts_spill_bytes vs the configured max) means the next frames "
+    "start dropping oldest-first (SpillNearFull).",
+)
+SPILL_BYTES = MetricSpec(
+    "kts_spill_bytes",
+    MetricType.GAUGE,
+    "Bytes the spill queue holds on disk (snappy-compressed snapshots "
+    "+ record framing), against --hub-spill-max-bytes.",
+)
+SPILL_OLDEST = MetricSpec(
+    "kts_spill_oldest_seconds",
+    MetricType.GAUGE,
+    "Age of the oldest spooled snapshot — how far behind this node's "
+    "contribution to the fleet record currently is. Falls to 0 as the "
+    "drain completes; stuck high with a nonzero depth means the drain "
+    "is failing (link still down, or the hub shedding hard).",
+)
+REMOTE_WRITE_SHARDS = MetricSpec(
+    "kts_remote_write_shards",
+    MetricType.GAUGE,
+    "Send shards the durable remote-write exporter runs "
+    "(--remote-write-shards): series hash to a shard by identity, each "
+    "shard owns its own WAL segment ring, retry/backoff state and "
+    "parked-poison ring. Absent in legacy best-effort mode (no "
+    "--remote-write-wal-dir).",
+)
+REMOTE_WRITE_WAL_BYTES = MetricSpec(
+    "kts_remote_write_wal_bytes",
+    MetricType.GAUGE,
+    "Bytes pending in this shard's write-ahead segment ring (encoded, "
+    "compressed WriteRequests not yet acknowledged by the receiver). "
+    "Bounded by --remote-write-wal-max-bytes per shard; at the bound "
+    "the OLDEST segment is evicted whole and counted in "
+    "kts_remote_write_dropped_total.",
+    extra_labels=("shard",),
+)
+REMOTE_WRITE_LAG = MetricSpec(
+    "kts_remote_write_lag_seconds",
+    MetricType.GAUGE,
+    "How stale the receiver's view of this shard is: the age of the "
+    "oldest still-undelivered WAL request while a backlog exists "
+    "(grows through a receiver outage — the case the alert exists "
+    "for), else the send-time minus sample-time of the newest "
+    "delivered request (~the push interval when healthy). Shrinks as "
+    "the drain catches up (RemoteWriteLagHigh alerts on it).",
+    extra_labels=("shard",),
+)
+REMOTE_WRITE_PARKED = MetricSpec(
+    "kts_remote_write_parked_total",
+    MetricType.COUNTER,
+    "Poison requests parked by this shard: the receiver answered a "
+    "non-retryable 4xx (bad payload, not a bad network), so retrying "
+    "would wedge the queue forever behind one request. The request is "
+    "moved to the shard's bounded parked ring for post-mortem and the "
+    "drain continues. A steady rate means a schema/receiver mismatch, "
+    "not an outage.",
+    extra_labels=("shard",),
+)
+REMOTE_WRITE_DROPPED = MetricSpec(
+    "kts_remote_write_dropped_total",
+    MetricType.COUNTER,
+    "Pending WriteRequests dropped OLDEST-FIRST because a shard's WAL "
+    "ring hit its byte bound — the receiver outage outlasted the WAL. "
+    "Counted and journaled (remote_write_drop event) so the gap in the "
+    "TSDB is an audited number, not a silent hole.",
+    extra_labels=("shard",),
+)
+
+EGRESS_METRICS: tuple[MetricSpec, ...] = (
+    SPILL_FRAMES,
+    SPILL_DROPPED,
+    SPILL_DEPTH,
+    SPILL_BYTES,
+    SPILL_OLDEST,
+    REMOTE_WRITE_SHARDS,
+    REMOTE_WRITE_WAL_BYTES,
+    REMOTE_WRITE_LAG,
+    REMOTE_WRITE_PARKED,
+    REMOTE_WRITE_DROPPED,
+)
+
 RENDER_PREWARM_WAIT = MetricSpec(
     "kts_render_prewarm_wait_seconds_total",
     MetricType.COUNTER,
@@ -1338,6 +1453,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_PUSH_FAILURES,
     SELF_PUSH_DROPPED,
     DELTA_SHED_HONORED,
+    *EGRESS_METRICS,
     RENDER_PREWARM_WAIT,
     BREAKER_STATE,
     BREAKER_TRIPS,

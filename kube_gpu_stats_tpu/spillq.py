@@ -1,0 +1,144 @@
+"""Node-side disk spill queue for the delta publisher (ISSUE 13).
+
+A daemon whose hub link is down used to silently drop every tick it
+sampled: the publisher's backoff stretched the push cadence, each
+failed push lost that snapshot, and the fleet record grew a hole the
+width of the partition. The spill queue closes the hole — while the
+link is down, every published snapshot spools to a bounded on-disk ring
+(the shared :mod:`wal` SegmentRing: CRC-framed segments, fsync per
+record, torn tails truncated on recovery) with its publish wall time;
+on reconnect the publisher drains the backlog OLDEST-FIRST through a
+drain-rate token bucket (a recovering hub must never be stampeded by
+its own returning fleet) and then resumes live deltas. A partition thus
+produces a late-but-complete record instead of a gap, and a partition
+longer than the spool bound loses oldest-first with the loss counted
+(``kts_spill_dropped_total``) and journaled — bounded loss is only
+acceptable when it is accounted.
+
+Bodies spool snappy-compressed (the rendered exposition text is highly
+compressible; the bench's ``spill_bytes_per_tick`` field prices the
+spool growth rate, which is what the OPERATIONS.md sizing table is
+derived from)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from . import snappy
+from .wal import SegmentRing
+
+log = logging.getLogger(__name__)
+
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+
+class SpillQueue:
+    """Bounded, crash-recoverable FIFO of (publish wall time, rendered
+    exposition body) — DeltaPublisher's offline buffer. Single-writer
+    (the publisher thread); ``status()`` snapshots are safe from HTTP
+    handler threads (the ring's own lock)."""
+
+    def __init__(self, directory: str, *,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 fsync: bool = True, tracer=None) -> None:
+        self._ring = SegmentRing(directory, max_bytes=max_bytes,
+                                 segment_bytes=min(1 << 20, max_bytes),
+                                 prefix="spill", fsync=fsync,
+                                 label="spill")
+        self._tracer = tracer
+        self.spooled_total = 0
+        self.drained_total = 0
+        # CRC-valid records that still failed snappy/utf-8 decode
+        # (version skew) — consumed without delivery, so the loss stays
+        # accounted: spooled == drained + dropped + undecodable + depth.
+        self.undecodable_total = 0
+        if self._ring.records_pending():
+            # A restart with a backlog on disk resumes the drain where
+            # the dead process stopped (minus the at-least-once cursor
+            # window) — the crash-mid-partition case.
+            log.info("spill queue: %d frame(s) recovered from disk",
+                     self._ring.records_pending())
+
+    @property
+    def dropped_total(self) -> int:
+        """Frames lost oldest-first to the byte bound — the counted,
+        journaled data-loss number the partition sim pins."""
+        return self._ring.evicted_records
+
+    @property
+    def torn_total(self) -> int:
+        return self._ring.torn_records
+
+    def spool(self, ts: float, body: str) -> int:
+        """Durably append one snapshot; returns (and journals) how many
+        OLDEST frames were evicted to stay under the bound."""
+        dropped = self._ring.append(ts, snappy.compress(body.encode()))
+        self.spooled_total += 1
+        if dropped and self._tracer is not None:
+            self._tracer.event(
+                "spill_drop",
+                f"spill queue over its byte bound: dropped {dropped} "
+                f"oldest frame(s) (kts_spill_dropped_total "
+                f"{self.dropped_total})")
+        return dropped
+
+    def peek(self) -> tuple[float, str] | None:
+        """Oldest unsent (ts, body) — send first, :meth:`commit` after
+        the hub acked, so a crash mid-drain re-sends rather than loses.
+        Records that somehow pass CRC but won't decode (version skew)
+        are skipped with a warning — a loop, not recursion: a badly
+        damaged spool must not blow the stack either."""
+        while True:
+            record = self._ring.peek()
+            if record is None:
+                return None
+            ts, payload = record
+            try:
+                return ts, snappy.decompress(payload).decode()
+            except (ValueError, UnicodeDecodeError) as exc:
+                # Drop it rather than wedge the drain forever on one
+                # frame — counted, never silent (the accounting
+                # invariant the partition sim pins).
+                log.warning("spill queue: dropping undecodable frame: %s",
+                            exc)
+                self.undecodable_total += 1
+                self._ring.commit()
+
+    def commit(self) -> None:
+        self._ring.commit()
+        self.drained_total += 1
+
+    def save_cursor(self, force: bool = False) -> None:
+        self._ring.save_cursor(force)
+
+    def depth(self) -> int:
+        return self._ring.records_pending()
+
+    def bytes_pending(self) -> int:
+        return self._ring.bytes_pending()
+
+    def oldest_age(self, now: float | None = None) -> float:
+        """Seconds the oldest spooled frame has waited (0 when empty) —
+        the 'how far behind is this node's record' gauge."""
+        oldest = self._ring.oldest_ts()
+        if oldest is None:
+            return 0.0
+        return max(0.0, (now if now is not None else time.time()) - oldest)
+
+    def status(self) -> dict:
+        ring = self._ring.status()
+        return {
+            "depth_frames": ring["records"],
+            "bytes": ring["bytes"],
+            "max_bytes": ring["max_bytes"],
+            "oldest_age_seconds": round(self.oldest_age(), 3),
+            "spooled_total": self.spooled_total,
+            "drained_total": self.drained_total,
+            "dropped_total": self.dropped_total,
+            "undecodable_total": self.undecodable_total,
+            "torn_total": self.torn_total,
+        }
+
+    def close(self) -> None:
+        self._ring.close()

@@ -389,6 +389,24 @@ def retry_after_seconds(headers, default: float = 1.0,
     return min(seconds, cap)
 
 
+def classify_push_status(code: int) -> str:
+    """Retry classification for push-sender HTTP responses, shared by
+    the durable remote-write shards and doctor's receiver probe:
+    'ok' (2xx — the receiver took it), 'retryable' (429, any 5xx, and
+    3xx — the no-redirect openers surface redirects as failures; the
+    network or the receiver's load is the problem, the payload is
+    fine), or 'poison' (any other 4xx — the PAYLOAD is wrong, and
+    retrying it would wedge a durable queue forever behind one bad
+    request; park it and move on). 415 is returned as 'poison' here —
+    the remote-write 2.0 downgrade special-case is the caller's
+    protocol knowledge, not retry classification."""
+    if 200 <= code < 300:
+        return "ok"
+    if code == 429 or code >= 500 or 300 <= code < 400:
+        return "retryable"
+    return "poison"
+
+
 def auth_headers(bearer_token_file: str = "", username: str = "",
                  password_file: str = "") -> dict:
     """Authorization header from file-backed credentials, re-read per

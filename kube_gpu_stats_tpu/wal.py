@@ -1,0 +1,483 @@
+"""Shared write-ahead-log discipline (ISSUE 13 satellite).
+
+Three subsystems independently grew the same durability recipe — the
+energy checkpoint (PR 7), the ingest session checkpoint (PR 10), and
+now the egress layer's spill queue / exporter segments (ISSUE 13).
+This module is the single implementation of both halves:
+
+- **Atomic JSON state** (:func:`write_state` / :func:`load_newest`):
+  full state to ``<path>.wal``, fsync, atomic rename over ``<path>``;
+  recovery reads BOTH candidates and the higher monotone ``seq`` wins —
+  a crash between the wal's fsync and the rename leaves the NEWER
+  fsynced state shadowed behind an older (or absent) main file, and
+  loading main alone would restart counters below already-published
+  values. Every state dict must carry a ``seq`` the writer increments.
+
+- **Bounded binary record log** (:class:`SegmentRing`): an append-only
+  ring of CRC-framed segment files in one directory — the spill queue's
+  frame store and the remote-write exporter's per-shard WAL. Appends go
+  to the tail segment (fsynced per append by default — these logs exist
+  exactly for the crash case); reads drain oldest-first through a
+  persistent cursor; when the ring exceeds its byte bound the OLDEST
+  segment is evicted whole and the evicted record count is returned to
+  the caller, which must count and journal it (bounded loss is a
+  feature only when it is accounted). Torn tails (a crash mid-append)
+  are truncated at the first bad CRC on recovery, never a raise.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+import zlib
+
+log = logging.getLogger(__name__)
+
+# One record's frame header: wall timestamp (f64), payload byte length
+# (u32), crc32 of the payload (u32). A record is readable iff the
+# header fits, the length fits the file, and the CRC matches — anything
+# else is a torn tail.
+_RECORD = struct.Struct("<dII")
+
+# Segment files: <dir>/<prefix>-<seq>.seg, seq monotone per directory.
+_SEG_SUFFIX = ".seg"
+
+
+# -- atomic JSON state (the checkpoint half) --------------------------------
+
+def write_state(path: str, state: dict, *, label: str = "state") -> bool:
+    """Write-ahead persist of one JSON state dict: full state to
+    ``<path>.wal``, fsync, atomic rename over ``<path>``. Returns False
+    (with a warning) on OSError — callers keep their dirty flag set and
+    retry on their own cadence."""
+    wal = path + ".wal"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(wal, "w", encoding="utf-8") as handle:
+            json.dump(state, handle, separators=(",", ":"))
+            handle.flush()
+            os.fsync(handle.fileno())
+        os.replace(wal, path)
+    except OSError as exc:
+        log.warning("%s checkpoint write failed: %s", label, exc)
+        return False
+    return True
+
+
+def read_state(path: str, version: int, *, label: str = "state",
+               version_key: str = "version") -> dict | None:
+    """One candidate file: None on absent/unreadable/garbage/
+    version-mismatch (each non-absent failure logged)."""
+    try:
+        with open(path, encoding="utf-8") as handle:
+            state = json.load(handle)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as exc:
+        log.warning("%s checkpoint %s unreadable (%s)", label, path, exc)
+        return None
+    if not isinstance(state, dict) or state.get(version_key) != version:
+        log.warning("%s checkpoint %s version %r unsupported; ignoring",
+                    label, path,
+                    state.get(version_key) if isinstance(state, dict)
+                    else type(state).__name__)
+        return None
+    return state
+
+
+def load_newest(path: str, version: int, *, label: str = "state",
+                seq_key: str = "seq") -> dict | None:
+    """Both candidates (main + ``.wal``), highest ``seq_key`` wins —
+    the crash-between-fsync-and-rename recovery rule every WAL user
+    shares. The winner's ``seq_key`` IS the max across both candidates,
+    so a restarting writer re-seeds its write epoch from the returned
+    state directly (:func:`newest_seq` re-reads both files; callers
+    that already hold the loaded state never need it)."""
+    main = read_state(path, version, label=label)
+    wal = read_state(path + ".wal", version, label=label)
+    state = main
+    if wal is not None and (state is None
+                            or wal.get(seq_key, 0) > state.get(seq_key, 0)):
+        state = wal
+        log.info("%s checkpoint: recovering from the newer .wal (crash "
+                 "between fsync and rename)", label)
+    return state
+
+
+def newest_seq(path: str, version: int, *, label: str = "state",
+               seq_key: str = "seq") -> int:
+    """Highest write epoch across BOTH candidate files (0 when neither
+    exists) — what a restarting writer must resume past."""
+    best = 0
+    for candidate in (path, path + ".wal"):
+        state = read_state(candidate, version, label=label)
+        if state is not None:
+            best = max(best, int(state.get(seq_key, 0)))
+    return best
+
+
+# -- bounded binary record log (the queue half) -----------------------------
+
+class SegmentRing:
+    """Bounded, crash-recoverable FIFO of (timestamp, payload) records
+    over CRC-framed segment files.
+
+    Single-writer/single-reader by contract (the publisher thread or a
+    shard sender owns its ring); the small lock only protects status()
+    snapshots from HTTP handler threads. Appends land in the tail
+    segment and roll to a new one at ``segment_bytes``; the ring
+    evicts whole OLDEST segments once total bytes exceed ``max_bytes``
+    (returning the evicted record count so the caller accounts the
+    loss). The read cursor (segment seq + record index) persists as a
+    tiny JSON state on the :func:`write_state` discipline so a restart
+    resumes the drain instead of replaying what was already shipped —
+    rate-limited by the caller via :meth:`save_cursor`.
+    """
+
+    CURSOR_VERSION = 1
+
+    def __init__(self, directory: str, *, max_bytes: int,
+                 segment_bytes: int = 1 << 20, prefix: str = "wal",
+                 fsync: bool = True, label: str = "segment-ring") -> None:
+        self._dir = directory
+        self._max_bytes = max(segment_bytes, max_bytes)
+        self._segment_bytes = segment_bytes
+        self._prefix = prefix
+        self._fsync = fsync
+        self._label = label
+        self._lock = threading.Lock()
+        # seg seq -> [(ts, payload), ...] for every live segment; the
+        # tail segment additionally has an open append handle. Records
+        # are small relative to max_bytes (frames/requests), so keeping
+        # the live window in memory is the simple-and-bounded choice —
+        # disk is the crash copy, memory is the working set.
+        self._segments: dict[int, list[tuple[float, bytes]]] = {}
+        self._sizes: dict[int, int] = {}
+        self._tail_seq = 0
+        self._tail_handle = None
+        self._tail_size = 0
+        # Read cursor: first unconsumed record is (cursor_seg,
+        # cursor_idx) in segment order.
+        self._cursor_seg = 0
+        self._cursor_idx = 0
+        self._cursor_dirty = False
+        self._cursor_epoch = 0
+        self.torn_records = 0     # truncated at recovery (crash tails)
+        self.evicted_records = 0  # dropped oldest-first at the byte cap
+        self.appended_records = 0
+        os.makedirs(directory, exist_ok=True)
+        self._recover()
+
+    # -- recovery -------------------------------------------------------------
+
+    def _seg_path(self, seq: int) -> str:
+        return os.path.join(self._dir, f"{self._prefix}-{seq:08d}"
+                            + _SEG_SUFFIX)
+
+    def _cursor_path(self) -> str:
+        return os.path.join(self._dir, self._prefix + "-cursor.json")
+
+    @staticmethod
+    def _read_segment(path: str) -> tuple[list[tuple[float, bytes]], int]:
+        """(records, torn) for one segment file: stop at the first
+        truncated/corrupt record — a crash mid-append tears only the
+        tail, and everything before it is CRC-proven intact."""
+        records: list[tuple[float, bytes]] = []
+        torn = 0
+        try:
+            with open(path, "rb") as handle:
+                data = handle.read()
+        except OSError:
+            return records, 1
+        pos = 0
+        header = _RECORD.size
+        while pos + header <= len(data):
+            ts, length, crc = _RECORD.unpack_from(data, pos)
+            end = pos + header + length
+            if end > len(data):
+                torn = 1
+                break
+            payload = data[pos + header:end]
+            if zlib.crc32(payload) != crc:
+                torn = 1
+                break
+            records.append((ts, payload))
+            pos = end
+        if pos < len(data) and not torn:
+            torn = 1
+        return records, torn
+
+    def _recover(self) -> None:
+        seqs = []
+        for name in os.listdir(self._dir):
+            if name.startswith(self._prefix + "-") and \
+                    name.endswith(_SEG_SUFFIX + ".wal"):
+                # Orphaned rewrite temp: a crash between a torn-tail
+                # rewrite and its os.replace. The .seg it shadowed was
+                # (or is about to be) re-recovered from its own intact
+                # prefix; the temp would otherwise sit outside the
+                # byte accounting forever.
+                try:
+                    os.unlink(os.path.join(self._dir, name))
+                except OSError:
+                    pass
+                continue
+            if name.startswith(self._prefix + "-") and \
+                    name.endswith(_SEG_SUFFIX):
+                try:
+                    seqs.append(int(name[len(self._prefix) + 1:
+                                         -len(_SEG_SUFFIX)]))
+                except ValueError:
+                    continue
+        for seq in sorted(seqs):
+            records, torn = self._read_segment(self._seg_path(seq))
+            if torn:
+                self.torn_records += torn
+                # Rewrite the proven-intact prefix so the torn bytes
+                # never come back on the NEXT recovery.
+                self._rewrite_segment(seq, records)
+            self._segments[seq] = records
+            self._sizes[seq] = sum(_RECORD.size + len(p)
+                                   for _t, p in records)
+        self._tail_seq = max(seqs) if seqs else 0
+        cursor = read_state(self._cursor_path(), self.CURSOR_VERSION,
+                            label=self._label + " cursor")
+        if cursor is not None:
+            self._cursor_seg = int(cursor.get("segment", 0))
+            self._cursor_idx = int(cursor.get("record", 0))
+            self._cursor_epoch = int(cursor.get("seq", 0))
+        self._drop_consumed_segments()
+        # Clamp a cursor pointing past reality (records torn behind it).
+        live = self._live_segments()
+        if live:
+            first = live[0]
+            if self._cursor_seg < first:
+                self._cursor_seg, self._cursor_idx = first, 0
+            elif self._cursor_seg in self._segments:
+                self._cursor_idx = min(
+                    self._cursor_idx, len(self._segments[self._cursor_seg]))
+        else:
+            self._cursor_seg = self._tail_seq
+            self._cursor_idx = 0
+
+    def _rewrite_segment(self, seq: int,
+                         records: list[tuple[float, bytes]]) -> None:
+        path = self._seg_path(seq)
+        try:
+            if not records:
+                os.unlink(path)
+                return
+            tmp = path + ".wal"
+            with open(tmp, "wb") as handle:
+                for ts, payload in records:
+                    handle.write(_RECORD.pack(ts, len(payload),
+                                              zlib.crc32(payload)))
+                    handle.write(payload)
+                handle.flush()
+                os.fsync(handle.fileno())
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("%s: segment %d rewrite failed: %s",
+                        self._label, seq, exc)
+
+    # -- write side -----------------------------------------------------------
+
+    def append(self, ts: float, payload: bytes) -> int:
+        """Durably append one record; returns how many OLDEST records
+        were evicted to stay under the byte bound (0 almost always —
+        the caller counts and journals any loss)."""
+        size = _RECORD.size + len(payload)
+        with self._lock:
+            if self._tail_handle is None or \
+                    self._tail_size + size > self._segment_bytes:
+                self._roll_tail()
+            handle = self._tail_handle
+            if handle is not None:
+                try:
+                    handle.write(_RECORD.pack(ts, len(payload),
+                                              zlib.crc32(payload)))
+                    handle.write(payload)
+                    handle.flush()
+                    if self._fsync:
+                        os.fsync(handle.fileno())
+                except OSError as exc:
+                    log.warning("%s: append failed: %s", self._label, exc)
+                    # The in-memory copy still queues it (disk lost the
+                    # crash copy, not the record).
+            self._segments.setdefault(self._tail_seq, []).append(
+                (ts, payload))
+            self._tail_size += size
+            self._sizes[self._tail_seq] = self._tail_size
+            self.appended_records += 1
+            return self._evict_over_bound()
+
+    def _roll_tail(self) -> None:
+        if self._tail_handle is not None:
+            try:
+                self._tail_handle.close()
+            except OSError:
+                pass
+        self._tail_seq += 1
+        self._tail_size = self._sizes.get(self._tail_seq, 0)
+        self._segments.setdefault(self._tail_seq, [])
+        try:
+            self._tail_handle = open(self._seg_path(self._tail_seq), "ab")
+        except OSError as exc:
+            log.warning("%s: cannot open segment %d: %s",
+                        self._label, self._tail_seq, exc)
+            self._tail_handle = None
+
+    def _evict_over_bound(self) -> int:
+        evicted = 0
+        while self.bytes_pending() > self._max_bytes:
+            live = self._live_segments()
+            if len(live) <= 1:
+                break  # never evict the open tail out from under itself
+            victim = live[0]
+            records = self._segments.pop(victim, [])
+            self._sizes.pop(victim, None)
+            start = self._cursor_idx if victim == self._cursor_seg else 0
+            evicted += max(0, len(records) - start)
+            if self._cursor_seg <= victim:
+                self._cursor_seg = victim + 1
+                self._cursor_idx = 0
+                self._cursor_dirty = True
+            try:
+                os.unlink(self._seg_path(victim))
+            except OSError:
+                pass
+        if evicted:
+            self.evicted_records += evicted
+        return evicted
+
+    # -- read side ------------------------------------------------------------
+
+    def _live_segments(self) -> list[int]:
+        return sorted(self._segments)
+
+    def _advance_to_records(self) -> bool:
+        """Move the cursor past exhausted segments; True when a record
+        is available at the cursor."""
+        while True:
+            records = self._segments.get(self._cursor_seg)
+            if records is None:
+                nxt = [s for s in self._segments if s > self._cursor_seg]
+                if not nxt:
+                    return False
+                self._cursor_seg = min(nxt)
+                self._cursor_idx = 0
+                continue
+            if self._cursor_idx < len(records):
+                return True
+            if self._cursor_seg == self._tail_seq:
+                return False  # drained to the live tail
+            self._drop_segment(self._cursor_seg)
+
+    def _drop_segment(self, seq: int) -> None:
+        self._segments.pop(seq, None)
+        self._sizes.pop(seq, None)
+        try:
+            os.unlink(self._seg_path(seq))
+        except OSError:
+            pass
+        nxt = [s for s in self._segments if s > seq]
+        self._cursor_seg = min(nxt) if nxt else self._tail_seq
+        self._cursor_idx = 0
+
+    def _drop_consumed_segments(self) -> None:
+        for seq in list(self._live_segments()):
+            if seq < self._cursor_seg and seq != self._tail_seq:
+                self._segments.pop(seq, None)
+                self._sizes.pop(seq, None)
+                try:
+                    os.unlink(self._seg_path(seq))
+                except OSError:
+                    pass
+
+    def peek(self) -> tuple[float, bytes] | None:
+        """Oldest unconsumed record without consuming it (send first,
+        commit after the receiver acked — at-least-once, never lossy)."""
+        with self._lock:
+            if not self._advance_to_records():
+                return None
+            return self._segments[self._cursor_seg][self._cursor_idx]
+
+    def commit(self) -> None:
+        """Consume the record :meth:`peek` returned. The cursor is
+        persisted separately (:meth:`save_cursor`) so a crash between
+        commit and save re-sends at most the uncheckpointed window."""
+        with self._lock:
+            if self._advance_to_records():
+                self._cursor_idx += 1
+                self._cursor_dirty = True
+
+    def save_cursor(self, force: bool = False) -> bool:
+        with self._lock:
+            if not self._cursor_dirty and not force:
+                return False
+            self._cursor_epoch += 1
+            state = {"version": self.CURSOR_VERSION,
+                     "seq": self._cursor_epoch,
+                     "segment": self._cursor_seg,
+                     "record": self._cursor_idx}
+            self._cursor_dirty = False
+        return write_state(self._cursor_path(), state,
+                           label=self._label + " cursor")
+
+    # -- introspection --------------------------------------------------------
+
+    def records_pending(self) -> int:
+        with self._lock:
+            return self._pending_locked()
+
+    def _pending_locked(self) -> int:
+        total = 0
+        for seq, records in self._segments.items():
+            if seq < self._cursor_seg:
+                continue
+            start = self._cursor_idx if seq == self._cursor_seg else 0
+            total += max(0, len(records) - start)
+        return total
+
+    def bytes_pending(self) -> int:
+        total = 0
+        for seq, records in self._segments.items():
+            if seq < self._cursor_seg:
+                continue
+            start = self._cursor_idx if seq == self._cursor_seg else 0
+            total += sum(_RECORD.size + len(p)
+                         for _t, p in records[start:])
+        return total
+
+    def oldest_ts(self) -> float | None:
+        """Wall timestamp of the oldest unconsumed record (spool age =
+        now - this)."""
+        with self._lock:
+            if not self._advance_to_records():
+                return None
+            return self._segments[self._cursor_seg][self._cursor_idx][0]
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "records": self._pending_locked(),
+                "bytes": self.bytes_pending(),
+                "segments": len(self._segments),
+                "appended_total": self.appended_records,
+                "evicted_total": self.evicted_records,
+                "torn_total": self.torn_records,
+                "max_bytes": self._max_bytes,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._tail_handle is not None:
+                try:
+                    self._tail_handle.close()
+                except OSError:
+                    pass
+                self._tail_handle = None
+        self.save_cursor(force=True)

@@ -441,6 +441,80 @@ def test_resync_storm_concurrent_fulls_no_drops_no_healthy_evictions():
         hub.stop()
 
 
+def test_expired_session_reestablishes_cleanly_on_drain():
+    """ISSUE 13 satellite: a publisher offline past the hub's session
+    expiry must re-establish on its spill drain with ONE FULL — no 409
+    loop, no duplicate-counted frames — and continue deltas off it."""
+    hub = _push_hub()
+    try:
+        encoder = delta.DeltaEncoder("node-a", generation=7)
+        assert _feed(hub, encoder, make_body(0, 10.0))[0] == 200
+        assert _feed(hub, encoder, make_body(0, 20.0))[0] == 200
+        # The partition outlives the expiry: the hub evicts the session
+        # AND its entry on the churn path (worker presumed gone).
+        hub.delta.evict(set())
+        del hub._parse_cache["node-a"]
+        # Drain: the publisher nacked on its first failed send, so the
+        # first post-partition frame is a FULL — accepted outright into
+        # a fresh session (no 409 needed at all).
+        encoder.nack()
+        full_before = hub.delta.full_frames_total
+        resyncs_before = hub.delta.resyncs_total
+        code, _resp = _feed(hub, encoder, make_body(0, 30.0))
+        assert code == 200
+        # The rest of the backlog rides deltas off the re-anchored
+        # session — never more FULLs, never a resync.
+        for duty in (31.0, 32.0, 33.0):
+            wire, kind = encoder.encode_next(make_body(0, duty))
+            assert kind == delta.KIND_DELTA
+            assert hub.delta.handle(wire)[0] == 200
+            encoder.ack()
+        assert hub.delta.full_frames_total == full_before + 1
+        assert hub.delta.resyncs_total == resyncs_before
+        assert hub.delta.duplicate_frames_total == 0
+        hub.refresh_once()
+        line = next(l for l in hub.registry.snapshot().render().splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 33"), line
+    finally:
+        hub.stop()
+
+
+def test_full_retransmit_not_double_counted():
+    """ISSUE 13 satellite: a FULL whose response was lost (flaky link
+    mid-drain) is re-sent with the SAME generation+seq; the hub applies
+    it idempotently but counts it once — the record stays exactly-once
+    even when the wire is at-least-once."""
+    hub = _push_hub()
+    try:
+        wire = delta.encode_full("node-a", 5, 1, make_body(0, 10.0))
+        assert hub.delta.handle(wire)[0] == 200
+        assert hub.delta.handle(wire)[0] == 200  # retransmit: still ok
+        assert hub.delta.full_frames_total == 1
+        assert hub.delta.duplicate_frames_total == 1
+        assert hub.delta.stats()["duplicate_frames"] == 1
+        # A retransmit with a FRESHER body (the publisher re-rendered
+        # before re-sending) must win — dedup is about counting, never
+        # about serving stale values.
+        fresher = delta.encode_full("node-a", 5, 1, make_body(0, 99.0))
+        assert hub.delta.handle(fresher)[0] == 200
+        hub.refresh_once()
+        line = next(l for l in hub.registry.snapshot().render().splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 99"), line
+        # The chain continues from the retransmitted seq.
+        encoder = delta.DeltaEncoder("node-a", generation=5)
+        encoder.seq = 1
+        encoder._keys = None
+        wire2 = delta.encode_full("node-a", 5, 2, make_body(0, 50.0))
+        assert hub.delta.handle(wire2)[0] == 200
+        assert hub.delta.full_frames_total == 2
+    finally:
+        hub.stop()
+
+
 # --- federation -------------------------------------------------------------
 
 def leaf_rollup_body() -> str:

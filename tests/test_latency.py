@@ -4,9 +4,18 @@ north star). bench.py runs the same harness and reports the number."""
 
 import statistics
 
+from flake import retry_once_on_box_noise
+
 from kube_gpu_stats_tpu.bench import run_latency_harness
 
 
+# Box-noise retry (the soak/multihost discipline): this harness drives a
+# real server subprocess, real sockets and wall-clock pacing, and its
+# scrape_p50 pin sits within 2x of the measured number — under full-suite
+# load a scheduling burst can push one run over (the ROADMAP scrape-creep
+# watch item's noise band) without any code having regressed. One loud
+# retry; failing twice still fails the suite.
+@retry_once_on_box_noise
 def test_p50_under_budget_with_scripted_delay(tmp_path):
     result = run_latency_harness(
         tmp_path, num_chips=8, ticks=30, rpc_delay=0.010, warmup=3
@@ -249,6 +258,32 @@ def test_overload_shed_priority_and_fairness():
     assert result["fence_held"], result
     assert result["sessions_alive"] == result["pushers"], result
     assert result["sources_served_fraction"] >= 0.9, result
+
+
+def test_partition_drain_throughput_and_spool_cost():
+    """ISSUE 13 acceptance pins: the spill queue's fsynced spool write
+    (the partition-mode per-tick hot path) must stay a rounding error
+    next to the 1 Hz poll interval, the on-disk cost per spooled
+    snapshot must stay in compressed-frame territory (the spool sizing
+    table assumes ~KB/tick, not the raw exposition), and the drain must
+    move a 200-frame backlog over real HTTP fast enough that the
+    --hub-drain-rate knob — not the implementation — is the limiter.
+    Best of 3 rounds, timeit.repeat style, so a co-tenant noise burst
+    can't fail the pin for the code's cost."""
+    from kube_gpu_stats_tpu.bench import measure_partition_drain
+
+    best = None
+    for _ in range(3):
+        result = measure_partition_drain()
+        assert result is not None
+        if best is None or result["partition_drain_frames_per_s"] > \
+                best["partition_drain_frames_per_s"]:
+            best = result
+    assert best["spill_spool_ms_per_frame"] < 50.0, best
+    assert best["spill_bytes_per_tick"] < 16_384, best
+    assert best["partition_drain_frames_per_s"] > 100.0, best
+    assert best["partition_catchup_s"] < 10.0, best
+    assert best["spill_dropped"] == 0, best
 
 
 def test_render_cost_bounded_at_32_chip_full_label_scale():

@@ -27,6 +27,7 @@ class FakeReceiver:
         self.headers = []
         self.puts = []
         self.fail_codes = []  # pop-front script of status codes
+        self.fail_headers = []  # optional parallel script of header dicts
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -35,6 +36,9 @@ class FakeReceiver:
                 outer.headers.append(dict(self.headers))
                 if outer.fail_codes:
                     self.send_response(outer.fail_codes.pop(0))
+                    for key, value in (outer.fail_headers.pop(0)
+                                       if outer.fail_headers else {}).items():
+                        self.send_header(key, value)
                     self.end_headers()
                     return
                 raw = snappy.decompress(body)
@@ -548,3 +552,236 @@ def test_extra_labels_stamped_on_every_series(registry):
                                (("cluster", "prod"),)))
     for labels, _, _ in decoded_v2:
         assert labels["cluster"] == "prod"
+
+
+# --- durable sharded mode (ISSUE 13): WAL-backed, backpressure-aware --------
+
+def _durable(registry, receiver, tmp_path, **kw):
+    kw.setdefault("min_interval", 0.0)
+    kw.setdefault("wal_dir", str(tmp_path / "rw-wal"))
+    return RemoteWriter(registry, receiver.url, job="kts", instance="n0",
+                        **kw)
+
+
+def _unblock(writer):
+    """Collapse the shards' probe backoff (tests don't sleep)."""
+    for shard in writer._shards:
+        shard.retry_at = 0.0
+
+
+def test_durable_single_shard_end_to_end(registry, tmp_path):
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path)
+        writer.push_once()
+        assert writer.pushes_total == 1
+        assert writer.backlog_records() == 0
+        (request,) = receiver.requests
+        names = {labels["__name__"] for labels, _ in request}
+        assert schema.DUTY_CYCLE.name in names
+        # Same series set as the legacy whole-snapshot request.
+        legacy = prompb.decode_write_request(
+            build_write_request(registry.snapshot(), "kts", "n0"))
+        assert sorted(str(l) for l, _ in request) == \
+            sorted(str(l) for l, _ in legacy)
+        writer.stop()
+
+
+def test_durable_outage_is_late_delivery_not_loss(registry, tmp_path):
+    """The tentpole contract: a receiver outage leaves requests in the
+    WAL; recovery drains them oldest-first — zero loss, in order."""
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path)
+        receiver.fail_codes.append(503)
+        writer.push_once()
+        assert writer.pushes_total == 0
+        assert writer.failures_total == 1
+        assert writer.backlog_records() == 1  # journaled, not dropped
+        # Durable mode keeps publish cadence; the SHARD backs off.
+        assert writer.consecutive_failures == 0
+        assert writer._shards[0].retry_at > 0
+        # Receiver recovers; a new snapshot publishes meanwhile.
+        loop = PollLoop(MockCollector(num_devices=2), registry,
+                        deadline=5.0)
+        loop.tick()
+        loop.stop()
+        _unblock(writer)
+        writer.push_once()
+        assert writer.backlog_records() == 0
+        assert writer.pushes_total == 2  # backlog + the new one, both
+        ts = [request[0][1][0][1] for request in receiver.requests]
+        assert ts == sorted(ts)  # oldest-first
+        writer.stop()
+
+
+def test_durable_poison_4xx_parks_and_drain_continues(registry, tmp_path):
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path)
+        receiver.fail_codes.append(400)
+        writer.push_once()
+        shard = writer._shards[0]
+        assert shard.parked_total == 1
+        assert writer.dropped_total == 1
+        assert writer.backlog_records() == 0  # the queue moved on
+        assert shard.parked_ring.records_pending() == 1  # kept for triage
+        # A poison response is NOT a backoff: the receiver is healthy
+        # and the next snapshot sails through.
+        loop = PollLoop(MockCollector(num_devices=1), registry,
+                        deadline=5.0)
+        loop.tick()
+        loop.stop()
+        writer.push_once()
+        assert writer.pushes_total == 1
+        writer.stop()
+
+
+def test_durable_honors_retry_after(registry, tmp_path):
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path)
+        receiver.fail_codes.append(429)
+        receiver.fail_headers.append({"Retry-After": "7"})
+        import time as time_mod
+
+        before = time_mod.monotonic()
+        writer.push_once()
+        shard = writer._shards[0]
+        assert shard.retry_at - before > 5.0  # the hint, not the base
+        assert writer.backlog_records() == 1
+        # Within the window the shard does not probe at all.
+        requests_before = len(receiver.headers)
+        writer.push_once()
+        assert len(receiver.headers) == requests_before
+        writer.stop()
+
+
+def test_durable_wal_bounded_evicts_oldest_counted(registry, tmp_path):
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path,
+                          wal_max_bytes=1 << 16)
+        receiver.fail_codes.extend([503] * 100)
+        loop = PollLoop(MockCollector(num_devices=2), registry,
+                        deadline=5.0)
+        for i in range(40):
+            loop.tick()
+            _unblock(writer)
+            writer.push_once()
+        loop.stop()
+        shard = writer._shards[0]
+        assert shard.dropped_total > 0  # the bound engaged, counted
+        assert shard.ring.bytes_pending() <= (1 << 16) + (1 << 20)
+        status = writer.egress_status()
+        assert status["shards"][0]["dropped_total"] == shard.dropped_total
+        writer.stop()
+
+
+def test_durable_wal_survives_restart(registry, tmp_path):
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path)
+        receiver.fail_codes.append(503)
+        writer.push_once()
+        assert writer.backlog_records() == 1
+        writer.stop()  # closes rings, saves cursors
+        writer2 = _durable(registry, receiver, tmp_path)
+        assert writer2.backlog_records() == 1  # recovered from disk
+        _unblock(writer2)
+        writer2.push_once()
+        assert writer2.backlog_records() == 0
+        assert receiver.requests  # the pre-crash request landed
+        writer2.stop()
+
+
+def test_durable_sharding_partitions_series_stably(registry, tmp_path):
+    from kube_gpu_stats_tpu.remote_write import shard_of
+
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path, shards=4)
+        writer.push_once()
+        assert 1 <= len(receiver.requests) <= 4
+        # Union over shard requests == the legacy whole-snapshot set.
+        got = sorted(str(labels) for request in receiver.requests
+                     for labels, _ in request)
+        legacy = prompb.decode_write_request(
+            build_write_request(registry.snapshot(), "kts", "n0"))
+        assert got == sorted(str(labels) for labels, _ in legacy)
+        writer.stop()
+    # Routing is stable and PYTHONHASHSEED-independent.
+    labels = [("chip", "0"), ("job", "kts")]
+    assert shard_of("accelerator_duty_cycle", labels, 4) == \
+        shard_of("accelerator_duty_cycle", list(labels), 4)
+    assert shard_of("x", [], 1) == 0
+
+
+def test_durable_415_downgrades_and_parks_that_request(registry, tmp_path):
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path, protocol="2.0")
+        receiver.fail_codes.append(415)
+        writer.push_once()
+        assert writer.protocol == "1.0"
+        shard = writer._shards[0]
+        assert shard.parked_total == 1  # 2.0 bytes can't be re-encoded
+        assert writer.backlog_records() == 0
+        # The next snapshot ships as 1.0 and lands.
+        loop = PollLoop(MockCollector(num_devices=1), registry,
+                        deadline=5.0)
+        loop.tick()
+        loop.stop()
+        writer.push_once()
+        assert receiver.requests and not receiver.requests_v2
+        writer.stop()
+
+
+def test_durable_lag_metering_and_egress_fold(registry, tmp_path):
+    from kube_gpu_stats_tpu.registry import (SnapshotBuilder,
+                                             contribute_egress_stats)
+
+    with FakeReceiver() as receiver:
+        writer = _durable(registry, receiver, tmp_path)
+        writer.push_once()
+        status = writer.egress_status()
+        assert status["durable"] is True
+        (shard,) = status["shards"]
+        assert shard["lag_seconds"] >= 0.0
+        assert shard["sent_total"] == 1
+        builder = SnapshotBuilder()
+        contribute_egress_stats(builder, {"remote_write": status})
+        text = builder.build().render()
+        assert "kts_remote_write_shards 1" in text
+        assert 'kts_remote_write_wal_bytes{shard="0"} 0' in text
+        assert 'kts_remote_write_lag_seconds{shard="0"}' in text
+        assert 'kts_remote_write_parked_total{shard="0"} 0' in text
+        assert 'kts_remote_write_dropped_total{shard="0"} 0' in text
+        writer.stop()
+
+
+def test_legacy_mode_has_no_egress_surface(registry):
+    with FakeReceiver() as receiver:
+        writer = RemoteWriter(registry, receiver.url, min_interval=0.0)
+        assert writer.egress_status() is None
+        assert not writer.durable
+        assert writer.backlog_records() == 0
+        writer.stop()
+
+
+def test_durable_flags_wire_through_daemon(tmp_path):
+    from kube_gpu_stats_tpu.config import Config, from_args
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    import pytest as pytest_mod
+
+    cfg = from_args(["--backend", "mock",
+                     "--remote-write-url", "http://127.0.0.1:9/push",
+                     "--remote-write-wal-dir", str(tmp_path / "wal"),
+                     "--remote-write-shards", "2"])
+    assert cfg.remote_write_shards == 2
+    with pytest_mod.raises(SystemExit):
+        from_args(["--backend", "mock", "--remote-write-shards", "2"])
+    d = Daemon(Config(backend="mock", attribution="off", listen_port=0,
+                      remote_write_url="http://127.0.0.1:9/push",
+                      remote_write_wal_dir=str(tmp_path / "wal2")))
+    try:
+        assert d.remote_writer.durable
+        d.poll.tick()
+        text = d.registry.snapshot().render()
+        assert "kts_remote_write_shards 1" in text
+    finally:
+        d.poll.stop()
+        d.collector.close()

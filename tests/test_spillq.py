@@ -1,0 +1,509 @@
+"""Node-side spill queue (spillq.py + DeltaPublisher integration,
+ISSUE 13 tentpole): offline publishers spool every published snapshot
+to a bounded on-disk ring, drain oldest-first rate-limited on
+reconnect, honor hub sheds without FULL amplification, and account
+every dropped frame."""
+
+import time
+
+from kube_gpu_stats_tpu import delta, schema
+from kube_gpu_stats_tpu.exposition import MetricsServer
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+from kube_gpu_stats_tpu.resilience import TokenBucket
+from kube_gpu_stats_tpu.spillq import SpillQueue
+from kube_gpu_stats_tpu.tracing import Tracer
+
+
+def _worker_registry():
+    worker = Registry()
+
+    def publish(duty: float) -> None:
+        builder = SnapshotBuilder()
+        labels = (("accel_type", "tpu-v5p"), ("chip", "0"),
+                  ("device_path", "/dev/accel0"), ("uuid", ""))
+        builder.add(schema.DEVICE_UP, 1.0, labels)
+        builder.add(schema.DUTY_CYCLE, duty, labels)
+        worker.publish(builder.build())
+
+    return worker, publish
+
+
+def _push_hub(**kw):
+    kw.setdefault("push_fence", 1e9)
+    return Hub([], targets_provider=lambda: [], interval=10.0, **kw)
+
+
+def _hub_duty(hub) -> str:
+    hub.refresh_once()
+    return next(l for l in hub.registry.snapshot().render().splitlines()
+                if l.startswith("accelerator_duty_cycle"))
+
+
+def test_spill_queue_roundtrip_and_status(tmp_path):
+    q = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    q.spool(100.0, "metric_a 1\n")
+    q.spool(101.0, "metric_a 2\n")
+    assert q.depth() == 2
+    assert q.status()["depth_frames"] == 2
+    ts, body = q.peek()
+    assert ts == 100.0 and body == "metric_a 1\n"
+    q.commit()
+    assert q.depth() == 1 and q.drained_total == 1
+    assert q.peek()[1] == "metric_a 2\n"
+    q.close()
+
+
+def test_spill_queue_bounded_drops_oldest_and_journals(tmp_path):
+    tracer = Tracer(enabled=True)
+    q = SpillQueue(str(tmp_path / "spill"), max_bytes=2048,
+                   fsync=False, tracer=tracer)
+    import random
+
+    rng = random.Random(13)
+    for i in range(200):
+        # Incompressible-ish bodies so the byte bound actually engages.
+        q.spool(float(i), "m %d # %s\n" % (
+            i, "".join(rng.choice("abcdefgh") for _ in range(80))))
+    assert q.dropped_total > 0
+    # Oldest-first: the head of the surviving queue is NOT frame 0.
+    ts, _body = q.peek()
+    assert ts > 0.0
+    assert q.spooled_total == 200
+    assert q.depth() + q.dropped_total == 200
+    events = tracer.events(0)["events"]
+    assert any(e.get("kind") == "spill_drop" for e in events)
+    q.close()
+
+
+def test_offline_publisher_spools_at_publish_cadence(tmp_path):
+    """A down hub no longer costs a tick per backoff window: every
+    push_once while offline spools (local disk — no backoff), and
+    consecutive_failures stays 0 so the follower keeps publish cadence;
+    the network PROBE alone backs off."""
+    worker, publish = _worker_registry()
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, "http://127.0.0.1:9", source="node-a",  # port 9: discard
+        timeout=0.2, spill=spill, drain_rate=1000.0)
+    try:
+        for i in range(4):
+            publish(10.0 + i)
+            publisher.push_once()
+        assert spill.depth() == 4
+        assert publisher.consecutive_failures == 0
+        # One real probe (the first push); the rest spooled behind the
+        # probe backoff without hammering the dead link.
+        assert publisher.failures_total >= 1
+        assert spill.spooled_total == 4
+    finally:
+        publisher.stop()
+
+
+def test_drain_after_partition_zero_loss_one_full_no_409_loop(tmp_path):
+    """The tentpole acceptance shape at unit scale: a partition's whole
+    backlog lands late-but-complete — ONE session FULL, the rest
+    deltas, zero resyncs, zero drops — then live deltas resume."""
+    worker, publish = _worker_registry()
+    hub = _push_hub()
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    port = server.port
+    server.stop()  # partition: nothing listening
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{port}", source="node-a",
+        timeout=0.5, spill=spill, drain_rate=10_000.0)
+    try:
+        for i in range(6):
+            publish(10.0 + i)
+            publisher.push_once()
+        assert spill.depth() == 6
+        # Link restored.
+        server2 = MetricsServer(hub.registry, host="127.0.0.1", port=port,
+                                ingest_provider=hub.delta.handle)
+        server2.start()
+        try:
+            publisher._probe_at = 0.0  # the probe window elapsed
+            publish(99.0)
+            publisher.push_once()  # spools the live frame, drains all 7
+            assert spill.depth() == 0
+            assert spill.drained_total == 7
+            assert spill.dropped_total == 0
+            stats = hub.delta.stats()
+            assert stats["full_frames"] == 1  # exactly one session FULL
+            assert stats["delta_frames"] == 6
+            assert stats["resyncs"] == 0     # never a 409 loop
+            assert stats["duplicate_frames"] == 0
+            assert _hub_duty(hub).endswith(" 99")
+            # Live mode resumed: the next publish goes straight through.
+            publish(123.0)
+            publisher.push_once()
+            assert spill.depth() == 0
+            assert _hub_duty(hub).endswith(" 123")
+        finally:
+            server2.stop()
+    finally:
+        publisher.stop()
+        hub.stop()
+
+
+def test_drain_rate_is_token_bucket_limited(tmp_path):
+    """Drain never stampedes a recovering hub: one push_once sends at
+    most the bucket's burst, and the amortized rate is the knob."""
+    worker, publish = _worker_registry()
+    hub = _push_hub()
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-a",
+        spill=spill, drain_rate=4.0)
+    clock = [0.0]
+    publisher._drain_bucket = TokenBucket(4.0, 2.0,
+                                          clock=lambda: clock[0])
+    try:
+        for i in range(10):
+            publish(float(i))
+            spill.spool(time.time(), worker.rendered()[0].decode())
+        depth = spill.depth()
+        publish(50.0)
+        publisher.push_once()  # spools 1 more, drains at most burst=2
+        assert depth + 1 - spill.depth() <= 2
+        clock[0] += 1.0  # one second refills 4 tokens
+        publisher.push_once()
+        assert spill.drained_total <= 2 + 4 + 1
+    finally:
+        publisher.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_drain_honors_shed_without_full_amplification(tmp_path):
+    """A recovering hub shedding 429+Retry-After pauses the drain; the
+    shed frame stays spooled (known-unapplied, re-sent later) and is
+    NEVER promoted to a FULL — 0 FULL amplification."""
+    worker, publish = _worker_registry()
+    hub = _push_hub(ingest_lanes=1, ingest_delta_rate=1e-6)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-a",
+        spill=spill, drain_rate=10_000.0)
+    try:
+        for i in range(3):
+            publish(10.0 + i)
+            spill.spool(time.time(), worker.rendered()[0].decode())
+        publish(40.0)
+        publisher.push_once()
+        # Frame 1 went as the session FULL (never rate-shed); frame 2
+        # was a DELTA the empty bucket refused.
+        assert publisher.shed_honored_total == 1
+        assert spill.depth() == 3  # shed frame + frame 3 + the live one
+        stats = hub.delta.stats()
+        assert stats["full_frames"] == 1
+        # Pressure lifts: drain completes as DELTAS off the acked state.
+        for lane in hub.delta._lanes:
+            lane.bucket = None
+        publisher._shed_until = 0.0
+        publisher.push_once()
+        stats = hub.delta.stats()
+        assert stats["full_frames"] == 1  # STILL one: no amplification
+        assert stats["resyncs"] == 0
+        assert spill.depth() == 0
+    finally:
+        publisher.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_undecodable_frame_skipped_and_counted(tmp_path):
+    """A CRC-valid record that fails snappy/utf-8 decode (version skew)
+    is consumed rather than wedging the drain — and COUNTED, so the
+    spooled == drained + dropped + undecodable + depth accounting
+    never silently leaks."""
+    q = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    q.spool(1.0, "metric_a 1\n")
+    q._ring.append(2.0, b"\xff\xff\xff\xffgarbage")  # not snappy
+    q.spool(3.0, "metric_a 3\n")
+    assert q.peek()[1] == "metric_a 1\n"
+    q.commit()
+    assert q.peek()[1] == "metric_a 3\n"  # skipped PAST the bad record
+    assert q.undecodable_total == 1
+    assert q.status()["undecodable_total"] == 1
+    q.close()
+
+
+def test_drain_cursor_persists_mid_drain(tmp_path):
+    """Every _drain_backlog exit persists the cursor (dirty-gated), not
+    just the backlog-cleared one: a crash mid-way through a rate-paced
+    drain replays at most the current cycle's window, never the whole
+    already-drained prefix."""
+    worker, publish = _worker_registry()
+    hub = _push_hub()
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-a",
+        spill=spill, drain_rate=2.0)
+    clock = [0.0]
+    publisher._drain_bucket = TokenBucket(2.0, 2.0,
+                                          clock=lambda: clock[0])
+    try:
+        for i in range(8):
+            publish(float(i))
+            spill.spool(time.time(), worker.rendered()[0].decode())
+        publish(50.0)
+        publisher.push_once()  # spools 1 more, drains at most burst=2
+        drained = spill.drained_total
+        assert 0 < drained < 9
+        # Crash: NO stop()/close()/save — the fresh queue must resume
+        # past the committed prefix off the per-cycle persisted cursor.
+        spill2 = SpillQueue(str(tmp_path / "spill"), fsync=False)
+        assert spill2.depth() == 9 - drained
+    finally:
+        publisher.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_spill_backlog_survives_publisher_restart(tmp_path):
+    """Crash mid-partition: the next publisher process resumes the
+    drain from disk (the at-least-once cursor window may re-send; the
+    hub's retransmit dedup absorbs that)."""
+    worker, publish = _worker_registry()
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, "http://127.0.0.1:9", source="node-a",
+        timeout=0.2, spill=spill, drain_rate=1000.0)
+    for i in range(3):
+        publish(10.0 + i)
+        publisher.push_once()
+    publisher.stop()  # close() saves the cursor
+    hub = _push_hub()
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    spill2 = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    assert spill2.depth() == 3
+    publisher2 = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-a",
+        spill=spill2, drain_rate=1000.0)
+    try:
+        publish(77.0)
+        publisher2.push_once()
+        assert spill2.depth() == 0
+        assert _hub_duty(hub).endswith(" 77")
+    finally:
+        publisher2.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_spill_status_and_metrics_fold(tmp_path):
+    from kube_gpu_stats_tpu.registry import contribute_egress_stats
+
+    worker, publish = _worker_registry()
+    spill = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    publisher = delta.DeltaPublisher(
+        worker, "http://127.0.0.1:9", source="node-a",
+        timeout=0.2, spill=spill)
+    try:
+        publish(10.0)
+        publisher.push_once()
+        status = publisher.spill_status()
+        assert status["depth_frames"] == 1
+        assert status["drain_rate"] == 50.0
+        assert status["draining"] is True
+        builder = SnapshotBuilder()
+        contribute_egress_stats(builder, {"spill": status})
+        text = builder.build().render()
+        assert 'kts_spill_frames_total{state="spooled"} 1' in text
+        assert "kts_spill_depth_frames 1" in text
+        assert "kts_spill_dropped_total 0" in text
+        assert "kts_spill_oldest_seconds" in text
+    finally:
+        publisher.stop()
+
+
+def test_publisher_without_spill_keeps_legacy_behavior():
+    """No spill configured: failures back off the push cadence exactly
+    as before (the tier-1 contract)."""
+    worker, publish = _worker_registry()
+    publisher = delta.DeltaPublisher(
+        worker, "http://127.0.0.1:9", source="node-a", timeout=0.2)
+    try:
+        publish(10.0)
+        publisher.push_once()
+        assert publisher.consecutive_failures == 1
+        assert publisher.failures_total == 1
+        assert publisher.spill_status() is None
+        assert publisher.backlog_depth == 0
+    finally:
+        publisher.stop()
+
+
+def test_daemon_wires_spill_queue(tmp_path):
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    d = Daemon(Config(backend="mock", attribution="off", listen_port=0,
+                      hub_url="http://127.0.0.1:9",
+                      hub_spill_dir=str(tmp_path / "spill"),
+                      hub_spill_max_bytes=1 << 20,
+                      hub_drain_rate=25.0))
+    try:
+        assert d.delta_pusher is not None
+        assert d.delta_pusher._spill is not None
+        assert d.delta_pusher.drain_rate == 25.0
+        # The egress fold reaches the daemon's own exposition.
+        d.poll.tick()
+        text = d.registry.snapshot().render()
+        assert "kts_spill_depth_frames" in text
+    finally:
+        d.poll.stop()
+        d.collector.close()
+
+
+def test_spill_flags_parse_and_validate(capsys):
+    import pytest
+
+    from kube_gpu_stats_tpu.config import from_args
+
+    cfg = from_args(["--backend", "mock", "--hub-url", "http://h:9401",
+                     "--hub-spill-dir", "/var/spool/kts",
+                     "--hub-spill-max-bytes", str(1 << 20),
+                     "--hub-drain-rate", "10"])
+    assert cfg.hub_spill_dir == "/var/spool/kts"
+    assert cfg.hub_spill_max_bytes == 1 << 20
+    assert cfg.hub_drain_rate == 10.0
+    with pytest.raises(SystemExit):
+        from_args(["--backend", "mock", "--hub-drain-rate", "0"])
+    assert "--hub-drain-rate" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        from_args(["--backend", "mock", "--hub-spill-max-bytes", "10"])
+
+
+# --- doctor --egress --------------------------------------------------------
+
+def _egress_server(payload):
+    srv = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                        egress_provider=lambda: payload)
+    srv.start()
+    return srv
+
+
+def test_doctor_egress_summarizes_healthy_spill():
+    from kube_gpu_stats_tpu import doctor
+
+    srv = _egress_server({
+        "enabled": True,
+        "spill": {"depth_frames": 2, "bytes": 512, "max_bytes": 1 << 20,
+                  "oldest_age_seconds": 4.0, "dropped_total": 0},
+        "remote_write": {"durable": True, "shards": [
+            {"shard": 0, "wal_bytes": 128, "lag_seconds": 1.5,
+             "parked_total": 0, "dropped_total": 0}]},
+        "senders": {"delta": {"consecutive_failures": 0}},
+    })
+    try:
+        result = doctor.check_egress(f"http://127.0.0.1:{srv.port}")
+        assert result.status == doctor.OK
+        assert "spill: 2 frame(s)" in result.detail
+        assert "remote-write: 1 shard(s)" in result.detail
+        assert result.data["egress"]["enabled"] is True
+    finally:
+        srv.stop()
+
+
+def test_doctor_egress_warns_on_loss_parked_and_down_link():
+    from kube_gpu_stats_tpu import doctor
+
+    srv = _egress_server({
+        "enabled": True,
+        "spill": {"depth_frames": 9, "bytes": 900_000,
+                  "max_bytes": 1_000_000, "oldest_age_seconds": 300.0,
+                  "dropped_total": 17},
+        "remote_write": {"durable": True, "shards": [
+            {"shard": 0, "wal_bytes": 4096, "lag_seconds": 250.0,
+             "parked_total": 3, "dropped_total": 2}]},
+        "senders": {"delta": {"consecutive_failures": 5}},
+    })
+    try:
+        result = doctor.check_egress(f"http://127.0.0.1:{srv.port}")
+        assert result.status == doctor.WARN
+        assert "DROPPED 17" in result.detail
+        assert "near its byte bound" in result.detail
+        assert "3 poison request(s) parked" in result.detail
+        assert "DROPPED 2 request(s)" in result.detail
+        assert "link down: delta" in result.detail
+    finally:
+        srv.stop()
+
+
+def test_doctor_egress_down_link_despite_pinned_zero_failures():
+    """The durable senders pin consecutive_failures to 0 by design (the
+    backoff belongs to the probe/shard loop, not the publish cadence) —
+    the down-link WARN must come from the spill queue's link_failures
+    and the shards' own failure counts."""
+    from kube_gpu_stats_tpu import doctor
+
+    srv = _egress_server({
+        "enabled": True,
+        "spill": {"depth_frames": 4, "bytes": 4096, "max_bytes": 1 << 20,
+                  "oldest_age_seconds": 30.0, "dropped_total": 0,
+                  "link_failures": 3},
+        "remote_write": {"durable": True, "shards": [
+            {"shard": 0, "wal_bytes": 2048, "lag_seconds": 30.0,
+             "parked_total": 0, "dropped_total": 0,
+             "consecutive_failures": 2}]},
+        "senders": {"delta": {"consecutive_failures": 0}},
+    })
+    try:
+        result = doctor.check_egress(f"http://127.0.0.1:{srv.port}")
+        assert result.status == doctor.WARN
+        assert "link down: delta, remote_write" in result.detail
+    finally:
+        srv.stop()
+
+
+def test_doctor_egress_classifies_absent_disabled_unreachable():
+    from kube_gpu_stats_tpu import doctor
+
+    bare = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    bare.start()
+    try:
+        result = doctor.check_egress(f"http://127.0.0.1:{bare.port}")
+        assert result.status == doctor.WARN
+        assert "no /debug/egress" in result.detail
+    finally:
+        bare.stop()
+    disabled = _egress_server({"enabled": False, "senders": {}})
+    try:
+        result = doctor.check_egress(f"http://127.0.0.1:{disabled.port}")
+        assert result.status == doctor.WARN
+        assert "no egress durability configured" in result.detail
+    finally:
+        disabled.stop()
+    result = doctor.check_egress("http://127.0.0.1:9")
+    assert result.status == doctor.FAIL
+
+
+def test_doctor_egress_cli_flag_runs_the_row(capsys):
+    from kube_gpu_stats_tpu import doctor
+
+    srv = _egress_server({"enabled": False, "senders": {}})
+    try:
+        code = doctor.main(["--backend", "mock", "--egress",
+                            "--listen-port", str(srv.port)])
+        out = capsys.readouterr().out
+        assert "egress" in out
+        assert "no egress durability configured" in out
+        assert code == 0  # WARN rows don't fail the doctor
+    finally:
+        srv.stop()

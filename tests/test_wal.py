@@ -1,0 +1,271 @@
+"""Shared WAL discipline (wal.py, ISSUE 13 satellite): atomic JSON
+state with dual-candidate crash recovery, and the bounded CRC-framed
+SegmentRing — torn-write/crash matrix for the code every checkpoint
+(energy, ingest sessions, spill queue, exporter shards) now rides."""
+
+import json
+import os
+
+import pytest
+
+from kube_gpu_stats_tpu import wal
+
+
+# -- atomic JSON state -------------------------------------------------------
+
+def test_write_then_load_roundtrip(tmp_path):
+    path = str(tmp_path / "state.json")
+    assert wal.write_state(path, {"version": 1, "seq": 3, "x": [1, 2]})
+    assert wal.load_newest(path, 1) == {"version": 1, "seq": 3, "x": [1, 2]}
+    assert not os.path.exists(path + ".wal")  # renamed, not left behind
+
+
+def test_crash_between_fsync_and_rename_recovers_from_wal(tmp_path):
+    """The recovery rule all three checkpoint users share: a newer
+    fsynced .wal stranded behind an older main must win."""
+    path = str(tmp_path / "state.json")
+    wal.write_state(path, {"version": 1, "seq": 5, "value": "old"})
+    # Simulate the crash: the NEXT write reached the .wal (fsynced) but
+    # died before os.replace.
+    (tmp_path / "state.json.wal").write_text(
+        json.dumps({"version": 1, "seq": 6, "value": "new"}))
+    assert wal.load_newest(path, 1)["value"] == "new"
+    assert wal.newest_seq(path, 1) == 6
+
+
+def test_older_wal_never_shadows_newer_main(tmp_path):
+    path = str(tmp_path / "state.json")
+    (tmp_path / "state.json.wal").write_text(
+        json.dumps({"version": 1, "seq": 2, "value": "stale"}))
+    wal.write_state(path, {"version": 1, "seq": 9, "value": "current"})
+    # write_state renamed the fresh wal over main; recreate a stale one.
+    (tmp_path / "state.json.wal").write_text(
+        json.dumps({"version": 1, "seq": 2, "value": "stale"}))
+    assert wal.load_newest(path, 1)["value"] == "current"
+    assert wal.newest_seq(path, 1) == 9
+
+
+@pytest.mark.parametrize("garbage", [b"", b"{", b"[1,2]", b"\x00\xff" * 40,
+                                     b'{"version": 99, "seq": 1}'])
+def test_garbage_and_wrong_version_ignored(tmp_path, garbage):
+    path = str(tmp_path / "state.json")
+    (tmp_path / "state.json").write_bytes(garbage)
+    assert wal.load_newest(path, 1) is None
+    assert wal.newest_seq(path, 1) == 0
+
+
+def test_torn_main_with_good_wal_recovers(tmp_path):
+    """A crash mid-rename can leave a truncated main; the .wal copy is
+    the fsynced truth."""
+    path = str(tmp_path / "state.json")
+    (tmp_path / "state.json").write_text('{"version": 1, "se')  # torn
+    (tmp_path / "state.json.wal").write_text(
+        json.dumps({"version": 1, "seq": 4, "value": "ok"}))
+    assert wal.load_newest(path, 1)["value"] == "ok"
+
+
+def test_unwritable_path_returns_false_not_raise(tmp_path):
+    target = tmp_path / "dir"
+    target.mkdir()
+    # Writing over a directory fails the rename; must be a False, not
+    # an exception on the caller's (poll/refresh) thread.
+    assert not wal.write_state(str(target), {"version": 1, "seq": 1})
+
+
+# -- SegmentRing -------------------------------------------------------------
+
+def ring(tmp_path, **kw):
+    kw.setdefault("max_bytes", 1 << 20)
+    kw.setdefault("segment_bytes", 256)
+    kw.setdefault("fsync", False)  # tests don't need the disk flush
+    return wal.SegmentRing(str(tmp_path / "ring"), **kw)
+
+
+def test_ring_fifo_roundtrip(tmp_path):
+    r = ring(tmp_path)
+    for i in range(10):
+        assert r.append(float(i), f"payload-{i}".encode()) == 0
+    assert r.records_pending() == 10
+    assert r.oldest_ts() == 0.0
+    out = []
+    while (record := r.peek()) is not None:
+        out.append(record)
+        r.commit()
+    assert [p for _t, p in out] == [f"payload-{i}".encode()
+                                    for i in range(10)]
+    assert r.records_pending() == 0
+
+
+def test_ring_survives_restart(tmp_path):
+    r = ring(tmp_path)
+    for i in range(20):
+        r.append(float(i), b"x" * 40)
+    # Consume 5, persist the cursor, "crash".
+    for _ in range(5):
+        r.peek()
+        r.commit()
+    assert r.save_cursor(force=True)
+    r.close()
+    r2 = ring(tmp_path)
+    assert r2.records_pending() == 15
+    assert r2.oldest_ts() == 5.0  # resumes AFTER the consumed prefix
+    assert r2.torn_records == 0
+
+
+def test_ring_unsaved_cursor_resends_at_least_once(tmp_path):
+    """A crash between commit and save_cursor re-sends the window — the
+    at-least-once half of the contract (never lossy)."""
+    r = ring(tmp_path)
+    for i in range(4):
+        r.append(float(i), b"p%d" % i)
+    r.save_cursor(force=True)
+    r.peek(), r.commit()  # consumed but cursor not saved
+    del r  # crash: no close(), no save
+    r2 = ring(tmp_path)
+    assert r2.records_pending() == 4  # record 0 comes back, not lost
+
+
+def test_ring_torn_tail_truncated_not_fatal(tmp_path):
+    r = ring(tmp_path)
+    for i in range(6):
+        r.append(float(i), b"payload-%d" % i)
+    r.close()
+    # Tear the newest segment mid-record (crash during append).
+    segs = sorted((tmp_path / "ring").glob("*.seg"))
+    data = segs[-1].read_bytes()
+    segs[-1].write_bytes(data[:-3])
+    r2 = ring(tmp_path)
+    assert r2.torn_records >= 1
+    drained = []
+    while (record := r2.peek()) is not None:
+        drained.append(record[1])
+        r2.commit()
+    # Everything before the torn tail is CRC-proven intact.
+    assert drained == [b"payload-%d" % i for i in range(5)]
+
+
+def test_ring_orphaned_rewrite_temp_cleaned_on_recovery(tmp_path):
+    """A crash between a torn-tail rewrite and its os.replace leaves a
+    '<seg>.seg.wal' temp; recovery must delete it (it matches no .seg
+    glob, so nothing else ever would) and recover the real segments."""
+    r = ring(tmp_path)
+    for i in range(4):
+        r.append(float(i), b"payload-%d" % i)
+    r.close()
+    orphan = tmp_path / "ring" / "wal-00000001.seg.wal"
+    orphan.write_bytes(b"half-written rewrite temp")
+    r2 = ring(tmp_path)
+    assert not orphan.exists()
+    assert r2.records_pending() == 4
+    assert r2.torn_records == 0
+    # And the torn bytes never come back on the NEXT recovery.
+    r2.close()
+    r3 = ring(tmp_path)
+    assert r3.torn_records == 0
+
+
+def test_ring_corrupt_middle_record_stops_at_crc(tmp_path):
+    r = ring(tmp_path, segment_bytes=1 << 20)  # one segment
+    for i in range(6):
+        r.append(float(i), b"payload-%d" % i)
+    r.close()
+    (seg,) = sorted((tmp_path / "ring").glob("*.seg"))
+    data = bytearray(seg.read_bytes())
+    data[data.index(b"payload-3")] ^= 0xFF  # corrupt record 3's payload
+    seg.write_bytes(bytes(data))
+    r2 = ring(tmp_path)
+    assert r2.torn_records >= 1
+    # The proven prefix survives; the suffix after the bad CRC is gone.
+    assert 0 < r2.records_pending() < 6
+
+
+def test_ring_bounded_evicts_oldest_and_reports(tmp_path):
+    r = ring(tmp_path, max_bytes=400, segment_bytes=100)
+    evicted = 0
+    for i in range(50):
+        evicted += r.append(float(i), b"z" * 60)
+    assert evicted > 0  # the cap engaged
+    assert r.evicted_records == evicted
+    assert r.bytes_pending() <= 400 + 100  # bound ~ max + one segment
+    # Oldest-first: the survivors are the newest records.
+    first = r.peek()
+    assert first is not None and first[0] > 0.0
+    # Conservation: everything appended is either pending or evicted.
+    assert r.records_pending() + evicted == 50
+
+
+def test_ring_eviction_survives_restart(tmp_path):
+    r = ring(tmp_path, max_bytes=300, segment_bytes=100)
+    for i in range(30):
+        r.append(float(i), b"y" * 50)
+    pending = r.records_pending()
+    oldest = r.oldest_ts()
+    r.close()
+    r2 = ring(tmp_path, max_bytes=300, segment_bytes=100)
+    assert r2.records_pending() == pending
+    assert r2.oldest_ts() == oldest
+
+
+def test_ring_empty_dir_and_empty_ring(tmp_path):
+    r = ring(tmp_path)
+    assert r.peek() is None
+    assert r.oldest_ts() is None
+    assert r.records_pending() == 0
+    r.commit()  # commit on empty must be a no-op, not a raise
+    status = r.status()
+    assert status["records"] == 0 and status["torn_total"] == 0
+
+
+def test_ring_status_shape(tmp_path):
+    r = ring(tmp_path)
+    r.append(1.0, b"abc")
+    status = r.status()
+    for key in ("records", "bytes", "segments", "appended_total",
+                "evicted_total", "torn_total", "max_bytes"):
+        assert key in status
+    assert status["records"] == 1 and status["bytes"] > 3
+
+
+# -- ported users still behave (energy + ingest on wal.py) -------------------
+
+def test_energy_checkpoint_still_recovers_newer_wal(tmp_path):
+    """The energy accountant's monotone-across-restarts guarantee must
+    survive the port onto wal.py (the PR 7 review-fix scenario)."""
+    from kube_gpu_stats_tpu.energy import EnergyAccountant
+
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path, checkpoint_interval=0.0)
+    acct.observe("dev0", "pod-a", "ml", 1.0, 100.0)
+    acct.observe("dev0", "pod-a", "ml", 2.0, 100.0)
+    assert acct.checkpoint(force=True)
+    # Newer fsynced .wal stranded by a crash before rename.
+    state = json.loads((tmp_path / "energy.json").read_text())
+    state["seq"] += 1
+    state["per_pod"] = [["pod-a", "ml", 999.0]]
+    (tmp_path / "energy.json.wal").write_text(json.dumps(state))
+    acct2 = EnergyAccountant(checkpoint_path=path)
+    assert acct2.checkpoint_loaded
+    assert acct2.digest()["per_pod"][0][2] == 999.0
+
+
+def test_ingest_checkpoint_epoch_resumes_past_both_candidates(tmp_path):
+    from kube_gpu_stats_tpu.delta import DeltaIngest
+
+    path = str(tmp_path / "ingest.json")
+    ingest = DeltaIngest(checkpoint_path=path, checkpoint_interval=0.0)
+    from kube_gpu_stats_tpu.delta import decode_frame, encode_full
+
+    ingest.apply(decode_frame(encode_full("src", 1, 1, "m 1\n")), 10)
+    assert ingest.checkpoint(force=True)
+    main_seq = json.loads((tmp_path / "ingest.json").read_text())["seq"]
+    # Strand a higher-seq .wal, then restart: the next write epoch must
+    # out-rank BOTH.
+    state = json.loads((tmp_path / "ingest.json").read_text())
+    state["seq"] = main_seq + 5
+    (tmp_path / "ingest.json.wal").write_text(json.dumps(state))
+    ingest2 = DeltaIngest(checkpoint_path=path, checkpoint_interval=0.0)
+    assert ingest2.checkpoint_loaded
+    ingest2.apply(decode_frame(encode_full("src2", 1, 1, "m 2\n")), 10)
+    assert ingest2.checkpoint(force=True)
+    assert json.loads(
+        (tmp_path / "ingest.json").read_text())["seq"] > main_seq + 5

@@ -162,13 +162,15 @@ def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
         trace_provider=tracer,
         fleet_provider=FleetLens(tracer=tracer),
         host_provider=HostStats(),
+        egress_provider=lambda: {"enabled": True,
+                                 "spill": {"SECRET": "SPOOL_DETAIL"}},
     )
     srv.start()
     try:
         for path in ("/debug/threads", "/debug/profile?seconds=0.1",
                      "/debug/ticks", "/debug/trace?last=5",
                      "/debug/events?since=0", "/debug/fleet",
-                     "/debug/host"):
+                     "/debug/host", "/debug/egress"):
             with pytest.raises(urllib.error.HTTPError) as err:
                 fetch(srv.port, path)
             assert err.value.code == 401, path
@@ -237,6 +239,66 @@ def test_debug_host_served_with_auth(tmp_path):
         assert b"/debug/host" in landing
     finally:
         srv.stop()
+
+
+def test_debug_egress_404_without_provider(server):
+    """Servers with no egress provider wired (bare registries) must
+    404 /debug/egress, mirroring /debug/host."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(server.port, "/debug/egress")
+    assert err.value.code == 404
+
+
+def test_debug_egress_served_with_auth_and_disabled_contract():
+    import json
+
+    payload_state = {"enabled": False, "senders": {}}
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
+        egress_provider=lambda: payload_state)
+    srv.start()
+    try:
+        # Nothing configured: enabled:false (the --no-trace contract —
+        # curl diagnoses config, not absence).
+        payload = json.loads(fetch(
+            srv.port, "/debug/egress",
+            headers=auth_header("prom", "s3cret")).read())
+        assert payload["enabled"] is False
+        payload_state.update(
+            enabled=True,
+            spill={"depth_frames": 3, "dropped_total": 0})
+        payload = json.loads(fetch(
+            srv.port, "/debug/egress",
+            headers=auth_header("prom", "s3cret")).read())
+        assert payload["spill"]["depth_frames"] == 3
+        landing = fetch(srv.port, "/",
+                        headers=auth_header("prom", "s3cret")).read()
+        assert b"/debug/egress" in landing
+    finally:
+        srv.stop()
+
+
+def test_debug_egress_daemon_end_to_end(tmp_path):
+    """The daemon wires its real payload: spill + senders visible."""
+    import json
+
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    d = Daemon(Config(backend="mock", attribution="off", listen_port=0,
+                      hub_url="http://127.0.0.1:9",
+                      hub_spill_dir=str(tmp_path / "spill")))
+    try:
+        d.server.start()
+        payload = json.loads(fetch(d.server.port, "/debug/egress").read())
+        assert payload["enabled"] is True
+        assert "spill" in payload
+        assert "delta" in payload["senders"]
+    finally:
+        d.server.stop()
+        d.collector.close()
 
 
 # -- TLS ---------------------------------------------------------------------
