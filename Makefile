@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -12,6 +12,7 @@ ci: native lint
 	python tools/host_sim.py
 	python tools/chaos_sim.py
 	python tools/partition_sim.py
+	python tools/skew_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -105,6 +106,19 @@ partition-sim:
 host-sim:
 	python tools/host_sim.py --verbose
 
+# Version-skew chaos smoke (<60 s, ISSUE 14): the rolling-upgrade
+# survival layer through a real mixed-version matrix — old publisher
+# vs new hub (census lists the wire-v1 straggler), new publisher vs
+# old/pre-negotiation hubs (hello-clamped / in-push encoding
+# downgrade, zero data loss), a daemon upgrade restarting onto an
+# old build's spill queue + checkpoints (re-encode, default-and-warn,
+# future-major quarantined byte-identical), a hub upgrade under live
+# pushers (checkpoint warm resume, 0 resyncs, <= 1 FULL per session,
+# census flips without a FULL), and a census-gated 426 refusal that
+# doctor --skew names. In `make ci` too.
+skew-sim:
+	python tools/skew_sim.py --verbose
+
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf
 # change; NOT part of `make ci` (ci runs the full bench) and never a
@@ -118,6 +132,7 @@ bench-quick: native
 lint:
 	python tools/check_metrics_docs.py
 	python tools/check_no_nvml.py
+	python tools/check_wal_versions.py
 
 # Eyeball where tick time goes: 200 simulated ticks through the
 # production loop with the flight recorder on, dumped as Chrome

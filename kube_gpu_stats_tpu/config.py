@@ -123,6 +123,13 @@ class Config:
     hub_spill_dir: str = ""
     hub_spill_max_bytes: int = 64 * 1024 * 1024
     hub_drain_rate: float = 50.0
+    # Rolling-upgrade skew control (ISSUE 14): the highest delta wire-
+    # protocol version this publisher will negotiate UP to. 0 = this
+    # build's maximum (delta.PROTO_MAX); pin lower to hold a rollout
+    # wave on the old encoding (the publisher still opens at v1 and
+    # only raises on the hub's hello, so this is a ceiling, not a
+    # request).
+    hub_proto_max: int = 0
     # Burst sampler + energy accounting (ISSUE 8 tentpole).
     burst_mode: str = "auto"  # off | auto (demand/anomaly armed) |
     #                           continuous
@@ -306,6 +313,15 @@ def add_delta_push_flags(p: argparse.ArgumentParser) -> None:
                         "fleet must never stampede a recovering hub; "
                         "429/503 + Retry-After from the hub pauses the "
                         "drain on top of this")
+    p.add_argument("--hub-proto-max", type=int,
+                   default=int(_env("HUB_PROTO_MAX", "0")),
+                   help="highest delta wire-protocol version to "
+                        "negotiate up to against --hub-url (version "
+                        "skew, ISSUE 14): the publisher always OPENS "
+                        "at v1 and only raises to min(this, the hub's "
+                        "advertised max). 0 = this build's maximum; "
+                        "pin (e.g. 1) to hold a rollout wave on the "
+                        "old encoding")
 
 
 def add_ingest_guard_flags(p: argparse.ArgumentParser) -> None:
@@ -356,6 +372,22 @@ def add_ingest_guard_flags(p: argparse.ArgumentParser) -> None:
                         "(the crash-tail bound: sessions whose deltas "
                         "landed after the last write pay one FULL "
                         "resync on restart)")
+    p.add_argument("--ingest-proto-min", type=int,
+                   default=int(_env("INGEST_PROTO_MIN", "0")),
+                   help="lowest delta wire-protocol version this hub "
+                        "accepts (version skew, ISSUE 14): frames "
+                        "below it draw a 426 refusal + this hub's "
+                        "advertised range, counted in "
+                        "kts_skew_refused_total and named by doctor "
+                        "--skew. Raise it AFTER kts_fleet_version_count "
+                        "shows the old version at 0 (census-gated "
+                        "rollout); 0 = everything this build decodes")
+    p.add_argument("--ingest-proto-max", type=int,
+                   default=int(_env("INGEST_PROTO_MAX", "0")),
+                   help="highest delta wire-protocol version this hub "
+                        "accepts; 0 = this build's maximum. Mostly a "
+                        "test/sim knob (play an old hub); production "
+                        "rollouts leave it 0")
 
 
 def validate_ingest_guard_args(args) -> str | None:
@@ -373,6 +405,12 @@ def validate_ingest_guard_args(args) -> str | None:
         return "--ingest-quarantine-window must be > 0 seconds"
     if args.ingest_checkpoint_interval <= 0:
         return "--ingest-checkpoint-interval must be > 0 seconds"
+    if args.ingest_proto_min < 0 or args.ingest_proto_max < 0:
+        return ("--ingest-proto-min/--ingest-proto-max must be >= 0 "
+                "(0 = this build's bound)")
+    if (args.ingest_proto_min and args.ingest_proto_max
+            and args.ingest_proto_min > args.ingest_proto_max):
+        return "--ingest-proto-min must be <= --ingest-proto-max"
     return None
 
 
@@ -391,6 +429,8 @@ def validate_delta_push_args(args) -> str | None:
                 "than one frame spools nothing)")
     if args.hub_drain_rate <= 0:
         return "--hub-drain-rate must be > 0 frames/second"
+    if args.hub_proto_max < 0:
+        return "--hub-proto-max must be >= 0 (0 = this build's maximum)"
     return None
 
 
@@ -880,6 +920,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         hub_spill_dir=args.hub_spill_dir,
         hub_spill_max_bytes=args.hub_spill_max_bytes,
         hub_drain_rate=args.hub_drain_rate,
+        hub_proto_max=args.hub_proto_max,
         burst_mode=args.burst_mode,
         burst_hz=args.burst_hz,
         burst_hold=args.burst_hold,

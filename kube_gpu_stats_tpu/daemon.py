@@ -335,6 +335,7 @@ class Daemon:
             energy_provider=self.energy,
             host_provider=self.hoststats,
             egress_provider=self._egress_payload,
+            skew_provider=self._skew_payload,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir,
@@ -410,6 +411,7 @@ class Daemon:
                 tracer=self.tracer,
                 spill=spill,
                 drain_rate=cfg.hub_drain_rate,
+                proto_max=cfg.hub_proto_max,
             )
 
     def _wire_tracer(self, collector) -> None:
@@ -474,6 +476,13 @@ class Daemon:
                     # deliberately NOT in failures (the hub is shaping
                     # load, not failing).
                     stats[mode]["shed_honored"] = sender.shed_honored_total
+                if hasattr(sender, "skew_refused_total"):
+                    # Delta publishers only (ISSUE 14): pushes the
+                    # upstream hub refused for wire-version skew (426)
+                    # — kts_skew_refused_total on this node's own
+                    # exposition, so a stuck rollout is visible from
+                    # EITHER end of the link.
+                    stats[mode]["skew_refused"] = sender.skew_refused_total
         return stats
 
     def _egress_stats(self) -> dict:
@@ -518,6 +527,27 @@ class Daemon:
                 }
         payload["senders"] = senders
         return payload
+
+    def _skew_payload(self) -> dict:
+        """/debug/skew for a daemon (ISSUE 14): this build's version +
+        wire-protocol range, the delta publisher's negotiation state
+        against its upstream hub when one is configured, and any
+        persisted-format files quarantined at startup — the node-side
+        evidence `doctor --skew` reads."""
+        from . import __version__, wal
+        from .delta import PROTO_MAX, PROTO_MIN
+
+        pusher = getattr(self, "delta_pusher", None)
+        return {
+            "role": "daemon",
+            "build": __version__,
+            "proto_min": PROTO_MIN,
+            "proto_max": PROTO_MAX,
+            "publisher": (pusher.skew_status()
+                          if pusher is not None else None),
+            "wal_quarantined": wal.quarantine_counts(),
+            "wal_quarantine_events": wal.quarantine_events(),
+        }
 
     def start(self) -> None:
         starter = getattr(self.attribution, "start", None)

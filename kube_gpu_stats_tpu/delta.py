@@ -95,7 +95,40 @@ def lane_of(source: str, lanes: int) -> int:
     return zlib.crc32(source.encode()) % lanes
 
 MAGIC = b"KTSD"
-VERSION = 1
+
+# Wire protocol range this build speaks (ISSUE 14). v1 is the original
+# frame layout; v2 adds a capability bitset to the header and
+# length-prefixed trailing extension blocks (unknown tags skipped —
+# forward tolerance is the contract that lets v2.1 add fields without
+# breaking v2.0 receivers). A publisher always OPENS at v1 — every
+# receiver ever shipped speaks it — and upgrades only after the
+# receiver's hello (the X-KTS-Proto-* headers on its first response)
+# proves the far side understands more, so negotiation can never cost
+# a frame, a 409 loop, or a quarantine strike. Version skew downgrades
+# ENCODING FEATURES, never data: a v1 frame carries the same series
+# payload a v2 frame would.
+PROTO_MIN = 1
+PROTO_MAX = 2
+VERSION = PROTO_MIN  # compat alias: the legacy (v1) frame version
+
+# Capability bitset (v2 headers + hello): encoding features a peer may
+# use, maskable per connection. A publisher intersects its own caps
+# with the receiver's hello caps and encodes with the intersection.
+CAP_BUILD_INFO = 1   # FULL frames may carry the build-version extension
+CAPS_CURRENT = CAP_BUILD_INFO
+
+# v2 trailing-extension tags. Unknown tags are skipped by length —
+# never an error — so future builds can append without a version bump.
+EXT_BUILD = 1        # utf-8 build version string (FULL frames)
+
+# Hello headers: the receiver advertises its range/caps/build on every
+# /ingest/delta response (200, 409 AND 426 — a refused peer must learn
+# what WOULD be accepted), and the publisher negotiates off them.
+HELLO_PROTO_MIN = "X-KTS-Proto-Min"
+HELLO_PROTO_MAX = "X-KTS-Proto-Max"
+HELLO_CAPS = "X-KTS-Caps"
+HELLO_BUILD = "X-KTS-Build"
+
 KIND_FULL = 0
 KIND_DELTA = 1
 
@@ -115,6 +148,20 @@ class ResyncRequired(ValueError):
     send a FULL snapshot (answered as HTTP 409)."""
 
 
+class FrameVersionSkew(ValueError):
+    """The frame's protocol version is outside what this receiver
+    speaks (ISSUE 14). Deliberately NOT a malformed-frame verdict: the
+    peer is healthy, just from another rollout wave — it gets a
+    distinct 426-style refusal with the receiver's (min, max) hello so
+    it can renegotiate, never a quarantine strike."""
+
+    def __init__(self, version: int, lo: int, hi: int) -> None:
+        super().__init__(
+            f"protocol version {version} outside supported "
+            f"range {lo}..{hi}")
+        self.version = version
+
+
 class Frame(NamedTuple):
     kind: int
     source: str
@@ -123,6 +170,9 @@ class Frame(NamedTuple):
     body: str | None                 # FULL frames
     slots: tuple[int, ...]           # DELTA frames: changed slots +
     values: tuple[float, ...]        # their new values (parallel)
+    proto: int = 1                   # wire version the frame arrived in
+    caps: int = 0                    # publisher capability bitset (v2+)
+    build: str = ""                  # publisher build (v2 FULL ext)
 
 
 def _varint(value: int) -> bytes:
@@ -153,10 +203,13 @@ def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
             raise ValueError("varint too long")
 
 
-def _header(kind: int, source: str, generation: int, seq: int) -> bytearray:
+def _header(kind: int, source: str, generation: int, seq: int,
+            proto: int = PROTO_MIN, caps: int = 0) -> bytearray:
     raw = bytearray(MAGIC)
-    raw.append(VERSION)
+    raw.append(proto)
     raw.append(kind)
+    if proto >= 2:
+        raw += _varint(caps)
     encoded = source.encode()
     raw += _varint(len(encoded))
     raw += encoded
@@ -165,24 +218,40 @@ def _header(kind: int, source: str, generation: int, seq: int) -> bytearray:
     return raw
 
 
-def encode_full(source: str, generation: int, seq: int, body: str) -> bytes:
+def _ext_block(tag: int, payload: bytes) -> bytes:
+    return _varint(tag) + _varint(len(payload)) + payload
+
+
+def encode_full(source: str, generation: int, seq: int, body: str, *,
+                proto: int = PROTO_MIN, caps: int = 0,
+                build: str = "") -> bytes:
     """One snappy-compressed FULL frame carrying the rendered exposition
     text verbatim — the receiver parses it with the same interned
     tokenizer the pull path uses, so push state can never diverge from
-    what a scrape of the same bytes would have produced."""
-    raw = _header(KIND_FULL, source, generation, seq)
+    what a scrape of the same bytes would have produced. At proto >= 2
+    (and with CAP_BUILD_INFO granted) the frame also carries the
+    publisher's build version as a trailing extension — the hub-side
+    fleet version census reads it off the session."""
+    raw = _header(KIND_FULL, source, generation, seq, proto, caps)
     encoded = body.encode()
     raw += _varint(len(encoded))
     raw += encoded
+    if proto >= 2 and build and caps & CAP_BUILD_INFO:
+        raw += _ext_block(EXT_BUILD, build.encode())
     return snappy.compress(bytes(raw))
 
 
 def encode_delta(source: str, generation: int, seq: int,
-                 changes: Sequence[tuple[int, float]]) -> bytes:
+                 changes: Sequence[tuple[int, float]], *,
+                 proto: int = PROTO_MIN, caps: int = 0,
+                 build: str = "") -> bytes:
     """One snappy-compressed DELTA frame: ascending (slot, value) pairs,
     slots gap-encoded (varint deltas) so a sparse change-set over a
-    large series list stays a couple of bytes per slot."""
-    raw = _header(KIND_DELTA, source, generation, seq)
+    large series list stays a couple of bytes per slot. ``build`` (v2 +
+    CAP_BUILD_INFO only) appends the build extension — the encoder
+    sends it on the first frame after a negotiation so the receiver's
+    version census never waits for the next FULL."""
+    raw = _header(KIND_DELTA, source, generation, seq, proto, caps)
     raw += _varint(len(changes))
     prev = 0
     for slot, value in changes:
@@ -191,6 +260,8 @@ def encode_delta(source: str, generation: int, seq: int,
         raw += _varint(slot - prev)
         prev = slot
         raw += _F64.pack(value)
+    if proto >= 2 and build and caps & CAP_BUILD_INFO:
+        raw += _ext_block(EXT_BUILD, build.encode())
     return snappy.compress(bytes(raw))
 
 
@@ -210,22 +281,56 @@ def _declared_size(wire: bytes) -> int:
     raise ValueError("truncated snappy preamble")
 
 
+def _read_exts(data: bytes, pos: int) -> tuple[str, int]:
+    """Walk v2 trailing extension blocks from ``pos`` to the end of
+    the frame: (tag, length)-prefixed, unknown tags skipped whole —
+    the forward-tolerance half of the version contract (a v2.x
+    publisher may append blocks a v2.0 receiver has never heard of;
+    only a block that lies about its length is malformed). Returns the
+    build-version extension's value ("" when absent)."""
+    build = ""
+    n = len(data)
+    while pos < n:
+        tag, pos = _read_varint(data, pos)
+        length, pos = _read_varint(data, pos)
+        if pos + length > n:
+            raise ValueError("truncated extension block")
+        if tag == EXT_BUILD:
+            build = data[pos:pos + length].decode()
+        pos += length
+    return build, pos
+
+
 def decode_frame(wire: bytes) -> Frame:
     """Strict decode of one wire frame; raises ValueError on anything
-    malformed (the ingest answers 400, never crashes the hub)."""
+    malformed (the ingest answers 400, never crashes the hub) and the
+    distinct :class:`FrameVersionSkew` on a version outside
+    PROTO_MIN..PROTO_MAX (answered 426 + hello, never counted
+    hostile)."""
     if _declared_size(wire) > MAX_FRAME_BYTES:
         raise ValueError("frame exceeds the size cap")
-    data = snappy.decompress(wire)
+    return decode_frame_raw(snappy.decompress(wire))
+
+
+def decode_frame_raw(data: bytes) -> Frame:
+    """:func:`decode_frame` minus the snappy layer — for callers that
+    already hold the decompressed bytes (the spill queue's legacy
+    wire-frame recovery sniffs the magic off its own decompression and
+    must not pay a second one)."""
     if data[:4] != MAGIC:
         raise ValueError("bad magic")
     if len(data) < 6:
         raise ValueError("truncated header")
-    if data[4] != VERSION:
-        raise ValueError(f"unsupported version {data[4]}")
+    proto = data[4]
+    if proto < PROTO_MIN or proto > PROTO_MAX:
+        raise FrameVersionSkew(proto, PROTO_MIN, PROTO_MAX)
     kind = data[5]
     if kind not in (KIND_FULL, KIND_DELTA):
         raise ValueError(f"unknown frame kind {kind}")
     pos = 6
+    caps = 0
+    if proto >= 2:
+        caps, pos = _read_varint(data, pos)
     n, pos = _read_varint(data, pos)
     if pos + n > len(data):
         raise ValueError("truncated source")
@@ -237,10 +342,17 @@ def decode_frame(wire: bytes) -> Frame:
     seq, pos = _read_varint(data, pos)
     if kind == KIND_FULL:
         n, pos = _read_varint(data, pos)
-        if pos + n != len(data):
+        if proto < 2:
+            if pos + n != len(data):
+                raise ValueError("full-frame body length mismatch")
+        elif pos + n > len(data):
             raise ValueError("full-frame body length mismatch")
-        return Frame(kind, source, generation, seq,
-                     data[pos:pos + n].decode(), (), ())
+        body = data[pos:pos + n].decode()
+        build = ""
+        if proto >= 2:
+            build, _ = _read_exts(data, pos + n)
+        return Frame(kind, source, generation, seq, body, (), (),
+                     proto, caps, build)
     count, pos = _read_varint(data, pos)
     slots = []
     values = []
@@ -280,10 +392,17 @@ def decode_frame(wire: bytes) -> Frame:
             pos += 8
     except IndexError:
         raise ValueError("truncated varint") from None
+    build = ""
+    if proto >= 2:
+        # Trailing extension blocks (skipped by tag unless known):
+        # v2's evolution room. A delta CAN carry the build extension —
+        # the encoder announces on the first frame after a negotiation
+        # so the receiver's version census never waits for a FULL.
+        build, pos = _read_exts(data, pos)
     if pos != n:
         raise ValueError("trailing bytes after delta changes")
     return Frame(kind, source, generation, seq, None,
-                 tuple(slots), tuple(values))
+                 tuple(slots), tuple(values), proto, caps, build)
 
 
 def new_generation() -> int:
@@ -300,7 +419,8 @@ class DeltaEncoder:
     agnostic (the tests drive it with injected drops/reorders/restarts;
     DeltaPublisher adds HTTP)."""
 
-    def __init__(self, source: str, generation: int | None = None) -> None:
+    def __init__(self, source: str, generation: int | None = None, *,
+                 build: str = "") -> None:
         self.source = source
         self.generation = (generation if generation is not None
                            else new_generation())
@@ -311,6 +431,33 @@ class DeltaEncoder:
         self._need_full = True
         self.full_frames = 0
         self.delta_frames = 0
+        # Negotiated wire state (ISSUE 14): open at v1 / no caps — the
+        # one encoding every receiver ever shipped accepts — and let
+        # set_wire() raise it once the receiver's hello proves more.
+        self.proto = PROTO_MIN
+        self.caps = 0
+        self.build = build
+        # Announce-once (ISSUE 14): after a negotiation raises the
+        # wire version, the next frame — FULL or DELTA — carries the
+        # build extension so the receiver's census updates immediately
+        # instead of waiting for the next FULL. Cleared on ack (a
+        # deferred/nacked frame re-announces).
+        self._announce_build = False
+
+    def set_wire(self, proto: int, caps: int) -> bool:
+        """Adopt a negotiated (proto, caps); True when anything
+        changed. No resync needed in either direction: the receiver
+        keys session state on (generation, seq), not on the frame
+        version, so consecutive frames may legally differ — exactly
+        what a mid-chain downgrade against a rolled-back hub needs."""
+        proto = max(PROTO_MIN, min(PROTO_MAX, proto))
+        caps = caps & CAPS_CURRENT if proto >= 2 else 0
+        changed = (proto, caps) != (self.proto, self.caps)
+        self.proto = proto
+        self.caps = caps
+        if changed and proto >= 2:
+            self._announce_build = True
+        return changed
 
     def encode_next(self, body: str) -> tuple[bytes, int]:
         """(wire frame, kind) advancing the session to seq+1. The caller
@@ -325,22 +472,38 @@ class DeltaEncoder:
             # express it, and a FULL re-anchors slot indexing exactly.
             # The key compare is pointer-cheap: names and label tuples
             # come interned from the shared parse pools.
-            wire = encode_full(self.source, self.generation, seq, body)
+            wire = encode_full(self.source, self.generation, seq, body,
+                               proto=self.proto, caps=self.caps,
+                               build=self.build)
             kind = KIND_FULL
         else:
             changes = [(i, v) for i, v in enumerate(values)
                        if v != self._values[i]]
-            wire = encode_delta(self.source, self.generation, seq, changes)
+            wire = encode_delta(self.source, self.generation, seq, changes,
+                                proto=self.proto, caps=self.caps,
+                                build=(self.build if self._announce_build
+                                       else ""))
             kind = KIND_DELTA
-        self._pending = (keys, values, kind)
+        # Did THIS frame carry the build extension? ack() may only
+        # clear the announce flag then — a negotiation lands between
+        # the POST and the ack, so the flag it raises must survive
+        # the ack of the pre-negotiation frame in flight.
+        announced = (self.proto >= 2 and bool(self.build)
+                     and bool(self.caps & CAP_BUILD_INFO)
+                     and (kind == KIND_FULL or self._announce_build))
+        self._pending = (keys, values, kind, announced)
         return wire, kind
 
     def ack(self) -> None:
-        keys, values, kind = self._pending
+        keys, values, kind, announced = self._pending
         self.seq += 1
         self._keys = keys
         self._values = values
         self._need_full = False
+        if announced:
+            # The acked frame carried the build extension: the
+            # receiver's census has it now.
+            self._announce_build = False
         if kind == KIND_FULL:
             self.full_frames += 1
         else:
@@ -404,7 +567,9 @@ class DeltaPublisher(PublishFollower):
                  headers_provider=None, render_stats=None, tracer=None,
                  ca_file: str = "", insecure_tls: bool = False,
                  generation: int | None = None, rng=None,
-                 spill=None, drain_rate: float = 50.0) -> None:
+                 spill=None, drain_rate: float = 50.0,
+                 proto_max: int = PROTO_MAX,
+                 build: str | None = None) -> None:
         super().__init__(registry, min_interval, thread_name="delta-push")
         self._url = url.rstrip("/") + INGEST_PATH
         self._https = self._url.startswith("https://")
@@ -420,11 +585,29 @@ class DeltaPublisher(PublishFollower):
         self._insecure_tls = insecure_tls
         self._render_stats = render_stats
         self._tracer = tracer
-        self._encoder = DeltaEncoder(source, generation)
+        if build is None:
+            from . import __version__ as build
+        self._encoder = DeltaEncoder(source, generation, build=build)
         self.resyncs_total = 0
         self.auth_failures_total = 0
         self.last_frame_bytes = 0
         self.last_frame_kind: int | None = None
+        # Version-skew negotiation state (ISSUE 14). proto_max pins the
+        # ceiling this publisher will negotiate UP to (--hub-proto-max:
+        # staged rollouts hold a wave at v1; the skew sim uses it to be
+        # an "old" publisher); the encoder still opens at v1 and only
+        # the receiver's hello raises it. The counters split the three
+        # outcomes apart: negotiated (normal), downgraded (the receiver
+        # rolled BACK mid-session and our frames started drawing
+        # "unsupported version"), refused (disjoint ranges — 426, the
+        # one outcome that cannot self-heal without an operator).
+        # 0 = this build's maximum (the --hub-proto-max default).
+        self._proto_cap = max(PROTO_MIN,
+                              min(PROTO_MAX, proto_max or PROTO_MAX))
+        self._hub_hello: dict | None = None
+        self.proto_upgrades_total = 0
+        self.proto_downgrades_total = 0
+        self.skew_refused_total = 0
         # Shed-honoring state (ISSUE 12 satellite): when the hub answers
         # 429/503 + Retry-After, the next push is deferred until a
         # decorrelated-jitter spread of that hint has passed — delay =
@@ -487,9 +670,32 @@ class DeltaPublisher(PublishFollower):
                 f"{delay:.2f}s (Retry-After {retry_after:g}s)",
                 source=self._encoder.source)
 
-    def _post(self, wire: bytes) -> tuple[str, float]:
-        """('ok' | 'resync' | 'shed' | 'error', retry-after seconds —
-        meaningful only for 'shed') for one frame POST."""
+    @staticmethod
+    def _parse_hello(headers) -> dict | None:
+        """The receiver's advertised (min, max, caps, build) from its
+        response headers; None when the receiver predates hellos (an
+        old hub — the publisher then stays at v1, the feature-masked
+        encoding every build accepts)."""
+        if headers is None:
+            return None
+        raw_max = headers.get(HELLO_PROTO_MAX)
+        if raw_max is None:
+            return None
+        try:
+            return {
+                "proto_min": int(headers.get(HELLO_PROTO_MIN, "1")),
+                "proto_max": int(raw_max),
+                "caps": int(headers.get(HELLO_CAPS, "0")),
+                "build": headers.get(HELLO_BUILD, ""),
+            }
+        except ValueError:
+            return None
+
+    def _post(self, wire: bytes) -> tuple[str, float, dict | None]:
+        """('ok' | 'resync' | 'shed' | 'skew' | 'unsupported' |
+        'error', retry-after seconds — meaningful only for 'shed',
+        receiver hello when its response carried one) for one frame
+        POST."""
         import urllib.error
         import urllib.request
 
@@ -512,11 +718,18 @@ class DeltaPublisher(PublishFollower):
         else:
             opener = push_opener()
         try:
-            with opener.open(request, timeout=self._timeout):
-                return "ok", 0.0
+            with opener.open(request, timeout=self._timeout) as response:
+                return "ok", 0.0, self._parse_hello(response.headers)
         except urllib.error.HTTPError as exc:
+            hello = self._parse_hello(exc.headers)
             if exc.code == 409:
-                return "resync", 0.0
+                return "resync", 0.0, hello
+            if exc.code == 426:
+                # Version skew the receiver refused outright (ISSUE
+                # 14): our frame's protocol version is outside its
+                # accepted range. The hello rides the refusal so the
+                # caller can renegotiate into range when one exists.
+                return "skew", 0.0, hello
             if exc.code in (429, 503) and \
                     exc.headers.get("Retry-After") is not None:
                 # Admission shed, not a failure: the hub refused the
@@ -524,7 +737,7 @@ class DeltaPublisher(PublishFollower):
                 # come back. Known-unapplied => defer + re-diff, never
                 # a FULL promotion (that would amplify exactly the load
                 # being shed).
-                return "shed", retry_after_seconds(exc.headers)
+                return "shed", retry_after_seconds(exc.headers), hello
             if exc.code == 401:
                 # Credential problem, not a transport blip: count it
                 # separately so "the hub rejects our password" is
@@ -532,35 +745,132 @@ class DeltaPublisher(PublishFollower):
                 self.auth_failures_total += 1
                 log.warning("delta push unauthorized (HTTP 401): check "
                             "--hub-auth-username/--hub-auth-password-file")
-                return "error", 0.0
+                return "error", 0.0, hello
+            if exc.code == 400:
+                # An OLD receiver (pre-hello) rejecting a v2 frame says
+                # "unsupported version" in the body — the one signal a
+                # build that predates negotiation can give. Distinct
+                # outcome: the caller downgrades to v1 and re-sends
+                # INSIDE this push (a rolling hub downgrade costs one
+                # frame round-trip, not a quarantine strike per push).
+                body = b""
+                try:
+                    body = exc.read(200)
+                except Exception:  # noqa: BLE001 - conn already dead
+                    pass
+                if b"unsupported version" in body:
+                    return "unsupported", 0.0, hello
             log.warning("delta push rejected (HTTP %d)", exc.code)
-            return "error", 0.0
+            return "error", 0.0, hello
         except Exception as exc:  # noqa: BLE001 - transport failure
             log.warning("delta push failed: %s", exc)
-            return "error", 0.0
+            return "error", 0.0, None
+
+    def _negotiate(self, hello: dict | None) -> bool:
+        """Adopt the receiver's hello (ISSUE 14): clamp our wire
+        version into the intersection of its advertised range and our
+        own ceiling. True when the encoder's wire state changed. A
+        disjoint range changes nothing — the 426 path owns that
+        refusal's accounting."""
+        if not hello:
+            return False
+        self._hub_hello = hello
+        target = min(self._proto_cap, hello["proto_max"])
+        if target < hello["proto_min"]:
+            return False
+        before = self._encoder.proto
+        if not self._encoder.set_wire(target, hello["caps"]):
+            return False
+        if self._encoder.proto > before:
+            self.proto_upgrades_total += 1
+        elif self._encoder.proto < before:
+            self.proto_downgrades_total += 1
+        # else: caps-only renegotiation (a hub minor enabled a new
+        # feature bit) — a wire change worth the trace event below,
+        # but neither an upgrade nor a downgrade: counting it as a
+        # downgrade would make doctor --skew cry rollback on a
+        # routine feature rollout.
+        if self._tracer is not None:
+            self._tracer.event(
+                "proto_negotiated",
+                f"{self._encoder.source}: wire protocol v{before} -> "
+                f"v{self._encoder.proto} (hub "
+                f"{hello.get('build') or 'unknown build'} speaks "
+                f"{hello['proto_min']}..{hello['proto_max']})",
+                source=self._encoder.source)
+        return True
 
     def _send_frame(self, body: str) -> tuple[str, float]:
-        """Encode + POST one snapshot with in-push 409 recovery (the
-        hub lost or never had our session — restarted hub, evicted
-        source, seq gap after our own failed send: one FULL inside this
-        push, not one more interval of gap). Owns the encoder's
-        ack/defer/nack transition and the pushes_total/last_frame
-        accounting; the caller classifies the outcome ('ok' | 'shed' |
-        'error') for its own path (live vs backlog drain)."""
+        """Encode + POST one snapshot with bounded in-push recovery:
+        409 resync (the hub lost or never had our session — one FULL
+        inside this push, not one more interval of gap), old-hub
+        "unsupported version" 400 (downgrade the ENCODING to v1 and
+        re-send the same data), and 426 version-skew refusal
+        (renegotiate into the advertised range when one exists). Owns
+        the encoder's ack/defer/nack transition and the
+        pushes_total/last_frame accounting; the caller classifies the
+        outcome ('ok' | 'shed' | 'skew' | ...) for its own path (live
+        vs backlog drain)."""
         encoder = self._encoder
         wire, kind = encoder.encode_next(body)
-        outcome, retry_after = self._post(wire)
-        if outcome == "resync":
-            self.resyncs_total += 1
-            encoder.nack()
-            if self._tracer is not None:
-                self._tracer.event(
-                    "delta_resync",
-                    f"{encoder.source}: hub demanded resync; sending full "
-                    f"snapshot", source=encoder.source)
+        outcome, retry_after, hello = self._post(wire)
+        for _attempt in range(2):
+            if outcome == "resync":
+                self.resyncs_total += 1
+                encoder.nack()
+                if self._tracer is not None:
+                    self._tracer.event(
+                        "delta_resync",
+                        f"{encoder.source}: hub demanded resync; sending "
+                        f"full snapshot", source=encoder.source)
+            elif outcome == "unsupported" and encoder.proto > PROTO_MIN:
+                # A receiver that predates negotiation (or rolled back
+                # to one) 400s our v2 frames with "unsupported
+                # version" and no hello. Drop the ENCODING to v1 —
+                # same data, legacy framing — and re-send now. The
+                # frame definitely never touched session state (a 400
+                # is pre-apply), so defer + re-diff, never a FULL.
+                self.proto_downgrades_total += 1
+                if self._tracer is not None:
+                    self._tracer.event(
+                        "proto_downgrade",
+                        f"{encoder.source}: hub rejected wire protocol "
+                        f"v{encoder.proto} (pre-negotiation build); "
+                        f"downgrading encoding to v{PROTO_MIN}",
+                        source=encoder.source)
+                encoder.set_wire(PROTO_MIN, 0)
+                self._hub_hello = None
+                encoder.defer()
+            elif outcome == "skew":
+                # Distinct 426 refusal: our version is outside the
+                # receiver's accepted window (e.g. a census-gated
+                # --ingest-proto-min floor). Definitely unapplied.
+                # Renegotiate into range when the hello offers one we
+                # can speak; a disjoint range stays refused — counted,
+                # journaled, and visible in doctor --skew on BOTH ends.
+                self.skew_refused_total += 1
+                encoder.defer()
+                if self._tracer is not None:
+                    self._tracer.event(
+                        "skew_refused",
+                        f"{encoder.source}: hub refused wire protocol "
+                        f"v{encoder.proto} (accepts "
+                        f"{hello['proto_min']}..{hello['proto_max']})"
+                        if hello else
+                        f"{encoder.source}: hub refused wire protocol "
+                        f"v{encoder.proto} (version skew)",
+                        source=encoder.source)
+                if not self._negotiate(hello):
+                    break
+            else:
+                break
             wire, kind = encoder.encode_next(body)
-            outcome, retry_after = self._post(wire)
+            outcome, retry_after, hello = self._post(wire)
         if outcome == "ok":
+            # Adopt the receiver's hello for FUTURE frames (the normal
+            # upgrade path: first FULL goes v1, the 200's hello raises
+            # the session to the common maximum, deltas ride v2).
+            self._negotiate(hello)
             encoder.ack()
             self.pushes_total += 1
             self.last_frame_bytes = len(wire)
@@ -571,9 +881,38 @@ class DeltaPublisher(PublishFollower):
             # untouched), not a resync (the frame never reached session
             # state, so the acked diff base is still valid).
             encoder.defer()
+        elif outcome in ("skew", "unsupported"):
+            # Refused for version reasons and no renegotiation landed:
+            # definitely unapplied, so the acked diff base survives.
+            # The caller treats it like a down link (spool when a spill
+            # queue exists — the backlog drains complete after the
+            # rollout wave that fixes the skew).
+            encoder.defer()
         else:
             encoder.nack()
         return outcome, retry_after
+
+    @property
+    def negotiated_proto(self) -> int:
+        return self._encoder.proto
+
+    def skew_status(self) -> dict:
+        """This publisher's side of the version-skew picture (ISSUE
+        14): what it speaks, what it negotiated, what the hub last
+        advertised, and the refusal/downgrade counters — the daemon's
+        /debug/skew payload and doctor --skew's node-side evidence."""
+        return {
+            "source": self._encoder.source,
+            "build": self._encoder.build,
+            "proto_min": PROTO_MIN,
+            "proto_max": self._proto_cap,
+            "negotiated_proto": self._encoder.proto,
+            "negotiated_caps": self._encoder.caps,
+            "hub": dict(self._hub_hello) if self._hub_hello else None,
+            "skew_refused_total": self.skew_refused_total,
+            "proto_upgrades_total": self.proto_upgrades_total,
+            "proto_downgrades_total": self.proto_downgrades_total,
+        }
 
     @property
     def backlog_depth(self) -> int:
@@ -643,10 +982,12 @@ class DeltaPublisher(PublishFollower):
                     # of the drain contract.
                     self._note_shed(retry_after)
                     return
-                # Still partitioned: the frame stays at the head, the
-                # probe backs off, failures stay visible in the push
-                # health.
-                self.failures_total += 1
+                # Still partitioned (or version-refused — its own
+                # counter, not a push failure): the frame stays at the
+                # head, the probe backs off, failures stay visible in
+                # the push health.
+                if outcome not in ("skew", "unsupported"):
+                    self.failures_total += 1
                 self._link_failures += 1
                 self._probe_at = (time.monotonic()
                                   + self.backoff.interval_for(
@@ -719,6 +1060,20 @@ class DeltaPublisher(PublishFollower):
                     "delta", serialize_seconds, self.last_frame_bytes)
         elif outcome == "shed":
             self._note_shed(retry_after)
+        elif outcome in ("skew", "unsupported"):
+            # Version-refused, NOT a transport failure: it has its own
+            # counter (kts_skew_refused_total / downgrades) and its own
+            # operator surface (doctor --skew) — counting it into
+            # collector_push_failures_total would page the wrong
+            # runbook. The DATA still survives the skew: with a spill
+            # queue the snapshot spools and the backlog drains complete
+            # after the rollout wave that fixes the mismatch; either
+            # way the follower's backoff paces the retries.
+            if self._spill is not None:
+                self._enter_spill(text, generation)
+                self.consecutive_failures = 0
+            else:
+                self.consecutive_failures += 1
         else:
             self.failures_total += 1
             if self._spill is not None:
@@ -744,7 +1099,7 @@ class _Session:
     pays replay, never apply."""
 
     __slots__ = ("source", "generation", "seq", "last_monotonic", "frames",
-                 "last_gap", "order")
+                 "last_gap", "order", "proto", "caps", "build")
 
     def __init__(self, source: str, order: int = 0) -> None:
         self.source = source
@@ -762,6 +1117,13 @@ class _Session:
         # tables, so the hub's target order (and its first-wins series
         # dedup) is indistinguishable from the single-table era.
         self.order = order
+        # Fleet version census (ISSUE 14): the wire version + caps of
+        # the session's last frame and the publisher build its v2
+        # FULLs declared. proto 0 = nothing seen yet (a warm-restart
+        # replay; the publisher's next frame stamps the truth).
+        self.proto = 0
+        self.caps = 0
+        self.build = ""
 
     def stamp(self, now: float) -> None:
         if self.last_monotonic:
@@ -887,9 +1249,31 @@ class DeltaIngest:
                  quarantine_threshold: int = 5,
                  quarantine_window: float = 60.0,
                  checkpoint_path: str = "",
-                 checkpoint_interval: float = 10.0) -> None:
+                 checkpoint_interval: float = 10.0,
+                 proto_min: int = PROTO_MIN,
+                 proto_max: int = PROTO_MAX,
+                 build: str | None = None) -> None:
         self._tracer = tracer
         self._expiry = expiry
+        # Accepted wire-version window (ISSUE 14). The default is
+        # everything this build can decode; --ingest-proto-min raises
+        # the floor for census-gated rollouts (refuse stragglers with
+        # 426 instead of silently carrying v1 forever), and the skew
+        # sim pins proto_max below the ceiling to play an old hub.
+        # Frames outside the window draw a 426 + hello — a distinct,
+        # journaled refusal (kts_skew_refused_total), never a
+        # malformed-frame quarantine strike: the peer is healthy, just
+        # mid-rollout.
+        self._proto_min = max(PROTO_MIN,
+                              min(PROTO_MAX, proto_min or PROTO_MIN))
+        self._proto_max = max(self._proto_min,
+                              min(PROTO_MAX, proto_max or PROTO_MAX))
+        if build is None:
+            from . import __version__ as build
+        self._build = build
+        self._skew_lock = threading.Lock()
+        self.skew_refused_total = 0
+        self._skew_peers: dict[str, dict] = {}
         # Sharded lanes (ISSUE 11 tentpole): sources hash to a lane;
         # each lane serializes only its own sources' applies, so at
         # 10k-pusher fan-in the handler threads stop convoying behind
@@ -948,7 +1332,7 @@ class DeltaIngest:
         self.checkpoint_writes = 0
         self.checkpoint_loaded = False
         self._replay_lock = threading.Lock()
-        self._pending_replay: dict[str, tuple[int, int, int, str]] = {}
+        self._pending_replay: dict[str, tuple] = {}
         self._replay_thread: threading.Thread | None = None
         self._replay_loaded_monotonic = 0.0
         self.warm_restart_sessions = 0
@@ -1011,6 +1395,115 @@ class DeltaIngest:
     # Quarantine keys beyond this are evicted oldest-first: a flood of
     # spoofed sources must not grow the breaker dict without bound.
     MAX_QUARANTINE_KEYS = 1024
+
+    # Refused-peer records beyond this are evicted oldest-first: the
+    # doctor needs the skewed peers NAMED, but a spoofed flood must
+    # not grow the dict without bound.
+    MAX_SKEW_PEERS = 64
+
+    # A peer refused for version skew within this window answers the
+    # same 426 from its record, BEFORE any decompression: a 426 is
+    # deliberately not a quarantine strike (the peer is healthy, just
+    # mid-rollout), so without this fence a version-stamp flood would
+    # buy a full snappy decompress per frame forever — exactly the
+    # cost class the PR 10 malformed-frame breaker fences for garbage.
+    # last_wall is NOT refreshed by throttled replies, so the window
+    # expires one throttle period after the last DECODED refusal: a
+    # flood pays at most one decompress per window, and a peer that
+    # just upgraded waits at most this long to be decoded again.
+    SKEW_THROTTLE_SECONDS = 1.0
+
+    # -- version skew (ISSUE 14) ----------------------------------------------
+
+    def hello_headers(self) -> dict[str, str]:
+        """The receiver's capability advertisement, attached to every
+        ingest response (200/409/426 alike): the publisher's
+        negotiation input. Header cost is a few dozen bytes against a
+        snappy frame — cheaper than any scheme that makes the
+        publisher ASK."""
+        return {
+            HELLO_PROTO_MIN: str(self._proto_min),
+            HELLO_PROTO_MAX: str(self._proto_max),
+            HELLO_CAPS: str(CAPS_CURRENT),
+            HELLO_BUILD: self._build,
+        }
+
+    def _skew_response(self, version: int) -> tuple[int, bytes, dict]:
+        """The one 426 refusal shape both the decoded path and the
+        throttle fast path answer with — hello + Retry-After attached,
+        so a refused peer always learns what WOULD be accepted."""
+        headers = self.hello_headers()
+        headers["Retry-After"] = "60"
+        return (426,
+                f"upgrade required: wire protocol v{version} outside "
+                f"accepted range {self._proto_min}.."
+                f"{self._proto_max}\n".encode(),
+                headers)
+
+    def _record_skew_peer(self, key: str, version: int) -> bool:
+        """Upsert one refused-peer record (bounded, oldest evicted);
+        True when this (key, version) pair is new — the journal-once
+        signal. Caller holds _skew_lock."""
+        record = self._skew_peers.get(key)
+        fresh = record is None or record["version"] != version
+        if record is None:
+            if len(self._skew_peers) >= self.MAX_SKEW_PEERS:
+                self._skew_peers.pop(next(iter(self._skew_peers)))
+            record = {"version": version, "count": 0, "last_wall": 0.0}
+            self._skew_peers[key] = record
+        record["version"] = version
+        record["count"] += 1
+        record["last_wall"] = time.time()
+        return fresh
+
+    def _refuse_skew(self, key: str, version: int,
+                     peer: str = "") -> tuple[int, bytes, dict]:
+        """426-style refusal for an out-of-range wire version: counted,
+        peer recorded for doctor --skew, journaled on the first sight
+        of each (peer, version) — NOT per frame, a stuck straggler
+        retries every push interval for hours. ``peer`` (when it names
+        an address distinct from ``key``) gets its own record so the
+        pre-decode throttle covers source-keyed refusals too — the
+        count rides the primary key alone."""
+        with self._skew_lock:
+            self.skew_refused_total += 1
+            fresh = self._record_skew_peer(key, version)
+            if peer and peer != key:
+                # The address record makes the pre-decode throttle
+                # cover source-keyed refusals too. Both records count
+                # their own sightings (doctor lists both; the overall
+                # tally is skew_refused_total, counted once above).
+                self._record_skew_peer(peer, version)
+        if fresh and self._tracer is not None:
+            self._tracer.event(
+                "skew_refused",
+                f"{key}: refused wire protocol v{version} (this hub "
+                f"accepts {self._proto_min}..{self._proto_max}) — "
+                f"version skew; see doctor --skew",
+                source=key)
+        return self._skew_response(version)
+
+    def _skew_throttled(self, key: str) -> tuple[int, bytes,
+                                                 dict] | None:
+        """Pre-decode fast path: a peer refused for skew TWICE within
+        the throttle window answers its recorded 426 (counted, hello
+        attached) for a dict lookup — no decompression. The first
+        retry after a refusal always decodes: _send_frame renegotiates
+        off the 426's hello and re-POSTs inside the same push, and
+        that recovery frame may now be in range — throttling it would
+        convert the documented one-round-trip recovery into a deferred
+        push. None when decode should proceed. last_wall is
+        deliberately not refreshed here (see SKEW_THROTTLE_SECONDS)."""
+        with self._skew_lock:
+            record = self._skew_peers.get(key)
+            if record is None or record["count"] < 2 or (
+                    time.time() - record["last_wall"]
+                    >= self.SKEW_THROTTLE_SECONDS):
+                return None
+            self.skew_refused_total += 1
+            record["count"] += 1
+            version = record["version"]
+        return self._skew_response(version)
 
     def _count_shed(self, reason: str) -> None:
         with self._shed_lock:
@@ -1150,11 +1643,37 @@ class DeltaIngest:
             self._count_shed("quarantined")
             return (429, b"quarantined: repeated malformed frames\n",
                     {"Retry-After": f"{self._quarantine_window:g}"})
+        if peer:
+            # Version-skew fast fence (same spirit as the quarantine
+            # check above, gentler verdict): a peer refused within the
+            # throttle window re-draws its 426 before any decode work,
+            # so a skewed flood costs a dict lookup per frame — a
+            # healthy co-NAT'd pusher caught by the shared address is
+            # deferred (not failed) for at most one window.
+            throttled = self._skew_throttled(peer)
+            if throttled is not None:
+                return throttled
         try:
             frame = decode_frame(wire)
+        except FrameVersionSkew as exc:
+            # NOT a malformed-frame strike: the peer is a healthy
+            # exporter from another rollout wave. Keyed on the peer
+            # address (the frame may be undecodable past the header,
+            # so the source is untrustworthy) — the refusal carries
+            # this hub's hello so the publisher can renegotiate.
+            return self._refuse_skew(peer or "unknown-peer", exc.version)
         except ValueError as exc:
             self._record_malformed([peer_key] if peer_key else [])
             return 400, f"bad delta frame: {exc}\n".encode(), {}
+        if not self._proto_min <= frame.proto <= self._proto_max:
+            # Decodable, but outside THIS hub's accepted window — a
+            # census-gated --ingest-proto-min floor refusing a
+            # straggler, or a sim playing an old hub. The frame
+            # decoded, so key the refusal on the honest source name;
+            # the peer address rides along so the pre-decode throttle
+            # fences repeats of THIS class too.
+            return self._refuse_skew(frame.source, frame.proto,
+                                     peer=peer)
         source_key = "source:" + frame.source
         if self._quarantine_blocked(source_key):
             self._count_shed("quarantined")
@@ -1172,7 +1691,12 @@ class DeltaIngest:
             # recovering peer whose first frame drew a resync would
             # stay quarantined one extra window.
             self._absolve([k for k in (peer_key, source_key) if k])
-            return 409, f"resync required: {exc}\n".encode(), {}
+            # The hello rides the 409 too: a publisher recovering into
+            # a freshly-upgraded hub learns the new range on the very
+            # response that triggers its FULL, so the resync frame can
+            # already ride the negotiated version.
+            return (409, f"resync required: {exc}\n".encode(),
+                    self.hello_headers())
         except ValueError as exc:  # unparseable FULL body
             # The frame DECODED, so the source identity is reliable —
             # quarantine that alone, never the peer: many pushers share
@@ -1189,7 +1713,12 @@ class DeltaIngest:
                 with self._inflight_lock:
                     self._inflight -= 1
         self._absolve([k for k in (peer_key, source_key) if k])
-        return 200, b"ok\n", {}
+        # Every accepted frame's response is a hello (ISSUE 14): a few
+        # dozen header bytes buy the publisher a zero-round-trip
+        # upgrade path — its first v1 FULL's 200 already names the
+        # common maximum, so the session's deltas ride the negotiated
+        # version from frame two.
+        return 200, b"ok\n", self.hello_headers()
 
     def _route(self, source: str) -> tuple[_Lane, dict]:
         """(lane, entry mapping) for a source — the source is hashed
@@ -1262,9 +1791,27 @@ class DeltaIngest:
                       nbytes: int, entry) -> None:
         lane.bytes += nbytes
         session = lane.sessions.get(frame.source)
+        if session is not None:
+            # Fleet version census (ISSUE 14): every frame refreshes
+            # the session's observed wire state; a capability frame's
+            # build extension names the publisher build (most v2
+            # frames omit it — announce-once — so keep the last
+            # answer), while a v1 frame CLEARS it: a publisher rolled
+            # back to a pre-capability build must not stay listed
+            # under its new-build census entry forever, or the
+            # operator could never confirm the rollback landed.
+            session.proto = frame.proto
+            session.caps = frame.caps
+            if frame.build:
+                session.build = frame.build
+            elif frame.proto < 2:
+                session.build = ""
         if frame.kind == KIND_FULL:
             if session is None:
                 session = _Session(frame.source, next(self._order))
+                session.proto = frame.proto
+                session.caps = frame.caps
+                session.build = frame.build
                 lane.sessions[frame.source] = session
             elif (session.generation == frame.generation
                     and frame.seq == session.seq and session.frames):
@@ -1380,6 +1927,68 @@ class DeltaIngest:
                     gaps[source] = session.last_gap
         return gaps
 
+    def fleet_versions(self) -> dict[str, int]:
+        """Version census over live sessions (ISSUE 14), the
+        kts_fleet_version_count{version} source: keyed by the
+        publisher build its v2 FULLs declared when known, else by the
+        bare wire version ("wire-v1" — a pre-capability build), else
+        "unknown" (a warm-restart replay whose publisher hasn't pushed
+        since this hub started). On a federation root the leaf hubs ARE
+        sessions here, so the census covers the whole re-export tree."""
+        census: dict[str, int] = {}
+        for lane in self._lanes:
+            with lane.lock:
+                for session in lane.sessions.values():
+                    if session.build:
+                        key = session.build
+                    elif session.proto:
+                        key = f"wire-v{session.proto}"
+                    else:
+                        key = "unknown"
+                    census[key] = census.get(key, 0) + 1
+        return census
+
+    # Downgraded-peer names listed verbatim in skew_status() are capped;
+    # past this the list carries a count, not ten thousand URLs.
+    MAX_SKEW_NAMES = 32
+
+    def skew_status(self) -> dict:
+        """The receiver's half of the version-skew picture (ISSUE 14):
+        what this hub accepts, the live fleet version census, every
+        refused peer (bounded, with the version it offered), and the
+        sessions still riding a wire version below this hub's maximum
+        (the not-yet-upgraded stragglers a census-gated rollout watches)
+        — the hub's /debug/skew payload and doctor --skew's evidence."""
+        downgraded: list[dict] = []
+        extra = 0
+        for lane in self._lanes:
+            with lane.lock:
+                for source, session in lane.sessions.items():
+                    if 0 < session.proto < self._proto_max:
+                        if len(downgraded) < self.MAX_SKEW_NAMES:
+                            downgraded.append({
+                                "source": source,
+                                "proto": session.proto,
+                                "build": session.build,
+                            })
+                        else:
+                            extra += 1
+        with self._skew_lock:
+            peers = {key: dict(record)
+                     for key, record in self._skew_peers.items()}
+            refused = self.skew_refused_total
+        return {
+            "build": self._build,
+            "proto_min": self._proto_min,
+            "proto_max": self._proto_max,
+            "caps": CAPS_CURRENT,
+            "fleet_versions": self.fleet_versions(),
+            "skew_refused_total": refused,
+            "refused_peers": peers,
+            "downgraded_sessions": downgraded,
+            "downgraded_sessions_truncated": extra,
+        }
+
     def evict(self, alive: set) -> None:
         """Drop sessions for departed targets on the same refresh path
         that evicts their _TargetCache entries — a worker restarting
@@ -1402,11 +2011,19 @@ class DeltaIngest:
             "quarantined": self.quarantined,
             "shed": sum(self.shed_total.values()),
             "warm_restart_pending": len(self._pending_replay),
+            "skew_refused": self.skew_refused_total,
         }
 
     # -- warm restart (ISSUE 12): WAL checkpoint + replay ---------------------
 
-    CHECKPOINT_VERSION = 1
+    # v2 (ISSUE 14) appends each session record's observed wire state
+    # (proto, caps, build) so a restarted hub's fleet version census
+    # survives the restart. v1 records (5 fields) still load — the
+    # wire state defaults to unknown until the publisher's next frame
+    # stamps the truth — and a v1 build confronted with a v2 file
+    # quarantines it aside intact (wal.read_state's refuse-don't-
+    # corrupt rule) instead of corrupting it.
+    CHECKPOINT_VERSION = 2
 
     @staticmethod
     def _render_series(series) -> str:
@@ -1448,7 +2065,7 @@ class DeltaIngest:
         checkpoint taken between a session's FULL and its first DELTA
         replays to exactly the post-FULL seq). Serialization happens
         outside the locks; only list() copies happen inside."""
-        raw: list[tuple[str, int, int, int, list]] = []
+        raw: list[tuple] = []
         store = self._entry_store
         sharded = (isinstance(store, LaneStore)
                    and len(store.shards) == len(self._lanes))
@@ -1461,10 +2078,14 @@ class DeltaIngest:
                             or entry.series is None):
                         continue
                     raw.append((source, session.generation, session.seq,
-                                session.order, list(entry.series)))
+                                session.order, list(entry.series),
+                                session.proto, session.caps,
+                                session.build))
         sessions = [
-            [source, generation, seq, order, self._render_series(series)]
-            for source, generation, seq, order, series in raw
+            [source, generation, seq, order,
+             self._render_series(series), proto, caps, build]
+            for source, generation, seq, order, series,
+            proto, caps, build in raw
         ]
         # Sessions still AWAITING warm replay carry forward verbatim
         # (their records are already in checkpoint form): a checkpoint
@@ -1477,9 +2098,9 @@ class DeltaIngest:
         captured = {record[0] for record in sessions}
         with self._replay_lock:
             pending = list(self._pending_replay.items())
-        for source, (generation, seq, order, body) in pending:
+        for source, record in pending:
             if source not in captured:
-                sessions.append([source, generation, seq, order, body])
+                sessions.append([source, *record])
         self._ckpt_seq += 1
         return {
             "version": self.CHECKPOINT_VERSION,
@@ -1531,11 +2152,32 @@ class DeltaIngest:
         self._ckpt_seq = int(state.get("seq", 0)) if state is not None else 0
         if state is None:
             return
+        if "sessions" not in state:
+            # Pruned-keys tolerance (ISSUE 14 satellite): an older (or
+            # hand-edited) checkpoint missing the sessions list loads
+            # as empty with a warning, never a KeyError on the restart
+            # path — the hub starts cold for those sessions, which is
+            # exactly what no checkpoint would have meant.
+            log.warning("ingest checkpoint has no 'sessions' key "
+                        "(older build?); starting with no warm sessions")
         max_order = 0
-        for source, generation, seq, order, body in \
-                state.get("sessions", ()):
+        for record in state.get("sessions", ()):
+            if len(record) < 5:
+                log.warning("ingest checkpoint record %r too short; "
+                            "skipping (that source pays one FULL "
+                            "resync)", record[:1])
+                continue
+            # v1 records stop at the body; v2 appends (proto, caps,
+            # build). Unknown FURTHER fields from a future minor are
+            # ignored — forward tolerance, the same rule the wire
+            # decoder applies to extension blocks.
+            source, generation, seq, order, body = record[:5]
+            proto = int(record[5]) if len(record) > 5 else 0
+            caps = int(record[6]) if len(record) > 6 else 0
+            build = str(record[7]) if len(record) > 7 else ""
             self._pending_replay[str(source)] = (
-                int(generation), int(seq), int(order), str(body))
+                int(generation), int(seq), int(order), str(body),
+                proto, caps, build)
             max_order = max(max_order, int(order))
         self._order = itertools.count(max_order + 1)
         self.checkpoint_loaded = True
@@ -1543,13 +2185,12 @@ class DeltaIngest:
         log.info("ingest checkpoint loaded: %d session(s) pending warm "
                  "replay", len(self._pending_replay))
 
-    def _replay_one(self, source: str,
-                    record: tuple[int, int, int, str]) -> None:
+    def _replay_one(self, source: str, record: tuple) -> None:
         """Rebuild one source's session + entry from its checkpoint
         record. Parse runs before the lane lock (the FULL-storm
         discipline); a session that already exists wins — a live FULL
         is always fresher than the checkpoint."""
-        generation, seq, order, body = record
+        generation, seq, order, body, proto, caps, build = record
         series = parse_exposition_interned(body)
         entry = (self._entry_factory(series)
                  if self._entry_factory is not None else None)
@@ -1560,6 +2201,12 @@ class DeltaIngest:
             session = _Session(source, order)
             session.generation = generation
             session.seq = seq
+            # Census continuity across the restart (ISSUE 14): the
+            # checkpointed wire state stands in until the publisher's
+            # next frame re-stamps the truth.
+            session.proto = proto
+            session.caps = caps
+            session.build = build
             # Stamped now, not at checkpoint time: the session is
             # fresh-for-one-fence-window so the first refresh after a
             # restart serves the checkpointed values (that is the warm

@@ -53,6 +53,16 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  loss, a near-full spool, parked poison, or a down
                  link; classified 401/404/disabled like --host. Same
                  server fallback as --trace.
+  --skew         pull the RUNNING daemon's (or hub's) /debug/skew
+                 snapshot and print the rolling-upgrade picture: the
+                 fleet version census (hub), every refused peer with
+                 the wire version it offered, downgraded/not-yet-
+                 upgraded sessions, the publisher's negotiated version
+                 against its upstream hub (daemon/leaf), and any
+                 persisted-format files quarantined at startup. WARN
+                 on refusals, forced downgrades, quarantines, or a
+                 mixed-version census; same server fallback as
+                 --trace.
 
 Exit code: 0 = no failures (warns allowed), 1 = at least one failure,
 2 = usage error. Every probe is time-bounded; doctor never hangs on a
@@ -863,6 +873,26 @@ def check_egress(base: str) -> CheckResult:
             parts.append(f"spill DROPPED {spill['dropped_total']} "
                          f"frame(s) at the byte bound (data loss, "
                          f"accounted — see kts_spill_dropped_total)")
+        if spill.get("undecodable_total", 0):
+            # ISSUE 14 satellite: the counter existed since the spill
+            # queue landed, but no operator surface explained what a
+            # nonzero value MEANS or where to look next.
+            status = WARN
+            parts.append(
+                f"{spill['undecodable_total']} spooled frame(s) "
+                f"undecodable — version skew (a build this one can't "
+                f"read wrote them); see doctor --skew")
+        if spill.get("reencoded_total", 0):
+            parts.append(
+                f"{spill['reencoded_total']} old-format spooled "
+                f"frame(s) recovered by re-encoding at the negotiated "
+                f"wire version")
+        if spill.get("skew_segments_total", 0):
+            status = WARN
+            parts.append(
+                f"{spill['skew_segments_total']} future-format spill "
+                f"segment(s) quarantined intact (*.skew — a downgrade "
+                f"landed on a newer build's spool); see doctor --skew")
         max_bytes = spill.get("max_bytes") or 0
         if max_bytes and spill.get("bytes", 0) > 0.8 * max_bytes:
             status = WARN
@@ -904,6 +934,127 @@ def check_egress(base: str) -> CheckResult:
         parts.append("egress healthy; no backlog")
     return _result("egress", status, "; ".join(parts),
                    data={"egress": payload})
+
+
+def skew_verdict(payload: dict) -> tuple[str, str]:
+    """(status, detail) for a /debug/skew payload — the fleet version
+    census plus every refused/downgraded peer, named (ISSUE 14). Pure
+    so tests drive it on canned JSON; check_skew wraps it with the
+    fetch. WARN on anything an operator should act on mid-rollout:
+    refused peers (426s — a version outside the accepted window),
+    publisher-side refusals or forced downgrades, quarantined
+    persisted formats, or a mixed-version census (a rollout in flight
+    — or stuck)."""
+    parts: list[str] = []
+    status = OK
+    build = payload.get("build", "unknown")
+    parts.append(f"build {build} speaks wire "
+                 f"v{payload.get('proto_min', '?')}.."
+                 f"v{payload.get('proto_max', '?')}")
+    ingest = payload.get("ingest")
+    if ingest:
+        census = ingest.get("fleet_versions") or {}
+        if census:
+            parts.append("fleet census: " + ", ".join(
+                f"{version}={count}"
+                for version, count in sorted(census.items())))
+            if len(census) > 1:
+                status = WARN
+                parts.append("MIXED fleet (rollout in progress — "
+                             "census-gate the next wave on "
+                             "kts_fleet_version_count)")
+        refused = ingest.get("refused_peers") or {}
+        if refused or ingest.get("skew_refused_total", 0):
+            status = WARN
+            names = "; ".join(
+                f"{peer} offered v{record.get('version', '?')} "
+                f"(x{record.get('count', 0)})"
+                for peer, record in sorted(refused.items()))
+            parts.append(
+                f"REFUSED {ingest.get('skew_refused_total', 0)} "
+                f"frame(s) outside accepted "
+                f"v{ingest.get('proto_min', '?')}.."
+                f"v{ingest.get('proto_max', '?')}"
+                + (f": {names}" if names else ""))
+        downgraded = ingest.get("downgraded_sessions") or []
+        if downgraded:
+            extra = ingest.get("downgraded_sessions_truncated", 0)
+            names = ", ".join(
+                f"{row.get('source', '?')} (v{row.get('proto', '?')}"
+                + (f", {row['build']}" if row.get("build") else "")
+                + ")"
+                for row in downgraded)
+            parts.append(
+                f"{len(downgraded) + extra} session(s) below this "
+                f"hub's max: {names}"
+                + (f" … +{extra} more" if extra else ""))
+    publisher = payload.get("publisher")
+    if publisher:
+        hub_hello = publisher.get("hub")
+        negotiated = publisher.get("negotiated_proto", "?")
+        if hub_hello:
+            parts.append(
+                f"publisher negotiated v{negotiated} with hub "
+                f"{hub_hello.get('build') or 'unknown build'} "
+                f"(speaks {hub_hello.get('proto_min', '?')}.."
+                f"{hub_hello.get('proto_max', '?')})")
+        else:
+            parts.append(f"publisher at v{negotiated} (hub hello not "
+                         f"seen yet — pre-negotiation hub, or no push "
+                         f"landed)")
+        if publisher.get("skew_refused_total", 0):
+            status = WARN
+            parts.append(
+                f"upstream hub REFUSED {publisher['skew_refused_total']} "
+                f"push(es) for version skew (426) — disjoint ranges "
+                f"cannot self-heal; fix the rollout order")
+        if publisher.get("proto_downgrades_total", 0):
+            status = WARN
+            parts.append(
+                f"{publisher['proto_downgrades_total']} encoding "
+                f"downgrade(s) (hub rolled back or predates "
+                f"negotiation — data intact, features masked)")
+    quarantined = payload.get("wal_quarantined") or {}
+    if quarantined:
+        status = WARN
+        parts.append(
+            "QUARANTINED future-format file(s), byte-identical aside: "
+            + ", ".join(f"{store}={count}"
+                        for store, count in sorted(quarantined.items()))
+            + " — a downgrade landed on newer persisted state; "
+            "re-upgrade (or move the .skew file back) to replay")
+    return status, "; ".join(parts)
+
+
+def check_skew(base: str) -> CheckResult:
+    """--skew: read /debug/skew from the RUNNING daemon or hub and
+    print the rolling-upgrade picture — version census, refused and
+    downgraded peers, quarantined persisted formats. Classified
+    401/404 like --egress: a WARN row diagnoses config, only a broken
+    surface FAILs."""
+    import urllib.error
+
+    try:
+        payload = _fetch_json(base + "/debug/skew")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "skew", WARN,
+                f"{base}/debug/skew requires authentication "
+                f"(HTTP {exc.code}); the skew snapshot sits behind "
+                f"the exporter's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "skew", WARN,
+                f"{base}: no /debug/skew (exporter predates the "
+                f"version-skew layer — which is itself a version-skew "
+                f"data point: this build is newer than that one)")
+        return _result("skew", FAIL, f"{base}/debug/skew: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable, bad JSON
+        return _result("skew", FAIL,
+                       f"{base}: skew snapshot unreadable ({exc})")
+    status, detail = skew_verdict(payload)
+    return _result("skew", status, detail, data={"skew": payload})
 
 
 def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
@@ -1238,7 +1389,8 @@ def run_checks(cfg: Config, url: str = "",
                fleet: bool = False,
                energy: bool = False,
                host: bool = False,
-               egress: bool = False) -> list[CheckResult]:
+               egress: bool = False,
+               skew: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -1291,6 +1443,14 @@ def run_checks(cfg: Config, url: str = "",
                        if url.startswith(("http://", "https://"))
                        else f"http://127.0.0.1:{cfg.listen_port}")
         probes.append(("egress", lambda: check_egress(egress_base)))
+    if skew:
+        # /debug/skew lives on BOTH daemon and hub servers: an http(s)
+        # --url names which to read; otherwise fall back to the local
+        # daemon on the configured listen port, like --egress.
+        skew_base = (trace_base(url)
+                     if url.startswith(("http://", "https://"))
+                     else f"http://127.0.0.1:{cfg.listen_port}")
+        probes.append(("skew", lambda: check_skew(skew_base)))
     if fleet:
         # The fleet lens lives on the HUB, not the daemon: an http(s)
         # --url names the hub to read; otherwise fall back to a local
@@ -1358,6 +1518,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     energy = False
     host = False
     egress = False
+    skew = False
     url = ""
     args: list[str] = []
     it = iter(raw)
@@ -1374,6 +1535,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             host = True
         elif token == "--egress":
             egress = True
+        elif token == "--skew":
+            skew = True
         elif token == "--url":
             url = next(it, "")
             if not url or url.startswith("--"):
@@ -1391,7 +1554,8 @@ def main(argv: Sequence[str] | None = None) -> int:
     cfg = from_args(args)
     started = time.monotonic()
     results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
-                         energy=energy, host=host, egress=egress)
+                         energy=energy, host=host, egress=egress,
+                         skew=skew)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

@@ -217,7 +217,23 @@ class EnergyAccountant:
                                 label="energy")
         if state is None:
             return
-        for pod, namespace, joules in state.get("per_pod", ()):
+        # Pruned-keys tolerance (ISSUE 14 satellite): an older build
+        # wrote fewer keys and shorter per_pod records — default and
+        # warn, never a KeyError/ValueError on the restart path (a
+        # crash-loop here would cost exactly the monotone-across-
+        # restarts guarantee the checkpoint exists for).
+        missing = [key for key in ("per_pod", "covered_seconds",
+                                   "total_seconds")
+                   if key not in state]
+        if missing:
+            log.warning("energy checkpoint missing %s (older build?); "
+                        "defaulting", ", ".join(missing))
+        for record in state.get("per_pod", ()):
+            if len(record) < 3:
+                log.warning("energy checkpoint per_pod record %r too "
+                            "short; skipping", record)
+                continue
+            pod, namespace, joules = record[:3]
             self._per_pod[(str(pod), str(namespace))] = float(joules)
         self.covered_seconds = float(state.get("covered_seconds", 0.0))
         self.total_seconds = float(state.get("total_seconds", 0.0))

@@ -157,7 +157,7 @@ class MetricsServer:
                  trace_provider=None, fleet_provider=None,
                  ingest_provider=None, burst_provider=None,
                  energy_provider=None, host_provider=None,
-                 egress_provider=None,
+                 egress_provider=None, skew_provider=None,
                  prewarm_renders: bool = True,
                  ingest_read_deadline: float = 10.0):
         self._registry = registry
@@ -212,6 +212,12 @@ class MetricsServer:
         # nothing configured answers enabled:false (the --no-trace
         # contract); None (bare test servers) 404s.
         self._egress = egress_provider
+        # Version-skew snapshot (ISSUE 14, duck-typed: () -> dict):
+        # serves /debug/skew — build + wire-protocol range, publisher
+        # negotiation state (daemon) or fleet version census + refused
+        # peers (hub), quarantined persisted formats — the payload
+        # `doctor --skew` reads. None (bare test servers) 404s.
+        self._skew = skew_provider
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -619,6 +625,23 @@ class MetricsServer:
                             + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/skew" and outer._skew is not None:
+                    # Version-skew picture (ISSUE 14): this process's
+                    # build + wire-protocol range, negotiation state
+                    # (publisher) or fleet version census + refused
+                    # peers (hub), and any quarantined persisted
+                    # formats — the payload doctor --skew reads.
+                    import json
+
+                    try:
+                        payload = outer._skew()
+                    except Exception as exc:  # noqa: BLE001 - a status
+                        # walk must not 500 the whole debug surface.
+                        payload = {"error": str(exc)}
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -665,6 +688,8 @@ class MetricsServer:
                         links += ["/debug/host"]
                     if outer._egress is not None:
                         links += ["/debug/egress"]
+                    if outer._skew is not None:
+                        links += ["/debug/skew"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

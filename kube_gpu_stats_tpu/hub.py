@@ -480,7 +480,9 @@ class Hub:
                  ingest_quarantine_threshold: int = 5,
                  ingest_quarantine_window: float = 60.0,
                  ingest_checkpoint: str = "",
-                 ingest_checkpoint_interval: float = 10.0) -> None:
+                 ingest_checkpoint_interval: float = 10.0,
+                 ingest_proto_min: int = 0,
+                 ingest_proto_max: int = 0) -> None:
         if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -628,7 +630,12 @@ class Hub:
             quarantine_threshold=ingest_quarantine_threshold,
             quarantine_window=ingest_quarantine_window,
             checkpoint_path=ingest_checkpoint,
-            checkpoint_interval=ingest_checkpoint_interval)
+            checkpoint_interval=ingest_checkpoint_interval,
+            # Version-skew window (ISSUE 14): 0 = this build's bound;
+            # --ingest-proto-min raises the floor for census-gated
+            # rollouts, frames outside draw 426 + hello.
+            proto_min=ingest_proto_min,
+            proto_max=ingest_proto_max)
             if delta_ingest else None)
         self._push_served = 0  # targets served by push, last refresh
         # Federated slice_* series dropped because two leaves claimed
@@ -1296,6 +1303,15 @@ class Hub:
                             lane["frames"], labels)
                 builder.add(schema.INGEST_LANE_APPLY_SECONDS,
                             lane["apply_seconds"], labels)
+            # Fleet version census + skew refusals (ISSUE 14): the
+            # census-gated-rollout gauge (one series per live publisher
+            # build; on a federation root the leaves' sessions census
+            # the whole tree) and the refused-peer counter doctor
+            # --skew explains.
+            for version, count in sorted(
+                    self.delta.fleet_versions().items()):
+                builder.add(schema.FLEET_VERSION_COUNT, float(count),
+                            (("version", version),))
         if self._federate:
             # Born at 0 on every federation root (increase() alerting):
             # non-federate hubs never re-export slice_* series, so the
@@ -1313,10 +1329,34 @@ class Hub:
                         labels)
         if self._render_stats is not None:
             self._render_stats.contribute(builder)
-        if self._push_stats is not None:
-            contribute_push_stats(builder, self._push_stats())
+        push_stats = (self._push_stats()
+                      if self._push_stats is not None else None)
+        if push_stats is not None:
+            contribute_push_stats(builder, push_stats)
         if self._egress_stats is not None:
             contribute_egress_stats(builder, self._egress_stats())
+        # Rolling-upgrade census inputs (ISSUE 14): this hub's build +
+        # wire range on its own exposition, skew refusals it issued
+        # (ingest) PLUS any it drew as a leaf pushing upstream (one
+        # unlabeled counter — summed at the source so the series stays
+        # unique), and persisted formats quarantined at startup.
+        from . import __version__ as _build
+        from . import wal as wal_mod
+
+        builder.add(
+            schema.BUILD_INFO, 1.0,
+            [("version", _build),
+             ("proto_min", str(delta_mod.PROTO_MIN)),
+             ("proto_max", str(delta_mod.PROTO_MAX))])
+        skew_refused = (self.delta.skew_refused_total
+                        if self.delta is not None else 0)
+        if push_stats is not None:
+            skew_refused += sum(entry.get("skew_refused", 0)
+                                for entry in push_stats.values())
+        builder.add(schema.SKEW_REFUSED, float(skew_refused))
+        for store, count in sorted(wal_mod.quarantine_counts().items()):
+            builder.add(schema.WAL_QUARANTINED, float(count),
+                        (("store", store),))
         # The hub's own process health (CPU, RSS, fds) — same process_*
         # families the daemon exports, so one dashboard covers both.
         procstats.contribute(builder, proc_readings)
@@ -2147,6 +2187,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                 # A leaf hub pushing into a shedding root honors its
                 # Retry-After exactly like a daemon does (ISSUE 12).
                 stats[mode]["shed_honored"] = sender.shed_honored_total
+            if hasattr(sender, "skew_refused_total"):
+                # Root-hub skew refusals this leaf drew (ISSUE 14):
+                # folded into the leaf's own kts_skew_refused_total.
+                stats[mode]["skew_refused"] = sender.skew_refused_total
         return stats
 
     def egress_payload() -> dict:
@@ -2163,6 +2207,32 @@ def main(argv: Sequence[str] | None = None) -> int:
             }
             for mode, sender in senders
         }
+        return payload
+
+    def skew_payload() -> dict:
+        # /debug/skew for the hub (ISSUE 14): the receiver half (fleet
+        # version census + refused peers from the ingest) plus — on a
+        # federation leaf — the publisher half against the root, plus
+        # any persisted formats quarantined at startup. Same shape
+        # doctor --skew reads from daemons, with the hub extras.
+        from . import __version__, wal
+        from .delta import PROTO_MAX, PROTO_MIN
+
+        payload: dict = {
+            "role": "hub",
+            "build": __version__,
+            "proto_min": PROTO_MIN,
+            "proto_max": PROTO_MAX,
+            "publisher": None,
+            "wal_quarantined": wal.quarantine_counts(),
+            "wal_quarantine_events": wal.quarantine_events(),
+        }
+        if hub.delta is not None:
+            payload["ingest"] = hub.delta.skew_status()
+        for mode, sender in senders:
+            status_fn = getattr(sender, "skew_status", None)
+            if mode == "delta" and callable(status_fn):
+                payload["publisher"] = status_fn()
         return payload
 
     def egress_stats() -> dict:
@@ -2213,7 +2283,9 @@ def main(argv: Sequence[str] | None = None) -> int:
               ingest_quarantine_threshold=args.ingest_quarantine_threshold,
               ingest_quarantine_window=args.ingest_quarantine_window,
               ingest_checkpoint=args.ingest_checkpoint,
-              ingest_checkpoint_interval=args.ingest_checkpoint_interval)
+              ingest_checkpoint_interval=args.ingest_checkpoint_interval,
+              ingest_proto_min=args.ingest_proto_min,
+              ingest_proto_max=args.ingest_proto_max)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -2280,7 +2352,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             insecure_tls=args.hub_insecure_tls,
             tracer=hub.tracer,
             spill=spill,
-            drain_rate=args.hub_drain_rate)))
+            drain_rate=args.hub_drain_rate,
+            proto_max=args.hub_proto_max)))
 
     if args.once:
         frame = hub.refresh_once()
@@ -2306,7 +2379,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         trace_provider=hub.tracer,
         fleet_provider=hub.fleet,
         ingest_provider=hub.delta.handle if hub.delta is not None else None,
-        egress_provider=egress_payload)
+        egress_provider=egress_payload,
+        skew_provider=skew_payload)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

@@ -1539,8 +1539,10 @@ class PollLoop:
                 schema.RPC_BATCHED_FAMILIES,
                 float(rpc_stats().get("batched_families", 0)),
             )
-        if self._push_stats is not None:
-            contribute_push_stats(builder, self._push_stats())
+        push_stats = (self._push_stats()
+                      if self._push_stats is not None else None)
+        if push_stats is not None:
+            contribute_push_stats(builder, push_stats)
         if self._egress_stats is not None:
             # Spill / durable remote-write health (ISSUE 13): the
             # kts_spill_* and kts_remote_write_* families ride every
@@ -1558,6 +1560,37 @@ class PollLoop:
             1.0,
             [("version", self._version), ("backend", self._collector.name)],
         )
+        # Rolling-upgrade census inputs (ISSUE 14): the wire-protocol
+        # range this build speaks rides every exposition so a
+        # scrape-side census never needs the push path, and any
+        # future-format files quarantined at startup stay visible for
+        # as long as the process runs (the degradation they mean must
+        # never be silent). Late imports: delta pulls in the publisher
+        # stack, which not every daemon configures.
+        from . import wal as wal_mod
+        from .delta import PROTO_MAX, PROTO_MIN
+
+        builder.add(
+            schema.BUILD_INFO,
+            1.0,
+            [("version", self._version),
+             ("proto_min", str(PROTO_MIN)),
+             ("proto_max", str(PROTO_MAX))],
+        )
+        for store, count in sorted(wal_mod.quarantine_counts().items()):
+            builder.add(schema.WAL_QUARANTINED, float(count),
+                        (("store", store),))
+        if push_stats is not None:
+            # Upstream-hub skew refusals this node's delta publisher
+            # drew (426): a daemon-side mirror of the hub's own
+            # kts_skew_refused_total, emitted only when a delta
+            # publisher is configured (the key rides its push stats).
+            entries = [entry for entry in push_stats.values()
+                       if "skew_refused" in entry]
+            if entries:
+                builder.add(schema.SKEW_REFUSED,
+                            float(sum(entry["skew_refused"]
+                                      for entry in entries)))
         if self._process_metrics:
             procstats.contribute(builder, self._harvest_procstats())
         builder.add_histogram(self._hist)

@@ -367,6 +367,12 @@ def contribute_egress_stats(builder: SnapshotBuilder, stats) -> None:
         builder.add(schema.SPILL_FRAMES,
                     float(spill.get("drained_total", 0)),
                     (("state", "drained"),))
+        builder.add(schema.SPILL_FRAMES,
+                    float(spill.get("reencoded_total", 0)),
+                    (("state", "reencoded"),))
+        builder.add(schema.SPILL_FRAMES,
+                    float(spill.get("undecodable_total", 0)),
+                    (("state", "undecodable"),))
         builder.add(schema.SPILL_DROPPED,
                     float(spill.get("dropped_total", 0)))
         builder.add(schema.SPILL_DEPTH,

@@ -202,6 +202,17 @@ def encode_shard_request(samples, protocol: str) -> bytes:
 # its bytes already are.
 _PROTO_BYTE = {"1.0": b"\x01", "2.0": b"\x02"}
 
+# WAL record payload format (ISSUE 14), stamped into every segment's
+# container header: v1 = one remote-write-protocol byte (_PROTO_BYTE)
+# followed by the compressed WriteRequest body. A segment declaring a
+# NEWER payload format at recovery (downgrade mid-rollout) is parked
+# aside intact by the ring, never fed to this decoder.
+RW_WAL_FORMAT_VERSION = 1
+
+# The parked-poison ring's records are raw request payloads kept for
+# post-mortem; same format lineage as the WAL records they came from.
+RW_PARKED_FORMAT_VERSION = 1
+
 
 class _Shard:
     """One send shard of the durable exporter: its own WAL ring,
@@ -221,7 +232,8 @@ class _Shard:
         self.ring = SegmentRing(
             os.path.join(directory, f"shard-{index:02d}"),
             max_bytes=max_bytes, segment_bytes=min(1 << 20, max_bytes),
-            prefix="rw", fsync=fsync, label=f"remote-write shard {index}")
+            prefix="rw", fsync=fsync, label=f"remote-write shard {index}",
+            format_version=RW_WAL_FORMAT_VERSION)
         # Poison requests, kept (bounded, oldest evicted uncounted —
         # these are already counted as parked) for post-mortem: curl
         # the receiver with one by hand to see WHY it 400s.
@@ -229,7 +241,8 @@ class _Shard:
             os.path.join(directory, f"shard-{index:02d}", "parked"),
             max_bytes=4 << 20, segment_bytes=1 << 20,
             prefix="parked", fsync=False,
-            label=f"remote-write shard {index} parked")
+            label=f"remote-write shard {index} parked",
+            format_version=RW_PARKED_FORMAT_VERSION)
         self._tracer = tracer
         self.parked_total = 0
         self.sent_total = 0
@@ -299,6 +312,12 @@ class _Shard:
             "parked_total": self.parked_total,
             "dropped_total": self.dropped_total,
             "torn_total": self.ring.torn_records,
+            # Future-format segments set aside intact at recovery
+            # (version skew after a downgrade, ISSUE 14) — visible so
+            # the lag they explain is diagnosable, and replayable by
+            # moving the .skew file back under the writing build.
+            "skew_segments_total": self.ring.skew_segments,
+            "format_version": ring["format_version"],
             "consecutive_failures": self.failures,
             "retry_in_seconds": round(
                 max(0.0, self.retry_at - time.monotonic()), 3),

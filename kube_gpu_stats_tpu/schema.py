@@ -662,6 +662,69 @@ HUB_WARM_RESTART_CHECKPOINT_AGE = MetricSpec(
     "resync on the next start.",
 )
 
+# Version-skew survival families (ISSUE 14): rolling upgrades leave
+# the fleet mixed-build for hours; these are the census and the
+# refusal accounting the 'Rolling upgrades' runbook keys on.
+
+BUILD_INFO = MetricSpec(
+    "kts_build_info",
+    MetricType.GAUGE,
+    "Constant 1 on daemon and hub alike; the labels carry this "
+    "process's exporter build version and the delta wire-protocol "
+    "range it speaks (proto_min..proto_max). Join/group across the "
+    "fleet for a scrape-side version census; the push-side census the "
+    "hub computes itself is kts_fleet_version_count.",
+    extra_labels=("version", "proto_min", "proto_max"),
+)
+FLEET_VERSION_COUNT = MetricSpec(
+    "kts_fleet_version_count",
+    MetricType.GAUGE,
+    "Live push sessions per publisher version, from the hub's ingest "
+    "census: the label is the build its FULL frames declared "
+    "(capability-carrying builds), 'wire-vN' for a pre-capability "
+    "build that only stamps the wire version, or 'unknown' for a "
+    "warm-restored session whose publisher hasn't pushed since "
+    "restart. THE census-gated-rollout gauge: proceed to the next "
+    "wave when the old version's count reaches 0 (see the Rolling "
+    "upgrades runbook and the FleetVersionSkewStuck alert).",
+    extra_labels=("version",),
+)
+SKEW_REFUSED = MetricSpec(
+    "kts_skew_refused_total",
+    MetricType.COUNTER,
+    "Frames refused for wire-protocol version skew (HTTP 426 + this "
+    "end's advertised range). On a hub: frames whose version fell "
+    "outside --ingest-proto-min/max — a healthy peer from another "
+    "rollout wave, NOT a malformed-frame quarantine strike; the "
+    "refused peers are named at /debug/skew and by doctor --skew. On "
+    "a daemon/leaf: pushes the upstream hub refused the same way. "
+    "Steady growth means a publisher/hub pair whose ranges are "
+    "disjoint — it cannot self-heal; fix the rollout "
+    "(FleetVersionSkewStuck).",
+)
+WAL_QUARANTINED = MetricSpec(
+    "kts_wal_quarantined_total",
+    MetricType.COUNTER,
+    "Persisted files set aside byte-identical (renamed *.skew-vN / "
+    "*.skew) because they carry a FUTURE format version this build "
+    "cannot safely parse — a downgrade landed on a newer build's "
+    "state. The process starts degraded from empty state for that "
+    "store instead of truncating data a newer build wrote; "
+    "re-upgrading (or moving the file back under the writing build) "
+    "replays it. Labeled by store (energy, ingest, spill, remote-write "
+    "shard N...); any increase deserves a look — it means version "
+    "skew reached disk.",
+    extra_labels=("store",),
+)
+
+# Shared by daemon and hub expositions (the hub-only census family
+# rides HUB_METRICS); folded into SELF_METRICS below.
+SKEW_METRICS: tuple[MetricSpec, ...] = (
+    BUILD_INFO,
+    SKEW_REFUSED,
+    WAL_QUARANTINED,
+)
+
 # Fleet-lens families (fleetlens.py, driven from the hub refresh):
 # cross-node anomaly detection, slow-node attribution, SLO burn windows.
 
@@ -763,6 +826,7 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_WARM_RESTART_REPLAY_SECONDS,
     HUB_WARM_RESTART_CHECKPOINT_WRITES,
     HUB_WARM_RESTART_CHECKPOINT_AGE,
+    FLEET_VERSION_COUNT,
     FLEET_TARGETS_ANOMALOUS,
     FLEET_ANOMALIES,
     FLEET_SLO_BURN,
@@ -1236,8 +1300,13 @@ SPILL_FRAMES = MetricSpec(
     MetricType.COUNTER,
     "Delta-push snapshots through the disk spill queue, by state: "
     "'spooled' (published while the hub link was down — written to the "
-    "bounded on-disk ring instead of dropped) and 'drained' (sent to "
-    "the hub on reconnect, oldest-first, drain-rate limited). spooled "
+    "bounded on-disk ring instead of dropped), 'drained' (sent to "
+    "the hub on reconnect, oldest-first, drain-rate limited), "
+    "'reencoded' (old-format spooled wire frames whose FULL body was "
+    "recovered and re-sent at the negotiated wire version — a "
+    "mid-rollout spool replays, it doesn't rot), and 'undecodable' "
+    "(CRC-valid records no decoder in this build understands — "
+    "version skew; doctor --egress points at doctor --skew). spooled "
     "minus drained minus kts_spill_dropped_total is the live backlog "
     "(kts_spill_depth_frames).",
     extra_labels=("state",),
@@ -1454,6 +1523,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_PUSH_DROPPED,
     DELTA_SHED_HONORED,
     *EGRESS_METRICS,
+    *SKEW_METRICS,
     RENDER_PREWARM_WAIT,
     BREAKER_STATE,
     BREAKER_TRIPS,

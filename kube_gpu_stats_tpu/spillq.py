@@ -32,6 +32,17 @@ log = logging.getLogger(__name__)
 
 DEFAULT_MAX_BYTES = 64 * 1024 * 1024
 
+# Spill record payload format (ISSUE 14), stamped into every segment's
+# container header: v1 = one snappy-compressed rendered exposition
+# body per record. The DRAIN owns wire-version compatibility — bodies
+# re-encode through the publisher's live encoder at whatever version
+# the hub negotiated at drain time, so a spool written before an
+# upgrade (or before a hub downgrade) replays correctly either way. A
+# future-format segment is quarantined whole by the ring at recovery
+# (renamed aside intact, outside the accounting), never fed to this
+# decoder.
+SPILL_FORMAT_VERSION = 1
+
 
 class SpillQueue:
     """Bounded, crash-recoverable FIFO of (publish wall time, rendered
@@ -45,14 +56,30 @@ class SpillQueue:
         self._ring = SegmentRing(directory, max_bytes=max_bytes,
                                  segment_bytes=min(1 << 20, max_bytes),
                                  prefix="spill", fsync=fsync,
-                                 label="spill")
+                                 label="spill",
+                                 format_version=SPILL_FORMAT_VERSION)
         self._tracer = tracer
         self.spooled_total = 0
         self.drained_total = 0
-        # CRC-valid records that still failed snappy/utf-8 decode
-        # (version skew) — consumed without delivery, so the loss stays
-        # accounted: spooled == drained + dropped + undecodable + depth.
+        # CRC-valid records that still failed every decode — consumed
+        # without delivery, so the loss stays accounted: spooled ==
+        # drained + dropped + undecodable + depth. Nonzero is surfaced
+        # by doctor --egress with a version-skew hint (ISSUE 14
+        # satellite: the counter existed, no operator surface explained
+        # it).
         self.undecodable_total = 0
+        # Old-format records recovered by re-encoding (ISSUE 14): a
+        # record that decompresses to a raw v1/v2 WIRE FRAME (an older
+        # build spooled encoded frames, not bodies) has its FULL body
+        # extracted and re-enters the drain as a plain snapshot — the
+        # publisher re-encodes it at the NEGOTIATED wire version
+        # instead of counting it undecodable. Counted at COMMIT (the
+        # record was delivered), not at peek: a drain stalled on a
+        # down/shedding hub re-peeks the same head every probe cycle,
+        # and per-peek counting would inflate the metric by the retry
+        # count.
+        self.reencoded_total = 0
+        self._head_reencoded = False
         if self._ring.records_pending():
             # A restart with a backlog on disk resumes the drain where
             # the dead process stopped (minus the at-least-once cursor
@@ -95,19 +122,64 @@ class SpillQueue:
                 return None
             ts, payload = record
             try:
-                return ts, snappy.decompress(payload).decode()
-            except (ValueError, UnicodeDecodeError) as exc:
+                raw = snappy.decompress(payload)
+            except ValueError as exc:
+                self._drop_undecodable(exc)
+                continue
+            if raw[:4] == b"KTSD":
+                body = self._recover_wire_frame(raw)
+                if body is not None:
+                    self._head_reencoded = True
+                    return ts, body
+                self._drop_undecodable(
+                    ValueError("spooled wire frame carries no "
+                               "recoverable FULL body"))
+                continue
+            self._head_reencoded = False
+            try:
+                return ts, raw.decode()
+            except UnicodeDecodeError as exc:
                 # Drop it rather than wedge the drain forever on one
                 # frame — counted, never silent (the accounting
                 # invariant the partition sim pins).
-                log.warning("spill queue: dropping undecodable frame: %s",
-                            exc)
-                self.undecodable_total += 1
-                self._ring.commit()
+                self._drop_undecodable(exc)
+
+    @staticmethod
+    def _recover_wire_frame(raw: bytes) -> str | None:
+        """Old-format spool records (ISSUE 14): a build that spooled
+        ENCODED wire frames instead of bodies left snappy'd KTSD
+        frames in the ring. A FULL frame still carries the complete
+        rendered body — extract it, and the drain re-encodes it at the
+        negotiated wire version (the publisher's encoder owns that).
+        ``raw`` is the record ALREADY decompressed (the caller's magic
+        sniff paid the snappy pass; decode_frame_raw must not pay a
+        second one). None for anything else: a standalone DELTA has no
+        base to apply against (its data rides the next FULL's resync),
+        and garbage stays undecodable."""
+        from . import delta
+
+        try:
+            frame = delta.decode_frame_raw(raw)
+        except ValueError:  # FrameVersionSkew included
+            return None
+        if frame.kind == delta.KIND_FULL and frame.body:
+            return frame.body
+        return None
+
+    def _drop_undecodable(self, exc: Exception) -> None:
+        log.warning("spill queue: dropping undecodable frame "
+                    "(version skew? see doctor --skew): %s", exc)
+        self.undecodable_total += 1
+        self._ring.commit()
 
     def commit(self) -> None:
         self._ring.commit()
         self.drained_total += 1
+        if self._head_reencoded:
+            # The recovered old-format record was actually DELIVERED
+            # (at the negotiated wire version) — count it now, once.
+            self.reencoded_total += 1
+            self._head_reencoded = False
 
     def save_cursor(self, force: bool = False) -> None:
         self._ring.save_cursor(force)
@@ -137,7 +209,14 @@ class SpillQueue:
             "drained_total": self.drained_total,
             "dropped_total": self.dropped_total,
             "undecodable_total": self.undecodable_total,
+            "reencoded_total": self.reencoded_total,
             "torn_total": self.torn_total,
+            # Version-skew surfaces (ISSUE 14): future-format segments
+            # parked intact at recovery + this writer's payload format
+            # + pre-versioning segments still in the ring.
+            "skew_segments_total": ring["skew_segments_total"],
+            "format_version": ring["format_version"],
+            "legacy_segments": ring["legacy_segments"],
         }
 
     def close(self) -> None:

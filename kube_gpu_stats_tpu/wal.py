@@ -23,6 +23,30 @@ This module is the single implementation of both halves:
   the caller, which must count and journal it (bounded loss is a
   feature only when it is accounted). Torn tails (a crash mid-append)
   are truncated at the first bad CRC on recovery, never a raise.
+
+Version-skew survival (ISSUE 14): a fleet is never upgraded
+atomically, so every persisted format here is versioned and every
+reader follows one rule — **tolerate the past, quarantine the
+future, never corrupt either**:
+
+- JSON state carries a mandatory ``version`` stamp
+  (:func:`write_state` refuses an unstamped dict; the
+  ``tools/check_wal_versions.py`` lint backs this statically). Readers
+  accept any version up to their own (older builds simply wrote fewer
+  keys — loaders default-and-warn, satellite of ISSUE 14) and
+  **quarantine** a future-major file: moved byte-identical aside to
+  ``<path>.skew-v<N>`` (never truncated, never overwritten), so a
+  DOWNGRADE can move it back and replay it. The process starts
+  degraded-but-running from empty state, and the quarantine is
+  counted (:func:`quarantine_counts`, the ``kts_wal_quarantined_total``
+  source) so the degradation is visible, not silent.
+- Segment files written by this build open with a ``KTSG`` header
+  (container format byte + caller-declared payload format byte);
+  headerless files from older builds read as legacy payload-v1 — a
+  ring may hold BOTH mid-rollout. A segment whose container or
+  payload format is from the future is quarantined whole (renamed to
+  ``<seg>.skew``, bytes intact, outside the ring's accounting) and
+  recovery continues with the rest of the ring.
 """
 
 from __future__ import annotations
@@ -45,14 +69,110 @@ _RECORD = struct.Struct("<dII")
 # Segment files: <dir>/<prefix>-<seq>.seg, seq monotone per directory.
 _SEG_SUFFIX = ".seg"
 
+# Segment container header (ISSUE 14): magic + container format byte +
+# caller-declared payload format byte. Headerless segments (older
+# builds) are read as container v0 / payload v1.
+_SEG_MAGIC = b"KTSG"
+SEGMENT_CONTAINER_VERSION = 1
+
+# Quarantined future-format files: moved byte-identical aside under
+# this suffix family, never truncated — a downgrade moves them back.
+_SKEW_SUFFIX = ".skew"
+
+# -- quarantine accounting (module-wide, all stores) ------------------------
+# One registry for every WAL user in the process so the daemon/hub can
+# export kts_wal_quarantined_total{store} and doctor can list what was
+# set aside without each subsystem growing its own plumbing.
+_quarantine_lock = threading.Lock()
+_quarantine_counts: dict[str, int] = {}
+_quarantine_events: list[dict] = []
+_QUARANTINE_EVENT_CAP = 64
+
+
+def _note_quarantine(label: str, path: str, aside: str,
+                     version) -> None:
+    with _quarantine_lock:
+        _quarantine_counts[label] = _quarantine_counts.get(label, 0) + 1
+        _quarantine_events.append({
+            "store": label, "path": path, "aside": aside,
+            "version": version,
+        })
+        del _quarantine_events[:-_QUARANTINE_EVENT_CAP]
+
+
+def quarantine_counts() -> dict[str, int]:
+    """store label -> files quarantined this process — the
+    ``kts_wal_quarantined_total{store}`` source."""
+    with _quarantine_lock:
+        return dict(_quarantine_counts)
+
+
+def quarantine_events() -> list[dict]:
+    """Recent quarantine records (bounded) for /debug and doctor
+    surfaces: which file went aside where, and what version it
+    claimed."""
+    with _quarantine_lock:
+        return list(_quarantine_events)
+
+
+def reset_quarantine_stats() -> None:
+    """Test hook: the registry is process-global, and suites assert
+    exact counts."""
+    with _quarantine_lock:
+        _quarantine_counts.clear()
+        del _quarantine_events[:]
+
+
+def _quarantine_aside(path: str, version, *, label: str,
+                      base: str = "") -> str | None:
+    """Move a future-format file byte-identical aside (refuse, don't
+    corrupt): ``<path>.skew-v<N>`` (or the caller's ``base`` — the
+    segment rings park as ``<seg>.skew``), first free numbered variant
+    if a previous rollout already parked one — two downgrade accidents
+    in a row must keep BOTH files, never clobber the first. Returns
+    the aside path, or None when the move itself failed (the file is
+    left in place and the caller must NOT overwrite it)."""
+    base = base or f"{path}{_SKEW_SUFFIX}-v{version}"
+    target = base
+    for attempt in range(1, 100):
+        if not os.path.exists(target):
+            break
+        target = f"{base}.{attempt}"
+    else:
+        log.warning("%s: no free quarantine slot beside %s", label, path)
+        return None
+    try:
+        os.replace(path, target)
+    except OSError as exc:
+        log.warning("%s: could not quarantine %s aside: %s",
+                    label, path, exc)
+        return None
+    log.warning(
+        "%s: %s carries future format version %r (this build understands "
+        "older); quarantined byte-identical at %s — starting degraded "
+        "from empty state. A downgrade to the writing build can move it "
+        "back and replay it.", label, path, version, target)
+    _note_quarantine(label, path, target, version)
+    return target
+
 
 # -- atomic JSON state (the checkpoint half) --------------------------------
 
-def write_state(path: str, state: dict, *, label: str = "state") -> bool:
+def write_state(path: str, state: dict, *, label: str = "state",
+                version_key: str = "version") -> bool:
     """Write-ahead persist of one JSON state dict: full state to
     ``<path>.wal``, fsync, atomic rename over ``<path>``. Returns False
     (with a warning) on OSError — callers keep their dirty flag set and
-    retry on their own cadence."""
+    retry on their own cadence.
+
+    Every state dict MUST stamp its format version (ISSUE 14): an
+    unstamped write raises — readers on other builds have no other way
+    to decide tolerate-vs-quarantine, and the check_wal_versions lint
+    enforces the same contract statically."""
+    if version_key not in state:
+        raise ValueError(
+            f"{label} checkpoint state has no {version_key!r} stamp — "
+            f"every wal.py writer must version its format (ISSUE 14)")
     wal = path + ".wal"
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -69,8 +189,15 @@ def write_state(path: str, state: dict, *, label: str = "state") -> bool:
 
 def read_state(path: str, version: int, *, label: str = "state",
                version_key: str = "version") -> dict | None:
-    """One candidate file: None on absent/unreadable/garbage/
-    version-mismatch (each non-absent failure logged)."""
+    """One candidate file: None on absent/unreadable/garbage.
+
+    Version rule (ISSUE 14): a stamp AT OR BELOW ``version`` loads —
+    an older build simply wrote fewer keys, and every loader defaults
+    the missing ones — while a FUTURE stamp is quarantined
+    byte-identical aside (``<path>.skew-v<N>``) and None returned: the
+    caller starts degraded from empty state instead of truncating data
+    a newer build wrote (a downgrade can move the file back and replay
+    it). A non-integer or non-positive stamp is garbage, not skew."""
     try:
         with open(path, encoding="utf-8") as handle:
             state = json.load(handle)
@@ -79,12 +206,21 @@ def read_state(path: str, version: int, *, label: str = "state",
     except (OSError, ValueError) as exc:
         log.warning("%s checkpoint %s unreadable (%s)", label, path, exc)
         return None
-    if not isinstance(state, dict) or state.get(version_key) != version:
+    found = state.get(version_key) if isinstance(state, dict) else None
+    if not isinstance(found, int) or isinstance(found, bool) or found < 1:
         log.warning("%s checkpoint %s version %r unsupported; ignoring",
                     label, path,
-                    state.get(version_key) if isinstance(state, dict)
+                    found if isinstance(state, dict)
                     else type(state).__name__)
         return None
+    if found > version:
+        # Refuse-don't-corrupt: this file is from a newer build.
+        _quarantine_aside(path, found, label=label)
+        return None
+    if found < version:
+        log.info("%s checkpoint %s is format v%d (this build writes "
+                 "v%d): loading with defaults for the newer keys",
+                 label, path, found, version)
     return state
 
 
@@ -141,13 +277,22 @@ class SegmentRing:
 
     def __init__(self, directory: str, *, max_bytes: int,
                  segment_bytes: int = 1 << 20, prefix: str = "wal",
-                 fsync: bool = True, label: str = "segment-ring") -> None:
+                 fsync: bool = True, label: str = "segment-ring",
+                 format_version: int = 1) -> None:
         self._dir = directory
         self._max_bytes = max(segment_bytes, max_bytes)
         self._segment_bytes = segment_bytes
         self._prefix = prefix
         self._fsync = fsync
         self._label = label
+        # The CALLER's record-payload format (ISSUE 14): stamped into
+        # every new segment's KTSG header beside the container version,
+        # and the ceiling this reader accepts — a recovered segment
+        # declaring a NEWER payload format is quarantined whole
+        # (renamed aside intact; a downgrade replays it) instead of
+        # being fed to a decoder that predates it. Headerless segments
+        # from pre-versioning builds read as payload v1.
+        self._format_version = max(1, int(format_version))
         self._lock = threading.Lock()
         # seg seq -> [(ts, payload), ...] for every live segment; the
         # tail segment additionally has an open append handle. Records
@@ -168,6 +313,13 @@ class SegmentRing:
         self.torn_records = 0     # truncated at recovery (crash tails)
         self.evicted_records = 0  # dropped oldest-first at the byte cap
         self.appended_records = 0
+        # Future-format segments set aside intact at recovery (version
+        # skew after a downgrade) — counted so the degradation is
+        # visible in status()/doctor, and per-segment payload formats
+        # tracked so mixed-version rings stay diagnosable.
+        self.skew_segments = 0
+        self._headered: set[int] = set()        # segments with KTSG
+        self._payload_versions: dict[int, int] = {}
         os.makedirs(directory, exist_ok=True)
         self._recover()
 
@@ -180,19 +332,35 @@ class SegmentRing:
     def _cursor_path(self) -> str:
         return os.path.join(self._dir, self._prefix + "-cursor.json")
 
-    @staticmethod
-    def _read_segment(path: str) -> tuple[list[tuple[float, bytes]], int]:
-        """(records, torn) for one segment file: stop at the first
-        truncated/corrupt record — a crash mid-append tears only the
-        tail, and everything before it is CRC-proven intact."""
+    def _read_segment(self, path: str) -> tuple[
+            list[tuple[float, bytes]], int, int, int]:
+        """(records, torn, payload_version, skew_version) for one
+        segment file: stop at the first truncated/corrupt record — a
+        crash mid-append tears only the tail, and everything before it
+        is CRC-proven intact. A ``KTSG`` header names the container
+        and payload format versions; a headerless file is a
+        pre-versioning build's segment (payload_version 0 here, read
+        as payload v1). skew_version > 0 means the segment is from a
+        NEWER build — the caller must quarantine it whole, never parse
+        past the header."""
         records: list[tuple[float, bytes]] = []
         torn = 0
         try:
             with open(path, "rb") as handle:
                 data = handle.read()
         except OSError:
-            return records, 1
+            return records, 1, 0, 0
         pos = 0
+        payload_version = 0  # 0 = headerless legacy (reads as v1)
+        if data[:4] == _SEG_MAGIC:
+            if len(data) < 6:
+                return records, 1, SEGMENT_CONTAINER_VERSION, 0
+            container_v, payload_v = data[4], data[5]
+            if container_v > SEGMENT_CONTAINER_VERSION or \
+                    payload_v > self._format_version:
+                return records, 0, payload_v, max(container_v, payload_v)
+            payload_version = payload_v
+            pos = 6
         header = _RECORD.size
         while pos + header <= len(data):
             ts, length, crc = _RECORD.unpack_from(data, pos)
@@ -208,7 +376,7 @@ class SegmentRing:
             pos = end
         if pos < len(data) and not torn:
             torn = 1
-        return records, torn
+        return records, torn, payload_version, 0
 
     def _recover(self) -> None:
         seqs = []
@@ -233,19 +401,62 @@ class SegmentRing:
                 except ValueError:
                     continue
         for seq in sorted(seqs):
-            records, torn = self._read_segment(self._seg_path(seq))
+            path = self._seg_path(seq)
+            records, torn, payload_v, skew = self._read_segment(path)
+            if skew:
+                # A newer build wrote this segment (downgrade in
+                # progress): set it aside INTACT — outside the ring's
+                # byte accounting, never truncated — and recover the
+                # rest of the ring around it. The free-slot probe
+                # matters even here: a drained ring restarts its seq
+                # numbering, so a SECOND downgrade accident can land
+                # the same seq — it must park beside the first file,
+                # never over it.
+                aside = _quarantine_aside(path, skew, label=self._label,
+                                          base=path + _SKEW_SUFFIX)
+                if aside is None:
+                    continue
+                self.skew_segments += 1
+                log.warning(
+                    "%s: segment %d declares future format v%d (this "
+                    "build reads <= container v%d / payload v%d); "
+                    "quarantined intact at %s", self._label, seq, skew,
+                    SEGMENT_CONTAINER_VERSION, self._format_version,
+                    aside)
+                continue
+            headered = payload_v > 0
             if torn:
                 self.torn_records += torn
                 # Rewrite the proven-intact prefix so the torn bytes
-                # never come back on the NEXT recovery.
-                self._rewrite_segment(seq, records)
+                # never come back on the NEXT recovery. Headerness is
+                # preserved: rewriting a legacy segment WITH a header
+                # would turn a later downgrade's recovery of it into a
+                # full-segment truncation (the old reader sees the
+                # header bytes as a torn first record).
+                self._rewrite_segment(
+                    seq, records,
+                    payload_version=payload_v if headered else 0)
+            if headered:
+                self._headered.add(seq)
+            self._payload_versions[seq] = payload_v if headered else 1
             self._segments[seq] = records
             self._sizes[seq] = sum(_RECORD.size + len(p)
-                                   for _t, p in records)
+                                   for _t, p in records) + \
+                (6 if headered else 0)
         self._tail_seq = max(seqs) if seqs else 0
         cursor = read_state(self._cursor_path(), self.CURSOR_VERSION,
                             label=self._label + " cursor")
         if cursor is not None:
+            missing = [key for key in ("segment", "record")
+                       if key not in cursor]
+            if missing:
+                # Older-build cursor with pruned keys (ISSUE 14
+                # satellite): default-and-warn, never a KeyError on
+                # the restart path — the clamp below keeps the
+                # defaulted cursor inside reality either way.
+                log.warning("%s cursor missing %s (older build?); "
+                            "defaulting to the oldest record",
+                            self._label, ", ".join(missing))
             self._cursor_seg = int(cursor.get("segment", 0))
             self._cursor_idx = int(cursor.get("record", 0))
             self._cursor_epoch = int(cursor.get("seq", 0))
@@ -264,7 +475,11 @@ class SegmentRing:
             self._cursor_idx = 0
 
     def _rewrite_segment(self, seq: int,
-                         records: list[tuple[float, bytes]]) -> None:
+                         records: list[tuple[float, bytes]], *,
+                         payload_version: int = 0) -> None:
+        """payload_version > 0 rewrites with a KTSG header carrying
+        it; 0 rewrites headerless (a legacy segment stays readable by
+        the build that wrote it, should a downgrade follow)."""
         path = self._seg_path(seq)
         try:
             if not records:
@@ -272,6 +487,10 @@ class SegmentRing:
                 return
             tmp = path + ".wal"
             with open(tmp, "wb") as handle:
+                if payload_version > 0:
+                    handle.write(_SEG_MAGIC
+                                 + bytes((SEGMENT_CONTAINER_VERSION,
+                                          payload_version)))
                 for ts, payload in records:
                     handle.write(_RECORD.pack(ts, len(payload),
                                               zlib.crc32(payload)))
@@ -325,6 +544,19 @@ class SegmentRing:
         self._segments.setdefault(self._tail_seq, [])
         try:
             self._tail_handle = open(self._seg_path(self._tail_seq), "ab")
+            if self._tail_handle.tell() == 0:
+                # Fresh segment: stamp the KTSG header (ISSUE 14) so
+                # readers on other builds can tell this segment's
+                # container + payload format apart from both older
+                # headerless segments and newer ones they must park.
+                self._tail_handle.write(
+                    _SEG_MAGIC + bytes((SEGMENT_CONTAINER_VERSION,
+                                        self._format_version)))
+                self._tail_handle.flush()
+                self._tail_size += 6
+                self._headered.add(self._tail_seq)
+                self._payload_versions[self._tail_seq] = \
+                    self._format_version
         except OSError as exc:
             log.warning("%s: cannot open segment %d: %s",
                         self._label, self._tail_seq, exc)
@@ -339,6 +571,8 @@ class SegmentRing:
             victim = live[0]
             records = self._segments.pop(victim, [])
             self._sizes.pop(victim, None)
+            self._headered.discard(victim)
+            self._payload_versions.pop(victim, None)
             start = self._cursor_idx if victim == self._cursor_seg else 0
             evicted += max(0, len(records) - start)
             if self._cursor_seg <= victim:
@@ -379,6 +613,8 @@ class SegmentRing:
     def _drop_segment(self, seq: int) -> None:
         self._segments.pop(seq, None)
         self._sizes.pop(seq, None)
+        self._headered.discard(seq)
+        self._payload_versions.pop(seq, None)
         try:
             os.unlink(self._seg_path(seq))
         except OSError:
@@ -392,6 +628,8 @@ class SegmentRing:
             if seq < self._cursor_seg and seq != self._tail_seq:
                 self._segments.pop(seq, None)
                 self._sizes.pop(seq, None)
+                self._headered.discard(seq)
+                self._payload_versions.pop(seq, None)
                 try:
                     os.unlink(self._seg_path(seq))
                 except OSError:
@@ -470,6 +708,16 @@ class SegmentRing:
                 "evicted_total": self.evicted_records,
                 "torn_total": self.torn_records,
                 "max_bytes": self._max_bytes,
+                # Version-skew surfaces (ISSUE 14): future-format
+                # segments parked aside at recovery, the payload
+                # format this writer stamps, and whether the live ring
+                # still carries legacy (pre-versioning) segments — the
+                # mixed-fleet picture doctor --skew folds in.
+                "skew_segments_total": self.skew_segments,
+                "format_version": self._format_version,
+                "legacy_segments": sum(
+                    1 for seq in self._segments
+                    if seq not in self._headered),
             }
 
     def close(self) -> None:

@@ -274,3 +274,11 @@ def test_host_stats_flags(monkeypatch):
     cfg = from_args([])
     assert cfg.host_stats is False
     assert cfg.cgroup_root == "/env/cg"
+
+
+def test_hub_proto_max_flag_reaches_config():
+    """ISSUE 14 regression: the flag existed but wasn't mapped into
+    Config, so --hub-proto-max silently did nothing — a canary wave
+    'held at v1' would have negotiated up anyway."""
+    assert from_args([]).hub_proto_max == 0
+    assert from_args(["--hub-proto-max", "1"]).hub_proto_max == 1

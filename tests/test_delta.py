@@ -1147,3 +1147,343 @@ def test_fleet_lens_scores_frame_gap_for_push_targets(monkeypatch):
         assert 'slice_target_fetch_seconds{target="w0"} 0' in body
     finally:
         hub.stop()
+
+
+# -- version skew (ISSUE 14): versioned wire, hello negotiation, 426 ---------
+
+def test_codec_v2_roundtrip_with_caps_and_build():
+    body = make_body(1, 0.5)
+    wire = delta.encode_full("src", 7, 0, body, proto=2,
+                             caps=delta.CAP_BUILD_INFO, build="9.9.9")
+    frame = delta.decode_frame(wire)
+    assert (frame.proto, frame.caps, frame.build) == (
+        2, delta.CAP_BUILD_INFO, "9.9.9")
+    assert frame.body == body
+    wire = delta.encode_delta("src", 7, 1, [(0, 1.5), (3, 2.5)],
+                              proto=2, caps=delta.CAP_BUILD_INFO,
+                              build="9.9.9")
+    frame = delta.decode_frame(wire)
+    assert frame.proto == 2 and frame.build == "9.9.9"
+    assert frame.slots == (0, 3) and frame.values == (1.5, 2.5)
+
+
+def test_codec_v1_frames_carry_no_extensions():
+    """The v1 layout is byte-frozen: a capability build talking v1 is
+    indistinguishable from an old build (that IS the downgrade)."""
+    wire = delta.encode_full("src", 7, 0, "m 1\n", proto=1,
+                             caps=delta.CAP_BUILD_INFO, build="9.9.9")
+    frame = delta.decode_frame(wire)
+    assert (frame.proto, frame.caps, frame.build) == (1, 0, "")
+
+
+def test_codec_unknown_extension_tags_skipped_forward_tolerant():
+    """A v2.x publisher may append blocks a v2.0 receiver never heard
+    of: skipped whole by length, never an error."""
+    from kube_gpu_stats_tpu import snappy
+
+    wire = delta.encode_full("src", 7, 0, "m 1\n", proto=2,
+                             caps=delta.CAP_BUILD_INFO, build="b1")
+    raw = snappy.decompress(wire)
+    raw += delta._varint(200) + delta._varint(4) + b"\x00\x01\x02\x03"
+    frame = delta.decode_frame(snappy.compress(raw))
+    assert frame.build == "b1" and frame.body == "m 1\n"
+    # But a block lying about its length IS malformed.
+    truncated = snappy.decompress(wire) + delta._varint(200) \
+        + delta._varint(99) + b"zz"
+    with pytest.raises(ValueError, match="truncated extension"):
+        delta.decode_frame(snappy.compress(truncated))
+
+
+def test_decode_out_of_range_version_is_distinct_skew_error():
+    from kube_gpu_stats_tpu import snappy
+
+    wire = delta.encode_full("src", 7, 0, "m 1\n")
+    raw = bytearray(snappy.decompress(wire))
+    raw[4] = 9
+    with pytest.raises(delta.FrameVersionSkew) as exc:
+        delta.decode_frame(snappy.compress(bytes(raw)))
+    assert exc.value.version == 9
+    assert isinstance(exc.value, ValueError)  # still catchable broadly
+
+
+def test_ingest_answers_426_plus_hello_never_quarantine():
+    """An out-of-range frame is a healthy peer from another rollout
+    wave: 426 + this hub's advertised range, counted + journaled once,
+    NEVER a malformed-frame quarantine strike."""
+    from kube_gpu_stats_tpu import snappy
+    from kube_gpu_stats_tpu.tracing import Tracer
+
+    tracer = Tracer()
+    hub = _push_hub(tracer=None)
+    ingest = hub.delta
+    ingest._tracer = tracer
+    wire = delta.encode_full("src-future", 7, 0, "m 1\n")
+    raw = bytearray(snappy.decompress(wire))
+    raw[4] = 9
+    future = snappy.compress(bytes(raw))
+    for _ in range(3):
+        code, body, headers = ingest.handle(future, peer="10.0.0.9")
+        assert code == 426
+        assert headers[delta.HELLO_PROTO_MIN] == str(delta.PROTO_MIN)
+        assert headers[delta.HELLO_PROTO_MAX] == str(delta.PROTO_MAX)
+        assert "Retry-After" in headers
+    assert ingest.skew_refused_total == 3
+    assert ingest.quarantined == 0  # not a hostile-frame strike
+    status = ingest.skew_status()
+    assert "10.0.0.9" in status["refused_peers"]
+    assert status["refused_peers"]["10.0.0.9"]["version"] == 9
+    events = [e for e in tracer.events()["events"]
+              if e["kind"] == "skew_refused"]
+    assert len(events) == 1  # journaled on first sight, not per frame
+
+
+def test_ingest_window_refuses_decodable_but_gated_version():
+    """--ingest-proto-min floor (census-gated rollout): a DECODABLE v1
+    frame below the floor draws 426 keyed on the honest source name."""
+    hub = _push_hub(ingest_proto_min=2)
+    wire = delta.encode_full("http://old-node/metrics", 7, 0, "m 1\n",
+                             proto=1)
+    code, _body, headers = hub.delta.handle(wire)
+    assert code == 426
+    assert "http://old-node/metrics" in \
+        hub.delta.skew_status()["refused_peers"]
+
+
+def test_ingest_hello_rides_200_and_409():
+    hub = _push_hub()
+    encoder = delta.DeltaEncoder("src", generation=1)
+    wire, _ = encoder.encode_next(make_body(0, 0.1))
+    code, _body, headers = hub.delta.handle(wire)
+    assert code == 200
+    assert headers[delta.HELLO_PROTO_MAX] == str(delta.PROTO_MAX)
+    # A delta with no session draws a 409 WITH the hello: the refused
+    # peer renegotiates on the very response that triggers its FULL.
+    orphan = delta.encode_delta("nobody", 3, 5, [(0, 1.0)])
+    code, _body, headers = hub.delta.handle(orphan)
+    assert code == 409
+    assert delta.HELLO_PROTO_MAX in headers
+
+
+def test_session_census_tracks_proto_caps_and_build():
+    hub = _push_hub()
+    v1 = delta.DeltaEncoder("old-node", generation=1)
+    _feed(hub, v1, make_body(0, 0.1))
+    v2 = delta.DeltaEncoder("new-node", generation=2, build="7.7.7")
+    v2.set_wire(2, delta.CAP_BUILD_INFO)
+    _feed(hub, v2, make_body(1, 0.2))
+    census = hub.delta.fleet_versions()
+    assert census == {"wire-v1": 1, "7.7.7": 1}
+    status = hub.delta.skew_status()
+    assert [row["source"] for row in status["downgraded_sessions"]] \
+        == ["old-node"]
+
+
+def test_encoder_announces_build_on_first_frame_after_upgrade():
+    """The census must not wait for the next FULL: the first frame —
+    even a DELTA — after set_wire carries the build extension, then
+    stops paying the bytes."""
+    hub = _push_hub()
+    encoder = delta.DeltaEncoder("node", generation=1, build="8.8.8")
+    _feed(hub, encoder, make_body(0, 0.1))  # v1 FULL opener
+    assert hub.delta.fleet_versions() == {"wire-v1": 1}
+    assert encoder.set_wire(2, delta.CAP_BUILD_INFO)
+    wire, kind = encoder.encode_next(make_body(0, 0.2))
+    assert kind == delta.KIND_DELTA
+    frame = delta.decode_frame(wire)
+    assert frame.build == "8.8.8"  # the announce-once delta
+    code, _b, _h = hub.delta.handle(wire)
+    assert code == 200
+    encoder.ack()
+    assert hub.delta.fleet_versions() == {"8.8.8": 1}
+    # Announced and acked: the NEXT delta drops the extension bytes.
+    wire, _ = encoder.encode_next(make_body(0, 0.3))
+    assert delta.decode_frame(wire).build == ""
+    assert hub.delta.handle(wire)[0] == 200
+    encoder.ack()
+    # A v1<->v2 mixed chain is legal: session state keys on (gen, seq).
+    encoder.set_wire(1, 0)
+    wire, _ = encoder.encode_next(make_body(0, 0.4))
+    code, _b, _h = hub.delta.handle(wire)
+    assert code == 200
+
+
+def test_publisher_negotiates_up_off_hello_and_stays_within_cap():
+    """End to end over real HTTP: opens at v1, the 200's hello raises
+    the session to the common max; a capped publisher never leaves v1;
+    a census-gated hub 426s the capped one and doctor names it."""
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    registry, publish = _worker_registry()
+    hub = _push_hub()
+    server = MetricsServer(registry=hub.registry, host="127.0.0.1",
+                           port=0, ingest_provider=hub.delta.handle)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        pub = delta.DeltaPublisher(registry, url, source="n1",
+                                   min_interval=0.0, timeout=2.0)
+        publish(0.1)
+        pub.push_once()
+        assert pub.negotiated_proto == delta.PROTO_MAX
+        assert pub.proto_upgrades_total == 1
+        capped = delta.DeltaPublisher(registry, url, source="n2",
+                                      min_interval=0.0, timeout=2.0,
+                                      proto_max=1)
+        capped.push_once()
+        assert capped.negotiated_proto == 1
+        assert capped.skew_refused_total == 0
+        status = pub.skew_status()
+        assert status["hub"]["proto_max"] == delta.PROTO_MAX
+        assert status["negotiated_proto"] == delta.PROTO_MAX
+    finally:
+        server.stop()
+
+
+def test_publisher_refused_by_gated_hub_counts_and_defers():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    registry, publish = _worker_registry()
+    hub = _push_hub(ingest_proto_min=2)
+    server = MetricsServer(registry=hub.registry, host="127.0.0.1",
+                           port=0, ingest_provider=hub.delta.handle)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        pub = delta.DeltaPublisher(registry, url, source="n-old",
+                                   min_interval=0.0, timeout=2.0,
+                                   proto_max=1)
+        publish(0.1)
+        pub.push_once()
+        assert pub.pushes_total == 0
+        assert pub.skew_refused_total >= 1
+        # Refused-not-failed: the diff base survived (defer), so when
+        # the window opens the next frame needs no resync.
+        assert pub.failures_total == 0
+    finally:
+        server.stop()
+
+
+def test_checkpoint_v1_records_load_with_defaults(tmp_path):
+    """Cross-version checkpoint (ISSUE 14 satellite): an old build's
+    v1 file — 5-field session records, pruned keys — must warm-restore
+    without a KeyError; the wire state defaults to unknown until the
+    publisher's next frame."""
+    import json as json_mod
+
+    path = tmp_path / "ingest.json"
+    path.write_text(json_mod.dumps({
+        "version": 1,
+        "seq": 3,
+        "sessions": [
+            ["old-src", 11, 4, 1, "m 1\n"],       # v1: five fields
+            ["bad-record"],                        # tolerated: skipped
+        ],
+    }))
+    hub = _push_hub(ingest_checkpoint=str(path))
+    ingest = hub.delta
+    assert ingest.checkpoint_loaded
+    assert ingest.warm_restart_pending == 1
+    # The v1 record replays: its DELTA applies with no resync.
+    wire = delta.encode_delta("old-src", 11, 5, [])
+    code, _b, _h = ingest.handle(wire)
+    assert code == 200
+    assert ingest.fleet_versions() == {"wire-v1": 1}
+
+
+def test_checkpoint_roundtrips_session_wire_state(tmp_path):
+    """A v2 checkpoint carries (proto, caps, build) so the census
+    survives a hub restart."""
+    path = tmp_path / "ingest.json"
+    hub = _push_hub(ingest_checkpoint=str(path),
+                    ingest_checkpoint_interval=0.0)
+    encoder = delta.DeltaEncoder("node", generation=1, build="6.6.6")
+    encoder.set_wire(2, delta.CAP_BUILD_INFO)
+    wire, _ = encoder.encode_next("m 1\n")
+    assert hub.delta.handle(wire)[0] == 200
+    assert hub.delta.checkpoint(force=True)
+    hub2 = _push_hub(ingest_checkpoint=str(path))
+    hub2.delta.start_replay()
+    deadline = time.monotonic() + 5.0
+    while hub2.delta.warm_restart_pending and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert hub2.delta.fleet_versions() == {"6.6.6": 1}
+
+
+def test_skew_refusals_throttled_before_decode(monkeypatch):
+    """From the SECOND refusal in a window, a skewed peer re-draws its
+    426 from the record — no decompress. The first retry after a
+    refusal always decodes (the publisher's in-push renegotiated frame
+    may now be in range), and the window expires from the last DECODED
+    refusal so an upgraded peer recovers within one window."""
+    from kube_gpu_stats_tpu import snappy
+
+    hub = _push_hub()
+    ingest = hub.delta
+    wire = delta.encode_full("src-future", 7, 0, "m 1\n")
+    raw = bytearray(snappy.decompress(wire))
+    raw[4] = 9
+    future = snappy.compress(bytes(raw))
+    assert ingest.handle(future, peer="10.0.0.9")[0] == 426
+    # First retry decodes (the in-push recovery contract)...
+    assert ingest.handle(future, peer="10.0.0.9")[0] == 426
+    calls = []
+    real = delta.decode_frame
+    monkeypatch.setattr(delta, "decode_frame",
+                        lambda w: calls.append(1) or real(w))
+    # ...the third within the window comes off the record.
+    assert ingest.handle(future, peer="10.0.0.9")[0] == 426
+    assert calls == []  # throttled: dict lookup, no decode
+    assert ingest.skew_refused_total == 3  # still counted honestly
+    # A different (healthy) peer is never throttled.
+    ok = delta.encode_full("src-ok", 7, 0, "m 1\n")
+    assert ingest.handle(ok, peer="10.0.0.8")[0] == 200
+    # Window expiry: age the record past the throttle and the frame
+    # is decoded again (an upgraded peer recovers within one window).
+    with ingest._skew_lock:
+        ingest._skew_peers["10.0.0.9"]["last_wall"] -= \
+            ingest.SKEW_THROTTLE_SECONDS + 1
+    assert ingest.handle(wire, peer="10.0.0.9")[0] == 200
+    assert calls  # decoded this time
+
+
+def test_inpush_renegotiated_retry_not_throttled():
+    """The publisher's renegotiated re-POST lands milliseconds after
+    its 426 — the throttle must decode it (one-round-trip recovery),
+    not replay the cached refusal."""
+    hub = _push_hub(ingest_proto_min=2)
+    v1 = delta.encode_full("src-roll", 7, 0, "m 1\n", proto=1)
+    assert hub.delta.handle(v1, peer="10.0.0.7")[0] == 426
+    v2 = delta.encode_full("src-roll", 7, 0, "m 1\n", proto=2)
+    assert hub.delta.handle(v2, peer="10.0.0.7")[0] == 200
+
+
+def test_census_clears_build_when_peer_rolls_back_to_v1():
+    """A publisher rolled back to a pre-capability build must not stay
+    listed under its new-build census entry (the operator could never
+    confirm the rollback landed)."""
+    hub = _push_hub()
+    encoder = delta.DeltaEncoder("node", generation=1, build="9.9.9")
+    encoder.set_wire(2, delta.CAP_BUILD_INFO)
+    _feed(hub, encoder, make_body(0, 0.1))
+    assert hub.delta.fleet_versions() == {"9.9.9": 1}
+    # The rollback: an old build restarts with a new generation and
+    # opens with a plain v1 FULL.
+    old = delta.DeltaEncoder("node", generation=2)
+    _feed(hub, old, make_body(0, 0.2))
+    assert hub.delta.fleet_versions() == {"wire-v1": 1}
+
+
+def test_spillq_reencode_counted_once_across_retried_drains(tmp_path):
+    """reencoded_total counts DELIVERIES (commit), not peeks — a drain
+    stalled on a down hub re-peeks the same head every probe cycle."""
+    from kube_gpu_stats_tpu.spillq import SpillQueue
+
+    q = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    q._ring.append(1.0, delta.encode_full("src", 9, 0, "m 7\n"))
+    for _ in range(5):  # five failed drain cycles re-peek the head
+        assert q.peek() == (1.0, "m 7\n")
+    assert q.reencoded_total == 0
+    q.commit()
+    assert q.reencoded_total == 1
+    q.close()

@@ -534,3 +534,133 @@ def test_live_resilience_reads_running_exporters_breakers(tmp_path):
 
     live.write_text('accelerator_up{chip="0"} 1\n')
     assert doctor.check_live_resilience(str(live)).status == "skip"
+
+
+# -- doctor --skew (ISSUE 14) ------------------------------------------------
+
+def test_skew_verdict_healthy_single_version():
+    from kube_gpu_stats_tpu.doctor import OK, skew_verdict
+
+    status, detail = skew_verdict({
+        "role": "hub", "build": "0.5.0",
+        "proto_min": 1, "proto_max": 2,
+        "ingest": {"proto_min": 1, "proto_max": 2,
+                   "fleet_versions": {"0.5.0": 12},
+                   "skew_refused_total": 0, "refused_peers": {},
+                   "downgraded_sessions": []},
+        "publisher": None, "wal_quarantined": {},
+    })
+    assert status == OK
+    assert "fleet census: 0.5.0=12" in detail
+
+
+def test_skew_verdict_names_refused_and_downgraded_peers():
+    from kube_gpu_stats_tpu.doctor import WARN, skew_verdict
+
+    status, detail = skew_verdict({
+        "role": "hub", "build": "0.5.0",
+        "proto_min": 1, "proto_max": 2,
+        "ingest": {
+            "proto_min": 2, "proto_max": 2,
+            "fleet_versions": {"0.5.0": 3, "wire-v1": 1},
+            "skew_refused_total": 40,
+            "refused_peers": {
+                "http://node-9:9400/metrics": {"version": 1,
+                                               "count": 40}},
+            "downgraded_sessions": [
+                {"source": "http://node-3:9400/metrics", "proto": 1,
+                 "build": "0.4.0"}],
+            "downgraded_sessions_truncated": 0,
+        },
+        "publisher": None, "wal_quarantined": {},
+    })
+    assert status == WARN
+    assert "http://node-9:9400/metrics offered v1" in detail
+    assert "http://node-3:9400/metrics (v1, 0.4.0)" in detail
+    assert "MIXED fleet" in detail
+
+
+def test_skew_verdict_publisher_and_quarantine_sides():
+    from kube_gpu_stats_tpu.doctor import WARN, skew_verdict
+
+    status, detail = skew_verdict({
+        "role": "daemon", "build": "0.5.0",
+        "proto_min": 1, "proto_max": 2,
+        "publisher": {
+            "negotiated_proto": 1,
+            "hub": {"build": "0.6.0", "proto_min": 2, "proto_max": 3},
+            "skew_refused_total": 7, "proto_downgrades_total": 0,
+        },
+        "wal_quarantined": {"energy": 1},
+    })
+    assert status == WARN
+    assert "REFUSED 7 push(es)" in detail
+    assert "QUARANTINED" in detail and "energy=1" in detail
+
+
+def test_doctor_egress_undecodable_spool_points_at_skew():
+    """ISSUE 14 satellite: spillq.undecodable_total finally has an
+    operator surface — doctor --egress explains it and routes to
+    doctor --skew."""
+    from kube_gpu_stats_tpu.doctor import WARN, check_egress
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    payload = {
+        "enabled": True,
+        "spill": {"depth_frames": 0, "bytes": 0, "max_bytes": 1 << 20,
+                  "oldest_age_seconds": 0, "dropped_total": 0,
+                  "undecodable_total": 3, "reencoded_total": 2,
+                  "link_failures": 0},
+        "senders": {},
+    }
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           egress_provider=lambda: payload)
+    server.start()
+    try:
+        result = check_egress(f"http://127.0.0.1:{server.port}")
+    finally:
+        server.stop()
+    assert result.status == WARN
+    assert "3 spooled frame(s) undecodable" in result.detail
+    assert "doctor --skew" in result.detail
+    assert "2 old-format spooled frame(s) recovered" in result.detail
+
+
+def test_doctor_skew_cli_flag_runs_the_row(capsys):
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           skew_provider=lambda: {
+                               "role": "daemon", "build": "0.5.0",
+                               "proto_min": 1, "proto_max": 2,
+                               "publisher": None,
+                               "wal_quarantined": {}})
+    server.start()
+    try:
+        code = doctor.main(["--backend", "mock", "--skew",
+                            "--listen-port", str(server.port)])
+        out = capsys.readouterr().out
+        assert "skew" in out
+        assert "build 0.5.0 speaks wire v1..v2" in out
+        assert code == 0
+    finally:
+        server.stop()
+
+
+def test_doctor_skew_classifies_missing_surface():
+    from kube_gpu_stats_tpu.doctor import FAIL, WARN, check_skew
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    server.start()  # no skew provider: 404s
+    try:
+        result = check_skew(f"http://127.0.0.1:{server.port}")
+    finally:
+        server.stop()
+    assert result.status == WARN
+    assert "predates the version-skew layer" in result.detail
+    assert check_skew("http://127.0.0.1:9").status == FAIL

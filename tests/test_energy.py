@@ -396,3 +396,55 @@ def test_daemon_derives_cover_gap_from_burst_hz():
     finally:
         daemon.start()  # stop() on a never-started HTTP server hangs
         daemon.stop()
+
+
+# -- cross-version checkpoint tolerance (ISSUE 14 satellite) -----------------
+
+def test_checkpoint_pruned_keys_default_and_warn(tmp_path, caplog):
+    """An older build wrote fewer keys: the loader defaults the missing
+    ones with a warning instead of KeyError-ing the restart path, and
+    the pod totals it DID write survive."""
+    import json
+    import logging
+
+    path = tmp_path / "energy.json"
+    path.write_text(json.dumps({
+        "version": 1,
+        "per_pod": [["train-pod", "ml", 123.5],
+                    ["short-record"]],  # tolerated: skipped
+        # covered_seconds/total_seconds/seq absent (older build)
+    }))
+    with caplog.at_level(logging.WARNING):
+        acct = EnergyAccountant(checkpoint_path=str(path))
+    assert acct.checkpoint_loaded
+    assert acct._per_pod[("train-pod", "ml")] == 123.5
+    assert acct.covered_seconds == 0.0 and acct.total_seconds == 0.0
+    assert any("missing" in r.message for r in caplog.records)
+
+
+def test_checkpoint_future_major_quarantined_byte_identical(tmp_path):
+    """Refuse-don't-corrupt: a checkpoint from a newer build parks
+    aside intact (a downgrade replays it later); the accountant starts
+    degraded from empty — and NEVER truncates what it cannot read."""
+    import json
+
+    from kube_gpu_stats_tpu import wal
+
+    wal.reset_quarantine_stats()
+    path = tmp_path / "energy.json"
+    raw = json.dumps({"version": 99, "per_pod": [["p", "ns", 1.0]],
+                      "new_field": True}).encode()
+    path.write_bytes(raw)
+    acct = EnergyAccountant(checkpoint_path=str(path))
+    assert not acct.checkpoint_loaded and not acct._per_pod
+    assert not path.exists()
+    aside = tmp_path / "energy.json.skew-v99"
+    assert aside.read_bytes() == raw
+    assert wal.quarantine_counts() == {"energy": 1}
+    # The degraded accountant's own writes go to the MAIN path — the
+    # parked file is never overwritten.
+    acct.observe("dev0", "p2", "ns", 1.0, 100.0)
+    acct.observe("dev0", "p2", "ns", 2.0, 100.0)
+    assert acct.checkpoint(force=True)
+    assert aside.read_bytes() == raw
+    wal.reset_quarantine_stats()

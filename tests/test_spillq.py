@@ -507,3 +507,39 @@ def test_doctor_egress_cli_flag_runs_the_row(capsys):
         assert code == 0  # WARN rows don't fail the doctor
     finally:
         srv.stop()
+
+
+def test_spooled_wire_frame_recovered_by_reencode(tmp_path):
+    """ISSUE 14: an old build spooled ENCODED wire frames, not bodies.
+    A FULL frame's body is recovered (the drain re-encodes it at the
+    negotiated wire version); a standalone DELTA has no base and stays
+    undecodable — counted, never wedging."""
+    q = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    q._ring.append(1.0, delta.encode_full("src", 9, 0, "metric_a 7\n"))
+    q._ring.append(2.0, delta.encode_delta("src", 9, 1, [(0, 8.0)]))
+    q.spool(3.0, "metric_a 9\n")
+    assert q.peek() == (1.0, "metric_a 7\n")  # body out of the frame
+    q.commit()
+    assert q.reencoded_total == 1
+    assert q.peek() == (3.0, "metric_a 9\n")  # DELTA skipped + counted
+    assert q.undecodable_total == 1
+    status = q.status()
+    assert status["reencoded_total"] == 1
+    assert status["format_version"] >= 1
+    q.close()
+
+
+def test_spill_segments_stamp_format_version(tmp_path):
+    """New spill segments carry the KTSG header; a restart reads its
+    own stamp back with zero skew/legacy segments."""
+    q = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    q.spool(1.0, "metric_a 1\n")
+    q.close()
+    segs = sorted((tmp_path / "spill").glob("*.seg"))
+    assert segs and segs[0].read_bytes()[:4] == b"KTSG"
+    q2 = SpillQueue(str(tmp_path / "spill"), fsync=False)
+    assert q2.depth() == 1
+    status = q2.status()
+    assert status["skew_segments_total"] == 0
+    assert status["legacy_segments"] == 0
+    q2.close()

@@ -269,3 +269,235 @@ def test_ingest_checkpoint_epoch_resumes_past_both_candidates(tmp_path):
     assert ingest2.checkpoint(force=True)
     assert json.loads(
         (tmp_path / "ingest.json").read_text())["seq"] > main_seq + 5
+
+
+# -- cross-version matrix (ISSUE 14): tolerate the past, quarantine the
+# -- future, never corrupt either --------------------------------------------
+
+def test_write_state_refuses_unstamped_dict(tmp_path):
+    """Every wal.py writer must version its format — the runtime half
+    of the check_wal_versions lint."""
+    with pytest.raises(ValueError, match="version"):
+        wal.write_state(str(tmp_path / "state.json"), {"seq": 1})
+
+
+def test_read_state_loads_older_format_with_defaults(tmp_path):
+    """An older build wrote fewer keys under a lower stamp: the reader
+    accepts any version up to its own."""
+    path = tmp_path / "state.json"
+    path.write_text(json.dumps({"version": 1, "seq": 4}))
+    state = wal.read_state(str(path), 3)
+    assert state == {"version": 1, "seq": 4}
+    assert path.exists()  # loaded, never touched
+
+
+def test_read_state_quarantines_future_major_byte_identical(tmp_path):
+    """Refuse-don't-corrupt: a future-major checkpoint moves aside
+    INTACT (a downgrade can move it back and replay it), the reader
+    starts from empty state, and the quarantine is counted."""
+    wal.reset_quarantine_stats()
+    path = tmp_path / "state.json"
+    raw = json.dumps({"version": 7, "seq": 9,
+                      "field_from_the_future": [1, 2]}).encode()
+    path.write_bytes(raw)
+    assert wal.read_state(str(path), 2, label="teststore") is None
+    assert not path.exists()  # never truncated IN PLACE...
+    aside = tmp_path / "state.json.skew-v7"
+    assert aside.read_bytes() == raw  # ...parked byte-identical
+    assert wal.quarantine_counts() == {"teststore": 1}
+    events = wal.quarantine_events()
+    assert events and events[-1]["version"] == 7
+    wal.reset_quarantine_stats()
+
+
+def test_read_state_quarantine_never_overwrites_prior_park(tmp_path):
+    """Two rollout accidents in a row must keep BOTH parked files."""
+    wal.reset_quarantine_stats()
+    path = tmp_path / "state.json"
+    for marker in ("first", "second"):
+        path.write_text(json.dumps({"version": 9, "m": marker}))
+        assert wal.read_state(str(path), 1) is None
+    parked = sorted(p.name for p in tmp_path.glob("state.json.skew-v9*"))
+    assert len(parked) == 2
+    wal.reset_quarantine_stats()
+
+
+def test_read_state_nonint_version_is_garbage_not_skew(tmp_path):
+    """A bogus stamp is a corrupt file, not a future build: ignored in
+    place, never quarantined."""
+    wal.reset_quarantine_stats()
+    path = tmp_path / "state.json"
+    for stamp in ("2", None, True, -1, 0):
+        path.write_text(json.dumps({"version": stamp}))
+        assert wal.read_state(str(path), 2) is None
+        assert path.exists()
+    assert wal.quarantine_counts() == {}
+
+
+def test_ring_headerless_legacy_segment_reads_as_v1(tmp_path):
+    """A pre-versioning build's segment (no KTSG header) must keep
+    reading — a ring legally holds BOTH mid-rollout."""
+    import struct
+    import zlib as zlib_mod
+
+    directory = tmp_path / "ring"
+    directory.mkdir()
+    rec = struct.Struct("<dII")
+    payload = b"legacy-record"
+    with open(directory / "wal-00000001.seg", "wb") as handle:
+        handle.write(rec.pack(1.0, len(payload),
+                              zlib_mod.crc32(payload)))
+        handle.write(payload)
+    r = wal.SegmentRing(str(directory), max_bytes=1 << 20,
+                        segment_bytes=256, fsync=False,
+                        format_version=1)
+    assert r.records_pending() == 1
+    assert r.peek() == (1.0, payload)
+    status = r.status()
+    assert status["legacy_segments"] == 1
+    assert status["skew_segments_total"] == 0
+    # New appends land in a NEW, headered segment; the mixed ring
+    # keeps draining oldest-first across the format boundary.
+    r.append(2.0, b"new-record")
+    r.commit()
+    assert r.peek() == (2.0, b"new-record")
+    assert r.status()["legacy_segments"] < r.status()["segments"]
+
+
+def test_ring_future_format_segment_quarantined_whole(tmp_path):
+    """A segment stamped with a NEWER payload format (downgrade onto a
+    newer build's ring) parks intact as <seg>.skew; recovery continues
+    with the rest of the ring."""
+    wal.reset_quarantine_stats()
+    r = ring(tmp_path, format_version=1)
+    r.append(1.0, b"own-record")
+    r.close()
+    directory = tmp_path / "ring"
+    import struct
+    import zlib as zlib_mod
+
+    rec = struct.Struct("<dII")
+    payload = b"from-the-future"
+    future = directory / "wal-00000009.seg"
+    raw = (b"KTSG" + bytes((1, 5))
+           + rec.pack(2.0, len(payload), zlib_mod.crc32(payload))
+           + payload)
+    future.write_bytes(raw)
+    r2 = ring(tmp_path, format_version=1)
+    assert r2.skew_segments == 1
+    assert (directory / "wal-00000009.seg.skew").read_bytes() == raw
+    assert not future.exists()
+    assert r2.records_pending() == 1  # the rest of the ring survives
+    assert r2.peek() == (1.0, b"own-record")
+    assert r2.status()["skew_segments_total"] == 1
+    assert wal.quarantine_counts().get("segment-ring") == 1
+    wal.reset_quarantine_stats()
+
+
+def test_ring_future_container_version_also_quarantined(tmp_path):
+    directory = tmp_path / "ring"
+    directory.mkdir()
+    import struct
+    import zlib as zlib_mod
+
+    rec = struct.Struct("<dII")
+    payload = b"p"
+    (directory / "wal-00000001.seg").write_bytes(
+        b"KTSG" + bytes((9, 1))
+        + rec.pack(1.0, len(payload), zlib_mod.crc32(payload)) + payload)
+    r = wal.SegmentRing(str(directory), max_bytes=1 << 20, fsync=False,
+                        format_version=1)
+    assert r.skew_segments == 1
+    assert r.records_pending() == 0
+    wal.reset_quarantine_stats()
+
+
+def test_ring_torn_legacy_segment_rewritten_headerless(tmp_path):
+    """Recovery of a torn LEGACY segment must rewrite it WITHOUT a
+    header: stamping it would turn a later downgrade's recovery into a
+    full-segment truncation (the old reader sees header bytes as a
+    torn first record)."""
+    import struct
+    import zlib as zlib_mod
+
+    directory = tmp_path / "ring"
+    directory.mkdir()
+    rec = struct.Struct("<dII")
+    payload = b"intact-legacy"
+    with open(directory / "wal-00000001.seg", "wb") as handle:
+        handle.write(rec.pack(1.0, len(payload),
+                              zlib_mod.crc32(payload)))
+        handle.write(payload)
+        handle.write(b"\x01\x02\x03")  # the torn tail
+    r = wal.SegmentRing(str(directory), max_bytes=1 << 20, fsync=False,
+                        format_version=1)
+    assert r.torn_records == 1
+    assert r.peek() == (1.0, payload)
+    r.close()
+    rewritten = (directory / "wal-00000001.seg").read_bytes()
+    assert not rewritten.startswith(b"KTSG")
+
+
+def test_ring_new_segments_stamp_the_header(tmp_path):
+    r = ring(tmp_path, format_version=3)
+    r.append(1.0, b"abc")
+    r.close()
+    segs = sorted((tmp_path / "ring").glob("*.seg"))
+    data = segs[-1].read_bytes()
+    assert data[:4] == b"KTSG"
+    assert data[4] == wal.SEGMENT_CONTAINER_VERSION
+    assert data[5] == 3
+    # And the same build reads its own stamp back.
+    r2 = ring(tmp_path, format_version=3)
+    assert r2.records_pending() == 1
+    assert r2.skew_segments == 0
+
+
+def test_ring_cursor_with_pruned_keys_defaults_not_keyerror(tmp_path):
+    """An older build's cursor missing keys must default-and-warn on
+    the restart path (ISSUE 14 satellite), clamped into reality."""
+    r = ring(tmp_path)
+    for i in range(3):
+        r.append(float(i), b"x")
+    r.save_cursor(force=True)
+    r.close()
+    cursor_path = tmp_path / "ring" / "wal-cursor.json"
+    state = json.loads(cursor_path.read_text())
+    state.pop("record", None)
+    state.pop("seq", None)
+    cursor_path.write_text(json.dumps(state))
+    r2 = ring(tmp_path)  # must not raise
+    assert r2.records_pending() == 3  # defaulted to the oldest record
+
+
+def test_ring_second_quarantine_of_same_seq_keeps_both(tmp_path):
+    """A drained ring restarts its seq numbering, so two downgrade
+    accidents can park the SAME segment name — the second must land
+    beside the first (.skew.1), never over it."""
+    import struct
+    import zlib as zlib_mod
+
+    wal.reset_quarantine_stats()
+    directory = tmp_path / "ring"
+    directory.mkdir()
+    rec = struct.Struct("<dII")
+
+    def future_seg(marker: bytes) -> bytes:
+        return (b"KTSG" + bytes((1, 5))
+                + rec.pack(1.0, len(marker), zlib_mod.crc32(marker))
+                + marker)
+
+    first, second = future_seg(b"first"), future_seg(b"second")
+    (directory / "wal-00000001.seg").write_bytes(first)
+    r = wal.SegmentRing(str(directory), max_bytes=1 << 20, fsync=False,
+                        format_version=1)
+    r.close()
+    (directory / "wal-00000001.seg").write_bytes(second)
+    r2 = wal.SegmentRing(str(directory), max_bytes=1 << 20, fsync=False,
+                         format_version=1)
+    r2.close()
+    parked = sorted(p.name for p in directory.glob("*.skew*"))
+    assert len(parked) == 2
+    contents = {p.read_bytes() for p in directory.glob("*.skew*")}
+    assert contents == {first, second}  # both intact, neither clobbered
+    wal.reset_quarantine_stats()

@@ -1,0 +1,97 @@
+"""check_wal_versions lint (ISSUE 14 satellite): every wal.py writer
+call site must stamp a format version — SegmentRing(format_version=),
+write_state state dicts with a 'version' key. The lint is the static
+half; wal.write_state's runtime raise is the backstop."""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import check_wal_versions  # noqa: E402
+
+
+def _check(tmp_path, source: str) -> list[str]:
+    path = tmp_path / "module.py"
+    path.write_text(textwrap.dedent(source))
+    return check_wal_versions.check_file(path)
+
+
+def test_unstamped_segment_ring_flagged(tmp_path):
+    problems = _check(tmp_path, """
+        from .wal import SegmentRing
+        ring = SegmentRing("/d", max_bytes=1)
+    """)
+    assert len(problems) == 1
+    assert "format_version" in problems[0]
+
+
+def test_stamped_segment_ring_passes(tmp_path):
+    assert _check(tmp_path, """
+        from .wal import SegmentRing
+        ring = SegmentRing("/d", max_bytes=1, format_version=2)
+    """) == []
+
+
+def test_write_state_with_literal_stamp_passes(tmp_path):
+    assert _check(tmp_path, """
+        from . import wal
+        wal.write_state("/p", {"version": 3, "seq": 1})
+    """) == []
+
+
+def test_write_state_unstamped_literal_flagged(tmp_path):
+    problems = _check(tmp_path, """
+        from . import wal
+        wal.write_state("/p", {"seq": 1})
+    """)
+    assert len(problems) == 1
+    assert "version" in problems[0]
+
+
+def test_write_state_through_local_state_function_passes(tmp_path):
+    """The energy.py shape: state built by a method whose returned
+    dict literal carries the stamp."""
+    assert _check(tmp_path, """
+        from . import wal
+
+        class Store:
+            def _state(self):
+                return {"version": 2, "data": []}
+
+            def checkpoint(self):
+                wal.write_state("/p", self._state())
+    """) == []
+
+
+def test_write_state_untraceable_without_any_stamp_flagged(tmp_path):
+    problems = _check(tmp_path, """
+        from . import wal
+
+        def save(state):
+            wal.write_state("/p", state)
+    """)
+    assert len(problems) == 1
+
+
+def test_custom_version_key_respected(tmp_path):
+    assert _check(tmp_path, """
+        from . import wal
+        wal.write_state("/p", {"fmt": 1}, version_key="fmt")
+    """) == []
+    assert len(_check(tmp_path, """
+        from . import wal
+        wal.write_state("/p", {"version": 1}, version_key="fmt")
+    """)) == 1
+
+
+def test_lint_green_on_the_real_package():
+    """The shipped package must pass its own lint (the make lint
+    gate); run the tool as the Makefile does."""
+    result = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "check_wal_versions.py")],
+        capture_output=True, text=True)
+    assert result.returncode == 0, result.stderr

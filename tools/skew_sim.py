@@ -1,0 +1,605 @@
+#!/usr/bin/env python
+"""Mixed-fleet version-skew chaos smoke (ISSUE 14, `make skew-sim`):
+the rolling-upgrade survival layer driven end to end through the
+version mixes a real rollout produces — real daemons (mock backend)
+publishing through real DeltaPublishers into real MetricsServer-fronted
+hubs, with the two ends deliberately run at different protocol builds:
+
+- **Old publisher → new hub**: a publisher capped at wire v1 (an
+  un-upgraded wave) against a current hub. Everything flows at v1,
+  zero refusals, exactly one FULL per session, and the hub's fleet
+  census lists the straggler as ``wire-v1``.
+- **New publisher → old hub**: a current publisher against (a) a hub
+  advertising only v1 — the hello clamps the publisher to the
+  feature-masked v1 encoding at zero cost (no refusal, no extra FULL,
+  no downgrade event: it OPENED at v1 and simply never upgrades), and
+  (b) a pre-negotiation hub that 400s v2 frames with "unsupported
+  version" and no hello — the publisher downgrades its ENCODING inside
+  the same push and the data still lands (one round-trip, not a
+  quarantine strike per push).
+- **Mid-flight daemon upgrade onto old disk state**: a restart onto a
+  spill queue written by an older build — a headerless (pre-versioning)
+  segment holding plain spooled bodies, one record in the ancient
+  spooled-wire-frame format (recovered by re-encoding at the
+  negotiated version, counted ``reencoded``), and one garbage record
+  (counted ``undecodable``, drain never wedges) — plus an energy
+  checkpoint with pruned keys (default-and-warn, totals preserved) and
+  a FUTURE-major energy checkpoint (quarantined byte-identical aside,
+  daemon starts degraded, never truncates).
+- **Hub upgrade under live pushers**: an old-window hub with live
+  publishers is stopped and replaced on the same port by a
+  current-window hub warm-restarting from the same ingest checkpoint.
+  Sessions resume with ZERO 409 resyncs and zero extra FULLs; the
+  publishers negotiate UP off the first 200's hello and the census
+  flips to the new build without waiting for a FULL (announce-once).
+- **Stuck skew + doctor**: a census-gated hub (--ingest-proto-min 2)
+  refusing a v1-capped publisher with 426 — counted on BOTH ends,
+  journaled once (not per frame), and `doctor --skew` NAMES the
+  refused peer against the live /debug/skew endpoint.
+
+Exit 0 with a PASS line, else 1 with evidence. Wired into `make ci`.
+Each scenario gets the PR 10 box-noise single retry (tests/flake.py
+semantics): one loud retry on a failed run, a second failure is real.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import struct
+import sys
+import tempfile
+import time
+import zlib
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+_RECORD = struct.Struct("<dII")  # wal.py's segment record framing
+
+
+def wait_for(predicate, timeout: float, interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+def _make_daemon():
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    daemon = Daemon(Config(backend="mock", attribution="off",
+                           interval=0.05, listen_port=0,
+                           device_processes="off"))
+    daemon.start()
+    return daemon
+
+
+def _hub_server(hub, port: int = 0):
+    """MetricsServer fronting a hub's ingest + skew surfaces, the way
+    hub.main wires them."""
+    from kube_gpu_stats_tpu import __version__, wal
+    from kube_gpu_stats_tpu.delta import PROTO_MAX, PROTO_MIN
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    def skew_payload() -> dict:
+        return {
+            "role": "hub",
+            "build": __version__,
+            "proto_min": PROTO_MIN,
+            "proto_max": PROTO_MAX,
+            "publisher": None,
+            "ingest": hub.delta.skew_status(),
+            "wal_quarantined": wal.quarantine_counts(),
+        }
+
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=port,
+                           ingest_provider=hub.delta.handle,
+                           skew_provider=skew_payload)
+    server.start()
+    return server
+
+
+def scenario_wire_matrix(verbose: bool) -> list[str]:
+    """Old publisher → new hub AND new publisher → v1-window hub."""
+    from kube_gpu_stats_tpu import __version__
+    from kube_gpu_stats_tpu.delta import DeltaPublisher
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+    hub = Hub([], targets_provider=lambda: [], interval=0.2,
+              push_fence=1e9)
+    old_hub = Hub([], targets_provider=lambda: [], interval=0.2,
+                  push_fence=1e9, ingest_proto_max=1)
+    server = _hub_server(hub)
+    old_server = _hub_server(old_hub)
+    daemon = _make_daemon()
+    pub_old = DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{server.port}",
+        source="http://node-old:9400/metrics",
+        min_interval=0.02, timeout=1.0, proto_max=1)
+    pub_new = DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{server.port}",
+        source="http://node-new:9400/metrics",
+        min_interval=0.02, timeout=1.0)
+    pub_vs_old = DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{old_server.port}",
+        source="http://node-vs-old:9400/metrics",
+        min_interval=0.02, timeout=1.0)
+    try:
+        for pub in (pub_old, pub_new, pub_vs_old):
+            pub.start()
+        if not wait_for(lambda: all(p.pushes_total >= 5 for p in
+                                    (pub_old, pub_new, pub_vs_old)),
+                        15.0):
+            problems.append("wire-matrix: publishers never synced")
+        # Steady-state fence: early daemon ticks legitimately grow the
+        # series set (trace digest, push stats warming up), and a key
+        # change IS a FULL by design. The skew assertions below count
+        # FULLs from here on — where only version traffic could cause
+        # one.
+        fulls0 = {p: p._encoder.full_frames
+                  for p in (pub_old, pub_new, pub_vs_old)}
+        marks = {p: p.pushes_total for p in fulls0}
+        if not wait_for(lambda: all(p.pushes_total >= marks[p] + 5
+                                    for p in fulls0), 15.0):
+            problems.append("wire-matrix: pushes stalled post-sync")
+        # Old publisher stays at v1 against the new hub; the new one
+        # negotiates up off the first 200's hello; both cost exactly
+        # one FULL and zero refusals/resyncs.
+        if pub_old.negotiated_proto != 1:
+            problems.append(
+                f"wire-matrix: v1-capped publisher negotiated "
+                f"v{pub_old.negotiated_proto}, want 1")
+        if pub_new.negotiated_proto != 2:
+            problems.append(
+                f"wire-matrix: new publisher stuck at "
+                f"v{pub_new.negotiated_proto}, want 2")
+        if pub_new.proto_upgrades_total != 1:
+            problems.append(
+                f"wire-matrix: want exactly 1 upgrade negotiation, got "
+                f"{pub_new.proto_upgrades_total}")
+        # New publisher against the v1-window hub: clamped by the
+        # hello at ZERO cost — no refusal, no downgrade event (it
+        # opened at v1 and simply never upgraded).
+        if pub_vs_old.negotiated_proto != 1:
+            problems.append(
+                f"wire-matrix: publisher vs old hub at "
+                f"v{pub_vs_old.negotiated_proto}, want 1")
+        for name, pub in (("old", pub_old), ("new", pub_new),
+                          ("vs-old", pub_vs_old)):
+            if pub.skew_refused_total or pub.proto_downgrades_total:
+                problems.append(
+                    f"wire-matrix: {name} publisher counted refusals/"
+                    f"downgrades ({pub.skew_refused_total}/"
+                    f"{pub.proto_downgrades_total}) on a legal mix")
+            if pub._encoder.full_frames > fulls0[pub]:
+                problems.append(
+                    f"wire-matrix: {name} publisher sent "
+                    f"{pub._encoder.full_frames - fulls0[pub]} FULL(s) "
+                    f"in version-relevant steady state, want 0")
+        for name, h in (("new", hub), ("old-window", old_hub)):
+            if h.delta.resyncs_total or h.delta.skew_refused_total:
+                problems.append(
+                    f"wire-matrix: {name} hub counted "
+                    f"{h.delta.resyncs_total} resyncs / "
+                    f"{h.delta.skew_refused_total} refusals on a "
+                    f"legal mix")
+        census = hub.delta.fleet_versions()
+        if census.get("wire-v1") != 1 or census.get(__version__) != 1:
+            problems.append(
+                f"wire-matrix: census {census} should list 1x wire-v1 "
+                f"(the capped publisher) and 1x {__version__}")
+        if verbose and not problems:
+            print(f"  wire-matrix: census {census}, "
+                  f"0 refusals, 1 FULL each")
+    finally:
+        for pub in (pub_old, pub_new, pub_vs_old):
+            pub.stop()
+        daemon.stop()
+        server.stop()
+        old_server.stop()
+    return problems
+
+
+def scenario_prenegotiation_hub(verbose: bool) -> list[str]:
+    """A pre-hello hub 400s v2 frames with 'unsupported version': the
+    publisher must downgrade its ENCODING inside the push and land the
+    same data — one round-trip, zero data loss, zero resyncs."""
+    from kube_gpu_stats_tpu import snappy
+    from kube_gpu_stats_tpu.delta import (CAP_BUILD_INFO, DeltaPublisher)
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+    hub = Hub([], targets_provider=lambda: [], interval=0.2,
+              push_fence=1e9)
+
+    def prenegotiation_ingest(wire: bytes, peer: str = ""):
+        # An old build: no hello headers ever, and a v2 frame draws
+        # the only signal it can give — 400 "unsupported version".
+        if snappy.decompress(wire)[4] > 1:
+            return (400, b"bad delta frame: unsupported version 2\n", {})
+        code, body, _headers = hub.delta.handle(wire, peer=peer)
+        return code, body, {}
+
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=prenegotiation_ingest)
+    server.start()
+    daemon = _make_daemon()
+    pub = DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{server.port}",
+        source="http://node-roll:9400/metrics",
+        min_interval=0.02, timeout=1.0)
+    try:
+        pub.start()
+        if not wait_for(lambda: pub.pushes_total >= 5, 15.0):
+            problems.append("prenegotiation: publisher never synced")
+        pushes_before = pub.pushes_total
+        fulls_before = pub._encoder.full_frames
+        # "The hub we negotiated v2 with rolled back": force the
+        # encoder to v2 against the hello-less receiver.
+        pub._encoder.set_wire(2, CAP_BUILD_INFO)
+        if not wait_for(
+                lambda: pub.proto_downgrades_total >= 1
+                and pub.pushes_total > pushes_before, 15.0):
+            problems.append(
+                "prenegotiation: publisher never downgraded off the "
+                "'unsupported version' 400")
+        if pub.negotiated_proto != 1:
+            problems.append(
+                f"prenegotiation: publisher at "
+                f"v{pub.negotiated_proto} after downgrade, want 1")
+        if pub._encoder.full_frames > fulls_before \
+                or hub.delta.resyncs_total:
+            problems.append(
+                f"prenegotiation: downgrade cost "
+                f"{pub._encoder.full_frames - fulls_before} FULL(s) + "
+                f"{hub.delta.resyncs_total} resync(s), want 0 (a 400 "
+                f"is pre-apply; the diff base survives)")
+        if verbose and not problems:
+            print(f"  prenegotiation: in-push downgrade after "
+                  f"{pushes_before} v-mixed pushes, 0 resyncs")
+    finally:
+        pub.stop()
+        daemon.stop()
+        server.stop()
+    return problems
+
+
+def scenario_daemon_upgrade(tmp: str, verbose: bool) -> list[str]:
+    """A daemon restarting mid-rollout onto an OLD build's disk state:
+    legacy spill segments (incl. the ancient spooled-wire-frame
+    format), a pruned-keys energy checkpoint, and a FUTURE-major
+    energy checkpoint that must quarantine byte-identical."""
+    import json
+
+    from kube_gpu_stats_tpu import snappy, wal
+    from kube_gpu_stats_tpu.delta import DeltaPublisher, encode_full
+    from kube_gpu_stats_tpu.energy import EnergyAccountant
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.spillq import SpillQueue
+
+    problems: list[str] = []
+    base = pathlib.Path(tmp)
+
+    # --- the old build's spill queue, crafted byte-for-byte ----------
+    spill_dir = base / "spill"
+    spill_dir.mkdir(parents=True)
+    bodies = [f'accelerator_duty_cycle{{chip="{i}"}} 0.{i}\n'
+              for i in range(3)]
+    records = [snappy.compress(body.encode()) for body in bodies]
+    # The ancient format: a spooled ENCODED wire frame (v1 FULL).
+    records.append(encode_full("http://node-up:9400/metrics", 7, 0,
+                               'accelerator_duty_cycle{chip="9"} 0.9\n'))
+    # And one garbage record the drain must count, not wedge on.
+    records.append(b"\x00garbage-not-snappy\xff")
+    with open(spill_dir / "spill-00000001.seg", "wb") as handle:
+        for payload in records:  # headerless: a pre-versioning segment
+            handle.write(_RECORD.pack(time.time(), len(payload),
+                                      zlib.crc32(payload)))
+            handle.write(payload)
+
+    # --- old-build energy checkpoint with pruned keys ----------------
+    energy_path = base / "energy.json"
+    energy_path.write_text(json.dumps({
+        "version": 1,
+        "per_pod": [["train-pod", "ml", 123.5]],
+        # covered_seconds/total_seconds/seq deliberately absent: an
+        # older build never wrote them.
+    }))
+    accountant = EnergyAccountant(checkpoint_path=str(energy_path))
+    if accountant._per_pod.get(("train-pod", "ml")) != 123.5:
+        problems.append("daemon-upgrade: pruned-keys energy checkpoint "
+                        "lost the pod totals")
+    if not accountant.checkpoint_loaded:
+        problems.append("daemon-upgrade: pruned-keys energy checkpoint "
+                        "refused to load")
+
+    # --- FUTURE-major energy checkpoint: quarantine, don't corrupt ---
+    wal.reset_quarantine_stats()
+    future_path = base / "energy-future.json"
+    future_bytes = json.dumps({"version": 99, "per_pod": [],
+                               "from": "the future"}).encode()
+    future_path.write_bytes(future_bytes)
+    degraded = EnergyAccountant(checkpoint_path=str(future_path))
+    aside = future_path.parent / (future_path.name + ".skew-v99")
+    if degraded._per_pod or degraded.checkpoint_loaded:
+        problems.append("daemon-upgrade: future-major checkpoint was "
+                        "LOADED instead of quarantined")
+    if future_path.exists():
+        problems.append("daemon-upgrade: future-major checkpoint left "
+                        "in place (next write would overwrite it)")
+    if not aside.exists() or aside.read_bytes() != future_bytes:
+        problems.append("daemon-upgrade: quarantined checkpoint not "
+                        "byte-identical aside")
+    if wal.quarantine_counts().get("energy", 0) != 1:
+        problems.append(
+            f"daemon-upgrade: quarantine not counted "
+            f"({wal.quarantine_counts()})")
+
+    # --- the upgraded daemon drains the old spool --------------------
+    hub = Hub([], targets_provider=lambda: [], interval=0.2,
+              push_fence=1e9)
+    server = _hub_server(hub)
+    daemon = _make_daemon()
+    spill = SpillQueue(str(spill_dir), tracer=daemon.tracer)
+    if spill.depth() != len(records):
+        problems.append(
+            f"daemon-upgrade: recovered {spill.depth()} spooled "
+            f"record(s) from the old build, want {len(records)}")
+    pub = DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{server.port}",
+        source="http://node-up:9400/metrics",
+        min_interval=0.02, timeout=1.0, spill=spill, drain_rate=2000.0)
+    try:
+        pub.start()
+        pub._probe_at = 0.0
+        if not wait_for(lambda: spill.depth() == 0, 15.0):
+            problems.append(
+                f"daemon-upgrade: old-build spool never drained "
+                f"(depth {spill.depth()})")
+        if spill.reencoded_total != 1:
+            problems.append(
+                f"daemon-upgrade: {spill.reencoded_total} wire-frame "
+                f"record(s) re-encoded, want 1")
+        if spill.undecodable_total != 1:
+            problems.append(
+                f"daemon-upgrade: {spill.undecodable_total} record(s) "
+                f"undecodable, want exactly 1 (the garbage record)")
+        # Accounting closes: every recovered record is drained,
+        # re-encoded or counted — nothing silently vanished.
+        delivered = spill.drained_total
+        if delivered + spill.undecodable_total < len(records):
+            problems.append(
+                f"daemon-upgrade: {delivered} drained + "
+                f"{spill.undecodable_total} undecodable < "
+                f"{len(records)} recovered — silent loss")
+        if verbose and not problems:
+            print(f"  daemon-upgrade: {delivered} drained "
+                  f"(1 re-encoded), 1 undecodable counted, energy "
+                  f"checkpoints tolerated/quarantined")
+    finally:
+        pub.stop()
+        daemon.stop()
+        server.stop()
+        wal.reset_quarantine_stats()
+    return problems
+
+
+def scenario_hub_upgrade(tmp: str, verbose: bool) -> list[str]:
+    """Hub upgrade under live pushers: old-window hub checkpoint-
+    restarts as a current-window hub on the same port — zero 409s,
+    zero extra FULLs, publishers negotiate UP, census flips without a
+    FULL (announce-once)."""
+    from kube_gpu_stats_tpu import __version__
+    from kube_gpu_stats_tpu.delta import DeltaPublisher
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+    ckpt = str(pathlib.Path(tmp) / "ingest.json")
+    hub1 = Hub([], targets_provider=lambda: [], interval=0.2,
+               push_fence=1e9, ingest_proto_max=1,
+               ingest_checkpoint=ckpt)
+    server1 = _hub_server(hub1)
+    port = server1.port
+    daemon = _make_daemon()
+    pubs = [DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{port}",
+        source=f"http://node-{i}:9400/metrics",
+        min_interval=0.02, timeout=1.0) for i in range(3)]
+    hub2 = None
+    server2 = None
+    try:
+        for pub in pubs:
+            pub.start()
+        if not wait_for(lambda: all(p.pushes_total >= 10 for p in pubs),
+                        15.0):
+            problems.append("hub-upgrade: publishers never synced to "
+                            "the old hub")
+        if any(p.negotiated_proto != 1 for p in pubs):
+            problems.append("hub-upgrade: old-window hub negotiated "
+                            "above v1")
+        # FULLs from here on are upgrade traffic (the early series
+        # churn that legitimately re-FULLs is behind us).
+        fulls0 = {p: p._encoder.full_frames for p in pubs}
+        # --- the upgrade: stop, checkpoint, restart as current build -
+        server1.stop()
+        hub1.delta.checkpoint(force=True)
+        hub2 = Hub([], targets_provider=lambda: [], interval=0.2,
+                   push_fence=1e9, ingest_checkpoint=ckpt)
+        server2 = _hub_server(hub2, port=port)
+        for pub in pubs:
+            pub._probe_at = 0.0  # collapse the probe backoff
+        if not wait_for(
+                lambda: all(p.negotiated_proto == 2 for p in pubs),
+                15.0):
+            problems.append(
+                f"hub-upgrade: publishers never negotiated up "
+                f"({[p.negotiated_proto for p in pubs]})")
+        if hub2.delta.resyncs_total:
+            problems.append(
+                f"hub-upgrade: {hub2.delta.resyncs_total} resync(s) "
+                f"across a checkpointed upgrade, want 0 (warm restart)")
+        for pub in pubs:
+            # <= 1 FULL per re-established session: the publisher
+            # nacked its in-flight frame when the listener died, so
+            # ONE recovery FULL is the honest contract; anything more
+            # is an unexplained resync.
+            if pub._encoder.full_frames > fulls0[pub] + 1:
+                problems.append(
+                    f"hub-upgrade: {pub.source} sent "
+                    f"{pub._encoder.full_frames - fulls0[pub]} FULLs "
+                    f"across the upgrade, want <= 1 per re-established "
+                    f"session")
+        # Census flips to the build WITHOUT a FULL: the announce-once
+        # delta carries the build extension.
+        if not wait_for(
+                lambda: hub2.delta.fleet_versions().get(__version__)
+                == len(pubs), 15.0):
+            problems.append(
+                f"hub-upgrade: census never flipped to {__version__} "
+                f"({hub2.delta.fleet_versions()})")
+        if verbose and not problems:
+            print(f"  hub-upgrade: {len(pubs)} sessions warm across "
+                  f"the upgrade, 0 resyncs, census "
+                  f"{hub2.delta.fleet_versions()}")
+    finally:
+        for pub in pubs:
+            pub.stop()
+        daemon.stop()
+        server1.stop()
+        if server2 is not None:
+            server2.stop()
+    return problems
+
+
+def scenario_stuck_skew_and_doctor(verbose: bool) -> list[str]:
+    """A census-gated hub refusing a v1-capped publisher: 426 counted
+    on both ends, journaled once, and doctor --skew NAMES the peer."""
+    from kube_gpu_stats_tpu.delta import DeltaPublisher
+    from kube_gpu_stats_tpu.doctor import WARN, check_skew
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+    hub = Hub([], targets_provider=lambda: [], interval=0.2,
+              push_fence=1e9, ingest_proto_min=2)
+    server = _hub_server(hub)
+    daemon = _make_daemon()
+    source = "http://node-stuck:9400/metrics"
+    pub = DeltaPublisher(
+        daemon.registry, f"http://127.0.0.1:{server.port}",
+        source=source, min_interval=0.02, timeout=1.0, proto_max=1)
+    try:
+        pub.start()
+        if not wait_for(lambda: pub.skew_refused_total >= 2, 15.0):
+            problems.append("stuck-skew: publisher never counted the "
+                            "426 refusals")
+        if hub.delta.skew_refused_total < 2:
+            problems.append(
+                f"stuck-skew: hub counted "
+                f"{hub.delta.skew_refused_total} refusal(s), want >= 2")
+        if pub.pushes_total:
+            problems.append(
+                f"stuck-skew: {pub.pushes_total} push(es) landed "
+                f"through a disjoint version window")
+        status = hub.delta.skew_status()
+        if source not in status.get("refused_peers", {}):
+            problems.append(
+                f"stuck-skew: refused peer not named in skew_status "
+                f"({list(status.get('refused_peers', {}))})")
+        # Journaled ONCE per (peer, version), not per refused frame.
+        events = [e for e in hub.tracer.events()["events"]
+                  if e.get("kind") == "skew_refused"]
+        if len(events) != 1:
+            problems.append(
+                f"stuck-skew: {len(events)} skew_refused journal "
+                f"event(s), want exactly 1 (first sight only)")
+        # doctor --skew against the LIVE endpoint names the peer.
+        result = check_skew(f"http://127.0.0.1:{server.port}")
+        if result.status != WARN or source not in result.detail:
+            problems.append(
+                f"stuck-skew: doctor --skew did not name the refused "
+                f"peer ([{result.status}] {result.detail[:200]})")
+        if verbose and not problems:
+            print(f"  stuck-skew: {hub.delta.skew_refused_total} "
+                  f"refusals counted, 1 journal event, doctor names "
+                  f"{source}")
+    finally:
+        pub.stop()
+        daemon.stop()
+        server.stop()
+    return problems
+
+
+def _with_retry(name: str, attempt, verbose: bool) -> list[str]:
+    """PR 10 box-noise discipline for the sim's subprocess-style waits
+    (tests/flake.py semantics): one LOUD retry per scenario, a second
+    failure is a real regression and fails the sim."""
+    problems = attempt()
+    if problems:
+        print(f"skew-sim: scenario {name} failed once "
+              f"({len(problems)} problem(s)); box-noise retry "
+              f"(exactly one)")
+        problems = attempt()
+    return problems
+
+
+def run(verbose: bool) -> int:
+    problems: list[str] = []
+    attempt_counter = [0]
+
+    def fresh_tmp(base: str, name: str) -> str:
+        attempt_counter[0] += 1
+        path = pathlib.Path(base) / f"{name}-{attempt_counter[0]}"
+        path.mkdir(parents=True)
+        return str(path)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        problems += _with_retry(
+            "wire-matrix", lambda: scenario_wire_matrix(verbose),
+            verbose)
+        problems += _with_retry(
+            "prenegotiation",
+            lambda: scenario_prenegotiation_hub(verbose), verbose)
+        problems += _with_retry(
+            "daemon-upgrade",
+            lambda: scenario_daemon_upgrade(
+                fresh_tmp(tmp, "daemon-upgrade"), verbose), verbose)
+        problems += _with_retry(
+            "hub-upgrade",
+            lambda: scenario_hub_upgrade(
+                fresh_tmp(tmp, "hub-upgrade"), verbose), verbose)
+        problems += _with_retry(
+            "stuck-skew",
+            lambda: scenario_stuck_skew_and_doctor(verbose), verbose)
+    if not problems:
+        print("skew-sim PASS: mixed-version matrix survived — old/new "
+              "publisher x old/new hub all flowed with 0 refusals and "
+              "1 FULL each (pre-negotiation 400s downgraded in-push), "
+              "a daemon upgrade drained an old-build spool (wire-frame "
+              "record re-encoded, garbage counted) with pruned-keys "
+              "checkpoints tolerated and a future-major checkpoint "
+              "quarantined byte-identical, a hub upgrade under live "
+              "pushers warm-resumed with 0 resyncs and the census "
+              "flipped without a FULL, and a census-gated refusal was "
+              "counted both ends with doctor --skew naming the peer")
+        return 0
+    print("skew-sim FAIL:")
+    for problem in problems:
+        print(f"  {problem}")
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
