@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim local-sim
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -13,6 +13,7 @@ ci: native lint
 	python tools/chaos_sim.py
 	python tools/partition_sim.py
 	python tools/skew_sim.py
+	python tools/localfault_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -106,6 +107,18 @@ partition-sim:
 host-sim:
 	python tools/host_sim.py --verbose
 
+# Local fault survival smoke (<60 s, ISSUE 15): a real daemon + hub
+# driven through faultfs-injected ENOSPC (spill disk fills mid-drain),
+# EIO on the energy checkpoint fsync, an EROFS "remount" under the
+# hub's ingest checkpoint, a killed burst-sampler thread, and EMFILE
+# on the hub's accept loop. Asserts zero process deaths, every lost
+# record counted in kts_store_lost_records_total, every store
+# auto-recovering when its fault clears (energy monotone, ingest
+# exactly-once), and `doctor --stores` naming each degraded store and
+# restarted thread. In `make ci` too.
+local-sim:
+	python tools/localfault_sim.py --verbose
+
 # Version-skew chaos smoke (<60 s, ISSUE 14): the rolling-upgrade
 # survival layer through a real mixed-version matrix — old publisher
 # vs new hub (census lists the wire-v1 straggler), new publisher vs
@@ -133,6 +146,7 @@ lint:
 	python tools/check_metrics_docs.py
 	python tools/check_no_nvml.py
 	python tools/check_wal_versions.py
+	python tools/check_supervised_threads.py
 
 # Eyeball where tick time goes: 200 simulated ticks through the
 # production loop with the flight recorder on, dumped as Chrome

@@ -102,6 +102,22 @@ def _egress_fields(line: dict) -> None:
         line["partition_catchup_s_200f"] = drain["partition_catchup_s"]
 
 
+def _localfault_fields(line: dict) -> None:
+    """Degraded-store tick cost (ISSUE 15): the per-tick price of the
+    disk-backed store ops while their durability state machines are
+    latched degraded, vs healthy fsync — the <10%-of-tick-budget CI
+    pin lives in tests/test_latency.py."""
+    from kube_gpu_stats_tpu.bench import measure_degraded_overhead
+
+    degraded = measure_degraded_overhead()
+    if degraded is not None:
+        line["healthy_store_ms_per_tick"] = degraded[
+            "healthy_store_ms_per_tick"]
+        line["degraded_store_ms_per_tick"] = degraded[
+            "degraded_store_ms_per_tick"]
+        line["degraded_overhead_pct"] = degraded["degraded_overhead_pct"]
+
+
 def _burst_fields(line: dict) -> None:
     """Burst-sampler cost figures (ISSUE 8): tick-path fold overhead as
     a percent of the 50 ms budget (the <2% CI pin, tests/test_latency),
@@ -201,6 +217,7 @@ def _quick() -> int:
             "fleet_score_ms_per_refresh")
     _delta_fields(line, quick=True)
     _egress_fields(line)
+    _localfault_fields(line)
     _burst_fields(line)
     _host_fields(line)
     print(json.dumps(line))
@@ -317,6 +334,7 @@ def main() -> int:
     _merge_hub_fields(line, measure_hub_merge)
     _delta_fields(line)
     _egress_fields(line)
+    _localfault_fields(line)
     _burst_fields(line)
     _host_fields(line)
     print(json.dumps(line))

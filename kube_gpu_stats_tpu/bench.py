@@ -528,7 +528,9 @@ def try_embedded_harness(probe: dict, *, ticks: int = 50, warmup: int = 5,
             finally:
                 stop.set()
 
-        burner = threading.Thread(target=burn, name="bench-burn", daemon=True)
+        from .supervisor import spawn
+
+        burner = spawn(burn, name="bench-burn")
         burner.start()
         # Let the burn compile + actually load the chip before measuring.
         deadline = time.monotonic() + 60.0
@@ -1233,6 +1235,85 @@ def measure_partition_drain(frames: int = 200,
 
         logging.getLogger(__name__).warning(
             "partition-drain bench failed", exc_info=True)
+        return None
+
+
+def measure_degraded_overhead(ticks: int = 200,
+                              budget_ms: float = 50.0) -> dict | None:
+    """Degraded-store cost on the tick path (ISSUE 15): the per-tick
+    price of the disk-backed store ops — one spill spool (the delta
+    publisher's offline write), one energy observe + forced checkpoint
+    — measured HEALTHY (fsync to a real tmpdir) vs DEGRADED (the
+    stores' durability state machines latched on a full disk, so every
+    op takes the gated in-memory path).
+
+    The number that matters is ``degraded_overhead_pct``: the degraded
+    per-tick store cost as a percent of the 50 ms tick budget. The
+    design intent is that degraded mode is CHEAPER than healthy (no
+    fsync, no syscalls between probes) — the CI pin (<10%,
+    tests/test_latency.py) guards against a regression where the
+    degraded path accidentally grows retries/logging/probing per op.
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        import errno as errno_mod
+        import pathlib
+        import tempfile
+
+        from . import wal
+        from .energy import EnergyAccountant
+        from .spillq import SpillQueue
+
+        body = "x" * 4096
+
+        def run_ticks(spill: SpillQueue, acct: EnergyAccountant) -> float:
+            start = time.perf_counter()
+            for i in range(ticks):
+                spill.spool(float(i), body)
+                acct.observe("dev0", "pod", "ns", float(i + 1), 100.0)
+                acct.checkpoint(force=True)
+            return (time.perf_counter() - start) / ticks * 1000.0
+
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                base = pathlib.Path(tmp)
+                spill = SpillQueue(str(base / "spill"), fsync=True)
+                acct = EnergyAccountant(
+                    checkpoint_path=str(base / "energy.json"),
+                    checkpoint_interval=0.0)
+                healthy_ms = run_ticks(spill, acct)
+                spill.close()
+            with tempfile.TemporaryDirectory() as tmp:
+                base = pathlib.Path(tmp)
+                spill = SpillQueue(str(base / "spill"), fsync=True)
+                acct = EnergyAccountant(
+                    checkpoint_path=str(base / "energy.json"),
+                    checkpoint_interval=0.0)
+                # Latch both stores degraded with the probe far away:
+                # every tick op takes the pure in-memory path, which is
+                # what a long ENOSPC episode costs per tick.
+                for label in ("spill", "energy"):
+                    health = wal.store_health(label)
+                    health.probe_interval = 3600.0
+                    health.record_fault(
+                        OSError(errno_mod.ENOSPC, "bench: disk full"))
+                degraded_ms = run_ticks(spill, acct)
+                lost = wal.store_health("spill").lost_records
+                spill.close()
+        finally:
+            wal.reset_store_stats()
+        return {
+            "healthy_store_ms_per_tick": round(healthy_ms, 4),
+            "degraded_store_ms_per_tick": round(degraded_ms, 4),
+            "degraded_overhead_pct": round(
+                degraded_ms / budget_ms * 100.0, 3),
+            "degraded_lost_counted": lost,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a failure
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "degraded-overhead bench failed", exc_info=True)
         return None
 
 

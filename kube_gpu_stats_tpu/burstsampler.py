@@ -48,6 +48,7 @@ from typing import Callable, Mapping, Sequence
 
 from . import schema
 from .registry import HistogramState
+from .supervisor import spawn
 
 log = logging.getLogger(__name__)
 
@@ -106,6 +107,11 @@ class BurstSampler:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Optional supervisor heartbeat (ISSUE 15 coverage sweep): the
+        # daemon sets this to Supervisor.beater("burst") so a sampler
+        # wedged inside a D-state sysfs read is detected as a HANG, not
+        # only outright thread death. Beaten once per loop pass.
+        self.heartbeat = None
 
     # -- arming ---------------------------------------------------------------
 
@@ -209,6 +215,15 @@ class BurstSampler:
     def run_forever(self) -> None:
         period = 1.0 / self.hz
         while not self._stop.is_set():
+            if self._thread is not threading.current_thread():
+                # Replaced by a respawn while wedged: retire — two
+                # sampler threads would double every ring's sample
+                # rate (ISSUE 15).
+                log.info("burst sampler thread superseded by respawn; "
+                         "retiring")
+                return
+            if self.heartbeat is not None:
+                self.heartbeat()
             if not self.armed:
                 expired = False
                 with self._lock:
@@ -238,10 +253,21 @@ class BurstSampler:
             self._stop.wait(max(0.0, period - (time.monotonic() - started)))
 
     def start(self) -> None:
-        if self.mode == "off" or self._thread is not None:
+        """Start the sampling thread. A live thread is left alone; a
+        DEAD one is replaced (the pre-fix `is not None` check made a
+        died-once sampler unrestartable forever)."""
+        if self.mode == "off" or self.thread_alive():
             return
-        self._thread = threading.Thread(
-            target=self.run_forever, name="burst-sampler", daemon=True)
+        self.respawn()
+
+    def respawn(self) -> None:
+        """The supervisor's restart closure (ISSUE 15 coverage sweep):
+        ALWAYS spawns — a HUNG thread (heartbeat missed, still alive
+        in a D-state read) is abandoned and retires itself at its next
+        superseded check; start() alone could never recover a hang."""
+        if self.mode == "off":
+            return
+        self._thread = spawn(self.run_forever, name="burst-sampler")
         self._thread.start()
 
     def thread_alive(self) -> bool:

@@ -202,6 +202,12 @@ class Daemon:
         # fleetlens.contribute_trace_digest) — the per-node half of the
         # hub fleet lens's cross-node slow-node attribution (ISSUE 5).
         self.tracer = Tracer(enabled=cfg.trace_enabled)
+        # Store-fault journal feed (ISSUE 15): every WAL-backed store's
+        # disk_fault / store_recovered transitions land in the shared
+        # event journal beside breaker trips and health flips.
+        from . import wal as wal_mod
+
+        wal_mod.set_journal(self.tracer)
         self.collector = build_collector(cfg)
         self._wire_tracer(self.collector)
         self.attribution = build_attribution(cfg)
@@ -336,6 +342,7 @@ class Daemon:
             host_provider=self.hoststats,
             egress_provider=self._egress_payload,
             skew_provider=self._skew_payload,
+            stores_provider=self._stores_payload,
         )
         self.textfile = (
             TextfileWriter(self.registry, cfg.textfile_dir,
@@ -549,6 +556,22 @@ class Daemon:
             "wal_quarantine_events": wal.quarantine_events(),
         }
 
+    def _stores_payload(self) -> dict:
+        """/debug/stores (ISSUE 15): every disk-backed store's
+        durability state machine (which store is degraded, why, what
+        was lost), the accept-loop fd fence, and the supervisor's
+        restarted/storm-latched thread report — what `doctor --stores`
+        summarizes."""
+        from . import wal
+
+        return {
+            "enabled": True,
+            "role": "daemon",
+            "stores": wal.store_report(),
+            "accept_fence": self.server.accept_fence_status(),
+            "threads": self.supervisor.restart_report(),
+        }
+
     def start(self) -> None:
         starter = getattr(self.attribution, "start", None)
         if starter:
@@ -588,10 +611,43 @@ class Daemon:
             alive = getattr(component, "thread_alive", None)
             starter = getattr(component, "start", None)
             if component is not None and callable(alive) and callable(starter):
+                # Publish-following senders beat once per loop pass
+                # (ISSUE 15 coverage sweep): a sender wedged INSIDE a
+                # push — a hung socket, a stuck fsync on the spill
+                # drain — is detected as a hang, not only when the
+                # thread dies outright. 60 s covers the worst honest
+                # pass (several 10 s-timeout POSTs back to back).
+                heartbeat_timeout = 0.0
+                restart = starter
+                if hasattr(component, "heartbeat"):
+                    component.heartbeat = self.supervisor.beater(name)
+                    heartbeat_timeout = 60.0
+                    # Hang restarts must ABANDON the wedged thread
+                    # (PublishFollower.respawn; the old one retires at
+                    # its next superseded() check) — start() is
+                    # deliberately a no-op on a live thread, so it
+                    # cannot recover a hang.
+                    restart = getattr(component, "respawn", starter)
                 self.supervisor.register(
-                    name, is_alive=alive, restart=starter,
+                    name, is_alive=alive, restart=restart,
+                    heartbeat_timeout=heartbeat_timeout,
                     breaker_prefixes=(("kubelet",)
                                       if name == "attribution" else ()))
+        if self.burst is not None:
+            # The sub-tick sampler (ISSUE 15 coverage sweep): a killed
+            # or wedged sampler thread silently stopped burst/energy
+            # fidelity forever before this row existed.
+            self.burst.heartbeat = self.supervisor.beater("burst")
+            self.supervisor.register(
+                "burst", is_alive=self.burst.thread_alive,
+                restart=self.burst.respawn, heartbeat_timeout=30.0)
+        if self.server.prewarm_enabled:
+            # The render pre-warmer: a dead warmer regresses scrape p99
+            # ~10x (BENCH_r06) with zero functional symptom — exactly
+            # the silent-stop class the coverage sweep closes.
+            self.supervisor.register(
+                "render-warmer", is_alive=self.server.warm_thread_alive,
+                restart=self.server.respawn_warm)
         self.supervisor.start()
         log.info(
             "kube-tpu-stats %s: backend=%s devices=%d listening on %s:%d",

@@ -73,6 +73,7 @@ from typing import NamedTuple, Sequence
 
 from . import snappy, wal
 from .resilience import CLOSED, OPEN, CircuitBreaker, TokenBucket
+from .supervisor import spawn
 from .validate import parse_exposition_interned, retry_after_seconds
 from .workers import PublishFollower, push_opener
 
@@ -959,6 +960,17 @@ class DeltaPublisher(PublishFollower):
             return
         try:
             while True:
+                if self.superseded():
+                    # A respawn replaced this thread while it was
+                    # wedged mid-drain: stop BEFORE the next
+                    # peek/commit — two drains on one cursor skip
+                    # records (ISSUE 15).
+                    return
+                if self.heartbeat is not None:
+                    # A long rate-paced drain stays inside push_once for
+                    # many sends; each loop beat keeps the supervisor's
+                    # hang detector honest (ISSUE 15).
+                    self.heartbeat()
                 if self._shed_until and time.monotonic() < self._shed_until:
                     return
                 if self._drain_bucket is not None and \
@@ -969,6 +981,14 @@ class DeltaPublisher(PublishFollower):
                     break
                 _ts, body = record
                 outcome, retry_after = self._send_frame(body)
+                if self.superseded():
+                    # The wedge was INSIDE the send and a respawned
+                    # thread took over meanwhile: committing now would
+                    # double-advance the cursor past a record the new
+                    # thread never saw. Leave the frame spooled —
+                    # at-least-once, the hub's dup detection absorbs
+                    # the re-send.
+                    return
                 if outcome == "ok":
                     spill.commit()
                     self._link_failures = 0
@@ -2249,8 +2269,7 @@ class DeltaIngest:
                     f"session(s) replayed in "
                     f"{self.warm_restart_replay_seconds:.2f}s")
 
-        self._replay_thread = threading.Thread(
-            target=sweep, name="ingest-replay", daemon=True)
+        self._replay_thread = spawn(sweep, name="ingest-replay")
         self._replay_thread.start()
 
     def lane_stats(self) -> list[dict[str, float]]:

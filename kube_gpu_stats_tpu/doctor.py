@@ -53,6 +53,16 @@ Accepts the exporter's own flags (same config surface, C6) plus:
                  loss, a near-full spool, parked poison, or a down
                  link; classified 401/404/disabled like --host. Same
                  server fallback as --trace.
+  --stores       pull the RUNNING daemon's (or hub's) /debug/stores
+                 snapshot and summarize the local-fault-survival
+                 picture: every disk-backed store's durability state
+                 machine (which store is degraded, with which errno,
+                 for how long, how many records lost durability),
+                 the accept loop's fd-exhaustion fence, and the
+                 supervisor's restarted / storm-latched thread
+                 report. WARN names each degraded store and each
+                 restarted thread; classified 401/404 like --host.
+                 Same server fallback as --trace.
   --skew         pull the RUNNING daemon's (or hub's) /debug/skew
                  snapshot and print the rolling-upgrade picture: the
                  fleet version census (hub), every refused peer with
@@ -936,6 +946,101 @@ def check_egress(base: str) -> CheckResult:
                    data={"egress": payload})
 
 
+def stores_verdict(payload: dict) -> tuple[str, str]:
+    """(status, detail) for a /debug/stores payload — every degraded
+    store NAMED with its reason/errno/loss, every restarted thread
+    NAMED with its count, storm latches called out (ISSUE 15). Pure so
+    tests and the localfault sim drive it on canned JSON; check_stores
+    wraps it with the fetch."""
+    parts: list[str] = []
+    status = OK
+    degraded = []
+    lost_total = 0
+    faults_total = 0
+    for store, info in sorted((payload.get("stores") or {}).items()):
+        faults_total += sum((info.get("fault_counts") or {}).values())
+        lost_total += info.get("lost_records", 0)
+        if info.get("state") == "degraded":
+            label = f"{store} ({info.get('reason', '?')}"
+            if info.get("errno"):
+                label += f", {info['errno']}"
+            if "degraded_for_seconds" in info:
+                label += f", {info['degraded_for_seconds']:.0f}s"
+            label += ")"
+            degraded.append(label)
+    if degraded:
+        status = WARN
+        parts.append("degraded store(s): " + ", ".join(degraded)
+                     + " — durability off, telemetry in-memory, "
+                     "auto-probing for recovery")
+    if lost_total:
+        status = WARN
+        parts.append(f"{lost_total} record(s) lost durability "
+                     f"(kts_store_lost_records_total — exactly what a "
+                     f"crash during the window would cost)")
+    if faults_total and not degraded:
+        parts.append(f"{faults_total} disk fault(s) survived and "
+                     f"recovered (kts_disk_faults_total)")
+    fence = payload.get("accept_fence") or {}
+    if fence.get("in_episode"):
+        status = WARN
+        parts.append(f"accept loop shedding on fd exhaustion "
+                     f"({fence.get('fenced_total', 0)} fenced)")
+    elif fence.get("fenced_total"):
+        parts.append(f"accept loop survived {fence['fenced_total']} "
+                     f"fd-exhaustion fault(s)")
+    restarted = [row for row in (payload.get("threads") or [])
+                 if row.get("restarts", 0) > 0]
+    if restarted:
+        status = WARN
+        parts.append("restarted thread(s): " + ", ".join(
+            f"{row['component']} x{row['restarts']}"
+            + (f" ({row['last_reason']})" if row.get("last_reason")
+               else "")
+            for row in restarted))
+    storms = [row["component"]
+              for row in (payload.get("threads") or [])
+              if row.get("storm_latched")]
+    if storms:
+        status = WARN
+        parts.append("RESTART STORM latched: " + ", ".join(storms)
+                     + " — respawns paused; the component is dying on "
+                     "arrival, read its last restart reason above")
+    if not parts:
+        parts.append("all stores durable; no thread restarts")
+    return status, "; ".join(parts)
+
+
+def check_stores(base: str) -> CheckResult:
+    """--stores: read /debug/stores and summarize the local-fault-
+    survival picture. Classified 401/404 like --host: a WARN row
+    diagnoses config, only a broken surface FAILs."""
+    import urllib.error
+
+    try:
+        payload = _fetch_json(base + "/debug/stores")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "stores", WARN,
+                f"{base}/debug/stores requires authentication "
+                f"(HTTP {exc.code}); the stores snapshot sits behind "
+                f"the exporter's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "stores", WARN,
+                f"{base}: no /debug/stores (exporter predates the "
+                f"local-fault-survival layer, or this server has none "
+                f"wired)")
+        return _result("stores", FAIL,
+                       f"{base}/debug/stores: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable, bad JSON
+        return _result("stores", FAIL,
+                       f"{base}: stores snapshot unreadable ({exc})")
+    status, detail = stores_verdict(payload)
+    return _result("stores", status, detail, data={"stores": payload})
+
+
 def skew_verdict(payload: dict) -> tuple[str, str]:
     """(status, detail) for a /debug/skew payload — the fleet version
     census plus every refused/downgraded peer, named (ISSUE 14). Pure
@@ -1390,7 +1495,8 @@ def run_checks(cfg: Config, url: str = "",
                energy: bool = False,
                host: bool = False,
                egress: bool = False,
-               skew: bool = False) -> list[CheckResult]:
+               skew: bool = False,
+               stores: bool = False) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -1451,6 +1557,13 @@ def run_checks(cfg: Config, url: str = "",
                      if url.startswith(("http://", "https://"))
                      else f"http://127.0.0.1:{cfg.listen_port}")
         probes.append(("skew", lambda: check_skew(skew_base)))
+    if stores:
+        # /debug/stores lives on BOTH daemon and hub servers (ISSUE
+        # 15); same fallback as --skew.
+        stores_base = (trace_base(url)
+                       if url.startswith(("http://", "https://"))
+                       else f"http://127.0.0.1:{cfg.listen_port}")
+        probes.append(("stores", lambda: check_stores(stores_base)))
     if fleet:
         # The fleet lens lives on the HUB, not the daemon: an http(s)
         # --url names the hub to read; otherwise fall back to a local
@@ -1519,6 +1632,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     host = False
     egress = False
     skew = False
+    stores = False
     url = ""
     args: list[str] = []
     it = iter(raw)
@@ -1527,6 +1641,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             as_json = True
         elif token == "--trace":
             trace = True
+        elif token == "--stores":
+            stores = True
         elif token == "--fleet":
             fleet = True
         elif token == "--energy":
@@ -1555,7 +1671,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     started = time.monotonic()
     results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
                          energy=energy, host=host, egress=egress,
-                         skew=skew)
+                         skew=skew, stores=stores)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

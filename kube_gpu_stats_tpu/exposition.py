@@ -11,6 +11,7 @@ Two outputs, matching the reference's (SURVEY.md §2 C5):
 
 from __future__ import annotations
 
+import errno
 import http.server
 import logging
 import os
@@ -20,6 +21,7 @@ from pathlib import Path
 
 from . import schema
 from .registry import HistogramState, Registry
+from .supervisor import spawn
 from .workers import PublishFollower, push_opener
 
 log = logging.getLogger(__name__)
@@ -121,6 +123,87 @@ class RenderStats:
         builder.add(schema.RENDER_CACHE_MISSES, float(cache_misses))
 
 
+class _AcceptFence:
+    """EMFILE/ENFILE fence for an accept loop (ISSUE 15): when the
+    process (or host) runs out of file descriptors, ``accept()`` fails
+    — socketserver swallows the OSError, so the loop never *dies*, but
+    it spins hot, burning CPU and log lines while serving nobody. The
+    fence converts that into shed-with-backoff: each fenced failure
+    counts (``kts_disk_faults_total{store="http-accept"}``), journals
+    once per episode through the shared store state machine, and sleeps
+    an exponentially growing beat (50 ms → 1 s) so in-flight handlers
+    get a chance to close sockets and return fds. A successful accept
+    re-arms instantly."""
+
+    FENCED_ERRNOS = frozenset(
+        getattr(errno, name)
+        for name in ("EMFILE", "ENFILE", "ENOBUFS", "ENOMEM")
+        if hasattr(errno, name))
+
+    def __init__(self) -> None:
+        from .resilience import BackoffPolicy
+        from .wal import store_health
+
+        # Shared state machine => shared metrics; per-fence episode
+        # bookkeeping below so two servers in one process (sims) report
+        # their own accept health at /debug/stores.
+        self._health = store_health("http-accept")
+        # The one backoff implementation (resilience.BackoffPolicy),
+        # like every other retry path in the package: 50 ms doubling to
+        # a 1 s cap, reset on the first successful accept.
+        self._backoff = BackoffPolicy(base=0.05, cap=1.0, jitter=False)
+        self.fenced_total = 0
+        self.episodes = 0
+        self.in_episode = False
+
+    def faulted(self, exc: OSError) -> None:
+        if not self.in_episode:
+            self.episodes += 1
+            self.in_episode = True
+        self.fenced_total += 1
+        self._health.record_fault(exc)
+        time.sleep(self._backoff.next_delay())
+
+    def accepted(self) -> None:
+        if not self.in_episode:
+            return
+        self.in_episode = False
+        self._backoff.reset()
+        self._health.ok()
+
+    def status(self) -> dict:
+        return {
+            "fenced_total": self.fenced_total,
+            "episodes": self.episodes,
+            "in_episode": self.in_episode,
+            "state": self._health.state,
+        }
+
+
+class _FencedHTTPServer(http.server.ThreadingHTTPServer):
+    """ThreadingHTTPServer whose accept path survives fd exhaustion:
+    ``get_request`` routes EMFILE-class OSErrors through the
+    :class:`_AcceptFence` (count + journal + backoff) before re-raising
+    into socketserver's own swallow — the accept loop sheds, it never
+    dies and never spins."""
+
+    fence: _AcceptFence | None = None
+
+    def get_request(self):
+        try:
+            request = super().get_request()
+        except OSError as exc:
+            fence = self.fence
+            if (fence is not None and getattr(exc, "errno", None)
+                    in _AcceptFence.FENCED_ERRNOS):
+                fence.faulted(exc)
+            raise
+        fence = self.fence
+        if fence is not None:
+            fence.accepted()
+        return request
+
+
 class MetricsServer:
     """Threaded HTTP server for /metrics, /healthz and /.
 
@@ -158,6 +241,7 @@ class MetricsServer:
                  ingest_provider=None, burst_provider=None,
                  energy_provider=None, host_provider=None,
                  egress_provider=None, skew_provider=None,
+                 stores_provider=None,
                  prewarm_renders: bool = True,
                  ingest_read_deadline: float = 10.0):
         self._registry = registry
@@ -218,6 +302,13 @@ class MetricsServer:
         # peers (hub), quarantined persisted formats — the payload
         # `doctor --skew` reads. None (bare test servers) 404s.
         self._skew = skew_provider
+        # Local-fault snapshot (ISSUE 15, duck-typed: () -> dict):
+        # serves /debug/stores — per-store durability states (which
+        # store is degraded, why, how much was lost) plus the
+        # supervisor's restarted/storm-latched thread report — the
+        # payload `doctor --stores` reads. None (bare test servers)
+        # 404s.
+        self._stores = stores_provider
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -642,6 +733,22 @@ class MetricsServer:
                             + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/stores" and outer._stores is not None:
+                    # Local fault survival (ISSUE 15): every store's
+                    # durability state machine + the thread restart/
+                    # storm report — behind the same auth gate as every
+                    # non-probe path.
+                    import json
+
+                    try:
+                        payload = outer._stores()
+                    except Exception as exc:  # noqa: BLE001 - a status
+                        # walk must not 500 the whole debug surface.
+                        payload = {"enabled": False, "error": str(exc)}
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -690,6 +797,8 @@ class MetricsServer:
                         links += ["/debug/egress"]
                     if outer._skew is not None:
                         links += ["/debug/skew"]
+                    if outer._stores is not None:
+                        links += ["/debug/stores"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()
@@ -713,8 +822,12 @@ class MetricsServer:
         if (tls_cert_file or tls_key_file) and not (
                 tls_cert_file and tls_key_file):
             raise ValueError("TLS needs both tls_cert_file and tls_key_file")
-        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        # Fenced accept loop (ISSUE 15): fd exhaustion sheds with
+        # backoff + journal instead of spinning the accept thread hot.
+        self._server = _FencedHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
+        self._fence = _AcceptFence()
+        self._server.fence = self._fence
         if tls_cert_file:
             import ssl
 
@@ -750,6 +863,18 @@ class MetricsServer:
         """Actual bound port (useful when constructed with port 0 in tests)."""
         return self._server.server_address[1]
 
+    @property
+    def prewarm_enabled(self) -> bool:
+        """Whether this server runs a render pre-warmer thread (the
+        supervisor registers its row only when one exists)."""
+        return self._prewarm
+
+    def accept_fence_status(self) -> dict:
+        """The accept loop's fd-exhaustion fence state, for
+        /debug/stores (ISSUE 15) — per-server, so two servers in one
+        process (sims) each report their own episode."""
+        return self._fence.status()
+
     def _warm_loop(self) -> None:
         """Fill the per-generation render cache right behind each
         publish: one render + one gzip per generation, charged to this
@@ -769,14 +894,28 @@ class MetricsServer:
             self._registry.wait_for_publish(generation, timeout=0.5)
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="metrics-http", daemon=True
-        )
+        self._thread = spawn(self._server.serve_forever,
+                             name="metrics-http")
         self._thread.start()
         if self._prewarm:
-            self._warm_thread = threading.Thread(
-                target=self._warm_loop, name="render-warmer", daemon=True)
-            self._warm_thread.start()
+            self.respawn_warm()
+
+    def warm_thread_alive(self) -> bool:
+        """Liveness probe for the supervisor's render-warmer row
+        (ISSUE 15 coverage sweep); False when pre-warming is off."""
+        return (self._warm_thread is not None
+                and self._warm_thread.is_alive())
+
+    def respawn_warm(self) -> None:
+        """Crash-only restart for the render pre-warmer: a fresh
+        thread over the same registry (the per-generation cache IS the
+        retained state). Doubles as the initial start."""
+        if not self._prewarm or self._warm_stop.is_set():
+            return
+        if self.warm_thread_alive():
+            return
+        self._warm_thread = spawn(self._warm_loop, name="render-warmer")
+        self._warm_thread.start()
 
     def stop(self) -> None:
         self._warm_stop.set()
@@ -888,9 +1027,7 @@ class TextfileWriter:
                     log.warning("textfile write failed: %s", exc)
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self.run_forever, name="textfile-writer", daemon=True
-        )
+        self._thread = spawn(self.run_forever, name="textfile-writer")
         self._thread.start()
 
     def thread_alive(self) -> bool:

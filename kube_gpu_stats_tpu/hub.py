@@ -43,9 +43,12 @@ from typing import Mapping, Sequence
 
 from . import delta as delta_mod
 from . import fleetlens, procstats, schema
+from . import wal as wal_mod
 from .registry import (HistogramState, Registry, Series, SnapshotBuilder,
-                       contribute_egress_stats, contribute_push_stats)
+                       contribute_egress_stats, contribute_push_stats,
+                       contribute_store_metrics)
 from .resilience import CircuitBreaker
+from .supervisor import spawn
 from .top import (_COUNTER_BY_NAME, _GAUGE_BY_NAME, ChipRow, Frame,
                   fold_target)
 from .tracing import Tracer, log_every
@@ -644,6 +647,16 @@ class Hub:
         self._cycle_seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Thread supervisor (ISSUE 15 coverage sweep): hub main() wires
+        # one and registers the refresh loop / senders / pre-warmer;
+        # when set, its kts_component_* self-metrics ride every publish
+        # and the refresh loop beats it per cycle.
+        self._supervisor = None
+        self.heartbeat = None
+        # Store-fault journal feed (ISSUE 15): disk_fault /
+        # store_recovered events from every WAL store land in this
+        # process's shared journal.
+        wal_mod.set_journal(self.tracer)
 
     def _breaker(self, target: str) -> CircuitBreaker:
         breaker = self._breakers.get(target)
@@ -1341,7 +1354,6 @@ class Hub:
         # unlabeled counter — summed at the source so the series stays
         # unique), and persisted formats quarantined at startup.
         from . import __version__ as _build
-        from . import wal as wal_mod
 
         builder.add(
             schema.BUILD_INFO, 1.0,
@@ -1357,6 +1369,15 @@ class Hub:
         for store, count in sorted(wal_mod.quarantine_counts().items()):
             builder.add(schema.WAL_QUARANTINED, float(count),
                         (("store", store),))
+        # Local fault survival (ISSUE 15): per-store durability state +
+        # fault/loss accounting for the ingest checkpoint, any spill
+        # queue / remote-write WAL this hub runs, and the accept fence.
+        contribute_store_metrics(builder)
+        if self._supervisor is not None:
+            # Thread supervision self-metrics (kts_component_* +
+            # restart storms) on the hub's own exposition, the daemon
+            # contract (ISSUE 15 coverage sweep).
+            self._supervisor.contribute(builder)
         # The hub's own process health (CPU, RSS, fds) — same process_*
         # families the daemon exports, so one dashboard covers both.
         procstats.contribute(builder, proc_readings)
@@ -1837,6 +1858,15 @@ class Hub:
         # Fixed-cadence like poll.py: sleep the remainder of the interval
         # so a slow refresh doesn't push the next one further out.
         while not self._stop.is_set():
+            if self._thread is not threading.current_thread():
+                # A supervisor respawn replaced this thread while it
+                # was wedged (ISSUE 15): retire rather than run two
+                # refresh loops over one cache/session state.
+                log.info("hub refresh thread superseded by respawn; "
+                         "retiring")
+                return
+            if self.heartbeat is not None:
+                self.heartbeat()
             started = time.monotonic()
             try:
                 self.refresh_once()
@@ -1848,9 +1878,27 @@ class Hub:
     def start(self) -> None:
         if self.delta is not None:
             self.delta.start_replay()
-        self._thread = threading.Thread(
-            target=self.run_forever, name="hub-refresh", daemon=True)
+        self.respawn()
+
+    def thread_alive(self) -> bool:
+        """Liveness probe for the supervisor's hub-refresh row
+        (ISSUE 15 coverage sweep)."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def respawn(self) -> None:
+        """(Re)start the refresh thread — the supervisor's crash-only
+        restart closure: a wedged previous thread is abandoned (it
+        retires at its next stop-check), warm state (caches, sessions,
+        baselines) survives on self."""
+        self._thread = spawn(self.run_forever, name="hub-refresh")
         self._thread.start()
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Wire the process supervisor (hub main): its kts_component_*
+        rows + restart storms ride every publish, and the refresh loop
+        beats it once per cycle."""
+        self._supervisor = supervisor
+        self.heartbeat = supervisor.beater("hub-refresh")
 
     def stop(self) -> None:
         self._stop.set()
@@ -2367,6 +2415,29 @@ def main(argv: Sequence[str] | None = None) -> int:
         # All targets down = nothing aggregated: signal it like top --once.
         return 2 if not frame.rows and frame.errors else 0
 
+    # Thread supervisor (ISSUE 15 coverage sweep): the hub's refresh
+    # loop, push senders and render pre-warmer get the same liveness/
+    # hang/restart-storm coverage the daemon's workers have had since
+    # ISSUE 1 — a silently dead refresh thread used to mean a frozen
+    # rollup until the liveness probe killed the pod.
+    from .supervisor import Supervisor
+
+    supervisor = Supervisor(check_interval=1.0, tracer=hub.tracer)
+    hub.attach_supervisor(supervisor)
+
+    def stores_payload() -> dict:
+        # /debug/stores (ISSUE 15): per-store durability states +
+        # restarted/storm-latched threads — what doctor --stores reads.
+        from . import wal
+
+        return {
+            "enabled": True,
+            "role": "hub",
+            "stores": wal.store_report(),
+            "accept_fence": server.accept_fence_status(),
+            "threads": supervisor.restart_report(),
+        }
+
     server = MetricsServer(
         hub.registry, host=args.listen_host, port=args.listen_port,
         healthz_max_age=max(3 * args.interval, 30.0),
@@ -2376,11 +2447,13 @@ def main(argv: Sequence[str] | None = None) -> int:
         auth_password_sha256=args.auth_password_sha256,
         render_stats=render_stats,
         ready_check=hub.ready,
+        health_provider=supervisor.health_report,
         trace_provider=hub.tracer,
         fleet_provider=hub.fleet,
         ingest_provider=hub.delta.handle if hub.delta is not None else None,
         egress_provider=egress_payload,
-        skew_provider=skew_payload)
+        skew_provider=skew_payload,
+        stores_provider=stores_payload)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.
@@ -2394,6 +2467,30 @@ def main(argv: Sequence[str] | None = None) -> int:
         for _, sender in senders:
             sender.start()
         hub.start()
+        # Registered started-components-only, supervisor last (the
+        # daemon.start discipline: no watchdog pass may see a component
+        # before its thread exists).
+        supervisor.register(
+            "hub-refresh", is_alive=hub.thread_alive,
+            restart=hub.respawn,
+            heartbeat_timeout=max(30.0, 5 * args.interval))
+        for mode, sender in senders:
+            has_heartbeat = hasattr(sender, "heartbeat")
+            if has_heartbeat:
+                sender.heartbeat = supervisor.beater(mode)
+            supervisor.register(
+                mode, is_alive=sender.thread_alive,
+                # respawn (not start) for heartbeat-supervised senders:
+                # a hang restart must abandon the wedged thread, and
+                # start() no-ops on a live one (daemon.start contract).
+                restart=getattr(sender, "respawn", sender.start)
+                if has_heartbeat else sender.start,
+                heartbeat_timeout=60.0 if has_heartbeat else 0.0)
+        if server.prewarm_enabled:
+            supervisor.register(
+                "render-warmer", is_alive=server.warm_thread_alive,
+                restart=server.respawn_warm)
+        supervisor.start()
         if args.targets_dns:
             log.info("hub serving DNS-discovered targets (%s) on %s:%d",
                      args.targets_dns, args.listen_host, server.port)
@@ -2406,6 +2503,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         stop.wait()
         return 0
     finally:
+        # Supervisor first: a watchdog pass mid-teardown would respawn
+        # the very threads being joined (the daemon.stop discipline).
+        supervisor.stop()
         hub.stop()
         for _, sender in senders:
             sender.stop()

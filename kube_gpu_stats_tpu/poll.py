@@ -52,8 +52,10 @@ from .fleetlens import contribute_trace_digest
 from .ici import RateTracker
 from .registry import (FilteredSnapshotBuilder, HistogramState, Registry,
                        Series, SnapshotBuilder, _series_prefix,
-                       contribute_egress_stats, contribute_push_stats)
+                       contribute_egress_stats, contribute_push_stats,
+                       contribute_store_metrics)
 from .resilience import DeadlineBudget
+from .supervisor import spawn
 from .tracing import Tracer, log_every
 from .workers import DaemonSamplerPool
 
@@ -714,9 +716,7 @@ class PollLoop:
         with the process; it's daemonic). State carried by self (rate
         baselines, restart counters, energy) survives, so a respawn is
         not a telemetry reset."""
-        thread = threading.Thread(
-            target=self.run_forever, name="poll-loop", daemon=True
-        )
+        thread = spawn(self.run_forever, name="poll-loop")
         self._thread = thread
         thread.start()
 
@@ -1580,6 +1580,11 @@ class PollLoop:
         for store, count in sorted(wal_mod.quarantine_counts().items()):
             builder.add(schema.WAL_QUARANTINED, float(count),
                         (("store", store),))
+        # Local fault survival (ISSUE 15): per-store durability state,
+        # per-errno fault counts and lost-record accounting for every
+        # disk-backed store this daemon runs (energy checkpoint, spill
+        # queue, remote-write WAL) plus the accept-loop fence.
+        contribute_store_metrics(builder)
         if push_stats is not None:
             # Upstream-hub skew refusals this node's delta publisher
             # drew (426): a daemon-side mirror of the hub's own

@@ -396,6 +396,28 @@ def contribute_egress_stats(builder: SnapshotBuilder, stats) -> None:
                         float(shard.get("dropped_total", 0)), label)
 
 
+def contribute_store_metrics(builder: SnapshotBuilder) -> None:
+    """Fold the local-fault-survival families (ISSUE 15) from the
+    process-global store registry (wal.store_report): durability state,
+    per-errno fault counts and lost-record accounting for every
+    disk-backed store this process opened (plus the accept-loop fence).
+    One definition shared by the poll loop and the hub; a process with
+    no disk-backed stores contributes nothing."""
+    from . import wal
+
+    for store, info in sorted(wal.store_report().items()):
+        label = (("store", store),)
+        builder.add(schema.STORE_STATE,
+                    wal.STORE_STATE_VALUES.get(info.get("state"), 0.0),
+                    label)
+        builder.add(schema.STORE_LOST,
+                    float(info.get("lost_records", 0)), label)
+        for name in sorted(info.get("fault_counts", {})):
+            builder.add(schema.DISK_FAULTS,
+                        float(info["fault_counts"][name]),
+                        (("store", store), ("errno", name)))
+
+
 class FilteredSnapshotBuilder(SnapshotBuilder):
     """SnapshotBuilder that drops families the operator disabled
     (``--metrics-include``/``--metrics-exclude``, schema.FILTERABLE_METRICS).

@@ -61,6 +61,7 @@ import zlib
 from . import schema, snappy
 from .proto import prompb, prompb2
 from .registry import Registry, Snapshot, format_value
+from .supervisor import spawn
 from .validate import classify_push_status, retry_after_seconds
 from .wal import SegmentRing
 from .workers import PublishFollower, push_opener
@@ -318,6 +319,9 @@ class _Shard:
             # moving the .skew file back under the writing build.
             "skew_segments_total": self.ring.skew_segments,
             "format_version": ring["format_version"],
+            # Durability state machine (ISSUE 15): this shard's WAL
+            # store health, for /debug/stores + doctor --stores.
+            "health": ring["health"],
             "consecutive_failures": self.failures,
             "retry_in_seconds": round(
                 max(0.0, self.retry_at - time.monotonic()), 3),
@@ -435,17 +439,25 @@ class RemoteWriter(PublishFollower):
         # short-lived threads so one slow receiver connection doesn't
         # serialize the others (each shard is single-pumper by
         # construction: only this thread spawns them, and join is
-        # unconditional).
+        # unconditional). ``abort`` carries THIS push thread's identity
+        # into the pumps: if a supervisor respawn replaces the follower
+        # while it is wedged here (ISSUE 15), the old generation's
+        # pumps stop before their next peek/commit — two pumpers on one
+        # shard WAL would race the cursor and skip records.
+        me = threading.current_thread()
+
+        def abort() -> bool:
+            return self._thread is not None and self._thread is not me
+
         backlogged = [s for s in self._shards
                       if s.ring.records_pending()
                       and time.monotonic() >= s.retry_at]
         if len(backlogged) <= 1:
             for shard in backlogged:
-                self._pump(shard)
+                self._pump(shard, abort)
         else:
-            threads = [threading.Thread(target=self._pump, args=(shard,),
-                                        name=f"rw-shard-{shard.index}",
-                                        daemon=True)
+            threads = [spawn(self._pump, args=(shard, abort),
+                             name=f"rw-shard-{shard.index}")
                        for shard in backlogged]
             for thread in threads:
                 thread.start()
@@ -459,12 +471,22 @@ class RemoteWriter(PublishFollower):
         for shard in self._shards:
             shard.ring.save_cursor()
 
-    def _pump(self, shard: _Shard) -> None:
+    def _pump(self, shard: _Shard, abort=None) -> None:
         """Send up to drain_max_per_push requests from one shard's WAL
         head. Retry classification is the whole point: retryable leaves
         the record at the head and backs off; poison parks it and moves
-        on; ok commits and meters the lag."""
+        on; ok commits and meters the lag. ``abort`` (from the owning
+        push thread) stops the pump before its next peek/commit when a
+        respawn superseded that owner."""
         for _ in range(self._drain_max):
+            if abort is not None and abort():
+                return
+            if self.heartbeat is not None:
+                # Per-record beat: a deep multi-shard drain can hold
+                # push_once well past the loop-level heartbeat window,
+                # and honest slow progress must not read as a hang
+                # (ISSUE 15).
+                self.heartbeat()
             if time.monotonic() < shard.retry_at:
                 return
             record = shard.ring.peek()
@@ -482,6 +504,13 @@ class RemoteWriter(PublishFollower):
                 shard.note_failure()
                 return
             code, response_headers = self._post_raw(payload[1:], headers)
+            if abort is not None and abort():
+                # The wedge was INSIDE the POST and a respawned push
+                # thread owns this shard now: committing would advance
+                # the cursor past a record the new pumper never saw.
+                # The record stays at the head — at-least-once, and
+                # same-timestamp re-delivery is idempotent receiver-side.
+                return
             verdict = ("retryable" if code is None
                        else classify_push_status(code))
             if verdict == "ok":

@@ -725,6 +725,71 @@ SKEW_METRICS: tuple[MetricSpec, ...] = (
     WAL_QUARANTINED,
 )
 
+# Local fault survival families (ISSUE 15): every disk-backed store
+# (energy checkpoint, ingest checkpoint, spill queue, remote-write
+# WAL shards) and the HTTP accept loops carry a durability state
+# machine — a full disk, an I/O error, a read-only remount or fd
+# exhaustion becomes a counted, journaled, auto-recovering
+# degradation instead of a crash or a silent stop.
+
+STORE_STATE = MetricSpec(
+    "kts_store_state",
+    MetricType.GAUGE,
+    "Durability state per disk-backed store (energy, ingest, spill, "
+    "remote-write shard N, http-accept): 1 healthy (durable ops reach "
+    "the disk), 0 degraded (a local resource fault — ENOSPC, EIO, "
+    "EROFS, EMFILE; telemetry continues in-memory, loss is counted in "
+    "kts_store_lost_records_total, and the store re-probes the disk "
+    "every few seconds, re-arming automatically when the fault "
+    "clears). The reason/errno detail lives at /debug/stores and in "
+    "doctor --stores; alert on sustained 0 (StoreDegraded).",
+    extra_labels=("store",),
+)
+DISK_FAULTS = MetricSpec(
+    "kts_disk_faults_total",
+    MetricType.COUNTER,
+    "OS-level faults per store and errno (ENOSPC, EDQUOT, EIO, EROFS, "
+    "EACCES, EMFILE, ENFILE, ...): every failed durable op counts "
+    "here, while the matching log line fires once per (store, errno) "
+    "EPISODE, not once per tick. A steady rate on one store names the "
+    "sick filesystem; rates across every store mean the node's disk "
+    "(or fd budget) is the problem (DiskFaultsHigh).",
+    extra_labels=("store", "errno"),
+)
+STORE_LOST = MetricSpec(
+    "kts_store_lost_records_total",
+    MetricType.COUNTER,
+    "Records whose DURABILITY was lost to a local fault, per store: "
+    "ring records appended memory-only while the store was degraded, "
+    "records shed oldest-first to reclaim a full disk, and records "
+    "whose durable copy was quarantined with an EIO-sick segment. "
+    "The queues keep serving from memory, so nothing is silently "
+    "dropped while the process lives — this counter is exactly what a "
+    "crash during the degraded window would cost. Checkpoint stores "
+    "defer (rewrite whole on recovery) rather than lose, so they "
+    "stay at 0 here.",
+    extra_labels=("store",),
+)
+THREAD_RESTART_STORMS = MetricSpec(
+    "kts_thread_restart_storms_total",
+    MetricType.COUNTER,
+    "Restart storms the supervisor latched per component: a component "
+    "restarted so often inside the storm window that respawning it "
+    "again is hammering, not healing — restarts pause for the storm "
+    "hold (the component reads degraded with a 'restart storm' "
+    "reason), then ONE probe respawn re-tests it. Any increase means "
+    "a worker thread is dying on arrival — read its last restart "
+    "reason at /debug/stores (ThreadRestartStorm).",
+    extra_labels=("component",),
+)
+
+LOCAL_FAULT_METRICS: tuple[MetricSpec, ...] = (
+    STORE_STATE,
+    DISK_FAULTS,
+    STORE_LOST,
+    THREAD_RESTART_STORMS,
+)
+
 # Fleet-lens families (fleetlens.py, driven from the hub refresh):
 # cross-node anomaly detection, slow-node attribution, SLO burn windows.
 
@@ -1524,6 +1589,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     DELTA_SHED_HONORED,
     *EGRESS_METRICS,
     *SKEW_METRICS,
+    *LOCAL_FAULT_METRICS,
     RENDER_PREWARM_WAIT,
     BREAKER_STATE,
     BREAKER_TRIPS,

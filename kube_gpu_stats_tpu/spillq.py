@@ -217,6 +217,9 @@ class SpillQueue:
             "skew_segments_total": ring["skew_segments_total"],
             "format_version": ring["format_version"],
             "legacy_segments": ring["legacy_segments"],
+            # Durability state machine (ISSUE 15): degraded/healthy +
+            # fault/loss ledger, for /debug/stores + doctor --stores.
+            "health": ring["health"],
         }
 
     def close(self) -> None:

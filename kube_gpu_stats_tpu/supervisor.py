@@ -52,6 +52,23 @@ STALE = "stale"
 HEALTH_VALUES = {HEALTHY: 1.0, DEGRADED: 0.5, STALE: 0.0}
 
 
+def spawn(target: Callable, *, name: str, daemon: bool = True,
+          args: tuple = (), kwargs: dict | None = None) -> threading.Thread:
+    """The ONE place package threads are born (ISSUE 15 coverage
+    sweep). Every long-lived thread in kube_gpu_stats_tpu must be
+    created through here — tools/check_supervised_threads.py fails
+    `make lint` on a bare ``threading.Thread(...)`` anywhere else in
+    the package — so no thread can quietly predate (or outlive) the
+    supervision story: a spawned thread is either registered with a
+    Supervisor by its owner or deliberately short-lived, and either
+    way it is visible at /debug/threads under a real name.
+
+    Returns the (unstarted) thread; callers keep their own ``.start()``
+    so restart closures stay exactly where they were."""
+    return threading.Thread(target=target, name=name, daemon=daemon,
+                            args=args, kwargs=kwargs or {})
+
+
 @dataclasses.dataclass
 class ComponentHealth:
     """One row of the health report (also the /healthz body shape)."""
@@ -83,6 +100,18 @@ class _Component:
         self.last_restart_at: float | None = None
         self.next_restart_at = 0.0
         self.last_reason = ""
+        # Restart-storm self-metering (ISSUE 15): recent restart
+        # timestamps inside the storm window, the latch deadline, and
+        # the storms-latched counter (kts_thread_restart_storms_total).
+        # probe_next marks the first post-hold respawn as THE probe;
+        # probing means that probe is outstanding — if the component is
+        # hung/dead again before it reads healthy once, the storm
+        # re-latches immediately (one probe, not five).
+        self.restart_times: list[float] = []
+        self.storm_until = 0.0
+        self.storms = 0
+        self.probe_next = False
+        self.probing = False
 
 
 class Supervisor:
@@ -94,6 +123,17 @@ class Supervisor:
     # long enough for dashboards/probes to catch the event, short enough
     # that a genuinely recovered component returns to healthy.
     DEGRADED_HOLD = 60.0
+
+    # Restart-storm latch (ISSUE 15): STORM_THRESHOLD restarts inside
+    # STORM_WINDOW seconds means respawning is hammering, not healing —
+    # a component dying on arrival (bad config, broken dependency)
+    # would otherwise burn CPU and flood the journal forever. The latch
+    # pauses restarts for STORM_HOLD (the component reads degraded with
+    # a 'restart storm' reason), then ONE probe respawn re-tests it; a
+    # probe that dies again re-latches immediately.
+    STORM_WINDOW = 120.0
+    STORM_THRESHOLD = 5
+    STORM_HOLD = 300.0
 
     def __init__(self, *, check_interval: float = 1.0,
                  clock: Callable[[], float] = time.monotonic,
@@ -220,6 +260,8 @@ class Supervisor:
         for component in components:
             hung, dead, reason = self._probe(component, now)
             if not (hung or dead):
+                # Healthy: an outstanding storm probe SUCCEEDED.
+                component.probing = False
                 if (component.last_restart_at is not None
                         and now - component.last_restart_at
                         > self.DEGRADED_HOLD):
@@ -231,6 +273,15 @@ class Supervisor:
                 continue
             component.last_reason = reason
             if component.restart is None:
+                continue
+            if now < component.storm_until:
+                continue  # storm latch: paused until the probe window
+            if component.probing:
+                # The post-hold probe respawn is hung/dead again: the
+                # component is still dying on arrival — re-latch
+                # IMMEDIATELY (one probe per hold, the documented
+                # contract), don't pay another full storm window.
+                self._latch_storm(component, now)
                 continue
             if now < component.next_restart_at:
                 continue  # backoff pacing: don't hot-loop a dying component
@@ -253,8 +304,46 @@ class Supervisor:
             component.last_beat = now  # grace: the fresh thread starts clean
             component.next_restart_at = now + component.backoff.next_delay()
             restarted.append(component.name)
+            if component.probe_next:
+                # First respawn after a storm hold: THE probe. If it is
+                # hung/dead at any pass before reading healthy once,
+                # the latch above re-engages without a fresh window.
+                component.probe_next = False
+                component.probing = True
+            self._meter_storm(component, now)
         self._observe_transitions()
         return restarted
+
+    def _meter_storm(self, component: _Component, now: float) -> None:
+        """Count this restart against the storm window; latch when the
+        component is dying on arrival (ISSUE 15)."""
+        component.restart_times.append(now)
+        component.restart_times = [
+            t for t in component.restart_times
+            if now - t <= self.STORM_WINDOW]
+        if len(component.restart_times) < self.STORM_THRESHOLD:
+            return
+        self._latch_storm(component, now)
+
+    def _latch_storm(self, component: _Component, now: float) -> None:
+        component.storms += 1
+        component.storm_until = now + self.STORM_HOLD
+        component.restart_times.clear()
+        component.probing = False
+        component.probe_next = True  # the first post-hold respawn probes
+        log.warning(
+            "supervisor: %s restart storm — latching degraded, "
+            "restarts paused %.0fs, then ONE probe respawn "
+            "(storm #%d; last reason: %s)",
+            component.name, self.STORM_HOLD, component.storms,
+            component.last_reason)
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.event(
+                "thread_restart_storm",
+                f"{component.name}: restart storm #{component.storms}; "
+                f"restarts paused {self.STORM_HOLD:.0f}s, then one "
+                f"probe respawn ({component.last_reason})",
+                component=component.name)
 
     def _observe_transitions(self) -> None:
         """Journal feed (one pass per watchdog check): attach the
@@ -317,6 +406,18 @@ class Supervisor:
         }
         for component in components:
             hung, dead, reason = self._probe(component, now)
+            if (hung or dead) and now < component.storm_until:
+                # Storm-latched (ISSUE 15): the dead state is KNOWN and
+                # deliberate — restarts are paused, the probe respawn
+                # is scheduled. Degraded (with the storm named), not
+                # stale: the stale alert means "nobody is handling
+                # this", and the latch IS the handling.
+                rows.append(ComponentHealth(
+                    component.name, DEGRADED,
+                    f"restart storm: restarts paused "
+                    f"{component.storm_until - now:.0f}s more "
+                    f"({component.last_reason})", component.restarts))
+                continue
             if hung or dead:
                 rows.append(ComponentHealth(
                     component.name, STALE, reason, component.restarts))
@@ -346,6 +447,32 @@ class Supervisor:
                     component.name, HEALTHY, "", component.restarts))
         return rows
 
+    def restart_report(self) -> list[dict]:
+        """Per-component restart/storm bookkeeping for /debug/stores
+        and doctor --stores (ISSUE 15): which threads the watchdog has
+        respawned, why, and whether any are storm-latched right now."""
+        now = self._clock()
+        with self._lock:
+            components = list(self._components.values())
+        out: list[dict] = []
+        for component in components:
+            row: dict = {
+                "component": component.name,
+                "restarts": component.restarts,
+                "storms": component.storms,
+                "storm_latched": now < component.storm_until,
+            }
+            if component.last_reason:
+                row["last_reason"] = component.last_reason
+            if component.last_restart_at is not None:
+                row["last_restart_ago_seconds"] = round(
+                    max(0.0, now - component.last_restart_at), 1)
+            if now < component.storm_until:
+                row["storm_resumes_in_seconds"] = round(
+                    component.storm_until - now, 1)
+            out.append(row)
+        return out
+
     def health_report(self) -> Sequence[tuple[str, str, str]]:
         """(name, state, reason) rows for MetricsServer's /healthz body;
         breakers that belong to no registered component get their own
@@ -368,6 +495,8 @@ class Supervisor:
         """Fold kts_* self-metrics into a SnapshotBuilder (called from
         the poll loop's snapshot build, like RenderStats.contribute)."""
         breakers = self.breakers()
+        with self._lock:
+            storms = {c.name: c.storms for c in self._components.values()}
         for row in self.health(breakers):
             labels = (("component", row.name),)
             builder.add(schema.COMPONENT_HEALTHY,
@@ -376,6 +505,8 @@ class Supervisor:
             # a burst if the series first appears already at N.
             builder.add(schema.COMPONENT_RESTARTS, float(row.restarts),
                         labels)
+            builder.add(schema.THREAD_RESTART_STORMS,
+                        float(storms.get(row.name, 0)), labels)
         for name, breaker in sorted(breakers.items()):
             labels = (("component", name),)
             builder.add(schema.BREAKER_STATE, breaker.state_value(), labels)

@@ -47,15 +47,45 @@ future, never corrupt either**:
   payload format is from the future is quarantined whole (renamed to
   ``<seg>.skew``, bytes intact, outside the ring's accounting) and
   recovery continues with the rest of the ring.
+
+Local fault survival (ISSUE 15): the agent observes exactly the host
+pathologies — full disks, I/O errors, read-only remounts, fd
+exhaustion — it must itself survive, so every disk-backed store here
+carries a :class:`StoreHealth` durability state machine. A local
+resource fault is a *counted, journaled, auto-recovering degradation*,
+never a crash and never a silent stop:
+
+- **ENOSPC** sheds the OLDEST segment to reclaim space, then enters
+  ``degraded(disk_full)``: telemetry continues in-memory, every record
+  that lost durability is counted (``kts_store_lost_records_total``).
+- **EIO** quarantines the bad tail segment aside (``<seg>.eioq``) and
+  re-opens a fresh one; a second failure degrades the store.
+- **EROFS** (and permission faults) disable durability with ONE
+  journal event — memory-only until the disk returns.
+- Every degraded state **probe-recovers automatically**: the next
+  durable op after the probe interval is attempted for real, and on
+  success the store re-arms durability (journaled). The monotone-
+  counter and exactly-once guarantees survive the degraded window —
+  checkpoints simply persist less often (in-memory state never
+  resets), and the rings' read cursors still commit.
+
+Faults export as ``kts_store_state{store}`` /
+``kts_disk_faults_total{store,errno}`` /
+``kts_store_lost_records_total{store}`` (module registry, the
+quarantine-counts pattern), surface at ``/debug/stores`` and in
+``doctor --stores``, and log once per (store, errno) EPISODE — a full
+disk is one warning, not one per tick.
 """
 
 from __future__ import annotations
 
+import errno as errno_mod
 import json
 import logging
 import os
 import struct
 import threading
+import time
 import zlib
 
 log = logging.getLogger(__name__)
@@ -123,6 +153,253 @@ def reset_quarantine_stats() -> None:
         del _quarantine_events[:]
 
 
+# -- per-store durability state machine (ISSUE 15) --------------------------
+
+STORE_HEALTHY = "healthy"
+STORE_DEGRADED = "degraded"
+
+# Numeric export values for kts_store_state{store} (the
+# kts_component_healthy convention: 1 = durable, 0 = degraded).
+STORE_STATE_VALUES = {STORE_HEALTHY: 1.0, STORE_DEGRADED: 0.0}
+
+# errno -> degradation reason. Anything else is "io_fault" — still a
+# counted, probed degradation, just without a specialized recovery move.
+_FAULT_REASONS = {
+    errno_mod.ENOSPC: "disk_full",
+    errno_mod.EDQUOT: "disk_full",
+    errno_mod.EIO: "io_error",
+    errno_mod.EROFS: "read_only",
+    errno_mod.EACCES: "read_only",
+    errno_mod.EPERM: "read_only",
+    errno_mod.EMFILE: "fd_exhausted",
+    errno_mod.ENFILE: "fd_exhausted",
+    # Kernel resource exhaustion on the accept path (socket buffers /
+    # memory) — same operator fix class as fd exhaustion (raise the
+    # budget, find the leak), and the accept fence fences all four.
+    errno_mod.ENOBUFS: "fd_exhausted",
+    errno_mod.ENOMEM: "fd_exhausted",
+}
+
+# How long a degraded store waits before the next durable op is
+# attempted for real (the attempt IS the recovery probe). Short enough
+# that a cleared fault re-arms within seconds; long enough that a full
+# disk isn't re-stat'd on every 1 Hz tick. Sims/tests lower it via
+# set_probe_interval().
+DEFAULT_PROBE_INTERVAL = 5.0
+
+
+def classify_oserror(exc: BaseException) -> tuple[str, str]:
+    """(reason, errno name) for one OSError — the single errno
+    taxonomy every store and the accept-loop fence share, so
+    kts_disk_faults_total{errno} is spelled identically everywhere."""
+    err = getattr(exc, "errno", None)
+    name = errno_mod.errorcode.get(err, "E_UNKNOWN") if err else "E_UNKNOWN"
+    return _FAULT_REASONS.get(err, "io_fault"), name
+
+
+class StoreHealth:
+    """Durability state machine for one disk-backed store.
+
+    Two states: ``healthy`` (durable ops go to disk) and ``degraded``
+    (a local resource fault; ops are skipped except for a periodic
+    probe, telemetry continues in-memory, loss is counted). Thread-safe
+    — checkpoint writers, ring appends and HTTP status readers all
+    touch it. Transitions (not repeats) log and journal: one episode of
+    a full disk is one warning + one ``disk_fault`` event, and the
+    recovery is one ``store_recovered`` event."""
+
+    def __init__(self, store: str, *,
+                 clock=time.monotonic,
+                 probe_interval: float | None = None) -> None:
+        self.store = store
+        self._clock = clock
+        # Resolved at construction time (not def time) so a sim's
+        # set_probe_interval() applies to stores created after it too.
+        self.probe_interval = (DEFAULT_PROBE_INTERVAL
+                               if probe_interval is None
+                               else probe_interval)
+        self._lock = threading.Lock()
+        self.state = STORE_HEALTHY
+        self.reason = ""
+        self.errno_name = ""
+        self.last_error = ""
+        self.fault_counts: dict[str, int] = {}  # errno name -> faults
+        self.lost_records = 0   # records that lost durability (counted!)
+        self.episodes = 0       # healthy -> degraded transitions
+        self.recoveries = 0     # degraded -> healthy transitions
+        self.degraded_since: float | None = None
+        self._probe_at = 0.0
+
+    # -- fault/recovery edges -------------------------------------------------
+
+    def record_fault(self, exc: BaseException, *, lost: int = 0) -> str:
+        """Count one OSError against this store and (if not already)
+        enter the degraded state. Returns the classified reason so the
+        caller can pick its recovery move (shed / quarantine / stop).
+        Logs + journals on the EPISODE edge only — a new errno class
+        mid-episode re-journals (the fault changed shape), a repeat of
+        the same one doesn't."""
+        reason, name = classify_oserror(exc)
+        with self._lock:
+            transition = (self.state != STORE_DEGRADED
+                          or name != self.errno_name)
+            if self.state != STORE_DEGRADED:
+                self.episodes += 1
+                self.degraded_since = self._clock()
+            self.state = STORE_DEGRADED
+            self.reason = reason
+            self.errno_name = name
+            self.last_error = str(exc)
+            self.fault_counts[name] = self.fault_counts.get(name, 0) + 1
+            self.lost_records += lost
+            self._probe_at = self._clock() + self.probe_interval
+        if transition:
+            log.warning(
+                "store %s degraded (%s, %s): %s — continuing in-memory, "
+                "loss counted in kts_store_lost_records_total; durable "
+                "ops re-probe every %.0fs and re-arm when the disk "
+                "returns", self.store, reason, name, exc,
+                self.probe_interval)
+            _journal_event(
+                "disk_fault",
+                f"store {self.store} degraded ({reason}, {name}): {exc}",
+                store=self.store, reason=reason, errno=name)
+        return reason
+
+    def record_lost(self, n: int = 1) -> None:
+        """Count records that lost durability without a fresh OSError
+        (memory-only appends while degraded, shed-to-reclaim evictions)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.lost_records += n
+
+    def ok(self) -> None:
+        """A durable op succeeded: re-arm durability if degraded."""
+        with self._lock:
+            if self.state == STORE_HEALTHY:
+                return
+            self.state = STORE_HEALTHY
+            reason, name = self.reason, self.errno_name
+            self.reason = ""
+            self.errno_name = ""
+            self.degraded_since = None
+            self.recoveries += 1
+            self._probe_at = 0.0
+        log.warning("store %s recovered: durability re-armed after %s "
+                    "(%s)", self.store, reason, name)
+        _journal_event(
+            "store_recovered",
+            f"store {self.store} recovered from {reason} ({name}): "
+            f"durability re-armed",
+            store=self.store, reason=reason, errno=name)
+
+    def allow_io(self) -> bool:
+        """Should a durable op be ATTEMPTED right now? Always while
+        healthy; while degraded only once per probe interval — that
+        attempt is the recovery probe, and its success calls
+        :meth:`ok`. A False return means the caller stays on its
+        in-memory path (and counts the loss where records are at
+        stake)."""
+        with self._lock:
+            if self.state == STORE_HEALTHY:
+                return True
+            now = self._clock()
+            if now >= self._probe_at:
+                self._probe_at = now + self.probe_interval
+                return True
+            return False
+
+    # -- read side ------------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self.state,
+                "reason": self.reason,
+                "errno": self.errno_name,
+                "last_error": self.last_error,
+                "fault_counts": dict(self.fault_counts),
+                "lost_records": self.lost_records,
+                "episodes": self.episodes,
+                "recoveries": self.recoveries,
+            }
+            if self.degraded_since is not None:
+                out["degraded_for_seconds"] = round(
+                    max(0.0, self._clock() - self.degraded_since), 3)
+            return out
+
+
+# Module registry: one StoreHealth per store label, shared by every WAL
+# user so the daemon/hub export kts_store_* without per-subsystem
+# plumbing (the quarantine_counts pattern). The journal hook is set
+# once by whoever owns the process's Tracer.
+_store_lock = threading.Lock()
+_stores: dict[str, StoreHealth] = {}
+_journal_tracers: list = []
+
+
+def store_health(store: str) -> StoreHealth:
+    """Get-or-create the durability state machine for one store label
+    ('energy', 'ingest', 'spill', 'remote-write shard 0', ...)."""
+    with _store_lock:
+        health = _stores.get(store)
+        if health is None:
+            health = _stores[store] = StoreHealth(store)
+        return health
+
+
+def store_report() -> dict[str, dict]:
+    """store label -> status dict for /debug/stores and doctor
+    --stores."""
+    with _store_lock:
+        stores = list(_stores.items())
+    return {store: health.status() for store, health in stores}
+
+
+def set_journal(tracer) -> None:
+    """Wire a flight recorder: disk_fault / store_recovered events
+    land in the shared journal (daemon and hub call this at
+    construction). SUBSCRIBES rather than replaces — an in-process
+    daemon+hub pair (sims, tests) each keep their journal feed; in
+    production there is one tracer per process either way. None
+    detaches everything (tests)."""
+    with _store_lock:
+        if tracer is None:
+            del _journal_tracers[:]
+        elif tracer not in _journal_tracers:
+            _journal_tracers.append(tracer)
+
+
+def _journal_event(kind: str, detail: str, **attrs) -> None:
+    with _store_lock:
+        tracers = list(_journal_tracers)
+    for tracer in tracers:
+        try:
+            tracer.event(kind, detail, **attrs)
+        except Exception:  # noqa: BLE001 - telemetry about telemetry
+            log.debug("store journal event failed", exc_info=True)
+
+
+def set_probe_interval(seconds: float) -> None:
+    """Adjust the degraded-probe cadence for every store, existing and
+    future (sims/tests; production keeps the default). Pending probe
+    deadlines reset so a SHORTER interval applies immediately."""
+    global DEFAULT_PROBE_INTERVAL
+    DEFAULT_PROBE_INTERVAL = seconds
+    with _store_lock:
+        for health in _stores.values():
+            health.probe_interval = seconds
+            health._probe_at = 0.0
+
+
+def reset_store_stats() -> None:
+    """Test hook: the registry is process-global, and suites assert
+    exact counts/states."""
+    with _store_lock:
+        _stores.clear()
+
+
 def _quarantine_aside(path: str, version, *, label: str,
                       base: str = "") -> str | None:
     """Move a future-format file byte-identical aside (refuse, don't
@@ -159,20 +436,35 @@ def _quarantine_aside(path: str, version, *, label: str,
 # -- atomic JSON state (the checkpoint half) --------------------------------
 
 def write_state(path: str, state: dict, *, label: str = "state",
-                version_key: str = "version") -> bool:
+                version_key: str = "version",
+                health: StoreHealth | None = None) -> bool:
     """Write-ahead persist of one JSON state dict: full state to
     ``<path>.wal``, fsync, atomic rename over ``<path>``. Returns False
-    (with a warning) on OSError — callers keep their dirty flag set and
-    retry on their own cadence.
+    on OSError — callers keep their dirty flag set and retry on their
+    own cadence.
 
     Every state dict MUST stamp its format version (ISSUE 14): an
     unstamped write raises — readers on other builds have no other way
     to decide tolerate-vs-quarantine, and the check_wal_versions lint
-    enforces the same contract statically."""
+    enforces the same contract statically.
+
+    Durability faults (ISSUE 15) route through the store's
+    :class:`StoreHealth` (``health``, defaulting to the registry entry
+    for ``label``): an ENOSPC/EIO/EROFS here degrades the store — one
+    warning per episode, not one per cadence — and while degraded the
+    disk is only re-touched once per probe interval (the skip returns
+    False exactly like a failed write, so every caller's dirty-flag
+    retry loop doubles as the probe cadence). Checkpoint state lives
+    in memory and is rewritten whole on the next success, so a
+    degraded window defers persistence without losing records."""
     if version_key not in state:
         raise ValueError(
             f"{label} checkpoint state has no {version_key!r} stamp — "
             f"every wal.py writer must version its format (ISSUE 14)")
+    if health is None:
+        health = store_health(label)
+    if not health.allow_io():
+        return False  # degraded: stay off the disk until the probe window
     wal = path + ".wal"
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -182,8 +474,9 @@ def write_state(path: str, state: dict, *, label: str = "state",
             os.fsync(handle.fileno())
         os.replace(wal, path)
     except OSError as exc:
-        log.warning("%s checkpoint write failed: %s", label, exc)
+        health.record_fault(exc)
         return False
+    health.ok()
     return True
 
 
@@ -293,6 +586,22 @@ class SegmentRing:
         # being fed to a decoder that predates it. Headerless segments
         # from pre-versioning builds read as payload v1.
         self._format_version = max(1, int(format_version))
+        # Durability state machine (ISSUE 15): every disk fault in this
+        # ring routes through here — counted, journaled, probed. The
+        # registry entry is shared with write_state cursor saves so one
+        # store has ONE state.
+        self.health = store_health(label)
+        # Records shed to reclaim space inside the current append()
+        # (ENOSPC recovery move) — folded into append's return value so
+        # the caller journals the loss exactly like a byte-bound evict.
+        self._shed_in_append = 0
+        # True when the CURRENT tail segment holds memory-only records
+        # the disk file doesn't (a degraded-window append): the next
+        # durable write must roll to a FRESH segment first, or the
+        # disk file's record indexes desynchronize from memory and a
+        # post-crash recovery maps the drain cursor onto the wrong
+        # records (skipping a durable, undelivered one uncounted).
+        self._tail_gap = False
         self._lock = threading.Lock()
         # seg seq -> [(ts, payload), ...] for every live segment; the
         # tail segment additionally has an open append handle. Records
@@ -320,7 +629,14 @@ class SegmentRing:
         self.skew_segments = 0
         self._headered: set[int] = set()        # segments with KTSG
         self._payload_versions: dict[int, int] = {}
-        os.makedirs(directory, exist_ok=True)
+        # Satellite of ISSUE 15 (the bare-OSError audit): construction
+        # runs on pool workers and handler threads — an unwritable/
+        # read-only directory must degrade the store, never propagate
+        # and kill the constructing thread.
+        try:
+            os.makedirs(directory, exist_ok=True)
+        except OSError as exc:
+            self.health.record_fault(exc)
         self._recover()
 
     # -- recovery -------------------------------------------------------------
@@ -380,7 +696,14 @@ class SegmentRing:
 
     def _recover(self) -> None:
         seqs = []
-        for name in os.listdir(self._dir):
+        try:
+            names = os.listdir(self._dir)
+        except OSError as exc:
+            # Same audit class as the ctor makedirs: an EIO/EMFILE here
+            # must start the ring empty + degraded, not kill the thread.
+            self.health.record_fault(exc)
+            names = []
+        for name in names:
             if name.startswith(self._prefix + "-") and \
                     name.endswith(_SEG_SUFFIX + ".wal"):
                 # Orphaned rewrite temp: a crash between a torn-tail
@@ -499,39 +822,179 @@ class SegmentRing:
                 os.fsync(handle.fileno())
             os.replace(tmp, path)
         except OSError as exc:
-            log.warning("%s: segment %d rewrite failed: %s",
-                        self._label, seq, exc)
+            # Recovery-time rewrite failure: the torn bytes stay on
+            # disk (re-truncated on the NEXT recovery); fault counted,
+            # never raised off the recovering thread.
+            self.health.record_fault(exc)
 
     # -- write side -----------------------------------------------------------
 
     def append(self, ts: float, payload: bytes) -> int:
-        """Durably append one record; returns how many OLDEST records
-        were evicted to stay under the byte bound (0 almost always —
-        the caller counts and journals any loss)."""
+        """Append one record — durably while the store is healthy,
+        memory-only (durability loss counted) while it is degraded.
+        Returns how many OLDEST records were dropped to stay under the
+        byte bound or to reclaim a full disk (0 almost always — the
+        caller counts and journals any loss).
+
+        Fault containment (ISSUE 15): an ENOSPC sheds the oldest
+        segment and retries once on a fresh tail; an EIO quarantines
+        the sick tail segment aside and retries once on a fresh one;
+        EROFS/EMFILE/anything else degrades immediately. Every path
+        lands the record in memory (the queue keeps serving) and every
+        record that missed the disk is counted lost — a crash during
+        the window loses exactly the accounted set, nothing silent."""
         size = _RECORD.size + len(payload)
         with self._lock:
-            if self._tail_handle is None or \
-                    self._tail_size + size > self._segment_bytes:
-                self._roll_tail()
-            handle = self._tail_handle
-            if handle is not None:
-                try:
-                    handle.write(_RECORD.pack(ts, len(payload),
-                                              zlib.crc32(payload)))
-                    handle.write(payload)
-                    handle.flush()
-                    if self._fsync:
-                        os.fsync(handle.fileno())
-                except OSError as exc:
-                    log.warning("%s: append failed: %s", self._label, exc)
-                    # The in-memory copy still queues it (disk lost the
-                    # crash copy, not the record).
+            self._shed_in_append = 0
+            wrote = False
+            if self.health.allow_io():
+                episodes_before = self.health.episodes
+                if self._tail_gap or self._tail_handle is None or \
+                        self._tail_size + size > self._segment_bytes:
+                    # _tail_gap: the open tail carries memory-only
+                    # records — re-align disk and memory on a fresh
+                    # segment before writing durably again.
+                    self._roll_tail()
+                wrote = self._write_record(ts, payload, episodes_before)
+                if wrote:
+                    self.health.ok()
+                else:
+                    self.health.record_lost(1)
+            else:
+                # Degraded, between probes: stay off the disk entirely.
+                self.health.record_lost(1)
+            if not wrote:
+                self._tail_gap = True
             self._segments.setdefault(self._tail_seq, []).append(
                 (ts, payload))
             self._tail_size += size
             self._sizes[self._tail_seq] = self._tail_size
             self.appended_records += 1
-            return self._evict_over_bound()
+            return self._evict_over_bound() + self._shed_in_append
+
+    def _write_framed(self, handle, ts: float, payload: bytes) -> None:
+        handle.write(_RECORD.pack(ts, len(payload), zlib.crc32(payload)))
+        handle.write(payload)
+        handle.flush()
+        if self._fsync:
+            os.fsync(handle.fileno())
+
+    def _write_record(self, ts: float, payload: bytes,
+                      episodes_before: int) -> bool:
+        """One durable write attempt with the per-errno recovery move
+        applied and ONE retry on a fresh tail. True iff the record is
+        on disk. Never raises — this runs on publisher/pool/handler
+        threads (the ISSUE 15 satellite's bug class was exactly an
+        fsync failure propagating off one). ``episodes_before`` gates
+        the ENOSPC shed to once per EPISODE: if shedding a segment
+        didn't clear the disk, shedding more of our own data won't —
+        the WAL is rarely the disk's hog."""
+        if self._tail_handle is not None:
+            try:
+                self._write_framed(self._tail_handle, ts, payload)
+                return True
+            except OSError as exc:
+                reason = self.health.record_fault(exc)
+        else:
+            # The roll itself failed (fault recorded there — e.g. the
+            # KTSG header write drew the ENOSPC): apply the same
+            # per-reason recovery move before giving up.
+            reason = self.health.reason or "io_fault"
+        new_episode = self.health.episodes > episodes_before
+        if reason == "disk_full" and new_episode:
+            # Shed oldest-first to reclaim, then retry once: a spool
+            # that filled its own disk trades its oldest records for
+            # the ability to keep journaling the newest.
+            self._shed_oldest()
+        elif reason == "io_error" and new_episode:
+            # Quarantine the sick segment; a fresh file on the same
+            # disk often survives a localized bad block. Once per
+            # EPISODE like the shed: re-quarantining on every recovery
+            # probe of a persistently sick disk would re-count the
+            # already-counted in-memory tail as lost and grow a new
+            # .eioq file per probe.
+            self._quarantine_tail()
+        else:
+            return False  # read-only / fd exhaustion / ongoing episode
+        self._roll_tail()
+        if self._tail_handle is None:
+            return False
+        try:
+            self._write_framed(self._tail_handle, ts, payload)
+            return True
+        except OSError as exc:
+            self.health.record_fault(exc)
+            return False
+
+    def _shed_oldest(self) -> None:
+        """ENOSPC reclaim: drop the OLDEST live segment that actually
+        HAS a disk file — disk AND memory (the memory copy of records
+        whose loss we are about to account must not resurrect them).
+        Memory-only segments (appended during the degraded window) are
+        never shed: unlinking nothing reclaims nothing, and their
+        records are the telemetry-continues-in-memory promise. Counted
+        in evicted_records AND the append's return value (the caller
+        journals it) AND the store's lost_records."""
+        live = self._live_segments()
+        for victim in live:
+            if victim == self._tail_seq and len(live) <= 1:
+                return  # never shed the only (open) tail
+            if not os.path.exists(self._seg_path(victim)):
+                continue  # memory-only: nothing on disk to reclaim
+            if victim == self._tail_seq:
+                return  # only the open tail is disk-backed: keep it
+            records = self._segments.pop(victim, [])
+            self._sizes.pop(victim, None)
+            self._headered.discard(victim)
+            self._payload_versions.pop(victim, None)
+            start = self._cursor_idx if victim == self._cursor_seg else 0
+            lost = max(0, len(records) - start)
+            if self._cursor_seg <= victim:
+                self._cursor_seg = victim + 1
+                self._cursor_idx = 0
+                self._cursor_dirty = True
+            try:
+                os.unlink(self._seg_path(victim))
+            except OSError:
+                pass
+            if lost:
+                self.evicted_records += lost
+                self._shed_in_append += lost
+                self.health.record_lost(lost)
+            return
+
+    def _quarantine_tail(self) -> None:
+        """EIO containment: close the tail handle and park the
+        segment's FILE aside (``<seg>.eioq``, first free slot — the
+        skew-quarantine discipline) so the next roll opens a fresh
+        file. The in-memory records stay drainable; their durable
+        copies just went aside, so their loss is counted."""
+        if self._tail_handle is not None:
+            try:
+                self._tail_handle.close()
+            except OSError:
+                pass
+            self._tail_handle = None
+        path = self._seg_path(self._tail_seq)
+        base = path + ".eioq"
+        target = base
+        for attempt in range(1, 100):
+            if not os.path.exists(target):
+                break
+            target = f"{base}.{attempt}"
+        try:
+            os.replace(path, target)
+        except OSError:
+            # Can't even rename it: leave it; recovery's CRC walk will
+            # salvage the intact prefix either way.
+            return
+        pending = self._segments.get(self._tail_seq, ())
+        start = (self._cursor_idx if self._tail_seq == self._cursor_seg
+                 else 0)
+        self.health.record_lost(max(0, len(pending) - start))
+        log.warning("%s: tail segment %d quarantined after EIO (%s); "
+                    "re-opening a fresh segment", self._label,
+                    self._tail_seq, target)
 
     def _roll_tail(self) -> None:
         if self._tail_handle is not None:
@@ -539,28 +1002,57 @@ class SegmentRing:
                 self._tail_handle.close()
             except OSError:
                 pass
-        self._tail_seq += 1
-        self._tail_size = self._sizes.get(self._tail_seq, 0)
-        self._segments.setdefault(self._tail_seq, [])
-        try:
-            self._tail_handle = open(self._seg_path(self._tail_seq), "ab")
-            if self._tail_handle.tell() == 0:
+            self._tail_handle = None
+        self._tail_gap = False  # a fresh segment re-aligns disk/memory
+        # Bounded seq probe: a recovery whose listdir faulted left this
+        # ring blind to pre-existing segment files — appending into one
+        # would bury new-format records behind stale ones under a
+        # header the ring never accounted. Skip PAST any non-empty
+        # file, leaving its bytes untouched for the next (seeing)
+        # recovery to replay.
+        for _ in range(10_000):
+            self._tail_seq += 1
+            try:
+                handle = open(self._seg_path(self._tail_seq), "ab")
+            except OSError as exc:
+                # Counted + episode-logged by the state machine (a
+                # full/read-only disk must not log once per roll
+                # attempt).
+                self.health.record_fault(exc)
+                self._tail_size = self._sizes.get(self._tail_seq, 0)
+                self._segments.setdefault(self._tail_seq, [])
+                return
+            try:
+                if handle.tell() != 0:
+                    handle.close()
+                    continue  # unknown pre-existing file: never append
                 # Fresh segment: stamp the KTSG header (ISSUE 14) so
                 # readers on other builds can tell this segment's
                 # container + payload format apart from both older
                 # headerless segments and newer ones they must park.
-                self._tail_handle.write(
+                handle.write(
                     _SEG_MAGIC + bytes((SEGMENT_CONTAINER_VERSION,
                                         self._format_version)))
-                self._tail_handle.flush()
-                self._tail_size += 6
-                self._headered.add(self._tail_seq)
-                self._payload_versions[self._tail_seq] = \
-                    self._format_version
-        except OSError as exc:
-            log.warning("%s: cannot open segment %d: %s",
-                        self._label, self._tail_seq, exc)
-            self._tail_handle = None
+                handle.flush()
+            except OSError as exc:
+                try:
+                    handle.close()
+                except OSError:
+                    pass
+                self.health.record_fault(exc)
+                self._tail_size = self._sizes.get(self._tail_seq, 0)
+                self._segments.setdefault(self._tail_seq, [])
+                return
+            self._tail_handle = handle
+            self._tail_size = 6
+            self._headered.add(self._tail_seq)
+            self._payload_versions[self._tail_seq] = self._format_version
+            self._segments.setdefault(self._tail_seq, [])
+            return
+        log.warning("%s: no free segment sequence found (10k probed)",
+                    self._label)
+        self._tail_size = self._sizes.get(self._tail_seq, 0)
+        self._segments.setdefault(self._tail_seq, [])
 
     def _evict_over_bound(self) -> int:
         evicted = 0
@@ -662,8 +1154,11 @@ class SegmentRing:
                      "segment": self._cursor_seg,
                      "record": self._cursor_idx}
             self._cursor_dirty = False
+        # The cursor shares the RING's health: a cursor-write fault is
+        # this store degrading, not a separate "spill cursor" store.
         return write_state(self._cursor_path(), state,
-                           label=self._label + " cursor")
+                           label=self._label + " cursor",
+                           health=self.health)
 
     # -- introspection --------------------------------------------------------
 
@@ -718,6 +1213,10 @@ class SegmentRing:
                 "legacy_segments": sum(
                     1 for seq in self._segments
                     if seq not in self._headered),
+                # Durability state machine (ISSUE 15): the store's
+                # current state + fault/loss accounting, for
+                # /debug/stores and doctor --stores.
+                "health": self.health.status(),
             }
 
     def close(self) -> None:

@@ -31,6 +31,7 @@ import urllib.request
 from typing import Callable
 
 from .resilience import BackoffPolicy
+from .supervisor import spawn
 
 log = logging.getLogger(__name__)
 
@@ -81,9 +82,7 @@ class PeriodicRefresher:
             self._stop_event.wait(wait)
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, name=self._thread_name, daemon=True
-        )
+        self._thread = spawn(self._run, name=self._thread_name)
         self._thread.start()
 
     def thread_alive(self) -> bool:
@@ -160,6 +159,12 @@ class PublishFollower:
         self.pushes_total = 0
         self.failures_total = 0
         self.dropped_total = 0
+        # Optional supervisor heartbeat (ISSUE 15 coverage sweep): the
+        # owner sets this to Supervisor.beater(<component>) so a wedge
+        # INSIDE push_once (a hung socket no timeout covers) is
+        # detected as a hang, not just thread death. Called once per
+        # loop iteration, between pushes.
+        self.heartbeat: Callable[[], None] | None = None
 
     def push_once(self) -> None:
         raise NotImplementedError
@@ -175,6 +180,18 @@ class PublishFollower:
             logging.getLogger(__name__).exception(
                 "%s push crashed; continuing", self._thread_name)
 
+    def superseded(self) -> bool:
+        """True when the calling thread is no longer this follower's
+        live thread — a respawn replaced it while it was wedged
+        (ISSUE 15). A superseded thread must retire WITHOUT touching
+        shared send state again: two loops draining one at-least-once
+        cursor (spill queue, remote-write WAL) would race peek/commit
+        and skip records. Never-started followers (tests/bench drive
+        push_once inline) have no thread and are never superseded."""
+        thread = self._thread
+        return (thread is not None
+                and thread is not threading.current_thread())
+
     def run_forever(self) -> None:
         import time
 
@@ -182,6 +199,12 @@ class PublishFollower:
         last_push = float("-inf")
         dirty = False
         while not self._stop_event.is_set():
+            if self.superseded():
+                log.info("%s thread superseded by respawn; retiring",
+                         self._thread_name)
+                return
+            if self.heartbeat is not None:
+                self.heartbeat()
             if self._registry.wait_for_publish(generation, timeout=0.2):
                 generation = self._registry.generation
                 dirty = True
@@ -190,18 +213,26 @@ class PublishFollower:
                 self._guarded_push()
                 last_push = time.monotonic()
                 dirty = False
-        if dirty:
+        if dirty and not self.superseded():
             self._guarded_push()
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self.run_forever, name=self._thread_name, daemon=True
-        )
+        """Start the push thread (idempotent: a live thread is left
+        alone — double-starting would double-drain)."""
+        if self.thread_alive():
+            return
+        self.respawn()
+
+    def respawn(self) -> None:
+        """The supervisor's crash-only restart closure: ALWAYS spawns
+        a fresh thread — a hung one (the hang the heartbeat detected)
+        is abandoned and retires itself at its next superseded() check
+        instead of being waited on."""
+        self._thread = spawn(self.run_forever, name=self._thread_name)
         self._thread.start()
 
     def thread_alive(self) -> bool:
-        """Liveness probe for the supervisor; start() doubles as the
-        crash-only restart (fresh thread, counters retained)."""
+        """Liveness probe for the supervisor."""
         return self._thread is not None and self._thread.is_alive()
 
     def stop(self) -> None:
@@ -220,11 +251,7 @@ class DaemonSamplerPool:
         # completes (ThreadPoolExecutor's shutdown lock, re-established).
         self._lock = threading.Lock()
         self._threads = [
-            threading.Thread(
-                target=self._worker,
-                name=f"{thread_name_prefix}-{i}",
-                daemon=True,
-            )
+            spawn(self._worker, name=f"{thread_name_prefix}-{i}")
             for i in range(max_workers)
         ]
         for thread in self._threads:

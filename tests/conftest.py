@@ -36,3 +36,19 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except ImportError:  # pragma: no cover - jax is baked into this image
     pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_store_health():
+    """The per-store durability registry (wal.StoreHealth, ISSUE 15) is
+    process-global like the quarantine counts: a fault-injection test
+    degrading 'spill' must not leave the NEXT test's spill queue
+    probe-gated off the disk."""
+    from kube_gpu_stats_tpu import wal
+
+    wal.reset_store_stats()
+    yield
+    wal.reset_store_stats()
+    wal.set_journal(None)

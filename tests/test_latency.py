@@ -288,6 +288,29 @@ def test_partition_drain_throughput_and_spool_cost():
     assert best["spill_dropped"] == 0, best
 
 
+def test_degraded_store_overhead_under_10pct_of_tick_budget():
+    """ISSUE 15 acceptance pin: while the disk-backed stores are
+    DEGRADED (full disk latched, probes far away), the per-tick store
+    ops must take the gated in-memory path — under 10% of the 50 ms
+    tick budget, and in practice cheaper than the healthy fsync path.
+    Guards a regression where degraded mode grows per-op retries,
+    probing or logging. Best of 3 rounds (timeit.repeat style) so a
+    co-tenant noise burst can't fail the pin."""
+    from kube_gpu_stats_tpu.bench import measure_degraded_overhead
+
+    best = None
+    for _ in range(3):
+        result = measure_degraded_overhead(ticks=100)
+        assert result is not None
+        if best is None or result["degraded_overhead_pct"] < \
+                best["degraded_overhead_pct"]:
+            best = result
+    assert best["degraded_overhead_pct"] < 10.0, best
+    # Every degraded-window spool is in the loss ledger — the exact
+    # accounting the localfault sim asserts end to end.
+    assert best["degraded_lost_counted"] == 100, best
+
+
 def test_render_cost_bounded_at_32_chip_full_label_scale():
     """Round-1 verdict item 7 (done round 3): series growth must not
     silently eat the scrape budget. Render a 32-chip snapshot with the

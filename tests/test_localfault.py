@@ -1,0 +1,653 @@
+"""Local fault survival (ISSUE 15): the per-store durability state
+machine, faultfs-driven store degradation/recovery, the supervisor's
+restart-storm latch, the supervised-spawn helper, /debug/stores, and
+the accept-loop fd-exhaustion fence."""
+
+from __future__ import annotations
+
+import errno
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu import wal
+from kube_gpu_stats_tpu.spillq import SpillQueue
+from kube_gpu_stats_tpu.testing.faultfs import FaultFS, fence_accepts
+from kube_gpu_stats_tpu.wal import SegmentRing
+
+
+class FakeTracer:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, detail="", **attrs):
+        self.events.append({"kind": kind, "detail": detail, **attrs})
+
+
+@pytest.fixture
+def fast_probe():
+    """Every degraded op probes immediately (tests can't wait 5 s)."""
+    wal.set_probe_interval(0.0)
+    yield
+    wal.set_probe_interval(5.0)
+
+
+# -- StoreHealth unit ---------------------------------------------------------
+
+def test_store_health_classifies_and_transitions():
+    health = wal.StoreHealth("t", clock=lambda: 0.0)
+    assert health.state == wal.STORE_HEALTHY
+    reason = health.record_fault(OSError(errno.ENOSPC, "full"), lost=2)
+    assert reason == "disk_full"
+    assert health.state == wal.STORE_DEGRADED
+    assert health.fault_counts == {"ENOSPC": 1}
+    assert health.lost_records == 2
+    assert health.episodes == 1
+    # Same errno again: counted, but still ONE episode.
+    health.record_fault(OSError(errno.ENOSPC, "full"))
+    assert health.fault_counts == {"ENOSPC": 2}
+    assert health.episodes == 1
+    health.ok()
+    assert health.state == wal.STORE_HEALTHY
+    assert health.recoveries == 1
+
+
+def test_store_health_probe_gating_uses_the_interval():
+    now = [0.0]
+    health = wal.StoreHealth("t", clock=lambda: now[0], probe_interval=10.0)
+    assert health.allow_io()  # healthy: always
+    health.record_fault(OSError(errno.EROFS, "ro"))
+    assert not health.allow_io()  # inside the probe window
+    now[0] = 10.5
+    assert health.allow_io()   # the probe
+    assert not health.allow_io()  # window re-armed by the probe
+    now[0] = 21.0
+    assert health.allow_io()
+
+
+def test_store_health_logs_once_per_episode(caplog):
+    health = wal.StoreHealth("quiet-store", clock=lambda: 0.0)
+    with caplog.at_level(logging.WARNING):
+        for _ in range(50):
+            health.record_fault(OSError(errno.ENOSPC, "full"))
+    lines = [r for r in caplog.records
+             if "quiet-store degraded" in r.getMessage()]
+    assert len(lines) == 1  # a full disk logs once per EPISODE, not per tick
+    assert health.fault_counts["ENOSPC"] == 50  # the counter carries the rate
+
+
+def test_store_health_journals_fault_and_recovery_edges():
+    tracer = FakeTracer()
+    wal.set_journal(tracer)
+    health = wal.StoreHealth("j", clock=lambda: 0.0)
+    for _ in range(3):
+        health.record_fault(OSError(errno.EIO, "io"))
+    health.ok()
+    kinds = [e["kind"] for e in tracer.events]
+    assert kinds == ["disk_fault", "store_recovered"]
+    assert tracer.events[0]["store"] == "j"
+    assert tracer.events[0]["errno"] == "EIO"
+
+
+def test_classify_oserror_taxonomy():
+    assert wal.classify_oserror(OSError(errno.ENOSPC, "x")) == \
+        ("disk_full", "ENOSPC")
+    assert wal.classify_oserror(OSError(errno.EDQUOT, "x")) == \
+        ("disk_full", "EDQUOT")
+    assert wal.classify_oserror(OSError(errno.EROFS, "x")) == \
+        ("read_only", "EROFS")
+    assert wal.classify_oserror(OSError(errno.EMFILE, "x")) == \
+        ("fd_exhausted", "EMFILE")
+    assert wal.classify_oserror(OSError(errno.ENOENT, "x")) == \
+        ("io_fault", "ENOENT")
+    # The accept fence's whole errno set classifies as fd_exhausted —
+    # the /debug/stores reason must match the runbook's triage table.
+    assert wal.classify_oserror(OSError(errno.ENOBUFS, "x"))[0] == \
+        "fd_exhausted"
+    assert wal.classify_oserror(OSError(errno.ENOMEM, "x"))[0] == \
+        "fd_exhausted"
+
+
+# -- write_state under faults -------------------------------------------------
+
+def test_write_state_fault_degrades_instead_of_raising(tmp_path,
+                                                       fast_probe):
+    path = str(tmp_path / "ck.json")
+    with FaultFS() as fs:
+        fs.inject(str(tmp_path), "enospc", ops=("write", "fsync"))
+        assert not wal.write_state(path, {"version": 1, "seq": 1},
+                                   label="ck-test")
+        health = wal.store_health("ck-test")
+        assert health.state == wal.STORE_DEGRADED
+        assert health.reason == "disk_full"
+        fs.clear()
+        # The fault cleared: the next attempt is the probe and re-arms.
+        assert wal.write_state(path, {"version": 1, "seq": 2},
+                               label="ck-test")
+        assert health.state == wal.STORE_HEALTHY
+    assert wal.load_newest(path, 1, label="ck-test")["seq"] == 2
+
+
+def test_write_state_skips_disk_between_probes(tmp_path):
+    """While degraded, write_state must not even touch the disk until
+    the probe window — the degraded-mode overhead budget rides on it."""
+    path = str(tmp_path / "ck.json")
+    health = wal.store_health("gated")
+    health.probe_interval = 3600.0
+    health.record_fault(OSError(errno.ENOSPC, "full"))
+    opens = []
+    with FaultFS() as fs:
+        rule = fs.inject(str(tmp_path), "enospc", ops=("open",))
+        assert not wal.write_state(path, {"version": 1, "seq": 1},
+                                   label="gated")
+        opens.append(rule.hits)
+    assert opens == [0]  # gated out before any open
+
+
+# -- SegmentRing under faults -------------------------------------------------
+
+def _ring(tmp_path, **kw):
+    kw.setdefault("max_bytes", 1 << 20)
+    kw.setdefault("segment_bytes", 256)
+    kw.setdefault("label", "ring-test")
+    kw.setdefault("format_version", 1)
+    return SegmentRing(str(tmp_path / "ring"), **kw)
+
+
+def test_ring_enospc_goes_memory_only_loss_counted(tmp_path, fast_probe):
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        ring = _ring(tmp_path)
+        ring.append(1.0, b"before")  # healthy baseline
+        fs.inject(str(tmp_path), "enospc",
+                  ops=("open", "write", "fsync"))
+        for i in range(5):
+            ring.append(2.0 + i, b"during-%d" % i)
+        assert ring.health.state == wal.STORE_DEGRADED
+        assert ring.health.reason == "disk_full"
+        # Telemetry continued in-memory: every record still drains.
+        assert ring.records_pending() == 6
+        # Durability loss exactly accounted: every degraded-window
+        # record is in the ledger.
+        assert ring.health.lost_records == 5
+        fs.clear()
+        ring.append(10.0, b"after")  # the probe: disk is back
+        assert ring.health.state == wal.STORE_HEALTHY
+        assert ring.health.recoveries == 1
+        ring.close()
+    # A restart sees exactly the durable set: baseline + post-recovery
+    # (the 5 degraded-window records are the counted loss).
+    recovered = _ring(tmp_path)
+    payloads = []
+    while True:
+        record = recovered.peek()
+        if record is None:
+            break
+        payloads.append(record[1])
+        recovered.commit()
+    assert b"before" in payloads
+    assert b"after" in payloads
+    assert not any(p.startswith(b"during") for p in payloads)
+    recovered.close()
+
+
+def test_ring_eio_quarantines_tail_and_recovers(tmp_path, fast_probe):
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        ring = _ring(tmp_path)
+        ring.append(1.0, b"one")
+        fs.inject(str(tmp_path / "ring"), "eio", ops=("write",), times=1)
+        ring.append(2.0, b"two")  # EIO -> quarantine + fresh-tail retry
+        assert ring.health.fault_counts.get("EIO") == 1
+        # The retry landed durably on a fresh segment: recovered in-line.
+        assert ring.health.state == wal.STORE_HEALTHY
+        quarantined = [name for name in os.listdir(str(tmp_path / "ring"))
+                       if ".eioq" in name]
+        assert quarantined, "sick tail segment parked aside"
+        assert ring.records_pending() == 2  # memory still serves both
+        ring.close()
+
+
+def test_ring_erofs_disables_durability_one_journal_event(tmp_path,
+                                                          fast_probe):
+    tracer = FakeTracer()
+    wal.set_journal(tracer)
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        ring = _ring(tmp_path)
+        ring.append(1.0, b"one")
+        fs.inject(str(tmp_path), "erofs", ops=("open", "write", "fsync"))
+        wal.set_probe_interval(3600.0)
+        for i in range(10):
+            ring.append(2.0 + i, b"x%d" % i)
+        assert ring.health.reason == "read_only"
+        faults = [e for e in tracer.events if e["kind"] == "disk_fault"]
+        assert len(faults) == 1  # ONE event for the whole episode
+        assert ring.records_pending() == 11
+        ring.close()
+
+
+def test_ring_enospc_sheds_oldest_segment_to_reclaim(tmp_path,
+                                                     fast_probe):
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        # Small segments so several exist before the fault.
+        ring = _ring(tmp_path, segment_bytes=64)
+        for i in range(10):
+            ring.append(float(i), b"p" * 40)
+        segments_before = ring.status()["segments"]
+        assert segments_before > 1
+        fs.inject(str(tmp_path), "enospc", ops=("write", "fsync"))
+        dropped = ring.append(99.0, b"p" * 40)
+        # The shed is returned to the caller (journaled like an
+        # eviction) and counted in both loss ledgers.
+        assert dropped > 0
+        assert ring.evicted_records == dropped
+        assert ring.health.lost_records >= dropped
+        assert ring.status()["segments"] < segments_before + 2
+        ring.close()
+
+
+def test_ring_recovery_write_rolls_past_a_gapped_tail(tmp_path,
+                                                      fast_probe):
+    """Review finding: a degraded window leaves memory-only records in
+    the still-open tail segment; the recovery write must land on a
+    FRESH segment, or disk and memory record indexes desynchronize and
+    a post-crash recovery maps the drain cursor onto the wrong records
+    — skipping a durable, undelivered one uncounted."""
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        ring = _ring(tmp_path)
+        ring.append(1.0, b"A")  # durable in the open tail
+        fs.inject(str(tmp_path), "erofs", ops=("write",))
+        ring.append(2.0, b"B")  # write fails, handle open: memory-only
+        assert ring.health.lost_records == 1
+        fs.clear()
+        ring.append(3.0, b"C")  # the probe: MUST roll to a fresh file
+        assert ring.health.state == wal.STORE_HEALTHY
+        # Drain A and B (in-memory continuity), persist the cursor —
+        # the pre-crash state the finding's scenario needs.
+        assert ring.peek()[1] == b"A"
+        ring.commit()
+        assert ring.peek()[1] == b"B"
+        ring.commit()
+        ring.close()
+    # "Crash" + restart: the durable-but-undelivered C must still be
+    # at the cursor (pre-fix, C shared A's file and the clamped cursor
+    # skipped it forever, uncounted).
+    recovered = _ring(tmp_path)
+    record = recovered.peek()
+    assert record is not None and record[1] == b"C"
+    recovered.close()
+
+
+def test_ring_torn_write_truncated_on_recovery(tmp_path):
+    from kube_gpu_stats_tpu.testing.faultfs import TornWrite
+
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        ring = _ring(tmp_path)
+        ring.append(1.0, b"good-record")
+        fs.inject(str(tmp_path), "torn", ops=("write",), times=1)
+        with pytest.raises(TornWrite):
+            # The "crash": half the frame lands, the process dies.
+            ring.append(2.0, b"torn-record-payload")
+    recovered = _ring(tmp_path)
+    assert recovered.torn_records >= 1
+    record = recovered.peek()
+    assert record is not None and record[1] == b"good-record"
+    recovered.close()
+
+
+def test_ring_constructor_survives_unwritable_dir(tmp_path, fast_probe):
+    """The audited bug class (satellite): SegmentRing() runs on pool
+    workers / handler threads — an EROFS from makedirs must degrade,
+    never propagate and kill the constructing thread."""
+    with FaultFS() as fs:
+        fs.inject(str(tmp_path), "erofs", ops=("makedirs", "open",
+                                               "write", "fsync"))
+        ring = SegmentRing(str(tmp_path / "newdir"), max_bytes=1 << 20,
+                           label="ctor-test", format_version=1)
+        assert ring.health.state == wal.STORE_DEGRADED
+        ring.append(1.0, b"x")  # still serves, memory-only
+        assert ring.records_pending() == 1
+
+
+def test_ring_recover_survives_unlistable_dir(tmp_path, fast_probe):
+    os.makedirs(str(tmp_path / "ring"), exist_ok=True)
+    with FaultFS() as fs:
+        fs.inject(str(tmp_path), "eio", ops=("listdir",), times=1)
+        ring = SegmentRing(str(tmp_path / "ring"), max_bytes=1 << 20,
+                           label="recover-test", format_version=1)
+    assert ring.health.fault_counts.get("EIO") == 1
+    ring.close()
+
+
+# -- store adoption: spillq + energy -----------------------------------------
+
+def test_spillq_full_disk_survival_and_exact_accounting(tmp_path,
+                                                        fast_probe):
+    with FaultFS() as fs:
+        fs.watch(str(tmp_path))
+        spill = SpillQueue(str(tmp_path / "spill"), fsync=True)
+        spill.spool(1.0, "body-before")
+        fs.inject(str(tmp_path), "enospc",
+                  ops=("open", "write", "fsync"))
+        for i in range(4):
+            spill.spool(2.0 + i, f"body-during-{i}")
+        status = spill.status()
+        assert status["health"]["state"] == wal.STORE_DEGRADED
+        assert status["depth_frames"] == 5  # nothing silently dropped
+        assert status["health"]["lost_records"] == 4
+        fs.clear()
+        spill.spool(10.0, "body-after")
+        assert spill.status()["health"]["state"] == wal.STORE_HEALTHY
+        # The drain still serves every frame oldest-first.
+        drained = []
+        while True:
+            record = spill.peek()
+            if record is None:
+                break
+            drained.append(record[1])
+            spill.commit()
+        assert drained[0] == "body-before"
+        assert drained[-1] == "body-after"
+        assert len(drained) == 6
+        spill.close()
+
+
+def test_energy_checkpoint_eio_defers_and_counters_stay_monotone(
+        tmp_path, fast_probe):
+    from kube_gpu_stats_tpu.energy import EnergyAccountant
+
+    path = str(tmp_path / "energy.json")
+    acct = EnergyAccountant(checkpoint_path=path, checkpoint_interval=0.0)
+    acct.observe("dev0", "pod-a", "ns", 1.0, 100.0)
+    acct.observe("dev0", "pod-a", "ns", 2.0, 100.0)
+    assert acct.checkpoint(force=True)
+    joules_before = acct._per_pod[("pod-a", "ns")]
+    with FaultFS() as fs:
+        fs.inject(str(tmp_path), "eio", ops=("fsync",))
+        acct.observe("dev0", "pod-a", "ns", 3.0, 100.0)
+        assert not acct.checkpoint(force=True)  # deferred, NOT raised
+        assert wal.store_health("energy").state == wal.STORE_DEGRADED
+        fs.clear()
+        assert acct.checkpoint(force=True)  # probe: re-armed
+        assert wal.store_health("energy").state == wal.STORE_HEALTHY
+    fresh = EnergyAccountant(checkpoint_path=path)
+    assert fresh._per_pod[("pod-a", "ns")] >= joules_before  # monotone
+
+
+def test_store_metrics_contribution():
+    from kube_gpu_stats_tpu import schema
+    from kube_gpu_stats_tpu.registry import (SnapshotBuilder,
+                                             contribute_store_metrics)
+
+    health = wal.store_health("m-test")
+    health.record_fault(OSError(errno.ENOSPC, "full"), lost=3)
+    builder = SnapshotBuilder()
+    contribute_store_metrics(builder)
+    series = {(s.spec.name, tuple(s.labels)): s.value
+              for s in builder.build().series}
+    assert series[(schema.STORE_STATE.name,
+                   (("store", "m-test"),))] == 0.0
+    assert series[(schema.STORE_LOST.name,
+                   (("store", "m-test"),))] == 3.0
+    assert series[(schema.DISK_FAULTS.name,
+                   (("store", "m-test"), ("errno", "ENOSPC")))] == 1.0
+
+
+# -- supervisor: storm latch + spawn -----------------------------------------
+
+def _dying_component(supervisor, clock):
+    from kube_gpu_stats_tpu.resilience import BackoffPolicy
+
+    supervisor.register(
+        "dies", is_alive=lambda: False, restart=lambda: None,
+        backoff=BackoffPolicy(base=1e-9, cap=1e-9, jitter=False))
+
+
+def test_supervisor_latches_restart_storm_and_probes_after_hold():
+    from kube_gpu_stats_tpu.supervisor import DEGRADED, Supervisor
+
+    now = [0.0]
+    supervisor = Supervisor(clock=lambda: now[0])
+    _dying_component(supervisor, now)
+    restarts = 0
+    for _ in range(Supervisor.STORM_THRESHOLD):
+        restarts += len(supervisor.check_once())
+        now[0] += 1.0
+    assert restarts == Supervisor.STORM_THRESHOLD
+    report = supervisor.restart_report()[0]
+    assert report["storms"] == 1 and report["storm_latched"]
+    # Latched: no more respawns inside the hold...
+    for _ in range(10):
+        assert supervisor.check_once() == []
+        now[0] += 1.0
+    # ...and health reads DEGRADED with the storm named, not stale.
+    row = supervisor.health()[0]
+    assert row.state == DEGRADED and "restart storm" in row.reason
+    # Hold over: ONE probe respawn...
+    now[0] += Supervisor.STORM_HOLD
+    assert supervisor.check_once() == ["dies"]
+    # ...and a probe that dies again RE-LATCHES immediately — not
+    # another five free respawns (the documented contract).
+    now[0] += 1.0
+    assert supervisor.check_once() == []
+    report = supervisor.restart_report()[0]
+    assert report["storms"] == 2 and report["storm_latched"]
+    assert report["restarts"] == Supervisor.STORM_THRESHOLD + 1
+
+
+def test_supervisor_storm_event_journaled():
+    from kube_gpu_stats_tpu.supervisor import Supervisor
+
+    now = [0.0]
+    tracer = FakeTracer()
+    supervisor = Supervisor(clock=lambda: now[0], tracer=tracer)
+    _dying_component(supervisor, now)
+    for _ in range(Supervisor.STORM_THRESHOLD):
+        supervisor.check_once()
+        now[0] += 1.0
+    assert any(e["kind"] == "thread_restart_storm" for e in tracer.events)
+
+
+def test_supervisor_contributes_storm_counter():
+    from kube_gpu_stats_tpu import schema
+    from kube_gpu_stats_tpu.registry import SnapshotBuilder
+    from kube_gpu_stats_tpu.supervisor import Supervisor
+
+    now = [0.0]
+    supervisor = Supervisor(clock=lambda: now[0])
+    _dying_component(supervisor, now)
+    for _ in range(Supervisor.STORM_THRESHOLD):
+        supervisor.check_once()
+        now[0] += 1.0
+    builder = SnapshotBuilder()
+    supervisor.contribute(builder)
+    series = {(s.spec.name, tuple(s.labels)): s.value
+              for s in builder.build().series}
+    assert series[(schema.THREAD_RESTART_STORMS.name,
+                   (("component", "dies"),))] == 1.0
+
+
+def test_publish_follower_respawn_retires_the_wedged_thread():
+    """A hang-triggered respawn must ABANDON the wedged sender thread
+    and the abandoned thread must retire at its next superseded()
+    check — two run_forever loops draining one at-least-once cursor
+    would race peek/commit and skip records (review finding)."""
+    from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+    from kube_gpu_stats_tpu.workers import PublishFollower
+
+    wedge = threading.Event()
+    pushed = []
+
+    class Wedgy(PublishFollower):
+        def push_once(self):
+            pushed.append(threading.current_thread())
+            wedge.wait(5.0)
+
+    registry = Registry()
+    follower = Wedgy(registry, 0.0, thread_name="pf-test")
+    follower.start()
+    try:
+        registry.publish(SnapshotBuilder().build())
+        deadline = time.monotonic() + 5.0
+        while not pushed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pushed, "follower never pushed"
+        old = follower._thread
+        follower.respawn()  # the supervisor's hang restart
+        assert follower._thread is not old
+        wedge.set()  # the wedge clears...
+        old.join(3.0)
+        assert not old.is_alive(), "superseded thread did not retire"
+        assert len(pushed) == 1  # and it never pushed again
+        # start() on a live thread stays a no-op (no triple-spawn).
+        live = follower._thread
+        follower.start()
+        assert follower._thread is live
+    finally:
+        wedge.set()
+        follower.stop()
+
+
+def test_spawn_returns_named_daemon_thread():
+    from kube_gpu_stats_tpu.supervisor import spawn
+
+    ran = threading.Event()
+    thread = spawn(ran.set, name="spawn-test")
+    assert thread.daemon and thread.name == "spawn-test"
+    assert not thread.is_alive()  # caller owns .start()
+    thread.start()
+    assert ran.wait(2.0)
+
+
+def test_burst_sampler_start_respawns_a_dead_thread():
+    """Pre-fix, a died-once sampler was unrestartable (`is not None`
+    latch) — the supervisor's restart closure silently no-opped."""
+    from kube_gpu_stats_tpu.burstsampler import BurstSampler
+
+    sampler = BurstSampler(lambda: None, lambda: [], mode="continuous")
+    sampler.start()
+    assert sampler.thread_alive()
+    first = sampler._thread
+    sampler._stop.set()  # kill it the rude way
+    sampler._wake.set()
+    first.join(timeout=2.0)
+    assert not sampler.thread_alive()
+    sampler._stop.clear()
+    sampler.start()  # the supervisor's restart closure
+    assert sampler.thread_alive() and sampler._thread is not first
+    sampler.stop()
+
+
+# -- /debug/stores + accept fence --------------------------------------------
+
+def _get(url, auth=None):
+    request = urllib.request.Request(url)
+    if auth:
+        import base64
+
+        request.add_header(
+            "Authorization",
+            "Basic " + base64.b64encode(auth.encode()).decode())
+    with urllib.request.urlopen(request, timeout=5) as response:
+        return response.status, response.read()
+
+
+def test_debug_stores_endpoint_and_auth(tmp_path):
+    import hashlib
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry
+
+    health = wal.store_health("endpoint-test")
+    health.record_fault(OSError(errno.ENOSPC, "full"))
+
+    def stores():
+        return {"enabled": True, "stores": wal.store_report(),
+                "threads": []}
+
+    server = MetricsServer(
+        Registry(), host="127.0.0.1", port=0,
+        auth_username="ops",
+        auth_password_sha256=hashlib.sha256(b"pw").hexdigest(),
+        stores_provider=stores)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base + "/debug/stores")
+        assert err.value.code == 401  # auth-gated like every /debug
+        status, body = _get(base + "/debug/stores", auth="ops:pw")
+        payload = json.loads(body)
+        assert payload["stores"]["endpoint-test"]["state"] == "degraded"
+        assert payload["stores"]["endpoint-test"]["reason"] == "disk_full"
+    finally:
+        server.stop()
+
+
+def test_accept_loop_survives_fd_exhaustion(tmp_path):
+    """EMFILE on accept: shed-with-backoff, counted, then full
+    recovery — never an accept-loop death (the tentpole fence)."""
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+
+    registry = Registry()
+    builder = SnapshotBuilder()
+    registry.publish(builder.build())
+    server = MetricsServer(registry, host="127.0.0.1", port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        _get(base + "/healthz")  # warm: the loop accepts fine
+        proxy = fence_accepts(server, times=4)
+        deadline = time.monotonic() + 10.0
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                status, _ = _get(base + "/healthz")
+                break
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.05)
+        assert status == 200, "accept loop dead after EMFILE burst"
+        assert proxy.faults_served == 4
+        fence = server.accept_fence_status()
+        assert fence["fenced_total"] == 4
+        assert fence["episodes"] >= 1
+        assert not fence["in_episode"]  # recovered
+        health = wal.store_health("http-accept")
+        assert health.fault_counts.get("EMFILE") == 4
+        assert health.state == wal.STORE_HEALTHY
+    finally:
+        server.stop()
+
+
+def test_fetch_pool_socket_emfile_sheds_not_crashes(monkeypatch):
+    """EMFILE on the hub fetch pool's socket open path: the refresh
+    counts a fetch failure (breaker discipline) and the pool thread
+    survives — pinned shed-not-crash (satellite)."""
+    import http.client
+
+    from kube_gpu_stats_tpu.hub import Hub
+
+    def exhausted(self):
+        raise OSError(errno.EMFILE, "too many open files")
+
+    monkeypatch.setattr(http.client.HTTPConnection, "connect", exhausted)
+    hub = Hub(["http://127.0.0.1:9/metrics"], interval=10.0)
+    try:
+        frame = hub.refresh_once()
+        assert frame.errors  # the failure is counted...
+        hub.refresh_once()   # ...and the pool keeps refreshing
+    finally:
+        hub.stop()

@@ -1,0 +1,81 @@
+"""check_supervised_threads lint (ISSUE 15 satellite): every thread in
+kube_gpu_stats_tpu/ must be born through supervisor.spawn() — bare
+threading.Thread(...) call sites (and Thread subclasses) fail `make
+lint`, with supervisor.py (the helper's home) and testing/ (test
+doubles) allowlisted."""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import check_supervised_threads  # noqa: E402
+
+
+def _check(tmp_path, source: str, name: str = "module.py") -> list[str]:
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return check_supervised_threads.check_file(path)
+
+
+def test_bare_threading_thread_flagged(tmp_path):
+    problems = _check(tmp_path, """
+        import threading
+        t = threading.Thread(target=print, name="x", daemon=True)
+    """)
+    assert len(problems) == 1
+    assert "supervisor.spawn" in problems[0]
+
+
+def test_imported_thread_name_flagged(tmp_path):
+    problems = _check(tmp_path, """
+        from threading import Thread
+        t = Thread(target=print)
+    """)
+    assert len(problems) == 1
+
+
+def test_thread_subclass_flagged(tmp_path):
+    problems = _check(tmp_path, """
+        import threading
+
+        class Worker(threading.Thread):
+            pass
+    """)
+    assert len(problems) == 1
+    assert "subclasses" in problems[0]
+
+
+def test_spawn_helper_usage_passes(tmp_path):
+    assert _check(tmp_path, """
+        from .supervisor import spawn
+        t = spawn(print, name="ok")
+        t.start()
+    """) == []
+
+
+def test_unrelated_thread_attribute_passes(tmp_path):
+    """Other .Thread attributes (a fake SDK's client.Thread) must not
+    false-positive; only the threading module's constructor counts."""
+    assert _check(tmp_path, """
+        import sdk
+        t = sdk.Thread(target=print)
+    """) == []
+
+
+def test_allowlist_covers_supervisor_and_testing():
+    assert "supervisor.py" in check_supervised_threads.ALLOW_FILES
+    assert "testing" in check_supervised_threads.ALLOW_DIRS
+
+
+def test_lint_green_on_the_real_package():
+    """The shipped package must pass its own lint (the make lint
+    gate); run the tool as the Makefile does."""
+    result = subprocess.run(
+        [sys.executable,
+         str(ROOT / "tools" / "check_supervised_threads.py")],
+        capture_output=True, text=True)
+    assert result.returncode == 0, result.stderr

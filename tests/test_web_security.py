@@ -164,13 +164,15 @@ def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
         host_provider=HostStats(),
         egress_provider=lambda: {"enabled": True,
                                  "spill": {"SECRET": "SPOOL_DETAIL"}},
+        stores_provider=lambda: {"enabled": True,
+                                 "stores": {"SECRET_STORE": {}}},
     )
     srv.start()
     try:
         for path in ("/debug/threads", "/debug/profile?seconds=0.1",
                      "/debug/ticks", "/debug/trace?last=5",
                      "/debug/events?since=0", "/debug/fleet",
-                     "/debug/host", "/debug/egress"):
+                     "/debug/host", "/debug/egress", "/debug/stores"):
             with pytest.raises(urllib.error.HTTPError) as err:
                 fetch(srv.port, path)
             assert err.value.code == 401, path
@@ -278,6 +280,42 @@ def test_debug_egress_served_with_auth_and_disabled_contract():
         assert b"/debug/egress" in landing
     finally:
         srv.stop()
+
+
+def test_debug_stores_404_without_provider(server):
+    """Servers with no stores provider wired (bare registries) must
+    404 /debug/stores, mirroring /debug/egress."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(server.port, "/debug/stores")
+    assert err.value.code == 404
+
+
+def test_debug_stores_daemon_end_to_end(tmp_path):
+    """The daemon wires its real payload (ISSUE 15): store states,
+    accept-fence status and the supervisor thread report, plus the
+    landing-page inventory row."""
+    import json
+
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+
+    d = Daemon(Config(backend="mock", attribution="off", listen_port=0,
+                      hub_url="http://127.0.0.1:9",
+                      hub_spill_dir=str(tmp_path / "spill")))
+    try:
+        d.server.start()
+        payload = json.loads(fetch(d.server.port, "/debug/stores").read())
+        assert payload["enabled"] is True
+        assert payload["role"] == "daemon"
+        assert "spill" in payload["stores"]
+        assert "http-accept" in payload["stores"]
+        assert "accept_fence" in payload
+        assert isinstance(payload["threads"], list)
+        landing = fetch(d.server.port, "/").read()
+        assert b"/debug/stores" in landing
+    finally:
+        d.server.stop()
+        d.collector.close()
 
 
 def test_debug_egress_daemon_end_to_end(tmp_path):

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Lint: every thread in the package is born through the supervised
+spawn helper (ISSUE 15 satellite).
+
+The supervisor coverage sweep only holds if no new code path can
+quietly grow a bare ``threading.Thread(...)``: a thread created
+outside :func:`kube_gpu_stats_tpu.supervisor.spawn` is invisible to
+the one-birthplace discipline — it may be unnamed, non-daemonic
+(wedging process exit on a stuck backend, the workers.py lesson), and
+nothing forces its owner to think about liveness/restart. The runtime
+can't enforce this (threading.Thread is the stdlib), so this lint
+catches it at `make lint` time, like check_wal_versions does for
+unstamped WAL formats:
+
+- ``threading.Thread(...)`` / ``Thread(...)`` call sites anywhere in
+  ``kube_gpu_stats_tpu/`` fail, EXCEPT in ``supervisor.py`` (the
+  helper's home — the one real constructor call lives there) and in
+  the allowlist below (test doubles under ``testing/`` build fixture
+  servers/sockets, not production workers).
+- Subclassing ``threading.Thread`` fails too — it is the same escape
+  hatch with a class statement in front.
+
+Scans the kube_gpu_stats_tpu package only (tests and tools drive
+threads deliberately, including hostile ones).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "kube_gpu_stats_tpu"
+
+# Files allowed to touch threading.Thread directly:
+# - supervisor.py IS the helper (spawn() wraps the constructor)
+# - testing/ holds test doubles (fake kubelet/libtpu servers, the
+#   faultfs socket proxies) that never ship in the daemon
+ALLOW_FILES = {"supervisor.py"}
+ALLOW_DIRS = {"testing"}
+
+
+def _is_thread_ref(node: ast.expr) -> bool:
+    """threading.Thread / Thread (imported name) references."""
+    if isinstance(node, ast.Attribute) and node.attr == "Thread":
+        return isinstance(node.value, ast.Name) and \
+            node.value.id == "threading"
+    if isinstance(node, ast.Name) and node.id == "Thread":
+        return True
+    return False
+
+
+def check_file(path: pathlib.Path) -> list[str]:
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except SyntaxError as exc:
+        return [f"{path}: unparseable ({exc})"]
+    try:
+        rel = path.relative_to(ROOT)
+    except ValueError:
+        rel = path
+    problems: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_thread_ref(node.func):
+            problems.append(
+                f"{rel}:{node.lineno}: bare threading.Thread(...) — "
+                f"create package threads through supervisor.spawn() "
+                f"(ISSUE 15: one birthplace, supervised or "
+                f"deliberately short-lived)")
+        elif isinstance(node, ast.ClassDef) and \
+                any(_is_thread_ref(base) for base in node.bases):
+            problems.append(
+                f"{rel}:{node.lineno}: class {node.name} subclasses "
+                f"threading.Thread — same escape hatch; compose with "
+                f"supervisor.spawn() instead")
+    return problems
+
+
+def main() -> int:
+    problems: list[str] = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel_parts = path.relative_to(PACKAGE).parts
+        if path.name in ALLOW_FILES or rel_parts[0] in ALLOW_DIRS:
+            continue
+        problems.extend(check_file(path))
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print("fix: from .supervisor import spawn; "
+              "thread = spawn(target, name=...); thread.start()",
+              file=sys.stderr)
+        return 1
+    print("check_supervised_threads: every package thread is born "
+          "through supervisor.spawn()")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

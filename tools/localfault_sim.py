@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""Local fault survival smoke (ISSUE 15, `make local-sim`): a REAL
+daemon (mock backend, burst sampler continuous, energy checkpoint,
+delta publisher with a disk spill queue) pushing into a REAL
+MetricsServer-fronted hub (delta ingest + WAL checkpoint), driven
+through every local resource fault the tentpole names — injected at
+the os level by testing/faultfs.py, path-prefix-scoped to this sim's
+tmpdir:
+
+- **ENOSPC mid-drain**: the spill queue's disk fills while a hub
+  blackout's backlog is spooling and draining. The store must degrade
+  (counted, journaled), telemetry must continue in-memory with every
+  durability loss accounted, and when the "disk" clears the store must
+  re-arm and the WHOLE backlog (memory-only window included) must
+  drain — zero frames silently dropped, zero process deaths.
+- **EIO on checkpoint fsync**: the energy checkpoint's fsync dies.
+  checkpoint() must defer (never raise off the pool), the store must
+  degrade then auto-recover, and per-pod joules must stay MONOTONE
+  across a daemon restart onto the same path.
+- **Read-only remount**: the hub's ingest-checkpoint disk goes EROFS.
+  Exactly one disk_fault journal event for the episode, ingest keeps
+  applying frames exactly-once (0 duplicate-counted), durability
+  re-arms when the mount returns.
+- **Killed sampler thread**: the burst sampler thread dies to an
+  injected exception. The supervisor watchdog must respawn it and
+  count the restart; sampling resumes.
+- **fd exhaustion**: the hub's accept loop draws EMFILE. The fence
+  must shed-with-backoff (counted, journaled) and the loop must serve
+  again — never an accept-loop death.
+
+After the faults: `doctor --stores` against both processes must name
+every store that degraded and every thread that was restarted, and the
+kts_store_* families must carry the same accounting on the daemon's
+own exposition. Exit 0 with a PASS line, else 1 with evidence. Wired
+into `make ci`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def wait_for(predicate, timeout: float, interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+def run(verbose: bool) -> int:
+    from kube_gpu_stats_tpu import doctor, wal
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.supervisor import Supervisor
+    from kube_gpu_stats_tpu.testing.faultfs import FaultFS, fence_accepts
+    from kube_gpu_stats_tpu.validate import parse_exposition
+
+    problems: list[str] = []
+    wal.set_probe_interval(0.2)  # fast auto-recovery probes for the sim
+
+    def note(line: str) -> None:
+        if verbose:
+            print("  " + line)
+
+    with tempfile.TemporaryDirectory() as tmp, FaultFS() as fs:
+        base = pathlib.Path(tmp)
+        # Wrap every file the stores will open under the sim root so
+        # faults injected MID-LIFE hit already-open handles too.
+        fs.watch(str(base))
+
+        # ---- the hub: delta ingest + WAL checkpoint + supervisor ----
+        hub = Hub([], targets_provider=lambda: [], interval=0.2,
+                  push_fence=1e9,
+                  ingest_checkpoint=str(base / "ingest" / "ck.json"),
+                  ingest_checkpoint_interval=0.05)
+        supervisor = Supervisor(check_interval=0.1, tracer=hub.tracer)
+        hub.attach_supervisor(supervisor)
+        server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                               ingest_provider=hub.delta.handle,
+                               stores_provider=lambda: {
+                                   "enabled": True, "role": "hub",
+                                   "stores": wal.store_report(),
+                                   "accept_fence":
+                                       server.accept_fence_status(),
+                                   "threads":
+                                       supervisor.restart_report(),
+                               })
+        server.start()
+        hub_port = server.port
+
+        # ---- the daemon: spill + energy + burst, all disk-backed ----
+        daemon = Daemon(Config(
+            backend="mock", attribution="off", interval=0.05,
+            listen_port=0, device_processes="off",
+            burst_mode="continuous", burst_hz=50.0,
+            energy_checkpoint=str(base / "energy" / "ck.json"),
+            energy_checkpoint_interval=0.05,
+            hub_url=f"http://127.0.0.1:{hub_port}",
+            hub_push_interval=0.02,
+            hub_push_source="http://node-0:9400/metrics",
+            hub_spill_dir=str(base / "spill"),
+            hub_drain_rate=5000.0,
+        ))
+        daemon.start()
+        hub.start()
+        supervisor.register("hub-refresh", is_alive=hub.thread_alive,
+                            restart=hub.respawn, heartbeat_timeout=30.0)
+        supervisor.start()
+        daemon_base = f"http://127.0.0.1:{daemon.server.port}"
+        hub_base = f"http://127.0.0.1:{hub_port}"
+        server2 = None
+        try:
+            publisher = daemon.delta_pusher
+            spill = publisher._spill
+            if not wait_for(lambda: publisher.pushes_total >= 2, 15.0):
+                problems.append("setup: publisher never synced to hub")
+
+            # ============ 1. ENOSPC on the spill disk mid-drain ======
+            server.stop()  # blackout: snapshots start spooling
+            if not wait_for(lambda: spill.depth() >= 3, 15.0):
+                problems.append("enospc: snapshots not spooling")
+            durable_spooled = spill.spooled_total
+            fs.inject(str(base / "spill"), "enospc",
+                      ops=("open", "write", "fsync"))
+            spill_health = wal.store_health("spill")
+            if not wait_for(
+                    lambda: spill_health.state == wal.STORE_DEGRADED,
+                    10.0):
+                problems.append("enospc: spill store never degraded")
+            if not wait_for(lambda: spill_health.lost_records >= 2, 10.0):
+                problems.append("enospc: loss not counted while degraded")
+            depth_mid = spill.depth()
+            lost_mid = spill_health.lost_records
+            note(f"enospc: spill degraded "
+                 f"({spill_health.errno_name}), depth {depth_mid}, "
+                 f"{lost_mid} record(s) lost durability, daemon alive")
+            fs.clear()  # the disk clears...
+            if not wait_for(
+                    lambda: spill_health.state == wal.STORE_HEALTHY,
+                    10.0):
+                problems.append(
+                    "enospc: store did not auto-recover after the "
+                    "fault cleared")
+            # ...and the hub returns: EVERYTHING drains (memory-only
+            # window included — loss was durability-only).
+            server2 = MetricsServer(hub.registry, host="127.0.0.1",
+                                    port=hub_port,
+                                    ingest_provider=hub.delta.handle)
+            server2.start()
+            publisher._probe_at = 0.0
+            if not wait_for(lambda: spill.depth() == 0, 20.0):
+                problems.append(
+                    f"enospc: backlog never drained "
+                    f"(depth {spill.depth()})")
+            if spill.dropped_total:
+                problems.append(
+                    f"enospc: {spill.dropped_total} frame(s) dropped — "
+                    f"the degraded window must lose durability, not "
+                    f"records")
+            if spill.drained_total < durable_spooled:
+                problems.append("enospc: drained fewer frames than "
+                                "were spooled before the fault")
+            note(f"enospc: recovered; {spill.drained_total} frames "
+                 f"drained incl. the in-memory window, 0 dropped")
+
+            # ============ 2. EIO on the energy checkpoint fsync ======
+            energy_health = wal.store_health("energy")
+            if not wait_for(
+                    lambda: daemon.energy.checkpoint_writes >= 1, 10.0):
+                problems.append("eio: energy checkpoint never wrote")
+            joules_before = sum(daemon.energy._per_pod.values())
+            fs.inject(str(base / "energy"), "eio", ops=("fsync",))
+            if not wait_for(
+                    lambda: energy_health.state == wal.STORE_DEGRADED,
+                    10.0):
+                problems.append("eio: energy store never degraded "
+                                "(fsync fault not contained?)")
+            if not daemon.poll.thread_alive():
+                problems.append("eio: poll loop died to a checkpoint "
+                                "fault (the audited bug class)")
+            fs.clear()
+            if not wait_for(
+                    lambda: energy_health.state == wal.STORE_HEALTHY,
+                    10.0):
+                problems.append("eio: energy store did not re-arm")
+            writes_after = daemon.energy.checkpoint_writes
+            if not wait_for(
+                    lambda: daemon.energy.checkpoint_writes
+                    > writes_after, 10.0):
+                problems.append("eio: checkpoints did not resume")
+            note(f"eio: energy checkpoint degraded then re-armed "
+                 f"({energy_health.fault_counts.get('EIO', 0)} fault(s) "
+                 f"counted)")
+
+            # ============ 3. EROFS on the hub ingest checkpoint ======
+            ingest_health = wal.store_health("ingest")
+            events_before = [
+                e for e in hub.tracer.events().get("events", ())
+                if e["kind"] == "disk_fault"
+                and e["attrs"].get("store") == "ingest"]
+            dups_before = hub.delta.duplicate_frames_total
+            fs.inject(str(base / "ingest"), "erofs",
+                      ops=("open", "write", "fsync"))
+            if not wait_for(
+                    lambda: ingest_health.state == wal.STORE_DEGRADED,
+                    10.0):
+                problems.append("erofs: ingest store never degraded")
+            frames_at = hub.delta.delta_frames_total
+            if not wait_for(
+                    lambda: hub.delta.delta_frames_total > frames_at + 2,
+                    10.0):
+                problems.append(
+                    "erofs: ingest stopped applying frames while its "
+                    "checkpoint disk was read-only")
+            fault_events = [
+                e for e in hub.tracer.events().get("events", ())
+                if e["kind"] == "disk_fault"
+                and e["attrs"].get("store") == "ingest"]
+            if len(fault_events) - len(events_before) != 1:
+                problems.append(
+                    f"erofs: expected exactly 1 disk_fault journal "
+                    f"event for the episode, saw "
+                    f"{len(fault_events) - len(events_before)}")
+            fs.clear()
+            if not wait_for(
+                    lambda: ingest_health.state == wal.STORE_HEALTHY,
+                    10.0):
+                problems.append("erofs: ingest store did not re-arm")
+            if hub.delta.duplicate_frames_total != dups_before:
+                problems.append("erofs: duplicate-counted frames during "
+                                "the episode (exactly-once broken)")
+            note("erofs: ingest checkpoint degraded (1 journal event), "
+                 "frames kept applying exactly-once, re-armed")
+
+            # ============ 4. killed background thread ================
+            restarts_before = next(
+                (r["restarts"]
+                 for r in daemon.supervisor.restart_report()
+                 if r["component"] == "burst"), 0)
+
+            def _die() -> int:
+                raise RuntimeError("sim: sampler killed")
+
+            daemon.burst._read_once = _die  # the thread dies on arrival
+            if not wait_for(lambda: not daemon.burst.thread_alive(),
+                            10.0):
+                problems.append("kill: sampler thread refused to die "
+                                "(sim harness bug)")
+            del daemon.burst.__dict__["_read_once"]  # heal the cause
+            if not wait_for(lambda: daemon.burst.thread_alive(), 15.0):
+                problems.append(
+                    "kill: supervisor never respawned the sampler")
+            report = next(
+                (r for r in daemon.supervisor.restart_report()
+                 if r["component"] == "burst"), None)
+            if report is None or report["restarts"] <= restarts_before:
+                problems.append("kill: burst restart not counted")
+            note(f"kill: sampler died, supervisor respawned it "
+                 f"(restart #{report['restarts'] if report else '?'})")
+
+            # ============ 5. fd exhaustion on the accept loop ========
+            proxy = fence_accepts(server2, times=5)
+            pushes_at = publisher.pushes_total
+            if not wait_for(
+                    lambda: publisher.pushes_total > pushes_at + 2,
+                    15.0):
+                problems.append(
+                    "emfile: pushes never recovered after the accept "
+                    "fence (loop dead?)")
+            if proxy.faults_served != 5:
+                problems.append(
+                    f"emfile: fence served {proxy.faults_served}/5 "
+                    f"injected faults")
+            fence = server2.accept_fence_status()
+            if fence["fenced_total"] < 5 or fence["in_episode"]:
+                problems.append(
+                    f"emfile: fence accounting wrong ({fence})")
+            accept_health = wal.store_health("http-accept")
+            if accept_health.fault_counts.get("EMFILE", 0) < 5:
+                problems.append("emfile: faults not counted in "
+                                "kts_disk_faults_total{store=http-accept}")
+            note(f"emfile: accept loop shed {fence['fenced_total']} "
+                 f"fault(s) across {fence['episodes']} episode(s) and "
+                 f"recovered")
+
+            # ============ doctor --stores names everything ===========
+            result = doctor.check_stores(daemon_base)
+            if result.status == doctor.FAIL:
+                problems.append(f"doctor --stores failed: {result.detail}")
+            payload = result.data.get("stores", {})
+            detail = result.detail
+            for store in ("spill", "energy"):
+                info = (payload.get("stores") or {}).get(store)
+                if not info or not sum(
+                        (info.get("fault_counts") or {}).values()):
+                    problems.append(
+                        f"doctor: store {store!r} fault history missing "
+                        f"from /debug/stores")
+            if "burst" not in detail:
+                problems.append(
+                    f"doctor --stores did not name the restarted "
+                    f"burst thread: {detail!r}")
+            note(f"doctor --stores [{result.status}]: {detail}")
+
+            # Daemon's own exposition carries the accounting.
+            import urllib.request
+
+            with urllib.request.urlopen(daemon_base + "/metrics",
+                                        timeout=5) as response:
+                text = response.read().decode()
+            families = {name for name, _labels, _v
+                        in parse_exposition(text)}
+            for family in ("kts_store_state", "kts_disk_faults_total",
+                           "kts_store_lost_records_total",
+                           "kts_thread_restart_storms_total"):
+                if family not in families:
+                    problems.append(
+                        f"{family} missing from the daemon exposition")
+            lost_exported = sum(
+                v for name, labels, v in parse_exposition(text)
+                if name == "kts_store_lost_records_total"
+                and labels.get("store") == "spill")
+            if lost_exported != spill_health.lost_records:
+                problems.append(
+                    f"exported spill loss {lost_exported} != ledger "
+                    f"{spill_health.lost_records} (accounting drift)")
+
+            # THE acceptance bar: zero process deaths.
+            if not daemon.poll.thread_alive():
+                problems.append("daemon poll loop dead at sim end")
+            if not hub.thread_alive():
+                problems.append("hub refresh thread dead at sim end")
+        finally:
+            supervisor.stop()
+            daemon.stop()
+            hub.stop()
+            if server2 is not None:
+                server2.stop()
+            server.stop()
+
+    if problems:
+        print("LOCALFAULT SIM FAIL")
+        for problem in problems:
+            print(f"  ! {problem}")
+        return 1
+    print("PASS localfault-sim: ENOSPC/EIO/EROFS/killed-thread/EMFILE "
+          "all survived — 0 process deaths, loss exactly accounted, "
+          "every store auto-recovered, doctor --stores names the "
+          "degraded stores and restarted threads")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args()
+    return run(args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
