@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim local-sim
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim local-sim cardinality-sim bench-diff
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -14,6 +14,7 @@ ci: native lint
 	python tools/partition_sim.py
 	python tools/skew_sim.py
 	python tools/localfault_sim.py
+	python tools/cardinality_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -131,6 +132,24 @@ local-sim:
 # doctor --skew names. In `make ci` too.
 skew-sim:
 	python tools/skew_sim.py --verbose
+
+# Cardinality-admission smoke (<60 s, ISSUE 16): a real hub under a
+# 1M-unique-series label bomb from 2 of 16 pushers — over-budget FULLs
+# clamped to their admitted prefix, ledger-growing frames refused 413
+# at the hard cap before any parse, every dropped series accounted
+# with the exported kts_cardinality_shed_total counters exactly equal
+# to the in-process and /debug/cardinality ledgers, RSS growth under a
+# pinned bound, the 14 healthy pushers byte-identical to a bomb-free
+# control hub, and idle eviction re-admitting a 413'd late joiner once
+# the bomb stops. In `make ci` too.
+cardinality-sim:
+	python tools/cardinality_sim.py --verbose
+
+# Compare the two newest BENCH_r*.json runs field by field with noise
+# bands — report-only (exit 0), the reviewer's diff surface for perf
+# PRs.
+bench-diff:
+	python tools/bench_diff.py
 
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf

@@ -133,6 +133,22 @@ def _burst_fields(line: dict) -> None:
         line["burst_thread_cpu_pct"] = burst["burst_thread_cpu_pct"]
 
 
+def _cardinality_fields(line: dict) -> None:
+    """Cardinality-admission cost (ISSUE 16): the accountant's
+    bookkeeping per ingested series against the full ingest path's
+    per-series cost (the <2% CI pin lives in tests/test_latency.py),
+    and process RSS after a budgeted hub clamps a label bomb."""
+    from kube_gpu_stats_tpu.bench import measure_cardinality_admission
+
+    card = measure_cardinality_admission()
+    if card is not None:
+        line["cardinality_admission_ns_per_series"] = card[
+            "cardinality_admission_ns_per_series"]
+        line["cardinality_admission_overhead_pct"] = card[
+            "cardinality_admission_overhead_pct"]
+        line["hub_rss_mb_under_bomb"] = card["hub_rss_mb_under_bomb"]
+
+
 def _host_fields(line: dict) -> None:
     """Host-signals collector cost (ISSUE 10): p50 of one full
     HostStats.read() over a realistic fixture tree — pool-thread cost
@@ -220,6 +236,7 @@ def _quick() -> int:
     _localfault_fields(line)
     _burst_fields(line)
     _host_fields(line)
+    _cardinality_fields(line)
     print(json.dumps(line))
     sys.stdout.flush()
     os._exit(0)
@@ -337,6 +354,7 @@ def main() -> int:
     _localfault_fields(line)
     _burst_fields(line)
     _host_fields(line)
+    _cardinality_fields(line)
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or
     # PJRT atexit hook) blocked in native code; the JSON line is already

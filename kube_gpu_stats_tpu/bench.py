@@ -1141,6 +1141,108 @@ def measure_overload_shed(pushers: int = 256, lanes: int = 4,
         return None
 
 
+def measure_cardinality_admission(pushers: int = 256, frames: int = 40,
+                                  bomb_series: int = 100_000,
+                                  bomb_frames: int = 4) -> dict | None:
+    """Cardinality-admission cost figures (ISSUE 16 acceptance):
+
+    - ``cardinality_admission_ns_per_series``: the accountant's
+      bookkeeping (admit + install) per ingested series — the exact
+      ops a FULL apply pays on top of parse/entry-build.
+    - ``ingest_ns_per_series``: the full ingest path's per-series cost
+      (real hub, real FULL frames through handle()) — the denominator
+      for the <2% overhead pin in tests/test_latency.py.
+    - ``cardinality_admission_overhead_pct``: the ratio of the two.
+    - ``hub_rss_mb_under_bomb``: process RSS (MB) after a budgeted hub
+      absorbs a label bomb (``bomb_frames`` FULLs of ``bomb_series``
+      unique series each, clamped to a 500-series budget) — the
+      state-bounding claim as a recorded figure; the hard pin lives in
+      tools/cardinality_sim.py.
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench."""
+    try:
+        from .cardinality import SeriesAccountant
+        from .delta import encode_full
+        from .hub import Hub
+
+        series_per_full = 6
+        sources = [f"http://adm-{i:05d}:9400/metrics"
+                   for i in range(pushers)]
+
+        # -- (a) the bookkeeping alone, steady-state (every source
+        # established after the first rep, so admit takes its
+        # headroom path, not first-install) --------------------------
+        acc = SeriesAccountant(
+            budget_per_source=series_per_full,
+            hard_cap=pushers * series_per_full * 2,
+            high_watermark=pushers * series_per_full * 2)
+        start = time.perf_counter()
+        booked = 0
+        for _rep in range(frames):
+            for source in sources:
+                admitted = acc.admit(source, series_per_full)
+                acc.install(source, admitted, 600)
+                booked += series_per_full
+        admission_ns = (time.perf_counter() - start) / booked * 1e9
+
+        # -- (b) the full ingest path those ops ride on ---------------
+        hub = Hub([], targets_provider=lambda: [], interval=10.0,
+                  ingest_lanes=2, ingest_max_sessions=pushers + 8,
+                  series_budget_per_source=500,
+                  series_hard_cap=pushers * series_per_full + 1000,
+                  series_high_watermark=pushers * series_per_full + 1000)
+        try:
+            bodies = [build_pusher_body(i) for i in range(pushers)]
+            wires = [encode_full(sources[i], i + 1, 1, bodies[i])
+                     for i in range(pushers)]
+            for wire in wires:  # establish sessions (untimed)
+                code, _resp, _hdrs = hub.delta.handle(wire)
+                assert code == 200, code
+            start = time.perf_counter()
+            ingested = 0
+            for rep in range(max(2, frames // 8)):
+                for i, source in enumerate(sources):
+                    code, _resp, _hdrs = hub.delta.handle(encode_full(
+                        source, i + 1, rep + 2, bodies[i]))
+                    assert code == 200, code
+                    ingested += series_per_full
+            ingest_ns = (time.perf_counter() - start) / ingested * 1e9
+
+            # -- (c) RSS after a label bomb (clamped, so the unique
+            # series must NOT accumulate) -----------------------------
+            bomb = "http://bomb:9400/metrics"
+            for rep in range(bomb_frames):
+                lines = ["# TYPE accelerator_duty_cycle gauge"]
+                lines += [
+                    f'accelerator_duty_cycle{{pod="b-{rep}-{j}",'
+                    f'slice="zz",worker="bomb"}} 1'
+                    for j in range(bomb_series)]
+                code, _resp, _hdrs = hub.delta.handle(encode_full(
+                    bomb, 900_000, rep + 1, "\n".join(lines) + "\n"))
+                assert code == 200, code
+            rss_kb = 0
+            with open("/proc/self/status") as handle:
+                for line in handle:
+                    if line.startswith("VmRSS:"):
+                        rss_kb = int(line.split()[1])
+                        break
+            bomb_live = hub.cardinality.live_series()
+        finally:
+            hub.stop()
+        return {
+            "cardinality_admission_ns_per_series": round(admission_ns, 1),
+            "ingest_ns_per_series": round(ingest_ns, 1),
+            "cardinality_admission_overhead_pct": round(
+                admission_ns / ingest_ns * 100.0, 3),
+            "hub_rss_mb_under_bomb": round(rss_kb / 1024.0, 1),
+            "bomb_series_attempted": bomb_series * bomb_frames,
+            "bomb_live_series": bomb_live,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
 def measure_partition_drain(frames: int = 200,
                             drain_rate: float = 1e9) -> dict | None:
     """Partition-survival egress figures (ISSUE 13 acceptance): spool

@@ -73,6 +73,10 @@ class Config:
     #                             events; --no-trace disables recording
     #                             (the endpoints stay up and say so)
     drop_labels: tuple[str, ...] = ()  # label keys emitted as "" (cardinality)
+    label_value_cap: int = 0  # distinct values per attribution label key
+    #                           before new values degrade to "overflow"
+    #                           at the plan compiler (ISSUE 16 fence);
+    #                           0 = unfenced
     metrics_include: tuple[str, ...] = ()  # family allowlist (() = all)
     metrics_exclude: tuple[str, ...] = ()  # family denylist
     disabled_metrics: frozenset = frozenset()  # resolved from the two above
@@ -390,6 +394,78 @@ def add_ingest_guard_flags(p: argparse.ArgumentParser) -> None:
                         "rollouts leave it 0")
 
 
+def add_cardinality_flags(p: argparse.ArgumentParser) -> None:
+    """The hub's cardinality & memory admission knobs (ISSUE 16): the
+    series ledger's budgets, hard cap and eviction watermarks. All 0 by
+    default = accounting only (kts_series_live/kts_source_series still
+    export), no admission — the same off-by-default contract as the
+    ingest guards."""
+    p.add_argument("--series-budget-per-source", type=int,
+                   default=int(_env("SERIES_BUDGET_PER_SOURCE", "0")),
+                   help="max series one source (push session or pull "
+                        "target) may install: a FULL over it lands "
+                        "clamped to the admitted prefix — existing "
+                        "series keep updating, only the NEW series are "
+                        "dropped and counted "
+                        "(kts_cardinality_shed_total{reason="
+                        "\"source_budget\"}). Size from the honest "
+                        "fleet's max(kts_source_series). 0 = unlimited")
+    p.add_argument("--series-hard-cap", type=int,
+                   default=int(_env("SERIES_HARD_CAP", "0")),
+                   help="global live-series hard cap across every "
+                        "source: frames that would grow a full ledger "
+                        "draw a 413-style shed the publisher defers on "
+                        "like a 429 (no FULL promotion, no resync "
+                        "storm). The hub's last line against OOM; "
+                        "0 = unlimited")
+    p.add_argument("--series-high-watermark", type=int,
+                   default=int(_env("SERIES_HIGH_WATERMARK", "0")),
+                   help="live-series level above which the accountant "
+                        "LRU-evicts IDLE sources (no update for "
+                        "--series-idle-refreshes refreshes) through the "
+                        "hub's churn path, counted as "
+                        "kts_cardinality_evicted_total{reason=\"idle\"}. "
+                        "Set below --series-hard-cap so idle state "
+                        "yields before live traffic sheds. 0 = never "
+                        "evict")
+    p.add_argument("--series-low-watermark", type=int,
+                   default=int(_env("SERIES_LOW_WATERMARK", "0")),
+                   help="eviction target: once above the high "
+                        "watermark, idle sources are evicted until the "
+                        "ledger is back under this (hysteresis — "
+                        "without it the ledger oscillates across the "
+                        "watermark every refresh). 0 = 90%% of the "
+                        "high watermark")
+    p.add_argument("--series-idle-refreshes", type=int,
+                   default=int(_env("SERIES_IDLE_REFRESHES", "5")),
+                   help="refreshes without an update before a source "
+                        "counts as idle and becomes evictable above "
+                        "the high watermark (a source still pushing or "
+                        "being pulled is never evicted for pressure)")
+
+
+def validate_cardinality_args(args) -> str | None:
+    """Range rules for the cardinality admission flags; the hub parser
+    surfaces the string through parser.error."""
+    for name in ("series_budget_per_source", "series_hard_cap",
+                 "series_high_watermark", "series_low_watermark"):
+        if getattr(args, name) < 0:
+            return (f"--{name.replace('_', '-')} must be >= 0 "
+                    f"(0 disables)")
+    if args.series_idle_refreshes < 1:
+        return "--series-idle-refreshes must be >= 1"
+    if (args.series_high_watermark and args.series_hard_cap
+            and args.series_high_watermark > args.series_hard_cap):
+        return "--series-high-watermark must be <= --series-hard-cap"
+    if (args.series_low_watermark and args.series_high_watermark
+            and args.series_low_watermark > args.series_high_watermark):
+        return "--series-low-watermark must be <= --series-high-watermark"
+    if args.series_low_watermark and not args.series_high_watermark:
+        return ("--series-low-watermark needs --series-high-watermark "
+                "(eviction is watermark-driven)")
+    return None
+
+
 def validate_ingest_guard_args(args) -> str | None:
     """Range rules for the ingest survival flags; the hub parser
     surfaces the string through parser.error."""
@@ -592,6 +668,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "empty strings for cardinality control, e.g. "
                         "'pod,namespace,container'); the label SET stays "
                         "stable so series identity never churns")
+    p.add_argument("--label-value-cap", type=int,
+                   default=int(_env("LABEL_VALUE_CAP", "0")),
+                   help="cardinality fence at the plan compiler (ISSUE "
+                        "16): max distinct values per attribution label "
+                        "key (pod/namespace/container); once a key "
+                        "reaches the cap, NEW values degrade to the "
+                        "\"overflow\" aggregate instead of minting "
+                        "fresh series — a bad kubelet join or pod-churn "
+                        "storm stops exploding cardinality. Known "
+                        "values keep passing (series identity is "
+                        "stable); fence hits count as "
+                        "kts_cardinality_fenced_total and journal a "
+                        "cardinality_fenced event. 0 = unfenced")
     p.add_argument("--metrics-include", default=_env("METRICS_INCLUDE", ""),
                    help="comma-separated allowlist of device metric "
                         "families to export (exact names or globs, e.g. "
@@ -793,6 +882,8 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         parser.error(f"--remote-write-extra-labels: {exc}")
     if args.max_process_series < 1:
         parser.error("--max-process-series must be >= 1")
+    if args.label_value_cap < 0:
+        parser.error("--label-value-cap must be >= 0 (0 = unfenced)")
     if args.interval <= 0:
         parser.error("--interval must be > 0 seconds")
     if args.deadline <= 0:
@@ -893,6 +984,7 @@ def from_args(argv: Sequence[str] | None = None) -> Config:
         pipeline_fetch=not args.no_pipeline_fetch,
         trace_enabled=not args.no_trace,
         drop_labels=drop_labels,
+        label_value_cap=args.label_value_cap,
         metrics_include=metrics_include,
         metrics_exclude=metrics_exclude,
         disabled_metrics=disabled_metrics,

@@ -313,6 +313,7 @@ class Daemon:
             burst_sampler=self.burst,
             energy=self.energy,
             host_stats=self.hoststats,
+            label_value_cap=cfg.label_value_cap,
         )
         # Hung-tick watchdog threshold: same formula as healthz_max_age
         # (a few missed intervals; floor for tiny test intervals), so the

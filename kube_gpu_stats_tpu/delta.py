@@ -72,6 +72,7 @@ import zlib
 from typing import NamedTuple, Sequence
 
 from . import snappy, wal
+from .cardinality import CardinalityShed, clamp_series
 from .resilience import CLOSED, OPEN, CircuitBreaker, TokenBucket
 from .supervisor import spawn
 from .validate import parse_exposition_interned, retry_after_seconds
@@ -739,6 +740,17 @@ class DeltaPublisher(PublishFollower):
                 # a FULL promotion (that would amplify exactly the load
                 # being shed).
                 return "shed", retry_after_seconds(exc.headers), hello
+            if exc.code == 413:
+                # Cardinality admission shed (ISSUE 16): the hub's
+                # series ledger is full. Same contract as 429 —
+                # known-unapplied, defer + re-diff, never a FULL
+                # promotion or a nack (a nack's forced FULL is the
+                # maximally-expensive frame to throw at a full hub).
+                # Default pacing even without Retry-After: a pre-hello
+                # proxy may strip the header.
+                return ("shed",
+                        retry_after_seconds(exc.headers, default=15.0),
+                        hello)
             if exc.code == 401:
                 # Credential problem, not a transport blip: count it
                 # separately so "the hub rejects our password" is
@@ -1272,9 +1284,14 @@ class DeltaIngest:
                  checkpoint_interval: float = 10.0,
                  proto_min: int = PROTO_MIN,
                  proto_max: int = PROTO_MAX,
-                 build: str | None = None) -> None:
+                 build: str | None = None,
+                 accountant=None) -> None:
         self._tracer = tracer
         self._expiry = expiry
+        # Cardinality admission (ISSUE 16): a SeriesAccountant shared
+        # with the hub's pull path, or None — the accept-everything
+        # contract every in-process user keeps.
+        self._accountant = accountant
         # Accepted wire-version window (ISSUE 14). The default is
         # everything this build can decode; --ingest-proto-min raises
         # the floor for census-gated rollouts (refuse stragglers with
@@ -1644,6 +1661,22 @@ class DeltaIngest:
             self._count_shed("memory")
             return (503, b"shed: session table at the memory fence\n",
                     {"Retry-After": "15"}), False
+        # Cardinality hard-cap pre-parse fence (ISSUE 16): a NEW
+        # source's FULL cannot be admitted while the series ledger is
+        # full, so refuse it before the multi-millisecond parse — a
+        # label-bomb flood costs a comparison per frame. Established
+        # sources pass: their replace/clamp verdict needs the parsed
+        # series count (apply() owns it), and refusing their recovery
+        # FULL would convert one shed into a 409 storm.
+        if (frame.kind == KIND_FULL and self._accountant is not None
+                and not self._session_established(frame.source)
+                and self._accountant.at_hard_cap()):
+            if acquired:
+                with self._inflight_lock:
+                    self._inflight -= 1
+            self._accountant.count_shed(frame.source, "hard_cap")
+            return (413, b"shed: series hard cap\n",
+                    {"Retry-After": "30"}), False
         return None, acquired
 
     # -- write side (HTTP POST threads) --------------------------------------
@@ -1717,6 +1750,18 @@ class DeltaIngest:
             # already ride the negotiated version.
             return (409, f"resync required: {exc}\n".encode(),
                     self.hello_headers())
+        except CardinalityShed as exc:
+            # Series hard cap (ISSUE 16): protocol-honest traffic — the
+            # frame was well-formed, the ledger is just full. Absolve
+            # like a 409 (a recovering peer's first frame must not stay
+            # quarantined), answer 413 + Retry-After: the publisher
+            # defers exactly like a 429 (the frame never touched
+            # session state, so the acked diff base survives), and a
+            # budget raise or an eviction re-admits the next FULL.
+            self._absolve([k for k in (peer_key, source_key) if k])
+            headers = self.hello_headers()
+            headers["Retry-After"] = f"{exc.retry_after:g}"
+            return 413, f"shed: {exc}\n".encode(), headers
         except ValueError as exc:  # unparseable FULL body
             # The frame DECODED, so the source identity is reliable —
             # quarantine that alone, never the peer: many pushers share
@@ -1782,8 +1827,22 @@ class DeltaIngest:
         # multi-millisecond parse. With sharded lanes the storm also
         # spreads the post-parse session work over the lane locks.
         entry = None
+        admitted_full = -1
+        offered_full = 0
         if frame.kind == KIND_FULL:
             series = parse_exposition_interned(frame.body)
+            offered_full = len(series)
+            if self._accountant is not None:
+                # Cardinality admission (ISSUE 16), pre-lock like the
+                # parse (the budgets are static scalars): clamp the
+                # FULL to its admitted prefix — series are born in body
+                # order, so the prefix keeps slot indexing stable and
+                # the source's DELTAs for admitted slots still apply.
+                # Past the hard cap a frame that would GROW the ledger
+                # from nothing raises CardinalityShed -> 413.
+                admitted_full = self._accountant.admit(frame.source,
+                                                       offered_full)
+                series = clamp_series(series, admitted_full)
             if self._entry_factory is not None:
                 entry = self._entry_factory(series)
         lane, store = self._route(frame.source)
@@ -1806,6 +1865,18 @@ class DeltaIngest:
                 # wait excluded.
                 lane.apply_seconds += (pre_lock_seconds
                                       + time.perf_counter() - locked_start)
+        if self._accountant is not None:
+            # Ledger update AFTER the lane lock released (the
+            # accountant's lock is a leaf — never held across lane
+            # work): a FULL replaced the source's footprint, a DELTA
+            # stamps the idle clock. A raised resync skips both.
+            if frame.kind == KIND_FULL:
+                self._accountant.install(
+                    frame.source, admitted_full, len(frame.body),
+                    kind="push",
+                    clamped=0 <= admitted_full < offered_full)
+            else:
+                self._accountant.touch(frame.source)
 
     def _apply_locked(self, lane: _Lane, store: dict, frame: Frame,
                       nbytes: int, entry) -> None:
@@ -1889,12 +1960,31 @@ class DeltaIngest:
                 lane, frame.source,
                 f"seq gap (session at {session.seq}, frame {frame.seq})")
         n = len(entry.series)
-        for slot in frame.slots:
+        slots, values = frame.slots, frame.values
+        overflow = 0
+        if (self._accountant is not None
+                and self._accountant.is_clamped(frame.source)):
+            # Clamped source (ISSUE 16): the publisher's slot space is
+            # its FULL series set, ours is the admitted prefix — slots
+            # past the prefix are the *dropped* series' updates, not
+            # corruption. Filter-and-count them instead of resyncing:
+            # a resync here would loop forever (the next FULL clamps
+            # identically) and re-parse the bomb every interval.
+            kept = [(s, v) for s, v in zip(slots, values) if s < n]
+            overflow = len(slots) - len(kept)
+            if overflow:
+                slots = [s for s, _ in kept]
+                values = [v for _, v in kept]
+        for slot in slots:
             if slot >= n:
                 raise self._resync(
                     lane, frame.source, f"slot {slot} out of range ({n})")
-        entry.apply_patch(frame.slots, frame.values, frame.source,
-                          native_mod=self._native_mod)
+        if slots:
+            entry.apply_patch(slots, values, frame.source,
+                              native_mod=self._native_mod)
+        if overflow:
+            self._accountant.count_shed(frame.source, "source_budget",
+                                        overflow)
         session.seq = frame.seq
         session.stamp(time.monotonic())
         session.frames += 1

@@ -241,7 +241,7 @@ class MetricsServer:
                  ingest_provider=None, burst_provider=None,
                  energy_provider=None, host_provider=None,
                  egress_provider=None, skew_provider=None,
-                 stores_provider=None,
+                 stores_provider=None, cardinality_provider=None,
                  prewarm_renders: bool = True,
                  ingest_read_deadline: float = 10.0):
         self._registry = registry
@@ -309,6 +309,13 @@ class MetricsServer:
         # payload `doctor --stores` reads. None (bare test servers)
         # 404s.
         self._stores = stores_provider
+        # Cardinality-admission snapshot (ISSUE 16, duck-typed:
+        # () -> dict): serves /debug/cardinality — the series ledger
+        # (live vs limits), top offenders by series and by shed,
+        # eviction history — the payload `doctor --cardinality` reads
+        # to name a label bomb's source. None (daemons, bare test
+        # servers) 404s.
+        self._cardinality = cardinality_provider
         # Fleet lens (fleetlens.FleetLens, duck-typed: anything with
         # rollup() -> dict): serves /debug/fleet — per-target health,
         # the anomaly list, SLO burn state, slow-node attribution.
@@ -749,6 +756,22 @@ class MetricsServer:
                             + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif (path == "/debug/cardinality"
+                        and outer._cardinality is not None):
+                    # Cardinality admission (ISSUE 16): the series
+                    # ledger vs its limits + top offenders — the
+                    # payload doctor --cardinality reads.
+                    import json
+
+                    try:
+                        payload = outer._cardinality()
+                    except Exception as exc:  # noqa: BLE001 - a status
+                        # walk must not 500 the whole debug surface.
+                        payload = {"enabled": False, "error": str(exc)}
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -799,6 +822,8 @@ class MetricsServer:
                         links += ["/debug/skew"]
                     if outer._stores is not None:
                         links += ["/debug/stores"]
+                    if outer._cardinality is not None:
+                        links += ["/debug/cardinality"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

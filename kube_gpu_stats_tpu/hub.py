@@ -44,9 +44,10 @@ from typing import Mapping, Sequence
 from . import delta as delta_mod
 from . import fleetlens, procstats, schema
 from . import wal as wal_mod
+from .cardinality import SeriesAccountant, clamp_series
 from .registry import (HistogramState, Registry, Series, SnapshotBuilder,
-                       contribute_egress_stats, contribute_push_stats,
-                       contribute_store_metrics)
+                       contribute_cardinality, contribute_egress_stats,
+                       contribute_push_stats, contribute_store_metrics)
 from .resilience import CircuitBreaker
 from .supervisor import spawn
 from .top import (_COUNTER_BY_NAME, _GAUGE_BY_NAME, ChipRow, Frame,
@@ -485,7 +486,12 @@ class Hub:
                  ingest_checkpoint: str = "",
                  ingest_checkpoint_interval: float = 10.0,
                  ingest_proto_min: int = 0,
-                 ingest_proto_max: int = 0) -> None:
+                 ingest_proto_max: int = 0,
+                 series_budget_per_source: int = 0,
+                 series_hard_cap: int = 0,
+                 series_high_watermark: int = 0,
+                 series_low_watermark: int = 0,
+                 series_idle_refreshes: int = 5) -> None:
         if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -619,8 +625,21 @@ class Hub:
         # control + quarantine + the warm-restart checkpoint live in
         # DeltaIngest; the hub only owns the cadence (checkpoint per
         # refresh, replay kicked at start) and the /readyz gate.
+        # Cardinality & memory admission (ISSUE 16): one ledger over
+        # BOTH state-birth sites (push apply, pull-parse install). The
+        # accountant always exists — kts_series_live/kts_source_series
+        # meter a hub with every knob at 0 — but with no limits set the
+        # admission calls degenerate to accounting.
+        self.cardinality = SeriesAccountant(
+            budget_per_source=series_budget_per_source,
+            hard_cap=series_hard_cap,
+            high_watermark=series_high_watermark,
+            low_watermark=series_low_watermark,
+            idle_refreshes=series_idle_refreshes,
+            tracer=self.tracer)
         self.delta = (delta_mod.DeltaIngest(
             tracer=self.tracer,
+            accountant=self.cardinality,
             expiry=max(10.0 * self._push_fence, 60.0),
             entry_factory=lambda series: _TargetCache(
                 "", series, pushed=True, wants_rollup=federate),
@@ -763,16 +782,30 @@ class Hub:
                 # Touched but unchanged: adopt the new signature so the
                 # stat path resumes short-circuiting next refresh.
                 entry.stat_sig = stat_sig
+                self.cardinality.touch(target)
                 done = time.monotonic()
                 return entry, done, done - fetch_start, None
             parse_start = time.monotonic()
             parse_ns = self.tracer.clock_ns() if self.tracer.enabled else 0
-            entry = _TargetCache(body, parse_exposition_interned(body),
-                                 stat_sig)
+            series = parse_exposition_interned(body)
+            # Pull-parse admission (ISSUE 16), the second state-birth
+            # site: the same budgets that clamp a push FULL clamp a
+            # pulled body before it becomes cached state. A
+            # CardinalityShed (hard cap, nothing installed yet)
+            # propagates as this target's fetch failure — counted and
+            # breaker-struck per target, already shed-accounted by the
+            # accountant.
+            offered = len(series)
+            admitted = self.cardinality.admit(target, offered)
+            series = clamp_series(series, admitted)
+            entry = _TargetCache(body, series, stat_sig)
             parse_seconds = time.monotonic() - parse_start
             if parse_ns:
                 self.tracer.aux_span("parse", parse_ns, target=target)
             self._parse_cache[target] = entry
+            self.cardinality.install(target, admitted, len(body),
+                                     kind="pull",
+                                     clamped=admitted < offered)
             done = time.monotonic()
             return entry, done, done - fetch_start, parse_seconds
 
@@ -1325,6 +1358,17 @@ class Hub:
                     self.delta.fleet_versions().items()):
                 builder.add(schema.FLEET_VERSION_COUNT, float(count),
                             (("version", version),))
+        # Cardinality admission self-metering (ISSUE 16): the series
+        # ledger, its sheds/evictions and the top-K offenders — on
+        # EVERY publish branch (a mid-bomb zero-target refresh must not
+        # blank the evidence). 'exposition' is the previous publish's
+        # series count (tick N exports N-1's size, the trace-digest
+        # convention — the first publish omits it rather than lie 0).
+        snapshot = self.registry.snapshot()
+        contribute_cardinality(
+            builder, self.cardinality,
+            exposition_series=(len(snapshot.series)
+                               if snapshot.timestamp > 0 else None))
         if self._federate:
             # Born at 0 on every federation root (increase() alerting):
             # non-federate hubs never re-export slice_* series, so the
@@ -1444,6 +1488,31 @@ class Hub:
             # view — and its cached state is evicted just below.
             known = set(targets)
             targets += [s for s in self.delta.sources() if s not in known]
+        # Cardinality ledger churn (ISSUE 16): advance the idle clock,
+        # release departed sources' footprints, then — above the high
+        # watermark — LRU-evict idle sources. Evicted PUSH sources
+        # leave the target list right here, so the prune loops below
+        # (parse cache, breakers, fleet baselines, delta session) sweep
+        # their state on the one churn path that already exists;
+        # evicted CONFIGURED pull targets stay listed (the operator
+        # chose them — only their cached state is released, and the
+        # next fetch re-admits them).
+        self.cardinality.tick()
+        alive_now = set(targets)
+        for source in self.cardinality.ledger_sources():
+            if source not in alive_now:
+                self.cardinality.forget(source)
+        evicted = set(self.cardinality.evict_idle())
+        if evicted:
+            keep = set(self._configured)
+            targets = [t for t in targets
+                       if t not in evicted or t in keep]
+            for target in evicted & keep:
+                self._hist_cache.pop(target, None)
+                try:
+                    del self._parse_cache[target]
+                except KeyError:
+                    pass
         if targets != self._targets:
             self._targets = targets
         alive = set(targets)
@@ -2131,8 +2200,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     # add_delta_push_flags), so spellings, env vars and defaults cannot
     # drift between the two CLIs. On a hub, --hub-url points at the
     # PARENT (root) hub of a federation tree.
-    from .config import (add_delta_push_flags, add_fleet_lens_flags,
-                         add_ingest_guard_flags,
+    from .config import (add_cardinality_flags, add_delta_push_flags,
+                         add_fleet_lens_flags, add_ingest_guard_flags,
+                         validate_cardinality_args,
                          validate_delta_push_args,
                          validate_fleet_lens_args,
                          validate_ingest_guard_args)
@@ -2140,6 +2210,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     add_fleet_lens_flags(parser)
     add_delta_push_flags(parser)
     add_ingest_guard_flags(parser)
+    add_cardinality_flags(parser)
     args = parser.parse_args(argv)
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
@@ -2150,6 +2221,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     guard_error = validate_ingest_guard_args(args)
     if guard_error:
         parser.error(guard_error)
+    cardinality_error = validate_cardinality_args(args)
+    if cardinality_error:
+        parser.error(cardinality_error)
     if args.ingest_lanes < 0 or args.ingest_lanes > 256:
         parser.error("--ingest-lanes must be 0 (auto) or 1..256")
     if not 1 <= args.remote_write_shards <= 64:
@@ -2333,7 +2407,12 @@ def main(argv: Sequence[str] | None = None) -> int:
               ingest_checkpoint=args.ingest_checkpoint,
               ingest_checkpoint_interval=args.ingest_checkpoint_interval,
               ingest_proto_min=args.ingest_proto_min,
-              ingest_proto_max=args.ingest_proto_max)
+              ingest_proto_max=args.ingest_proto_max,
+              series_budget_per_source=args.series_budget_per_source,
+              series_hard_cap=args.series_hard_cap,
+              series_high_watermark=args.series_high_watermark,
+              series_low_watermark=args.series_low_watermark,
+              series_idle_refreshes=args.series_idle_refreshes)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -2438,6 +2517,14 @@ def main(argv: Sequence[str] | None = None) -> int:
             "threads": supervisor.restart_report(),
         }
 
+    def cardinality_payload() -> dict:
+        # /debug/cardinality (ISSUE 16): the admission ledger — totals
+        # vs limits, top offenders by series and by shed — what doctor
+        # --cardinality reads to name a label bomb's source.
+        payload = hub.cardinality.debug_payload()
+        payload["enabled"] = hub.cardinality.enabled
+        return payload
+
     server = MetricsServer(
         hub.registry, host=args.listen_host, port=args.listen_port,
         healthz_max_age=max(3 * args.interval, 30.0),
@@ -2453,7 +2540,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         ingest_provider=hub.delta.handle if hub.delta is not None else None,
         egress_provider=egress_payload,
         skew_provider=skew_payload,
-        stores_provider=stores_payload)
+        stores_provider=stores_payload,
+        cardinality_provider=cardinality_payload)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

@@ -47,6 +47,7 @@ import time
 from typing import Callable, Mapping, NamedTuple, Protocol, Sequence
 
 from . import procstats, schema
+from .cardinality import LabelFence
 from .collectors import Collector, CollectorError, Device, Sample
 from .fleetlens import contribute_trace_digest
 from .ici import RateTracker
@@ -292,6 +293,7 @@ class PollLoop:
         burst_sampler=None,
         energy=None,
         host_stats=None,
+        label_value_cap: int = 0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._collector = collector
@@ -363,6 +365,14 @@ class PollLoop:
         # (trace_overhead_ns_per_span) — with --no-trace as the escape
         # hatch (tracer.enabled False = every call a cheap no-op).
         self.tracer = tracer if tracer is not None else Tracer()
+        # Label-churn fence (ISSUE 16): caps distinct values per
+        # attribution label key at the plan compiler, so a kubelet join
+        # minting a fresh pod per tick degrades to pod="overflow"
+        # aggregation instead of a per-tick series (and plan!)
+        # explosion. 0 = unfenced (the default): fence() is then an
+        # identity with no per-label work.
+        self._label_fence = LabelFence(label_value_cap,
+                                       tracer=self.tracer)
         # Burst sampler + energy accountant (ISSUE 8): the tick drains
         # each device's sub-tick power ring, hands the samples to the
         # per-pod joules integrator (trapezoid over burst samples when
@@ -1159,6 +1169,11 @@ class PollLoop:
         # invalidation.
         gen = self._filter_gen
         attribution = self._attribution.lookup(dev)
+        # Cardinality fence (ISSUE 16) BEFORE the plan key: an
+        # over-cap label value degrades to the "overflow" aggregate
+        # here, so churned values share one plan (and one series set)
+        # per device instead of recompiling — and growing — per tick.
+        attribution = self._label_fence.fence(attribution)
         key = tuple(sorted(attribution.items()))
         plan = self._plans.get(dev.device_id)
         if plan is not None and plan.key == key and plan.cfg_gen == gen:
@@ -1555,6 +1570,21 @@ class PollLoop:
         # without a profiler.
         builder.add(schema.RENDER_PREWARM_WAIT,
                     self._registry.render_wait_seconds)
+        # Cardinality self-metering (ISSUE 16): the last published
+        # snapshot's series count (what a scraper receives — tick N
+        # exports tick N-1's size, the trace-digest convention), plus
+        # the label fence's per-key hit counters when the fence is on
+        # (enabling it is a deliberate series-set change, the
+        # contribute_egress_stats convention).
+        builder.add(schema.SERIES_LIVE,
+                    float(len(self._registry.snapshot().series)),
+                    (("component", "exposition"),))
+        if self._label_fence.enabled:
+            fenced = self._label_fence.fenced_totals()
+            for label_key in sorted(fenced):
+                builder.add(schema.CARDINALITY_FENCED,
+                            float(fenced[label_key]),
+                            (("label", label_key),))
         builder.add(
             schema.SELF_INFO,
             1.0,

@@ -396,6 +396,41 @@ def contribute_egress_stats(builder: SnapshotBuilder, stats) -> None:
                         float(shard.get("dropped_total", 0)), label)
 
 
+def contribute_cardinality(builder: SnapshotBuilder, accountant,
+                           exposition_series: int | None = None,
+                           top_k: int = 10) -> None:
+    """Fold the cardinality-admission ledger (ISSUE 16) into a
+    snapshot: the live-series gauge, per-source/per-reason shed
+    counters (reasons born at 0 under source="other" so
+    increase()-based CardinalityShedActive alerting sees the first
+    shed), eviction counters, and the top-K offenders as
+    kts_source_series. One definition for every accountant owner so
+    the exported ledger can never drift from the in-process one — the
+    cardinality sim pins the two equal."""
+    from .cardinality import EVICT_REASONS, SHED_REASONS
+
+    builder.add(schema.SERIES_LIVE, float(accountant.live_series()),
+                (("component", "entries"),))
+    if exposition_series is not None:
+        builder.add(schema.SERIES_LIVE, float(exposition_series),
+                    (("component", "exposition"),))
+    shed = accountant.shed_totals()
+    for reason in SHED_REASONS:
+        shed.setdefault(("other", reason), 0)
+    for source, reason in sorted(shed):
+        builder.add(schema.CARDINALITY_SHED,
+                    float(shed[(source, reason)]),
+                    (("source", source), ("reason", reason)))
+    evicted = accountant.evicted_totals()
+    for reason in EVICT_REASONS:
+        builder.add(schema.CARDINALITY_EVICTED,
+                    float(evicted.get(reason, 0)),
+                    (("reason", reason),))
+    for source, live in accountant.top_sources(top_k):
+        builder.add(schema.SOURCE_SERIES, float(live),
+                    (("source", source),))
+
+
 def contribute_store_metrics(builder: SnapshotBuilder) -> None:
     """Fold the local-fault-survival families (ISSUE 15) from the
     process-global store registry (wal.store_report): durability state,

@@ -621,6 +621,66 @@ INGEST_QUARANTINED = MetricSpec(
     "means someone is POSTing garbage at /ingest/delta — the "
     "ingest_quarantine journal event (/debug/events) names the key.",
 )
+# Cardinality admission families (ISSUE 16): the series ledger, its
+# sheds/evictions, and the daemon-side label fence — see the
+# 'Cardinality admission' runbook in docs/OPERATIONS.md.
+
+SERIES_LIVE = MetricSpec(
+    "kts_series_live",
+    MetricType.GAUGE,
+    "Live series by component: 'entries' is the hub's admission ledger "
+    "(series held across all ingested/pulled target entries — what the "
+    "budgets and the hard cap bound), 'exposition' is the series count "
+    "of the last rendered snapshot (what a scraper actually receives). "
+    "Size budgets from 'entries'; it is the number that grows when a "
+    "label bomb lands.",
+    extra_labels=("component",),
+)
+CARDINALITY_SHED = MetricSpec(
+    "kts_cardinality_shed_total",
+    MetricType.COUNTER,
+    "Series refused by cardinality admission, by source and reason: "
+    "'source_budget' (a FULL over its source's series budget — the "
+    "frame still lands, clamped to the admitted prefix; only the NEW "
+    "series are dropped and existing series keep updating) and "
+    "'hard_cap' (the global ledger is full; a frame that would grow it "
+    "draws a 413 the publisher defers on, like a 429). Sources beyond "
+    "the accounting bound aggregate under source=\"other\". A steady "
+    "rate means a label bomb is being contained — doctor --cardinality "
+    "names the offender (CardinalityShedActive).",
+    extra_labels=("source", "reason"),
+)
+CARDINALITY_EVICTED = MetricSpec(
+    "kts_cardinality_evicted_total",
+    MetricType.COUNTER,
+    "Series evicted by the accountant above its high watermark, by "
+    "reason ('idle': the source had not updated for the configured "
+    "number of refreshes — LRU order, pruned through the hub's churn "
+    "path so parse cache, delta session and fleet baselines go "
+    "together). An evicted push source re-admits itself with one FULL "
+    "resync when it wakes; accounted loss, never a crash.",
+    extra_labels=("reason",),
+)
+SOURCE_SERIES = MetricSpec(
+    "kts_source_series",
+    MetricType.GAUGE,
+    "Live series for the top-K sources in the admission ledger (K "
+    "bounded so this family cannot itself explode). The budget-sizing "
+    "input: set --series-budget-per-source comfortably above the "
+    "honest fleet's max(kts_source_series).",
+    extra_labels=("source",),
+)
+CARDINALITY_FENCED = MetricSpec(
+    "kts_cardinality_fenced_total",
+    MetricType.COUNTER,
+    "Daemon-side label-fence hits by label key: plan compilations "
+    "where a label value past the per-key distinct-value cap "
+    "(--label-value-cap) degraded to the \"overflow\" aggregate "
+    "instead of minting a new series. Nonzero means attribution is "
+    "churning values (bad kubelet join, pod-churn storm) — the "
+    "cardinality_fenced journal event has the first occurrence.",
+    extra_labels=("label",),
+)
 HUB_WARM_RESTART_SESSIONS = MetricSpec(
     "kts_hub_warm_restart_sessions",
     MetricType.GAUGE,
@@ -886,6 +946,9 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     INGEST_NATIVE,
     INGEST_SHED,
     INGEST_QUARANTINED,
+    CARDINALITY_SHED,
+    CARDINALITY_EVICTED,
+    SOURCE_SERIES,
     HUB_WARM_RESTART_SESSIONS,
     HUB_WARM_RESTART_PENDING,
     HUB_WARM_RESTART_REPLAY_SECONDS,
@@ -1587,6 +1650,8 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_PUSH_FAILURES,
     SELF_PUSH_DROPPED,
     DELTA_SHED_HONORED,
+    SERIES_LIVE,
+    CARDINALITY_FENCED,
     *EGRESS_METRICS,
     *SKEW_METRICS,
     *LOCAL_FAULT_METRICS,

@@ -1,0 +1,567 @@
+"""Cardinality & memory admission tests (ISSUE 16): the series
+accountant's budget/hard-cap/eviction arithmetic, the label fence, the
+ingest-path integration (clamped FULLs that keep their delta chains,
+413 at the hard cap with publisher defer — never a resync loop), the
+pull-parse install, idle eviction through the hub's one churn path,
+the exported self-metering, doctor's verdict, and the long-churn
+object-count regression pin (satellite: no unbounded survivor maps)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kube_gpu_stats_tpu import delta, schema
+from kube_gpu_stats_tpu.cardinality import (CardinalityShed, LabelFence,
+                                            SeriesAccountant, clamp_series)
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.registry import Registry, SnapshotBuilder
+
+
+def _body(worker: int, duty: float, chips: int = 2) -> str:
+    builder = SnapshotBuilder()
+    for chip in range(chips):
+        labels = (
+            ("accel_type", "tpu-v5p"), ("chip", str(chip)),
+            ("device_path", f"/dev/accel{chip}"), ("uuid", ""),
+            ("slice", f"s{worker % 2}"), ("worker", str(worker)),
+            ("topology", "2x2"))
+        builder.add(schema.DEVICE_UP, 1.0, labels)
+        builder.add(schema.DUTY_CYCLE, duty + chip, labels)
+        builder.add(schema.POWER, 200.0 + duty, labels)
+    return builder.build().render()
+
+
+def _push_hub(**kwargs) -> Hub:
+    kwargs.setdefault("targets_provider", lambda: [])
+    kwargs.setdefault("interval", 10.0)
+    kwargs.setdefault("push_fence", 1e9)
+    return Hub([], **kwargs)
+
+
+def _feed(hub: Hub, encoder: delta.DeltaEncoder, body: str) -> int:
+    wire, _kind = encoder.encode_next(body)
+    code, _resp, _hdrs = hub.delta.handle(wire)
+    if code == 200:
+        encoder.ack()
+    else:
+        encoder.nack()
+    return code
+
+
+# --- accountant arithmetic --------------------------------------------------
+
+def test_accountant_disabled_is_accounting_only():
+    acc = SeriesAccountant()
+    assert not acc.enabled
+    assert acc.admit("a", 10_000) == 10_000
+    acc.install("a", 10_000, 500)
+    assert acc.live_series() == 10_000
+    assert acc.shed_totals() == {}
+
+
+def test_budget_clamps_counts_and_unclamps_on_raise():
+    acc = SeriesAccountant(budget_per_source=5)
+    assert acc.admit("a", 8) == 5
+    acc.install("a", 5, 100, clamped=True)
+    assert acc.is_clamped("a")
+    assert acc.shed_totals() == {("a", "source_budget"): 3}
+    # Every over-budget FULL counts again — the counter is series
+    # DROPPED, not sources clamped.
+    assert acc.admit("a", 8) == 5
+    assert acc.shed_totals() == {("a", "source_budget"): 6}
+    # A budget raise re-admits the whole set on the next FULL.
+    acc.budget_per_source = 10
+    assert acc.admit("a", 8) == 8
+    acc.install("a", 8, 100, clamped=False)
+    assert not acc.is_clamped("a")
+    assert acc.live_series() == 8
+
+
+def test_hard_cap_refuses_new_source_but_clamps_established():
+    acc = SeriesAccountant(hard_cap=10)
+    assert acc.admit("a", 6) == 6
+    acc.install("a", 6, 100)
+    # Established source replacing its set: clamped to headroom, never
+    # refused (existing series must keep updating).
+    assert acc.admit("b", 6) == 4
+    acc.install("b", 4, 100, clamped=True)
+    assert acc.live_series() == 10
+    assert acc.at_hard_cap()
+    # A brand-new source with zero headroom: refused outright.
+    with pytest.raises(CardinalityShed) as exc:
+        acc.admit("c", 1)
+    assert exc.value.retry_after > 0
+    assert acc.shed_totals()[("c", "hard_cap")] == 1
+    # An established source never draws the exception — its replace is
+    # floored at its own current footprint.
+    assert acc.admit("a", 8) == 6
+
+
+def test_evict_idle_prefers_biggest_source_at_seq_tie():
+    """A whole cohort going idle in one refresh must cost one label
+    bomb, not every small healthy source whose dict insertion order
+    happened to be older."""
+    acc = SeriesAccountant(high_watermark=100, low_watermark=90)
+    for i in range(10):
+        acc.install(f"small-{i}", 6, 60)
+    acc.install("bomb", 80, 800)
+    for _ in range(acc.idle_refreshes + 1):
+        acc.tick()
+    evicted = acc.evict_idle()
+    assert evicted == ["bomb"]
+    assert acc.live_series() == 60
+    assert acc.evicted_totals() == {"idle": 80}
+
+
+def test_evict_idle_skips_active_sources():
+    acc = SeriesAccountant(high_watermark=10, low_watermark=1,
+                           idle_refreshes=2)
+    acc.install("busy", 8, 80)
+    acc.install("quiet", 8, 80)
+    for _ in range(3):
+        acc.tick()
+        acc.touch("busy")
+    assert acc.evict_idle() == ["quiet"]
+    # Still above low watermark but nothing else is idle: a source
+    # that is still updating is never evicted for pressure.
+    assert acc.live_series() == 8
+    assert "busy" in acc.ledger_sources()
+
+
+def test_shed_ledger_aggregates_past_64_sources():
+    acc = SeriesAccountant(budget_per_source=1)
+    for i in range(80):
+        acc.count_shed(f"s-{i:03d}", "source_budget")
+    totals = acc.shed_totals()
+    distinct = {source for source, _ in totals}
+    assert len(distinct) <= 65  # 64 named + "other"
+    assert totals[("other", "source_budget")] == 16
+    assert sum(totals.values()) == 80
+
+
+def test_forget_releases_footprint():
+    acc = SeriesAccountant()
+    acc.install("a", 7, 70)
+    acc.forget("a")
+    assert acc.live_series() == 0
+    assert acc.live_bytes() == 0
+    assert acc.source_count() == 0
+
+
+def test_debug_payload_shape():
+    acc = SeriesAccountant(budget_per_source=3, hard_cap=100,
+                           high_watermark=50)
+    assert acc.admit("a", 5) == 3
+    acc.install("a", 3, 30, clamped=True)
+    payload = acc.debug_payload()
+    assert payload["live_series"] == 3
+    assert payload["limits"]["hard_cap"] == 100
+    assert payload["limits"]["low_watermark"] == 45  # 90% default
+    assert payload["clamped_sources"] == ["a"]
+    assert payload["top_sources"][0]["source"] == "a"
+    assert payload["shed"] == [
+        {"source": "a", "reasons": {"source_budget": 2}}]
+    json.dumps(payload)  # must be wire-clean
+
+
+def test_clamp_series_prefix():
+    series = [("m", (), 1.0), ("m", (), 2.0), ("m", (), 3.0)]
+    assert clamp_series(series, 2) == series[:2]
+    assert clamp_series(series, 3) is series
+    assert clamp_series(series, 99) is series
+
+
+# --- label fence ------------------------------------------------------------
+
+def test_label_fence_caps_distinct_values_with_stable_identity():
+    fence = LabelFence(value_cap=2)
+    assert fence.fence({"pod": "a"}) == {"pod": "a"}
+    assert fence.fence({"pod": "b"}) == {"pod": "b"}
+    assert fence.fence({"pod": "c"}) == {"pod": "overflow"}
+    # Known values keep passing — series identity for admitted values
+    # is stable, only NEW values degrade.
+    assert fence.fence({"pod": "a"}) == {"pod": "a"}
+    assert fence.fence({"pod": "d"}) == {"pod": "overflow"}
+    assert fence.fenced_totals() == {"pod": 2}
+    assert fence.admitted_values("pod") == 2
+
+
+def test_label_fence_disabled_returns_input_untouched():
+    fence = LabelFence(value_cap=0)
+    labels = {"pod": "a"}
+    assert fence.fence(labels) is labels
+    assert not fence.enabled
+
+
+# --- ingest integration -----------------------------------------------------
+
+def test_full_clamped_to_prefix_delta_chain_survives():
+    """Over-budget FULL: the admitted PREFIX is installed (series are
+    born in body order, so slot indexing stays stable), the source's
+    deltas keep applying to admitted slots, overflow slots are
+    dropped-and-counted — NEVER a resync (a resync would re-parse the
+    bomb forever)."""
+    hub = _push_hub(series_budget_per_source=4)
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, _body(0, 10.0)) == 200
+        assert hub.cardinality.live_series() == 4
+        assert hub.cardinality.is_clamped("w0")
+        # The encoder diffs against the FULL body it sent (6 series);
+        # a value change on chip 0 (slot < 4) and chip 1 (slots >= 4
+        # for POWER) rides one delta: admitted slots apply, overflow
+        # slots are tolerated.
+        assert _feed(hub, encoder, _body(0, 11.0)) == 200
+        assert hub.delta.resyncs_total == 0
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        line = next(l for l in text.splitlines()
+                    if l.startswith("accelerator_duty_cycle")
+                    and 'chip="0"' in l)
+        assert line.endswith(" 11"), line
+        shed = hub.cardinality.shed_totals()
+        assert shed[("w0", "source_budget")] >= 2
+    finally:
+        hub.stop()
+
+
+def test_hard_cap_pre_parse_413_and_established_survives():
+    hub = _push_hub(series_hard_cap=6)
+    try:
+        first = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, first, _body(0, 10.0)) == 200
+        assert hub.cardinality.at_hard_cap()
+        # New source at the cap: refused 413 + Retry-After BEFORE any
+        # parse (the pre-parse fence), publisher-classified as shed.
+        wire = delta.encode_full("w1", 2, 1, _body(1, 20.0))
+        code, resp, hdrs = hub.delta.handle(wire)
+        assert code == 413, (code, resp)
+        assert "Retry-After" in hdrs
+        # The established source keeps pushing FULLs (a restart) —
+        # clamped to its own footprint, never refused.
+        restart = delta.DeltaEncoder("w0", generation=2)
+        assert _feed(hub, restart, _body(0, 30.0)) == 200
+    finally:
+        hub.stop()
+
+
+def test_publisher_defers_413_like_429_then_lands_on_budget_raise():
+    """Satellite 3: a 413 is the shed retry class — no FULL promotion,
+    no failure/backoff, no resync — and once the operator raises the
+    cap (or eviction frees room), the SAME deferred series land on the
+    next push with zero resyncs."""
+    import random
+
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    hub = _push_hub(series_hard_cap=6, ingest_lanes=1)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    filler = delta.DeltaEncoder("filler", generation=1)
+    code, _resp, _hdrs = hub.delta.handle(
+        delta.encode_full("filler", 1, 1, _body(0, 5.0)))
+    assert code == 200
+
+    worker = Registry()
+
+    def publish(duty: float) -> None:
+        builder = SnapshotBuilder()
+        labels = (("accel_type", "tpu-v5p"), ("chip", "0"),
+                  ("device_path", "/dev/accel0"), ("uuid", ""))
+        builder.add(schema.DEVICE_UP, 1.0, labels)
+        builder.add(schema.DUTY_CYCLE, duty, labels)
+        worker.publish(builder.build())
+
+    publish(10.0)
+    publisher = delta.DeltaPublisher(
+        worker, f"http://127.0.0.1:{server.port}", source="node-new",
+        rng=random.Random(7))
+    try:
+        publisher.push_once()  # session FULL refused 413 at the cap
+        assert publisher.shed_honored_total == 1
+        assert publisher.failures_total == 0
+        assert publisher.resyncs_total == 0
+        assert publisher.consecutive_failures == 0
+        assert publisher._shed_until > time.monotonic()
+        # Deferring: no POST at all while the window holds.
+        frames = hub.delta.stats()["full_frames"]
+        publisher.push_once()
+        assert hub.delta.stats()["full_frames"] == frames
+        # The operator raises the cap; the deferral window passes; the
+        # very next push lands the full series set. No resync anywhere.
+        hub.cardinality.hard_cap = 100
+        publisher._shed_until = 0.0
+        publisher.push_once()
+        assert publisher.pushes_total == 1
+        assert publisher.shed_honored_total == 1
+        assert publisher.resyncs_total == 0
+        assert hub.delta.resyncs_total == 0
+        assert "node-new" in hub.cardinality.ledger_sources()
+    finally:
+        publisher.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_budget_raise_readmits_clamped_series_on_next_full():
+    """A clamped source's dropped series are DEFERRED, not lost: raise
+    the budget and the next FULL (here: a shape change, the encoder's
+    natural FULL trigger) lands every series — no resync, no manual
+    kick."""
+    hub = _push_hub(series_budget_per_source=4)
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, _body(0, 10.0)) == 200
+        assert hub.cardinality.live_series() == 4
+        hub.cardinality.budget_per_source = 0  # raise (off)
+        assert _feed(hub, encoder, _body(0, 10.0, chips=3)) == 200
+        assert hub.cardinality.live_series() == 9
+        assert not hub.cardinality.is_clamped("w0")
+        assert hub.delta.resyncs_total == 0
+    finally:
+        hub.stop()
+
+
+def test_pull_parse_install_clamped_and_accounted(tmp_path):
+    """The pull path births series through the same gate: a configured
+    target's parse is clamped to its admitted prefix and the ledger
+    carries it as kind=pull; the target STAYS configured (only its
+    cached state is bounded, the operator chose the target)."""
+    target = tmp_path / "w0.prom"
+    target.write_text(_body(0, 42.0))
+    hub = Hub([str(target)], interval=10.0,
+              series_budget_per_source=4)
+    try:
+        hub.refresh_once()
+        assert hub.cardinality.live_series() == 4
+        assert hub.cardinality.is_clamped(str(target))
+        payload = hub.cardinality.debug_payload()
+        (entry,) = [row for row in payload["top_sources"]
+                    if row["source"] == str(target)]
+        assert entry["kind"] == "pull"
+        assert str(target) in hub._targets
+        assert hub.cardinality.shed_totals()[
+            (str(target), "source_budget")] == 2
+    finally:
+        hub.stop()
+
+
+def test_idle_eviction_sweeps_push_state_through_churn_path():
+    """Above the high watermark, an idle push source is evicted through
+    the refresh's ONE churn path: ledger, target list, parse cache and
+    delta session all go together, the eviction is counted, and the
+    evicted worker's comeback is a clean 409 -> FULL re-admission."""
+    hub = _push_hub(series_budget_per_source=0, series_hard_cap=0,
+                    series_high_watermark=8, series_low_watermark=7,
+                    series_idle_refreshes=2)
+    try:
+        quiet = delta.DeltaEncoder("quiet", generation=1)
+        busy = delta.DeltaEncoder("busy", generation=1)
+        assert _feed(hub, quiet, _body(0, 10.0)) == 200
+        assert _feed(hub, busy, _body(1, 20.0)) == 200
+        assert hub.cardinality.live_series() == 12
+        for duty in (21.0, 22.0, 23.0):
+            assert _feed(hub, busy, _body(1, duty)) == 200
+            hub.refresh_once()
+        assert "quiet" not in hub.cardinality.ledger_sources()
+        assert "quiet" not in hub._targets
+        assert "quiet" not in hub._parse_cache
+        assert "quiet" not in hub.delta.sources()
+        assert "busy" in hub.delta.sources()
+        assert hub.cardinality.evicted_totals() == {"idle": 6}
+        text = hub.registry.snapshot().render()
+        assert 'kts_cardinality_evicted_total{reason="idle"} 6' in text
+        # Comeback: the evicted session's next delta draws a resync,
+        # the FULL re-admits — standard churn recovery, nothing new.
+        wire, _kind = quiet.encode_next(_body(0, 11.0))
+        assert hub.delta.handle(wire)[0] == 409
+        quiet.nack()
+        assert _feed(hub, quiet, _body(0, 11.0)) == 200
+    finally:
+        hub.stop()
+
+
+def test_self_metering_exported_with_born_at_zero_reasons():
+    hub = _push_hub(series_budget_per_source=100)
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, _body(0, 10.0)) == 200
+        hub.refresh_once()
+        # The exposition-size gauge reports the PREVIOUS publish (the
+        # tick N-1 convention), so it appears from the second refresh.
+        hub.refresh_once()
+        text = hub.registry.snapshot().render()
+        assert 'kts_series_live{component="entries"} 6' in text
+        assert 'kts_series_live{component="exposition"}' in text
+        assert 'kts_source_series{source="w0"} 6' in text
+        # Reasons born at 0 under source="other": increase()-based
+        # alerting sees the FIRST real shed.
+        for reason in ("source_budget", "hard_cap"):
+            assert (f'kts_cardinality_shed_total{{source="other",'
+                    f'reason="{reason}"}} 0') in text
+        assert 'kts_cardinality_evicted_total{reason="idle"} 0' in text
+    finally:
+        hub.stop()
+
+
+# --- /debug/cardinality + doctor -------------------------------------------
+
+def test_debug_cardinality_endpoint_and_doctor_check():
+    from kube_gpu_stats_tpu.doctor import check_cardinality
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    hub = _push_hub(series_budget_per_source=4)
+    server = MetricsServer(
+        hub.registry, host="127.0.0.1", port=0,
+        ingest_provider=hub.delta.handle,
+        cardinality_provider=lambda: dict(
+            hub.cardinality.debug_payload(),
+            enabled=hub.cardinality.enabled))
+    server.start()
+    try:
+        encoder = delta.DeltaEncoder("w0", generation=1)
+        assert _feed(hub, encoder, _body(0, 10.0)) == 200
+        base = f"http://127.0.0.1:{server.port}"
+        payload = json.loads(urllib.request.urlopen(
+            base + "/debug/cardinality", timeout=10).read())
+        assert payload["enabled"] is True
+        assert payload["live_series"] == 4
+        assert payload["clamped_sources"] == ["w0"]
+        result = check_cardinality(base)
+        assert result.status == "warn"  # clamped source named
+        assert "w0" in result.detail
+    finally:
+        server.stop()
+        hub.stop()
+
+
+def test_doctor_cardinality_verdict_texts():
+    from kube_gpu_stats_tpu.doctor import cardinality_verdict
+
+    status, detail = cardinality_verdict(
+        {"live_series": 12, "sources": 2, "limits": {"hard_cap": 100},
+         "enabled": True})
+    assert status == "ok" and "12 series live" in detail
+    status, detail = cardinality_verdict(
+        {"live_series": 100, "sources": 3,
+         "limits": {"hard_cap": 100}, "enabled": True,
+         "clamped_sources": ["bomb"], "shed_total": 50,
+         "shed": [{"source": "bomb", "reasons": {"hard_cap": 50}}],
+         "top_sources": [{"source": "bomb", "series": 90}]})
+    assert status == "warn"
+    assert "AT HARD CAP" in detail and "bomb" in detail
+
+
+# --- config flags -----------------------------------------------------------
+
+def test_cardinality_flag_validation():
+    import argparse
+
+    from kube_gpu_stats_tpu.config import (add_cardinality_flags,
+                                           validate_cardinality_args)
+
+    parser = argparse.ArgumentParser()
+    add_cardinality_flags(parser)
+    good = parser.parse_args(["--series-hard-cap", "1000",
+                              "--series-high-watermark", "900"])
+    assert validate_cardinality_args(good) is None
+    bad = parser.parse_args(["--series-hard-cap", "100",
+                             "--series-high-watermark", "200"])
+    assert "high-watermark" in validate_cardinality_args(bad)
+    orphan = parser.parse_args(["--series-low-watermark", "10"])
+    assert "low-watermark" in validate_cardinality_args(orphan)
+
+
+# --- poll-loop label fence --------------------------------------------------
+
+def test_poll_loop_fences_churning_pod_label(tmp_path):
+    """A workload churning its pod label every tick (the classic
+    per-job pod explosion) degrades to pod="overflow" past the cap:
+    the plan cache and the series set stop growing, and the fence's
+    hit counter rides the exposition."""
+    from kube_gpu_stats_tpu.collectors.mock import MockCollector
+    from kube_gpu_stats_tpu.poll import PollLoop
+
+    class ChurningAttribution:
+        def __init__(self) -> None:
+            self.n = 0
+
+        def lookup(self, dev):
+            self.n += 1
+            return {"pod": f"job-{self.n}", "namespace": "ml",
+                    "container": "w"}
+
+    reg = Registry()
+    loop = PollLoop(MockCollector(num_devices=1), reg, deadline=5.0,
+                    attribution=ChurningAttribution(),
+                    label_value_cap=3)
+    try:
+        for _ in range(10):
+            loop.tick()
+        series = reg.snapshot().series
+        pods = {dict(s.labels).get("pod") for s in series
+                if "pod" in dict(s.labels)}
+        # 3 admitted values + the overflow aggregate, never 10.
+        assert "overflow" in pods
+        assert len(pods) <= 4, pods
+        fenced = loop._label_fence.fenced_totals()
+        assert fenced.get("pod", 0) >= 6
+        text = reg.snapshot().render()
+        assert 'kts_cardinality_fenced_total{label="pod"}' in text
+    finally:
+        loop.stop()
+
+
+# --- long-churn object-count regression (satellite 1) ----------------------
+
+def test_long_churn_keeps_hub_and_intern_pools_flat():
+    """30 churn cycles of come-and-go push sources: every per-target
+    survivor map (parse cache, hist cache, breakers, fleet baselines,
+    delta sessions, cardinality ledger) must track the LIVE set, and
+    the validate.py intern pools must stay under their wholesale-clear
+    bound — sizes at cycle 10 equal sizes at cycle 30."""
+    from kube_gpu_stats_tpu import validate
+
+    hub = _push_hub(push_fence=1e9)
+    hub.delta._expiry = 0.04
+
+    def sizes() -> dict:
+        return {
+            "parse_cache": len(hub._parse_cache),
+            "hist_cache": len(hub._hist_cache),
+            "breakers": len(hub._breakers),
+            "fleet": len(hub.fleet._targets) if hub.fleet else 0,
+            "sessions": len(hub.delta.sources()),
+            "ledger": hub.cardinality.source_count(),
+        }
+
+    try:
+        snap10 = None
+        for cycle in range(30):
+            for k in range(4):
+                encoder = delta.DeltaEncoder(
+                    f"churn-{cycle:03d}-{k}", generation=cycle + 1)
+                wire, _kind = encoder.encode_next(_body(k, 10.0 + cycle))
+                assert hub.delta.handle(wire)[0] == 200
+            hub.refresh_once()
+            time.sleep(0.05)  # past expiry: this cycle's sources die
+            if cycle == 10:
+                hub.refresh_once()  # sweep before measuring
+                snap10 = sizes()
+        hub.refresh_once()
+        snap30 = sizes()
+        assert snap30 == snap10, (snap10, snap30)
+        # The dead generations left nothing behind anywhere.
+        assert snap30["sessions"] == 0
+        assert snap30["ledger"] == 0
+        assert snap30["parse_cache"] == 0
+        # Intern pools are bounded memos with wholesale clear.
+        assert len(validate._NAME_POOL) <= validate.BOUNDED_MEMO_MAX
+        assert len(validate._LABEL_CACHE) <= validate.BOUNDED_MEMO_MAX
+    finally:
+        hub.stop()

@@ -311,6 +311,30 @@ def test_degraded_store_overhead_under_10pct_of_tick_budget():
     assert best["degraded_lost_counted"] == 100, best
 
 
+def test_cardinality_admission_overhead_under_2pct_of_ingest():
+    """ISSUE 16 acceptance pin: the cardinality accountant's hot-path
+    bookkeeping (admit + install per FULL) must stay under 2% of the
+    full ingest path's per-series cost (measured ~0.2% — two absolute
+    measurements ratioed, not a noisy A/B difference). Guards a
+    regression where admission grows per-series work (a per-label walk,
+    a sort, an allocation) onto every frame of every healthy pusher.
+    Best of 3 rounds so a co-tenant noise burst can't fail the pin."""
+    from kube_gpu_stats_tpu.bench import measure_cardinality_admission
+
+    best = None
+    for _ in range(3):
+        result = measure_cardinality_admission(
+            pushers=128, frames=20, bomb_series=20_000, bomb_frames=2)
+        assert result is not None
+        if best is None or result["cardinality_admission_overhead_pct"] \
+                < best["cardinality_admission_overhead_pct"]:
+            best = result
+    assert best["cardinality_admission_overhead_pct"] < 2.0, best
+    # The bomb was clamped: the ledger holds the budget, not the bomb
+    # (the RSS half of the claim is pinned in tools/cardinality_sim.py).
+    assert best["bomb_live_series"] < 2_000, best
+
+
 def test_render_cost_bounded_at_32_chip_full_label_scale():
     """Round-1 verdict item 7 (done round 3): series growth must not
     silently eat the scrape budget. Render a 32-chip snapshot with the

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Diff the two newest BENCH_r*.json runs (ISSUE 16 satellite,
+`make bench-diff`): every shared numeric field side by side with the
+relative delta, flagged when it moves outside a noise band — the
+reviewer's perf-diff surface for a PR that lands a new BENCH file.
+
+Report-only by design: the benchmarks run on whatever box CI landed
+on, so a single-sample delta is a conversation starter, not a gate
+(the gates live in tests/test_latency.py with their own headroom).
+Always exits 0 unless the files themselves are unreadable.
+
+Noise bands are relative and field-class based: sub-millisecond
+timings and GC pauses jitter hardest (50%), most timings/through-
+puts get 25%, and counts/sizes that should be deterministic get 5%.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+_RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# (suffix/substring, relative noise band) — first match wins.
+_BANDS = (
+    ("gc_max_pause_ms", 0.50),
+    ("p99", 0.50),
+    ("_bytes", 0.05),
+    ("_count", 0.05),
+    ("series", 0.05),
+    ("", 0.25),
+)
+
+
+def band_for(field: str) -> float:
+    for needle, band in _BANDS:
+        if needle in field:
+            return band
+    return 0.25
+
+
+def newest_two(root: pathlib.Path) -> list[pathlib.Path]:
+    """The two newest runs by rN, numerically — the sequence has gaps
+    (r12/r14 never landed), so lexical sort or mtime would lie."""
+    runs = sorted(
+        ((int(_RUN_RE.search(p.name).group(1)), p)
+         for p in root.glob("BENCH_r*.json") if _RUN_RE.search(p.name)),
+        key=lambda pair: pair[0])
+    return [p for _n, p in runs[-2:]]
+
+
+def load_numeric(path: pathlib.Path) -> dict:
+    data = json.loads(path.read_text())
+    return {k: float(v) for k, v in data.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def diff(old_path: pathlib.Path, new_path: pathlib.Path) -> list[str]:
+    old = load_numeric(old_path)
+    new = load_numeric(new_path)
+    lines = [f"bench-diff: {old_path.name} -> {new_path.name}"]
+    flagged: list[str] = []
+    rows: list[str] = []
+    for field in sorted(old.keys() & new.keys()):
+        a, b = old[field], new[field]
+        if a == b:
+            continue
+        if a == 0.0:
+            rel = float("inf") if b else 0.0
+        else:
+            rel = (b - a) / abs(a)
+        band = band_for(field)
+        mark = ""
+        if abs(rel) > band:
+            mark = f"  << outside +/-{band:.0%} noise band"
+            flagged.append(field)
+        rows.append(f"  {field}: {a:g} -> {b:g} "
+                    f"({rel:+.1%}){mark}")
+    lines.extend(rows or ["  (no shared numeric field changed)"])
+    added = sorted(new.keys() - old.keys())
+    removed = sorted(old.keys() - new.keys())
+    if added:
+        lines.append("  new field(s): " + ", ".join(added))
+    if removed:
+        lines.append("  removed field(s): " + ", ".join(removed))
+    if flagged:
+        lines.append(f"  {len(flagged)} field(s) moved outside their "
+                     f"noise band: " + ", ".join(flagged))
+    else:
+        lines.append("  all shared fields within their noise bands")
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root", default=str(ROOT),
+                        help="directory holding BENCH_r*.json")
+    args = parser.parse_args(argv)
+    runs = newest_two(pathlib.Path(args.root))
+    if len(runs) < 2:
+        print(f"bench-diff: need two BENCH_r*.json under {args.root}, "
+              f"found {len(runs)} — nothing to compare")
+        return 0
+    try:
+        for line in diff(runs[0], runs[1]):
+            print(line)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"bench-diff: unreadable run file: {exc}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Cardinality-admission smoke (ISSUE 16, `make cardinality-sim`): a
+real hub behind a real MetricsServer takes a label bomb — 2 of 16
+pushers POST FULL frames whose series are unique every wave (~1M
+unique series attempted) while the other 14 keep pushing their normal
+6-series bodies — and must:
+
+- **Shed with exact accounting**: every dropped series lands in the
+  shed ledger, and the three views of that ledger — the in-process
+  accountant, the /debug/cardinality payload, and the exported
+  kts_cardinality_shed_total counters — agree exactly. Clamps are
+  deterministic, so the bomb's source_budget shed count is pinned to
+  the arithmetic (offered - budget per frame).
+- **Hold RSS under a pinned bound**: the bomb's unique series never
+  accumulate (clamped FULLs keep only the admitted prefix; at the
+  hard cap a ledger-growing frame is refused 413 before parse), so
+  process RSS growth across the whole bomb stays under the pin.
+- **Leave healthy pushers byte-identical**: the 14 healthy workers'
+  exposition series on the bombed hub match a control hub (same
+  healthy fleet, no bomb) byte for byte.
+- **Recover when the bomb stops**: idle eviction above the high
+  watermark reclaims the bombs' footprint through the churn path, and
+  a brand-new source that drew 413 at the cap is admitted afterward —
+  without a resync.
+
+Exit 0 with a PASS line, else 1 with evidence. Wired into `make ci`;
+the admission hot-path cost is CI-pinned separately in
+tests/test_latency.py (bench.measure_cardinality_admission).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import resource
+import sys
+import urllib.request
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from chaos_sim import SessionFleet, post_frame  # noqa: E402
+
+HEALTHY = 14
+BOMBS = 2
+WAVES = 10
+BOMB_SERIES = 50_000          # unique series per bomb frame
+BUDGET = 500                  # per-source series budget
+HARD_CAP = 700                # ledger-wide cap
+HIGH = 650                    # idle-eviction watermark
+IDLE_REFRESHES = 2
+RSS_PIN_MB = 384              # max RSS growth across the bomb
+
+
+def bomb_body(bomb: int, wave: int, n: int = BOMB_SERIES) -> str:
+    """One bomb frame: n series of a KNOWN family, every label value
+    unique to this (bomb, wave) — the classic unbounded-pod-label
+    explosion. slice="zz-bomb" keeps slice rollups for the healthy
+    workers clean."""
+    lines = ["# TYPE accelerator_duty_cycle gauge"]
+    for j in range(n):
+        lines.append(
+            f'accelerator_duty_cycle{{accel_type="tpu-v5p",chip="0",'
+            f'pod="bomb-{bomb}-{wave}-{j}",slice="zz-bomb",'
+            f'worker="bomb{bomb}"}} 1')
+    return "\n".join(lines) + "\n"
+
+
+def healthy_lines(text: str) -> str:
+    """The healthy workers' per-worker series, sorted — the byte-
+    identical comparison surface (self-metrics and rollups carry no
+    worker label and differ by design)."""
+    wanted = tuple(f'worker="{i}"' for i in range(HEALTHY))
+    return "\n".join(sorted(
+        line for line in text.splitlines()
+        if any(w in line for w in wanted)))
+
+
+def shed_from_exposition(text: str) -> dict:
+    """{(source, reason): n} parsed back out of the rendered
+    kts_cardinality_shed_total counters (zero rows dropped to match
+    shed_totals())."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line.startswith("kts_cardinality_shed_total{"):
+            continue
+        labels, value = line.rsplit(" ", 1)
+        fields = dict(
+            part.split("=", 1)
+            for part in labels[labels.index("{") + 1:-1].split('",')
+            if "=" in part)
+        source = fields["source"].strip('"')
+        reason = fields["reason"].strip('"')
+        if float(value):
+            out[(source, reason)] = int(float(value))
+    return out
+
+
+def run(verbose: bool) -> int:
+    from kube_gpu_stats_tpu.delta import encode_full
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+
+    def make_hub():
+        hub = Hub([], targets_provider=lambda: [], interval=0.2,
+                  push_fence=1e9, ingest_lanes=2,
+                  series_budget_per_source=BUDGET,
+                  series_hard_cap=HARD_CAP,
+                  series_high_watermark=HIGH,
+                  series_idle_refreshes=IDLE_REFRESHES)
+        server = MetricsServer(
+            hub.registry, host="127.0.0.1", port=0,
+            trace_provider=hub.tracer,
+            ingest_provider=hub.delta.handle,
+            cardinality_provider=lambda: dict(
+                hub.cardinality.debug_payload(),
+                enabled=hub.cardinality.enabled))
+        server.start()
+        return hub, server
+
+    hub, server = make_hub()          # the bombed hub
+    control, control_server = make_hub()  # same fleet, no bomb
+    bomb_sources = [f"http://bomb-{b}:9400/metrics" for b in range(BOMBS)]
+    bomb_gens = [1000 + b for b in range(BOMBS)]
+    intruder = "http://late-joiner:9400/metrics"
+    try:
+        fleet = SessionFleet(server.port, HEALTHY, prefix="healthy")
+        peer = SessionFleet(control_server.port, HEALTHY,
+                            prefix="healthy")
+        for name, outcomes in (("bombed", fleet.seed()),
+                               ("control", peer.seed())):
+            bad = [o for o in outcomes if o[1] != 200]
+            if bad:
+                problems.append(f"{name} hub: seeding failed: {bad[:3]}")
+        hub.refresh_once()
+        control.refresh_once()
+        rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+        # --- the bomb: WAVES waves of fresh unique series ------------
+        attempted = 0
+        statuses: dict = {}
+        intruder_413 = None
+        for wave in range(WAVES):
+            for name, outcomes in (
+                    ("bombed", fleet.delta_wave(40.0 + wave)),
+                    ("control", peer.delta_wave(40.0 + wave))):
+                bad = [o for o in outcomes if o[1] != 200]
+                if bad:
+                    problems.append(
+                        f"{name} hub: healthy deltas failed beside the "
+                        f"bomb: {bad[:3]}")
+            for b in range(BOMBS):
+                wire = encode_full(bomb_sources[b], bomb_gens[b],
+                                   wave + 1, bomb_body(b, wave))
+                status, _retry = post_frame(server.port, wire,
+                                            timeout=60.0)
+                attempted += BOMB_SERIES
+                statuses[status] = statuses.get(status, 0) + 1
+            if wave == 2:
+                # Mid-bomb, the ledger sits at the hard cap: a brand-
+                # new source must be refused 413 + Retry-After before
+                # any parse work.
+                status, retry = post_frame(
+                    server.port,
+                    encode_full(intruder, 7, 1, fleet.bodies[0]))
+                intruder_413 = (status, retry)
+            hub.refresh_once()
+            control.refresh_once()
+        rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rss_growth_mb = (rss_after - rss_before) / 1024.0
+
+        if statuses.get(200, 0) != BOMBS * WAVES:
+            problems.append(
+                f"bomb frames not all clamped-and-accepted: {statuses} "
+                f"(an established source's FULL must land, clamped)")
+        if attempted < 1_000_000:
+            problems.append(
+                f"bomb too small: {attempted} unique series attempted, "
+                f"want >= 1M")
+        if intruder_413 is None or intruder_413[0] != 413 \
+                or intruder_413[1] is None:
+            problems.append(
+                f"new source at the hard cap answered {intruder_413}, "
+                f"want (413, Retry-After)")
+        if rss_growth_mb > RSS_PIN_MB:
+            problems.append(
+                f"RSS grew {rss_growth_mb:.0f} MB across the bomb "
+                f"(pin: {RSS_PIN_MB} MB) — shed series are "
+                f"accumulating somewhere")
+
+        # --- exact accounting: three views of one ledger -------------
+        # (the last wave's refresh already published the counters; an
+        # extra no-traffic refresh here would advance the idle clock)
+        in_process = {k: v for k, v in
+                      hub.cardinality.shed_totals().items() if v}
+        exported = shed_from_exposition(hub.registry.snapshot().render())
+        debug = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/cardinality",
+            timeout=10).read())
+        via_debug = {
+            (row["source"], reason): n
+            for row in debug.get("shed", [])
+            for reason, n in (row.get("reasons") or {}).items() if n}
+        if exported != in_process:
+            problems.append(
+                f"exported shed ledger != in-process ledger: "
+                f"{exported} vs {in_process}")
+        if via_debug != in_process:
+            problems.append(
+                f"/debug/cardinality shed ledger != in-process ledger: "
+                f"{via_debug} vs {in_process}")
+        # The clamp arithmetic is deterministic: every bomb-0 frame
+        # offers BOMB_SERIES and keeps BUDGET.
+        want_b0 = WAVES * (BOMB_SERIES - BUDGET)
+        got_b0 = in_process.get((bomb_sources[0], "source_budget"), 0)
+        if got_b0 != want_b0:
+            problems.append(
+                f"bomb-0 source_budget shed {got_b0}, want exactly "
+                f"{want_b0} ({WAVES} x ({BOMB_SERIES} - {BUDGET}))")
+        live = hub.cardinality.live_series()
+        if live > HARD_CAP:
+            problems.append(
+                f"{live} series live > hard cap {HARD_CAP}")
+
+        # --- healthy pushers byte-identical --------------------------
+        bombed_healthy = healthy_lines(hub.registry.snapshot().render())
+        control_healthy = healthy_lines(
+            control.registry.snapshot().render())
+        if bombed_healthy != control_healthy:
+            diff = [
+                f"  bombed:  {a!r}\n  control: {b!r}"
+                for a, b in zip(bombed_healthy.splitlines(),
+                                control_healthy.splitlines())
+                if a != b][:3]
+            problems.append(
+                "healthy workers' series differ from the control hub:\n"
+                + ("\n".join(diff) or "  (line counts differ)"))
+        if not bombed_healthy:
+            problems.append("healthy comparison surface empty "
+                            "(filter broken?)")
+
+        # --- recovery: bomb stops, idle eviction reclaims ------------
+        for wave in range(IDLE_REFRESHES + 2):
+            bad = [o for o in fleet.delta_wave(90.0 + wave)
+                   if o[1] != 200]
+            if bad:
+                problems.append(
+                    f"post-bomb healthy deltas failed: {bad[:3]}")
+            hub.refresh_once()
+        live_after = hub.cardinality.live_series()
+        if live_after > HIGH:
+            problems.append(
+                f"no recovery: {live_after} series still live after "
+                f"the bomb stopped (high watermark {HIGH})")
+        evicted = hub.cardinality.evicted_totals().get("idle", 0)
+        if not evicted:
+            problems.append(
+                "kts_cardinality_evicted_total{reason=idle} never "
+                "rose — the bombs' footprint was not reclaimed")
+        status, _retry = post_frame(
+            server.port, encode_full(intruder, 8, 1, fleet.bodies[0]))
+        if status != 200:
+            problems.append(
+                f"late joiner still refused ({status}) after the bomb "
+                f"stopped — 413 must clear without a resync")
+        if verbose:
+            print(f"  bomb: {attempted} unique series attempted, "
+                  f"{live} live at peak (cap {HARD_CAP}), "
+                  f"shed ledger {sum(in_process.values())} across "
+                  f"{len(in_process)} rows, RSS +{rss_growth_mb:.0f} MB "
+                  f"(pin {RSS_PIN_MB}), {evicted} series idle-evicted, "
+                  f"late joiner admitted post-bomb")
+    finally:
+        server.stop()
+        hub.stop()
+        control_server.stop()
+        control.stop()
+
+    if not problems:
+        print(f"cardinality-sim PASS: {attempted} unique series from "
+              f"{BOMBS} label bombs shed with exact 3-way ledger "
+              f"agreement, RSS +{rss_growth_mb:.0f} MB "
+              f"(pin {RSS_PIN_MB}), {HEALTHY} healthy pushers "
+              f"byte-identical to control, idle eviction re-admitted "
+              f"the late joiner")
+        return 0
+    print("cardinality-sim FAIL:")
+    for problem in problems:
+        print(f"  {problem}")
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
