@@ -4,7 +4,7 @@
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
 # (helm render when the binary exists, the static chart tests always),
 # wheel + console-script smoke in a scratch venv (no index needed).
-ci: native lint
+ci: native lint bench-diff
 	python -m pytest tests/ -q -m 'not chaos'
 	python tools/fleet_sim.py
 	python tools/federation_sim.py
@@ -145,11 +145,14 @@ skew-sim:
 cardinality-sim:
 	python tools/cardinality_sim.py --verbose
 
-# Compare the two newest BENCH_r*.json runs field by field with noise
-# bands — report-only (exit 0), the reviewer's diff surface for perf
-# PRs.
+# Compare the two newest BENCH_r*.json runs field by field, noise
+# bands derived from the BENCH_r* history — CI-GATING (ISSUE 17): a
+# PINNED field (ingest storm, scrape p99, poll max_hz, merge cold/p50,
+# ingest CPU%) drifting past its band in the bad direction exits
+# nonzero unless BENCH_WAIVERS.json names it. In `make ci`. Runbook:
+# OPERATIONS.md "Performance ledger".
 bench-diff:
-	python tools/bench_diff.py
+	python tools/bench_diff.py --gate
 
 # Perf smoke (<60 s): reduced-tick simulated harness + 64-worker hub
 # merge, no real-chip probing. A quick number for iterating on a perf

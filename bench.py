@@ -37,6 +37,7 @@ def _delta_fields(line: dict, quick: bool = False) -> None:
     bench failure."""
     from kube_gpu_stats_tpu.bench import (measure_delta_federation,
                                           measure_ingest_storm,
+                                          measure_ingest_storm_procs,
                                           measure_quiet_tick_delta)
 
     fed = measure_delta_federation()
@@ -52,17 +53,41 @@ def _delta_fields(line: dict, quick: bool = False) -> None:
         line["delta_quiet_tick_bytes"] = quiet["quiet_delta_bytes"]
         line["delta_full_snapshot_bytes"] = quiet["full_bytes"]
         line["delta_quiet_tick_ratio"] = quiet["ratio"]
-    if not quick:
+    if quick:
+        # --quick storm mode (ISSUE 17): a 2k-pusher, 2-wave in-process
+        # storm — same machinery, ~15x cheaper — normalized to the
+        # per-frame figure shared with the full run so the perf ledger
+        # has an ingest number from smoke runs too.
+        storm = measure_ingest_storm(pushers=2_000, waves=2)
+        if storm is not None:
+            line["delta_ingest_storm_us_per_frame"] = round(
+                storm["delta_ingest_10k_ms_per_refresh"] * 1000.0
+                / storm["pushers"], 2)
+            line["ingest_cpu_pct"] = storm["ingest_cpu_pct"]
+    else:
         storm = measure_ingest_storm()
         if storm is not None:
             line["delta_ingest_10k_ms_per_refresh"] = storm[
                 "delta_ingest_10k_ms_per_refresh"]
+            line["delta_ingest_storm_us_per_frame"] = round(
+                storm["delta_ingest_10k_ms_per_refresh"] * 1000.0
+                / storm["pushers"], 2)
             line["ingest_cpu_pct"] = storm["ingest_cpu_pct"]
             line["resync_storm_recovery_s"] = storm[
                 "resync_storm_recovery_s"]
             line["resync_storm_dropped"] = storm["resync_storm_dropped"]
             line["ingest_lanes"] = storm["lanes"]
             line["ingest_native"] = storm["native"]
+        # The same storm through 4 SO_REUSEPORT acceptor processes
+        # (ISSUE 17 tentpole 3): real HTTP clients against the pool's
+        # public port, with the per-proc counter conservation law
+        # checked (acceptance pin for --ingest-procs).
+        storm_mp = measure_ingest_storm_procs()
+        if storm_mp is not None:
+            line["delta_ingest_10k_procs4_ms_per_refresh"] = storm_mp[
+                "delta_ingest_procs_ms_per_refresh"]
+            line["ingest_procs"] = storm_mp["procs"]
+            line["ingest_procs_conserved"] = storm_mp["conserved"]
         # Survival-layer figures (ISSUE 12): warm-restart resume rate +
         # replay wall at 2k sessions, and the shed-priority outcome of
         # a 4x-budget stampede (CI pins live in tests/test_latency.py).

@@ -934,6 +934,115 @@ def measure_ingest_storm(pushers: int = 10_000, waves: int = 3,
         return None
 
 
+def measure_ingest_storm_procs(procs: int = 4, pushers: int = 10_000,
+                               waves: int = 3, interval: float = 10.0,
+                               client_threads: int = 16) -> dict | None:
+    """The 10k-pusher storm through the SO_REUSEPORT acceptor pool
+    (ISSUE 17 tentpole 3): the same frames as measure_ingest_storm, but
+    POSTed by real HTTP clients (persistent connections, one per client
+    thread) against the pool's public port — so the number prices what
+    multi-proc mode actually changes: connection accept/parse/relay
+    across ``procs`` processes instead of one GIL. Alongside the wave
+    wall time it checks the conservation law (per-proc accepted
+    counters sum exactly to the hub's own frame totals), the acceptance
+    pin for ``--ingest-procs``.
+
+    Bounded and failure-proof: returns None rather than failing the
+    bench (and on platforms without SO_REUSEPORT)."""
+    try:
+        import concurrent.futures
+        import http.client
+        import socket
+
+        from .delta import (CONTENT_TYPE, INGEST_PATH, encode_delta,
+                            encode_full)
+        from .hub import Hub
+        from .ingestproc import IngestProcPool
+        from .validate import parse_exposition_interned
+
+        if not hasattr(socket, "SO_REUSEPORT"):
+            return None
+        hub = Hub([], targets_provider=lambda: [], interval=interval)
+        pool = None
+        try:
+            pool = IngestProcPool(hub.delta.handle, host="127.0.0.1",
+                                  port=0, procs=procs, parent_port=0)
+            pool.start()
+            sources = [f"http://node-{i:05d}:9400/metrics"
+                       for i in range(pushers)]
+            bodies = [build_pusher_body(i) for i in range(pushers)]
+            probe = parse_exposition_interned(bodies[0])
+            slot_by_name = {name: slot for slot, (name, _labels, _v)
+                            in enumerate(probe)}
+            churn_slots = sorted(
+                (slot_by_name["accelerator_duty_cycle"],
+                 slot_by_name["accelerator_power_watts"]))
+
+            def drain(chunk) -> None:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", pool.port, timeout=30.0)
+                try:
+                    for wire in chunk:
+                        conn.request(
+                            "POST", INGEST_PATH, body=wire,
+                            headers={"Content-Type": CONTENT_TYPE})
+                        resp = conn.getresponse()
+                        resp.read()
+                        assert resp.status == 200, resp.status
+                finally:
+                    conn.close()
+
+            def blast(wires) -> float:
+                ways = max(1, client_threads)
+                per = -(-len(wires) // ways)
+                start = time.monotonic()
+                with concurrent.futures.ThreadPoolExecutor(ways) as tp:
+                    futures = [tp.submit(drain, wires[i:i + per])
+                               for i in range(0, len(wires), per)]
+                    for future in futures:
+                        future.result()
+                return (time.monotonic() - start) * 1000.0
+
+            seed_ms = blast([encode_full(source, i + 1, 1, bodies[i])
+                             for i, source in enumerate(sources)])
+            hub.refresh_once()
+            wave_ms = []
+            for wave in range(waves):
+                wave_ms.append(blast([
+                    encode_delta(source, i + 1, wave + 2,
+                                 [(churn_slots[0], 50.0 + wave + i * 1e-3),
+                                  (churn_slots[1], 300.0 + wave)])
+                    for i, source in enumerate(sources)]))
+            hub.refresh_once()
+            ingest = hub.delta
+            hub_frames = (ingest.full_frames_total
+                          + ingest.delta_frames_total
+                          + ingest.duplicate_frames_total)
+            accepted = pool.accepted_total()
+            per_proc = {idx: s["accepted"]
+                        for idx, s in pool.proc_stats().items()}
+        finally:
+            if pool is not None:
+                pool.stop()
+            hub.stop()
+        return {
+            "procs": procs,
+            "pushers": pushers,
+            "seed_ms": round(seed_ms, 1),
+            "delta_ingest_procs_ms_per_refresh": round(
+                statistics.median(wave_ms), 1),
+            "ingest_procs_cpu_pct": round(
+                100.0 * statistics.median(wave_ms) / (interval * 1000.0),
+                2),
+            "accepted_total": accepted,
+            "hub_frames_total": hub_frames,
+            "conserved": accepted == hub_frames == pushers * (waves + 1),
+            "per_proc_accepted": per_proc,
+        }
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None
+
+
 def measure_warm_restart(pushers: int = 2_000, tail_fraction: float = 0.02,
                          interval: float = 10.0) -> dict | None:
     """Warm-restart recovery at fleet scale (ISSUE 12 acceptance): seed

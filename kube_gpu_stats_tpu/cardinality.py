@@ -104,6 +104,12 @@ class SeriesAccountant:
     def __init__(self, *, budget_per_source: int = 0, hard_cap: int = 0,
                  high_watermark: int = 0, low_watermark: int = 0,
                  idle_refreshes: int = 5, tracer=None) -> None:
+        # Config generation (ISSUE 17): bumped by every knob write —
+        # construction and the runtime raises/lowers the operator makes
+        # (``hub.cardinality.hard_cap = N``). The ingest hot path
+        # caches its enabled/disabled verdict against this stamp
+        # instead of re-deriving it per frame.
+        self.config_gen = 0
         self.budget_per_source = max(0, budget_per_source)
         self.hard_cap = max(0, hard_cap)
         self.high_watermark = max(0, high_watermark)
@@ -124,12 +130,42 @@ class SeriesAccountant:
         self._shed: dict[tuple[str, str], int] = {}
         self._evicted: dict[str, int] = {}
 
+    # The admission knobs are properties so a runtime write (tests and
+    # operators assign them directly) bumps config_gen — the hot path's
+    # cached verdict refreshes on the very next frame.
+    @property
+    def budget_per_source(self) -> int:
+        return self._budget_per_source
+
+    @budget_per_source.setter
+    def budget_per_source(self, value: int) -> None:
+        self._budget_per_source = value
+        self.config_gen += 1
+
+    @property
+    def hard_cap(self) -> int:
+        return self._hard_cap
+
+    @hard_cap.setter
+    def hard_cap(self, value: int) -> None:
+        self._hard_cap = value
+        self.config_gen += 1
+
+    @property
+    def high_watermark(self) -> int:
+        return self._high_watermark
+
+    @high_watermark.setter
+    def high_watermark(self, value: int) -> None:
+        self._high_watermark = value
+        self.config_gen += 1
+
     @property
     def enabled(self) -> bool:
         """Any knob on? False = the accept-everything contract (no
         per-frame lock taken on the ingest path at all)."""
-        return bool(self.budget_per_source or self.hard_cap
-                    or self.high_watermark)
+        return bool(self._budget_per_source or self._hard_cap
+                    or self._high_watermark)
 
     # -- refresh clock --------------------------------------------------------
 

@@ -144,6 +144,33 @@ MAX_FRAME_BYTES = 64 * 1024 * 1024
 
 _F64 = struct.Struct("<d")
 
+# Native DELTA decode (ISSUE 17): loaded lazily on the first decode so
+# import order can't matter; None after a failed probe keeps the
+# pure-Python loop as the permanent oracle/fallback. _NATIVE_FRAME is
+# the whole-frame fast path (header + slots + extensions in one C
+# call); _NATIVE_DECODE the slot-walk-only half, still used when the
+# frame decode punts (and by the FULL-frame-adjacent callers).
+_NATIVE_DECODE = None
+_NATIVE_FRAME = None
+_NATIVE_DECODE_LOADED = False
+
+
+def _native_decode_slots():
+    global _NATIVE_DECODE, _NATIVE_FRAME, _NATIVE_DECODE_LOADED
+    if not _NATIVE_DECODE_LOADED:
+        _NATIVE_DECODE_LOADED = True
+        try:
+            from . import native as native_pkg
+
+            mod = native_pkg.load_delta_decode()
+            _NATIVE_DECODE = mod.decode_delta_slots if mod else None
+            _NATIVE_FRAME = getattr(mod, "decode_delta_frame", None) \
+                if mod else None
+        except Exception:  # pragma: no cover - import-environment quirks
+            _NATIVE_DECODE = None
+            _NATIVE_FRAME = None
+    return _NATIVE_DECODE
+
 
 class ResyncRequired(ValueError):
     """The receiver cannot apply this delta frame; the publisher must
@@ -319,6 +346,20 @@ def decode_frame_raw(data: bytes) -> Frame:
     already hold the decompressed bytes (the spill queue's legacy
     wire-frame recovery sniffs the magic off its own decompression and
     must not pay a second one)."""
+    # Whole-frame native fast path (ISSUE 17): the common-case DELTA —
+    # header, source, slot walk, extension walk — in one C call. None
+    # for anything unusual (FULLs, skew, malformed bytes, unbounded-int
+    # varints): this Python path below stays the oracle and owns every
+    # error verdict; parity is pinned by the decode differential fuzz.
+    if not _NATIVE_DECODE_LOADED:
+        _native_decode_slots()
+    if _NATIVE_FRAME is not None:
+        decoded = _NATIVE_FRAME(data)
+        if decoded is not None:
+            (source, generation, seq, slots_t, values_t, proto, caps,
+             build) = decoded
+            return Frame(KIND_DELTA, source, generation, seq, None,
+                         slots_t, values_t, proto, caps, build)
     if data[:4] != MAGIC:
         raise ValueError("bad magic")
     if len(data) < 6:
@@ -356,44 +397,61 @@ def decode_frame_raw(data: bytes) -> Frame:
         return Frame(kind, source, generation, seq, body, (), (),
                      proto, caps, build)
     count, pos = _read_varint(data, pos)
-    slots = []
-    values = []
-    slot = 0
-    # Inlined varint walk (single-byte fast path): this loop runs once
-    # per changed slot per pushed frame — at 10k-pusher fan-in the
-    # _read_varint call overhead alone was a visible slice of ingest
-    # CPU. Bounds surface as IndexError -> the same "truncated varint"
-    # verdict the helper raises.
     n = len(data)
-    append_slot = slots.append
-    append_value = values.append
-    unpack_from = _F64.unpack_from
-    try:
-        for i in range(count):
-            byte = data[pos]
-            pos += 1
-            if byte < 0x80:
-                gap = byte
-            else:
-                gap = byte & 0x7F
-                shift = 7
-                while True:
-                    byte = data[pos]
-                    pos += 1
-                    gap |= (byte & 0x7F) << shift
-                    if not byte & 0x80:
-                        break
-                    shift += 7
-                    if shift > 63:
-                        raise ValueError("varint too long")
-            slot = slot + gap if i else gap
-            if pos + 8 > n:
-                raise ValueError("truncated delta value")
-            append_slot(slot)
-            append_value(unpack_from(data, pos)[0])
-            pos += 8
-    except IndexError:
-        raise ValueError("truncated varint") from None
+    # Native slot walk (ISSUE 17): one C call instead of a Python loop
+    # per changed slot — the decode half of the 10k-pusher ingest bill.
+    # Semantics (and error strings) are pinned identical to the Python
+    # loop below by the differential fuzz in tests/test_delta.py; the C
+    # side returns None (and this falls through) for adversarial frames
+    # whose slot arithmetic needs Python's unbounded ints.
+    decoded = None
+    native = _NATIVE_DECODE
+    if native is None and not _NATIVE_DECODE_LOADED:
+        native = _native_decode_slots()
+    if native is not None and count <= 0xFFFF_FFFF:
+        decoded = native(data, pos, count)
+    if decoded is not None:
+        slots_t, values_t, pos = decoded
+    else:
+        slots = []
+        values = []
+        slot = 0
+        # Inlined varint walk (single-byte fast path): this loop runs
+        # once per changed slot per pushed frame — at 10k-pusher fan-in
+        # the _read_varint call overhead alone was a visible slice of
+        # ingest CPU. Bounds surface as IndexError -> the same
+        # "truncated varint" verdict the helper raises.
+        append_slot = slots.append
+        append_value = values.append
+        unpack_from = _F64.unpack_from
+        try:
+            for i in range(count):
+                byte = data[pos]
+                pos += 1
+                if byte < 0x80:
+                    gap = byte
+                else:
+                    gap = byte & 0x7F
+                    shift = 7
+                    while True:
+                        byte = data[pos]
+                        pos += 1
+                        gap |= (byte & 0x7F) << shift
+                        if not byte & 0x80:
+                            break
+                        shift += 7
+                        if shift > 63:
+                            raise ValueError("varint too long")
+                slot = slot + gap if i else gap
+                if pos + 8 > n:
+                    raise ValueError("truncated delta value")
+                append_slot(slot)
+                append_value(unpack_from(data, pos)[0])
+                pos += 8
+        except IndexError:
+            raise ValueError("truncated varint") from None
+        slots_t = tuple(slots)
+        values_t = tuple(values)
     build = ""
     if proto >= 2:
         # Trailing extension blocks (skipped by tag unless known):
@@ -404,7 +462,7 @@ def decode_frame_raw(data: bytes) -> Frame:
     if pos != n:
         raise ValueError("trailing bytes after delta changes")
     return Frame(kind, source, generation, seq, None,
-                 tuple(slots), tuple(values), proto, caps, build)
+                 slots_t, values_t, proto, caps, build)
 
 
 def new_generation() -> int:
@@ -1292,6 +1350,17 @@ class DeltaIngest:
         # with the hub's pull path, or None — the accept-everything
         # contract every in-process user keeps.
         self._accountant = accountant
+        # Generation-stamped admission fast path (ISSUE 17): the hub
+        # always wires an accountant (the ledger powers
+        # kts_series_live), but with every knob off the per-frame
+        # admission work — admit/clamp on FULLs, touch/is_clamped on
+        # DELTAs — is pure tax. Cache the enabled verdict against the
+        # accountant's config generation: the hot path pays one int
+        # compare per frame, and an operator's runtime knob write
+        # (which bumps config_gen) lands on the very next frame.
+        self._acct_gen = -1
+        self._acct_on = False
+        self._refresh_acct_verdict()
         # Accepted wire-version window (ISSUE 14). The default is
         # everything this build can decode; --ingest-proto-min raises
         # the floor for census-gated rollouts (refuse stragglers with
@@ -1308,6 +1377,7 @@ class DeltaIngest:
         if build is None:
             from . import __version__ as build
         self._build = build
+        self._hello: dict[str, str] | None = None  # built on first use
         self._skew_lock = threading.Lock()
         self.skew_refused_total = 0
         self._skew_peers: dict[str, dict] = {}
@@ -1457,13 +1527,19 @@ class DeltaIngest:
         ingest response (200/409/426 alike): the publisher's
         negotiation input. Header cost is a few dozen bytes against a
         snappy frame — cheaper than any scheme that makes the
-        publisher ASK."""
-        return {
-            HELLO_PROTO_MIN: str(self._proto_min),
-            HELLO_PROTO_MAX: str(self._proto_max),
-            HELLO_CAPS: str(CAPS_CURRENT),
-            HELLO_BUILD: self._build,
-        }
+        publisher ASK. The stamps are fixed at construction, so the
+        per-frame cost is one dict copy (hoisted from four str()
+        builds per response, ISSUE 17); a copy because two refusal
+        paths attach Retry-After to the returned mapping."""
+        hello = self._hello
+        if hello is None:
+            hello = self._hello = {
+                HELLO_PROTO_MIN: str(self._proto_min),
+                HELLO_PROTO_MAX: str(self._proto_max),
+                HELLO_CAPS: str(CAPS_CURRENT),
+                HELLO_BUILD: self._build,
+            }
+        return dict(hello)
 
     def _skew_response(self, version: int) -> tuple[int, bytes, dict]:
         """The one 426 refusal shape both the decoded path and the
@@ -1621,6 +1697,24 @@ class DeltaIngest:
         lane = self._lanes[lane_of(source, len(self._lanes))]
         return source in lane.sessions or source in self._pending_replay
 
+    def _refresh_acct_verdict(self) -> None:
+        self._acct_live()
+
+    def _acct_live(self) -> bool:
+        """Generation-checked admission verdict: True when an
+        accountant is wired AND any knob is on. The common case (knobs
+        off) costs one attribute read + int compare per frame; a knob
+        write bumps the accountant's config_gen and refreshes the
+        verdict on the very next frame."""
+        acct = self._accountant
+        if acct is None:
+            return False
+        gen = acct.config_gen
+        if gen != self._acct_gen:
+            self._acct_gen = gen
+            self._acct_on = acct.enabled
+        return self._acct_on
+
     def _admit(self, frame: Frame) -> tuple[tuple | None, bool]:
         """(shed verdict or None, in-flight slot acquired). Shed order
         is the survival contract: chatty sources' DELTAs go first (429 —
@@ -1668,7 +1762,7 @@ class DeltaIngest:
         # sources pass: their replace/clamp verdict needs the parsed
         # series count (apply() owns it), and refusing their recovery
         # FULL would convert one shed into a 409 storm.
-        if (frame.kind == KIND_FULL and self._accountant is not None
+        if (frame.kind == KIND_FULL and self._acct_live()
                 and not self._session_established(frame.source)
                 and self._accountant.at_hard_cap()):
             if acquired:
@@ -1829,10 +1923,11 @@ class DeltaIngest:
         entry = None
         admitted_full = -1
         offered_full = 0
+        acct_on = self._acct_live()
         if frame.kind == KIND_FULL:
             series = parse_exposition_interned(frame.body)
             offered_full = len(series)
-            if self._accountant is not None:
+            if acct_on:
                 # Cardinality admission (ISSUE 16), pre-lock like the
                 # parse (the budgets are static scalars): clamp the
                 # FULL to its admitted prefix — series are born in body
@@ -1869,13 +1964,18 @@ class DeltaIngest:
             # Ledger update AFTER the lane lock released (the
             # accountant's lock is a leaf — never held across lane
             # work): a FULL replaced the source's footprint, a DELTA
-            # stamps the idle clock. A raised resync skips both.
+            # stamps the idle clock. A raised resync skips both. With
+            # every knob off (acct_on False) the install still runs —
+            # the ledger powers kts_series_live either way — but the
+            # per-DELTA idle-clock stamp is skipped: nothing evicts
+            # without a watermark, so the stamp is pure per-frame tax.
             if frame.kind == KIND_FULL:
                 self._accountant.install(
-                    frame.source, admitted_full, len(frame.body),
+                    frame.source, admitted_full if admitted_full >= 0
+                    else offered_full, len(frame.body),
                     kind="push",
                     clamped=0 <= admitted_full < offered_full)
-            else:
+            elif acct_on:
                 self._accountant.touch(frame.source)
 
     def _apply_locked(self, lane: _Lane, store: dict, frame: Frame,
@@ -1962,8 +2062,7 @@ class DeltaIngest:
         n = len(entry.series)
         slots, values = frame.slots, frame.values
         overflow = 0
-        if (self._accountant is not None
-                and self._accountant.is_clamped(frame.source)):
+        if self._acct_on and self._accountant.is_clamped(frame.source):
             # Clamped source (ISSUE 16): the publisher's slot space is
             # its FULL series set, ours is the admitted prefix — slots
             # past the prefix are the *dropped* series' updates, not

@@ -144,6 +144,19 @@ _PATCH_ROLLUP = 3   # frame-fold slice_* rollup cell
 _PATCH_HIST = 4     # drop the cached histogram fold
 _PATCH_DIGEST = 5   # drop the cached fleet digest
 
+# Compiled-program survival across target churn (ISSUE 17): patch
+# programs and merge-plan skeletons are pure functions of
+# (target, interned series shape), so they live in module/hub-level
+# memos instead of dying with the _TargetCache entry — a worker that
+# warm-restarts (new FULL, same shape) or churns out of DNS and back
+# re-parses its body but never recompiles. Cleared wholesale at the
+# cap, the bounded_memo idiom: churn that large means the memo isn't
+# helping anyway. The skeletons hold only interned/shared objects
+# (label tuples, fold keys, specs), so the resident cost per entry is
+# a few pointers per series.
+_PLAN_MEMO_MAX = 4096
+_PROGRAM_MEMO: dict[tuple, tuple] = {}
+
 
 class _TargetCache:
     """One target's zero-reparse ingest state (ISSUE 2 tentpole).
@@ -189,7 +202,7 @@ class _TargetCache:
                  "chip_plan", "rollup_plan", "hist_local", "frame_rows",
                  "frame_rollups", "fleet_digest", "stat_sig", "pushed",
                  "wants_rollup", "patch_actions", "patch_program",
-                 "value_slab")
+                 "value_slab", "shape")
 
     def __init__(self, body: str, series: list,
                  stat_sig: tuple | None = None,
@@ -239,6 +252,27 @@ class _TargetCache:
         # the pure-Python oracle path.
         self.patch_program: tuple | None = None
         self.value_slab = None
+        # Interned schema shape (ISSUE 17): the per-slot (name, labels)
+        # identity of this entry's series, values excluded — the key
+        # under which compiled merge plans and patch programs survive
+        # target churn. Lazy: computed the first time a plan or program
+        # wants it, while ``series`` is still resident.
+        self.shape: tuple | None = None
+
+    def shape_key(self) -> tuple:
+        """Two flat tuples of interned objects (names, label tuples):
+        cheap to hash (string hashes are memoized, label tuples are
+        pointer-shared via validate's pools) and equal exactly when a
+        rebuilt parse has the same series shape slot-for-slot — the
+        condition under which a memoized plan skeleton or patch
+        program is valid for this entry."""
+        shape = self.shape
+        if shape is None:
+            series = self.series
+            shape = self.shape = (
+                tuple(entry[0] for entry in series),
+                tuple(entry[1] for entry in series))
+        return shape
 
     def apply_patch(self, slots, values, target: str,
                     native_mod=None) -> None:
@@ -411,13 +445,29 @@ class _TargetCache:
         gate as patch_actions caching: pair indices compiled against a
         half-built plan set would freeze wrong positions in. Returns
         None while the gate isn't met (the Python oracle carries those
-        frames)."""
+        frames).
+
+        Memoized across entry lives keyed by (target, interned shape,
+        wants_rollup) — ISSUE 17: every component of the program
+        (kind bytes, plan pair indices, fold keys, columns) is a pure
+        function of that key, because pair positions are deterministic
+        for a fixed series shape. A source that resyncs with a FULL of
+        the same shape (warm restart, churn-and-return) gets its
+        program back without recompiling; only the value slab — the
+        one value-dependent piece — is rebuilt from the live series."""
         if self.chip_plan is None or (
                 self.rollup_plan is None and self.wants_rollup):
             return None
         import array as array_mod
         import sys as sys_mod
 
+        memo_key = (target, self.shape_key(), self.wants_rollup)
+        cached = _PROGRAM_MEMO.get(memo_key)
+        if cached is not None:
+            self.value_slab = array_mod.array(
+                "d", (entry[2] for entry in self.series))
+            self.patch_program = cached
+            return cached
         n = len(self.series)
         kinds = bytearray(n)
         chip_idx = array_mod.array("i")
@@ -444,6 +494,9 @@ class _TargetCache:
         self.patch_program = (bytes(kinds), chip_idx.tobytes(),
                               rollup_idx.tobytes(),
                               tuple(keys), tuple(cols))
+        if len(_PROGRAM_MEMO) >= _PLAN_MEMO_MAX:
+            _PROGRAM_MEMO.clear()
+        _PROGRAM_MEMO[memo_key] = self.patch_program
         return self.patch_program
 
 
@@ -597,6 +650,27 @@ class Hub:
         # sorted() in _merge_chip_series re-sorts the same few thousand
         # tuples every cycle. Bounded like validate's label cache.
         self._key_cache: dict[tuple, tuple] = {}
+        # Merge-plan skeleton memo (ISSUE 17): value-free plan skeletons
+        # keyed by (target, spec set, interned series shape), surviving
+        # _TargetCache eviction so target churn / same-shape resyncs
+        # re-stamp values instead of recompiling. Deliberately NOT
+        # pruned with departed targets in _refresh_targets — surviving
+        # churn is the point; the wholesale cap bounds it instead.
+        self._plan_memo: dict[tuple, tuple] = {}
+        # Native frame-fold (ISSUE 17): the refresh's fold-replay inner
+        # loop (rows[key] = row.clone_at(at)) in C when the extension is
+        # built; clone_at stays the differential oracle
+        # (tests/test_render_differential.py pins object-for-object
+        # parity). Gated on the same flag as native ingest so
+        # --no-native-ingest runs a fully pure-Python hub.
+        self._fold_native = None
+        if native_ingest:
+            try:
+                from . import native as native_pkg
+
+                self._fold_native = native_pkg.load_fold()
+            except Exception:  # pragma: no cover - import quirks
+                self._fold_native = None
         # Flight recorder (ISSUE 4): each refresh is one "cycle" trace —
         # fetch / frame_fold / merge / publish phases plus per-target
         # fetch+parse aux spans from the pool threads — and per-target
@@ -672,6 +746,11 @@ class Hub:
         # and the refresh loop beats it per cycle.
         self._supervisor = None
         self.heartbeat = None
+        # Extra self-metric contributors (ISSUE 17): components wired
+        # OUTSIDE the hub — today the SO_REUSEPORT IngestProcPool's
+        # kts_ingest_proc_* families — append series onto every
+        # publish without hub.py importing them.
+        self._extra_metrics: list = []
         # Store-fault journal feed (ISSUE 15): disk_fault /
         # store_recovered events from every WAL store land in this
         # process's shared journal.
@@ -1130,6 +1209,7 @@ class Hub:
         fold_mark = tracer.mark()
         rows: dict[tuple, ChipRow] = {}
         rollups: dict[tuple, float] = {}
+        fold_native = self._fold_native
         for (target, entry), at in zip(entries, ats):
             trows = entry.frame_rows
             if trows is None:
@@ -1138,8 +1218,11 @@ class Hub:
                 fold_target(entry.series_dicts, target, 0.0, trows, trollups)
                 entry.frame_rows = trows
                 entry.frame_rollups = trollups
-            for key, row in trows.items():
-                rows[key] = row.clone_at(at)
+            if fold_native is not None:
+                fold_native.fold_rows(rows, trows, at)
+            else:
+                for key, row in trows.items():
+                    rows[key] = row.clone_at(at)
             rollups.update(entry.frame_rollups)
         frame = Frame(rows, errors, rollups)
         frame.rates(self._previous)
@@ -1429,6 +1512,12 @@ class Hub:
         # watch item's first suspect, also in /debug/ticks meta).
         builder.add(schema.RENDER_PREWARM_WAIT,
                     self.registry.render_wait_seconds)
+        for contribute in self._extra_metrics:
+            try:
+                contribute(builder)
+            except Exception:  # noqa: BLE001 - a broken contributor
+                # must cost its own families, never the publish.
+                log.exception("extra metrics provider failed")
         self.registry.publish(builder.build())
         if self.delta is not None:
             # Warm-restart checkpoint (ISSUE 12): written HERE, on the
@@ -1664,7 +1753,7 @@ class Hub:
                 builder.add(schema.HUB_STRAGGLER_RATIO,
                             min(rates) / max(rates), labels)
 
-    def _build_merge_plan(self, target: str, series: Sequence,
+    def _build_merge_plan(self, target: str, entry: "_TargetCache",
                           specs: Mapping[str, schema.MetricSpec]) -> tuple:
         """Pre-resolve one target's re-export merge work for the given
         spec set — the per-target series index of the incremental
@@ -1678,14 +1767,40 @@ class Hub:
         change). The slot map lets a delta patch rebuild exactly the
         changed pairs in place (labels can't change in a delta).
 
+        The value-free SKELETON of the plan — dedup keys, specs,
+        disambiguated label tuples, slot map — is a pure function of
+        (target, interned series shape, spec set) and is memoized
+        across entry lives (ISSUE 17): a rebuilt parse with the same
+        shape (body changed values only, or the target churned out and
+        back) re-stamps current values into fresh Series pairs and
+        skips the per-slot spec lookup / worker disambiguation /
+        sorted-key build entirely. The ``pairs`` list is always fresh
+        per plan (apply_patch replaces its cells in place); the
+        frozenset/slot_map are immutable-by-convention and shared.
+
         The frozenset is the replay fast path: a target whose keys are
         disjoint from every earlier target's merges with two C-level set
         ops and one list extend. ``self_dup`` (a target colliding with
         ITSELF — duplicate series in one exposition) forces the per-key
         path, because the frozenset would silently swallow the
         duplicate instead of counting and dropping it."""
+        series = entry.series
+        # id(specs) is a safe key component: the only spec sets reaching
+        # this path are the PER_CHIP_SPECS / FEDERATED_SPECS module
+        # constants, which live for the process.
+        memo_key = (target, id(specs), entry.shape_key())
+        skeleton = self._plan_memo.get(memo_key)
+        if skeleton is not None:
+            keys, pair_meta, self_dup, slot_map, pair_slots = skeleton
+            pairs = [(key, Series(spec, label_tuple,
+                                  float(series[slot][2])))
+                     for (key, spec, label_tuple), slot
+                     in zip(pair_meta, pair_slots)]
+            return keys, pairs, self_dup, slot_map
         pairs: list[tuple[tuple, Series]] = []
         slot_map: dict[int, int] = {}
+        pair_meta: list[tuple] = []
+        pair_slots: list[int] = []
         for slot, (name, labels, value) in enumerate(series):
             spec = specs.get(name)
             if spec is None:
@@ -1696,8 +1811,15 @@ class Hub:
                 lambda: tuple(sorted(label_tuple))))
             slot_map[slot] = len(pairs)
             pairs.append((key, Series(spec, label_tuple, float(value))))
+            pair_meta.append((key, spec, label_tuple))
+            pair_slots.append(slot)
         keys = frozenset(key for key, _ in pairs)
-        return keys, pairs, len(keys) != len(pairs), slot_map
+        self_dup = len(keys) != len(pairs)
+        if len(self._plan_memo) >= _PLAN_MEMO_MAX:
+            self._plan_memo.clear()
+        self._plan_memo[memo_key] = (keys, tuple(pair_meta), self_dup,
+                                     slot_map, tuple(pair_slots))
+        return keys, pairs, self_dup, slot_map
 
     @staticmethod
     def _replay_plan(plan: tuple, seen: set, emit: list | None,
@@ -1745,13 +1867,13 @@ class Hub:
             plan = entry.chip_plan
             if plan is None:
                 plan = entry.chip_plan = self._build_merge_plan(
-                    target, entry.series, PER_CHIP_SPECS)
+                    target, entry, PER_CHIP_SPECS)
             duplicates += self._replay_plan(plan, seen, emit)
             if self._federate:
                 rollup = entry.rollup_plan
                 if rollup is None:
                     rollup = entry.rollup_plan = self._build_merge_plan(
-                        target, entry.series, FEDERATED_SPECS)
+                        target, entry, FEDERATED_SPECS)
                 duplicates += self._replay_plan(rollup, seen, rollup_emit,
                                                 rollup_dups)
         if rollup_dups:
@@ -1969,6 +2091,12 @@ class Hub:
         self._supervisor = supervisor
         self.heartbeat = supervisor.beater("hub-refresh")
 
+    def add_metrics_provider(self, contribute) -> None:
+        """Register a ``contribute(builder)`` callable appended to
+        every publish — how out-of-hub components (the SO_REUSEPORT
+        ingest pool) get their families onto this exposition."""
+        self._extra_metrics.append(contribute)
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -2098,6 +2226,19 @@ def main(argv: Sequence[str] | None = None) -> int:
                              "lock at high pusher fan-in). 0 = auto "
                              "(bounded by the core count); 1 restores "
                              "the single-lock behavior")
+    parser.add_argument("--ingest-procs", type=int, default=0,
+                        help="SO_REUSEPORT acceptor processes for the "
+                             "public port (ISSUE 17). 0 = off "
+                             "(in-process ingest). N>0 forks N acceptor "
+                             "children that each bind the public port "
+                             "with SO_REUSEPORT — the kernel shards "
+                             "publisher connections over them, so "
+                             "socket/HTTP handling scales past the GIL "
+                             "at 10k-pusher fan-in — and relay frames "
+                             "to this hub (the single-writer session "
+                             "authority) over pipelined unix channels; "
+                             "scrapes and probes on the public port are "
+                             "proxied through. Linux/BSD only")
     parser.add_argument("--no-native-ingest", action="store_true",
                         help="apply delta frames with the pure-Python "
                              "per-slot loop instead of the native "
@@ -2226,6 +2367,23 @@ def main(argv: Sequence[str] | None = None) -> int:
         parser.error(cardinality_error)
     if args.ingest_lanes < 0 or args.ingest_lanes > 256:
         parser.error("--ingest-lanes must be 0 (auto) or 1..256")
+    if args.ingest_procs < 0 or args.ingest_procs > 64:
+        parser.error("--ingest-procs must be 0 (off) or 1..64")
+    if args.ingest_procs > 0:
+        import socket as socket_mod
+
+        if not hasattr(socket_mod, "SO_REUSEPORT"):
+            parser.error("--ingest-procs needs SO_REUSEPORT "
+                         "(Linux/BSD); this platform has no such "
+                         "socket option")
+        if args.no_delta_ingest:
+            parser.error("--ingest-procs without delta ingest makes no "
+                         "sense (drop --no-delta-ingest or set "
+                         "--ingest-procs 0)")
+        if args.tls_cert_file or args.tls_key_file:
+            parser.error("--ingest-procs serves plain HTTP acceptors "
+                         "and cannot terminate TLS; drop the TLS flags "
+                         "or run single-process ingest")
     if not 1 <= args.remote_write_shards <= 64:
         parser.error("--remote-write-shards must be 1..64")
     if args.remote_write_shards > 1 and not args.remote_write_wal_dir:
@@ -2525,8 +2683,15 @@ def main(argv: Sequence[str] | None = None) -> int:
         payload["enabled"] = hub.cardinality.enabled
         return payload
 
+    # Multi-process ingest (ISSUE 17): the acceptor children own the
+    # PUBLIC port (SO_REUSEPORT); this process's exposition server
+    # retreats to an ephemeral loopback port the children proxy
+    # non-ingest requests to.
+    ingest_procs = max(0, args.ingest_procs)
+    serve_host = "127.0.0.1" if ingest_procs else args.listen_host
+    serve_port = 0 if ingest_procs else args.listen_port
     server = MetricsServer(
-        hub.registry, host=args.listen_host, port=args.listen_port,
+        hub.registry, host=serve_host, port=serve_port,
         healthz_max_age=max(3 * args.interval, 30.0),
         tls_cert_file=args.tls_cert_file, tls_key_file=args.tls_key_file,
         tls_client_ca_file=args.tls_client_ca_file,
@@ -2550,8 +2715,24 @@ def main(argv: Sequence[str] | None = None) -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+    pool = None
     try:
         server.start()
+        if ingest_procs and hub.delta is not None:
+            from .ingestproc import IngestProcPool
+
+            pool = IngestProcPool(
+                hub.delta.handle, host=args.listen_host,
+                port=args.listen_port, procs=ingest_procs,
+                parent_port=server.port,
+                auth=((args.auth_username, args.auth_password_sha256)
+                      if args.auth_username else None))
+            pool.start()
+            hub.add_metrics_provider(pool.contribute)
+            log.info("ingest sharded over %d SO_REUSEPORT acceptor "
+                     "process(es) on %s:%d (exposition proxied to "
+                     "127.0.0.1:%d)", ingest_procs, args.listen_host,
+                     pool.port, server.port)
         for _, sender in senders:
             sender.start()
         hub.start()
@@ -2579,21 +2760,24 @@ def main(argv: Sequence[str] | None = None) -> int:
                 "render-warmer", is_alive=server.warm_thread_alive,
                 restart=server.respawn_warm)
         supervisor.start()
+        public_port = pool.port if pool is not None else server.port
         if args.targets_dns:
             log.info("hub serving DNS-discovered targets (%s) on %s:%d",
-                     args.targets_dns, args.listen_host, server.port)
+                     args.targets_dns, args.listen_host, public_port)
         else:
             log.info("hub serving %d target(s)%s on %s:%d",
                      len(targets),
                      " (targets file re-read per refresh)"
                      if args.targets_file else "",
-                     args.listen_host, server.port)
+                     args.listen_host, public_port)
         stop.wait()
         return 0
     finally:
         # Supervisor first: a watchdog pass mid-teardown would respawn
         # the very threads being joined (the daemon.stop discipline).
         supervisor.stop()
+        if pool is not None:
+            pool.stop()
         hub.stop()
         for _, sender in senders:
             sender.stop()

@@ -68,3 +68,56 @@ def load_ingest():
                     "using pure Python", exc_info=True)
         return None
     return mod
+
+
+def load_delta_decode():
+    """The native DELTA frame slot/value decode (wirefast.cc
+    decode_delta_slots), or None — decode_frame_raw falls back to its
+    inlined Python loop. Same hasattr gate as load_ingest: a stale .so
+    degrades, never crashes."""
+    mod = load_wirefast()
+    if mod is None or not hasattr(mod, "decode_delta_slots"):
+        return None
+    return mod
+
+
+def load_render():
+    """The native exposition render + gzip (wirefast.cc
+    render_exposition/gzip_compress), configured with the pinned schema
+    family surface, or None — Registry.rendered falls back to the
+    Snapshot.render oracle. Byte-identity is pinned by
+    tests/test_render_differential.py and tests/test_golden.py."""
+    mod = load_wirefast()
+    if (mod is None or not hasattr(mod, "render_exposition")
+            or not hasattr(mod, "gzip_compress")):
+        return None
+    try:
+        from .. import schema
+
+        fams = []
+        for spec in schema.ALL_METRICS:
+            if spec.type is schema.MetricType.HISTOGRAM:
+                continue
+            family = spec.name
+            if spec.type is schema.MetricType.COUNTER:
+                family = spec.name.removesuffix("_total")
+            plain = (f"# HELP {spec.name} {spec.help}\n"
+                     f"# TYPE {spec.name} {spec.type.value}\n")
+            om = (f"# HELP {family} {spec.help}\n"
+                  f"# TYPE {family} {spec.type.value}\n")
+            fams.append((spec.name, plain.encode(), om.encode()))
+        mod.configure_render(tuple(fams))
+    except Exception:
+        log.warning("native render failed to configure; "
+                    "using pure Python", exc_info=True)
+        return None
+    return mod
+
+
+def load_fold():
+    """The native frame-fold inner loop (wirefast.cc fold_rows), or None
+    — the hub falls back to the per-row ChipRow.clone_at Python loop."""
+    mod = load_wirefast()
+    if mod is None or not hasattr(mod, "fold_rows"):
+        return None
+    return mod

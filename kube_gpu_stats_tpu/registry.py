@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import gzip
+import logging
 import math
 import threading
 import time
@@ -23,6 +24,27 @@ from typing import Iterable, Mapping, NamedTuple, Sequence
 
 from . import schema
 from .schema import MetricSpec, MetricType
+
+log = logging.getLogger(__name__)
+
+# Native render + gzip (ISSUE 17): one process-wide probe, shared by
+# every Registry — configure_render pins the module-level schema
+# surface, so there is nothing per-instance about the extension.
+_NATIVE_RENDER = None
+_NATIVE_RENDER_LOADED = False
+
+
+def _native_render_mod():
+    global _NATIVE_RENDER, _NATIVE_RENDER_LOADED
+    if not _NATIVE_RENDER_LOADED:
+        _NATIVE_RENDER_LOADED = True
+        try:
+            from . import native as native_pkg
+
+            _NATIVE_RENDER = native_pkg.load_render()
+        except Exception:  # pragma: no cover - import-environment quirks
+            _NATIVE_RENDER = None
+    return _NATIVE_RENDER
 
 
 @functools.lru_cache(maxsize=8192)
@@ -185,10 +207,16 @@ class Registry:
     without polling.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, native: bool = True) -> None:
         self._snapshot: Snapshot = EMPTY_SNAPSHOT
         self._published = threading.Condition()
         self._generation = 0
+        # native=False keeps this registry on the pure-Python render
+        # (the differential oracle in tests/test_render_differential.py);
+        # a native failure at render time also drops the instance back
+        # to Python permanently, so one bad snapshot shape can't crash
+        # scrapes or spam the log.
+        self._native_render = native
         # One render per generation (ISSUE 2): every reader of a given
         # (format, compression) shape between two publishes gets the same
         # memoized bytes — N concurrent scrapers plus the textfile and
@@ -250,10 +278,35 @@ class Registry:
         if entry is not None and entry[0] == generation:
             body = entry[1]
         else:
-            body = snapshot.render(openmetrics=openmetrics).encode()
+            body = None
+            mod = _native_render_mod() if self._native_render else None
+            if mod is not None:
+                try:
+                    body = mod.render_exposition(
+                        snapshot.series, snapshot.histograms, openmetrics)
+                except Exception:
+                    # Built-but-broken (or a snapshot shape the C side
+                    # refuses): degrade THIS registry loudly once; the
+                    # Python oracle below is always correct.
+                    log.warning("native render failed; falling back to "
+                                "pure Python", exc_info=True)
+                    self._native_render = False
+            if body is None:
+                body = snapshot.render(openmetrics=openmetrics).encode()
             self._render_cache[text_key] = (generation, body)
         if gzip_level:
-            body = gzip.compress(body, compresslevel=gzip_level, mtime=0)
+            gz = None
+            mod = _native_render_mod() if self._native_render else None
+            if mod is not None:
+                try:
+                    gz = mod.gzip_compress(body, gzip_level)
+                except Exception:
+                    log.warning("native gzip failed; falling back to "
+                                "pure Python", exc_info=True)
+                    self._native_render = False
+            if gz is None:
+                gz = gzip.compress(body, compresslevel=gzip_level, mtime=0)
+            body = gz
             self._render_cache[key] = (generation, body)
         return body, False
 
@@ -431,26 +484,47 @@ def contribute_cardinality(builder: SnapshotBuilder, accountant,
                     (("source", source),))
 
 
+# (generation stamp, prepared (spec, value, labels) rows): one entry,
+# process-global like the store registry it mirrors.
+_store_metrics_cache: tuple[int, tuple] = (0, ())
+
+
 def contribute_store_metrics(builder: SnapshotBuilder) -> None:
     """Fold the local-fault-survival families (ISSUE 15) from the
     process-global store registry (wal.store_report): durability state,
     per-errno fault counts and lost-record accounting for every
     disk-backed store this process opened (plus the accept-loop fence).
     One definition shared by the poll loop and the hub; a process with
-    no disk-backed stores contributes nothing."""
+    no disk-backed stores contributes nothing.
+
+    Edge-cached (ISSUE 17): every value here changes only on journaled
+    edges (fault, recovery, loss, new store), so the registry walk
+    reruns only when wal.health_generation() has moved — a quiet
+    publish replays the previous rows without touching a single
+    StoreHealth lock."""
     from . import wal
 
-    for store, info in sorted(wal.store_report().items()):
-        label = (("store", store),)
-        builder.add(schema.STORE_STATE,
-                    wal.STORE_STATE_VALUES.get(info.get("state"), 0.0),
-                    label)
-        builder.add(schema.STORE_LOST,
-                    float(info.get("lost_records", 0)), label)
-        for name in sorted(info.get("fault_counts", {})):
-            builder.add(schema.DISK_FAULTS,
-                        float(info["fault_counts"][name]),
-                        (("store", store), ("errno", name)))
+    global _store_metrics_cache
+    generation = wal.health_generation()
+    cached_generation, rows = _store_metrics_cache
+    if generation != cached_generation:
+        built: list = []
+        for store, info in sorted(wal.store_report().items()):
+            label = (("store", store),)
+            built.append((schema.STORE_STATE,
+                          wal.STORE_STATE_VALUES.get(info.get("state"),
+                                                     0.0),
+                          label))
+            built.append((schema.STORE_LOST,
+                          float(info.get("lost_records", 0)), label))
+            for name in sorted(info.get("fault_counts", {})):
+                built.append((schema.DISK_FAULTS,
+                              float(info["fault_counts"][name]),
+                              (("store", store), ("errno", name))))
+        rows = tuple(built)
+        _store_metrics_cache = (generation, rows)
+    for spec, value, labels in rows:
+        builder.add(spec, value, labels)
 
 
 class FilteredSnapshotBuilder(SnapshotBuilder):

@@ -582,6 +582,63 @@ INGEST_LANE_APPLY_SECONDS = MetricSpec(
     "sharding-isn't-helping signal (see the 'Scaling ingest' runbook).",
     extra_labels=("lane",),
 )
+INGEST_PROCS = MetricSpec(
+    "kts_ingest_procs",
+    MetricType.GAUGE,
+    "SO_REUSEPORT acceptor processes configured for delta ingest "
+    "(--ingest-procs). 0 means in-process ingest: POST handler "
+    "threads run inside the hub. N>0 means the kernel shards the "
+    "public-port accept load over N forked acceptors that validate at "
+    "the edge and relay frames to the hub over pipelined unix "
+    "channels — connection handling scales past the GIL while the hub "
+    "stays the single-writer session authority.",
+)
+INGEST_PROC_UP = MetricSpec(
+    "kts_ingest_proc_up",
+    MetricType.GAUGE,
+    "1 while this SO_REUSEPORT acceptor process is alive and relaying "
+    "(its control channel is connected), 0 while the pool is "
+    "respawning it. A proc flapping here while its siblings stay up "
+    "is a crash in the acceptor itself; every proc down at once "
+    "usually means the public port could not be bound.",
+    extra_labels=("proc",),
+)
+INGEST_PROC_FRAMES = MetricSpec(
+    "kts_ingest_proc_frames_total",
+    MetricType.COUNTER,
+    "Delta-protocol POST bodies this acceptor process relayed to the "
+    "hub (any verdict). The kernel's SO_REUSEPORT hash spreads "
+    "CONNECTIONS, so a roughly even spread is healthy; one proc "
+    "carrying most frames means a few chatty persistent connections, "
+    "not a broken hash.",
+    extra_labels=("proc",),
+)
+INGEST_PROC_ACCEPTED = MetricSpec(
+    "kts_ingest_proc_accepted_total",
+    MetricType.COUNTER,
+    "Frames relayed by this acceptor process that the hub applied "
+    "(200). Summed over procs this equals the hub's "
+    "kts_delta_frames_total (full + delta) plus duplicates — the "
+    "multi-proc conservation check chaos-sim and the storm bench pin.",
+    extra_labels=("proc",),
+)
+INGEST_PROC_SHED = MetricSpec(
+    "kts_ingest_proc_shed_total",
+    MetricType.COUNTER,
+    "Frames relayed by this acceptor process that the hub refused at "
+    "admission (429/503/413 shed classes). The per-reason split lives "
+    "in kts_ingest_shed_total; this per-proc view says WHERE the "
+    "refused load is landing.",
+    extra_labels=("proc",),
+)
+INGEST_PROC_BYTES = MetricSpec(
+    "kts_ingest_proc_bytes_total",
+    MetricType.COUNTER,
+    "Compressed delta-frame bytes this acceptor process relayed to "
+    "the hub. Compare with kts_delta_bytes_total to price the relay "
+    "overhead (should be ~equal: the relay ships the wire verbatim).",
+    extra_labels=("proc",),
+)
 INGEST_NATIVE = MetricSpec(
     "kts_ingest_native",
     MetricType.GAUGE,
@@ -944,6 +1001,12 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     INGEST_LANE_FRAMES,
     INGEST_LANE_APPLY_SECONDS,
     INGEST_NATIVE,
+    INGEST_PROCS,
+    INGEST_PROC_UP,
+    INGEST_PROC_FRAMES,
+    INGEST_PROC_ACCEPTED,
+    INGEST_PROC_SHED,
+    INGEST_PROC_BYTES,
     INGEST_SHED,
     INGEST_QUARANTINED,
     CARDINALITY_SHED,

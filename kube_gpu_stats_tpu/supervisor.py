@@ -155,6 +155,16 @@ class Supervisor:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Self-metrics memo (ISSUE 17): contribute() replays prepared
+        # rows between edges instead of re-probing every component on
+        # each publish. The watchdog pass (and any registration) bumps
+        # the generation; breakers trip asynchronously, so their live
+        # (state, trips) fingerprint is part of the cache key; the
+        # check-interval clock bound covers processes that publish
+        # without a running watchdog.
+        self._state_gen = 0
+        self._contrib_cache: tuple[float, int, tuple, tuple] = (
+            float("-inf"), -1, (), ())
 
     # -- registration --------------------------------------------------------
 
@@ -185,6 +195,7 @@ class Supervisor:
                     base=self._check_interval, cap=60.0, jitter=True),
                 breaker_prefixes=breaker_prefixes,
                 clock=self._clock)
+            self._state_gen += 1
 
     def register_breaker(self, name: str, breaker: CircuitBreaker) -> None:
         """Expose a circuit breaker in the kts_breaker_* self-metrics and
@@ -192,6 +203,7 @@ class Supervisor:
         upgrade swaps the collector and its breakers)."""
         with self._lock:
             self._breakers[name] = breaker
+            self._state_gen += 1
 
     def register_breakers(self,
                           breakers: Mapping[str, CircuitBreaker]) -> None:
@@ -207,6 +219,7 @@ class Supervisor:
         moment it exists — no re-registration choreography."""
         with self._lock:
             self._breaker_providers.append(provider)
+            self._state_gen += 1
 
     def breakers(self) -> dict[str, CircuitBreaker]:
         with self._lock:
@@ -312,6 +325,7 @@ class Supervisor:
                 component.probing = True
             self._meter_storm(component, now)
         self._observe_transitions()
+        self._state_gen += 1  # a watchdog pass revalidates contribute()
         return restarted
 
     def _meter_storm(self, component: _Component, now: float) -> None:
@@ -493,22 +507,49 @@ class Supervisor:
 
     def contribute(self, builder) -> None:
         """Fold kts_* self-metrics into a SnapshotBuilder (called from
-        the poll loop's snapshot build, like RenderStats.contribute)."""
+        the poll loop's snapshot build, like RenderStats.contribute).
+
+        Watchdog-cached (ISSUE 17): the component probe walk reruns
+        when a watchdog pass or a registration bumped the state
+        generation, when any breaker's live (state, trips) fingerprint
+        moved — breakers trip between watchdog passes, and their
+        self-metrics must ride the very next snapshot — or, with no
+        watchdog running, at most once per check interval. Between
+        edges a publish replays the prepared rows: a quiet high-rate
+        publisher no longer pays a full health walk per snapshot."""
+        now = self._clock()
         breakers = self.breakers()
+        fingerprint = tuple(
+            (name, breaker.state_value(), breaker.trips_total)
+            for name, breaker in sorted(breakers.items()))
+        cached_at, cached_gen, cached_fp, rows = self._contrib_cache
+        if (cached_gen != self._state_gen
+                or fingerprint != cached_fp
+                or now - cached_at >= self._check_interval):
+            rows = self._build_contrib_rows(breakers)
+            self._contrib_cache = (now, self._state_gen, fingerprint,
+                                   rows)
+        for spec, value, labels in rows:
+            builder.add(spec, value, labels)
+
+    def _build_contrib_rows(self, breakers) -> tuple:
         with self._lock:
             storms = {c.name: c.storms for c in self._components.values()}
+        rows: list = []
         for row in self.health(breakers):
             labels = (("component", row.name),)
-            builder.add(schema.COMPONENT_HEALTHY,
-                        HEALTH_VALUES[row.state], labels)
+            rows.append((schema.COMPONENT_HEALTHY,
+                         HEALTH_VALUES[row.state], labels))
             # Unconditional, born at 0: increase()-based alerting misses
             # a burst if the series first appears already at N.
-            builder.add(schema.COMPONENT_RESTARTS, float(row.restarts),
-                        labels)
-            builder.add(schema.THREAD_RESTART_STORMS,
-                        float(storms.get(row.name, 0)), labels)
+            rows.append((schema.COMPONENT_RESTARTS, float(row.restarts),
+                         labels))
+            rows.append((schema.THREAD_RESTART_STORMS,
+                         float(storms.get(row.name, 0)), labels))
         for name, breaker in sorted(breakers.items()):
             labels = (("component", name),)
-            builder.add(schema.BREAKER_STATE, breaker.state_value(), labels)
-            builder.add(schema.BREAKER_TRIPS, float(breaker.trips_total),
-                        labels)
+            rows.append((schema.BREAKER_STATE, breaker.state_value(),
+                         labels))
+            rows.append((schema.BREAKER_TRIPS, float(breaker.trips_total),
+                         labels))
+        return tuple(rows)

@@ -253,6 +253,7 @@ class StoreHealth:
             self.fault_counts[name] = self.fault_counts.get(name, 0) + 1
             self.lost_records += lost
             self._probe_at = self._clock() + self.probe_interval
+        _bump_health_generation()
         if transition:
             log.warning(
                 "store %s degraded (%s, %s): %s — continuing in-memory, "
@@ -273,6 +274,7 @@ class StoreHealth:
             return
         with self._lock:
             self.lost_records += n
+        _bump_health_generation()
 
     def ok(self) -> None:
         """A durable op succeeded: re-arm durability if degraded."""
@@ -286,6 +288,7 @@ class StoreHealth:
             self.degraded_since = None
             self.recoveries += 1
             self._probe_at = 0.0
+        _bump_health_generation()
         log.warning("store %s recovered: durability re-armed after %s "
                     "(%s)", self.store, reason, name)
         _journal_event(
@@ -338,14 +341,36 @@ _store_lock = threading.Lock()
 _stores: dict[str, StoreHealth] = {}
 _journal_tracers: list = []
 
+# Edge-stamped health generation (ISSUE 17): bumped on every edge that
+# changes what store_report()/contribute_store_metrics would emit — a
+# new store registering, a fault recorded (state + per-errno counts), a
+# recovery, records losing durability, or the test-hook reset. Publish
+# paths compare this against a cached stamp instead of walking the
+# registry: a quiet publish is one GIL-atomic int read.
+_health_gen = 1
+
+
+def health_generation() -> int:
+    """Monotone stamp of the store registry's emitted state. Reading it
+    is GIL-atomic by design (no lock): the per-publish fast path."""
+    return _health_gen
+
+
+def _bump_health_generation() -> None:
+    global _health_gen
+    with _store_lock:
+        _health_gen += 1
+
 
 def store_health(store: str) -> StoreHealth:
     """Get-or-create the durability state machine for one store label
     ('energy', 'ingest', 'spill', 'remote-write shard 0', ...)."""
+    global _health_gen
     with _store_lock:
         health = _stores.get(store)
         if health is None:
             health = _stores[store] = StoreHealth(store)
+            _health_gen += 1  # a new store appears in the report
         return health
 
 
@@ -396,8 +421,10 @@ def set_probe_interval(seconds: float) -> None:
 def reset_store_stats() -> None:
     """Test hook: the registry is process-global, and suites assert
     exact counts/states."""
+    global _health_gen
     with _store_lock:
         _stores.clear()
+        _health_gen += 1
 
 
 def _quarantine_aside(path: str, version, *, label: str,

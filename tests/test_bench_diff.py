@@ -1,0 +1,128 @@
+"""The perf ledger's CI gate (ISSUE 17): bench_diff must derive
+per-field noise bands from the BENCH_r* history, fail --gate runs only
+for PINNED fields drifting past their band in the bad direction, honor
+run-scoped waivers in BENCH_WAIVERS.json, and stay report-only for
+everything else — a perf regression should fail CI exactly like a
+correctness regression, and an intentional one must be named in-tree."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import bench_diff  # noqa: E402
+
+
+def write_runs(tmp_path, histories: dict[str, list[float]]):
+    """Lay down BENCH_r1..rN from per-field value sequences."""
+    n = max(len(vals) for vals in histories.values())
+    for i in range(n):
+        line = {field: vals[i] for field, vals in histories.items()
+                if i < len(vals)}
+        (tmp_path / f"BENCH_r{i + 1}.json").write_text(json.dumps(line))
+
+
+def test_bands_come_from_history_not_just_class_floors(tmp_path):
+    # A field that historically steps ~40% run-to-run must get a ~40%
+    # band (the median step), not the 25% class floor; a flat field
+    # keeps the floor.
+    write_runs(tmp_path, {
+        "jittery_ms": [100.0, 140.0, 100.0, 140.0, 100.0],
+        "steady_ms": [50.0, 50.5, 50.0, 50.5, 50.0],
+    })
+    history = [bench_diff.load_numeric(p)
+               for _n, p in bench_diff.all_runs(tmp_path)[:-1]]
+    bands = bench_diff.history_bands(history)
+    assert bands["jittery_ms"] == pytest.approx(0.4, rel=0.2)
+    assert bands["steady_ms"] == 0.25  # class floor
+
+
+def test_gate_fails_pinned_regression_without_waiver(tmp_path):
+    write_runs(tmp_path, {
+        "delta_ingest_10k_ms_per_refresh": [150.0, 155.0, 150.0, 152.0,
+                                            400.0],
+        "unpinned_thing_ms": [10.0, 10.0, 10.0, 10.0, 99.0],
+    })
+    lines, failures = bench_diff.diff(tmp_path, gate=True)
+    assert len(failures) == 1
+    assert "delta_ingest_10k_ms_per_refresh" in failures[0]
+    assert "no waiver" in failures[0]
+    # The unpinned field is flagged in the report but never gates.
+    assert any("unpinned_thing_ms" in line and "noise band" in line
+               for line in lines)
+    # Report-only mode sees the same drift but fails nothing.
+    _lines, failures = bench_diff.diff(tmp_path, gate=False)
+    assert failures == []
+
+
+def test_gate_honors_run_scoped_waiver(tmp_path):
+    write_runs(tmp_path, {
+        "scrape_p99_ms": [3.0, 3.1, 3.0, 3.2, 9.0],
+    })
+    (tmp_path / bench_diff.WAIVERS).write_text(json.dumps({"waivers": [
+        {"field": "scrape_p99_ms", "run": "r5",
+         "reason": "new TLS handshake benchmarked in; accepted"},
+    ]}))
+    lines, failures = bench_diff.diff(tmp_path, gate=True)
+    assert failures == []
+    assert any("WAIVED" in line for line in lines)
+    # The same waiver pointed at a DIFFERENT run does not apply (and is
+    # reported stale).
+    (tmp_path / bench_diff.WAIVERS).write_text(json.dumps({"waivers": [
+        {"field": "scrape_p99_ms", "run": "r4", "reason": "stale"},
+    ]}))
+    lines, failures = bench_diff.diff(tmp_path, gate=True)
+    assert len(failures) == 1
+    assert any("stale waiver" in line for line in lines)
+
+
+def test_pinned_improvement_never_fails(tmp_path):
+    # Ingest getting faster and max_hz rising are improvements —
+    # outside the band, flagged in the report, never a gate failure.
+    write_runs(tmp_path, {
+        "delta_ingest_10k_ms_per_refresh": [300.0, 310.0, 305.0, 311.0,
+                                            132.0],
+        "max_hz": [8000.0, 8100.0, 8050.0, 8200.0, 16000.0],
+    })
+    _lines, failures = bench_diff.diff(tmp_path, gate=True)
+    assert failures == []
+
+
+def test_max_hz_gates_on_falls_not_rises(tmp_path):
+    write_runs(tmp_path, {
+        "max_hz": [8000.0, 8100.0, 8050.0, 8200.0, 2000.0],
+    })
+    _lines, failures = bench_diff.diff(tmp_path, gate=True)
+    assert len(failures) == 1 and "max_hz" in failures[0]
+
+
+def test_malformed_waiver_is_an_error_not_a_skip(tmp_path):
+    write_runs(tmp_path, {"scrape_p99_ms": [3.0, 3.0, 3.0, 3.0, 9.0]})
+    (tmp_path / bench_diff.WAIVERS).write_text(
+        json.dumps({"waivers": [{"field": "scrape_p99_ms"}]}))
+    with pytest.raises(ValueError):
+        bench_diff.diff(tmp_path, gate=True)
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    write_runs(tmp_path, {
+        "hub_merge_64w_cold_ms": [60.0, 62.0, 61.0, 60.0, 300.0],
+    })
+    assert bench_diff.main(["--root", str(tmp_path)]) == 0
+    assert bench_diff.main(["--root", str(tmp_path), "--gate"]) == 1
+    err = capsys.readouterr().err
+    assert "GATE FAILURE" in err and bench_diff.WAIVERS in err
+
+
+def test_repo_history_gate_is_green():
+    """The checked-in BENCH_r* sequence must pass its own gate — `make
+    ci` runs exactly this (a PR landing a regressing BENCH file must
+    also land its waiver)."""
+    _lines, failures = bench_diff.diff(ROOT, gate=True)
+    assert failures == [], failures

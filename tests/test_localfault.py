@@ -405,6 +405,54 @@ def test_store_metrics_contribution():
                    (("store", "m-test"), ("errno", "ENOSPC")))] == 1.0
 
 
+def test_store_metrics_quiet_publishes_skip_registry_walk(monkeypatch):
+    """ISSUE 17 satellite: kts_store_* rows are edge-cached — a quiet
+    100-publish run performs ZERO health-registry walks (the rows
+    replay from the cache), and the next fault/loss edge invalidates
+    the cache for exactly one fresh walk."""
+    from kube_gpu_stats_tpu import schema
+    from kube_gpu_stats_tpu.registry import (SnapshotBuilder,
+                                             contribute_store_metrics)
+
+    wal.reset_store_stats()
+    health = wal.store_health("quiet-test")
+    health.record_fault(OSError(errno.ENOSPC, "full"), lost=2)
+    contribute_store_metrics(SnapshotBuilder())  # primes the cache
+
+    walks: list[int] = []
+    real_report = wal.store_report
+
+    def counting_report():
+        walks.append(1)
+        return real_report()
+
+    monkeypatch.setattr(wal, "store_report", counting_report)
+    first = None
+    for _ in range(100):
+        builder = SnapshotBuilder()
+        contribute_store_metrics(builder)
+        got = {(s.spec.name, tuple(s.labels)): s.value
+               for s in builder.build().series}
+        if first is None:
+            first = got
+        assert got == first
+    assert walks == []  # zero health-registry walks while quiet
+    assert first[(schema.STORE_LOST.name,
+                  (("store", "quiet-test"),))] == 2.0
+
+    # A loss edge flips the generation: exactly one fresh walk, and the
+    # new count lands in the very next publish.
+    health.record_lost(3)
+    builder = SnapshotBuilder()
+    contribute_store_metrics(builder)
+    assert len(walks) == 1
+    got = {(s.spec.name, tuple(s.labels)): s.value
+           for s in builder.build().series}
+    assert got[(schema.STORE_LOST.name,
+                (("store", "quiet-test"),))] == 5.0
+    wal.reset_store_stats()
+
+
 # -- supervisor: storm latch + spawn -----------------------------------------
 
 def _dying_component(supervisor, clock):

@@ -1,0 +1,222 @@
+"""Differential pins for the ISSUE 17 native hot paths: the wirefast
+exposition render + gzip (``render_exposition``/``gzip_compress``) and
+the hub frame-fold loop (``fold_rows``) must be indistinguishable from
+their pure-Python oracles — ``Snapshot.render().encode()``,
+``gzip.compress(..., mtime=0)`` and ``ChipRow.clone_at`` — over
+randomized registries (histograms, staleness NaNs, federation
+re-export families), randomized fold churn, and both exposition
+formats. Same discipline as tests/test_ingest_differential.py: drive
+both implementations with identical inputs, require identical bytes /
+identical objects, and pin that the native path is actually exercised
+(not silently oracled away)."""
+
+from __future__ import annotations
+
+import gzip as gzip_mod
+import random
+
+import pytest
+
+from kube_gpu_stats_tpu import registry as registry_mod
+from kube_gpu_stats_tpu import schema
+from kube_gpu_stats_tpu.native import load_fold, load_render
+from kube_gpu_stats_tpu.registry import (HistogramState, Registry, Series,
+                                         Snapshot)
+from kube_gpu_stats_tpu.top import ChipRow
+
+NATIVE = load_render()
+NATIVE_FOLD = load_fold()
+
+needs_native = pytest.mark.skipif(
+    NATIVE is None, reason="wirefast extension not built")
+needs_native_fold = pytest.mark.skipif(
+    NATIVE_FOLD is None, reason="wirefast extension not built")
+
+_PLAIN_SPECS = [s for s in schema.ALL_METRICS
+                if s.type is not schema.MetricType.HISTOGRAM]
+_HIST_SPECS = [s for s in schema.ALL_METRICS
+               if s.type is schema.MetricType.HISTOGRAM]
+
+# Every divergence class the formatter has: NaN (staleness markers),
+# infinities, int-collapse edges around 1e15, shortest-repr floats.
+_VALUES = (0.0, -0.0, 1.0, -1.5, float("nan"), float("inf"),
+           float("-inf"), 1e15, -1e15, 999999999999999.0, 2.0**53 + 2.0,
+           123456789.25, 0.1, 1e-9, 1e300, 3.0)
+
+_LABEL_VALUES = ("", "a", "train-0", 'quo"te', "back\\slash", "new\nline",
+                 "unicode-é", "tpu-v5p")
+
+
+def _random_snapshot(rng: random.Random) -> Snapshot:
+    """A randomized registry snapshot: per-chip families, slice_*
+    federation re-export rollups, self-metrics — any non-histogram
+    family the schema knows — plus dimensioned histogram states."""
+    series = []
+    for _ in range(rng.randrange(0, 60)):
+        spec = rng.choice(_PLAIN_SPECS)
+        labels = tuple(
+            (f"l{i}", rng.choice(_LABEL_VALUES))
+            for i in range(rng.randrange(0, 4)))
+        series.append(Series(spec, labels, rng.choice(_VALUES)))
+    hists = []
+    for _ in range(rng.randrange(0, 5)):
+        spec = rng.choice(_HIST_SPECS)
+        labels = ()
+        if rng.random() < 0.6:
+            labels = (("output", rng.choice(("http", "textfile"))),)
+        state = HistogramState.empty(
+            spec, (0.001, 0.01, 0.1, 1.0, 10.0), labels=labels)
+        for _ in range(rng.randrange(0, 12)):
+            state = state.observe(rng.uniform(0.0, 20.0),
+                                  rng.randrange(1, 4))
+        hists.append(state)
+    return Snapshot(tuple(series), tuple(hists), 0.0)
+
+
+@needs_native
+def test_native_render_matches_python_oracle_randomized():
+    """The acceptance pin: native render bytes == Snapshot.render()
+    bytes over randomized registries, both exposition formats."""
+    rng = random.Random(0x17E17)
+    for _ in range(300):
+        snap = _random_snapshot(rng)
+        for openmetrics in (False, True):
+            oracle = snap.render(openmetrics=openmetrics).encode()
+            native = NATIVE.render_exposition(
+                snap.series, snap.histograms, openmetrics)
+            assert native == oracle
+
+
+@needs_native
+def test_native_render_empty_and_eof_edges():
+    empty = Snapshot((), (), 0.0)
+    assert NATIVE.render_exposition((), (), False) == b""
+    assert (NATIVE.render_exposition((), (), True)
+            == empty.render(openmetrics=True).encode() == b"# EOF\n")
+
+
+@needs_native
+def test_native_gzip_matches_python_gzip():
+    """gzip_compress must be byte-identical to gzip.compress(mtime=0)
+    at every level the render cache can ask for — the compressed
+    artifact is part of the golden contract, not just the plaintext."""
+    rng = random.Random(7)
+    payloads = [b"", b"x", bytes(rng.randrange(256) for _ in range(4096)),
+                b"accelerator_duty_cycle 42\n" * 4096]
+    for level in (1, 2, 5, 6, 9):
+        for data in payloads:
+            assert (NATIVE.gzip_compress(data, level)
+                    == gzip_mod.compress(data, compresslevel=level,
+                                         mtime=0))
+
+
+@needs_native
+def test_registry_rendered_native_vs_oracle_registry():
+    """End-to-end through Registry.rendered: a native registry and a
+    native=False oracle registry publish identical snapshots and must
+    serve identical bytes for every (format, gzip) shape."""
+    rng = random.Random(0xD1FF)
+    fast, oracle = Registry(), Registry(native=False)
+    for _ in range(20):
+        snap = _random_snapshot(rng)
+        fast.publish(snap)
+        oracle.publish(snap)
+        for openmetrics in (False, True):
+            for level in (0, 6, 9):
+                got, _ = fast.rendered(openmetrics, level)
+                want, _ = oracle.rendered(openmetrics, level)
+                assert got == want
+    # The fast registry must still be on the native path — a silent
+    # mid-run fallback (native render raising) would have flipped it.
+    assert fast._native_render
+
+
+@needs_native
+def test_native_render_exercised_not_silently_oracled(monkeypatch):
+    """The differential suite is meaningless if Registry.rendered never
+    actually reaches the native module — count the calls."""
+    calls = {"render": 0, "gzip": 0}
+
+    class Shim:
+        @staticmethod
+        def render_exposition(series, hists, openmetrics):
+            calls["render"] += 1
+            return NATIVE.render_exposition(series, hists, openmetrics)
+
+        @staticmethod
+        def gzip_compress(data, level):
+            calls["gzip"] += 1
+            return NATIVE.gzip_compress(data, level)
+
+    monkeypatch.setattr(registry_mod, "_NATIVE_RENDER", Shim())
+    monkeypatch.setattr(registry_mod, "_NATIVE_RENDER_LOADED", True)
+    reg = Registry()
+    reg.publish(_random_snapshot(random.Random(1)))
+    body, hit = reg.rendered(False, 6)
+    assert not hit and body
+    assert calls == {"render": 1, "gzip": 1}
+
+
+def _random_rows(rng: random.Random, n: int) -> dict:
+    rows = {}
+    for i in range(n):
+        key = (f"http://t{i}", f"s{rng.randrange(3)}",
+               str(rng.randrange(8)), str(i))
+        row = ChipRow(key, at=rng.uniform(0, 100))
+        row.duty = rng.choice((None, rng.uniform(0, 100)))
+        row.mem_used = rng.choice((None, 1e9 * rng.random()))
+        row.ici_bps = rng.uniform(0, 1e9)
+        row.holders = rng.randrange(4)
+        row.steps_total = rng.choice((None, float(rng.randrange(10**6))))
+        rows[key] = row
+    return rows
+
+
+@needs_native_fold
+def test_frame_fold_parity_under_randomized_churn():
+    """fold_rows(dst, src, at) must produce rows field-identical to the
+    clone_at oracle, with clone independence (mutating a frame row
+    never touches the cached fold, and vice versa) — across rounds of
+    randomized churn of the cached fold between folds."""
+    rng = random.Random(0xF01D)
+    src = _random_rows(rng, 40)
+    for round_no in range(20):
+        at = rng.uniform(0, 1e6)
+        native_dst: dict = {}
+        oracle_dst = {}
+        NATIVE_FOLD.fold_rows(native_dst, src, at)
+        for key, row in src.items():
+            oracle_dst[key] = row.clone_at(at)
+        assert native_dst.keys() == oracle_dst.keys()
+        for key in oracle_dst:
+            assert native_dst[key].__dict__ == oracle_dst[key].__dict__
+            assert native_dst[key] is not src[key]
+            assert type(native_dst[key]) is ChipRow
+        #
+
+        # Clone independence both ways: frame mutation (rates()) must
+        # not leak into the cached fold; fold churn must not reach the
+        # already-built frame.
+        sample = rng.choice(list(src))
+        native_dst[sample].duty = -1.0
+        assert src[sample].duty != -1.0
+        src[sample].ici_bps += 7.0
+        assert native_dst[sample].ici_bps != src[sample].ici_bps
+        # Randomized churn: add, drop, and restamp rows.
+        for key in rng.sample(list(src), k=min(4, len(src))):
+            del src[key]
+        src.update(_random_rows(rng, rng.randrange(1, 6)))
+
+
+@needs_native_fold
+def test_hub_refresh_uses_native_fold():
+    """Not-silently-oracled pin for the fold: a default hub loads the
+    fold module; --no-native-ingest style hubs must not."""
+    from kube_gpu_stats_tpu.hub import Hub
+
+    fast = Hub([], targets_provider=lambda: [], interval=10.0,
+               push_fence=1e9)
+    oracle = Hub([], targets_provider=lambda: [], interval=10.0,
+                 push_fence=1e9, native_ingest=False)
+    assert fast._fold_native is not None
+    assert oracle._fold_native is None

@@ -1,17 +1,32 @@
 #!/usr/bin/env python
-"""Diff the two newest BENCH_r*.json runs (ISSUE 16 satellite,
-`make bench-diff`): every shared numeric field side by side with the
-relative delta, flagged when it moves outside a noise band — the
-reviewer's perf-diff surface for a PR that lands a new BENCH file.
+"""The perf ledger's diff-and-gate surface (ISSUE 16 report, promoted
+to CI-gating by ISSUE 17): diff the two newest BENCH_r*.json runs field
+by field, with per-field noise bands derived from the BENCH_r* history,
+and — under ``--gate`` (`make bench-diff`, wired into `make ci`) — exit
+nonzero when a PINNED field drifts past its band in the bad direction
+without a waiver entry in BENCH_WAIVERS.json.
 
-Report-only by design: the benchmarks run on whatever box CI landed
-on, so a single-sample delta is a conversation starter, not a gate
-(the gates live in tests/test_latency.py with their own headroom).
-Always exits 0 unless the files themselves are unreadable.
+How the bands are built: for every numeric field, the relative step
+|new-old|/|old| is computed across each consecutive pair of historical
+runs (all runs EXCEPT the newest — a regression must not widen its own
+band), and the band is the median historical step, floored by a
+field-class minimum (sub-ms timings and p99s jitter hardest) and capped
+at 75%. Fields with fewer than 3 historical steps fall back to the
+class floor alone. So a field that has always jittered 20% run-to-run
+gets a 20%+ band; a field that historically moves 2% gets its class
+floor — the gate tightens exactly where the history says it can.
 
-Noise bands are relative and field-class based: sub-millisecond
-timings and GC pauses jitter hardest (50%), most timings/through-
-puts get 25%, and counts/sizes that should be deterministic get 5%.
+Pinned fields (the hot-path numbers ISSUE 17 reclaimed) gate in their
+bad direction only: ingest-storm and merge getting FASTER never fails
+CI. Everything else stays report-only — single-sample deltas on
+whatever box CI landed on are a conversation starter; the correctness
+gates live in tests/test_latency.py with their own headroom.
+
+Filing a waiver: add an entry to BENCH_WAIVERS.json naming the field,
+the run that regresses it (e.g. "r18"), and the reason — the PR that
+causes an intentional regression must name it in-tree. Waivers are
+run-scoped: they expire by construction when the next BENCH lands.
+See OPERATIONS.md "Performance ledger".
 """
 
 from __future__ import annotations
@@ -20,14 +35,18 @@ import argparse
 import json
 import pathlib
 import re
+import statistics
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
+WAIVERS = "BENCH_WAIVERS.json"
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
-# (suffix/substring, relative noise band) — first match wins.
-_BANDS = (
+# (substring, relative floor) — first match wins. Sub-millisecond
+# timings and GC pauses jitter hardest; counts/sizes that should be
+# deterministic get a tight floor.
+_FLOORS = (
     ("gc_max_pause_ms", 0.50),
     ("p99", 0.50),
     ("_bytes", 0.05),
@@ -35,23 +54,36 @@ _BANDS = (
     ("series", 0.05),
     ("", 0.25),
 )
+_BAND_CAP = 0.75
+_MIN_HISTORY_STEPS = 3
+
+# The hot-path numbers this repo's perf PRs reclaimed (ISSUE 17):
+# field -> +1 when a RISE is a regression, -1 when a FALL is. A pinned
+# field improving never fails the gate.
+PINNED = {
+    "delta_ingest_10k_ms_per_refresh": +1,
+    "ingest_cpu_pct": +1,
+    "scrape_p99_ms": +1,
+    "max_hz": -1,
+    "hub_merge_64w_cold_ms": +1,
+    "hub_merge_64w_p50_ms": +1,
+}
 
 
-def band_for(field: str) -> float:
-    for needle, band in _BANDS:
+def floor_for(field: str) -> float:
+    for needle, floor in _FLOORS:
         if needle in field:
-            return band
+            return floor
     return 0.25
 
 
-def newest_two(root: pathlib.Path) -> list[pathlib.Path]:
-    """The two newest runs by rN, numerically — the sequence has gaps
+def all_runs(root: pathlib.Path) -> list[tuple[int, pathlib.Path]]:
+    """Every BENCH run by rN, numerically — the sequence has gaps
     (r12/r14 never landed), so lexical sort or mtime would lie."""
-    runs = sorted(
+    return sorted(
         ((int(_RUN_RE.search(p.name).group(1)), p)
          for p in root.glob("BENCH_r*.json") if _RUN_RE.search(p.name)),
         key=lambda pair: pair[0])
-    return [p for _n, p in runs[-2:]]
 
 
 def load_numeric(path: pathlib.Path) -> dict:
@@ -60,28 +92,95 @@ def load_numeric(path: pathlib.Path) -> dict:
             if isinstance(v, (int, float)) and not isinstance(v, bool)}
 
 
-def diff(old_path: pathlib.Path, new_path: pathlib.Path) -> list[str]:
-    old = load_numeric(old_path)
-    new = load_numeric(new_path)
-    lines = [f"bench-diff: {old_path.name} -> {new_path.name}"]
+def history_bands(history: list[dict]) -> dict[str, float]:
+    """Per-field noise band from consecutive historical steps (the
+    newest run is NOT in ``history`` — it must not widen its own
+    band). Median |relative step|, floored by field class, capped."""
+    steps: dict[str, list[float]] = {}
+    for old, new in zip(history, history[1:]):
+        for field in old.keys() & new.keys():
+            a, b = old[field], new[field]
+            if a == 0.0:
+                continue
+            steps.setdefault(field, []).append(abs(b - a) / abs(a))
+    bands: dict[str, float] = {}
+    for field, deltas in steps.items():
+        floor = floor_for(field)
+        if len(deltas) < _MIN_HISTORY_STEPS:
+            bands[field] = floor
+        else:
+            bands[field] = min(_BAND_CAP,
+                               max(floor, statistics.median(deltas)))
+    return bands
+
+
+def load_waivers(root: pathlib.Path) -> list[dict]:
+    path = root / WAIVERS
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    waivers = data.get("waivers", []) if isinstance(data, dict) else data
+    for entry in waivers:
+        if not {"field", "run", "reason"} <= set(entry):
+            raise ValueError(
+                f"{WAIVERS}: every waiver needs field/run/reason, "
+                f"got {entry}")
+    return waivers
+
+
+def waived(waivers: list[dict], field: str, run: int) -> str | None:
+    for entry in waivers:
+        if entry["field"] == field and entry["run"] == f"r{run}":
+            return entry["reason"]
+    return None
+
+
+def diff(root: pathlib.Path, gate: bool) -> tuple[list[str], list[str]]:
+    """Returns (report lines, gate failures). Gate failures are empty
+    unless ``gate`` and a pinned field drifted bad-direction past its
+    band without a waiver."""
+    runs = all_runs(root)
+    if len(runs) < 2:
+        return ([f"bench-diff: need two BENCH_r*.json under {root}, "
+                 f"found {len(runs)} — nothing to compare"], [])
+    (old_n, old_path), (new_n, new_path) = runs[-2], runs[-1]
+    history = [load_numeric(p) for _n, p in runs[:-1]]
+    bands = history_bands(history)
+    waivers = load_waivers(root)
+    old, new = load_numeric(old_path), load_numeric(new_path)
+
+    lines = [f"bench-diff: {old_path.name} -> {new_path.name} "
+             f"(bands from {len(history)} historical run(s))"]
+    failures: list[str] = []
     flagged: list[str] = []
-    rows: list[str] = []
     for field in sorted(old.keys() & new.keys()):
         a, b = old[field], new[field]
         if a == b:
             continue
-        if a == 0.0:
-            rel = float("inf") if b else 0.0
-        else:
-            rel = (b - a) / abs(a)
-        band = band_for(field)
+        rel = (b - a) / abs(a) if a != 0.0 else float("inf")
+        band = bands.get(field, floor_for(field))
+        pin = PINNED.get(field)
         mark = ""
         if abs(rel) > band:
-            mark = f"  << outside +/-{band:.0%} noise band"
             flagged.append(field)
-        rows.append(f"  {field}: {a:g} -> {b:g} "
-                    f"({rel:+.1%}){mark}")
-    lines.extend(rows or ["  (no shared numeric field changed)"])
+            mark = f"  << outside +/-{band:.0%} noise band"
+            if pin is not None and rel * pin > 0:
+                reason = waived(waivers, field, new_n)
+                if reason is not None:
+                    mark += f"  [pinned; WAIVED: {reason}]"
+                elif gate:
+                    mark += "  [pinned: GATE FAILURE]"
+                    failures.append(
+                        f"{field}: {a:g} -> {b:g} ({rel:+.1%}) past "
+                        f"+/-{band:.0%} band, no waiver for r{new_n} "
+                        f"in {WAIVERS}")
+                else:
+                    mark += "  [pinned]"
+        rows_pin = " (pinned)" if pin is not None else ""
+        lines.append(f"  {field}{rows_pin}: {a:g} -> {b:g} "
+                     f"({rel:+.1%}){mark}")
+    if len(lines) == 1:
+        lines.append("  (no shared numeric field changed)")
     added = sorted(new.keys() - old.keys())
     removed = sorted(old.keys() - new.keys())
     if added:
@@ -93,25 +192,41 @@ def diff(old_path: pathlib.Path, new_path: pathlib.Path) -> list[str]:
                      f"noise band: " + ", ".join(flagged))
     else:
         lines.append("  all shared fields within their noise bands")
-    return lines
+    stale = [w for w in waivers if w["run"] != f"r{new_n}"]
+    if stale:
+        lines.append(
+            f"  {len(stale)} stale waiver(s) (not for r{new_n}): "
+            + ", ".join(f"{w['field']}@{w['run']}" for w in stale)
+            + " — safe to delete")
+    return lines, failures
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--root", default=str(ROOT),
                         help="directory holding BENCH_r*.json")
+    parser.add_argument("--gate", action="store_true",
+                        help="exit 1 when a pinned field drifts past "
+                             "its noise band in the bad direction "
+                             "without a BENCH_WAIVERS.json entry")
     args = parser.parse_args(argv)
-    runs = newest_two(pathlib.Path(args.root))
-    if len(runs) < 2:
-        print(f"bench-diff: need two BENCH_r*.json under {args.root}, "
-              f"found {len(runs)} — nothing to compare")
-        return 0
     try:
-        for line in diff(runs[0], runs[1]):
-            print(line)
+        lines, failures = diff(pathlib.Path(args.root), gate=args.gate)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
-        print(f"bench-diff: unreadable run file: {exc}",
+        print(f"bench-diff: unreadable run/waiver file: {exc}",
               file=sys.stderr)
+        return 1
+    for line in lines:
+        print(line)
+    if failures:
+        print("bench-diff GATE FAILURE — pinned perf field(s) "
+              "regressed past their noise band:", file=sys.stderr)
+        for failure in failures:
+            print(f"  {failure}", file=sys.stderr)
+        print(f"  intentional? name it: add a waiver to {WAIVERS} "
+              f"(field/run/reason). Triage: make profile-ingest / "
+              f"make profile-tick; see OPERATIONS.md 'Performance "
+              f"ledger'.", file=sys.stderr)
         return 1
     return 0
 

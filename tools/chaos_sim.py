@@ -137,8 +137,42 @@ class SessionFleet:
         return outcomes
 
 
+def make_front(hub, server, procs: int, port: int = 0,
+               read_deadline: float = 10.0):
+    """The public-facing ingest front for a scenario: (public_port,
+    pool). procs=0 is the classic single-process shape (the hub's own
+    MetricsServer is public, pool None); procs>0 puts an ISSUE 17
+    SO_REUSEPORT acceptor pool in front, relaying to the same hub —
+    every scenario must pass in both shapes."""
+    if procs <= 0:
+        return server.port, None
+    from kube_gpu_stats_tpu.ingestproc import IngestProcPool
+
+    pool = IngestProcPool(hub.delta.handle, host="127.0.0.1", port=port,
+                          procs=procs, parent_port=server.port,
+                          read_deadline=read_deadline)
+    pool.start()
+    return pool.port, pool
+
+
+def check_proc_conservation(hub, pool, label: str) -> list[str]:
+    """The multi-proc conservation law: every frame the acceptors
+    relayed is accounted by the hub, and the accepted sum equals the
+    hub's own full+delta+duplicate totals."""
+    if pool is None:
+        return []
+    ingest = hub.delta
+    hub_total = (ingest.full_frames_total + ingest.delta_frames_total
+                 + ingest.duplicate_frames_total)
+    if pool.accepted_total() != hub_total:
+        return [f"{label}: per-proc accepted sum "
+                f"{pool.accepted_total()} != hub frame total {hub_total}"]
+    return []
+
+
 def scenario_warm_restart(tmp: str, daemons_n: int,
-                          sessions_n: int, verbose: bool) -> list[str]:
+                          sessions_n: int, verbose: bool,
+                          procs: int = 0) -> list[str]:
     """Kill/restart a checkpointing root hub under real daemons + a
     synthesized session fleet; assert warm resume."""
     from kube_gpu_stats_tpu.config import Config
@@ -168,8 +202,9 @@ def scenario_warm_restart(tmp: str, daemons_n: int,
                            ready_check=hub.ready,
                            ingest_provider=hub.delta.handle)
     server.start()
-    port = server.port
+    port, pool = make_front(hub, server, procs)
     hub2 = server2 = None
+    pool2 = None
     try:
         import os
 
@@ -223,6 +258,8 @@ def scenario_warm_restart(tmp: str, daemons_n: int,
         # crash point (stop() force-writes a newest-state checkpoint —
         # a clean drain — so the crash is simulated by restoring the
         # pre-stop bytes, exactly what kill -9 would have left).
+        if pool is not None:
+            pool.stop()
         server.stop()
         hub.stop()
         pathlib.Path(ckpt).write_bytes(crash_state)
@@ -230,11 +267,16 @@ def scenario_warm_restart(tmp: str, daemons_n: int,
         resyncs_before_restart = sum(p.resyncs_total for p in publishers)
         restart_start = time.monotonic()
         hub2 = make_hub()
-        server2 = MetricsServer(hub2.registry, host="127.0.0.1", port=port,
+        server2 = MetricsServer(hub2.registry, host="127.0.0.1",
+                                port=(0 if procs else port),
                                 trace_provider=hub2.tracer,
                                 ready_check=hub2.ready,
                                 ingest_provider=hub2.delta.handle)
         server2.start()
+        if procs:
+            # The restarted acceptor pool rebinds the SAME public port
+            # (the fleet's publishers reconnect there).
+            _port2, pool2 = make_front(hub2, server2, procs, port=port)
         hub2.start()
 
         # The silent synthesized fleet resumes its chains cold-free:
@@ -292,6 +334,10 @@ def scenario_warm_restart(tmp: str, daemons_n: int,
             daemon.stop()
         for fake in fakes:
             fake.stop()
+        if pool is not None:
+            pool.stop()
+        if pool2 is not None:
+            pool2.stop()
         if server2 is not None:
             server2.stop()
         if hub2 is not None:
@@ -299,7 +345,7 @@ def scenario_warm_restart(tmp: str, daemons_n: int,
     return problems
 
 
-def scenario_stampede(verbose: bool) -> list[str]:
+def scenario_stampede(verbose: bool, procs: int = 0) -> list[str]:
     """2x-budget publisher stampede against an admission-controlled
     hub: shed-not-crash, zero established-session drops."""
     from kube_gpu_stats_tpu.delta import encode_full
@@ -316,8 +362,9 @@ def scenario_stampede(verbose: bool) -> list[str]:
                            trace_provider=hub.tracer,
                            ingest_provider=hub.delta.handle)
     server.start()
+    port, pool = make_front(hub, server, procs)
     try:
-        fleet = SessionFleet(server.port, n, prefix="stampede")
+        fleet = SessionFleet(port, n, prefix="stampede")
         bad_seed = [o for o in fleet.seed() if o[1] != 200]
         if bad_seed:
             problems.append(f"stampede: seeding failed: {bad_seed[:3]}")
@@ -326,8 +373,8 @@ def scenario_stampede(verbose: bool) -> list[str]:
         # The fence: a new session at capacity is refused 503 +
         # Retry-After, never accepted into RSS.
         status, retry = post_frame(
-            server.port, encode_full("http://intruder:9400/metrics",
-                                     7, 1, fleet.bodies[0]))
+            port, encode_full("http://intruder:9400/metrics",
+                              7, 1, fleet.bodies[0]))
         if status != 503 or retry is None:
             problems.append(
                 f"stampede: memory fence answered {status} "
@@ -347,9 +394,9 @@ def scenario_stampede(verbose: bool) -> list[str]:
             # A recovery FULL mid-storm must always be admitted.
             victim = wave * 31 % n
             status, _retry = post_frame(
-                server.port, encode_full(fleet.sources[victim],
-                                         5_000_000 + victim * 10 + wave, 1,
-                                         fleet.bodies[victim]))
+                port, encode_full(fleet.sources[victim],
+                                  5_000_000 + victim * 10 + wave, 1,
+                                  fleet.bodies[victim]))
             if status != 200:
                 problems.append(
                     f"stampede: recovery FULL refused with {status} "
@@ -380,16 +427,32 @@ def scenario_stampede(verbose: bool) -> list[str]:
             problems.append(
                 "stampede: kts_ingest_shed_total{reason=delta_rate} "
                 "missing from the exposition")
+        problems += check_proc_conservation(hub, pool, "stampede")
+        if pool is not None:
+            relayed = sum(s["frames"]
+                          for s in pool.proc_stats().values())
+            # Every frame passed through exactly one acceptor: the n
+            # seeds, the intruder probe, every wave outcome, and the 4
+            # recovery FULLs.
+            expected = n + 1 + landed + shed + len(crashed) + 4
+            if relayed != expected:
+                problems.append(
+                    f"stampede: acceptors relayed {relayed} frames, "
+                    f"expected {expected}")
         if verbose:
             print(f"  stampede: {landed} landed, {shed} shed with 429, "
-                  f"{alive}/{n} sessions alive")
+                  f"{alive}/{n} sessions alive"
+                  + (f", {procs} acceptor procs conserved counters"
+                     if pool is not None else ""))
     finally:
+        if pool is not None:
+            pool.stop()
         server.stop()
         hub.stop()
     return problems
 
 
-def scenario_hostile(verbose: bool) -> list[str]:
+def scenario_hostile(verbose: bool, procs: int = 0) -> list[str]:
     """Slow-loris + corrupt-frame flood beside healthy pushers."""
     import json
     import urllib.request
@@ -407,8 +470,12 @@ def scenario_hostile(verbose: bool) -> list[str]:
                            ingest_provider=hub.delta.handle,
                            ingest_read_deadline=1.0)
     server.start()
+    # The acceptor edge applies the same 1 s body-read deadline the
+    # in-process server does — the lorises must be cut off at the
+    # child, never holding a relay channel.
+    port, pool = make_front(hub, server, procs, read_deadline=1.0)
     try:
-        fleet = SessionFleet(server.port, 16, prefix="healthy")
+        fleet = SessionFleet(port, 16, prefix="healthy")
         bad_seed = [o for o in fleet.seed() if o[1] != 200]
         if bad_seed:
             problems.append(f"hostile: seeding failed: {bad_seed[:3]}")
@@ -416,7 +483,7 @@ def scenario_hostile(verbose: bool) -> list[str]:
         # --- slow-loris: headers + a dribble, then silence ------------
         lorises = []
         for _ in range(5):
-            sock = socket.create_connection(("127.0.0.1", server.port),
+            sock = socket.create_connection(("127.0.0.1", port),
                                             timeout=10)
             sock.sendall(b"POST /ingest/delta HTTP/1.1\r\n"
                          b"Host: chaos\r\n"
@@ -465,7 +532,7 @@ def scenario_hostile(verbose: bool) -> list[str]:
             evil_gen += 1
             wire = encode_full(evil_source, evil_gen, 1,
                                "this is { not an exposition !!\n")
-            status, retry = post_frame(server.port, wire)
+            status, retry = post_frame(port, wire)
             if status == 429 and retry is not None:
                 quarantined_at = attempt
                 break
@@ -490,35 +557,43 @@ def scenario_hostile(verbose: bool) -> list[str]:
             problems.append(
                 "hostile: kts_ingest_quarantined did not rise")
         events = json.loads(urllib.request.urlopen(
-            f"http://127.0.0.1:{server.port}/debug/events",
+            f"http://127.0.0.1:{port}/debug/events",
             timeout=10).read())
         if not any(e.get("kind") == "ingest_quarantine"
                    for e in events.get("events", [])):
             problems.append(
                 "hostile: no ingest_quarantine journal event")
+        if pool is not None:
+            problems += check_proc_conservation(hub, pool, "hostile")
         if verbose:
             print(f"  hostile: {cut}/5 lorises cut, evil source "
                   f"quarantined after {quarantined_at} bad frames, "
                   f"healthy pushers unaffected")
     finally:
+        if pool is not None:
+            pool.stop()
         server.stop()
         hub.stop()
     return problems
 
 
-def run(daemons_n: int, sessions_n: int, verbose: bool) -> int:
+def run(daemons_n: int, sessions_n: int, verbose: bool,
+        procs: int = 0) -> int:
     problems: list[str] = []
     with tempfile.TemporaryDirectory() as tmp:
         problems += scenario_warm_restart(tmp, daemons_n, sessions_n,
-                                          verbose)
-    problems += scenario_stampede(verbose)
-    problems += scenario_hostile(verbose)
+                                          verbose, procs=procs)
+    problems += scenario_stampede(verbose, procs=procs)
+    problems += scenario_hostile(verbose, procs=procs)
     if not problems:
+        front = (f" — all through {procs} SO_REUSEPORT acceptor "
+                 f"process(es) with conserved per-proc counters"
+                 if procs else "")
         print(f"chaos-sim PASS: hub kill/restart warm-resumed "
               f"{sessions_n} sessions + {daemons_n} daemons, stampede "
               f"shed with 429 and zero session drops, lorises cut at "
               f"the read deadline, corrupt-frame source quarantined "
-              f"with healthy pushers unharmed")
+              f"with healthy pushers unharmed{front}")
         return 0
     print("chaos-sim FAIL:")
     for problem in problems:
@@ -532,9 +607,14 @@ def main(argv=None) -> int:
     parser.add_argument("--sessions", type=int, default=256,
                         help="synthesized delta sessions in the "
                              "warm-restart fleet")
+    parser.add_argument("--ingest-procs", type=int, default=0,
+                        help="run every scenario through N SO_REUSEPORT "
+                             "acceptor processes (ISSUE 17 multi-proc "
+                             "ingest) instead of in-process ingest")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
-    return run(args.daemons, args.sessions, args.verbose)
+    return run(args.daemons, args.sessions, args.verbose,
+               procs=args.ingest_procs)
 
 
 if __name__ == "__main__":
