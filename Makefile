@@ -1,4 +1,4 @@
-.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim local-sim cardinality-sim bench-diff
+.PHONY: test bench bench-quick profile-tick profile-ingest trace-tick native dashboard golden clean run-mock ci chaos lint fleet-sim federation-sim energy-sim host-sim chaos-sim partition-sim skew-sim local-sim cardinality-sim query-sim bench-diff
 
 # The full gate .github/workflows/ci.yaml encodes, runnable offline:
 # native build, suite (goldens diffed), zero-NVML grep, chart checks
@@ -15,6 +15,7 @@ ci: native lint bench-diff
 	python tools/skew_sim.py
 	python tools/localfault_sim.py
 	python tools/cardinality_sim.py
+	python tools/query_sim.py
 	@if command -v helm >/dev/null 2>&1; then \
 	    helm template deploy/helm/kube-tpu-stats >/dev/null && \
 	    echo 'helm render: ok'; \
@@ -144,6 +145,19 @@ skew-sim:
 # the bomb stops. In `make ci` too.
 cardinality-sim:
 	python tools/cardinality_sim.py --verbose
+
+# Dashboard-stampede smoke (<30 s, ISSUE 18): 256 keep-alive readers
+# polling /query against a LIVE-refreshing hub — p50/p99 pinned (the
+# pre-rendered per-(family,window,generation) response cache is the
+# mechanism), >= 50% 304s for conditional readers once the generation
+# holds, a tightened per-client gate shedding 429 + Retry-After with
+# the observed count exactly equal to the gate ledger and the exported
+# kts_query_shed_total, and the history ring's slab bytes flat under
+# the whole storm. In `make ci` too; the recorded figures live in
+# BENCH_r*.json (bench.measure_query_serving) with CI pins in
+# tests/test_latency.py.
+query-sim:
+	python tools/query_sim.py --verbose
 
 # Compare the two newest BENCH_r*.json runs field by field, noise
 # bands derived from the BENCH_r* history — CI-GATING (ISSUE 17): a

@@ -444,6 +444,52 @@ def add_cardinality_flags(p: argparse.ArgumentParser) -> None:
                         "being pulled is never evicted for pressure)")
 
 
+def add_history_flags(p: argparse.ArgumentParser) -> None:
+    """The hub's history-ring + /query serving knobs (ISSUE 18): the
+    embedded lookback store behind `/query` and `doctor --fleet --at`.
+    On by default with a bounded footprint (~16 KB of preallocated
+    slab per series across the fixed 1h/24h/7d tiers)."""
+    p.add_argument("--no-history", action="store_true",
+                   default=_env("NO_HISTORY", "") == "1",
+                   help="disable the in-hub history ring: /query "
+                        "answers enabled:false, doctor --fleet --at "
+                        "degrades with a pointer here, and the hub "
+                        "holds zero ring memory")
+    p.add_argument("--history-series-max", type=int,
+                   default=int(_env("HISTORY_SERIES_MAX", "1024")),
+                   help="series identities (rollup family + labels) "
+                        "the ring preallocates slabs for — the memory "
+                        "bound is this times the fixed per-series slab "
+                        "cost. At the cap, new identities reclaim a "
+                        "stale slab (kts_history_series_evicted_total) "
+                        "or shed (kts_history_series_shed_total); the "
+                        "live exposition is never affected")
+    p.add_argument("--history-query-qps", type=float,
+                   default=float(_env("HISTORY_QUERY_QPS", "50")),
+                   help="per-client /query admission rate: tokens per "
+                        "second, over it draws 429 + Retry-After "
+                        "(kts_query_shed_total) — one misconfigured "
+                        "dashboard at 100 Hz cannot starve scrapes. "
+                        "0 = unlimited")
+    p.add_argument("--history-query-burst", type=float,
+                   default=float(_env("HISTORY_QUERY_BURST", "100")),
+                   help="per-client /query token bucket depth: the "
+                        "burst a dashboard page-load may spend at once "
+                        "before the per-second rate applies")
+
+
+def validate_history_args(args) -> str | None:
+    """Range rules for the history-ring flags; the hub parser surfaces
+    the string through parser.error."""
+    if args.history_series_max < 1:
+        return "--history-series-max must be >= 1"
+    if args.history_query_qps < 0:
+        return "--history-query-qps must be >= 0 (0 = unlimited)"
+    if args.history_query_burst < 1:
+        return "--history-query-burst must be >= 1"
+    return None
+
+
 def validate_cardinality_args(args) -> str | None:
     """Range rules for the cardinality admission flags; the hub parser
     surfaces the string through parser.error."""

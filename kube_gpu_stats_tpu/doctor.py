@@ -1416,6 +1416,148 @@ def check_fleet(base: str) -> CheckResult:
     return _result("fleet", status, detail, data=data)
 
 
+def parse_at(raw: str, now: float) -> float:
+    """``--at`` value -> unix seconds. Accepts absolute unix seconds
+    (anything past ~2001), or an ago-style offset: plain seconds, or a
+    number with an m/h suffix, optional leading '-' ("600", "10m",
+    "-2h" all mean that long before now). Raises ValueError with the
+    accepted forms — main() prints it as the usage error."""
+    text = raw.strip().lstrip("-")
+    if not text:
+        raise ValueError("--at requires a time (unix seconds, or an "
+                         "ago-offset like 600, 10m, 2h)")
+    scale = 1.0
+    if text[-1] in ("m", "h"):
+        scale = 60.0 if text[-1] == "m" else 3600.0
+        text = text[:-1]
+    try:
+        value = float(text)
+    except ValueError:
+        raise ValueError(f"--at: {raw!r} is not a time (unix seconds, "
+                         f"or an ago-offset like 600, 10m, 2h)")
+    if scale == 1.0 and value > 1e9:
+        return value  # absolute unix timestamp
+    return now - value * scale
+
+
+def fleet_at_verdict(steps_payload: dict, up_payload: dict,
+                     ratio_payload: dict,
+                     at_ts: float) -> tuple[str, str, dict]:
+    """(status, detail, data) for a retroactive fleet post-mortem at
+    ``at_ts``, computed from the hub history ring's /query?at=
+    payloads (named-window nearest-sample semantics: each value is the
+    populated bucket nearest the timestamp from the finest tier still
+    covering it — the sample's own timestamp is printed as 'as of').
+    Pure so the fault-injection test drives it on canned payloads: a
+    straggler visible at the timestamp stays named here even after it
+    recovers, because the verdict reads the ring, not the live lens."""
+    data: dict = {"at": at_ts, "slices": {}, "targets_down": []}
+    parts: list[str] = []
+    status = OK
+    # Per-slice straggler attribution from the per-worker step rates.
+    by_slice: dict[str, list[tuple[str, float, float]]] = {}
+    for entry in steps_payload.get("series") or []:
+        labels = entry.get("labels") or {}
+        slice_name = labels.get("slice", "")
+        worker = labels.get("worker", "")
+        by_slice.setdefault(slice_name, []).append(
+            (worker, float(entry.get("v", 0.0)),
+             float(entry.get("t", at_ts))))
+    ratios = {
+        (entry.get("labels") or {}).get("slice", ""):
+            float(entry.get("v", 0.0))
+        for entry in ratio_payload.get("series") or []
+    }
+    for slice_name in sorted(by_slice):
+        workers = by_slice[slice_name]
+        best = max(rate for _w, rate, _t in workers)
+        slowest = min(workers, key=lambda w: w[1])
+        ratio = ratios.get(
+            slice_name,
+            (slowest[1] / best) if best > 0 else 1.0)
+        data["slices"][slice_name] = {
+            "ratio": ratio,
+            "slowest_worker": slowest[0],
+            "slowest_rate": slowest[1],
+            "best_rate": best,
+            "sample_ts": slowest[2],
+        }
+        if best > 0 and ratio < 0.75:
+            status = WARN
+            parts.append(
+                f"slice {slice_name}: straggler worker {slowest[0]} at "
+                f"{slowest[1]:g} steps/s vs best {best:g} "
+                f"(ratio {ratio:.2f}, as of {_ts(slowest[2])})")
+    down = [
+        ((entry.get("labels") or {}).get("target", ""),
+         float(entry.get("t", at_ts)))
+        for entry in up_payload.get("series") or []
+        if float(entry.get("v", 1.0)) == 0.0
+    ]
+    for target, sample_ts in sorted(down):
+        status = WARN
+        data["targets_down"].append(target)
+        parts.append(f"{target} was down (as of {_ts(sample_ts)})")
+    if not (steps_payload.get("series") or up_payload.get("series")):
+        return (WARN,
+                f"history has no samples near {_ts(at_ts)} — the ring "
+                f"holds 1h/24h/7d tiers from THIS hub boot only (it "
+                f"intentionally does not survive a restart)", data)
+    if not parts:
+        parts.append(f"fleet healthy at {_ts(at_ts)}: no straggler "
+                     f"slice, no down target in the nearest samples")
+    return status, "; ".join(parts), data
+
+
+def _ts(ts: float) -> str:
+    """Compact UTC render for --at verdict lines."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def check_fleet_at(base: str, at_ts: float) -> CheckResult:
+    """--fleet --at: replay the fleet verdict from the hub's history
+    ring at a past timestamp (three /query?at= reads; the ring's
+    nearest-sample answer, not the live lens)."""
+    import urllib.error
+
+    payloads = {}
+    for family in ("slice_worker_steps_per_second", "slice_target_up",
+                   "slice_straggler_ratio"):
+        try:
+            payloads[family] = _fetch_json(
+                f"{base}/query?family={family}&at={at_ts}")
+        except urllib.error.HTTPError as exc:
+            if exc.code in (401, 403):
+                return _result(
+                    "fleet-at", WARN,
+                    f"{base}/query requires authentication "
+                    f"(HTTP {exc.code}); /query sits behind the hub's "
+                    f"basic-auth gate by design")
+            if exc.code == 404:
+                # An unknown family 404s too (e.g. the ring holds no
+                # samples for it yet) — the no-samples verdict below
+                # covers it.
+                payloads[family] = {}
+                continue
+            return _result("fleet-at", FAIL,
+                           f"{base}/query: HTTP {exc.code}")
+        except Exception as exc:  # noqa: BLE001 - unreachable hub
+            return _result("fleet-at", FAIL,
+                           f"{base}: history unreadable ({exc})")
+        if payloads[family].get("enabled") is False:
+            return _result(
+                "fleet-at", WARN,
+                f"{base}: history disabled (hub runs --no-history or "
+                f"predates the history ring) — --at has nothing to "
+                f"replay from")
+    status, detail, data = fleet_at_verdict(
+        payloads.get("slice_worker_steps_per_second") or {},
+        payloads.get("slice_target_up") or {},
+        payloads.get("slice_straggler_ratio") or {},
+        at_ts)
+    return _result("fleet-at", status, detail, data=data)
+
+
 def check_url(target: str) -> list[CheckResult]:
     """Both --url rows — scrape contract + live breaker state — off ONE
     fetch: a node being diagnosed precisely because it is degraded must
@@ -1602,7 +1744,8 @@ def run_checks(cfg: Config, url: str = "",
                egress: bool = False,
                skew: bool = False,
                stores: bool = False,
-               cardinality: bool = False) -> list[CheckResult]:
+               cardinality: bool = False,
+               fleet_at: float | None = None) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -1692,7 +1835,13 @@ def run_checks(cfg: Config, url: str = "",
         fleet_base = (trace_base(url)
                       if url.startswith(("http://", "https://"))
                       else f"http://127.0.0.1:{HUB_DEFAULT_PORT}")
-        probes.append(("fleet", lambda: check_fleet(fleet_base)))
+        if fleet_at is not None:
+            # --at: retroactive post-mortem from the history ring
+            # instead of the live lens (ISSUE 18).
+            probes.append(("fleet-at",
+                           lambda: check_fleet_at(fleet_base, fleet_at)))
+        else:
+            probes.append(("fleet", lambda: check_fleet(fleet_base)))
     results: list[CheckResult] = []
     for name, probe in probes:
         results.extend(_bounded(name, probe))
@@ -1753,6 +1902,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     stores = False
     cardinality = False
     url = ""
+    at_raw = ""
     args: list[str] = []
     it = iter(raw)
     for token in it:
@@ -1786,14 +1936,38 @@ def main(argv: Sequence[str] | None = None) -> int:
                 print("--url requires a target (URL or .prom file)",
                       file=sys.stderr)
                 return 2
+        elif token == "--at":
+            at_raw = next(it, "")
+            if not at_raw or at_raw.startswith("--"):
+                print("--at requires a time (unix seconds, or an "
+                      "ago-offset like 600, 10m, 2h)", file=sys.stderr)
+                return 2
+        elif token.startswith("--at="):
+            at_raw = token[len("--at="):]
+            if not at_raw:
+                print("--at requires a time (unix seconds, or an "
+                      "ago-offset like 600, 10m, 2h)", file=sys.stderr)
+                return 2
         else:
             args.append(token)
+    fleet_at = None
+    if at_raw:
+        if not fleet:
+            print("--at only makes sense with --fleet (it replays the "
+                  "fleet verdict from the hub's history ring)",
+                  file=sys.stderr)
+            return 2
+        try:
+            fleet_at = parse_at(at_raw, time.time())
+        except ValueError as exc:
+            print(str(exc), file=sys.stderr)
+            return 2
     cfg = from_args(args)
     started = time.monotonic()
     results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
                          energy=energy, host=host, egress=egress,
                          skew=skew, stores=stores,
-                         cardinality=cardinality)
+                         cardinality=cardinality, fleet_at=fleet_at)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

@@ -20,6 +20,7 @@ import time
 from pathlib import Path
 
 from . import schema
+from .history import etag_match
 from .registry import HistogramState, Registry
 from .supervisor import spawn
 from .workers import PublishFollower, push_opener
@@ -49,6 +50,17 @@ def _gzip_accepted(accept_encoding: str) -> bool:
     return False
 
 
+def _metrics_etag(boot_id: str, generation: int, openmetrics: bool,
+                  gzip_wanted: bool) -> str:
+    """Strong ETag for a /metrics representation: boot nonce (a warm
+    restart resets the generation counter — without the nonce a reader
+    from the previous boot could draw a stale 304), render generation,
+    and the negotiated shape (format + encoding), so the same reader
+    regenerates the same tag for the same request between publishes."""
+    return (f'"{boot_id}-{generation}'
+            f'-m{int(openmetrics)}{int(gzip_wanted)}"')
+
+
 class RenderStats:
     """Scrape-side self-observability shared by every render site (HTTP
     scrape, textfile, pushgateway, remote_write — round-1 verdict item 5:
@@ -67,6 +79,10 @@ class RenderStats:
         self._rejected_warned = False
         self._cache_hits = 0
         self._cache_misses = 0
+        # Conditional reads answered 304, by path. Seeded so both
+        # series are born at 0 on the first contribute — same
+        # increase()-alerting reasoning as the rejection counter.
+        self._not_modified: dict[str, int] = {"/metrics": 0, "/query": 0}
 
     def observe(self, output: str, seconds: float, nbytes: int) -> None:
         with self._lock:
@@ -90,6 +106,13 @@ class RenderStats:
             else:
                 self._cache_misses += 1
 
+    def observe_not_modified(self, path: str) -> None:
+        """Count a conditional read answered 304 (the If-None-Match hit
+        that cost zero render/gzip/transfer —
+        kts_scrape_not_modified_total{path=...})."""
+        with self._lock:
+            self._not_modified[path] = self._not_modified.get(path, 0) + 1
+
     def reject(self) -> None:
         """Count a scrape the storm guard answered 503 — the guard must
         be diagnosable from the exposition, not just from gaps."""
@@ -111,6 +134,7 @@ class RenderStats:
             rejected = self._rejected
             cache_hits = self._cache_hits
             cache_misses = self._cache_misses
+            not_modified = sorted(self._not_modified.items())
         for hist in hists:
             builder.add_histogram(hist)
         for output, total in sizes:
@@ -121,6 +145,9 @@ class RenderStats:
         builder.add(schema.SELF_SCRAPES_REJECTED, float(rejected))
         builder.add(schema.RENDER_CACHE_HITS, float(cache_hits))
         builder.add(schema.RENDER_CACHE_MISSES, float(cache_misses))
+        for path, count in not_modified:
+            builder.add(schema.SCRAPE_NOT_MODIFIED, float(count),
+                        (("path", path),))
 
 
 class _AcceptFence:
@@ -189,6 +216,13 @@ class _FencedHTTPServer(http.server.ThreadingHTTPServer):
 
     fence: _AcceptFence | None = None
 
+    # socketserver's default listen backlog is 5 — a 256-reader
+    # dashboard stampede (ISSUE 18) overflows it instantly and the
+    # dropped SYNs come back as multi-second TCP retransmits, which is
+    # the whole query p99. The accept loop drains a deeper backlog in
+    # microseconds; memory cost is a queue of accepted-socket refs.
+    request_queue_size = 256
+
     def get_request(self):
         try:
             request = super().get_request()
@@ -242,9 +276,16 @@ class MetricsServer:
                  energy_provider=None, host_provider=None,
                  egress_provider=None, skew_provider=None,
                  stores_provider=None, cardinality_provider=None,
+                 history_provider=None,
                  prewarm_renders: bool = True,
                  ingest_read_deadline: float = 10.0):
         self._registry = registry
+        # History ring + /query serving (ISSUE 18, duck-typed:
+        # handle_query(params, client, gzip_ok, if_none_match) ->
+        # (status, body, headers)): the hub wires its HistoryStore
+        # here; a wired-but-disabled store (--no-history) answers
+        # enabled:false, None (daemons, bare test servers) 404s.
+        self._history = history_provider
         self._healthz_max_age = healthz_max_age
         self._render_stats = render_stats
         # Delta-push ingest (delta.DeltaIngest.handle, duck-typed:
@@ -368,6 +409,17 @@ class MetricsServer:
             # sockets exhaust the thread budget.
             timeout = 30.0
 
+            # Keep-alive (ISSUE 18): every response path sends
+            # Content-Length (the two write sites are _send_plain and
+            # the do_GET tail), so HTTP/1.1 persistent connections are
+            # safe — and they change the dashboard-stampede cost model
+            # from connect+thread-spawn+teardown PER REQUEST (~1 ms of
+            # single-core CPU, which saturates at ~1k req/s and turns
+            # 256 readers into 200 ms queueing tails) to parse+respond
+            # on a long-lived thread. Idle connections are bounded by
+            # ``timeout`` above.
+            protocol_version = "HTTP/1.1"
+
             # Scrapes arrive at >= 1/s per Prometheus; default logging to
             # stderr per request would swamp the DaemonSet logs.
             def log_message(self, fmt: str, *args) -> None:
@@ -411,9 +463,13 @@ class MetricsServer:
             def _send_plain(self, code: int, body: bytes,
                             headers: dict | None = None) -> None:
                 self.send_response(code)
+                content_type = "text/plain"
                 for key, value in (headers or {}).items():
+                    if key.lower() == "content-type":
+                        content_type = value
+                        continue
                     self.send_header(key, value)
-                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -486,6 +542,34 @@ class MetricsServer:
                              'Basic realm="kube-tpu-stats"'})
                         return
                 if path == "/metrics":
+                    # Content negotiation: Prometheus asks for
+                    # OpenMetrics with an explicit Accept; default
+                    # stays text 0.0.4.
+                    accept = self.headers.get("Accept", "")
+                    use_om = "application/openmetrics-text" in accept
+                    gz_wanted = _gzip_accepted(
+                        self.headers.get("Accept-Encoding", ""))
+                    # Conditional scrape (ISSUE 18): the ETag names
+                    # (boot, generation, shape), so If-None-Match on an
+                    # unchanged generation answers 304 BEFORE the
+                    # scrape-slot acquire — zero render, zero gzip, zero
+                    # body, and it can't be starved by the storm guard
+                    # it relieves. A publish racing this check just
+                    # misses (full response with the new ETag).
+                    inm = self.headers.get("If-None-Match", "")
+                    boot = getattr(outer._registry, "boot_id", "")
+                    if inm and boot:
+                        etag = _metrics_etag(
+                            boot, outer._registry.generation, use_om,
+                            gz_wanted)
+                        if etag_match(inm, etag):
+                            if outer._render_stats is not None:
+                                outer._render_stats.observe_not_modified(
+                                    "/metrics")
+                            self._send_plain(
+                                304, b"",
+                                {"ETag": etag, "Vary": "Accept-Encoding"})
+                            return
                     slots = outer._scrape_slots
                     if slots is not None and not slots.acquire(blocking=False):
                         if outer._render_stats is not None:
@@ -494,28 +578,23 @@ class MetricsServer:
                                          {"Retry-After": "1"})
                         return
                     try:
-                        # Content negotiation: Prometheus asks for
-                        # OpenMetrics with an explicit Accept; default
-                        # stays text 0.0.4.
-                        accept = self.headers.get("Accept", "")
-                        use_om = "application/openmetrics-text" in accept
                         render_start = time.monotonic()
                         # Memoized per generation (Registry.rendered): N
                         # concurrent scrapers between publishes cost one
                         # render+compress, and the bytes are identical to
                         # an uncached Snapshot.render() (golden-pinned).
-                        body, cache_hit = outer._registry.rendered(
-                            openmetrics=use_om)
-                        if len(body) >= outer.GZIP_MIN_BYTES and \
-                                _gzip_accepted(
-                                    self.headers.get("Accept-Encoding", "")):
+                        body, cache_hit, body_gen = (
+                            outer._registry.rendered_versioned(
+                                openmetrics=use_om))
+                        if len(body) >= outer.GZIP_MIN_BYTES and gz_wanted:
                             # Level 3, not 6: measured on a 32-chip 161 KB
                             # exposition, 0.4 ms vs 1.1 ms for only ~1 KB
                             # more wire (10.0 vs 8.9 KB) — compression
                             # latency sits on the north-star scrape path,
                             # the bytes don't.
-                            body, cache_hit = outer._registry.rendered(
-                                openmetrics=use_om, gzip_level=3)
+                            body, cache_hit, body_gen = (
+                                outer._registry.rendered_versioned(
+                                    openmetrics=use_om, gzip_level=3))
                             encoding = "gzip"
                         if outer._render_stats is not None:
                             # Render + gzip, post-compression size: the
@@ -534,6 +613,13 @@ class MetricsServer:
                         OPENMETRICS_CONTENT_TYPE if use_om else CONTENT_TYPE,
                     )
                     self.send_header("Vary", "Accept-Encoding")
+                    if boot:
+                        # The generation rendered_versioned returned IS
+                        # the generation of these bytes (coherent read
+                        # under the publish lock), so this ETag can
+                        # never name a body it doesn't match.
+                        self.send_header("ETag", _metrics_etag(
+                            boot, body_gen, use_om, gz_wanted))
                     if encoding:
                         self.send_header("Content-Encoding", encoding)
                 elif path == "/healthz":
@@ -798,6 +884,27 @@ class MetricsServer:
                     body = "".join(parts).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                elif path == "/query" and outer._history is not None:
+                    # History-ring range/at reads (ISSUE 18). The store
+                    # owns admission, validation, the ETag verdict and
+                    # the pre-rendered response cache; this handler only
+                    # writes what it returns — a hot query is a dict hit
+                    # and a sendall, never a render.
+                    try:
+                        code, qbody, qheaders = outer._history.handle_query(
+                            self._query(), self.client_address[0],
+                            _gzip_accepted(
+                                self.headers.get("Accept-Encoding", "")),
+                            self.headers.get("If-None-Match", ""))
+                    except Exception:  # noqa: BLE001 - a query must not
+                        # kill the handler thread with a stack trace as
+                        # the only evidence.
+                        log.exception("/query crashed")
+                        code, qbody, qheaders = 500, b"query error\n", {}
+                    if code == 304 and outer._render_stats is not None:
+                        outer._render_stats.observe_not_modified("/query")
+                    self._send_plain(code, qbody, qheaders or None)
+                    return
                 elif path == "/":
                     # Every endpoint this server actually serves, so the
                     # landing page IS the endpoint inventory (the trace
@@ -824,6 +931,8 @@ class MetricsServer:
                         links += ["/debug/stores"]
                     if outer._cardinality is not None:
                         links += ["/debug/cardinality"]
+                    if outer._history is not None:
+                        links += ["/query?family=slice_chips&window=1h"]
                     body = ("<html><body>kube-tpu-stats " + " ".join(
                         f'<a href="{link}">{link.partition("?")[0]}</a>'
                         for link in links) + "</body></html>").encode()

@@ -1,0 +1,481 @@
+"""Embedded history ring + pre-rendered time-travel queries (ISSUE 18).
+
+The hub answers "what is the fleet doing right now"; incident triage
+needs "what was it doing ten minutes ago" without standing up a TSDB.
+This module keeps a bounded, downsampled in-hub ring per rollup family
+and serves it three ways:
+
+- ``/query?family=...&window=...`` — a range read over one rollup
+  family, served from a per-(family, window, generation) pre-rendered
+  + pre-gzipped response cache: a hot dashboard query is a dict hit
+  and a ``sendall``, never a render.
+- ``/query?family=...&at=<ts>`` — nearest-sample lookup at a past
+  timestamp, the payload ``doctor --fleet --at`` replays the fleet
+  verdict from.
+- ``kts_history_*`` / ``kts_query_*`` self-metrics on every publish.
+
+Ring mechanics: fixed tiers (named windows), each a preallocated slab
+of (mean, count, bucket-id) arrays — writes are in-place array stores,
+no per-sample allocation, so feeding the ring at render-generation
+time costs ~nothing on the refresh path. Samples land via
+:meth:`HistoryStore.record` (refresh thread, staged) and
+:meth:`HistoryStore.commit` (once per publish). A tier bucket holds
+the MEAN of the samples that landed in it (downsampling semantics the
+brute-force oracle in tests/test_history.py pins).
+
+Memory is fixed by construction: ``max_series`` identities, each
+costing exactly ``SERIES_BYTES`` of slab. At the cap, a new identity
+either reuses the slab of a series idle longer than ``reclaim_age``
+(counted kts_history_series_evicted_total) or is shed (counted
+kts_history_series_shed_total) — target churn can grow neither the
+series map nor RSS.
+
+The ring is deliberately in-memory only: it is derived serving state
+re-foldable from the fleet, NOT session state — a hub restart starts
+an empty ring (the WAL checkpoint restores ingest sessions, and the
+next refreshes refill the finest tier within its window). The restart
+contract — and the boot-scoped ETags that keep a restart from ever
+304-ing stale dashboards — is pinned in tests/test_history.py.
+
+Read admission: per-client token buckets on ``/query`` (429 +
+Retry-After, the PR 10 ingest shed discipline) so one misconfigured
+dashboard at 100 Hz cannot starve scrapes. Runbook: docs/OPERATIONS.md
+"Dashboard serving & time travel".
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import math
+import os
+import threading
+import time
+from array import array
+
+# Named windows -> (bucket step seconds, slot count). The finest tier
+# holds one refresh-cadence sample per bucket at the default 10 s
+# interval; the coarser tiers downsample by bucket mean. Fixed at
+# construction; /query lists them on a bad window name.
+DEFAULT_TIERS: tuple[tuple[str, float, int], ...] = (
+    ("1h", 10.0, 360),      # 10 s buckets x 360 = 1 h lookback
+    ("24h", 300.0, 288),    # 5 min buckets x 288 = 24 h
+    ("7d", 3600.0, 168),    # 1 h buckets x 168 = 7 d
+)
+
+# Bodies below this aren't worth the gzip member overhead (the
+# exposition.MetricsServer threshold, same reasoning).
+GZIP_MIN_BYTES = 256
+
+
+class _TierRing:
+    """One preallocated ring: per-slot running mean + sample count +
+    the absolute bucket id that wrote the slot (a wrapped slot with a
+    stale id is empty, not ancient data)."""
+
+    __slots__ = ("step", "slots", "vals", "cnts", "ids")
+
+    def __init__(self, step: float, slots: int) -> None:
+        self.step = step
+        self.slots = slots
+        self.vals = array("d", bytes(8 * slots))
+        self.cnts = array("I", bytes(4 * slots))
+        self.ids = array("q", (-1,)) * slots
+
+    def reset(self) -> None:
+        """Blank for identity reuse — in place, no reallocation."""
+        for i in range(self.slots):
+            self.ids[i] = -1
+            self.cnts[i] = 0
+
+    def write(self, now: float, value: float) -> None:
+        bucket = int(now // self.step)
+        i = bucket % self.slots
+        if self.ids[i] != bucket:
+            self.ids[i] = bucket
+            self.cnts[i] = 1
+            self.vals[i] = value
+        else:
+            count = self.cnts[i] + 1
+            self.cnts[i] = count
+            self.vals[i] += (value - self.vals[i]) / count
+
+    def samples(self, now: float) -> list[list[float]]:
+        """[[bucket_start_ts, mean], ...] oldest-first for every
+        populated bucket inside the window ending at ``now``."""
+        newest = int(now // self.step)
+        out: list[list[float]] = []
+        for bucket in range(newest - self.slots + 1, newest + 1):
+            i = bucket % self.slots
+            if self.ids[i] == bucket and self.cnts[i]:
+                out.append([bucket * self.step, self.vals[i]])
+        return out
+
+    def at(self, ts: float) -> tuple[float, float] | None:
+        """(bucket_start_ts, mean) for the populated bucket NEAREST
+        ``ts`` (by bucket distance, earlier wins a tie), or None when
+        the whole window around ``ts`` is empty."""
+        want = int(ts // self.step)
+        for distance in range(self.slots):
+            for bucket in (want - distance, want + distance):
+                i = bucket % self.slots
+                if self.ids[i] == bucket and self.cnts[i]:
+                    return bucket * self.step, self.vals[i]
+        return None
+
+
+class _SeriesRings:
+    """All tiers for one (family, labels) identity."""
+
+    __slots__ = ("tiers", "last_write")
+
+    def __init__(self, tier_defs) -> None:
+        self.tiers = {name: _TierRing(step, slots)
+                      for name, step, slots in tier_defs}
+        self.last_write = 0.0
+
+    def reset(self) -> None:
+        for ring in self.tiers.values():
+            ring.reset()
+        self.last_write = 0.0
+
+
+class QueryGate:
+    """Per-client token admission for /query — the ingest shed
+    discipline (ISSUE 12) applied to the read side: over-rate clients
+    draw 429 + Retry-After and are counted, never queued. rate <= 0
+    admits everything (accounting only)."""
+
+    MAX_CLIENTS = 4096
+
+    def __init__(self, rate: float, burst: float) -> None:
+        self.rate = rate
+        self.burst = max(1.0, burst)
+        self.admitted_total = 0
+        self.shed_total = 0
+        self._clients: dict[str, tuple[float, float]] = {}
+        self._lock = threading.Lock()
+
+    def admit(self, client: str,
+              now: float | None = None) -> tuple[bool, int]:
+        """(admitted, retry_after_seconds). retry_after is 0 when
+        admitted."""
+        if self.rate <= 0:
+            self.admitted_total += 1
+            return True, 0
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            tokens, last = self._clients.get(client, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            if tokens >= 1.0:
+                self._clients[client] = (tokens - 1.0, now)
+                self.admitted_total += 1
+                return True, 0
+            self._clients[client] = (tokens, now)
+            self.shed_total += 1
+            retry = max(1, math.ceil((1.0 - tokens) / self.rate))
+            if len(self._clients) > self.MAX_CLIENTS:
+                # Bounded client map: drop the stalest half. A dropped
+                # client re-enters with a full bucket — admission, not
+                # punishment, is the contract.
+                for key, _ in sorted(
+                        self._clients.items(),
+                        key=lambda kv: kv[1][1])[:self.MAX_CLIENTS // 2]:
+                    del self._clients[key]
+            return False, retry
+
+
+class HistoryStore:
+    """The hub's history ring + /query serving state.
+
+    Single-writer: ``record``/``commit`` run only on the refresh
+    thread (the snapshot-swap discipline); ``handle_query`` runs on
+    handler threads and takes ``_lock`` only to BUILD a generation's
+    response (a few dict/array reads) — the hot path is a lock-free
+    dict hit on the pre-rendered cache.
+
+    ``enabled=False`` keeps the full API surface (hub main wires the
+    store unconditionally so /query answers ``enabled: false`` under
+    ``--no-history`` instead of an ambiguous 404) but records nothing
+    and serves no data.
+    """
+
+    def __init__(self, enabled: bool = True, max_series: int = 1024,
+                 query_qps: float = 50.0, query_burst: float = 100.0,
+                 reclaim_age: float = 7200.0,
+                 tiers: tuple[tuple[str, float, int], ...] | None = None)\
+            -> None:
+        self.enabled = enabled
+        self.max_series = max(1, max_series)
+        self.reclaim_age = reclaim_age
+        self.tiers = tuple(tiers if tiers is not None else DEFAULT_TIERS)
+        # Fixed per-identity slab cost: mean f64 + count u32 + id i64
+        # per slot, every tier. The memory bound IS arithmetic:
+        # max_series * SERIES_BYTES.
+        self.series_bytes = sum(slots * (8 + 4 + 8)
+                                for _n, _s, slots in self.tiers)
+        self.gate = QueryGate(query_qps, query_burst)
+        # family -> labels-tuple -> rings. Mutated only under _lock.
+        self._data: dict[str, dict[tuple, _SeriesRings]] = {}
+        self._series_count = 0
+        self._free: list[_SeriesRings] = []
+        self._staged: list[tuple[str, tuple, float]] = []
+        self._lock = threading.Lock()
+        # Boot-scoped ETag nonce: a warm-restarted hub restarts its
+        # render generation near 0, and a generation-only ETag would
+        # let a dashboard's If-None-Match from the PREVIOUS boot draw
+        # a stale 304. tests/test_history.py pins two stores never
+        # share an ETag space.
+        self._boot = os.urandom(4).hex()
+        self.generation = 0
+        self._committed_at = 0.0
+        # (family, window) -> (generation, etag, body, gzipped body).
+        self._resp_cache: dict[tuple[str, str],
+                               tuple[int, str, bytes, bytes]] = {}
+        self.samples_total = 0
+        self.series_shed_total = 0
+        self.series_evicted_total = 0
+        self.requests_total = 0
+        self.cache_hits_total = 0
+        self.cache_misses_total = 0
+        self.write_ns_total = 0
+        self.commits_total = 0
+
+    # -- write side (refresh thread only) ------------------------------------
+
+    def record(self, family: str, labels: tuple, value: float) -> None:
+        """Stage one rollup sample for the in-flight refresh. Called
+        from the hub's rollup fold — a list append, nothing else, so
+        the refresh path pays ~nothing."""
+        if self.enabled:
+            self._staged.append((family, labels, value))
+
+    def commit(self, now: float, generation: int) -> None:
+        """Flush the staged samples into every tier, stamped with this
+        publish's wall time, and advance the serving generation (which
+        invalidates the response caches by key mismatch — no sweep)."""
+        staged = self._staged
+        if not self.enabled:
+            staged.clear()
+            return
+        start = time.perf_counter_ns()
+        with self._lock:
+            for family, labels, value in staged:
+                fam = self._data.get(family)
+                if fam is None:
+                    fam = self._data[family] = {}
+                rings = fam.get(labels)
+                if rings is None:
+                    rings = self._admit_locked(now)
+                    if rings is None:
+                        self.series_shed_total += 1
+                        continue
+                    fam[labels] = rings
+                for ring in rings.tiers.values():
+                    ring.write(now, value)
+                rings.last_write = now
+                self.samples_total += 1
+            staged.clear()
+            self.generation = generation
+            self._committed_at = now
+        self.write_ns_total += time.perf_counter_ns() - start
+        self.commits_total += 1
+
+    def _admit_locked(self, now: float) -> _SeriesRings | None:
+        """A ring set for a new identity: below the cap allocate (or
+        reuse a freed slab); at the cap reclaim the stalest identity
+        idle past reclaim_age, else shed."""
+        if self._free:
+            return self._free.pop()
+        if self._series_count < self.max_series:
+            self._series_count += 1
+            return _SeriesRings(self.tiers)
+        stalest: tuple[str, tuple] | None = None
+        stale_at = now - self.reclaim_age
+        for family, fam in self._data.items():
+            for labels, rings in fam.items():
+                if rings.last_write <= stale_at:
+                    stale_at = rings.last_write
+                    stalest = (family, labels)
+        if stalest is None:
+            return None
+        rings = self._data[stalest[0]].pop(stalest[1])
+        rings.reset()
+        self.series_evicted_total += 1
+        return rings
+
+    def bytes(self) -> int:
+        """Slab bytes currently held — by construction never more than
+        max_series * series_bytes (free-listed slabs stay counted:
+        they are still resident)."""
+        return self._series_count * self.series_bytes
+
+    # -- read side (handler threads) ------------------------------------------
+
+    def window_names(self) -> list[str]:
+        return [name for name, _s, _c in self.tiers]
+
+    def handle_query(self, params: dict, client: str, gzip_ok: bool,
+                     if_none_match: str) -> tuple[int, bytes, dict]:
+        """(status, body, headers) for one GET /query. Owns admission,
+        parameter validation, the ETag/304 verdict and the response
+        cache; the HTTP handler only writes what this returns."""
+        self.requests_total += 1
+        if not self.enabled:
+            body = json.dumps(
+                {"enabled": False,
+                 "hint": "hub started with --no-history"},
+                sort_keys=True).encode() + b"\n"
+            return 200, body, {"Content-Type": "application/json"}
+        admitted, retry = self.gate.admit(client)
+        if not admitted:
+            return (429, b"query rate limited\n",
+                    {"Retry-After": str(retry)})
+        family = params.get("family", "")
+        if not family:
+            return (400, b"missing ?family=; tracked families: "
+                    + ",".join(sorted(self._data)).encode() + b"\n", {})
+        at_raw = params.get("at", "")
+        if at_raw:
+            try:
+                at_ts = float(at_raw)
+            except ValueError:
+                return 400, b"?at= must be a unix timestamp\n", {}
+            body = (json.dumps(self.at_payload(family, at_ts),
+                               sort_keys=True) + "\n").encode()
+            return 200, body, {"Content-Type": "application/json"}
+        window = params.get("window", "") or self.tiers[0][0]
+        tier = {name: (step, slots)
+                for name, step, slots in self.tiers}.get(window)
+        if tier is None:
+            return (400, b"unknown ?window=; named windows: "
+                    + ",".join(self.window_names()).encode() + b"\n", {})
+        step_raw = params.get("step", "")
+        if step_raw:
+            # A window name IS a tier; the optional step is a
+            # cross-check, not a resampler (documented in OPERATIONS).
+            try:
+                if float(step_raw.rstrip("s")) != tier[0]:
+                    raise ValueError
+            except ValueError:
+                return (400, f"window {window} serves step "
+                        f"{tier[0]:g}s\n".encode(), {})
+        if family not in self._data:
+            return (404, b"unknown family; tracked: "
+                    + ",".join(sorted(self._data)).encode() + b"\n", {})
+        generation, etag, body, gz = self._response(family, window)
+        if etag_match(if_none_match, etag):
+            return 304, b"", {"ETag": etag, "Vary": "Accept-Encoding"}
+        headers = {"Content-Type": "application/json", "ETag": etag,
+                   "Vary": "Accept-Encoding"}
+        if gzip_ok and gz:
+            headers["Content-Encoding"] = "gzip"
+            return 200, gz, headers
+        return 200, body, headers
+
+    def _response(self, family: str,
+                  window: str) -> tuple[int, str, bytes, bytes]:
+        """(generation, etag, body, gz) from the per-(family, window,
+        generation) cache — the dict hit serving a read stampede. A
+        miss builds both shapes once under the lock."""
+        key = (family, window)
+        generation = self.generation
+        entry = self._resp_cache.get(key)
+        if entry is not None and entry[0] == generation:
+            self.cache_hits_total += 1
+            return entry
+        with self._lock:
+            generation = self.generation
+            entry = self._resp_cache.get(key)
+            if entry is not None and entry[0] == generation:
+                self.cache_hits_total += 1
+                return entry
+            self.cache_misses_total += 1
+            step = dict((n, s) for n, s, _c in self.tiers)[window]
+            now = self._committed_at
+            series = []
+            for labels, rings in sorted(
+                    self._data.get(family, {}).items()):
+                series.append({
+                    "labels": dict(labels),
+                    "samples": rings.tiers[window].samples(now),
+                })
+            payload = {"family": family, "window": window,
+                       "step_s": step, "generation": generation,
+                       "as_of": now, "series": series}
+            body = (json.dumps(payload, sort_keys=True) + "\n").encode()
+            # Strong ETag, boot-scoped (see __init__) and shape-stable:
+            # gzip and identity share it — the representation is the
+            # same JSON document either way and Vary covers the wire.
+            etag = f'"h{self._boot}-{generation}-{family}-{window}"'
+            gz = (gzip.compress(body, compresslevel=3, mtime=0)
+                  if len(body) >= GZIP_MIN_BYTES else b"")
+            entry = (generation, etag, body, gz)
+            self._resp_cache[key] = entry
+            return entry
+
+    def at_payload(self, family: str, ts: float) -> dict:
+        """Nearest-sample lookup at ``ts``: for each identity, the
+        populated bucket nearest the timestamp from the FINEST tier
+        whose window still covers it (named-window nearest-sample
+        semantics — doctor --fleet --at replays from this)."""
+        with self._lock:
+            now = self._committed_at
+            series = []
+            for labels, rings in sorted(
+                    self._data.get(family, {}).items()):
+                hit = None
+                window = ""
+                for name, step, slots in self.tiers:
+                    if now - ts <= step * slots:
+                        hit = rings.tiers[name].at(ts)
+                        if hit is not None:
+                            window = name
+                            break
+                if hit is not None:
+                    series.append({"labels": dict(labels),
+                                   "t": hit[0], "v": hit[1],
+                                   "window": window})
+        return {"family": family, "at": ts, "as_of": now,
+                "series": series}
+
+    # -- self-metrics (refresh thread, every publish) -------------------------
+
+    def contribute(self, builder) -> None:
+        """kts_history_* / kts_query_* onto a hub SnapshotBuilder —
+        every counter born at 0 (increase() alerting sees the first
+        shed)."""
+        from . import schema
+
+        builder.add(schema.HISTORY_SERIES, float(self._series_count))
+        builder.add(schema.HISTORY_BYTES, float(self.bytes()))
+        builder.add(schema.HISTORY_SAMPLES, float(self.samples_total))
+        builder.add(schema.HISTORY_SERIES_SHED,
+                    float(self.series_shed_total))
+        builder.add(schema.HISTORY_SERIES_EVICTED,
+                    float(self.series_evicted_total))
+        builder.add(schema.QUERY_REQUESTS, float(self.requests_total))
+        builder.add(schema.QUERY_SHED, float(self.gate.shed_total))
+        builder.add(schema.QUERY_CACHE_HITS,
+                    float(self.cache_hits_total))
+        builder.add(schema.QUERY_CACHE_MISSES,
+                    float(self.cache_misses_total))
+
+
+def etag_match(header: str, etag: str) -> bool:
+    """True when an If-None-Match header names ``etag`` (or ``*``).
+    W/ prefixes compare as their opaque tag: for a 304 the weak
+    comparison is the correct one (RFC 9110 §13.1.2)."""
+    header = header.strip()
+    if not header:
+        return False
+    if header == "*":
+        return True
+    for token in header.split(","):
+        token = token.strip()
+        if token.startswith("W/"):
+            token = token[2:]
+        if token == etag:
+            return True
+    return False

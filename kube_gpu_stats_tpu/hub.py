@@ -544,7 +544,8 @@ class Hub:
                  series_hard_cap: int = 0,
                  series_high_watermark: int = 0,
                  series_low_watermark: int = 0,
-                 series_idle_refreshes: int = 5) -> None:
+                 series_idle_refreshes: int = 5,
+                 history=None) -> None:
         if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -558,6 +559,12 @@ class Hub:
         # push sources join the effective target list on top of it each
         # refresh, so a push-only fleet needs no target config at all.
         self._configured = list(self._targets)
+        # History ring (history.HistoryStore, ISSUE 18): fed the folded
+        # slice rollups at publish time (record staged on the refresh
+        # thread, commit after registry.publish stamps the generation).
+        # None = no lookback (bare test hubs); a wired-but-disabled
+        # store (--no-history) records nothing.
+        self.history = history
         # Federation root (--federate): targets are leaf hubs — their
         # slice_* rollup series (FEDERATED_SPECS) are re-exported
         # alongside any per-chip series, so a root hub serves the whole
@@ -1232,9 +1239,15 @@ class Hub:
         merge_mark = tracer.mark()
         builder = SnapshotBuilder()
         for target in self._targets:
-            builder.add(schema.HUB_TARGET_UP,
-                        1.0 if reachable.get(target) else 0.0,
-                        (("target", target),))
+            up = 1.0 if reachable.get(target) else 0.0
+            builder.add(schema.HUB_TARGET_UP, up, (("target", target),))
+            if self.history is not None:
+                # Mirror per-target reachability into the ring so
+                # `doctor --fleet --at` can say which targets were down
+                # at the incident timestamp, not just which rollups
+                # moved.
+                self.history.record(schema.HUB_TARGET_UP.name,
+                                    (("target", target),), up)
             took = fetch_seconds.get(target)
             if took is not None:
                 builder.add(schema.HUB_TARGET_FETCH_SECONDS, took,
@@ -1518,7 +1531,16 @@ class Hub:
             except Exception:  # noqa: BLE001 - a broken contributor
                 # must cost its own families, never the publish.
                 log.exception("extra metrics provider failed")
+        if self.history is not None:
+            # kts_history_* / kts_query_* ride the same snapshot they
+            # describe.
+            self.history.contribute(builder)
         self.registry.publish(builder.build())
+        if self.history is not None:
+            # Commit AFTER publish so the ring's serving generation is
+            # the generation readers actually see — /query ETags and
+            # /metrics ETags advance together.
+            self.history.commit(time.time(), self.registry.generation)
         if self.delta is not None:
             # Warm-restart checkpoint (ISSUE 12): written HERE, on the
             # refresh thread, never on a handler thread — rate-limited
@@ -1692,37 +1714,48 @@ class Hub:
         last contribution) because a dipping counter is semantically a
         reset — Prometheus would rate() a phantom spike — while a
         dipping gauge is simply the current truth."""
+        hist = self.history
+        if hist is None:
+            add = builder.add
+        else:
+            # One seam feeds both consumers: every rollup series lands
+            # in the snapshot AND is staged for the history ring (a
+            # list append — the refresh path pays ~nothing, and the
+            # ring can never drift from what the exposition said).
+            def add(spec, value, labels=()):
+                builder.add(spec, value, labels)
+                hist.record(spec.name, labels, value)
         by_slice: dict[str, list] = {}
         for row in frame.rows.values():
             by_slice.setdefault(row.key[1], []).append(row)
         for slice_name in sorted(by_slice):
             rows = by_slice[slice_name]
             labels = (("slice", slice_name),)
-            builder.add(schema.HUB_CHIPS, float(len(rows)), labels)
-            builder.add(schema.HUB_CHIPS_UP,
+            add(schema.HUB_CHIPS, float(len(rows)), labels)
+            add(schema.HUB_CHIPS_UP,
                         float(sum(1 for r in rows if r.up == 1.0)), labels)
             workers = {self._worker_id(r) for r in rows}
-            builder.add(schema.HUB_WORKERS, float(len(workers)), labels)
+            add(schema.HUB_WORKERS, float(len(workers)), labels)
             duties = [r.duty for r in rows if r.duty is not None]
             if duties:
-                builder.add(schema.HUB_DUTY_MEAN,
+                add(schema.HUB_DUTY_MEAN,
                             sum(duties) / len(duties), labels)
-                builder.add(schema.HUB_DUTY_MIN, min(duties), labels)
-                builder.add(schema.HUB_DUTY_MAX, max(duties), labels)
+                add(schema.HUB_DUTY_MIN, min(duties), labels)
+                add(schema.HUB_DUTY_MAX, max(duties), labels)
             mfus = [r.mfu for r in rows if r.mfu is not None]
             if mfus:
-                builder.add(schema.HUB_MFU_MEAN,
+                add(schema.HUB_MFU_MEAN,
                             sum(mfus) / len(mfus), labels)
-                builder.add(schema.HUB_MFU_MIN, min(mfus), labels)
+                add(schema.HUB_MFU_MIN, min(mfus), labels)
             used = [r.mem_used for r in rows if r.mem_used is not None]
             if used:
-                builder.add(schema.HUB_MEMORY_USED, sum(used), labels)
+                add(schema.HUB_MEMORY_USED, sum(used), labels)
             total = [r.mem_total for r in rows if r.mem_total is not None]
             if total:
-                builder.add(schema.HUB_MEMORY_TOTAL, sum(total), labels)
+                add(schema.HUB_MEMORY_TOTAL, sum(total), labels)
             power = [r.power for r in rows if r.power is not None]
             if power:
-                builder.add(schema.HUB_POWER, sum(power), labels)
+                add(schema.HUB_POWER, sum(power), labels)
             # Per-slice joules (ISSUE 8): sum of the per-chip energy
             # counters over answered chips — a gauge under the dip
             # policy (see the docstring); audit-grade per-pod totals
@@ -1730,11 +1763,11 @@ class Hub:
             energies = [r.energy_total for r in rows
                         if r.energy_total is not None]
             if energies:
-                builder.add(schema.HUB_ENERGY, sum(energies), labels)
+                add(schema.HUB_ENERGY, sum(energies), labels)
             # Gate on series presence, not value: an idle interconnect is
             # a 0 reading, not a vanished series (absent() alerting).
             if any(r.ici_links for r in rows):
-                builder.add(schema.HUB_ICI_BANDWIDTH,
+                add(schema.HUB_ICI_BANDWIDTH,
                             sum(r.ici_bps for r in rows), labels)
             # Per-worker step rate = mean over the worker's chips (SPMD:
             # every chip participates in each step, so chips of one
@@ -1747,10 +1780,10 @@ class Hub:
             for worker in sorted(worker_rates):
                 rate = worker_rates[worker]
                 rates.append(rate)
-                builder.add(schema.HUB_WORKER_STEPS, rate,
+                add(schema.HUB_WORKER_STEPS, rate,
                             labels + (("worker", worker),))
             if rates and max(rates) > 0:
-                builder.add(schema.HUB_STRAGGLER_RATIO,
+                add(schema.HUB_STRAGGLER_RATIO,
                             min(rates) / max(rates), labels)
 
     def _build_merge_plan(self, target: str, entry: "_TargetCache",
@@ -2342,16 +2375,19 @@ def main(argv: Sequence[str] | None = None) -> int:
     # drift between the two CLIs. On a hub, --hub-url points at the
     # PARENT (root) hub of a federation tree.
     from .config import (add_cardinality_flags, add_delta_push_flags,
-                         add_fleet_lens_flags, add_ingest_guard_flags,
+                         add_fleet_lens_flags, add_history_flags,
+                         add_ingest_guard_flags,
                          validate_cardinality_args,
                          validate_delta_push_args,
                          validate_fleet_lens_args,
+                         validate_history_args,
                          validate_ingest_guard_args)
 
     add_fleet_lens_flags(parser)
     add_delta_push_flags(parser)
     add_ingest_guard_flags(parser)
     add_cardinality_flags(parser)
+    add_history_flags(parser)
     args = parser.parse_args(argv)
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
@@ -2365,6 +2401,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     cardinality_error = validate_cardinality_args(args)
     if cardinality_error:
         parser.error(cardinality_error)
+    history_error = validate_history_args(args)
+    if history_error:
+        parser.error(history_error)
     if args.ingest_lanes < 0 or args.ingest_lanes > 256:
         parser.error("--ingest-lanes must be 0 (auto) or 1..256")
     if args.ingest_procs < 0 or args.ingest_procs > 64:
@@ -2533,6 +2572,18 @@ def main(argv: Sequence[str] | None = None) -> int:
                     out["remote_write"] = status
         return out
 
+    # History ring (ISSUE 18): constructed even under --no-history so
+    # /query answers enabled:false (a wired-but-disabled store, the
+    # --no-host-stats convention) instead of an ambiguous 404; a
+    # disabled store records nothing and holds no slabs.
+    from .history import HistoryStore
+
+    history_store = HistoryStore(
+        enabled=not args.no_history,
+        max_series=args.history_series_max,
+        query_qps=args.history_query_qps,
+        query_burst=args.history_query_burst)
+
     hub = Hub(targets, interval=args.interval,
               expect_workers=args.expect_workers,
               rollups_only=args.rollups_only,
@@ -2570,7 +2621,8 @@ def main(argv: Sequence[str] | None = None) -> int:
               series_hard_cap=args.series_hard_cap,
               series_high_watermark=args.series_high_watermark,
               series_low_watermark=args.series_low_watermark,
-              series_idle_refreshes=args.series_idle_refreshes)
+              series_idle_refreshes=args.series_idle_refreshes,
+              history=history_store)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -2706,7 +2758,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         egress_provider=egress_payload,
         skew_provider=skew_payload,
         stores_provider=stores_payload,
-        cardinality_provider=cardinality_payload)
+        cardinality_provider=cardinality_payload,
+        history_provider=history_store)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

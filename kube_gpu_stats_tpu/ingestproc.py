@@ -87,10 +87,14 @@ CHANNELS_PER_PROC = 2
 
 # Headers a GET proxy forwards each way. Hop-by-hop headers
 # (Connection, Keep-Alive, Transfer-Encoding) must not cross.
-_PROXY_REQUEST_HEADERS = ("Accept", "Accept-Encoding", "Authorization")
+# If-None-Match/ETag/Vary carry the conditional-scrape contract
+# (ISSUE 18): without them a 304-capable reader behind --ingest-procs
+# would silently pay full bodies forever.
+_PROXY_REQUEST_HEADERS = ("Accept", "Accept-Encoding", "Authorization",
+                          "If-None-Match")
 _PROXY_RESPONSE_HEADERS = ("Content-Type", "Content-Encoding",
                            "Retry-After", "WWW-Authenticate",
-                           "Cache-Control")
+                           "Cache-Control", "ETag", "Vary")
 
 
 def _recv_exact(sock: socket.socket, count: int) -> bytes | None:

@@ -18,6 +18,7 @@ import functools
 import gzip
 import logging
 import math
+import os
 import threading
 import time
 from typing import Iterable, Mapping, NamedTuple, Sequence
@@ -211,6 +212,13 @@ class Registry:
         self._snapshot: Snapshot = EMPTY_SNAPSHOT
         self._published = threading.Condition()
         self._generation = 0
+        # Boot-scoped nonce embedded in every ETag (ISSUE 18): the
+        # generation counter restarts at 0 with the process, so a
+        # generation-only ETag would let a reader's If-None-Match from
+        # the PREVIOUS boot draw a stale 304 off a warm-restarted hub.
+        # Per-instance (not per-process) so in-process restart tests
+        # see the real contract.
+        self.boot_id = os.urandom(4).hex()
         # native=False keeps this registry on the pure-Python render
         # (the differential oracle in tests/test_render_differential.py);
         # a native failure at render time also drops the instance back
@@ -257,6 +265,16 @@ class Registry:
         gzip entry, so the two shapes share one serialization per
         generation. Byte-identity with ``Snapshot.render()`` is pinned by
         tests/test_golden.py."""
+        body, cache_hit, _generation = self.rendered_versioned(
+            openmetrics, gzip_level)
+        return body, cache_hit
+
+    def rendered_versioned(self, openmetrics: bool = False,
+                           gzip_level: int = 0) -> tuple[bytes, bool, int]:
+        """``rendered`` plus the generation THESE BYTES render — read
+        under the publish lock as a coherent pair with the snapshot, so
+        an ETag minted from it can never name a different generation's
+        body (the conditional-scrape contract, ISSUE 18)."""
         wait_start = time.perf_counter()
         with self._published:
             # One lock-held read so (generation, snapshot) is a coherent
@@ -272,7 +290,7 @@ class Registry:
         key = (openmetrics, gzip_level)
         entry = self._render_cache.get(key)
         if entry is not None and entry[0] == generation:
-            return entry[1], True
+            return entry[1], True, generation
         text_key = (openmetrics, 0)
         entry = self._render_cache.get(text_key)
         if entry is not None and entry[0] == generation:
@@ -308,7 +326,7 @@ class Registry:
                 gz = gzip.compress(body, compresslevel=gzip_level, mtime=0)
             body = gz
             self._render_cache[key] = (generation, body)
-        return body, False
+        return body, False, generation
 
     @property
     def generation(self) -> int:

@@ -967,6 +967,96 @@ FLEET_WORST_TICK = MetricSpec(
     extra_labels=("target", "phase"),
 )
 
+# History ring + /query serving families (history.py, ISSUE 18): the
+# hub's embedded lookback store and its read-admission layer.
+
+HISTORY_SERIES = MetricSpec(
+    "kts_history_series",
+    MetricType.GAUGE,
+    "Series identities (family + labels) the history ring currently "
+    "holds slabs for. Bounded by --history-series-max; at the cap new "
+    "identities either reclaim a stale slab "
+    "(kts_history_series_evicted_total) or are shed "
+    "(kts_history_series_shed_total) — this gauge never exceeds the "
+    "cap.",
+)
+HISTORY_BYTES = MetricSpec(
+    "kts_history_bytes",
+    MetricType.GAUGE,
+    "Bytes of preallocated ring slab the history store holds: series "
+    "count times the fixed per-series cost across every tier. Flat by "
+    "construction once the fleet's identities are admitted — growth "
+    "here is a bug, not load.",
+)
+HISTORY_SAMPLES = MetricSpec(
+    "kts_history_samples_total",
+    MetricType.COUNTER,
+    "Rollup samples folded into the history ring at publish time. "
+    "Rises by roughly (tracked series) per hub refresh; a stall while "
+    "refreshes continue means the ring is disabled or shedding.",
+)
+HISTORY_SERIES_SHED = MetricSpec(
+    "kts_history_series_shed_total",
+    MetricType.COUNTER,
+    "History samples dropped because the series cap was reached and no "
+    "slab was stale enough to reclaim. The live fleet view is "
+    "unaffected (the ring only serves /query lookback); raise "
+    "--history-series-max if the fleet legitimately outgrew it.",
+)
+HISTORY_SERIES_EVICTED = MetricSpec(
+    "kts_history_series_evicted_total",
+    MetricType.COUNTER,
+    "History series whose slab was reclaimed for a new identity after "
+    "sitting idle past the reclaim age — the expected steady cost of "
+    "target churn under a fixed-memory ring. Lookback for the evicted "
+    "identity is gone; the memory bound is the point.",
+)
+QUERY_REQUESTS = MetricSpec(
+    "kts_query_requests_total",
+    MetricType.COUNTER,
+    "GET /query requests received, before admission — the read-side "
+    "demand signal. Compare with kts_query_shed_total for the shed "
+    "fraction and kts_query_cache_hits_total for how many of the "
+    "admitted were a pre-rendered dict hit.",
+)
+QUERY_SHED = MetricSpec(
+    "kts_query_shed_total",
+    MetricType.COUNTER,
+    "/query requests answered 429 + Retry-After by the per-client "
+    "token gate (--history-query-qps/--history-query-burst). One "
+    "misconfigured dashboard polling at 100 Hz sheds here without "
+    "starving scrapes; triage: OPERATIONS.md 'Dashboard serving & "
+    "time travel'.",
+)
+QUERY_CACHE_HITS = MetricSpec(
+    "kts_query_cache_hits_total",
+    MetricType.COUNTER,
+    "/query range responses served from the per-(family, window, "
+    "generation) pre-rendered + pre-gzipped cache — a dict hit and a "
+    "sendall, no render. The expected overwhelming majority under a "
+    "dashboard stampede.",
+)
+QUERY_CACHE_MISSES = MetricSpec(
+    "kts_query_cache_misses_total",
+    MetricType.COUNTER,
+    "/query range responses that built (rendered + gzipped) their "
+    "payload — first read of a (family, window) after a publish. "
+    "Bounded by families x windows per generation; a rate far above "
+    "the refresh rate means the cache key space is being outpaced.",
+)
+
+HISTORY_METRICS: tuple[MetricSpec, ...] = (
+    HISTORY_SERIES,
+    HISTORY_BYTES,
+    HISTORY_SAMPLES,
+    HISTORY_SERIES_SHED,
+    HISTORY_SERIES_EVICTED,
+    QUERY_REQUESTS,
+    QUERY_SHED,
+    QUERY_CACHE_HITS,
+    QUERY_CACHE_MISSES,
+)
+
 HUB_METRICS: tuple[MetricSpec, ...] = (
     HUB_TARGET_UP,
     HUB_TARGET_FETCH_SECONDS,
@@ -1023,6 +1113,7 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     FLEET_SLO_BURN,
     FLEET_SLO_BAD,
     FLEET_WORST_TICK,
+    *HISTORY_METRICS,
 )
 
 # Buckets for hub_refresh_duration_seconds: a refresh crosses the network
@@ -1081,6 +1172,18 @@ RENDER_CACHE_HITS = MetricSpec(
     "generation had already been rendered (and, for compressed scrapes, "
     "gzipped) in this shape, so the reader got the memoized bytes. N "
     "concurrent scrapers per publish cost one render instead of N.",
+)
+SCRAPE_NOT_MODIFIED = MetricSpec(
+    "kts_scrape_not_modified_total",
+    MetricType.COUNTER,
+    "Conditional reads answered 304 Not Modified per path: the "
+    "client's If-None-Match named the current render generation's "
+    "ETag, so the response cost zero render, zero gzip, and zero "
+    "body transfer. The cheapest possible scrape — a high ratio "
+    "under a steady generation is the read path working as designed "
+    "(ISSUE 18); details: OPERATIONS.md 'Dashboard serving & time "
+    "travel'.",
+    extra_labels=("path",),
 )
 RENDER_CACHE_MISSES = MetricSpec(
     "kts_render_cache_misses_total",
@@ -1690,6 +1793,7 @@ SELF_METRICS: tuple[MetricSpec, ...] = (
     SELF_SCRAPES_REJECTED,
     RENDER_CACHE_HITS,
     RENDER_CACHE_MISSES,
+    SCRAPE_NOT_MODIFIED,
     SELF_POLL_ERRORS,
     TICK_PLAN_COMPILES,
     TICK_PLAN_CACHE_HITS,

@@ -664,3 +664,90 @@ def test_doctor_skew_classifies_missing_surface():
     assert result.status == WARN
     assert "predates the version-skew layer" in result.detail
     assert check_skew("http://127.0.0.1:9").status == FAIL
+
+
+# -- --at time parsing + the history-backed fleet row (ISSUE 18) -------------
+
+def test_parse_at_forms():
+    from kube_gpu_stats_tpu.doctor import parse_at
+
+    now = 2_000_000_000.0
+    assert parse_at("600", now) == now - 600.0
+    assert parse_at("10m", now) == now - 600.0
+    assert parse_at("2h", now) == now - 7200.0
+    assert parse_at("-2h", now) == now - 7200.0       # '-ago' spelling
+    assert parse_at("1722470400", now) == 1722470400.0  # absolute
+    for garbage in ("abc", "", "10d", "h"):
+        with pytest.raises(ValueError) as err:
+            parse_at(garbage, now)
+        assert "10m" in str(err.value)  # the error teaches the forms
+
+
+def test_at_flag_requires_fleet(capsys):
+    from kube_gpu_stats_tpu.doctor import main as doctor_main
+
+    assert doctor_main(["--at", "10m"]) == 2
+    assert "--fleet" in capsys.readouterr().err
+
+
+def test_check_fleet_at_against_a_live_hub_ring():
+    """End to end: a hub's history ring holds a straggler episode 10
+    minutes back; `doctor --fleet --at` replays it over real HTTP even
+    though the fleet has since recovered."""
+    import time as time_mod
+
+    from kube_gpu_stats_tpu.doctor import WARN, check_fleet_at
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.history import HistoryStore
+    from kube_gpu_stats_tpu.registry import Registry
+
+    store = HistoryStore()
+    now = time_mod.time()
+    t0 = now - 600.0
+    for worker, rate in (("w0", 10.0), ("w1", 10.0), ("w2", 2.0)):
+        store.record("slice_worker_steps_per_second",
+                     (("slice", "s0"), ("worker", worker)), rate)
+    store.record("slice_target_up", (("target", "node-2:9400"),), 0.0)
+    store.commit(t0, 1)
+    for worker in ("w0", "w1", "w2"):
+        store.record("slice_worker_steps_per_second",
+                     (("slice", "s0"), ("worker", worker)), 10.0)
+    store.record("slice_target_up", (("target", "node-2:9400"),), 1.0)
+    store.commit(now, 2)
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           history_provider=store)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        past = check_fleet_at(base, t0)
+        assert past.status == WARN
+        assert "straggler worker w2" in past.detail
+        assert "node-2:9400 was down" in past.detail
+        present = check_fleet_at(base, now)
+        assert "fleet healthy" in present.detail
+    finally:
+        server.stop()
+
+
+def test_check_fleet_at_on_a_history_less_hub():
+    """A hub without the ring (--no-history, or predating it) draws a
+    self-describing WARN, not a crash or a fake all-clear."""
+    from kube_gpu_stats_tpu.doctor import WARN, check_fleet_at
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.history import HistoryStore
+    from kube_gpu_stats_tpu.registry import Registry
+
+    bare = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    bare.start()
+    disabled = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                             history_provider=HistoryStore(enabled=False))
+    disabled.start()
+    try:
+        for server in (bare, disabled):
+            result = check_fleet_at(
+                f"http://127.0.0.1:{server.port}", 1_700_000_000.0)
+            assert result.status == WARN, result
+    finally:
+        bare.stop()
+        disabled.stop()

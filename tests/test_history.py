@@ -1,0 +1,326 @@
+"""History ring + /query serving (ISSUE 18): tier downsampling pinned
+against a brute-force oracle, the fixed-memory bound under identity
+churn, the intentional non-survival across a warm restart (and the
+boot-scoped ETag spaces that make it safe), the read-admission gate's
+exact accounting, and the retroactive `doctor --fleet --at` verdict
+replayed from ring payloads — a straggler visible in the past stays
+named after it recovers."""
+
+import gzip
+import json
+import math
+
+from kube_gpu_stats_tpu.doctor import OK, WARN, fleet_at_verdict
+from kube_gpu_stats_tpu.history import (DEFAULT_TIERS, HistoryStore,
+                                        QueryGate, etag_match)
+from kube_gpu_stats_tpu.registry import Registry
+
+BASE = 1_700_000_000.0  # aligned-ish anchor; bucket math floors anyway
+
+
+def feed(store, samples, family="slice_duty_cycle_mean",
+         labels=(("slice", "s0"),)):
+    """Record each (ts, value) as its own commit — one refresh per
+    sample, the hub's cadence."""
+    for generation, (ts, value) in enumerate(samples, start=1):
+        store.record(family, labels, value)
+        store.commit(ts, generation)
+
+
+def query(store, **params):
+    status, body, headers = store.handle_query(
+        params, "10.0.0.1", gzip_ok=False, if_none_match="")
+    return status, body, headers
+
+
+class TestTierDownsampling:
+    def test_every_tier_matches_the_bucket_mean_oracle(self):
+        # 90 samples at the 10 s refresh cadence: one per finest
+        # bucket, 3 per 5-min bucket (the 24h tier must average them),
+        # all inside one 1 h bucket until the boundary crossing below.
+        store = HistoryStore()
+        samples = [(BASE + 10.0 * i, float(i * i % 97))
+                   for i in range(90)]
+        feed(store, samples)
+        for window, step, _slots in DEFAULT_TIERS:
+            oracle: dict[int, list[float]] = {}
+            for ts, value in samples:
+                oracle.setdefault(math.floor(ts / step), []).append(value)
+            want = [[bucket * step, sum(vs) / len(vs)]
+                    for bucket, vs in sorted(oracle.items())]
+            status, body, _headers = query(
+                store, family="slice_duty_cycle_mean", window=window)
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["step_s"] == step
+            (series,) = payload["series"]
+            got = series["samples"]
+            assert len(got) == len(want)
+            for (got_ts, got_v), (want_ts, want_v) in zip(got, want):
+                assert got_ts == want_ts
+                assert math.isfinite(got_v)
+                assert abs(got_v - want_v) < 1e-9, (window, got_ts)
+
+    def test_boundary_sample_opens_the_next_bucket(self):
+        # A sample EXACTLY on a 5-min edge belongs to the bucket it
+        # opens, not the one it closes — the oracle and the ring must
+        # agree on half-open [start, start+step).
+        store = HistoryStore()
+        edge = (math.floor(BASE / 300.0) + 1) * 300.0
+        feed(store, [(edge - 10.0, 1.0), (edge, 5.0), (edge + 10.0, 7.0)])
+        status, body, _ = query(
+            store, family="slice_duty_cycle_mean", window="24h")
+        assert status == 200
+        (series,) = json.loads(body)["series"]
+        assert series["samples"] == [[edge - 300.0, 1.0], [edge, 6.0]]
+
+    def test_ring_wrap_drops_only_aged_out_buckets(self):
+        # 2x the finest window: the first hour's buckets are
+        # overwritten in place; what remains is exactly the newest 360.
+        store = HistoryStore()
+        samples = [(BASE + 10.0 * i, float(i)) for i in range(720)]
+        feed(store, samples)
+        status, body, _ = query(
+            store, family="slice_duty_cycle_mean", window="1h")
+        (series,) = json.loads(body)["series"]
+        assert len(series["samples"]) == 360
+        assert series["samples"][0][0] == BASE + 10.0 * 360
+        assert series["samples"][-1][1] == 719.0
+
+
+class TestFixedMemory:
+    def test_bytes_capped_and_shed_accounted_under_churn(self):
+        # 30 cycles of fresh identities: the slab count never passes
+        # max_series, and every sample that could not be admitted is
+        # counted — offered = admitted + shed, exactly.
+        store = HistoryStore(max_series=8)
+        bound = 8 * store.series_bytes
+        offered = 0
+        for cycle in range(30):
+            for i in range(4):
+                store.record("slice_power_watts",
+                             (("slice", f"c{cycle}-{i}"),), 1.0)
+                offered += 1
+            store.commit(BASE + 10.0 * cycle, cycle + 1)
+            assert store.bytes() <= bound
+        assert store.bytes() == bound
+        assert store.samples_total == 8  # the first 8 identities' writes
+        assert store.series_shed_total == offered - 8
+        assert store.series_evicted_total == 0
+
+    def test_reclaim_reuses_slabs_in_place(self):
+        # reclaim_age=0: every new identity reclaims the stalest slab
+        # instead of shedding — the slab count (and bytes) still never
+        # grows past the cap.
+        store = HistoryStore(max_series=8, reclaim_age=0.0)
+        bound = 8 * store.series_bytes
+        for cycle in range(30):
+            for i in range(4):
+                store.record("slice_power_watts",
+                             (("slice", f"c{cycle}-{i}"),), 1.0)
+            store.commit(BASE + 10.0 * cycle, cycle + 1)
+            assert store.bytes() <= bound
+        assert store.bytes() == bound
+        assert store.series_shed_total == 0
+        assert store.series_evicted_total == 30 * 4 - 8
+
+
+class TestWarmRestart:
+    def test_ring_does_not_survive_a_restart_by_design(self):
+        # The ring is in-hub process state, deliberately: a restarted
+        # hub answers /query with 404-unknown-family (and doctor --at
+        # says so), never with silently-empty history.
+        old = HistoryStore()
+        feed(old, [(BASE, 1.0), (BASE + 10.0, 2.0)])
+        status, _body, _ = query(
+            old, family="slice_duty_cycle_mean", window="1h")
+        assert status == 200
+        reborn = HistoryStore()
+        status, body, _ = query(
+            reborn, family="slice_duty_cycle_mean", window="1h")
+        assert status == 404
+        assert b"unknown family" in body
+
+    def test_boot_nonce_splits_the_etag_spaces(self):
+        # Same data, same generation, two boots: a dashboard holding
+        # the old boot's ETag must NOT draw a 304 from the new hub —
+        # its cache would be a different process's history.
+        def etag_of(store):
+            _status, _body, headers = query(
+                store, family="slice_duty_cycle_mean", window="1h")
+            return headers["ETag"]
+
+        first, second = HistoryStore(), HistoryStore()
+        feed(first, [(BASE, 1.0)])
+        feed(second, [(BASE, 1.0)])
+        assert etag_of(first) != etag_of(second)
+        status, _body, _headers = second.handle_query(
+            {"family": "slice_duty_cycle_mean", "window": "1h"},
+            "10.0.0.1", gzip_ok=False, if_none_match=etag_of(first))
+        assert status == 200  # full body, not a stale 304
+
+    def test_registry_metrics_etags_differ_across_boots(self):
+        from kube_gpu_stats_tpu.exposition import _metrics_etag
+
+        a, b = Registry(), Registry()
+        assert a.boot_id != b.boot_id
+        assert (_metrics_etag(a.boot_id, 1, False, False)
+                != _metrics_etag(b.boot_id, 1, False, False))
+
+
+class TestQueryServing:
+    def test_etag_roundtrip_and_invalidation(self):
+        store = HistoryStore()
+        feed(store, [(BASE, 1.0)])
+        status, body, headers = query(
+            store, family="slice_duty_cycle_mean", window="1h")
+        assert status == 200
+        etag = headers["ETag"]
+        status, body, headers = store.handle_query(
+            {"family": "slice_duty_cycle_mean", "window": "1h"},
+            "10.0.0.1", gzip_ok=False, if_none_match=etag)
+        assert (status, body) == (304, b"")
+        assert headers["ETag"] == etag
+        # A new publish invalidates by generation mismatch — same
+        # conditional now misses and the ETag moves.
+        store.record("slice_duty_cycle_mean", (("slice", "s0"),), 9.0)
+        store.commit(BASE + 10.0, 2)
+        status, _body, headers = store.handle_query(
+            {"family": "slice_duty_cycle_mean", "window": "1h"},
+            "10.0.0.1", gzip_ok=False, if_none_match=etag)
+        assert status == 200
+        assert headers["ETag"] != etag
+
+    def test_gzip_body_is_the_same_document(self):
+        store = HistoryStore()
+        feed(store, [(BASE + 10.0 * i, float(i)) for i in range(60)])
+        _s, plain, _h = query(
+            store, family="slice_duty_cycle_mean", window="1h")
+        status, gz, headers = store.handle_query(
+            {"family": "slice_duty_cycle_mean", "window": "1h"},
+            "10.0.0.1", gzip_ok=True, if_none_match="")
+        assert status == 200
+        assert headers["Content-Encoding"] == "gzip"
+        assert gzip.decompress(gz) == plain
+
+    def test_parameter_validation(self):
+        store = HistoryStore()
+        feed(store, [(BASE, 1.0)])
+        assert query(store)[0] == 400                       # no family
+        status, body, _ = query(
+            store, family="slice_duty_cycle_mean", window="3h")
+        assert status == 400
+        assert b"1h,24h,7d" in body
+        status, body, _ = query(
+            store, family="slice_duty_cycle_mean", window="1h",
+            step="300")
+        assert status == 400                                # wrong step
+        assert query(store, family="slice_duty_cycle_mean",
+                     window="1h", step="10s")[0] == 200
+        status, body, _ = query(store, family="nope", window="1h")
+        assert status == 404
+        assert b"slice_duty_cycle_mean" in body
+
+    def test_disabled_store_answers_enabled_false(self):
+        store = HistoryStore(enabled=False)
+        store.record("slice_chips", (), 1.0)
+        store.commit(BASE, 1)
+        assert store.samples_total == 0
+        status, body, _ = query(store, family="slice_chips")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is False
+        assert "--no-history" in payload["hint"]
+
+
+class TestQueryGate:
+    def test_exact_shed_accounting(self):
+        gate = QueryGate(rate=1.0, burst=2.0)
+        verdicts = [gate.admit("1.2.3.4", now=100.0) for _ in range(20)]
+        admitted = [v for v in verdicts if v[0]]
+        shed = [v for v in verdicts if not v[0]]
+        assert len(admitted) == 2           # the whole burst, no more
+        assert len(shed) == 18
+        assert gate.admitted_total == 2
+        assert gate.shed_total == 18
+        assert all(retry >= 1 for _ok, retry in shed)
+        # Tokens refill at the configured rate — and the counters only
+        # ever count, they never reset.
+        ok, retry = gate.admit("1.2.3.4", now=101.5)
+        assert ok
+        assert gate.admitted_total == 3
+
+    def test_clients_are_isolated(self):
+        gate = QueryGate(rate=1.0, burst=1.0)
+        assert gate.admit("1.2.3.4", now=100.0)[0]
+        assert not gate.admit("1.2.3.4", now=100.0)[0]
+        assert gate.admit("5.6.7.8", now=100.0)[0]
+
+    def test_rate_zero_admits_everything(self):
+        gate = QueryGate(rate=0.0, burst=1.0)
+        assert all(gate.admit("1.2.3.4")[0] for _ in range(50))
+        assert gate.shed_total == 0
+
+
+class TestEtagMatch:
+    def test_semantics(self):
+        assert etag_match('"a-1"', '"a-1"')
+        assert etag_match("*", '"anything"')
+        assert etag_match('"x", "a-1"', '"a-1"')
+        assert etag_match('W/"a-1"', '"a-1"')   # weak compare for 304s
+        assert not etag_match("", '"a-1"')
+        assert not etag_match('"a-2"', '"a-1"')
+
+
+class TestDoctorAt:
+    """`doctor --fleet --at` replays the verdict from ring payloads:
+    drive a REAL store through a straggler episode and its recovery,
+    and pin that the past still names the straggler."""
+
+    STEPS = "slice_worker_steps_per_second"
+    UP = "slice_target_up"
+
+    def make_history(self):
+        store = HistoryStore()
+        t0 = BASE
+        # t0: worker w2 straggling at 2 steps/s, target node-2 down.
+        for worker, rate in (("w0", 10.0), ("w1", 10.0), ("w2", 2.0)):
+            store.record(self.STEPS,
+                         (("slice", "s0"), ("worker", worker)), rate)
+        store.record(self.UP, (("target", "node-2:9400"),), 0.0)
+        store.commit(t0, 1)
+        # t0+600: fully recovered.
+        for worker in ("w0", "w1", "w2"):
+            store.record(self.STEPS,
+                         (("slice", "s0"), ("worker", worker)), 10.0)
+        store.record(self.UP, (("target", "node-2:9400"),), 1.0)
+        store.commit(t0 + 600.0, 2)
+        return store, t0
+
+    def verdict_at(self, store, ts):
+        return fleet_at_verdict(store.at_payload(self.STEPS, ts),
+                                store.at_payload(self.UP, ts),
+                                {"series": []}, ts)
+
+    def test_straggler_ten_minutes_ago_stays_named_after_recovery(self):
+        store, t0 = self.make_history()
+        status, detail, data = self.verdict_at(store, t0)
+        assert status == WARN
+        assert "straggler worker w2" in detail
+        assert "ratio 0.20" in detail
+        assert "as of" in detail
+        assert "node-2:9400 was down" in detail
+        assert data["slices"]["s0"]["slowest_worker"] == "w2"
+        assert data["targets_down"] == ["node-2:9400"]
+
+    def test_now_is_healthy_after_recovery(self):
+        store, t0 = self.make_history()
+        status, detail, _data = self.verdict_at(store, t0 + 600.0)
+        assert status == OK
+        assert "fleet healthy" in detail
+
+    def test_empty_ring_says_it_does_not_survive_restarts(self):
+        status, detail, _data = fleet_at_verdict(
+            {"series": []}, {"series": []}, {"series": []}, BASE)
+        assert status == WARN
+        assert "does not survive a restart" in detail

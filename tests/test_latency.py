@@ -399,3 +399,26 @@ def test_render_cost_bounded_at_32_chip_full_label_scale():
     # jitter; the measured number on an idle box is ~1-2 ms.
     assert p50 < 10.0, f"render p50 {p50:.2f} ms for {series_count} series"
     assert len(text) > 100_000  # the render actually carried the series
+
+
+@retry_once_on_box_noise
+def test_query_serving_stampede_pins():
+    """ISSUE 18 acceptance pins: 256 keep-alive dashboard readers
+    against a LIVE-refreshing hub see query p99 < 25 ms (the
+    pre-rendered per-(family, window, generation) response cache is
+    the mechanism — a reader never pays a render or a gzip), >= 50%
+    of If-None-Match /metrics scrapes answer 304 once the generation
+    holds, the ring's per-refresh write cost stays in microsecond
+    territory (measured ~1 ms against a 10 ms pin for box headroom),
+    and the ring's slab footprint stays a fixed few MB. Real sockets,
+    wall-clock pacing and a 1-core-CI thread ballet — box-noise retry,
+    same discipline as the harness pin above."""
+    from kube_gpu_stats_tpu.bench import measure_query_serving
+
+    result = measure_query_serving()
+    assert result is not None
+    assert result["query_p99_ms_256readers"] < 25.0, result
+    assert result["query_p50_ms_256readers"] < 15.0, result
+    assert result["scrape_304_ratio"] >= 0.5, result
+    assert result["history_write_ns_per_refresh"] < 10e6, result
+    assert result["history_rss_mb"] < 20.0, result

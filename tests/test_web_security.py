@@ -743,3 +743,96 @@ def test_truncated_post_body_is_400_not_a_stuck_thread():
     finally:
         srv.stop()
         hub.stop()
+
+
+# -- /query + conditional scrapes (ISSUE 18) ---------------------------------
+
+def _history_store(enabled=True):
+    from kube_gpu_stats_tpu.history import HistoryStore
+
+    store = HistoryStore(enabled=enabled)
+    store.record("slice_chips", (("slice", "s0"),), 4.0)
+    store.commit(1_700_000_000.0, 1)
+    return store
+
+
+def test_query_is_auth_gated():
+    """/query serves fleet telemetry history — it sits behind the same
+    basic-auth gate as /metrics and the /debug surface."""
+    store = _history_store()
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
+        history_provider=store,
+    )
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            fetch(srv.port, "/query?family=slice_chips&window=1h")
+        assert err.value.code == 401
+        assert b"slice_chips" not in err.value.read()  # no payload leak
+        resp = fetch(srv.port, "/query?family=slice_chips&window=1h",
+                     headers=auth_header("prom", "s3cret"))
+        assert resp.status == 200
+        payload = resp.read()
+        assert b'"family": "slice_chips"' in payload
+        assert resp.headers["ETag"].startswith('"h')
+    finally:
+        srv.stop()
+
+
+def test_query_listed_on_landing_page_when_wired():
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        history_provider=_history_store())
+    srv.start()
+    try:
+        assert b"/query" in fetch(srv.port, "/").read()
+    finally:
+        srv.stop()
+
+
+def test_query_404_when_unwired(server):
+    """Daemons and bare servers wire no history: /query is 404 and the
+    landing page does not advertise it."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(server.port, "/query?family=slice_chips&window=1h")
+    assert err.value.code == 404
+    assert b"/query" not in fetch(server.port, "/").read()
+
+
+def test_query_disabled_answers_enabled_false():
+    """--no-history wires a disabled store so a dashboard gets a
+    self-describing verdict, not an ambiguous 404."""
+    import json
+
+    srv = MetricsServer(make_registry(), host="127.0.0.1", port=0,
+                        history_provider=_history_store(enabled=False))
+    srv.start()
+    try:
+        payload = json.loads(
+            fetch(srv.port, "/query?family=slice_chips").read())
+        assert payload["enabled"] is False
+        assert "--no-history" in payload["hint"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_conditional_scrape_304(server):
+    """If-None-Match on an unchanged generation answers 304 with an
+    empty body; urllib surfaces 304 as an HTTPError, which is exactly
+    the zero-transfer contract."""
+    resp = fetch(server.port, "/metrics")
+    etag = resp.headers["ETag"]
+    assert etag
+    resp.read()
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(server.port, "/metrics", headers={"If-None-Match": etag})
+    assert err.value.code == 304
+    assert err.value.read() == b""
+    # A different (older/foreign) tag misses: full body, current ETag.
+    resp = fetch(server.port, "/metrics",
+                 headers={"If-None-Match": '"stale-0-m00"'})
+    assert resp.status == 200
+    assert resp.headers["ETag"] == etag
+    assert resp.read()

@@ -49,6 +49,16 @@ _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _FLOORS = (
     ("gc_max_pause_ms", 0.50),
     ("p99", 0.50),
+    # 304 hit ratio under steady generation: mostly deterministic (the
+    # readers send If-None-Match and the generation holds), a thread-
+    # scheduling tail of full responses jitters the rest.
+    ("scrape_304_ratio", 0.10),
+    # Per-refresh ring write cost is a handful of microseconds —
+    # perf_counter_ns noise at that scale needs a wide band.
+    ("history_write_ns", 0.50),
+    # Preallocated slabs: series_count x fixed cost, moves only when
+    # the tracked-family set changes.
+    ("history_rss_mb", 0.10),
     ("_bytes", 0.05),
     ("_count", 0.05),
     ("series", 0.05),
@@ -67,6 +77,10 @@ PINNED = {
     "max_hz": -1,
     "hub_merge_64w_cold_ms": +1,
     "hub_merge_64w_p50_ms": +1,
+    # ISSUE 18: the dashboard read path. Query p99 rising or the 304
+    # hit ratio falling means the stampede-proofing regressed.
+    "query_p99_ms_256readers": +1,
+    "scrape_304_ratio": -1,
 }
 
 
@@ -198,7 +212,30 @@ def diff(root: pathlib.Path, gate: bool) -> tuple[list[str], list[str]]:
             f"  {len(stale)} stale waiver(s) (not for r{new_n}): "
             + ", ".join(f"{w['field']}@{w['run']}" for w in stale)
             + " — safe to delete")
+    # Waivers naming runs OLDER than both compared runs are expired by
+    # construction (run-scoped: the run they covered has already been
+    # superseded twice) — under --gate, leaving them in the file is a
+    # failure, not a footnote, or dead waivers accrete until one
+    # accidentally matches a future field (ISSUE 18).
+    expired = [w for w in stale
+               if _run_number(w["run"]) is not None
+               and _run_number(w["run"]) < old_n]
+    if expired and gate:
+        for w in expired:
+            failures.append(
+                f"expired waiver {w['field']}@{w['run']}: names a run "
+                f"older than both compared runs (r{old_n} -> r{new_n}) "
+                f"— delete it from {WAIVERS}")
     return lines, failures
+
+
+def _run_number(run: str) -> int | None:
+    """'r17' -> 17; None for a malformed run tag (load_waivers already
+    guarantees the key exists, not its shape)."""
+    try:
+        return int(run.lstrip("r"))
+    except ValueError:
+        return None
 
 
 def main(argv=None) -> int:

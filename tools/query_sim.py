@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Dashboard-stampede smoke (ISSUE 18, `make query-sim`): a real hub
+behind a real MetricsServer serves /query to hundreds of concurrent
+readers while its refresh loop keeps publishing, and must:
+
+- **Hold the latency pins under the stampede**: 256 keep-alive readers
+  polling /query at dashboard pace against a LIVE-refreshing hub see
+  p50 < 15 ms and p99 < 25 ms — the pre-rendered, pre-gzipped
+  per-(family, window, generation) response cache is the whole
+  mechanism; readers never pay a render.
+- **Answer conditionals with 304s under a steady generation**: readers
+  that carry If-None-Match draw >= 50% 304s on /query AND /metrics
+  once publishes stop — zero render, zero gzip, zero body.
+- **Shed over-rate clients with exact accounting**: with the per-client
+  token gate tightened, one hammering client's observed 429s equal the
+  gate's shed_total delta exactly, every 429 carries Retry-After >= 1,
+  and the exported kts_query_shed_total agrees after the next publish.
+- **Keep the ring's memory fixed**: the reader storm adds zero bytes
+  to the history ring, and the slab arithmetic (series x fixed
+  per-identity cost) bounds it throughout.
+
+Exit 0 with a PASS line, else 1 with evidence. Wired into `make ci`;
+the recorded bench figures live in BENCH_r*.json via
+bench.measure_query_serving, with CI pins in tests/test_latency.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import pathlib
+import sys
+import threading
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from chaos_sim import SessionFleet  # noqa: E402
+
+PUSHERS = 16
+READERS = 256
+REQUESTS_PER_READER = 4
+PERIOD_S = 0.4                # per-reader /query pacing (~2.5 Hz)
+P50_PIN_MS = 15.0
+P99_PIN_MS = 25.0
+RATIO_FLOOR = 0.5             # 304 floor under a steady generation
+CONDITIONALS = 100            # conditional requests per surface
+HAMMER = 40                   # phase-C requests from the one client
+FAMILIES = ("slice_chips", "slice_duty_cycle_mean", "slice_power_watts",
+            "slice_memory_used_bytes")
+
+
+def counter_value(text: str, name: str) -> float:
+    """Sum of an exported counter's rows (kts_query_* carry no
+    labels, so this is the single row or 0.0 when absent)."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and line[len(name)] in " {":
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def run(verbose: bool) -> int:
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.history import HistoryStore, QueryGate
+    from kube_gpu_stats_tpu.hub import Hub
+
+    problems: list[str] = []
+
+    # qps=0 for phases A/B: all readers here share 127.0.0.1, and a
+    # shared token bucket would turn the latency phase into a shed
+    # test. Phase C swaps in a tight gate and pins the shed discipline.
+    store = HistoryStore(query_qps=0.0)
+    hub = Hub([], targets_provider=lambda: [], interval=10.0,
+              push_fence=1e9, ingest_lanes=2,
+              ingest_max_sessions=PUSHERS + 8, history=store)
+    server = MetricsServer(hub.registry, host="127.0.0.1", port=0,
+                           max_concurrent_scrapes=0,
+                           ingest_provider=hub.delta.handle,
+                           history_provider=store,
+                           prewarm_renders=False)
+    server.start()
+    try:
+        fleet = SessionFleet(server.port, PUSHERS, prefix="panel")
+        bad = [o for o in fleet.seed() if o[1] != 200]
+        if bad:
+            problems.append(f"seeding failed: {bad[:3]}")
+        hub.refresh_once()
+        hub.refresh_once()
+        port = server.port
+        bytes_before = store.bytes()
+        bound = store.max_series * store.series_bytes
+
+        # --- phase A: 256 live readers vs a refreshing hub -----------
+        stop_refresh = threading.Event()
+
+        def refresher() -> None:
+            while not stop_refresh.is_set():
+                hub.refresh_once()
+                stop_refresh.wait(0.1)
+
+        latencies: list[float] = []
+        reader_errors: list[str] = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(READERS + 1)
+
+        def reader(idx: int) -> None:
+            mine: list[float] = []
+            path = (f"/query?family={FAMILIES[idx % len(FAMILIES)]}"
+                    f"&window=1h")
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10.0)
+            try:
+                # Connected before the barrier; first requests spread
+                # across one period — a dashboard fleet holds its
+                # connections and is never phase-locked (bench.py
+                # measure_query_serving documents the convoy this
+                # avoids).
+                conn.connect()
+                barrier.wait()
+                time.sleep(idx * (PERIOD_S / READERS))
+                for _r in range(REQUESTS_PER_READER):
+                    start = time.perf_counter()
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    mine.append(time.perf_counter() - start)
+                    if resp.status != 200:
+                        raise AssertionError(
+                            f"{path} -> {resp.status}: {body[:80]!r}")
+                    time.sleep(PERIOD_S)
+            except Exception as exc:  # noqa: BLE001 - evidence, not a
+                # thread stack trace on stderr
+                with lock:
+                    reader_errors.append(f"reader {idx}: {exc!r}")
+                return
+            finally:
+                conn.close()
+            with lock:
+                latencies.extend(mine)
+
+        refresh_thread = threading.Thread(target=refresher, daemon=True)
+        refresh_thread.start()
+        threads = [threading.Thread(target=reader, args=(i,),
+                                    daemon=True)
+                   for i in range(READERS)]
+        for thread in threads:
+            thread.start()
+        barrier.wait()
+        for thread in threads:
+            thread.join(timeout=60.0)
+
+        if reader_errors:
+            problems.append(
+                f"{len(reader_errors)} of {READERS} readers failed: "
+                + "; ".join(reader_errors[:3]))
+        latencies.sort()
+        if latencies:
+            p50 = latencies[len(latencies) // 2] * 1000.0
+            p99 = latencies[int(len(latencies) * 0.99) - 1] * 1000.0
+        else:
+            p50 = p99 = float("inf")
+        if p50 >= P50_PIN_MS:
+            problems.append(
+                f"query p50 {p50:.1f} ms under {READERS} live readers "
+                f"(pin: < {P50_PIN_MS:g} ms)")
+        if p99 >= P99_PIN_MS:
+            problems.append(
+                f"query p99 {p99:.1f} ms under {READERS} live readers "
+                f"(pin: < {P99_PIN_MS:g} ms)")
+
+        # The storm read history, it must not have written any: the
+        # ring's bytes are a function of tracked series alone.
+        bytes_after = store.bytes()
+        if bytes_after != bytes_before:
+            problems.append(
+                f"ring grew under the reader storm: {bytes_before} -> "
+                f"{bytes_after} bytes — reads are writing somewhere")
+        if bytes_after > bound:
+            problems.append(
+                f"ring {bytes_after} bytes above its arithmetic bound "
+                f"{bound} (max_series x series_bytes)")
+
+        # --- phase B: steady generation, conditional readers ---------
+        stop_refresh.set()
+        refresh_thread.join(timeout=10.0)
+
+        def conditional_ratio(path: str) -> float:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10.0)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                resp.read()
+                etag = resp.getheader("ETag", "")
+                hits = 0
+                for _r in range(CONDITIONALS):
+                    conn.request("GET", path,
+                                 headers={"If-None-Match": etag})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status == 304:
+                        hits += 1
+                    else:
+                        etag = resp.getheader("ETag", etag)
+                return hits / CONDITIONALS
+            finally:
+                conn.close()
+
+        for path in ("/query?family=slice_chips&window=1h", "/metrics"):
+            ratio = conditional_ratio(path)
+            if ratio < RATIO_FLOOR:
+                problems.append(
+                    f"{path.split('?')[0]} 304 ratio {ratio:.2f} under "
+                    f"a steady generation (floor: {RATIO_FLOOR})")
+
+        # --- phase C: the tightened gate sheds with exact accounting -
+        store.gate = QueryGate(rate=2.0, burst=2.0)
+        shed_before = store.gate.shed_total
+        observed_429 = 0
+        retry_afters: list[str | None] = []
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=10.0)
+        try:
+            for _r in range(HAMMER):
+                conn.request(
+                    "GET", "/query?family=slice_chips&window=1h")
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 429:
+                    observed_429 += 1
+                    retry_afters.append(resp.getheader("Retry-After"))
+                elif resp.status != 200:
+                    problems.append(
+                        f"hammer saw {resp.status}, want 200 or 429")
+        finally:
+            conn.close()
+        shed_delta = store.gate.shed_total - shed_before
+        if observed_429 == 0:
+            problems.append(
+                f"gate at 2 qps never shed across {HAMMER} "
+                f"back-to-back requests")
+        if observed_429 != shed_delta:
+            problems.append(
+                f"shed accounting drifted: client observed "
+                f"{observed_429} 429s, gate counted {shed_delta}")
+        bad_retry = [r for r in retry_afters
+                     if r is None or not r.isdigit() or int(r) < 1]
+        if bad_retry:
+            problems.append(
+                f"429s without a usable Retry-After: {bad_retry[:3]}")
+        # Third view of the same ledger: the exported counter after the
+        # next publish.
+        hub.refresh_once()
+        exported = counter_value(hub.registry.snapshot().render(),
+                                 "kts_query_shed_total")
+        if exported != store.gate.shed_total:
+            problems.append(
+                f"kts_query_shed_total exports {exported:g}, gate "
+                f"counted {store.gate.shed_total}")
+
+        if verbose:
+            print(f"  {READERS} live readers x {REQUESTS_PER_READER}: "
+                  f"p50 {p50:.2f} ms / p99 {p99:.2f} ms "
+                  f"(pins {P50_PIN_MS:g}/{P99_PIN_MS:g}); "
+                  f"ring {bytes_after} bytes (bound {bound}, flat); "
+                  f"steady-gen 304s >= {RATIO_FLOOR:.0%} on /query and "
+                  f"/metrics; gate shed {shed_delta} of {HAMMER} with "
+                  f"Retry-After, exported counter agrees")
+    finally:
+        server.stop()
+        hub.stop()
+
+    if not problems:
+        print(f"query-sim PASS: {READERS} keep-alive readers rode a "
+              f"live-refreshing hub at p50 {p50:.1f} ms / "
+              f"p99 {p99:.1f} ms, steady-generation conditionals drew "
+              f">= {RATIO_FLOOR:.0%} 304s, the tightened gate shed "
+              f"{shed_delta} requests with exact 3-way accounting, "
+              f"ring fixed at {bytes_after} bytes")
+        return 0
+    print("query-sim FAIL:")
+    for problem in problems:
+        print(f"  {problem}")
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    return run(args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
