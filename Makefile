@@ -47,10 +47,14 @@ chaos: native
 bench: native
 	python bench.py
 
-# Fleet-lens smoke (<30 s): N real daemons (fake libtpu + FakeKubelet
-# attribution) + one hub; injects a straggler via a scripted RPC delay
-# and asserts `doctor --fleet` names the guilty node with its phase and
-# blamed port. Runs inside `make ci` too.
+# Fleet-lens smoke (<60 s), two scenarios, both inside `make ci`:
+# straggler — N real daemons (fake libtpu + FakeKubelet attribution) +
+# one hub; injects a straggler via a scripted RPC delay and asserts
+# `doctor --fleet` names the guilty node with its phase and blamed
+# port. link — degrades one shared ICI link from BOTH endpoint daemons'
+# fake runtimes (+ NIC drops on both hosts) and asserts the doctor
+# names the LINK host-counter-confirmed, accuses zero endpoint nodes,
+# and replays the verdict retroactively via `--at` after recovery.
 fleet-sim:
 	python tools/fleet_sim.py --verbose
 

@@ -188,6 +188,19 @@ def _host_fields(line: dict) -> None:
         line["hoststats_read_p99_ms"] = host["hoststats_read_p99_ms"]
 
 
+def _linkloc_fields(line: dict) -> None:
+    """Interconnect-localization pass cost (ISSUE 19): median
+    LinkLocalizer.observe wall time over an 8x8-torus fleet (256
+    endpoint views per refresh, one verdict forming and clearing
+    mid-run). Runs under the FleetLens lock on the refresh thread, so
+    this is refresh latency — pinned against drift by bench_diff."""
+    from kube_gpu_stats_tpu.bench import measure_fleet_localize
+
+    loc = measure_fleet_localize()
+    if loc is not None:
+        line["fleet_localize_ms"] = loc["fleet_localize_ms"]
+
+
 def _query_fields(line: dict) -> None:
     """Dashboard read-path figures (ISSUE 18): /query latency under 256
     keep-alive readers against a live-refreshing hub, the /metrics 304
@@ -296,6 +309,7 @@ def _quick() -> int:
     _burst_fields(line)
     _host_fields(line)
     _cardinality_fields(line)
+    _linkloc_fields(line)
     _query_fields(line)
     print(json.dumps(line))
     sys.stdout.flush()
@@ -415,6 +429,7 @@ def main() -> int:
     _burst_fields(line)
     _host_fields(line)
     _cardinality_fields(line)
+    _linkloc_fields(line)
     _query_fields(line)
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or

@@ -1950,3 +1950,57 @@ def measure_hub_merge(workers: int = 64, chips: int = 4,
         }
     except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
         return None
+
+
+def measure_fleet_localize(workers: int = 64,
+                           refreshes: int = 60) -> dict | None:
+    """Interconnect-localization pass cost (ISSUE 19): median wall time
+    of one LinkLocalizer.observe over an 8x8-torus fleet (64 workers,
+    6 link labels each, mapped onto the 128-edge graph = 256 endpoint
+    views baselined per refresh). The pass runs under the FleetLens
+    lock on the hub's refresh thread, so its cost is refresh latency —
+    it must stay a rounding error next to the merge itself.
+
+    Deterministic: rates carry an index-derived jitter (no RNG — the
+    MAD bands must price real arithmetic, not flat zeros), and one
+    link degrades mid-run so verdict bookkeeping (streaks, journal
+    events, tombstone rows) is on the measured path. Returns
+    {"fleet_localize_ms": ...} or None, never raises."""
+    try:
+        from . import linkloc
+
+        loc = linkloc.LinkLocalizer()
+        node_ids = [str(i) for i in range(workers)]
+        labels = ("x0", "x1", "y0", "y1", "z0", "z1")
+
+        def evidence(r: int, degraded: bool) -> dict:
+            nodes = {}
+            for i, worker in enumerate(node_ids):
+                links = {}
+                for li, label in enumerate(labels):
+                    rate = 3e7 + ((i * 31 + r * 17 + li * 7) % 13) * 1e4
+                    # Mid-run degradation of the SHARED edge 0-1 (8x8
+                    # row-major: worker 0's y1 and worker 1's y0 are
+                    # the same physical link), so a real verdict forms
+                    # and clears inside the measured window.
+                    if degraded and (worker, label) in (("0", "y1"),
+                                                        ("1", "y0")):
+                        rate *= 0.1
+                    links[label] = rate
+                nodes[worker] = {"links": links, "topology": "8x8",
+                                 "anomalies": set(), "host": False,
+                                 "target": f"http://w{worker}"}
+            return nodes
+
+        now = 1_000_000.0
+        walls = []
+        for r in range(refreshes):
+            nodes = evidence(r, degraded=refreshes // 3 < r
+                             < 2 * refreshes // 3)
+            start = time.perf_counter()
+            loc.observe(now, nodes)
+            walls.append((time.perf_counter() - start) * 1000.0)
+            now += 10.0
+        return {"fleet_localize_ms": round(statistics.median(walls), 3)}
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None

@@ -1279,6 +1279,8 @@ def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
     any anomaly is active or any burn window is over budget (burn >
     1.0). Pure so tests drive it on canned JSON; check_fleet wraps it
     with the fetch/auth/version classification."""
+    from .linkloc import LINK_EXPLAINED_KINDS
+
     parts: list[str] = []
     data: dict = {"attribution": payload.get("attribution"),
                   "anomalous": {}, "correlated": {},
@@ -1292,11 +1294,45 @@ def fleet_post_mortem(payload: dict) -> tuple[str, str, dict]:
         if worst.get("blame"):
             line += f", blame {worst['blame']}"
         parts.append(line + ")")
+    # Interconnect localization (ISSUE 19): the verdict the whole
+    # topology pass exists to print — name the sick LINK first, and
+    # below, do NOT also accuse the endpoint nodes whose anomalies the
+    # link fully explains (they are the innocent neighbors).
+    suspects = (payload.get("links") or {}).get("suspects") or {}
+    link_explained: dict[str, str] = {}
+    for link, verdict in sorted(suspects.items()):
+        status = WARN
+        ends = ",".join(verdict.get("endpoints") or ())
+        line = f"nodes {ends} slow; shared ICI link {link} suspect"
+        reason = verdict.get("reason", "")
+        if "host-counter-confirmed" in reason:
+            line += ", host-counter-confirmed"
+        elif "anomaly-correlated" in reason:
+            line += ", anomaly-correlated"
+        drop = verdict.get("drop")
+        if drop:
+            line += f" ({drop:.0%} below baseline)"
+        parts.append(line)
+        for target in verdict.get("targets") or ():
+            if target:
+                link_explained[target] = link
+    data["link_suspects"] = {link: dict(v)
+                            for link, v in sorted(suspects.items())}
+    data["link_explained"] = {}
     for target, entry in sorted((payload.get("targets") or {}).items()):
         anomalous = entry.get("anomalous") or {}
         if not anomalous:
             continue
         status = WARN
+        if target in link_explained and all(
+                kind in LINK_EXPLAINED_KINDS or kind.startswith("host_")
+                for kind in anomalous):
+            # Every anomaly on this endpoint is a symptom a degraded
+            # shared link produces (ici/steps/fetch slowdowns, the host
+            # NIC/IRQ corroboration) — the link verdict above already
+            # owns them, so the node is not accused.
+            data["link_explained"][target] = link_explained[target]
+            continue
         data["anomalous"][target] = dict(anomalous)
         # Freshness reports the CURRENT missed count (entry['missed']),
         # not the count frozen at the raise edge — a 100-refresh outage
@@ -1441,8 +1477,9 @@ def parse_at(raw: str, now: float) -> float:
 
 
 def fleet_at_verdict(steps_payload: dict, up_payload: dict,
-                     ratio_payload: dict,
-                     at_ts: float) -> tuple[str, str, dict]:
+                     ratio_payload: dict, at_ts: float,
+                     links_payload: dict | None = None
+                     ) -> tuple[str, str, dict]:
     """(status, detail, data) for a retroactive fleet post-mortem at
     ``at_ts``, computed from the hub history ring's /query?at=
     payloads (named-window nearest-sample semantics: each value is the
@@ -1451,7 +1488,8 @@ def fleet_at_verdict(steps_payload: dict, up_payload: dict,
     Pure so the fault-injection test drives it on canned payloads: a
     straggler visible at the timestamp stays named here even after it
     recovers, because the verdict reads the ring, not the live lens."""
-    data: dict = {"at": at_ts, "slices": {}, "targets_down": []}
+    data: dict = {"at": at_ts, "slices": {}, "targets_down": [],
+                  "links_suspect": []}
     parts: list[str] = []
     status = OK
     # Per-slice straggler attribution from the per-worker step rates.
@@ -1498,7 +1536,27 @@ def fleet_at_verdict(steps_payload: dict, up_payload: dict,
         status = WARN
         data["targets_down"].append(target)
         parts.append(f"{target} was down (as of {_ts(sample_ts)})")
-    if not (steps_payload.get("series") or up_payload.get("series")):
+    # Retroactive link localization (ISSUE 19): the link-suspect rows
+    # the hub recorded into the ring every publish. Ring buckets hold
+    # the MEAN of their samples, so any positive value means the link
+    # was accused for part of the bucket; the 0.0 tombstones the
+    # recovery wrote keep later buckets (and a fully-recovered
+    # incident's tail) reading clean — exactly the post-incident
+    # semantics a post-mortem wants.
+    for entry in (links_payload or {}).get("series") or []:
+        if float(entry.get("v", 0.0)) <= 0.0:
+            continue
+        labels = entry.get("labels") or {}
+        link = labels.get("link", "")
+        reason = labels.get("reason", "")
+        sample_ts = float(entry.get("t", at_ts))
+        status = WARN
+        data["links_suspect"].append(
+            {"link": link, "reason": reason, "sample_ts": sample_ts})
+        parts.append(f"ICI link {link} was suspect ({reason}, "
+                     f"as of {_ts(sample_ts)})")
+    if not (steps_payload.get("series") or up_payload.get("series")
+            or (links_payload or {}).get("series")):
         return (WARN,
                 f"history has no samples near {_ts(at_ts)} — the ring "
                 f"holds 1h/24h/7d tiers from THIS hub boot only (it "
@@ -1522,7 +1580,7 @@ def check_fleet_at(base: str, at_ts: float) -> CheckResult:
 
     payloads = {}
     for family in ("slice_worker_steps_per_second", "slice_target_up",
-                   "slice_straggler_ratio"):
+                   "slice_straggler_ratio", "kts_fleet_link_suspect"):
         try:
             payloads[family] = _fetch_json(
                 f"{base}/query?family={family}&at={at_ts}")
@@ -1554,7 +1612,8 @@ def check_fleet_at(base: str, at_ts: float) -> CheckResult:
         payloads.get("slice_worker_steps_per_second") or {},
         payloads.get("slice_target_up") or {},
         payloads.get("slice_straggler_ratio") or {},
-        at_ts)
+        at_ts,
+        links_payload=payloads.get("kts_fleet_link_suspect") or {})
     return _result("fleet-at", status, detail, data=data)
 
 

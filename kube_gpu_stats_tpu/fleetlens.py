@@ -62,7 +62,7 @@ import math
 import threading
 from typing import Mapping, Sequence
 
-from . import schema
+from . import linkloc, schema
 
 # Default SLO knobs (--slo-* flags; config.py re-exports these as the
 # shared flag surface). Freshness: 99% of observed chip-refreshes serve
@@ -253,6 +253,9 @@ def digest_from_series(series: Sequence) -> dict:
     slowest: dict | None = None
     burst_max: float | None = None
     host: dict[str, float] = {}
+    ici_links: dict[str, float] = {}
+    ici_worker = ""
+    ici_topology = ""
     for name, labels, value in series:
         if name == schema.TICK_PHASE_SECONDS.name:
             phase = labels.get("phase", "")
@@ -284,6 +287,17 @@ def digest_from_series(series: Sequence) -> dict:
             host["nic_drop_rate"] = value
         elif name == schema.HOST_THROTTLE_RATE.name:
             host["throttle_rate"] = value
+        elif name == schema.ICI_BANDWIDTH.name:
+            # Interconnect evidence (ISSUE 19): the target's per-link
+            # ICI rates, summed over its chips (every local chip rides
+            # the same physical links), plus the worker/topology
+            # identity the localization pass needs to place this node
+            # on the interconnect graph.
+            link = labels.get("link", "")
+            if link:
+                ici_links[link] = ici_links.get(link, 0.0) + value
+                ici_worker = ici_worker or labels.get("worker", "")
+                ici_topology = ici_topology or labels.get("topology", "")
     out: dict = {}
     if phases:
         out["phases"] = phases
@@ -293,6 +307,9 @@ def digest_from_series(series: Sequence) -> dict:
         out["burst_max_watts"] = burst_max
     if host:
         out["host"] = host
+    if ici_links:
+        out["ici"] = {"links": ici_links, "worker": ici_worker,
+                      "topology": ici_topology}
     return out
 
 
@@ -390,6 +407,10 @@ class FleetLens:
         # Fleet-wide slow-node attribution from the last refresh that
         # had any digest: {"target", "seconds", "phase", "blame"}.
         self._worst: dict | None = None
+        # Topology-aware ICI localization (ISSUE 19): the pass that
+        # names a sick LINK from the cross-node evidence this lens
+        # already holds. Guarded by self._lock like everything else.
+        self.links = linkloc.LinkLocalizer()
         self._last_seq = 0
         self._last_now = 0.0
 
@@ -450,6 +471,18 @@ class FleetLens:
                             value = host.get(key)
                             if value is not None:
                                 signals[name] = value
+                    ici_info = digests.get(target, {}).get("ici") \
+                        or (state.digest.get("ici")
+                            if state.digest else None)
+                    if ici_info and ici_info.get("links"):
+                        # Aggregate ICI throughput joins the scored
+                        # signals (NOT re-seed exempt: a job starting
+                        # moves it 0 -> big, which is a regime change,
+                        # not a fault). Per-LINK scoring with the
+                        # two-endpoint cross-check lives in the
+                        # localizer below.
+                        signals["ici"] = sum(
+                            ici_info["links"].values())
                     state.chips = len(rows) or state.chips
                     stale_chips = sum(1 for r in rows if r.up != 1.0)
                     fresh_bad += stale_chips
@@ -478,6 +511,32 @@ class FleetLens:
                     now, 1.0 if worst_ratio < self.straggler_ratio_min
                     else 0.0, 1.0)
             self._attribute(targets)
+            # Interconnect localization (ISSUE 19): assemble each
+            # answered worker's evidence — per-link ICI rates, its
+            # device-side anomaly kinds (ici/steps/fetch corroborate a
+            # link verdict), and whether PR 8's host signals are also
+            # anomalous — and let the localizer score the graph.
+            link_nodes: dict[str, dict] = {}
+            for target in targets:
+                state = self._targets.get(target)
+                if state is None or state.missed or not state.digest:
+                    continue
+                ici_info = state.digest.get("ici")
+                if not ici_info or not ici_info.get("links"):
+                    continue
+                worker = str(ici_info.get("worker", ""))
+                if not worker:
+                    continue
+                link_nodes[worker] = {
+                    "links": ici_info["links"],
+                    "topology": ici_info.get("topology", ""),
+                    "anomalies": set(state.anomalous),
+                    "host": any(k.startswith("host_")
+                                for k in state.anomalous),
+                    "target": target,
+                }
+            if link_nodes:
+                events.extend(self.links.observe(now, link_nodes))
         self._journal(events)
 
     def _signals(self, target: str, rows: list,
@@ -661,6 +720,9 @@ class FleetLens:
             straggler = self._straggler.window_state(self._last_now,
                                                      self._windows)
             worst = dict(self._worst) if self._worst else None
+            link_count = self.links.link_count()
+            link_rows = self.links.rows()
+            link_baselines = self.links.baseline_rows()
         builder.add(schema.FLEET_TARGETS_ANOMALOUS, float(anomalous))
         for (target, kind), count in totals:
             builder.add(schema.FLEET_ANOMALIES, float(count),
@@ -678,6 +740,26 @@ class FleetLens:
             builder.add(schema.FLEET_WORST_TICK, worst["seconds"],
                         (("target", worst["target"]),
                          ("phase", worst["phase"])))
+        builder.add(schema.FLEET_LINKS, float(link_count))
+        for link, reason, value in link_rows:
+            # Cleared/superseded identities keep exporting 0.0
+            # (series continuity: history nearest-sample reads must
+            # see the recovery, not a frozen accusation).
+            builder.add(schema.FLEET_LINK_SUSPECT, value,
+                        (("link", link), ("reason", reason)))
+        for link, baseline, band, observed in link_baselines:
+            labels = (("link", link),)
+            builder.add(schema.FLEET_LINK_BASELINE_BPS, baseline, labels)
+            builder.add(schema.FLEET_LINK_BASELINE_BAND, band, labels)
+            builder.add(schema.FLEET_LINK_OBSERVED_BPS, observed, labels)
+
+    def link_history_rows(self) -> list[tuple[str, str, float]]:
+        """(link, reason, value) suspect rows for the hub's history
+        ring — recorded every publish so `doctor --fleet --at` can
+        localize retroactively (1.0 while accused, 0.0 tombstones
+        after)."""
+        with self._lock:
+            return self.links.rows()
 
     # -- read side (HTTP threads) --------------------------------------------
 
@@ -738,5 +820,6 @@ class FleetLens:
                     },
                 },
                 "attribution": dict(self._worst) if self._worst else None,
+                "links": self.links.summary(),
             }
         return payload

@@ -1391,6 +1391,15 @@ class Hub:
         # the burn state must not vanish mid-incident.
         if self.fleet is not None:
             self.fleet.contribute(builder)
+            # Link-suspect verdicts ride the history ring (ISSUE 19) so
+            # `doctor --fleet --at` can name a sick ICI link after the
+            # incident cleared. Tombstone rows (0.0) are recorded too:
+            # nearest-sample reads must see the recovery.
+            if self.history is not None:
+                for link, reason, value in self.fleet.link_history_rows():
+                    self.history.record(
+                        schema.FLEET_LINK_SUSPECT.name,
+                        (("link", link), ("reason", reason)), value)
         # Delta-ingest self-metrics (ISSUE 7): frame mix, wire bytes,
         # resync rate, and how much of the fleet rides push vs pull.
         if self.delta is not None:

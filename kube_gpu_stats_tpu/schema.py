@@ -967,6 +967,72 @@ FLEET_WORST_TICK = MetricSpec(
     extra_labels=("target", "phase"),
 )
 
+# Interconnect-localization families (linkloc.py, ISSUE 19): the hub's
+# topology-aware ICI pass that names the sick LINK instead of accusing
+# the neighbor nodes that merely see its symptoms.
+
+FLEET_LINKS = MetricSpec(
+    "kts_fleet_links",
+    MetricType.GAUGE,
+    "ICI links in the modeled interconnect graph (torus adjacency from "
+    "the fleet's topology label, or the ring fallback over worker "
+    "ids). 0 means localization is inert — no parseable topology or a "
+    "sparse/non-numeric worker set; per-link verdicts can't exist "
+    "without a graph.",
+)
+FLEET_LINK_SUSPECT = MetricSpec(
+    "kts_fleet_link_suspect",
+    MetricType.GAUGE,
+    "1 while the localization pass accuses this ICI link: BOTH "
+    "endpoints' own per-link counters degraded below their baselines "
+    "together for consecutive refreshes, and no endpoint looks like a "
+    "whole-node fault (>= 2 sick edges). reason is the evidence trail "
+    "('ici-rate', plus '+anomaly-correlated' when the endpoints' "
+    "step/fetch/ici z-scores breached, plus '+host-counter-confirmed' "
+    "when PR 8's host NIC/IRQ signals corroborate). Falls to 0 on "
+    "recovery (the series persists as a tombstone so history lookback "
+    "sees the clear); detail at /debug/fleet under 'links' and in "
+    "`doctor --fleet`.",
+    extra_labels=("link", "reason"),
+)
+FLEET_LINK_BASELINE_BPS = MetricSpec(
+    "kts_fleet_link_baseline_bytes_per_second",
+    MetricType.GAUGE,
+    "Per-link rolling reference rate (EWMA across both endpoints' "
+    "views, warmup-gated, counter-reset tolerant) the localization "
+    "pass scores observations against. While a link is degraded the "
+    "reference folds 16x slower, so a sick link cannot drag its own "
+    "baseline down and self-clear.",
+    extra_labels=("link",),
+)
+FLEET_LINK_BASELINE_BAND = MetricSpec(
+    "kts_fleet_link_baseline_band_bytes_per_second",
+    MetricType.GAUGE,
+    "Per-link MAD tolerance band (robust sigma over the recent healthy "
+    "window, floored at 2% of the reference) around "
+    "kts_fleet_link_baseline_bytes_per_second. A link degrades when "
+    "both endpoints fall below baseline - max(6 * band, 25% of "
+    "baseline).",
+    extra_labels=("link",),
+)
+FLEET_LINK_OBSERVED_BPS = MetricSpec(
+    "kts_fleet_link_observed_bytes_per_second",
+    MetricType.GAUGE,
+    "Latest per-link ICI rate as the localization pass sees it: each "
+    "endpoint's accelerator_ici_link_bandwidth series mapped onto the "
+    "shared graph edge and averaged. Plot against the baseline/band "
+    "pair to watch a verdict form.",
+    extra_labels=("link",),
+)
+
+FLEET_LINK_METRICS: tuple[MetricSpec, ...] = (
+    FLEET_LINKS,
+    FLEET_LINK_SUSPECT,
+    FLEET_LINK_BASELINE_BPS,
+    FLEET_LINK_BASELINE_BAND,
+    FLEET_LINK_OBSERVED_BPS,
+)
+
 # History ring + /query serving families (history.py, ISSUE 18): the
 # hub's embedded lookback store and its read-admission layer.
 
@@ -1113,6 +1179,7 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     FLEET_SLO_BURN,
     FLEET_SLO_BAD,
     FLEET_WORST_TICK,
+    *FLEET_LINK_METRICS,
     *HISTORY_METRICS,
 )
 

@@ -29,6 +29,11 @@ class FakeLibtpuServer:
                                     # omitted from batched ("" selector)
                                     # responses, UNIMPLEMENTED when named
         server.reject_batch = True  # runtime predates the "" selector
+        server.ici_link_scale["x1"] = 0.1   # degrade one ICI link: its
+                                    # counter advances at 10% of the
+                                    # healthy step (link localization
+                                    # scenarios); counters stay
+                                    # cumulative across scale changes
 
     ``dialect`` selects the wire shape served (proto/tpumetrics.py module
     docstring): "flat" (round-1 shape, batched "" selector supported) or
@@ -63,6 +68,13 @@ class FakeLibtpuServer:
         self.uptime_base = 7200.0
         self.requests: list[str] = []
         self._ici_fetches = 0
+        # Per-link counter advance multiplier (healthy = absent = 1.0).
+        # Counters are integer ACCUMULATORS, not fetch * step: a scale
+        # change mid-run must bend the slope without ever moving a
+        # cumulative counter backwards (which exporters rightly treat
+        # as a runtime restart and drop the interval).
+        self.ici_link_scale: dict[str, float] = {}
+        self._ici_counters: dict[tuple[int, str], int] = {}
         self._lock = threading.Lock()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
         handler = grpc.method_handlers_generic_handler(
@@ -150,13 +162,16 @@ class FakeLibtpuServer:
             if metric == tpumetrics.ICI_TRAFFIC:
                 with self._lock:
                     self._ici_fetches += 1
-                    fetch = self._ici_fetches
-                for chip in self._chips():
-                    for li, link in enumerate(LINKS):
-                        counter = fetch * 1_000_000 * (chip + 1) * (li + 1)
-                        samples.append(
-                            tpumetrics.MetricSample(metric, chip, counter, link=link)
-                        )
+                    for chip in self._chips():
+                        for li, link in enumerate(LINKS):
+                            step = int(1_000_000 * (chip + 1) * (li + 1)
+                                       * self.ici_link_scale.get(link, 1.0))
+                            key = (chip, link)
+                            self._ici_counters[key] = (
+                                self._ici_counters.get(key, 0) + step)
+                            samples.append(tpumetrics.MetricSample(
+                                metric, chip, self._ici_counters[key],
+                                link=link))
             else:
                 for chip in self._chips():
                     samples.append(

@@ -751,3 +751,160 @@ def test_check_fleet_at_on_a_history_less_hub():
     finally:
         bare.stop()
         disabled.stop()
+
+
+# -- interconnect link verdicts (ISSUE 19) -----------------------------------
+
+def _link_rollup():
+    """A rollup mid link-incident: link 1-2 accused, its two endpoint
+    nodes showing exactly the symptoms the link explains."""
+    return {
+        "enabled": True,
+        "links": {
+            "graph": {"kind": "torus", "topology": "4x1",
+                      "nodes": 4, "links": 4},
+            "suspects": {
+                "1-2": {
+                    "reason": "ici-rate+anomaly-correlated"
+                              "+host-counter-confirmed",
+                    "endpoints": ["1", "2"],
+                    "targets": ["http://w1:9400/metrics",
+                                "http://w2:9400/metrics"],
+                    "since": 1000.0,
+                    "drop": 0.89,
+                    "observed_bps": 3.3e6,
+                    "baseline_bps": 3e7,
+                },
+            },
+            "baselines": {},
+        },
+        "targets": {
+            "http://w1:9400/metrics": {
+                "anomalous": {"ici": -7.2, "host_nic_drops": 9.0},
+                "signals": {},
+            },
+            "http://w2:9400/metrics": {
+                "anomalous": {"ici": -6.8, "steps": -4.1},
+                "signals": {},
+            },
+            "http://w0:9400/metrics": {"anomalous": {}, "signals": {}},
+        },
+        "anomalies": [],
+        "slo": {},
+    }
+
+
+def test_fleet_post_mortem_names_link_and_spares_neighbors():
+    """Tentpole acceptance sentence: the verdict names the shared LINK
+    (host-counter-confirmed, with the drop) and does NOT accuse the
+    endpoint nodes whose anomalies the link fully explains."""
+    status, detail, data = doctor.fleet_post_mortem(_link_rollup())
+    assert status == doctor.WARN
+    assert ("nodes 1,2 slow; shared ICI link 1-2 suspect, "
+            "host-counter-confirmed (89% below baseline)") in detail
+    assert "1-2" in data["link_suspects"]
+    # The innocent neighbors: explained, not accused.
+    assert data["anomalous"] == {}
+    assert data["link_explained"] == {
+        "http://w1:9400/metrics": "1-2",
+        "http://w2:9400/metrics": "1-2",
+    }
+    assert "http://w1:9400/metrics: ici" not in detail
+
+
+def test_fleet_post_mortem_link_does_not_absorb_unrelated_anomaly():
+    """An endpoint with an anomaly the link CANNOT explain (power) is
+    still accused — suppression is symptom-scoped, not node-scoped."""
+    payload = _link_rollup()
+    payload["targets"]["http://w1:9400/metrics"]["anomalous"] = {
+        "ici": -7.2, "power": 8.5}
+    status, detail, data = doctor.fleet_post_mortem(payload)
+    assert status == doctor.WARN
+    assert "shared ICI link 1-2 suspect" in detail
+    assert "http://w1:9400/metrics" in data["anomalous"]
+    assert "http://w1:9400/metrics" not in data["link_explained"]
+    # The other endpoint's symptoms are all link-shaped: still spared.
+    assert data["link_explained"] == {"http://w2:9400/metrics": "1-2"}
+
+
+def test_fleet_post_mortem_anomaly_correlated_without_host():
+    payload = _link_rollup()
+    payload["links"]["suspects"]["1-2"]["reason"] = \
+        "ici-rate+anomaly-correlated"
+    _status, detail, _data = doctor.fleet_post_mortem(payload)
+    assert "shared ICI link 1-2 suspect, anomaly-correlated" in detail
+    assert "host-counter-confirmed" not in detail
+
+
+def test_fleet_at_verdict_reads_link_suspect_from_ring_payload():
+    from kube_gpu_stats_tpu.doctor import OK, WARN, fleet_at_verdict
+
+    at = 1_700_000_000.0
+    links = {"series": [
+        {"labels": {"link": "1-2",
+                    "reason": "ici-rate+host-counter-confirmed"},
+         "v": 1.0, "t": at - 3.0},
+        # A cleared identity's tombstone must stay silent.
+        {"labels": {"link": "0-3", "reason": "ici-rate"},
+         "v": 0.0, "t": at - 3.0},
+    ]}
+    status, detail, data = fleet_at_verdict({}, {}, {}, at,
+                                            links_payload=links)
+    assert status == WARN
+    assert ("ICI link 1-2 was suspect "
+            "(ici-rate+host-counter-confirmed, as of") in detail
+    assert "0-3" not in detail
+    assert [e["link"] for e in data["links_suspect"]] == ["1-2"]
+    # Ring buckets hold the MEAN of their samples: a bucket where the
+    # suspect raised mid-bucket reads fractional, and still counts.
+    partial = {"series": [
+        {"labels": {"link": "1-2", "reason": "ici-rate"},
+         "v": 0.4, "t": at - 3.0}]}
+    status, detail, _data = fleet_at_verdict({}, {}, {}, at,
+                                             links_payload=partial)
+    assert status == WARN and "ICI link 1-2 was suspect" in detail
+    # All tombstones: clean verdict, not "no samples".
+    clean = {"series": [
+        {"labels": {"link": "1-2", "reason": "ici-rate"},
+         "v": 0.0, "t": at - 3.0}]}
+    status, detail, _data = fleet_at_verdict({}, {}, {}, at,
+                                             links_payload=clean)
+    assert status == OK and "fleet healthy" in detail
+
+
+def test_check_fleet_at_retroactive_link_suspect():
+    """Satellite 3: an already-cleared link fault is still localized
+    retroactively — `doctor --fleet --at <incident>` reads the suspect
+    row from the hub's history ring over real HTTP, while `--at now`
+    reads the recovery's tombstone as healthy."""
+    import time as time_mod
+
+    from kube_gpu_stats_tpu.doctor import WARN, check_fleet_at
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.history import HistoryStore
+    from kube_gpu_stats_tpu.registry import Registry
+
+    store = HistoryStore()
+    now = time_mod.time()
+    t0 = now - 600.0
+    reason = "ici-rate+anomaly-correlated+host-counter-confirmed"
+    store.record("kts_fleet_link_suspect",
+                 (("link", "1-2"), ("reason", reason)), 1.0)
+    store.commit(t0, 1)
+    # Incident over: the localizer's tombstone row.
+    store.record("kts_fleet_link_suspect",
+                 (("link", "1-2"), ("reason", reason)), 0.0)
+    store.commit(now, 2)
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           history_provider=store)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        past = check_fleet_at(base, t0)
+        assert past.status == WARN
+        assert f"ICI link 1-2 was suspect ({reason}" in past.detail
+        present = check_fleet_at(base, now)
+        assert "fleet healthy" in present.detail
+    finally:
+        server.stop()

@@ -995,3 +995,140 @@ def test_arm_hook_crash_does_not_kill_observe():
     for seq in range(8, 11):
         _observe(lens, seq, seq * 10.0, [target], [_row(target, duty=2.0)])
     assert "duty" in lens.rollup()["targets"][target]["anomalous"]
+
+
+# -- interconnect localization (ISSUE 19) ------------------------------------
+
+def _ici_digest(worker, rates, topology="4x1"):
+    return {"ici": {"links": dict(rates), "worker": worker,
+                    "topology": topology}}
+
+
+def _ring4_digests(targets, sick=(), sick_rate=3e6, rate=3e7):
+    """4 workers on a 4x1 torus, every local link at ``rate`` except
+    the (worker, label) views named in ``sick``."""
+    digests = {}
+    for i, target in enumerate(targets):
+        worker = str(i)
+        links = {
+            label: (sick_rate if (worker, label) in sick else rate)
+            for label in ("x0", "x1")
+        }
+        digests[target] = _ici_digest(worker, links)
+    return digests
+
+
+def test_digest_from_series_extracts_ici_links():
+    """Per-link ICI rates sum over the node's chips (chips share the
+    physical links) and carry the worker/topology graph identity."""
+    series = [
+        (schema.ICI_BANDWIDTH.name,
+         {"chip": "0", "link": "x0", "worker": "2",
+          "topology": "4x1"}, 1e6),
+        (schema.ICI_BANDWIDTH.name,
+         {"chip": "1", "link": "x0", "worker": "2",
+          "topology": "4x1"}, 2e6),
+        (schema.ICI_BANDWIDTH.name,
+         {"chip": "0", "link": "x1", "worker": "2",
+          "topology": "4x1"}, 5e6),
+        ("accelerator_up", {"chip": "0"}, 1.0),
+    ]
+    digest = digest_from_series(series)
+    assert digest["ici"] == {
+        "links": {"x0": 3e6, "x1": 5e6},
+        "worker": "2",
+        "topology": "4x1",
+    }
+
+
+def test_link_localizer_names_shared_link_not_endpoints():
+    """Tentpole acceptance shape, unit-scale: both endpoint views of
+    one edge collapse -> that edge (and only that edge) becomes the
+    suspect, with journal events on the raise."""
+    tracer = Tracer()
+    lens = FleetLens(tracer=tracer)
+    targets = [f"http://w{i}/metrics" for i in range(4)]
+    rows = [_row(t, worker=str(i)) for i, t in enumerate(targets)]
+    now = 0.0
+    for seq in range(1, 10):
+        now = seq * 10.0
+        _observe(lens, seq, now, targets, rows,
+                 digests=_ring4_digests(targets))
+    assert lens.rollup()["links"]["suspects"] == {}
+    # Link 1-2 degrades: worker 1 sees it as x1, worker 2 as x0.
+    sick = (("1", "x1"), ("2", "x0"))
+    for seq in range(10, 14):
+        now = seq * 10.0
+        _observe(lens, seq, now, targets, rows,
+                 digests=_ring4_digests(targets, sick=sick))
+    links = lens.rollup()["links"]
+    assert list(links["suspects"]) == ["1-2"]
+    verdict = links["suspects"]["1-2"]
+    assert verdict["reason"].startswith("ici-rate")
+    assert verdict["endpoints"] == ["1", "2"]
+    assert verdict["drop"] > 0.8
+    assert links["graph"] == {"kind": "torus", "topology": "4x1",
+                              "nodes": 4, "links": 4}
+    kinds = [e["kind"] for e in tracer.events()["events"]]
+    assert "fleet_link_suspect" in kinds
+    # The verdict's endpoints are explained targets for doctor's
+    # suppression pass.
+    assert lens.links.explained_targets() == {
+        targets[1]: "1-2", targets[2]: "1-2"}
+    # Gauges: suspect row at 1.0, the per-link baselines, link count.
+    builder = SnapshotBuilder()
+    lens.contribute(builder)
+    text = builder.build().render()
+    suspect = labeled(text, schema.FLEET_LINK_SUSPECT.name)
+    key = (("link", "1-2"), ("reason", verdict["reason"]))
+    assert suspect[key] == 1.0
+    assert values(text, schema.FLEET_LINKS.name) == [4.0]
+    baselines = labeled(text, schema.FLEET_LINK_BASELINE_BPS.name)
+    # Edge stats average the two endpoint views of the same wire.
+    assert baselines[(("link", "0-1"),)] == pytest.approx(3e7, rel=0.05)
+    # Recovery: verdict clears with a journal event and the suspect
+    # series drops to a 0.0 tombstone (history continuity).
+    for seq in range(14, 20):
+        now = seq * 10.0
+        _observe(lens, seq, now, targets, rows,
+                 digests=_ring4_digests(targets))
+    assert lens.rollup()["links"]["suspects"] == {}
+    kinds = [e["kind"] for e in tracer.events()["events"]]
+    assert "fleet_link_cleared" in kinds
+    rows_after = lens.link_history_rows()
+    assert ("1-2", verdict["reason"], 0.0) in rows_after
+    assert all(value == 0.0 for _l, _r, value in rows_after)
+
+
+def test_link_localizer_one_sided_view_never_accuses():
+    """Only ONE endpoint's view of the edge collapses (a local NIC/DMA
+    problem, not the shared link): no candidate, no suspect."""
+    lens = FleetLens()
+    targets = [f"http://w{i}/metrics" for i in range(4)]
+    rows = [_row(t, worker=str(i)) for i, t in enumerate(targets)]
+    for seq in range(1, 10):
+        _observe(lens, seq, seq * 10.0, targets, rows,
+                 digests=_ring4_digests(targets))
+    for seq in range(10, 16):
+        _observe(lens, seq, seq * 10.0, targets, rows,
+                 digests=_ring4_digests(targets, sick=(("1", "x1"),)))
+    assert lens.rollup()["links"]["suspects"] == {}
+
+
+def test_link_localizer_node_fault_blames_no_link():
+    """Every link incident to worker 1 collapses from both ends: the
+    common factor is the NODE, so accusing any single link would be
+    wrong — the disambiguation pass drops all of its candidate edges."""
+    lens = FleetLens()
+    targets = [f"http://w{i}/metrics" for i in range(4)]
+    rows = [_row(t, worker=str(i)) for i, t in enumerate(targets)]
+    for seq in range(1, 10):
+        _observe(lens, seq, seq * 10.0, targets, rows,
+                 digests=_ring4_digests(targets))
+    # Worker 1's whole interconnect is sick: its own two views AND the
+    # matching far-end views (0-1 seen from 0, 1-2 seen from 2).
+    sick = (("1", "x0"), ("1", "x1"), ("0", "x1"), ("2", "x0"))
+    for seq in range(10, 16):
+        _observe(lens, seq, seq * 10.0, targets, rows,
+                 digests=_ring4_digests(targets, sick=sick))
+    assert lens.rollup()["links"]["suspects"] == {}

@@ -1,6 +1,7 @@
-"""ICI counter delta/rate math (SURVEY.md §4 unit tier, §7 hard part d)."""
+"""ICI counter delta/rate math (SURVEY.md §4 unit tier, §7 hard part d)
+and the per-link baseline engine (ISSUE 19)."""
 
-from kube_gpu_stats_tpu.ici import RateTracker
+from kube_gpu_stats_tpu.ici import LinkBaselineEngine, RateTracker
 
 
 def test_first_sample_has_no_rate():
@@ -61,3 +62,139 @@ def test_link_name_churn_bounded():
     assert tracker.rate("dev0", "churn0", 200, 1001.0) == 100.0
     tracker.forget_device("dev0")
     assert tracker._last == {} and tracker._per_device == {}
+
+
+# -- counter wrap/restart pins (ISSUE 19 satellite 1) -----------------------
+
+
+def test_wraparound_never_emits_negative_or_spike_rate():
+    """A 64-bit counter wrapping appears as a smaller value — exactly
+    like a restart. The interval must be dropped: no negative rate, no
+    absurd positive spike from treating the wrap as a huge delta."""
+    rt = RateTracker()
+    near_max = 2**64 - 1000
+    rt.rate("0", "x0", near_max, now=1.0)
+    # Wrapped past zero: the raw value is now tiny.
+    assert rt.rate("0", "x0", 500, now=2.0) is None
+    # The post-wrap value is the new baseline; normal rates resume.
+    assert rt.rate("0", "x0", 1500, now=3.0) == 1000.0
+
+
+def test_restart_mid_stream_drops_exactly_one_interval():
+    rt = RateTracker()
+    rt.rate("0", "x0", 1_000_000, now=1.0)
+    assert rt.rate("0", "x0", 2_000_000, now=2.0) == 1_000_000.0
+    # Runtime restarted: counter rebased near zero.
+    assert rt.rate("0", "x0", 10_000, now=3.0) is None
+    assert rt.rate("0", "x0", 20_000, now=4.0) == 10_000.0
+
+
+def test_stale_device_forget_then_fresh_baseline():
+    """forget_device must clear ALL of the device's links; the next
+    observation of each is a first sample, never a rate against the
+    pre-departure counter."""
+    rt = RateTracker()
+    rt.rate("0", "x0", 100, now=1.0)
+    rt.rate("0", "y1", 5_000, now=1.0)
+    rt.rate("1", "x0", 100, now=1.0)
+    rt.forget_device("0")
+    assert rt.rate("0", "x0", 200, now=2.0) is None
+    assert rt.rate("0", "y1", 6_000, now=2.0) is None
+    # The other device's state is untouched.
+    assert rt.rate("1", "x0", 200, now=2.0) == 100.0
+
+
+# -- per-link baseline engine (ISSUE 19 tentpole) ---------------------------
+
+
+def _warm(engine, key, rate=3e7, samples=10, start=0.0):
+    now = start
+    for _ in range(samples):
+        now += 1.0
+        engine.observe(key, rate, now)
+    return now
+
+
+def test_engine_warmup_gates_flagging():
+    """A cold baseline degrades nothing — even a 90% drop inside the
+    warmup window stays unflagged."""
+    eng = LinkBaselineEngine(warmup=6)
+    eng.observe("0-1", 3e7, 1.0)
+    a = eng.observe("0-1", 3e6, 2.0)  # 90% drop, but only 2 samples
+    assert a is not None and not a.degraded
+
+
+def test_engine_degrades_and_hysteresis_clears():
+    eng = LinkBaselineEngine()
+    now = _warm(eng, "0-1")
+    a = eng.observe("0-1", 3e6, now + 1.0)
+    assert a.degraded and a.drop > 0.8
+    # Still degraded while the rate stays in the hole.
+    assert eng.observe("0-1", 3e6, now + 2.0).degraded
+    assert eng.degraded("0-1")
+    # Recovery to the reference clears (rate >= mean - gap/2).
+    a = eng.observe("0-1", 3e7, now + 3.0)
+    assert not a.degraded and not eng.degraded("0-1")
+
+
+def test_engine_degraded_baseline_does_not_self_clear():
+    """While degraded the reference folds 16x slower and the MAD
+    window freezes: a sick link sitting at 10% for many refreshes must
+    not drag its own baseline down to the sick rate and self-clear."""
+    eng = LinkBaselineEngine()
+    now = _warm(eng, "0-1")
+    last = None
+    for i in range(30):
+        last = eng.observe("0-1", 3e6, now + 1.0 + i)
+    assert last.degraded
+    assert last.mean > 1.5e7  # baseline still far above the sick rate
+
+
+def test_engine_counter_reset_is_a_noop_not_a_zero():
+    """RateTracker answers None for a reset interval; the engine must
+    treat that as 'no observation' — baseline intact, nothing flagged,
+    not a zero-rate reading (which WOULD look like total loss)."""
+    eng = LinkBaselineEngine()
+    now = _warm(eng, "0-1")
+    snap_before = eng.snapshot()["0-1"]
+    assert eng.observe("0-1", None, now + 1.0) is None
+    snap_after = eng.snapshot()["0-1"]
+    assert snap_after["mean"] == snap_before["mean"]
+    assert snap_after["samples"] == snap_before["samples"]
+    assert not snap_after["degraded"]
+    # The next real rate scores against the preserved baseline.
+    assert eng.observe("0-1", 3e7, now + 2.0).degraded is False
+
+
+def test_engine_mad_band_absorbs_jitter():
+    """Operational jitter around the reference (within the MAD band /
+    drop-fraction floor) never flags; only a real collapse does."""
+    eng = LinkBaselineEngine()
+    rates = [3e7 * (1.0 + 0.02 * ((i % 5) - 2)) for i in range(20)]
+    now = 0.0
+    for rate in rates:
+        now += 1.0
+        a = eng.observe("0-1", rate, now)
+        assert not a.degraded
+    assert eng.observe("0-1", 3e7 * 0.5, now + 1.0).degraded
+
+
+def test_engine_link_budget_capped():
+    eng = LinkBaselineEngine()
+    eng.MAX_LINKS = 8
+    for i in range(20):
+        eng.observe(f"link{i}", 1.0, float(i + 1))
+    assert len(eng._links) == 8
+    assert eng.observe("link19", 2.0, 100.0) is None
+
+
+def test_engine_sweep_forgets_stale_links():
+    eng = LinkBaselineEngine()
+    _warm(eng, "0-1", start=0.0)
+    _warm(eng, "2-3", start=500.0)
+    removed = eng.sweep(now=600.0, max_age=300.0)
+    assert removed == ["0-1"]
+    assert "0-1" not in eng.snapshot() and "2-3" in eng.snapshot()
+    # A swept link re-seeds from scratch (fresh warmup).
+    a = eng.observe("0-1", 3e6, 601.0)
+    assert a.samples == 1 and not a.degraded

@@ -155,3 +155,94 @@ def test_metadata_empty_worker_attribute_falls_back_to_tpu_env(tmp_path):
         srv.server_close()
     assert out["worker"] == "3"
     assert out["topology"] == "8x8x4"
+
+
+# -- interconnect graph (ISSUE 19) ------------------------------------------
+
+
+def test_parse_topology():
+    from kube_gpu_stats_tpu.topology import parse_topology
+
+    assert parse_topology("4x4x4") == (4, 4, 4)
+    assert parse_topology("2x2") == (2, 2)
+    assert parse_topology("16x16") == (16, 16)
+    # Accelerator types, empties, malformed strings: None (ring
+    # fallback), never an exception.
+    assert parse_topology("v5p-128") is None
+    assert parse_topology("") is None
+    assert parse_topology("8") is None
+    assert parse_topology("4x0") is None
+    assert parse_topology("4x-2") is None
+
+
+def test_link_name_is_numeric_aware():
+    from kube_gpu_stats_tpu.topology import link_name
+
+    assert link_name("2", "10") == "2-10"
+    assert link_name("10", "2") == "2-10"
+    assert link_name("b", "a") == "a-b"
+
+
+def test_torus_graph_adjacency():
+    from kube_gpu_stats_tpu.topology import InterconnectGraph
+
+    g = InterconnectGraph([str(i) for i in range(8)], "2x2x2")
+    assert g.kind == "torus"
+    # 2x2x2: every axis has size 2 — wrap links would duplicate the
+    # direct pair, so each node has exactly 3 neighbors (12 edges).
+    assert len(g.links()) == 12
+    assert g.neighbors("0") == ["1", "2", "4"]
+    assert g.endpoints("0-4") == ("0", "4")
+
+
+def test_torus_wraparound_only_above_size_two():
+    from kube_gpu_stats_tpu.topology import InterconnectGraph
+
+    g = InterconnectGraph([str(i) for i in range(4)], "4x1")
+    assert g.kind == "torus"
+    # Size-4 axis wraps: ring 0-1-2-3-0.
+    assert g.links() == ["0-1", "0-3", "1-2", "2-3"]
+
+
+def test_ring_fallback_without_parseable_topology():
+    from kube_gpu_stats_tpu.topology import InterconnectGraph
+
+    g = InterconnectGraph(["0", "1", "2", "3"], "v5p-128")
+    assert g.kind == "ring"
+    assert g.links() == ["0-1", "0-3", "1-2", "2-3"]
+
+
+def test_sparse_or_nonnumeric_workers_go_edgeless():
+    from kube_gpu_stats_tpu.topology import InterconnectGraph
+
+    # Sparse ids (worker 2 missing): guessing adjacency would accuse
+    # the wrong pair — the graph goes inert instead.
+    assert InterconnectGraph(["0", "1", "3"], "").links() == []
+    assert InterconnectGraph(["a", "b"], "").links() == []
+    assert InterconnectGraph([], "").links() == []
+    assert InterconnectGraph(["0"], "").links() == []
+
+
+def test_edge_for_maps_local_labels_to_shared_edges():
+    from kube_gpu_stats_tpu.topology import InterconnectGraph
+
+    g = InterconnectGraph([str(i) for i in range(4)], "4x1")
+    # Worker 1's +x neighbor and worker 2's -x neighbor are the SAME
+    # physical link — both local labels map to one canonical edge.
+    assert g.edge_for("1", "x1") == "1-2"
+    assert g.edge_for("2", "x0") == "1-2"
+    # Wraparound edge.
+    assert g.edge_for("0", "x0") == "0-3"
+    # Labels off the grid or outside the axis convention: no edge.
+    assert g.edge_for("0", "y0") is None   # axis 1 has size 1
+    assert g.edge_for("0", "z1") is None
+    assert g.edge_for("0", "bogus") is None
+    assert g.edge_for("9", "x0") is None   # unknown worker
+
+
+def test_describe_shape():
+    from kube_gpu_stats_tpu.topology import InterconnectGraph
+
+    g = InterconnectGraph([str(i) for i in range(4)], "2x2")
+    assert g.describe() == {"kind": "torus", "topology": "2x2",
+                            "nodes": 4, "links": 4}

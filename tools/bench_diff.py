@@ -81,6 +81,10 @@ PINNED = {
     # hit ratio falling means the stampede-proofing regressed.
     "query_p99_ms_256readers": +1,
     "scrape_304_ratio": -1,
+    # ISSUE 19: the interconnect-localization pass runs under the
+    # FleetLens lock on the hub's refresh thread — its cost is refresh
+    # latency, so a rise is a regression.
+    "fleet_localize_ms": +1,
 }
 
 

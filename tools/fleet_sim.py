@@ -2,19 +2,29 @@
 """Fleet-lens simulation smoke (ISSUE 5 satellite, `make fleet-sim`):
 spin N REAL daemons (full Daemon wiring: TPU backend over make_sysfs +
 FakeLibtpuServer, FakeKubelet-backed PodResources attribution) plus one
-hub scraping all of them, inject a straggler (a scripted RPC delay on
-one node's fake runtime), and assert the fleet lens attributes the
-slowness to that node — end to end through the daemons' self-exported
-flight-recorder digests, the hub's /debug/fleet, and
-`doctor --fleet`'s post-mortem.
+hub scraping all of them, and run two fault-injection scenarios:
 
-Exit 0 with a PASS line when the guilty node is named; exit 1 with the
-evidence otherwise. Wired into `make ci` as a smoke job.
+- **straggler**: a scripted RPC delay on one node's fake runtime; the
+  fleet lens must attribute the slowness to that node — end to end
+  through the daemons' self-exported flight-recorder digests, the
+  hub's /debug/fleet, and `doctor --fleet`'s post-mortem.
+- **link** (ISSUE 19): one ICI link between two HEALTHY nodes degrades
+  (both endpoints' fake counters slow to 10% on the labels that map to
+  the shared edge, with injected NIC drops on both hosts as the
+  host-side corroboration); `doctor --fleet` must name the LINK —
+  host-counter-confirmed — and accuse ZERO nodes (the endpoints are
+  innocent neighbors), then after recovery `doctor --fleet --at` must
+  still localize the cleared fault retroactively out of the hub's
+  history ring.
+
+Exit 0 with PASS lines when every scenario's verdict is right; exit 1
+with the evidence otherwise. Wired into `make ci` as a smoke job.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import pathlib
 import sys
 import tempfile
@@ -140,6 +150,202 @@ def run(nodes: int, refreshes: int, delay: float, verbose: bool) -> int:
                 fake.stop()
 
 
+def run_link(nodes: int, verbose: bool) -> int:
+    """ISSUE 19 scenario: degrade ONE ICI link between two healthy
+    nodes and assert the doctor names the link, not the neighbors."""
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.history import HistoryStore
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.testing.host_fixture import (make_host_tree,
+                                                         write_nic)
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    # Ring 0-1-2-3(-0) from KTS_TOPOLOGY=4x1: worker 1's local "x1"
+    # and worker 2's local "x0" are the SAME physical link 1-2 — the
+    # one this scenario degrades on both ends.
+    sick = ("1", "2")
+    sick_link = "1-2"
+    daemons: list = []
+    fakes: list = []
+    libtpus: list = []
+    roots: list = []
+    hub = None
+    hub_server = None
+    env_keys = ("KTS_SLICE", "KTS_WORKER", "KTS_TOPOLOGY")
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            targets = []
+            for node in range(nodes):
+                root = pathlib.Path(tmp) / f"link{node}"
+                roots.append(root)
+                make_sysfs(root / "sys", num_chips=2)
+                # Host evidence (PR 8/10): PSI/cgroup fixtures under a
+                # separate host tree; the NIC statistics live in the
+                # SAME sysfs root the TPU collector uses (one
+                # sysfs_root serves both readers).
+                host = make_host_tree(root / "host")
+                write_nic(root / "sys")
+                libtpu = FakeLibtpuServer(num_chips=2).start()
+                libtpus.append(libtpu)
+                socket = str(root / "kubelet.sock")
+                kubelet = FakeKubeletServer(
+                    socket, [tpu_pod(f"train-{node}", "ml", "worker",
+                                     ["0", "1"])]).start()
+                fakes.extend([libtpu, kubelet])
+                cfg = Config(
+                    backend="tpu",
+                    sysfs_root=str(root / "sys"),
+                    libtpu_ports=(libtpu.port,),
+                    interval=0.1,
+                    deadline=2.0,
+                    listen_host="127.0.0.1",
+                    listen_port=0,
+                    attribution="podresources",
+                    kubelet_socket=socket,
+                    attribution_interval=0.5,
+                    pipeline_fetch=False,
+                    use_native=False,
+                    proc_root=str(host["proc"]),
+                    cgroup_root=str(host["cgroup"]),
+                )
+                # The daemon reads its slice/worker/topology identity
+                # from the environment at construction — exactly how
+                # the DaemonSet injects it in production.
+                os.environ["KTS_SLICE"] = "sim"
+                os.environ["KTS_WORKER"] = str(node)
+                os.environ["KTS_TOPOLOGY"] = f"{nodes}x1"
+                daemon = Daemon(cfg)
+                daemon.start()
+                daemons.append(daemon)
+                targets.append(
+                    f"http://127.0.0.1:{daemon.server.port}/metrics")
+            for daemon in daemons:
+                daemon.registry.wait_for_publish(0, timeout=10)
+
+            history = HistoryStore()
+            hub = Hub(targets, interval=0.2, expect_workers=nodes,
+                      history=history)
+            hub_server = MetricsServer(
+                hub.registry, host="127.0.0.1", port=0,
+                trace_provider=hub.tracer, fleet_provider=hub.fleet,
+                history_provider=history)
+            hub_server.start()
+            base = f"http://127.0.0.1:{hub_server.port}"
+
+            # Phase 1 — healthy warmup: per-endpoint link baselines
+            # need their warmup samples, host baselines their
+            # min-sample count, before any verdict may fire.
+            for _ in range(10):
+                time.sleep(0.3)
+                hub.refresh_once()
+            if hub.fleet.links.suspects():
+                print("fleet-sim(link) FAIL: suspect raised during "
+                      f"healthy warmup: {hub.fleet.links.suspects()}")
+                return 1
+
+            # Phase 2 — degrade link 1-2 on BOTH ends (each endpoint's
+            # own counter slows on the label that maps to the shared
+            # edge), with NIC drops rising on both hosts as the
+            # corroborating host-side evidence.
+            libtpus[1].ici_link_scale["x1"] = 0.1
+            libtpus[2].ici_link_scale["x0"] = 0.1
+            drops = {w: 0 for w in sick}
+            for _ in range(6):
+                for _tick in range(3):
+                    time.sleep(0.1)
+                    for w in sick:
+                        drops[w] += 2000
+                        write_nic(roots[int(w)] / "sys",
+                                  rx_dropped=drops[w])
+                hub.refresh_once()
+            incident_ts = time.time()
+
+            result = doctor.check_fleet(base)
+            if verbose:
+                print(f"[{result.status}] fleet  {result.detail}")
+            data = result.data or {}
+            suspects = data.get("link_suspects") or {}
+            verdict = suspects.get(sick_link) or {}
+            reason = verdict.get("reason", "")
+            accused = data.get("anomalous") or {}
+            text = hub.registry.snapshot().render()
+            gauge_names_link = any(
+                line.startswith("kts_fleet_link_suspect")
+                and f'link="{sick_link}"' in line
+                and line.rstrip().endswith(" 1")
+                for line in text.splitlines())
+            ok = (sick_link in suspects
+                  and "host-counter-confirmed" in reason
+                  and not accused
+                  and gauge_names_link)
+            if not ok:
+                print("fleet-sim(link) FAIL:")
+                print(f"  expected link {sick_link} suspect, "
+                      f"host-counter-confirmed, zero node accusations")
+                print(f"  suspects: {suspects}")
+                print(f"  accused nodes: {accused}")
+                print(f"  gauge named link: {gauge_names_link}")
+                print(f"  doctor detail: {result.detail}")
+                return 1
+
+            # Phase 3 — repair the link, let the verdict clear.
+            libtpus[1].ici_link_scale.clear()
+            libtpus[2].ici_link_scale.clear()
+            cleared = False
+            for _ in range(10):
+                time.sleep(0.3)
+                hub.refresh_once()
+                if not hub.fleet.links.suspects():
+                    cleared = True
+                    break
+            if not cleared:
+                print("fleet-sim(link) FAIL: suspect never cleared "
+                      f"after repair: {hub.fleet.links.suspects()}")
+                return 1
+
+            # Phase 4 — retroactive post-mortem of the ALREADY-CLEARED
+            # fault out of the hub's history ring.
+            at_result = doctor.check_fleet_at(base, incident_ts)
+            if verbose:
+                print(f"[{at_result.status}] fleet-at  "
+                      f"{at_result.detail}")
+            at_links = [entry.get("link") for entry in
+                        (at_result.data or {}).get("links_suspect") or []]
+            if sick_link not in at_links:
+                print("fleet-sim(link) FAIL: doctor --fleet --at did "
+                      f"not localize the cleared fault retroactively")
+                print(f"  links_suspect: {at_links}")
+                print(f"  detail: {at_result.detail}")
+                return 1
+
+            print(f"fleet-sim(link) PASS: doctor --fleet named ICI "
+                  f"link {sick_link} ({reason}), accused zero nodes, "
+                  f"and --at localized the cleared fault "
+                  f"retroactively across {nodes} nodes")
+            return 0
+        finally:
+            for key, value in saved_env.items():
+                if value is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = value
+            if hub_server is not None:
+                hub_server.stop()
+            if hub is not None:
+                hub.stop()
+            for daemon in daemons:
+                daemon.stop()
+            for fake in fakes:
+                fake.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=3)
@@ -149,9 +355,20 @@ def main(argv=None) -> int:
                              "fake runtime (the straggler); far above "
                              "any cold-start read so attribution is "
                              "unambiguous")
+    parser.add_argument("--link-nodes", type=int, default=4,
+                        help="ring size for the link-degradation "
+                             "scenario (the sick link needs healthy "
+                             "neighbors on both sides)")
+    parser.add_argument("--scenario", choices=("all", "straggler",
+                                               "link"), default="all")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
-    return run(args.nodes, args.refreshes, args.delay, args.verbose)
+    rc = 0
+    if args.scenario in ("all", "straggler"):
+        rc = run(args.nodes, args.refreshes, args.delay, args.verbose)
+    if rc == 0 and args.scenario in ("all", "link"):
+        rc = run_link(args.link_nodes, args.verbose)
+    return rc
 
 
 if __name__ == "__main__":
