@@ -47,7 +47,7 @@ chaos: native
 bench: native
 	python bench.py
 
-# Fleet-lens smoke (<60 s), two scenarios, both inside `make ci`:
+# Fleet-lens smoke, three scenarios, all inside `make ci`:
 # straggler — N real daemons (fake libtpu + FakeKubelet attribution) +
 # one hub; injects a straggler via a scripted RPC delay and asserts
 # `doctor --fleet` names the guilty node with its phase and blamed
@@ -55,6 +55,10 @@ bench: native
 # fake runtimes (+ NIC drops on both hosts) and asserts the doctor
 # names the LINK host-counter-confirmed, accuses zero endpoint nodes,
 # and replays the verdict retroactively via `--at` after recovery.
+# waste — parks one pod's chips at duty ~0 and asserts
+# `doctor --efficiency` names it (and only it) off the signed
+# energy/waste attestation, the verdict clears with a journal event on
+# recovery, and `--at` replays the incident from the history ring.
 fleet-sim:
 	python tools/fleet_sim.py --verbose
 

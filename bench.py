@@ -201,6 +201,20 @@ def _linkloc_fields(line: dict) -> None:
         line["fleet_localize_ms"] = loc["fleet_localize_ms"]
 
 
+def _efficiency_fields(line: dict) -> None:
+    """Waste-scoring pass cost (ISSUE 20): median EfficiencyLens.observe
+    wall time over a 64-pod fold (EWMA scoring, verdict streaks, one
+    idle reservation raising and clearing mid-run, one UNKNOWN pod).
+    Runs under the FleetLens lock on the refresh thread, so this is
+    refresh latency — pinned against drift by bench_diff."""
+    from kube_gpu_stats_tpu.bench import measure_efficiency_score
+
+    eff = measure_efficiency_score()
+    if eff is not None:
+        line["fleet_efficiency_ms_per_refresh"] = eff[
+            "fleet_efficiency_ms_per_refresh"]
+
+
 def _query_fields(line: dict) -> None:
     """Dashboard read-path figures (ISSUE 18): /query latency under 256
     keep-alive readers against a live-refreshing hub, the /metrics 304
@@ -310,6 +324,7 @@ def _quick() -> int:
     _host_fields(line)
     _cardinality_fields(line)
     _linkloc_fields(line)
+    _efficiency_fields(line)
     _query_fields(line)
     print(json.dumps(line))
     sys.stdout.flush()
@@ -430,6 +445,7 @@ def main() -> int:
     _host_fields(line)
     _cardinality_fields(line)
     _linkloc_fields(line)
+    _efficiency_fields(line)
     _query_fields(line)
     print(json.dumps(line))
     # Guarantee exit: a wedged chip tunnel can leave a daemon thread (or

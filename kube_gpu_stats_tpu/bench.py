@@ -2004,3 +2004,60 @@ def measure_fleet_localize(workers: int = 64,
         return {"fleet_localize_ms": round(statistics.median(walls), 3)}
     except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
         return None
+
+
+def measure_efficiency_score(workers: int = 64,
+                             refreshes: int = 60) -> dict | None:
+    """Waste-scoring pass cost (ISSUE 20): median wall time of one
+    EfficiencyLens.observe over a 64-pod fleet (duty/power/steps/joules
+    EWMA folds, verdict streaks, ranking bookkeeping). Like the link
+    localizer this runs under the FleetLens lock on the hub's refresh
+    thread, so its cost is refresh latency.
+
+    Deterministic: evidence carries index-derived jitter (no RNG), one
+    pod parks idle mid-run so a real verdict raises and clears (journal
+    events + tombstone rows on the measured path), and one pod rides
+    blind (UNKNOWN gate exercised). Returns
+    {"fleet_efficiency_ms_per_refresh": ...} or None, never raises."""
+    try:
+        from . import efficiency
+
+        lens = efficiency.EfficiencyLens(warmup_refreshes=5,
+                                         idle_refreshes=4)
+        keys = [(f"train-{i}", "ml") for i in range(workers)]
+
+        def evidence(r: int, idle: bool) -> dict:
+            pods = {}
+            for i, key in enumerate(keys):
+                if i == workers - 1:
+                    # The blind pod: no duty evidence, zero coverage —
+                    # the UNKNOWN gate is on the measured path.
+                    pods[key] = {"duty": None, "power": None,
+                                 "steps": None, "chips": 4,
+                                 "joules": None, "coverage": 0.0}
+                    continue
+                duty = 60.0 + ((i * 31 + r * 17) % 13)
+                steps = 5.0 + ((i * 7 + r * 3) % 5) * 0.25
+                if idle and i == 0:
+                    # Mid-run idle reservation on pod 0: verdict forms
+                    # and clears inside the measured window.
+                    duty, steps = 0.0, 0.0
+                pods[key] = {"duty": duty, "power": 4.0 * duty,
+                             "steps": steps, "chips": 4,
+                             "joules": 1000.0 * i + 40.0 * r,
+                             "coverage": 0.9}
+            return pods
+
+        now = 1_000_000.0
+        walls = []
+        for r in range(refreshes):
+            pods = evidence(r, idle=refreshes // 3 < r
+                            < 2 * refreshes // 3)
+            start = time.perf_counter()
+            lens.observe(r + 1, now, pods)
+            walls.append((time.perf_counter() - start) * 1000.0)
+            now += 10.0
+        return {"fleet_efficiency_ms_per_refresh":
+                round(statistics.median(walls), 3)}
+    except Exception:  # noqa: BLE001 - an extra datum, never a bench failure
+        return None

@@ -490,6 +490,60 @@ def validate_history_args(args) -> str | None:
     return None
 
 
+def add_efficiency_flags(p: argparse.ArgumentParser) -> None:
+    """The hub's fleet-efficiency scoring knobs (ISSUE 20): waste
+    verdicts (idle-reservation / low-goodput), the top-K ranking bound,
+    and the /debug/efficiency attestation switch. Defaults live in
+    efficiency.py so the flag surface and the engine cannot drift."""
+    from .efficiency import (DEFAULT_IDLE_DUTY, DEFAULT_IDLE_REFRESHES,
+                             DEFAULT_TOP_K, DEFAULT_WARMUP_REFRESHES)
+
+    p.add_argument("--no-efficiency", action="store_true",
+                   default=_env("NO_EFFICIENCY", "") == "1",
+                   help="disable fleet efficiency scoring: no "
+                        "kts_fleet_efficiency_*/kts_fleet_waste_* "
+                        "families, no waste journal events, and "
+                        "/debug/efficiency answers enabled:false")
+    p.add_argument("--waste-warmup-refreshes", type=int,
+                   default=int(_env("WASTE_WARMUP_REFRESHES",
+                                    str(DEFAULT_WARMUP_REFRESHES))),
+                   help="refreshes a pod must be observed before any "
+                        "waste verdict may form — the grace a "
+                        "legitimately-starting pod (model loading, "
+                        "compilation) gets before idle chips count "
+                        "against it")
+    p.add_argument("--waste-idle-refreshes", type=int,
+                   default=int(_env("WASTE_IDLE_REFRESHES",
+                                    str(DEFAULT_IDLE_REFRESHES))),
+                   help="consecutive refreshes the idle-reservation / "
+                        "low-goodput shape must hold before the verdict "
+                        "raises (and journals fleet_waste)")
+    p.add_argument("--waste-idle-duty", type=float,
+                   default=float(_env("WASTE_IDLE_DUTY",
+                                      str(DEFAULT_IDLE_DUTY))),
+                   help="duty-cycle points at or below which a "
+                        "chip-holding pod counts as idle")
+    p.add_argument("--waste-top-k", type=int,
+                   default=int(_env("WASTE_TOP_K", str(DEFAULT_TOP_K))),
+                   help="per-pod efficiency/waste series exported on "
+                        "/metrics are bounded to the K worst offenders "
+                        "(the full ledger rides /debug/fleet)")
+
+
+def validate_efficiency_args(args) -> str | None:
+    """Range rules for the efficiency flags; the hub parser surfaces
+    the string through parser.error."""
+    if args.waste_warmup_refreshes < 1:
+        return "--waste-warmup-refreshes must be >= 1"
+    if args.waste_idle_refreshes < 1:
+        return "--waste-idle-refreshes must be >= 1"
+    if args.waste_idle_duty < 0 or args.waste_idle_duty > 100:
+        return "--waste-idle-duty must be 0..100 duty points"
+    if args.waste_top_k < 1:
+        return "--waste-top-k must be >= 1"
+    return None
+
+
 def validate_cardinality_args(args) -> str | None:
     """Range rules for the cardinality admission flags; the hub parser
     surfaces the string through parser.error."""

@@ -1617,6 +1617,151 @@ def check_fleet_at(base: str, at_ts: float) -> CheckResult:
     return _result("fleet-at", status, detail, data=data)
 
 
+def check_efficiency(base: str, audit_key: str) -> CheckResult:
+    """--efficiency: read the hub's /debug/efficiency energy/waste
+    attestation, verify its HMAC with the locally configured
+    --energy-audit-key (the same PR 7 contract as --energy: OK
+    verified, FAIL on tamper or a wrong key, WARN unsigned), and name
+    the pods the hub is accusing of wasting chips right now."""
+    import urllib.error
+
+    from .energy import verify_payload
+
+    try:
+        payload = _fetch_json(base + "/debug/efficiency")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "efficiency", WARN,
+                f"{base}/debug/efficiency requires authentication "
+                f"(HTTP {exc.code}); the attestation sits behind the "
+                f"hub's basic-auth gate by design")
+        if exc.code == 404:
+            return _result(
+                "efficiency", WARN,
+                f"{base}: no /debug/efficiency (hub predates the "
+                f"efficiency lens, or runs --no-fleet-lens)")
+        return _result("efficiency", FAIL,
+                       f"{base}/debug/efficiency: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable hub, bad JSON
+        return _result(
+            "efficiency", FAIL,
+            f"{base}: efficiency attestation unreadable ({exc})")
+    if not payload.get("enabled", True):
+        return _result(
+            "efficiency", WARN,
+            "efficiency scoring disabled on the hub (--no-efficiency); "
+            "no waste ledger to attest")
+    totals = payload.get("totals") or {}
+    waste = payload.get("waste") or {}
+    suspects = waste.get("suspects") or {}
+    summary = (f"{totals.get('leaves', 0)} leaf energy digest(s) "
+               f"({totals.get('leaves_signed', 0)} signed), "
+               f"{totals.get('joules', 0.0):.1f} J attributed, "
+               f"{len(suspects)} waste suspect(s)")
+    data = {"attestation": payload}
+    if not audit_key:
+        return _result(
+            "efficiency", WARN,
+            f"{summary}; attestation NOT verified (no "
+            f"--energy-audit-key configured locally)", data=data)
+    if not payload.get("signed") or "hmac" not in payload:
+        return _result(
+            "efficiency", FAIL,
+            f"{summary}; hub serves an UNSIGNED attestation but a "
+            f"local audit key is configured — the energy/waste rollup "
+            f"is not attestable", data=data)
+    if not verify_payload(payload, audit_key):
+        return _result(
+            "efficiency", FAIL,
+            f"{summary}; attestation signature DOES NOT VERIFY — "
+            f"payload tampered in flight, or the hub holds a different "
+            f"audit key", data=data)
+    if suspects:
+        names = "; ".join(
+            f"{name}: {info.get('reason')} "
+            f"({info.get('chips', 0)} chip(s))"
+            for name, info in sorted(suspects.items()))
+        return _result(
+            "efficiency", WARN,
+            f"{summary}; signature verified; wasting now: {names}",
+            data=data)
+    return _result("efficiency", OK, f"{summary}; signature verified",
+                   data=data)
+
+
+def efficiency_at_verdict(waste_payload: dict,
+                          at_ts: float) -> tuple[str, str, dict]:
+    """(status, detail, data) for a retroactive "who was wasting chips"
+    read at ``at_ts`` from the ring's kts_fleet_waste_suspect rows.
+    Ring buckets hold sample MEANS, so any positive value means the pod
+    was accused for part of the bucket; the 0.0 tombstones the recovery
+    wrote keep later buckets reading clean. Pure so the waste scenario
+    drives it on canned payloads too."""
+    data: dict = {"at": at_ts, "waste_suspects": []}
+    parts: list[str] = []
+    status = OK
+    for entry in waste_payload.get("series") or []:
+        if float(entry.get("v", 0.0)) <= 0.0:
+            continue
+        labels = entry.get("labels") or {}
+        pod = labels.get("pod", "")
+        namespace = labels.get("namespace", "")
+        reason = labels.get("reason", "")
+        sample_ts = float(entry.get("t", at_ts))
+        status = WARN
+        data["waste_suspects"].append(
+            {"pod": pod, "namespace": namespace, "reason": reason,
+             "sample_ts": sample_ts})
+        parts.append(f"{namespace}/{pod} was wasting chips ({reason}, "
+                     f"as of {_ts(sample_ts)})")
+    if not waste_payload.get("series"):
+        return (WARN,
+                f"history has no waste samples near {_ts(at_ts)} — the "
+                f"ring holds 1h/24h/7d tiers from THIS hub boot only "
+                f"(it intentionally does not survive a restart)", data)
+    if not parts:
+        parts.append(f"no pod was wasting chips at {_ts(at_ts)} in the "
+                     f"nearest samples")
+    return status, "; ".join(parts), data
+
+
+def check_efficiency_at(base: str, at_ts: float) -> CheckResult:
+    """--efficiency --at: replay the waste verdict from the hub's
+    history ring at a past timestamp (one /query?at= read of the
+    kts_fleet_waste_suspect rows the hub records every publish)."""
+    import urllib.error
+
+    try:
+        payload = _fetch_json(
+            f"{base}/query?family=kts_fleet_waste_suspect&at={at_ts}")
+    except urllib.error.HTTPError as exc:
+        if exc.code in (401, 403):
+            return _result(
+                "efficiency-at", WARN,
+                f"{base}/query requires authentication "
+                f"(HTTP {exc.code}); /query sits behind the hub's "
+                f"basic-auth gate by design")
+        if exc.code == 404:
+            # Unknown family 404s too (no waste row ever recorded) —
+            # the no-samples verdict covers it.
+            payload = {}
+        else:
+            return _result("efficiency-at", FAIL,
+                           f"{base}/query: HTTP {exc.code}")
+    except Exception as exc:  # noqa: BLE001 - unreachable hub
+        return _result("efficiency-at", FAIL,
+                       f"{base}: history unreadable ({exc})")
+    if payload.get("enabled") is False:
+        return _result(
+            "efficiency-at", WARN,
+            f"{base}: history disabled (hub runs --no-history or "
+            f"predates the history ring) — --at has nothing to replay "
+            f"from")
+    status, detail, data = efficiency_at_verdict(payload, at_ts)
+    return _result("efficiency-at", status, detail, data=data)
+
+
 def check_url(target: str) -> list[CheckResult]:
     """Both --url rows — scrape contract + live breaker state — off ONE
     fetch: a node being diagnosed precisely because it is degraded must
@@ -1804,7 +1949,9 @@ def run_checks(cfg: Config, url: str = "",
                skew: bool = False,
                stores: bool = False,
                cardinality: bool = False,
-               fleet_at: float | None = None) -> list[CheckResult]:
+               fleet_at: float | None = None,
+               efficiency: bool = False,
+               efficiency_at: float | None = None) -> list[CheckResult]:
     probes: list[tuple[str, Callable[[], object]]] = [
         ("native", lambda: check_native(cfg)),
         ("sysfs", lambda: check_sysfs(cfg)),
@@ -1901,6 +2048,25 @@ def run_checks(cfg: Config, url: str = "",
                            lambda: check_fleet_at(fleet_base, fleet_at)))
         else:
             probes.append(("fleet", lambda: check_fleet(fleet_base)))
+    if efficiency:
+        # The efficiency attestation lives on the HUB like the fleet
+        # lens; same base fallback (9401, hub.DEFAULT_PORT). The local
+        # --energy-audit-key verifies the rollup's HMAC — the same key
+        # contract as --energy.
+        from .hub import DEFAULT_PORT as _EFF_HUB_PORT
+
+        eff_base = (trace_base(url)
+                    if url.startswith(("http://", "https://"))
+                    else f"http://127.0.0.1:{_EFF_HUB_PORT}")
+        if efficiency_at is not None:
+            # --at: who was wasting chips during the incident — read
+            # from the ring, not the live ledger.
+            probes.append(("efficiency-at",
+                           lambda: check_efficiency_at(eff_base,
+                                                       efficiency_at)))
+        else:
+            probes.append(("efficiency", lambda: check_efficiency(
+                eff_base, cfg.energy_audit_key)))
     results: list[CheckResult] = []
     for name, probe in probes:
         results.extend(_bounded(name, probe))
@@ -1954,6 +2120,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     as_json = False
     trace = False
     fleet = False
+    efficiency = False
     energy = False
     host = False
     egress = False
@@ -1975,6 +2142,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             cardinality = True
         elif token == "--fleet":
             fleet = True
+        elif token == "--efficiency":
+            efficiency = True
         elif token == "--energy":
             energy = True
         elif token == "--host":
@@ -2010,23 +2179,30 @@ def main(argv: Sequence[str] | None = None) -> int:
         else:
             args.append(token)
     fleet_at = None
+    efficiency_at = None
     if at_raw:
-        if not fleet:
-            print("--at only makes sense with --fleet (it replays the "
-                  "fleet verdict from the hub's history ring)",
-                  file=sys.stderr)
+        if not fleet and not efficiency:
+            print("--at only makes sense with --fleet or --efficiency "
+                  "(it replays the verdict from the hub's history "
+                  "ring)", file=sys.stderr)
             return 2
         try:
-            fleet_at = parse_at(at_raw, time.time())
+            at_ts = parse_at(at_raw, time.time())
         except ValueError as exc:
             print(str(exc), file=sys.stderr)
             return 2
+        if fleet:
+            fleet_at = at_ts
+        if efficiency:
+            efficiency_at = at_ts
     cfg = from_args(args)
     started = time.monotonic()
     results = run_checks(cfg, url=url, trace=trace, fleet=fleet,
                          energy=energy, host=host, egress=egress,
                          skew=skew, stores=stores,
-                         cardinality=cardinality, fleet_at=fleet_at)
+                         cardinality=cardinality, fleet_at=fleet_at,
+                         efficiency=efficiency,
+                         efficiency_at=efficiency_at)
     results.sort(key=lambda r: _ORDER[r.status])
     if as_json:
         print(json.dumps({

@@ -276,7 +276,7 @@ class MetricsServer:
                  energy_provider=None, host_provider=None,
                  egress_provider=None, skew_provider=None,
                  stores_provider=None, cardinality_provider=None,
-                 history_provider=None,
+                 history_provider=None, efficiency_provider=None,
                  prewarm_renders: bool = True,
                  ingest_read_deadline: float = 10.0):
         self._registry = registry
@@ -363,6 +363,13 @@ class MetricsServer:
         # None = 404 (the hub wires it; daemons and --no-fleet-lens
         # hubs don't serve a fleet view).
         self._fleet = fleet_provider
+        # Fleet efficiency attestation (ISSUE 20, duck-typed: () ->
+        # dict): serves /debug/efficiency — the signed federation-wide
+        # energy/waste rollup `doctor --efficiency` verifies. A wired
+        # hub with --no-efficiency answers enabled:false (the
+        # --no-trace contract); None (daemons, bare test servers,
+        # hubs that predate the layer) 404s.
+        self._efficiency = efficiency_provider
         # Flight recorder (tracing.Tracer, duck-typed): serves the
         # /debug/ticks (phase summaries + slowest-tick table),
         # /debug/trace (Chrome trace-event JSON), and /debug/events
@@ -858,6 +865,24 @@ class MetricsServer:
                             + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif (path == "/debug/efficiency"
+                        and outer._efficiency is not None):
+                    # Fleet efficiency attestation (ISSUE 20): the
+                    # HMAC-signed energy/waste rollup — leaves' energy
+                    # digests folded with the hub's waste ledger —
+                    # behind the same auth gate as every non-probe
+                    # path. doctor --efficiency verifies the signature.
+                    import json
+
+                    try:
+                        payload = outer._efficiency()
+                    except Exception as exc:  # noqa: BLE001 - a status
+                        # walk must not 500 the whole debug surface.
+                        payload = {"enabled": False, "error": str(exc)}
+                    body = (json.dumps(payload, sort_keys=True)
+                            + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/fleet" and outer._fleet is not None:
                     # Fleet lens rollup (fleetlens.py): per-target
                     # baselines/anomalies, SLO burn windows, slow-node
@@ -917,6 +942,8 @@ class MetricsServer:
                                   "/debug/events"]
                     if outer._fleet is not None:
                         links += ["/debug/fleet"]
+                    if outer._efficiency is not None:
+                        links += ["/debug/efficiency"]
                     if outer._burst is not None:
                         links += ["/debug/burst"]
                     if outer._energy is not None:

@@ -63,6 +63,7 @@ import threading
 from typing import Mapping, Sequence
 
 from . import linkloc, schema
+from . import efficiency as efficiency_mod
 
 # Default SLO knobs (--slo-* flags; config.py re-exports these as the
 # shared flag surface). Freshness: 99% of observed chip-refreshes serve
@@ -256,6 +257,8 @@ def digest_from_series(series: Sequence) -> dict:
     ici_links: dict[str, float] = {}
     ici_worker = ""
     ici_topology = ""
+    energy_pods: dict[tuple[str, str], float] = {}
+    energy_coverage: float | None = None
     for name, labels, value in series:
         if name == schema.TICK_PHASE_SECONDS.name:
             phase = labels.get("phase", "")
@@ -298,6 +301,17 @@ def digest_from_series(series: Sequence) -> dict:
                 ici_links[link] = ici_links.get(link, 0.0) + value
                 ici_worker = ici_worker or labels.get("worker", "")
                 ici_topology = ici_topology or labels.get("topology", "")
+        elif name == schema.ENERGY_POD.name:
+            # Per-pod energy evidence (ISSUE 20): the node's attributed
+            # joules counters join its digest so the efficiency lens can
+            # score goodput-per-watt — and so the federation rollup can
+            # fold per-pod totals without refetching /debug/energy.
+            pod = labels.get("pod", "")
+            if pod:
+                pod_key = (pod, labels.get("namespace", ""))
+                energy_pods[pod_key] = energy_pods.get(pod_key, 0.0) + value
+        elif name == schema.ENERGY_COVERAGE.name:
+            energy_coverage = value
     out: dict = {}
     if phases:
         out["phases"] = phases
@@ -310,6 +324,15 @@ def digest_from_series(series: Sequence) -> dict:
     if ici_links:
         out["ici"] = {"links": ici_links, "worker": ici_worker,
                       "topology": ici_topology}
+    if energy_pods or energy_coverage is not None:
+        # JSON-safe shape (the digest embeds in /debug/fleet): pods as
+        # [pod, namespace, joules] lists, never tuple keys.
+        out["energy"] = {
+            "pods": [[pod, ns, joules]
+                     for (pod, ns), joules in sorted(energy_pods.items())],
+            "coverage": (energy_coverage
+                         if energy_coverage is not None else 0.0),
+        }
     return out
 
 
@@ -379,7 +402,14 @@ class FleetLens:
                  min_samples: int = MIN_BASELINE_SAMPLES,
                  miss_threshold: int = FRESHNESS_MISS_THRESHOLD,
                  alpha: float = BASELINE_ALPHA,
-                 windows: Sequence[tuple[float, str]] = SLO_WINDOWS) -> None:
+                 windows: Sequence[tuple[float, str]] = SLO_WINDOWS,
+                 efficiency: bool = True,
+                 waste_warmup_refreshes: int =
+                 efficiency_mod.DEFAULT_WARMUP_REFRESHES,
+                 waste_idle_refreshes: int =
+                 efficiency_mod.DEFAULT_IDLE_REFRESHES,
+                 waste_idle_duty: float = efficiency_mod.DEFAULT_IDLE_DUTY,
+                 waste_top_k: int = efficiency_mod.DEFAULT_TOP_K) -> None:
         # Journal feed (tracing.Tracer, duck-typed; None = no journal).
         self._tracer = tracer
         # Burst auto-arm hook (ISSUE 8): called as hook(target, kind, z)
@@ -411,6 +441,15 @@ class FleetLens:
         # names a sick LINK from the cross-node evidence this lens
         # already holds. Guarded by self._lock like everything else.
         self.links = linkloc.LinkLocalizer()
+        # Fleet efficiency scoring (ISSUE 20): who is wasting chips.
+        # None under --no-efficiency — the rollup then reports the
+        # layer disabled rather than silently absent. Guarded by
+        # self._lock like the localizer.
+        self.efficiency = efficiency_mod.EfficiencyLens(
+            warmup_refreshes=waste_warmup_refreshes,
+            idle_refreshes=waste_idle_refreshes,
+            idle_duty=waste_idle_duty,
+            top_k=waste_top_k) if efficiency else None
         self._last_seq = 0
         self._last_now = 0.0
 
@@ -537,7 +576,53 @@ class FleetLens:
                 }
             if link_nodes:
                 events.extend(self.links.observe(now, link_nodes))
+            if self.efficiency is not None:
+                events.extend(self.efficiency.observe(
+                    seq, now, self._pod_evidence(frame)))
         self._journal(events)
+
+    def _pod_evidence(self, frame) -> dict[tuple[str, str], dict]:
+        """Per-(pod, namespace) chip evidence for the efficiency lens
+        (lock held): duty/power/steps folded from the frame's attributed
+        rows, joined with per-pod joules and coverage from the hosting
+        targets' energy digests. Pods without attribution can't be
+        scored — waste attribution IS pod attribution."""
+        pod_rows: dict[tuple[str, str], list] = {}
+        for row in frame.rows.values():
+            if row.pod:
+                pod_rows.setdefault((row.pod, row.namespace or ""),
+                                    []).append(row)
+        out: dict[tuple[str, str], dict] = {}
+        for key, rows in pod_rows.items():
+            duties = [r.duty for r in rows if r.duty is not None]
+            powers = [r.power for r in rows if r.power is not None]
+            steps = [r.steps_per_s for r in rows
+                     if r.steps_per_s is not None]
+            joules: float | None = None
+            coverage = 0.0
+            for target in sorted({str(r.key[0]) for r in rows}):
+                state = self._targets.get(target)
+                energy = (state.digest.get("energy")
+                          if state is not None and state.digest else None)
+                if not energy:
+                    continue
+                for entry in energy.get("pods") or []:
+                    if (len(entry) >= 3 and entry[0] == key[0]
+                            and entry[1] == key[1]):
+                        joules = (joules or 0.0) + float(entry[2])
+                # A multi-node pod is covered if ANY hosting node still
+                # has energy evidence — UNKNOWN means fully blind.
+                coverage = max(coverage,
+                               float(energy.get("coverage") or 0.0))
+            out[key] = {
+                "duty": sum(duties) / len(duties) if duties else None,
+                "power": sum(powers) if powers else None,
+                "steps": sum(steps) if steps else None,
+                "chips": len(rows),
+                "joules": joules,
+                "coverage": coverage,
+            }
+        return out
 
     def _signals(self, target: str, rows: list,
                  fetch: float | None) -> dict[str, float]:
@@ -752,6 +837,11 @@ class FleetLens:
             builder.add(schema.FLEET_LINK_BASELINE_BPS, baseline, labels)
             builder.add(schema.FLEET_LINK_BASELINE_BAND, band, labels)
             builder.add(schema.FLEET_LINK_OBSERVED_BPS, observed, labels)
+        if self.efficiency is not None:
+            # Engine state is single-writer (this thread) but read by
+            # HTTP rollup threads, so the fold runs under the lock too.
+            with self._lock:
+                self.efficiency.contribute(builder)
 
     def link_history_rows(self) -> list[tuple[str, str, float]]:
         """(link, reason, value) suspect rows for the hub's history
@@ -760,6 +850,25 @@ class FleetLens:
         after)."""
         with self._lock:
             return self.links.rows()
+
+    def waste_history_rows(self) -> list[tuple[str, str, str, float]]:
+        """(pod, namespace, reason, value) waste rows for the hub's
+        history ring — recorded every publish so `doctor --efficiency
+        --at` answers "who was wasting chips during the incident"
+        retroactively (1.0 while accused, 0.0 tombstones after)."""
+        if self.efficiency is None:
+            return []
+        with self._lock:
+            return self.efficiency.rows()
+
+    def efficiency_summary(self) -> dict:
+        """The efficiency engine's waste ledger (for the attestation
+        fold and /debug/fleet). {"enabled": False} under
+        --no-efficiency."""
+        if self.efficiency is None:
+            return {"enabled": False}
+        with self._lock:
+            return self.efficiency.summary()
 
     # -- read side (HTTP threads) --------------------------------------------
 
@@ -821,5 +930,8 @@ class FleetLens:
                 },
                 "attribution": dict(self._worst) if self._worst else None,
                 "links": self.links.summary(),
+                "efficiency": (self.efficiency.summary()
+                               if self.efficiency is not None
+                               else {"enabled": False}),
             }
         return payload

@@ -42,6 +42,7 @@ import time
 from typing import Mapping, Sequence
 
 from . import delta as delta_mod
+from . import efficiency as efficiency_mod
 from . import fleetlens, procstats, schema
 from . import wal as wal_mod
 from .cardinality import SeriesAccountant, clamp_series
@@ -545,7 +546,15 @@ class Hub:
                  series_high_watermark: int = 0,
                  series_low_watermark: int = 0,
                  series_idle_refreshes: int = 5,
-                 history=None) -> None:
+                 history=None,
+                 efficiency: bool = True,
+                 waste_warmup_refreshes: int =
+                 efficiency_mod.DEFAULT_WARMUP_REFRESHES,
+                 waste_idle_refreshes: int =
+                 efficiency_mod.DEFAULT_IDLE_REFRESHES,
+                 waste_idle_duty: float = efficiency_mod.DEFAULT_IDLE_DUTY,
+                 waste_top_k: int = efficiency_mod.DEFAULT_TOP_K,
+                 energy_audit_key: str = "") -> None:
         if not targets and targets_provider is None and not delta_ingest:
             raise ValueError("hub needs at least one target")
         # Order-preserving dedup: a target listed twice (positional +
@@ -696,7 +705,25 @@ class Hub:
             freshness_target=slo_freshness_target,
             straggler_target=slo_straggler_target,
             straggler_ratio=slo_straggler_ratio,
+            efficiency=efficiency,
+            waste_warmup_refreshes=waste_warmup_refreshes,
+            waste_idle_refreshes=waste_idle_refreshes,
+            waste_idle_duty=waste_idle_duty,
+            waste_top_k=waste_top_k,
         ) if fleet_lens else None
+        # Federation energy/waste attestation (ISSUE 20): the hub-side
+        # audit key signs the /debug/efficiency rollup (the daemon-side
+        # key signs /debug/energy; they are usually the same secret).
+        # Leaves' /debug/energy digests are fetched lazily from the
+        # HTTP handler thread with a short TTL cache — never from the
+        # refresh loop, which must not block on N extra fetches.
+        self._energy_audit_key = energy_audit_key
+        self._efficiency_enabled = efficiency and fleet_lens
+        self._energy_digest_cache: tuple[float, dict] | None = None
+        self._energy_digest_lock = threading.Lock()
+        # Injectable for tests: fetcher(url) -> digest dict (raises on
+        # failure). None = the default urllib fetch.
+        self._energy_fetcher = None
         # Delta-push ingest (ISSUE 7 tentpole): daemons and leaf hubs
         # POST seq-numbered change-sets to /ingest/delta; the refresh
         # drains them straight onto the _TargetCache interned state,
@@ -1331,6 +1358,78 @@ class Hub:
                             "30s)", err)
         return frame
 
+    # -- federation energy/waste attestation (ISSUE 20) ----------------------
+
+    # Leaves folded per attestation: bounds the handler-thread fetch
+    # fan-out on a big fleet (the bound is attested — totals carries
+    # targets_total vs leaves so a truncated fold is visible, never
+    # silent). The TTL keeps a scrape storm on /debug/efficiency from
+    # re-fetching every leaf per request.
+    _ENERGY_FOLD_CAP = 8
+    _ENERGY_FOLD_TTL = 30.0
+
+    def _fetch_energy_digest(self, url: str) -> dict:
+        import json
+        import urllib.request
+
+        request = urllib.request.Request(url)
+        if self._headers_provider is not None:
+            try:
+                for key, value in (self._headers_provider() or {}).items():
+                    request.add_header(key, value)
+            except Exception:  # noqa: BLE001 - a token-file hiccup must
+                # not kill the fold; the leaf then answers 401 and rides
+                # the attestation as an {"error": ...} stub.
+                pass
+        with urllib.request.urlopen(
+                request, timeout=self._fetch_timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _leaf_energy_digests(self) -> tuple[dict[str, dict], int]:
+        """(target -> /debug/energy digest, eligible-target count) for
+        the attestation fold. Runs on HTTP handler threads (never the
+        refresh loop — N extra fetches must not blow the refresh
+        deadline), TTL-cached so scrapes amortize. Unreachable leaves
+        ride along as {"error": ...} stubs: a partial fold is still an
+        attestation, and the stub names the gap."""
+        http_targets = [t for t in self._targets
+                        if t.startswith(("http://", "https://"))]
+        with self._energy_digest_lock:
+            cached = self._energy_digest_cache
+            if (cached is not None
+                    and time.monotonic() - cached[0] < self._ENERGY_FOLD_TTL):
+                return cached[1], len(http_targets)
+        fetcher = self._energy_fetcher or self._fetch_energy_digest
+        leaves: dict[str, dict] = {}
+        for target in http_targets[:self._ENERGY_FOLD_CAP]:
+            base = target.rstrip("/")
+            if base.endswith("/metrics"):
+                base = base[:-len("/metrics")]
+            try:
+                leaves[target] = fetcher(base + "/debug/energy")
+            except Exception as exc:  # noqa: BLE001 - the stub is the
+                # evidence; the leaf's reachability already has its own
+                # freshness anomaly on the lens side.
+                leaves[target] = {"error": str(exc)}
+        with self._energy_digest_lock:
+            self._energy_digest_cache = (time.monotonic(), leaves)
+        return leaves, len(http_targets)
+
+    def efficiency_payload(self) -> dict:
+        """The /debug/efficiency provider: the leaves' signed
+        /debug/energy digests folded with this hub's waste ledger into
+        one canonical-JSON HMAC-signed attestation (efficiency.py owns
+        the shape; `doctor --efficiency` verifies the signature)."""
+        if not self._efficiency_enabled or self.fleet is None:
+            return {"enabled": False, "reason": "--no-efficiency"}
+        leaves, targets_total = self._leaf_energy_digests()
+        return efficiency_mod.build_attestation(
+            self.fleet.efficiency_summary(), leaves,
+            self._energy_audit_key,
+            node=os.environ.get("HOSTNAME", ""),
+            generated_at=time.time(),
+            targets_total=targets_total)
+
     def _sync_push_entries(self) -> dict[str, "_TargetCache"]:
         """target -> ready entry for every push-served target this
         refresh. Frames already applied themselves onto the entries at
@@ -1400,6 +1499,15 @@ class Hub:
                     self.history.record(
                         schema.FLEET_LINK_SUSPECT.name,
                         (("link", link), ("reason", reason)), value)
+                # Waste verdicts ride the ring too (ISSUE 20):
+                # `doctor --efficiency --at` answers "who was wasting
+                # chips during the incident" after the pod recovered.
+                for pod, namespace, reason, value in \
+                        self.fleet.waste_history_rows():
+                    self.history.record(
+                        schema.FLEET_WASTE_SUSPECT.name,
+                        (("pod", pod), ("namespace", namespace),
+                         ("reason", reason)), value)
         # Delta-ingest self-metrics (ISSUE 7): frame mix, wire bytes,
         # resync rate, and how much of the fleet rides push vs pull.
         if self.delta is not None:
@@ -2384,10 +2492,11 @@ def main(argv: Sequence[str] | None = None) -> int:
     # drift between the two CLIs. On a hub, --hub-url points at the
     # PARENT (root) hub of a federation tree.
     from .config import (add_cardinality_flags, add_delta_push_flags,
-                         add_fleet_lens_flags, add_history_flags,
-                         add_ingest_guard_flags,
+                         add_efficiency_flags, add_fleet_lens_flags,
+                         add_history_flags, add_ingest_guard_flags,
                          validate_cardinality_args,
                          validate_delta_push_args,
+                         validate_efficiency_args,
                          validate_fleet_lens_args,
                          validate_history_args,
                          validate_ingest_guard_args)
@@ -2397,6 +2506,18 @@ def main(argv: Sequence[str] | None = None) -> int:
     add_ingest_guard_flags(parser)
     add_cardinality_flags(parser)
     add_history_flags(parser)
+    add_efficiency_flags(parser)
+    # Hub-side audit key (ISSUE 20): signs the /debug/efficiency
+    # energy/waste attestation — same spelling, env var and caveat as
+    # the daemon's /debug/energy key (usually the same secret).
+    parser.add_argument("--energy-audit-key",
+                        default=os.environ.get("KTS_ENERGY_AUDIT_KEY", ""),
+                        help="HMAC-SHA256 key signing the "
+                             "/debug/efficiency energy/waste rollup; "
+                             "the same key verifies it via `doctor "
+                             "--efficiency`. Empty serves it unsigned. "
+                             "Prefer the KTS_ENERGY_AUDIT_KEY env var "
+                             "(a flag value is visible in `ps`)")
     args = parser.parse_args(argv)
     fleet_error = validate_fleet_lens_args(args)
     if fleet_error:
@@ -2413,6 +2534,9 @@ def main(argv: Sequence[str] | None = None) -> int:
     history_error = validate_history_args(args)
     if history_error:
         parser.error(history_error)
+    efficiency_error = validate_efficiency_args(args)
+    if efficiency_error:
+        parser.error(efficiency_error)
     if args.ingest_lanes < 0 or args.ingest_lanes > 256:
         parser.error("--ingest-lanes must be 0 (auto) or 1..256")
     if args.ingest_procs < 0 or args.ingest_procs > 64:
@@ -2631,7 +2755,13 @@ def main(argv: Sequence[str] | None = None) -> int:
               series_high_watermark=args.series_high_watermark,
               series_low_watermark=args.series_low_watermark,
               series_idle_refreshes=args.series_idle_refreshes,
-              history=history_store)
+              history=history_store,
+              efficiency=not args.no_efficiency,
+              waste_warmup_refreshes=args.waste_warmup_refreshes,
+              waste_idle_refreshes=args.waste_idle_refreshes,
+              waste_idle_duty=args.waste_idle_duty,
+              waste_top_k=args.waste_top_k,
+              energy_audit_key=args.energy_audit_key)
 
     # Push senders follow registry publishes, so they ship each merged
     # snapshot unmodified — the hub as a slice-level egress point.
@@ -2768,7 +2898,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         skew_provider=skew_payload,
         stores_provider=stores_payload,
         cardinality_provider=cardinality_payload,
-        history_provider=history_store)
+        history_provider=history_store,
+        # Wired even under --no-efficiency: the provider then answers
+        # enabled:false (config diagnosis), while a hub that predates
+        # the layer 404s — the established debug-endpoint contract.
+        efficiency_provider=hub.efficiency_payload
+        if hub.fleet is not None else None)
     # SIGTERM/SIGINT stop cleanly like the daemon (daemon.run): the push
     # senders flush the final snapshot on stop, so a pod reschedule is
     # not a data gap upstream.

@@ -1033,6 +1033,97 @@ FLEET_LINK_METRICS: tuple[MetricSpec, ...] = (
     FLEET_LINK_OBSERVED_BPS,
 )
 
+# Fleet-efficiency families (efficiency.py, ISSUE 20): per-pod waste
+# scoring driven from the hub refresh — who is holding chips without
+# using them. Per-pod exports are bounded to the waste top-K
+# (--waste-top-k), so a big fleet cannot label-bomb the hub's own
+# exposition with one series per pod.
+
+FLEET_EFFICIENCY_SCORE = MetricSpec(
+    "kts_fleet_efficiency_score",
+    MetricType.GAUGE,
+    "Per-pod efficiency score in [0, 1] from the hub's efficiency "
+    "lens: EWMA-smoothed MXU duty (as a fraction of 100) scaled by "
+    "step progress when the pod exports a step counter — 1.0 is a pod "
+    "earning its chips, ~0 is a pod holding them idle. Exported for "
+    "the waste top-K only (--waste-top-k bounds the per-pod series); "
+    "the full ledger is at /debug/fleet under 'efficiency' and in "
+    "`doctor --efficiency`. Pods with no duty evidence and no energy "
+    "coverage score UNKNOWN and are absent here, never 0.",
+    extra_labels=("pod", "namespace"),
+)
+FLEET_EFFICIENCY_STEPS_PER_JOULE = MetricSpec(
+    "kts_fleet_efficiency_steps_per_joule",
+    MetricType.GAUGE,
+    "Goodput per watt, per pod: the EWMA step rate divided by the "
+    "EWMA power draw of the chips the pod holds (steps/s per W = "
+    "steps per joule). Absent while the pod exports no step counter "
+    "or no power reading — a missing input must read as 'unknown', "
+    "not as zero goodput. Waste top-K pods only.",
+    extra_labels=("pod", "namespace"),
+)
+FLEET_EFFICIENCY_STEPS_PER_CHIP_HOUR = MetricSpec(
+    "kts_fleet_efficiency_steps_per_chip_hour",
+    MetricType.GAUGE,
+    "Goodput per reserved chip, per pod: the EWMA step rate times "
+    "3600 divided by the chips the pod holds — the bill-shaped "
+    "denominator (a pod wastes chip-hours whether or not it draws "
+    "power). Absent without a step counter. Waste top-K pods only.",
+    extra_labels=("pod", "namespace"),
+)
+FLEET_EFFICIENCY_UNKNOWN = MetricSpec(
+    "kts_fleet_efficiency_unknown_pods",
+    MetricType.GAUGE,
+    "Pods the efficiency lens refuses to score this refresh: no duty "
+    "evidence from any of the pod's chips AND zero energy coverage "
+    "(collector degraded, burst disarmed). UNKNOWN is deliberately "
+    "not wasteful — a degraded telemetry store must never page a "
+    "healthy tenant — so these pods are excluded from the waste "
+    "ranking until evidence returns.",
+)
+FLEET_WASTE_SUSPECT = MetricSpec(
+    "kts_fleet_waste_suspect",
+    MetricType.GAUGE,
+    "1 while the efficiency lens accuses this pod of wasting its "
+    "chips; reason is 'idle-reservation' (duty ~0 for "
+    "--waste-idle-refreshes consecutive refreshes on a pod past the "
+    "--waste-warmup-refreshes gate) or 'low-goodput' (power drawn "
+    "and duty up, step counter flat). Falls to 0 on recovery (the "
+    "series persists as a tombstone so history lookback sees the "
+    "clear); edge-journaled as fleet_waste / fleet_waste_cleared and "
+    "recorded into the history ring so `doctor --efficiency --at` "
+    "answers retroactively.",
+    extra_labels=("pod", "namespace", "reason"),
+)
+FLEET_WASTE_CHIPS = MetricSpec(
+    "kts_fleet_waste_chips",
+    MetricType.GAUGE,
+    "Chips the efficiency lens scores as wasted per pod: "
+    "(1 - efficiency score) times the chips the pod holds, exported "
+    "for the waste top-K ranking (--waste-top-k). Sum it for the "
+    "fleet's idle-reservation bill; the per-pod detail rides "
+    "/debug/fleet and `doctor --efficiency`.",
+    extra_labels=("pod", "namespace"),
+)
+FLEET_WASTE_PODS = MetricSpec(
+    "kts_fleet_waste_pods",
+    MetricType.GAUGE,
+    "Pods currently under an active waste verdict (idle-reservation "
+    "or low-goodput). 0 is the healthy steady state; alert on "
+    "sustained nonzero and walk `doctor --efficiency` for the guilty "
+    "pod.",
+)
+
+FLEET_EFFICIENCY_METRICS: tuple[MetricSpec, ...] = (
+    FLEET_EFFICIENCY_SCORE,
+    FLEET_EFFICIENCY_STEPS_PER_JOULE,
+    FLEET_EFFICIENCY_STEPS_PER_CHIP_HOUR,
+    FLEET_EFFICIENCY_UNKNOWN,
+    FLEET_WASTE_SUSPECT,
+    FLEET_WASTE_CHIPS,
+    FLEET_WASTE_PODS,
+)
+
 # History ring + /query serving families (history.py, ISSUE 18): the
 # hub's embedded lookback store and its read-admission layer.
 
@@ -1180,6 +1271,7 @@ HUB_METRICS: tuple[MetricSpec, ...] = (
     FLEET_SLO_BAD,
     FLEET_WORST_TICK,
     *FLEET_LINK_METRICS,
+    *FLEET_EFFICIENCY_METRICS,
     *HISTORY_METRICS,
 )
 

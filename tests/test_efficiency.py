@@ -1,0 +1,329 @@
+"""Fleet efficiency lens (ISSUE 20): per-pod waste scoring, idle-
+reservation / low-goodput verdicts with warmup + hysteresis, the
+UNKNOWN gate (a blind collector must never page a healthy tenant), the
+signed federation energy/waste attestation, the hub's leaf-digest fold,
+and the doctor's retroactive --at verdict."""
+
+import pytest
+
+from kube_gpu_stats_tpu import doctor, schema
+from kube_gpu_stats_tpu.efficiency import (CLEAR_REFRESHES,
+                                           EfficiencyLens,
+                                           build_attestation)
+from kube_gpu_stats_tpu.energy import verify_payload
+from kube_gpu_stats_tpu.hub import Hub
+from kube_gpu_stats_tpu.registry import SnapshotBuilder
+
+
+def ev(duty, power=200.0, steps=None, chips=4, joules=None,
+       coverage=1.0):
+    """One pod's per-refresh evidence dict."""
+    return {"duty": duty, "power": power, "steps": steps,
+            "chips": chips, "joules": joules, "coverage": coverage}
+
+
+def lens(**kwargs):
+    kwargs.setdefault("warmup_refreshes", 3)
+    kwargs.setdefault("idle_refreshes", 2)
+    return EfficiencyLens(**kwargs)
+
+
+def feed(engine, frames):
+    """Drive observe() over a list of {key: evidence} frames with a
+    deterministic clock; returns all journal events in order."""
+    events = []
+    now = 1_000_000.0
+    for seq, frame in enumerate(frames, start=1):
+        now += 10.0
+        events.extend(engine.observe(seq, now, frame))
+    return events
+
+
+KEY = ("train-0", "ml")
+
+
+# -- verdicts ----------------------------------------------------------------
+
+def test_warmup_gate_blocks_early_verdict():
+    """A pod idling from birth (model loading, compilation) is never
+    accused inside the warmup grace; the verdict lands on the first
+    warm refresh once the idle streak is satisfied."""
+    engine = lens(warmup_refreshes=3, idle_refreshes=2)
+    for seq in range(1, 4):
+        events = engine.observe(seq, 1000.0 + seq, {KEY: ev(0.0)})
+        assert events == [], f"accused during warmup at refresh {seq}"
+    events = engine.observe(4, 1004.0, {KEY: ev(0.0)})
+    assert [e[0] for e in events] == ["fleet_waste"]
+    kind, detail, attrs = events[0]
+    assert attrs["reason"] == "idle-reservation"
+    assert attrs["pod"] == "train-0" and attrs["namespace"] == "ml"
+    assert "ml/train-0" in detail and "4 chip(s)" in detail
+
+
+def test_idle_reservation_raises_once_and_clears_with_event():
+    engine = lens()
+    events = feed(engine, [{KEY: ev(80.0)}] * 4 + [{KEY: ev(0.2)}] * 4)
+    assert [e[0] for e in events] == ["fleet_waste"]
+    assert "ml/train-0" in engine.suspects()
+    # Healthy again: the clear needs CLEAR_REFRESHES consecutive busy
+    # refreshes, then journals exactly once.
+    events = feed(engine, [{KEY: ev(85.0)}] * (CLEAR_REFRESHES + 2))
+    assert [e[0] for e in events] == ["fleet_waste_cleared"]
+    assert "chips back in use" in events[0][1]
+    assert engine.suspects() == {}
+    # The identity keeps exporting a 0.0 tombstone for history reads.
+    assert engine.rows() == [("train-0", "ml", "idle-reservation", 0.0)]
+
+
+def test_one_busy_refresh_resets_the_idle_streak():
+    engine = lens(warmup_refreshes=1, idle_refreshes=3)
+    frames = ([{KEY: ev(80.0)}] * 2 + [{KEY: ev(0.0)}] * 2
+              + [{KEY: ev(80.0)}] + [{KEY: ev(0.0)}] * 2)
+    assert feed(engine, frames) == []
+    assert engine.observe(99, 2000.0, {KEY: ev(0.0)})[0][0] == \
+        "fleet_waste"
+
+
+def test_low_goodput_needs_a_flat_step_counter():
+    """Power drawn and duty up while the step counter is flat is
+    low-goodput; an ABSENT counter is unknowable, never flat."""
+    stuck = lens()
+    events = feed(stuck, [{KEY: ev(80.0, steps=5.0)}] * 3
+                  + [{KEY: ev(80.0, steps=0.0)}] * 3)
+    assert [e[0] for e in events] == ["fleet_waste"]
+    assert events[0][2]["reason"] == "low-goodput"
+
+    no_counter = lens()
+    assert feed(no_counter, [{KEY: ev(80.0, steps=None)}] * 10) == []
+    assert no_counter.suspects() == {}
+
+
+def test_departed_pod_clears_its_verdict():
+    """Job ended, chips released: that IS the recovery — the verdict
+    clears with a journal event and the tombstone rows stay."""
+    engine = lens()
+    feed(engine, [{KEY: ev(0.0)}] * 6)
+    assert "ml/train-0" in engine.suspects()
+    events = engine.observe(10, 3000.0, {})
+    assert [e[0] for e in events] == ["fleet_waste_cleared"]
+    assert "pod departed" in events[0][1]
+    assert engine.suspects() == {}
+    assert engine.rows() == [("train-0", "ml", "idle-reservation", 0.0)]
+
+
+# -- the UNKNOWN gate (zero-coverage regression) -----------------------------
+
+def test_blind_collector_scores_unknown_never_wasteful():
+    """THE regression (ISSUE 20 bugfix): a pod with no duty evidence
+    from any chip AND zero energy coverage must score UNKNOWN —
+    counted, never ranked, never accused. A degraded telemetry store
+    can never page a healthy tenant."""
+    engine = lens(warmup_refreshes=1, idle_refreshes=2)
+    blind = {"duty": None, "power": None, "steps": None, "chips": 8,
+             "joules": None, "coverage": 0.0}
+    events = feed(engine, [{KEY: dict(blind)}] * 20)
+    assert events == []
+    summary = engine.summary()
+    assert summary["unknown_pods"] == 1
+    assert summary["pods"]["ml/train-0"]["unknown"] is True
+    assert summary["pods"]["ml/train-0"]["score"] is None
+    assert summary["suspects"] == {}
+    assert summary["top_waste"] == []
+    builder = SnapshotBuilder()
+    engine.contribute(builder)
+    text = builder.build().render()
+    assert "kts_fleet_efficiency_unknown_pods 1" in text
+    assert "kts_fleet_waste_chips" not in text
+    assert "kts_fleet_waste_suspect" not in text
+
+
+def test_real_zero_duty_is_still_accusable():
+    """Duty evidence present — even a hard 0.0 reading — is evidence
+    of idleness, not blindness: the idle-reservation verdict must still
+    fire (coverage may legitimately be ~0 when burst sampling is off)."""
+    engine = lens(warmup_refreshes=1, idle_refreshes=2)
+    events = feed(engine, [{KEY: ev(0.0, power=None, coverage=0.0)}] * 4)
+    assert [e[0] for e in events] == ["fleet_waste"]
+
+
+# -- scores ------------------------------------------------------------------
+
+def test_score_scales_duty_by_step_progress():
+    busy = lens()
+    feed(busy, [{KEY: ev(80.0, steps=9.0)}] * 5)
+    stuck = lens()
+    feed(stuck, [{KEY: ev(80.0, steps=0.0)}] * 5)
+    busy_score = busy.summary()["pods"]["ml/train-0"]["score"]
+    stuck_score = stuck.summary()["pods"]["ml/train-0"]["score"]
+    assert busy_score == pytest.approx(0.8 * 0.9, abs=1e-6)
+    assert stuck_score == 0.0
+
+
+def test_goodput_rates_steps_per_joule_and_chip_hour():
+    engine = lens()
+    feed(engine, [{KEY: ev(100.0, power=100.0, steps=10.0,
+                           chips=4)}] * 6)
+    pod = engine.summary()["pods"]["ml/train-0"]
+    assert pod["steps_per_joule"] == pytest.approx(0.1, abs=1e-9)
+    assert pod["steps_per_chip_hour"] == pytest.approx(9000.0)
+
+
+def test_top_k_bounds_per_pod_exports_and_ranks_by_wasted_chips():
+    engine = lens(warmup_refreshes=1, top_k=2)
+    frame = {
+        ("idle-big", "ml"): ev(0.0, chips=8),      # 8 wasted chips
+        ("idle-small", "ml"): ev(0.0, chips=2),    # 2 wasted chips
+        ("half", "ml"): ev(50.0, chips=2),         # 1 wasted chip
+        ("busy", "ml"): ev(100.0, chips=4),        # ~0 wasted
+    }
+    feed(engine, [dict(frame) for _ in range(4)])
+    ranking = engine.summary()["top_waste"]
+    assert [r["pod"] for r in ranking] == ["idle-big", "idle-small"]
+    assert ranking[0]["wasted_chips"] == pytest.approx(8.0)
+    builder = SnapshotBuilder()
+    engine.contribute(builder)
+    text = builder.build().render()
+    score_rows = [line for line in text.splitlines()
+                  if line.startswith(schema.FLEET_EFFICIENCY_SCORE.name
+                                     + "{")]
+    assert len(score_rows) == 2  # top-K bound, not a census
+
+
+def test_observe_is_deterministic():
+    """Identical seeded input sequences produce byte-identical
+    summaries and journal events — no wall-clock, no randomness."""
+    frames = ([{KEY: ev(70.0, steps=5.0, joules=100.0)}] * 4
+              + [{KEY: ev(0.3, steps=0.0, joules=140.0)}] * 4
+              + [{KEY: ev(90.0, steps=7.0, joules=200.0)}] * 3)
+    a, b = lens(), lens()
+    assert feed(a, [dict(f) for f in frames]) == \
+        feed(b, [dict(f) for f in frames])
+    assert a.summary() == b.summary()
+    assert a.rows() == b.rows()
+
+
+def test_joules_counter_reset_skips_the_interval():
+    engine = lens()
+    feed(engine, [{KEY: ev(80.0, joules=1000.0)},
+                  {KEY: ev(80.0, joules=1400.0)},   # 40 J/s
+                  {KEY: ev(80.0, joules=5.0)}])     # reset: skipped
+    state = engine._pods[KEY]
+    assert state.joules_rate == pytest.approx(40.0)
+    assert state.last_joules == 5.0
+
+
+# -- the signed attestation --------------------------------------------------
+
+LEAF_A = {"per_pod": [["train-0", "ml", 120.0], ["train-1", "ml", 30.0]],
+          "coverage_ratio": 0.9, "signed": True, "hmac": "aa" * 32}
+LEAF_B = {"per_pod": [["other", "infra", 50.0]],
+          "coverage_ratio": 0.4, "signed": False}
+
+
+def test_attestation_folds_leaves_and_verifies():
+    engine = lens(warmup_refreshes=1)
+    feed(engine, [{KEY: ev(0.0)}] * 4)
+    payload = build_attestation(
+        engine.summary(), {"http://a/metrics": dict(LEAF_A),
+                           "http://b/metrics": dict(LEAF_B)},
+        "fleet-key", node="hub-1", generated_at=123.0, targets_total=5)
+    assert payload["totals"] == {
+        "joules": pytest.approx(200.0), "pod_totals": 3, "leaves": 2,
+        "leaves_signed": 1, "targets_total": 5,
+        "coverage_min": pytest.approx(0.4)}
+    assert "ml/train-0" in payload["waste"]["suspects"]
+    # Leaf digests ride verbatim, their own HMACs intact.
+    assert payload["leaves"]["http://a/metrics"]["hmac"] == "aa" * 32
+    assert payload["signed"] is True
+    assert verify_payload(payload, "fleet-key")
+    assert not verify_payload(payload, "wrong-key")
+    tampered = dict(payload)
+    tampered["totals"] = dict(payload["totals"], joules=1.0)  # shaved
+    assert not verify_payload(tampered, "fleet-key")
+
+
+def test_attestation_unsigned_without_key_and_skips_error_stubs():
+    payload = build_attestation(
+        lens().summary(),
+        {"http://a/metrics": dict(LEAF_A),
+         "http://down/metrics": {"error": "connection refused"}}, "")
+    assert payload["signed"] is False and "hmac" not in payload
+    assert payload["totals"]["joules"] == pytest.approx(150.0)
+    assert payload["totals"]["leaves_signed"] == 1
+    # The unreachable leaf rides as a stub naming the gap.
+    assert payload["leaves"]["http://down/metrics"]["error"]
+
+
+# -- the hub's leaf fold -----------------------------------------------------
+
+def test_hub_efficiency_payload_folds_leaves_with_stubs_and_caches():
+    calls = []
+
+    def fetcher(url):
+        calls.append(url)
+        if "9001" in url:
+            raise OSError("connection refused")
+        return dict(LEAF_A)
+
+    hub = Hub(["http://127.0.0.1:9000/metrics",
+               "http://127.0.0.1:9001/metrics"],
+              interval=3600.0, energy_audit_key="fleet-key")
+    try:
+        hub._energy_fetcher = fetcher
+        payload = hub.efficiency_payload()
+        assert payload["totals"]["leaves"] == 2
+        assert payload["totals"]["targets_total"] == 2
+        assert payload["leaves"][
+            "http://127.0.0.1:9001/metrics"]["error"]
+        assert verify_payload(payload, "fleet-key")
+        # Fetched URLs are the leaves' bases, /metrics stripped.
+        assert "http://127.0.0.1:9000/debug/energy" in calls
+        # TTL cache: a second scrape re-signs but does not re-fetch.
+        before = len(calls)
+        assert verify_payload(hub.efficiency_payload(), "fleet-key")
+        assert len(calls) == before
+    finally:
+        hub.stop()
+
+
+def test_hub_no_efficiency_answers_enabled_false():
+    hub = Hub(["http://127.0.0.1:9000/metrics"], interval=3600.0,
+              efficiency=False)
+    try:
+        assert hub.efficiency_payload() == {
+            "enabled": False, "reason": "--no-efficiency"}
+    finally:
+        hub.stop()
+
+
+# -- doctor: the retroactive --at verdict ------------------------------------
+
+def test_efficiency_at_names_the_accused_pod():
+    status, detail, data = doctor.efficiency_at_verdict(
+        {"series": [
+            {"labels": {"pod": "train-1", "namespace": "ml",
+                        "reason": "idle-reservation"},
+             "v": 1.0, "t": 1000.0},
+            {"labels": {"pod": "train-0", "namespace": "ml",
+                        "reason": "idle-reservation"},
+             "v": 0.0, "t": 1000.0},   # tombstone: innocent
+        ]}, 1000.0)
+    assert status == doctor.WARN
+    assert "ml/train-1 was wasting chips (idle-reservation" in detail
+    assert [s["pod"] for s in data["waste_suspects"]] == ["train-1"]
+
+
+def test_efficiency_at_all_tombstones_is_a_clean_ok():
+    status, detail, _ = doctor.efficiency_at_verdict(
+        {"series": [{"labels": {"pod": "train-0", "namespace": "ml",
+                                "reason": "idle-reservation"},
+                     "v": 0.0, "t": 1000.0}]}, 1000.0)
+    assert status == doctor.OK
+    assert "no pod was wasting chips" in detail
+
+
+def test_efficiency_at_empty_ring_warns_about_boot_scope():
+    status, detail, _ = doctor.efficiency_at_verdict({"series": []},
+                                                     1000.0)
+    assert status == doctor.WARN
+    assert "no waste samples" in detail

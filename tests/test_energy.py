@@ -448,3 +448,111 @@ def test_checkpoint_future_major_quarantined_byte_identical(tmp_path):
     assert acct.checkpoint(force=True)
     assert aside.read_bytes() == raw
     wal.reset_quarantine_stats()
+
+
+# -- /debug/efficiency + doctor --efficiency (ISSUE 20) ------------------------
+# The federation rollup carries the same attestation contract as the
+# per-node /debug/energy digest above; this matrix mirrors the
+# doctor --energy one: OK verified, FAIL on tamper or a wrong key,
+# WARN unsigned-without-a-local-key.
+
+@pytest.fixture
+def efficiency_server():
+    from kube_gpu_stats_tpu.efficiency import (EfficiencyLens,
+                                               build_attestation)
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    engine = EfficiencyLens(warmup_refreshes=1, idle_refreshes=2)
+    for seq in range(1, 5):
+        engine.observe(seq, 1000.0 + seq,
+                       {("train-1", "ml"): {
+                           "duty": 0.0, "power": 10.0, "steps": None,
+                           "chips": 4, "joules": None, "coverage": 1.0}})
+    leaf = {"per_pod": [["train-1", "ml", 250.0]],
+            "coverage_ratio": 0.8, "signed": True, "hmac": "bb" * 32}
+    state = {"payload": build_attestation(
+        engine.summary(), {"http://leaf-a/metrics": leaf},
+        "attest-key", node="hub-1", generated_at=777.0)}
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0,
+                           efficiency_provider=lambda: state["payload"])
+    server.start()
+    yield server, state
+    server.stop()
+
+
+def test_doctor_efficiency_verifies_live_attestation(efficiency_server):
+    server, _ = efficiency_server
+    result = doctor.check_efficiency(
+        f"http://127.0.0.1:{server.port}", "attest-key")
+    # The (real) idle pod rides the verified attestation as a WARN.
+    assert result.status == doctor.WARN
+    assert "signature verified" in result.detail
+    assert "ml/train-1: idle-reservation" in result.detail
+    assert "250.0 J attributed" in result.detail
+    assert "1 leaf energy digest(s) (1 signed)" in result.detail
+
+
+def test_doctor_efficiency_fails_on_wrong_key(efficiency_server):
+    server, _ = efficiency_server
+    result = doctor.check_efficiency(
+        f"http://127.0.0.1:{server.port}", "other-key")
+    assert result.status == doctor.FAIL
+    assert "DOES NOT VERIFY" in result.detail
+
+
+def test_doctor_efficiency_fails_on_bit_flipped_digest(efficiency_server):
+    """Tamper in flight: shave one leaf's joule bill inside the signed
+    payload — the hub-level HMAC must catch it even though the leaf
+    digest carries its own (stale) HMAC."""
+    server, state = efficiency_server
+    tampered = json.loads(json.dumps(state["payload"]))
+    tampered["leaves"]["http://leaf-a/metrics"]["per_pod"] = [
+        ["train-1", "ml", 1.0]]
+    state["payload"] = tampered
+    result = doctor.check_efficiency(
+        f"http://127.0.0.1:{server.port}", "attest-key")
+    assert result.status == doctor.FAIL
+    assert "DOES NOT VERIFY" in result.detail
+
+
+def test_doctor_efficiency_warns_without_local_key(efficiency_server):
+    server, _ = efficiency_server
+    result = doctor.check_efficiency(
+        f"http://127.0.0.1:{server.port}", "")
+    assert result.status == doctor.WARN
+    assert "NOT verified" in result.detail
+
+
+def test_doctor_efficiency_fails_on_unsigned_hub_with_local_key(
+        efficiency_server):
+    from kube_gpu_stats_tpu.efficiency import build_attestation
+
+    server, state = efficiency_server
+    state["payload"] = build_attestation({}, {}, "")  # hub unsigned
+    result = doctor.check_efficiency(
+        f"http://127.0.0.1:{server.port}", "attest-key")
+    assert result.status == doctor.FAIL
+    assert "UNSIGNED" in result.detail
+
+
+def test_doctor_efficiency_warns_on_disabled_hub(efficiency_server):
+    server, state = efficiency_server
+    state["payload"] = {"enabled": False, "reason": "--no-efficiency"}
+    result = doctor.check_efficiency(
+        f"http://127.0.0.1:{server.port}", "attest-key")
+    assert result.status == doctor.WARN
+    assert "--no-efficiency" in result.detail
+
+
+def test_doctor_efficiency_warns_on_missing_endpoint():
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+
+    server = MetricsServer(Registry(), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        result = doctor.check_efficiency(
+            f"http://127.0.0.1:{server.port}", "attest-key")
+        assert result.status == doctor.WARN
+        assert "no /debug/efficiency" in result.detail
+    finally:
+        server.stop()

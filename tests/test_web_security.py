@@ -166,13 +166,17 @@ def test_every_debug_endpoint_401s_without_leaking_trace_payloads():
                                  "spill": {"SECRET": "SPOOL_DETAIL"}},
         stores_provider=lambda: {"enabled": True,
                                  "stores": {"SECRET_STORE": {}}},
+        efficiency_provider=lambda: {
+            "enabled": True,
+            "waste": {"suspects": {"SECRET_NS/SECRET_POD": {}}}},
     )
     srv.start()
     try:
         for path in ("/debug/threads", "/debug/profile?seconds=0.1",
                      "/debug/ticks", "/debug/trace?last=5",
                      "/debug/events?since=0", "/debug/fleet",
-                     "/debug/host", "/debug/egress", "/debug/stores"):
+                     "/debug/host", "/debug/egress", "/debug/stores",
+                     "/debug/efficiency"):
             with pytest.raises(urllib.error.HTTPError) as err:
                 fetch(srv.port, path)
             assert err.value.code == 401, path
@@ -278,6 +282,41 @@ def test_debug_egress_served_with_auth_and_disabled_contract():
         landing = fetch(srv.port, "/",
                         headers=auth_header("prom", "s3cret")).read()
         assert b"/debug/egress" in landing
+    finally:
+        srv.stop()
+
+
+def test_debug_efficiency_404_without_provider(server):
+    """Servers with no efficiency provider wired (daemons, bare
+    registries, --no-fleet-lens hubs) must 404 /debug/efficiency,
+    mirroring /debug/fleet — the endpoint is a hub surface."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        fetch(server.port, "/debug/efficiency")
+    assert err.value.code == 404
+
+
+def test_debug_efficiency_disabled_answers_enabled_false():
+    """--no-efficiency keeps the endpoint up and says so (the
+    --no-trace contract): curl diagnoses config, not a hub that
+    predates the efficiency lens."""
+    import json
+
+    payload_state = {"enabled": False, "reason": "--no-efficiency"}
+    srv = MetricsServer(
+        make_registry(), host="127.0.0.1", port=0,
+        auth_username="prom",
+        auth_password_sha256=hashlib.sha256(b"s3cret").hexdigest(),
+        efficiency_provider=lambda: payload_state)
+    srv.start()
+    try:
+        payload = json.loads(fetch(
+            srv.port, "/debug/efficiency",
+            headers=auth_header("prom", "s3cret")).read())
+        assert payload["enabled"] is False
+        assert payload["reason"] == "--no-efficiency"
+        landing = fetch(srv.port, "/",
+                        headers=auth_header("prom", "s3cret")).read()
+        assert b"/debug/efficiency" in landing
     finally:
         srv.stop()
 

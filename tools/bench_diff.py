@@ -85,6 +85,9 @@ PINNED = {
     # FleetLens lock on the hub's refresh thread — its cost is refresh
     # latency, so a rise is a regression.
     "fleet_localize_ms": +1,
+    # ISSUE 20: the waste-scoring pass shares that refresh thread —
+    # same contract: a rise is a regression.
+    "fleet_efficiency_ms_per_refresh": +1,
 }
 
 

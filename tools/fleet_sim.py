@@ -2,7 +2,7 @@
 """Fleet-lens simulation smoke (ISSUE 5 satellite, `make fleet-sim`):
 spin N REAL daemons (full Daemon wiring: TPU backend over make_sysfs +
 FakeLibtpuServer, FakeKubelet-backed PodResources attribution) plus one
-hub scraping all of them, and run two fault-injection scenarios:
+hub scraping all of them, and run fault-injection scenarios:
 
 - **straggler**: a scripted RPC delay on one node's fake runtime; the
   fleet lens must attribute the slowness to that node — end to end
@@ -16,6 +16,13 @@ hub scraping all of them, and run two fault-injection scenarios:
   innocent neighbors), then after recovery `doctor --fleet --at` must
   still localize the cleared fault retroactively out of the hub's
   history ring.
+- **waste** (ISSUE 20): one pod parks its chips at duty ~0 while still
+  holding the reservation; `doctor --efficiency` must name that pod
+  (and only that pod) out of the hub's signed energy/waste
+  attestation, the top-K waste ranking must export it, the verdict
+  must clear with a `fleet_waste_cleared` journal event once the pod
+  resumes stepping, and `--at` must replay the incident from the
+  history ring after the clear.
 
 Exit 0 with PASS lines when every scenario's verdict is right; exit 1
 with the evidence otherwise. Wired into `make ci` as a smoke job.
@@ -346,6 +353,215 @@ def run_link(nodes: int, verbose: bool) -> int:
                 fake.stop()
 
 
+def run_waste(nodes: int, verbose: bool) -> int:
+    """ISSUE 20 scenario: one pod holds its chips with duty ~0 among
+    healthy workers. `doctor --efficiency` must name that pod (and only
+    that pod), the top-K waste ranking must export it, the verdict must
+    clear with a fleet_waste_cleared journal event once the pod starts
+    working again, and `doctor --efficiency --at <incident>` must name
+    it retroactively out of the history ring after the clear."""
+    from kube_gpu_stats_tpu import doctor
+    from kube_gpu_stats_tpu.config import Config
+    from kube_gpu_stats_tpu.daemon import Daemon
+    from kube_gpu_stats_tpu.exposition import MetricsServer
+    from kube_gpu_stats_tpu.history import HistoryStore
+    from kube_gpu_stats_tpu.hub import Hub
+    from kube_gpu_stats_tpu.proto import tpumetrics
+    from kube_gpu_stats_tpu.testing.kubelet_server import (FakeKubeletServer,
+                                                           tpu_pod)
+    from kube_gpu_stats_tpu.testing.libtpu_server import FakeLibtpuServer
+    from kube_gpu_stats_tpu.testing.sysfs_fixture import make_sysfs
+
+    idle_index = 1  # healthy neighbors on both sides
+    idle_pod = f"train-{idle_index}"
+    audit_key = "fleet-sim-audit-key"
+    daemons: list = []
+    fakes: list = []
+    libtpus: list = []
+    hub = None
+    hub_server = None
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            targets = []
+            for node in range(nodes):
+                root = pathlib.Path(tmp) / f"waste{node}"
+                make_sysfs(root / "sys", num_chips=2)
+                libtpu = FakeLibtpuServer(num_chips=2).start()
+                libtpus.append(libtpu)
+                socket = str(root / "kubelet.sock")
+                kubelet = FakeKubeletServer(
+                    socket, [tpu_pod(f"train-{node}", "ml", "worker",
+                                     ["0", "1"])]).start()
+                fakes.extend([libtpu, kubelet])
+                cfg = Config(
+                    backend="tpu",
+                    sysfs_root=str(root / "sys"),
+                    libtpu_ports=(libtpu.port,),
+                    interval=0.1,
+                    deadline=2.0,
+                    listen_host="127.0.0.1",
+                    listen_port=0,
+                    attribution="podresources",
+                    kubelet_socket=socket,
+                    attribution_interval=0.5,
+                    pipeline_fetch=False,
+                    use_native=False,
+                )
+                daemon = Daemon(cfg)
+                daemon.start()
+                daemons.append(daemon)
+                targets.append(
+                    f"http://127.0.0.1:{daemon.server.port}/metrics")
+            for daemon in daemons:
+                daemon.registry.wait_for_publish(0, timeout=10)
+
+            history = HistoryStore()
+            # Small verdict knobs so the scenario runs in CI time: the
+            # warmup gate and the idle streak still both exercise (the
+            # pod is observed healthy through warmup, then must hold
+            # the idle shape 3 consecutive refreshes to be accused).
+            hub = Hub(targets, interval=0.2, expect_workers=nodes,
+                      history=history,
+                      waste_warmup_refreshes=4, waste_idle_refreshes=3,
+                      energy_audit_key=audit_key)
+            hub_server = MetricsServer(
+                hub.registry, host="127.0.0.1", port=0,
+                trace_provider=hub.tracer, fleet_provider=hub.fleet,
+                history_provider=history,
+                efficiency_provider=hub.efficiency_payload)
+            hub_server.start()
+            base = f"http://127.0.0.1:{hub_server.port}"
+
+            # Phase 1 — healthy warmup, past the warmup gate: every pod
+            # busy, zero verdicts allowed.
+            for _ in range(7):
+                time.sleep(0.3)
+                hub.refresh_once()
+            if hub.fleet.efficiency.suspects():
+                print("fleet-sim(waste) FAIL: waste verdict during "
+                      f"healthy warmup: "
+                      f"{hub.fleet.efficiency.suspects()}")
+                return 1
+
+            # Phase 2 — the idle reservation: train-1's chips park at
+            # duty 0 while the pod keeps holding them (the fake's
+            # scripted per-chip override; default duty is 50+chip).
+            for chip in range(2):
+                libtpus[idle_index].scripted[
+                    (tpumetrics.DUTY_CYCLE, chip)] = 0.0
+            for _ in range(8):
+                time.sleep(0.3)
+                hub.refresh_once()
+            incident_ts = time.time()
+
+            result = doctor.check_efficiency(base, audit_key)
+            if verbose:
+                print(f"[{result.status}] efficiency  {result.detail}")
+            attestation = (result.data or {}).get("attestation") or {}
+            suspects = (attestation.get("waste") or {}).get(
+                "suspects") or {}
+            ranking = [row.get("pod") for row in
+                       (attestation.get("waste") or {}).get(
+                           "top_waste") or []]
+            text = hub.registry.snapshot().render()
+            gauge_names_pod = any(
+                line.startswith("kts_fleet_waste_suspect")
+                and f'pod="{idle_pod}"' in line
+                and line.rstrip().endswith(" 1")
+                for line in text.splitlines())
+            chips_ranked = any(
+                line.startswith("kts_fleet_waste_chips")
+                and f'pod="{idle_pod}"' in line
+                for line in text.splitlines())
+            innocents = [name for name in suspects
+                         if name != f"ml/{idle_pod}"]
+            ok = (f"ml/{idle_pod}" in suspects
+                  and suspects[f"ml/{idle_pod}"].get("reason")
+                  == "idle-reservation"
+                  and not innocents
+                  and ranking and ranking[0] == idle_pod
+                  and "signature verified" in result.detail
+                  and gauge_names_pod and chips_ranked)
+            if not ok:
+                print("fleet-sim(waste) FAIL:")
+                print(f"  expected ml/{idle_pod} idle-reservation, "
+                      f"zero false accusations, signed attestation")
+                print(f"  suspects: {suspects}")
+                print(f"  top_waste pods: {ranking}")
+                print(f"  gauge named pod: {gauge_names_pod}, "
+                      f"chips ranked: {chips_ranked}")
+                print(f"  doctor detail: {result.detail}")
+                return 1
+
+            # A wrong local key must FAIL verification outright — the
+            # attested rollup is only as trustworthy as that verdict.
+            bad = doctor.check_efficiency(base, "some-other-key")
+            if bad.status != doctor.FAIL:
+                print("fleet-sim(waste) FAIL: wrong audit key did not "
+                      f"FAIL verification: [{bad.status}] {bad.detail}")
+                return 1
+
+            # Phase 3 — the pod starts working: scripted duty override
+            # dropped, verdict must clear and journal the recovery.
+            libtpus[idle_index].scripted.clear()
+            cleared = False
+            for _ in range(12):
+                time.sleep(0.3)
+                hub.refresh_once()
+                if not hub.fleet.efficiency.suspects():
+                    cleared = True
+                    break
+            if not cleared:
+                print("fleet-sim(waste) FAIL: verdict never cleared "
+                      f"after recovery: "
+                      f"{hub.fleet.efficiency.suspects()}")
+                return 1
+            events = doctor._fetch_json(
+                base + "/debug/events").get("events") or []
+            clear_events = [
+                event for event in events
+                if event.get("kind") == "fleet_waste_cleared"
+                and f"ml/{idle_pod}" in (event.get("detail") or "")]
+            if not clear_events:
+                print("fleet-sim(waste) FAIL: no fleet_waste_cleared "
+                      "journal event naming the recovered pod")
+                print(f"  events: {[e.get('kind') for e in events]}")
+                return 1
+
+            # Phase 4 — retroactive: who was wasting chips during the
+            # (already cleared) incident, out of the history ring.
+            at_result = doctor.check_efficiency_at(base, incident_ts)
+            if verbose:
+                print(f"[{at_result.status}] efficiency-at  "
+                      f"{at_result.detail}")
+            at_pods = [entry.get("pod") for entry in
+                       (at_result.data or {}).get("waste_suspects")
+                       or []]
+            if idle_pod not in at_pods:
+                print("fleet-sim(waste) FAIL: doctor --efficiency --at "
+                      "did not name the idle pod retroactively")
+                print(f"  waste_suspects: {at_pods}")
+                print(f"  detail: {at_result.detail}")
+                return 1
+
+            print(f"fleet-sim(waste) PASS: doctor --efficiency named "
+                  f"ml/{idle_pod} (idle-reservation, signed attestation "
+                  f"verified, wrong key FAILed), zero false "
+                  f"accusations, verdict cleared with a journal event, "
+                  f"and --at named it retroactively across {nodes} "
+                  f"nodes")
+            return 0
+        finally:
+            if hub_server is not None:
+                hub_server.stop()
+            if hub is not None:
+                hub.stop()
+            for daemon in daemons:
+                daemon.stop()
+            for fake in fakes:
+                fake.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=3)
@@ -360,7 +576,8 @@ def main(argv=None) -> int:
                              "scenario (the sick link needs healthy "
                              "neighbors on both sides)")
     parser.add_argument("--scenario", choices=("all", "straggler",
-                                               "link"), default="all")
+                                               "link", "waste"),
+                        default="all")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
     rc = 0
@@ -368,6 +585,8 @@ def main(argv=None) -> int:
         rc = run(args.nodes, args.refreshes, args.delay, args.verbose)
     if rc == 0 and args.scenario in ("all", "link"):
         rc = run_link(args.link_nodes, args.verbose)
+    if rc == 0 and args.scenario in ("all", "waste"):
+        rc = run_waste(args.nodes, args.verbose)
     return rc
 
 
